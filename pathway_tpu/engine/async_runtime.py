@@ -1,0 +1,36 @@
+"""Dedicated event loop for async UDF execution.
+
+The analog of the reference's current-thread tokio runtime
+(``src/async_runtime.rs``): one long-lived background loop thread serves all
+async-UDF microbatches, so blocking resolution works regardless of whether
+the calling thread has its own running loop (scripts, notebooks, connector
+threads alike).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Coroutine
+
+_loop: asyncio.AbstractEventLoop | None = None
+_loop_lock = threading.Lock()
+
+
+def get_event_loop() -> asyncio.AbstractEventLoop:
+    global _loop
+    with _loop_lock:
+        if _loop is None or _loop.is_closed():
+            loop = asyncio.new_event_loop()
+            thread = threading.Thread(
+                target=loop.run_forever, name="pathway-tpu:async", daemon=True
+            )
+            thread.start()
+            _loop = loop
+        return _loop
+
+
+def run_coroutine_blocking(coro: Coroutine) -> Any:
+    """Run a coroutine on the shared background loop; block until done."""
+    future = asyncio.run_coroutine_threadsafe(coro, get_event_loop())
+    return future.result()

@@ -1,0 +1,157 @@
+"""Columnar delta batches — the unit of data flowing between engine operators.
+
+The reference moves per-row ``(key, tuple, time, diff)`` triples through
+timely exchange channels (``external/differential-dataflow``). Here a batch is
+a **struct-of-arrays**: a uint64 key vector, aligned value columns (typed numpy
+arrays for dense numeric data, object arrays otherwise) and an int64 diff
+vector, all for one logical timestamp. Dense columns can be handed to jitted
+XLA kernels without conversion; irregular columns stay on host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping
+
+import numpy as np
+
+from pathway_tpu.engine import value as value_mod
+
+
+class Batch:
+    """A set of keyed row deltas at a single logical time."""
+
+    __slots__ = ("keys", "cols", "diffs")
+
+    def __init__(
+        self,
+        keys: np.ndarray,
+        cols: dict[str, np.ndarray],
+        diffs: np.ndarray | None = None,
+    ):
+        keys = np.asarray(keys, dtype=np.uint64)
+        self.keys = keys
+        self.cols = cols
+        if diffs is None:
+            diffs = np.ones(len(keys), dtype=np.int64)
+        self.diffs = np.asarray(diffs, dtype=np.int64)
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def __repr__(self) -> str:
+        return f"Batch(n={len(self)}, cols={list(self.cols)})"
+
+    @property
+    def column_names(self) -> list[str]:
+        return list(self.cols)
+
+    def rows(self) -> Iterable[tuple[int, tuple, int]]:
+        """Iterate (key, row_tuple, diff)."""
+        names = list(self.cols)
+        col_arrays = [self.cols[n] for n in names]
+        keys = self.keys
+        diffs = self.diffs
+        for i in range(len(keys)):
+            yield int(keys[i]), tuple(c[i] for c in col_arrays), int(diffs[i])
+
+    def take(self, mask_or_idx: np.ndarray) -> "Batch":
+        if mask_or_idx.dtype == bool:
+            idx = np.nonzero(mask_or_idx)[0]
+        else:
+            idx = mask_or_idx
+        return Batch(
+            self.keys[idx],
+            {n: c[idx] for n, c in self.cols.items()},
+            self.diffs[idx],
+        )
+
+    def with_cols(self, cols: dict[str, np.ndarray]) -> "Batch":
+        return Batch(self.keys, cols, self.diffs)
+
+    def rename(self, mapping: Mapping[str, str]) -> "Batch":
+        return Batch(
+            self.keys,
+            {mapping.get(n, n): c for n, c in self.cols.items()},
+            self.diffs,
+        )
+
+    def select_cols(self, names: list[str]) -> "Batch":
+        return Batch(self.keys, {n: self.cols[n] for n in names}, self.diffs)
+
+    def negate(self) -> "Batch":
+        return Batch(self.keys, self.cols, -self.diffs)
+
+    @staticmethod
+    def empty(column_names: Iterable[str]) -> "Batch":
+        return Batch(
+            np.empty(0, dtype=np.uint64),
+            {n: np.empty(0, dtype=object) for n in column_names},
+            np.empty(0, dtype=np.int64),
+        )
+
+    @staticmethod
+    def from_rows(
+        column_names: list[str],
+        rows: list[tuple[int, tuple, int]],
+    ) -> "Batch":
+        n = len(rows)
+        keys = np.empty(n, dtype=np.uint64)
+        diffs = np.empty(n, dtype=np.int64)
+        cols = {name: np.empty(n, dtype=object) for name in column_names}
+        names = list(column_names)
+        for i, (k, row, d) in enumerate(rows):
+            keys[i] = k
+            diffs[i] = d
+            for j, name in enumerate(names):
+                cols[name][i] = row[j]
+        return Batch(keys, cols, diffs)
+
+
+def concat_batches(batches: list[Batch]) -> Batch | None:
+    batches = [b for b in batches if b is not None and len(b) > 0]
+    if not batches:
+        return None
+    if len(batches) == 1:
+        return batches[0]
+    names = batches[0].column_names
+    keys = np.concatenate([b.keys for b in batches])
+    diffs = np.concatenate([b.diffs for b in batches])
+    cols = {}
+    for n in names:
+        arrays = [b.cols[n] for b in batches]
+        if all(a.dtype == arrays[0].dtype and a.dtype != object for a in arrays):
+            cols[n] = np.concatenate(arrays)
+        else:
+            cols[n] = np.concatenate([a.astype(object) for a in arrays])
+    return Batch(keys, cols, diffs)
+
+
+def row_hashes(batch: Batch) -> np.ndarray:
+    """Per-row content hash over value columns (for consolidation grouping)."""
+    return value_mod.keys_for_value_columns(
+        [batch.cols[n] for n in batch.column_names], len(batch)
+    )
+
+
+def consolidate(batch: Batch | None) -> Batch | None:
+    """Sum diffs of identical (key, row) pairs; drop zero-diff rows."""
+    if batch is None or len(batch) == 0:
+        return None
+    rh = row_hashes(batch)
+    combo = np.empty(len(batch), dtype=[("k", np.uint64), ("r", np.uint64)])
+    combo["k"] = batch.keys
+    combo["r"] = rh
+    uniq, first_idx, inverse = np.unique(
+        combo, return_index=True, return_inverse=True
+    )
+    if len(uniq) == len(batch) and np.all(batch.diffs != 0):
+        return batch
+    summed = np.zeros(len(uniq), dtype=np.int64)
+    np.add.at(summed, inverse, batch.diffs)
+    keep = summed != 0
+    if not np.any(keep):
+        return None
+    idx = first_idx[keep]
+    out = batch.take(idx)
+    out.diffs = summed[keep]
+    return out

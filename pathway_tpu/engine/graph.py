@@ -1,0 +1,92 @@
+"""Engine operator graph.
+
+The analog of the reference's ``Graph`` trait + dataflow construction
+(``src/engine/graph.rs``, ``src/engine/dataflow.rs``), redesigned: operators
+are columnar-batch transformers wired into a DAG; a scheduler pumps logical
+epochs through the DAG in timestamp order (totally-ordered times make
+epoch-synchronous execution equivalent to differential dataflow's
+single-dimension case).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from pathway_tpu.engine.batch import Batch, concat_batches, consolidate
+
+
+class Node:
+    """Base engine operator."""
+
+    _ids = itertools.count()
+
+    def __init__(self, graph: "EngineGraph", inputs: list["Node"], column_names: list[str], name: str = ""):
+        self.id = next(Node._ids)
+        self.graph = graph
+        self.inputs = list(inputs)
+        self.column_names = list(column_names)
+        self.name = name or type(self).__name__
+        self.trace = None  # user frame attribution
+        graph.add_node(self)
+
+    def __repr__(self):
+        return f"<{self.name}#{self.id}>"
+
+    # --- execution interface ---
+    def step(self, time: int, ins: list[Batch | None]) -> Batch | None:
+        """Process one epoch's input deltas; return output deltas."""
+        raise NotImplementedError
+
+    def on_time_end(self, time: int) -> list[tuple[int, Batch]]:
+        """Called after epoch ``time`` is complete everywhere; may emit
+        deltas at strictly later times (buffer releases, async results)."""
+        return []
+
+    def reset(self) -> None:
+        """Drop run-scoped state (engine graphs can be executed repeatedly)."""
+
+
+class EngineGraph:
+    def __init__(self, parent: "EngineGraph | None" = None):
+        self.nodes: list[Node] = []
+        self.parent = parent
+
+    def add_node(self, node: Node) -> None:
+        self.nodes.append(node)
+
+    def topo_order(self, targets: Iterable[Node] | None = None) -> list[Node]:
+        """Topological order of nodes reaching ``targets`` (tree-shaken);
+        all nodes if targets is None."""
+        if targets is None:
+            wanted = set(n.id for n in self.nodes)
+        else:
+            wanted = set()
+            stack = list(targets)
+            while stack:
+                n = stack.pop()
+                if n.id in wanted:
+                    continue
+                wanted.add(n.id)
+                stack.extend(i for i in n.inputs if i.graph is self)
+        order: list[Node] = []
+        seen: set[int] = set()
+
+        def visit(n: Node):
+            if n.id in seen or n.id not in wanted:
+                return
+            seen.add(n.id)
+            for i in n.inputs:
+                if i.graph is self:
+                    visit(i)
+            order.append(n)
+
+        for n in self.nodes:
+            visit(n)
+        return order
+
+    def reset_all(self) -> None:
+        for n in self.nodes:
+            n.reset()

@@ -1,0 +1,65 @@
+"""As-of-now join: left rows are answered once, against the right state at
+their arrival epoch; later right-side updates do not retrigger old results.
+
+Reference parity: ``stdlib/temporal/_asof_now_join.py`` + the engine's
+``use_external_index_as_of_now`` request/response semantics (forget-style
+query streams). Key mode defaults to preserving left ids (request/response
+correlation).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from pathway_tpu.engine.batch import Batch
+from pathway_tpu.engine.graph import Node
+from pathway_tpu.engine.operators.join import JoinNode
+from pathway_tpu.engine.value import hash_values
+from pathway_tpu.internals.errors import get_global_error_log
+
+
+class AsofNowJoinNode(JoinNode):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._left_emitted: dict[int, dict[int, tuple]] = {}
+
+    def reset(self):
+        super().reset()
+        self._left_emitted = {}
+
+    def step(self, time, ins):
+        lb, rb = ins
+        # right side: just maintain state (no retriggering)
+        if rb is not None:
+            self._apply_side(
+                self._right, rb, self.inputs[1].column_names, self.right_on
+            )
+        if lb is None:
+            return None
+        rows: list[tuple[int, tuple, int]] = []
+        lnames = self.inputs[0].column_names
+        lid_idx = None
+        for key, lrow, diff in lb.rows():
+            if diff > 0:
+                jk = self._jk_of(lrow, lnames, self.left_on)
+                if jk is None:
+                    get_global_error_log().log("Error value in join key")
+                    continue
+                rbucket = self._right.get(jk, {})
+                emitted: dict[int, tuple] = {}
+                if rbucket:
+                    for rk, rrow in rbucket.items():
+                        out_key = self._out_key(key, rk)
+                        emitted[out_key] = self._make_row(lrow, rrow)
+                elif self.mode in ("left", "outer"):
+                    emitted[self._out_key(key, None)] = self._make_row(lrow, None)
+                for k, row in emitted.items():
+                    rows.append((k, row, 1))
+                self._left_emitted[key] = emitted
+            else:
+                emitted = self._left_emitted.pop(key, {})
+                for k, row in emitted.items():
+                    rows.append((k, row, -1))
+        if not rows:
+            return None
+        return Batch.from_rows(self.column_names, rows)

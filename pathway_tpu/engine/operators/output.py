@@ -1,0 +1,96 @@
+"""Output-side operators: capture, subscribe, connector sinks.
+
+Reference parity: ``output_table``/``subscribe_table`` (dataflow.rs:3542,3645)
+with per-time consolidated batches (``ConsolidateForOutput``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine.batch import Batch
+from pathway_tpu.engine.graph import Node
+from pathway_tpu.engine.state import TableState
+
+
+class CaptureNode(Node):
+    """Materializes the final table (used by debug/compute-and-print paths
+    and as the engine's ``ExportedTable``)."""
+
+    def __init__(self, graph, input_node, name="Capture"):
+        super().__init__(graph, [input_node], input_node.column_names, name)
+        self.state = TableState(input_node.column_names)
+        self.updates: list[tuple[int, Batch]] = []
+
+    def reset(self):
+        self.state = TableState(self.column_names)
+        self.updates = []
+
+    def step(self, time, ins):
+        (batch,) = ins
+        if batch is None or len(batch) == 0:
+            return None
+        self.state.apply(batch)
+        self.updates.append((time, batch))
+        return batch
+
+
+class SubscribeNode(Node):
+    """Calls back per delta row, per time flush and at end-of-stream."""
+
+    def __init__(
+        self,
+        graph,
+        input_node,
+        on_change: Callable | None = None,
+        on_time_end: Callable | None = None,
+        on_end: Callable | None = None,
+        skip_errors: bool = True,
+        name="Subscribe",
+    ):
+        super().__init__(graph, [input_node], input_node.column_names, name)
+        self.on_change = on_change
+        self.on_time_end_cb = on_time_end
+        self.on_end_cb = on_end
+        self.skip_errors = skip_errors
+        self._saw_data_at: int | None = None
+
+    def step(self, time, ins):
+        (batch,) = ins
+        self._saw_data_at = time
+        if batch is not None and len(batch) > 0 and self.on_change is not None:
+            from pathway_tpu.engine.value import ERROR, Pointer
+
+            for key, row, diff in batch.rows():
+                if self.skip_errors and any(v is ERROR for v in row):
+                    continue
+                self.on_change(
+                    Pointer(key),
+                    dict(zip(self.column_names, row)),
+                    time,
+                    diff > 0,
+                )
+        return batch
+
+    def on_time_end(self, time):
+        if self.on_time_end_cb is not None:
+            self.on_time_end_cb(time)
+        return []
+
+    def finish(self):
+        if self.on_end_cb is not None:
+            self.on_end_cb()
+
+
+class SinkNode(Node):
+    """Feeds consolidated batches to a writer callable (I/O connectors)."""
+
+    def __init__(self, graph, input_node, write_batch: Callable, name="Sink"):
+        super().__init__(graph, [input_node], input_node.column_names, name)
+        self.write_batch = write_batch
+
+    def step(self, time, ins):
+        (batch,) = ins
+        if batch is not None and len(batch) > 0:
+            self.write_batch(time, batch)
+        return batch

@@ -1,0 +1,140 @@
+"""Epoch scheduler — the engine's worker main loop.
+
+The analog of the reference's timely worker pump (``worker.step_or_park``,
+``src/engine/dataflow.rs:5595-5648``): delivers input deltas through the DAG
+in strict timestamp order. Totally-ordered logical times (reference
+``src/engine/timestamp.rs``: even = connector commits, odd = internal
+retractions) make the epoch-synchronous pass equivalent to differential
+dataflow progress tracking in the single-dimension case.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Callable
+
+from pathway_tpu.engine.batch import Batch, concat_batches, consolidate
+from pathway_tpu.engine.graph import EngineGraph, Node
+
+
+class Scheduler:
+    def __init__(self, graph: EngineGraph, targets: list[Node] | None = None):
+        self.graph = graph
+        self.order = graph.topo_order(targets)
+        self._order_ids = {n.id for n in self.order}
+        self._lock = threading.Condition()
+        # time -> node_id -> [Batch]; injected events (inputs + late emissions)
+        self._pending: dict[int, dict[int, list[Batch]]] = defaultdict(
+            lambda: defaultdict(list)
+        )
+        self._node_by_id = {n.id: n for n in self.order}
+        for n in self.order:
+            n.scheduler = self
+        # live sources: node_id -> current lower bound on future event times
+        self._source_frontiers: dict[int, int] = {}
+        self._async_inflight = 0
+        self._stopped = False
+        self.current_time: int = -1
+
+    # ------------------------------------------------------------------ inputs
+    def register_source(self, node: Node, initial_time: int = 0) -> None:
+        with self._lock:
+            self._source_frontiers[node.id] = initial_time
+
+    def advance_source(self, node: Node, new_time: int) -> None:
+        with self._lock:
+            self._source_frontiers[node.id] = new_time
+            self._lock.notify_all()
+
+    def close_source(self, node: Node) -> None:
+        with self._lock:
+            self._source_frontiers.pop(node.id, None)
+            self._lock.notify_all()
+
+    def inject(self, node: Node, time: int, batch: Batch) -> None:
+        """Thread-safe event injection (connector threads, async UDF results)."""
+        if batch is None or len(batch) == 0:
+            return
+        with self._lock:
+            self._pending[time][node.id].append(batch)
+            self._lock.notify_all()
+
+    def async_begin(self) -> None:
+        with self._lock:
+            self._async_inflight += 1
+
+    def async_done(self) -> None:
+        with self._lock:
+            self._async_inflight -= 1
+            self._lock.notify_all()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._lock.notify_all()
+
+    # ------------------------------------------------------------------ loop
+    def _ready_times(self) -> list[int]:
+        """Times safe to process: below every live source frontier."""
+        if not self._pending:
+            return []
+        frontier = min(self._source_frontiers.values(), default=None)
+        times = sorted(self._pending.keys())
+        if frontier is None:
+            return times
+        return [t for t in times if t < frontier]
+
+    def run(self) -> None:
+        """Process events until all sources are closed and queues drain."""
+        while True:
+            with self._lock:
+                while True:
+                    if self._stopped:
+                        return
+                    ready = self._ready_times()
+                    if ready:
+                        break
+                    if (
+                        not self._source_frontiers
+                        and not self._pending
+                        and self._async_inflight == 0
+                    ):
+                        return
+                    self._lock.wait(timeout=0.5)
+                t = ready[0]
+                injected = self._pending.pop(t)
+            self._run_epoch(t, injected)
+
+    def run_available(self) -> bool:
+        """Process everything currently ready; don't block. Returns whether
+        any epoch ran (used by bounded/interactive drivers)."""
+        ran = False
+        while True:
+            with self._lock:
+                ready = self._ready_times()
+                if not ready:
+                    return ran
+                t = ready[0]
+                injected = self._pending.pop(t)
+            self._run_epoch(t, injected)
+            ran = True
+
+    def _run_epoch(self, t: int, injected: dict[int, list[Batch]]) -> None:
+        self.current_time = t
+        outputs: dict[int, Batch | None] = {}
+        for node in self.order:
+            ins = [
+                outputs.get(i.id) if i.id in self._order_ids else None
+                for i in node.inputs
+            ]
+            out = node.step(t, ins)
+            extra = injected.get(node.id)
+            if extra:
+                out = concat_batches([out] + extra) if out is not None else concat_batches(extra)
+            outputs[node.id] = consolidate(out) if out is not None else None
+        # epoch complete: notify operators; collect late emissions
+        for node in self.order:
+            for future_t, batch in node.on_time_end(t):
+                assert future_t > t, f"{node} emitted at non-future time {future_t}"
+                self.inject(node, future_t, batch)

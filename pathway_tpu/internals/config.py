@@ -1,0 +1,85 @@
+"""Runtime configuration (reference ``internals/config.py``).
+
+Env vars: PATHWAY_THREADS / PATHWAY_PROCESSES / PATHWAY_PROCESS_ID /
+PATHWAY_FIRST_PORT (worker topology), PATHWAY_IGNORE_ASSERTS,
+PATHWAY_RUNTIME_TYPECHECKING, PATHWAY_PERSISTENT_STORAGE,
+PATHWAY_LICENSE_KEY (accepted, unused — no license gating in this build).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class PathwayConfig:
+    ignore_asserts: bool = field(
+        default_factory=lambda: _env_bool("PATHWAY_IGNORE_ASSERTS")
+    )
+    runtime_typechecking: bool = field(
+        default_factory=lambda: _env_bool("PATHWAY_RUNTIME_TYPECHECKING")
+    )
+    terminate_on_error: bool = field(
+        default_factory=lambda: _env_bool("PATHWAY_TERMINATE_ON_ERROR", True)
+    )
+    license_key: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_LICENSE_KEY")
+    )
+    replay_storage: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_REPLAY_STORAGE")
+    )
+    persistence_mode: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_PERSISTENCE_MODE")
+    )
+    snapshot_access: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_SNAPSHOT_ACCESS")
+    )
+    process_id: int = field(
+        default_factory=lambda: int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+    )
+    monitoring_server: str | None = field(
+        default_factory=lambda: os.environ.get("PATHWAY_MONITORING_SERVER")
+    )
+
+    @property
+    def threads(self) -> int:
+        return int(os.environ.get("PATHWAY_THREADS", "1"))
+
+    @property
+    def processes(self) -> int:
+        return int(os.environ.get("PATHWAY_PROCESSES", "1"))
+
+    @property
+    def first_port(self) -> int:
+        return int(os.environ.get("PATHWAY_FIRST_PORT", "10000"))
+
+
+pathway_config = PathwayConfig()
+
+_persistence_config: Any = None
+
+
+def set_persistence_config(cfg: Any) -> None:
+    global _persistence_config
+    _persistence_config = cfg
+
+
+def get_persistence_config() -> Any:
+    return _persistence_config
+
+
+def set_license_key(key: str | None) -> None:
+    pathway_config.license_key = key
+
+
+def set_monitoring_config(*, server_endpoint: str | None) -> None:
+    pathway_config.monitoring_server = server_endpoint

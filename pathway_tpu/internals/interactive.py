@@ -1,0 +1,19 @@
+"""LiveTable — background run with live snapshot display (reference
+``internals/interactive.py``). Minimal parity: snapshot() re-runs the
+captured subgraph; rich-based live view comes with the monitoring module.
+"""
+
+from __future__ import annotations
+
+
+class LiveTable:
+    def __init__(self, table):
+        self._table = table
+
+    def snapshot(self):
+        from pathway_tpu.debug import table_to_pandas
+
+        return table_to_pandas(self._table)
+
+    def _repr_html_(self):
+        return self.snapshot()._repr_html_()

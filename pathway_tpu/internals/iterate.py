@@ -1,0 +1,187 @@
+"""``pw.iterate`` — fixed-point iteration.
+
+Parity with reference ``Table.iterate``/``pw.iterate`` (engine ``iterate``,
+dataflow.rs:3737; Python ``IterateOperator``): run a body function mapping
+tables to tables until the iterated tables stop changing (or iteration_limit).
+
+Engine design: the body is captured once as a sub-dataflow; each outer epoch
+that changes the inputs recomputes the fixpoint and emits the output delta
+(non-incremental across iterations, incremental at the outer boundary — the
+totally-ordered-time analog of nested differential scopes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine.batch import Batch
+from pathway_tpu.engine.graph import EngineGraph, Node
+from pathway_tpu.engine.operators.core import InputNode
+from pathway_tpu.engine.operators.output import CaptureNode
+from pathway_tpu.engine.scheduler import Scheduler
+from pathway_tpu.engine.state import TableState
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.universe import Universe
+
+
+class _IterationResult(dict):
+    """Mapping of output name -> Table, attribute-accessible."""
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name)
+
+
+class IterateNode(Node):
+    """Engine node embedding a sub-dataflow executed to fixpoint."""
+
+    def __init__(
+        self,
+        graph,
+        outer_inputs: list[Node],
+        subgraph: EngineGraph,
+        sub_inputs: list[InputNode],  # iterated entry nodes
+        sub_outputs: list[Node],  # corresponding body outputs (same order)
+        result_node_index: int,
+        iteration_limit: int | None,
+        name="Iterate",
+    ):
+        super().__init__(
+            graph,
+            outer_inputs,
+            sub_outputs[result_node_index].column_names,
+            name,
+        )
+        self.subgraph = subgraph
+        self.sub_inputs = sub_inputs
+        self.sub_outputs = sub_outputs
+        self.result_node_index = result_node_index
+        self.iteration_limit = iteration_limit
+        self._in_states = [TableState(i.column_names) for i in outer_inputs]
+        self._emitted: dict[int, tuple] = {}
+
+    def reset(self):
+        self._in_states = [TableState(i.column_names) for i in self.inputs]
+        self._emitted = {}
+
+    def step(self, time, ins):
+        changed = False
+        for st, batch in zip(self._in_states, ins):
+            if batch is not None and len(batch) > 0:
+                st.apply(batch)
+                changed = True
+        if not changed:
+            return None
+        # fixpoint: current collections start as the outer inputs
+        currents = [dict(st.rows) for st in self._in_states]
+        limit = self.iteration_limit if self.iteration_limit is not None else 10_000
+        from pathway_tpu.engine.state import rows_equal
+
+        def tables_equal(a, b):
+            return all(
+                set(x) == set(y)
+                and all(rows_equal(x[k], y[k]) for k in x)
+                for x, y in zip(a, b)
+            )
+
+        for _round in range(limit):
+            outs = self._run_body(currents)
+            if tables_equal(outs, currents):
+                currents = outs
+                break
+            currents = outs
+        result = currents[self.result_node_index]
+        from pathway_tpu.engine.operators.core import diff_tables
+
+        out = diff_tables(self._emitted, result, self.column_names)
+        self._emitted = result
+        return out
+
+    def _run_body(self, currents: list[dict[int, tuple]]) -> list[dict[int, tuple]]:
+        captures = [
+            CaptureNode(self.subgraph, o) for o in self.sub_outputs
+        ] if not hasattr(self, "_captures") else self._captures
+        self._captures = captures
+        sched = Scheduler(self.subgraph, captures)
+        for n in sched.order:
+            n.reset()
+        for inp, rows in zip(self.sub_inputs, currents):
+            sched.register_source(inp, 0)
+        for inp, rows in zip(self.sub_inputs, currents):
+            if rows:
+                batch = Batch.from_rows(
+                    inp.column_names, [(k, r, 1) for k, r in rows.items()]
+                )
+                sched.inject(inp, 0, batch)
+            sched.close_source(inp)
+        sched.run()
+        return [dict(c.state.rows) for c in captures]
+
+
+def iterate(
+    body: Callable,
+    iteration_limit: int | None = None,
+    **kwargs,
+):
+    """Iterate ``body`` to fixpoint over the keyword tables.
+
+    ``body`` receives tables (same names as kwargs) and returns a dict /
+    namespace of tables with the same keys; iteration continues until
+    nothing changes.
+    """
+    from pathway_tpu.internals.table import Table
+    from pathway_tpu.internals import schema as schema_mod
+
+    names = list(kwargs.keys())
+    outer_tables: list[Table] = [kwargs[n] for n in names]
+
+    subgraph = EngineGraph(parent=G.engine_graph)
+    sub_inputs: list[InputNode] = []
+    sub_tables: list[Table] = []
+    # build placeholder tables backed by subgraph input nodes
+    import pathway_tpu.internals.parse_graph as pg
+
+    outer_engine_graph = G.engine_graph
+    pg.G.engine_graph = subgraph
+    try:
+        for t in outer_tables:
+            inode = InputNode(subgraph, list(t.column_names()), name="IterateIn")
+            sub_inputs.append(inode)
+            sub_tables.append(Table(inode, t._schema, Universe()))
+        result = body(**dict(zip(names, sub_tables)))
+        if isinstance(result, dict):
+            result_items = list(result.items())
+        else:
+            result_items = [(n, getattr(result, n)) for n in names]
+    finally:
+        pg.G.engine_graph = outer_engine_graph
+
+    # the iterated outputs, aligned with inputs by name
+    out_by_name = dict(result_items)
+    sub_outputs = []
+    for n in names:
+        if n not in out_by_name:
+            raise ValueError(f"iterate body must return table {n!r}")
+        sub_outputs.append(out_by_name[n]._node)
+
+    results = _IterationResult()
+    for idx, n in enumerate(names):
+        node = IterateNode(
+            G.engine_graph,
+            [t._node for t in outer_tables],
+            subgraph,
+            sub_inputs,
+            sub_outputs,
+            idx,
+            iteration_limit,
+        )
+        results[n] = Table(node, out_by_name[n]._schema, Universe())
+    if len(names) == 1:
+        return results[names[0]]
+    return results
+
+
+def iterate_universe(body, **kwargs):
+    return iterate(body, **kwargs)

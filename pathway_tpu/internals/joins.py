@@ -1,0 +1,267 @@
+"""Join API — ``t1.join(t2, t1.a == t2.b).select(...)``.
+
+Parity with reference ``internals/joins.py``: inner/left/right/outer modes,
+``pw.left``/``pw.right`` desugaring, id-preservation via ``id=``, instance
+colocation. Lowered to the engine's incremental hash join.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.operators import core as core_ops
+from pathway_tpu.engine.operators.join import JoinNode
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals import thisclass
+from pathway_tpu.internals.desugaring import substitute
+from pathway_tpu.internals.expression import (
+    ColumnBinaryOpExpression,
+    ColumnExpression,
+    ColumnReference,
+)
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.type_interpreter import infer_dtype
+from pathway_tpu.internals.universe import Universe
+
+
+def join(
+    left_table,
+    right_table,
+    *on,
+    id=None,
+    how="inner",
+    left_instance=None,
+    right_instance=None,
+):
+    from pathway_tpu.internals.table import Table
+
+    if hasattr(how, "value"):
+        how = how.value
+    return JoinResult(
+        left_table, right_table, list(on), id, how, left_instance, right_instance
+    )
+
+
+class JoinResult:
+    """Lazy join — materialized by ``select``/``reduce``."""
+
+    def __init__(self, left, right, on, id_, how, left_instance, right_instance):
+        from pathway_tpu.internals.table import Table
+
+        self._left = left
+        self._right = right
+        self._how = how
+        self._id = id_
+        left_exprs: list[ColumnExpression] = []
+        right_exprs: list[ColumnExpression] = []
+        for cond in on:
+            if not isinstance(cond, ColumnBinaryOpExpression) or cond._operator != "==":
+                raise ValueError(f"join condition must be `left == right`, got {cond!r}")
+            lexpr = substitute(cond._left, {thisclass.left: left, thisclass.this: left})
+            rexpr = substitute(cond._right, {thisclass.right: right, thisclass.this: right})
+            left_exprs.append(self._bind(lexpr, left))
+            right_exprs.append(self._bind(rexpr, right))
+        if left_instance is not None:
+            left_exprs.append(self._bind(substitute(left_instance, {thisclass.this: left}), left))
+            right_exprs.append(self._bind(substitute(right_instance, {thisclass.this: right}), right))
+        self._left_on = left_exprs
+        self._right_on = right_exprs
+
+    @staticmethod
+    def _bind(e, table):
+        return substitute(e, {thisclass.this: table})
+
+    def _build(self):
+        """Create the engine join node producing prefixed columns."""
+        from pathway_tpu.internals.table import _prepare_env
+        from pathway_tpu.engine.operators.core import RowwiseNode
+
+        left, right = self._left, self._right
+        # prelude on each side: all columns + join keys + id
+        lexprs = {f"__c_{n}": ColumnReference(left, n) for n in left.column_names()}
+        lexprs["__id"] = ColumnReference(left, "id")
+        for i, e in enumerate(self._left_on):
+            lexprs[f"__jk{i}"] = e
+        env, rw = _prepare_env(left, lexprs)
+        lprep = RowwiseNode(G.engine_graph, env, rw)
+
+        rexprs = {f"__c_{n}": ColumnReference(right, n) for n in right.column_names()}
+        rexprs["__id"] = ColumnReference(right, "id")
+        for i, e in enumerate(self._right_on):
+            rexprs[f"__jk{i}"] = e
+        env, rw = _prepare_env(right, rexprs)
+        rprep = RowwiseNode(G.engine_graph, env, rw)
+
+        jk_cols = [f"__jk{i}" for i in range(len(self._left_on))]
+        key_mode = "pair"
+        if self._id is not None:
+            idref = self._id
+            if isinstance(idref, ColumnReference):
+                if idref._table is self._left or idref._table is thisclass.left:
+                    key_mode = "left"
+                elif idref._table is self._right or idref._table is thisclass.right:
+                    key_mode = "right"
+        output_spec = (
+            [(f"__l_{n}", "left", f"__c_{n}") for n in left.column_names()]
+            + [("__l_id", "left", "__id")]
+            + [(f"__r_{n}", "right", f"__c_{n}") for n in right.column_names()]
+            + [("__r_id", "right", "__id")]
+        )
+        node = JoinNode(
+            G.engine_graph,
+            lprep,
+            rprep,
+            jk_cols,
+            jk_cols,
+            self._how,
+            output_spec,
+            key_mode=key_mode,
+        )
+        return node
+
+    def _rewrite_sel(self, e):
+        """Rewrite pw.left/pw.right/table references to join-output env names."""
+        left, right = self._left, self._right
+
+        def rw(e):
+            import copy
+
+            if isinstance(e, ColumnReference):
+                t = e._table
+                if t is thisclass.left or t is left:
+                    return ColumnReference(None, "__l_id" if e._name == "id" else f"__l_{e._name}")
+                if t is thisclass.right or t is right:
+                    return ColumnReference(None, "__r_id" if e._name == "id" else f"__r_{e._name}")
+                if t is thisclass.this:
+                    # unqualified this: resolve against left then right
+                    if e._name in left.column_names():
+                        return ColumnReference(None, f"__l_{e._name}")
+                    if e._name in right.column_names():
+                        return ColumnReference(None, f"__r_{e._name}")
+                    raise ValueError(f"unknown column {e._name!r} in join select")
+                if t is None:
+                    return e
+                raise ValueError(
+                    f"reference to table not part of this join: {e!r}"
+                )
+            e = copy.copy(e)
+            for attr in ("_left", "_right", "_expr", "_if", "_then", "_else",
+                         "_val", "_obj", "_index", "_default", "_replacement",
+                         "_instance", "_key_expr"):
+                if hasattr(e, attr):
+                    v = getattr(e, attr)
+                    if isinstance(v, ColumnExpression):
+                        setattr(e, attr, rw(v))
+            if hasattr(e, "_args"):
+                e._args = tuple(
+                    rw(a) if isinstance(a, ColumnExpression) else a for a in e._args
+                )
+            if hasattr(e, "_kwargs") and isinstance(e._kwargs, dict):
+                e._kwargs = {
+                    k: (rw(v) if isinstance(v, ColumnExpression) else v)
+                    for k, v in e._kwargs.items()
+                }
+            return e
+
+        return rw(e)
+
+    def _expand_select_args(self, args) -> dict[str, ColumnExpression]:
+        exprs: dict[str, ColumnExpression] = {}
+        left, right = self._left, self._right
+        for a in args:
+            if isinstance(a, thisclass._StarMarker):
+                src = a.placeholder
+                if src is thisclass.left:
+                    for n in left.column_names():
+                        if n not in a.excluded:
+                            exprs[n] = ColumnReference(thisclass.left, n)
+                elif src is thisclass.right:
+                    for n in right.column_names():
+                        if n not in a.excluded:
+                            exprs[n] = ColumnReference(thisclass.right, n)
+                else:  # pw.this in a join select: all columns from both
+                    for n in left.column_names():
+                        if n not in a.excluded:
+                            exprs[n] = ColumnReference(thisclass.left, n)
+                    for n in right.column_names():
+                        if n not in a.excluded and n not in exprs:
+                            exprs[n] = ColumnReference(thisclass.right, n)
+            elif isinstance(a, thisclass._WithoutHelper):
+                exprs.update(self._expand_select_args(list(a)))
+            elif isinstance(a, ColumnReference):
+                exprs[a.name] = a
+            else:
+                raise ValueError(f"bad positional select argument {a!r}")
+        return exprs
+
+    def select(self, *args, **kwargs):
+        from pathway_tpu.internals.table import Table
+        from pathway_tpu.engine.operators.core import RowwiseNode
+
+        node = self._build()
+        exprs = self._expand_select_args(args)
+        for name, e in kwargs.items():
+            exprs[name] = expr_mod.smart_coerce(e)
+        rewritten = {n: self._rewrite_sel(e) for n, e in exprs.items()}
+        out = RowwiseNode(G.engine_graph, node, rewritten)
+        defs = {}
+        for name, orig in exprs.items():
+            dtype = self._infer_joined(orig)
+            defs[name] = schema_mod.ColumnDefinition(dtype=dtype, name=name)
+        schema = schema_mod.schema_builder_from_definitions(defs)
+        return Table(out, schema, Universe())
+
+    def _infer_joined(self, e) -> dt.DType:
+        left, right = self._left, self._right
+
+        def dtype_of(e):
+            if isinstance(e, ColumnReference):
+                t = e._table
+                if t is thisclass.left:
+                    t = left
+                elif t is thisclass.right:
+                    t = right
+                if t in (left, right):
+                    base = (
+                        dt.Pointer(t._schema)
+                        if e._name == "id"
+                        else t._schema.__columns__[e._name].dtype
+                    )
+                    # outer joins pad with None
+                    if (t is left and self._how in ("right", "outer")) or (
+                        t is right and self._how in ("left", "outer")
+                    ):
+                        return dt.Optional(base)
+                    return base
+            return None
+
+        d = dtype_of(e)
+        if d is not None:
+            return d
+        return infer_dtype(e, left)
+
+    def filter(self, expression):
+        return self.select(
+            *[ColumnReference(thisclass.left, n) for n in self._left.column_names()],
+            __join_filter__=expression,
+        ).filter(ColumnReference(thisclass.this, "__join_filter__")).without(
+            "__join_filter__"
+        )
+
+    def reduce(self, *args, **kwargs):
+        return self.select(
+            *[ColumnReference(thisclass.left, n) for n in self._left.column_names()]
+        ).reduce(*args, **kwargs)
+
+    def groupby(self, *args, **kwargs):
+        full = self.select(
+            *[ColumnReference(thisclass.left, n) for n in self._left.column_names()],
+            **{
+                n: ColumnReference(thisclass.right, n)
+                for n in self._right.column_names()
+                if n not in self._left.column_names()
+            },
+        )
+        return full.groupby(*args, **kwargs)

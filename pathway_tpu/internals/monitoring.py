@@ -1,0 +1,17 @@
+"""Monitoring — levels + console dashboard (reference ``internals/monitoring.py``).
+
+The rich-based live dashboard fed by engine probes arrives with the
+observability subsystem; MonitoringLevel is part of the run() surface now.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class MonitoringLevel(enum.Enum):
+    AUTO = 0
+    AUTO_ALL = 1
+    NONE = 2
+    IN_OUT = 3
+    ALL = 4

@@ -1,0 +1,106 @@
+"""``pw.run`` and the graph runner.
+
+Parity with reference ``internals/run.py`` + ``graph_runner/__init__.py``:
+tree-shakes the engine graph from requested outputs, resets run-scoped state,
+feeds static sources, starts connector threads and pumps the scheduler until
+the frontier closes (or forever for unbounded streaming inputs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+from pathway_tpu.engine.graph import Node
+from pathway_tpu.engine.operators.output import CaptureNode, SubscribeNode
+from pathway_tpu.engine.scheduler import Scheduler
+from pathway_tpu.internals.parse_graph import G
+
+
+class GraphRunner:
+    def __init__(self, targets: list[Node]):
+        self.targets = targets
+
+    def run(self) -> None:
+        sched = Scheduler(G.engine_graph, self.targets)
+        involved = {n.id for n in sched.order}
+        for node in sched.order:
+            node.reset()
+        # static sources
+        static = [
+            (node, provider)
+            for node, provider in G.static_sources.values()
+            if node.id in involved
+        ]
+        for node, _ in static:
+            sched.register_source(node, 0)
+        connectors = [c for c in G.connectors if c.node.id in involved]
+        for c in connectors:
+            sched.register_source(c.node, 0)
+        for node, provider in static:
+            batch = provider()
+            if batch is not None and len(batch) > 0:
+                sched.inject(node, 0, batch)
+            sched.close_source(node)
+        for c in connectors:
+            c.start(sched)
+        try:
+            sched.run()
+            # end-of-stream: flush buffers repeatedly until quiescent
+            while True:
+                flushed = False
+                for node in sched.order:
+                    flush = getattr(node, "flush", None)
+                    if flush is None:
+                        continue
+                    rows = flush()
+                    if rows:
+                        from pathway_tpu.engine.batch import Batch
+
+                        t = max(sched.current_time + 1, 1)
+                        sched.inject(
+                            node, t, Batch.from_rows(node.column_names, rows)
+                        )
+                        flushed = True
+                if not flushed:
+                    break
+                sched.run()
+        finally:
+            for c in connectors:
+                c.stop()
+        for node in sched.order:
+            if isinstance(node, SubscribeNode):
+                node.finish()
+
+
+def run(
+    *,
+    debug: bool = False,
+    monitoring_level: Any = None,
+    with_http_server: bool = False,
+    default_logging: bool = True,
+    persistence_config: Any = None,
+    runtime_typechecking: bool | None = None,
+    license_key: str | None = None,
+    terminate_on_error: bool = True,
+    **kwargs,
+) -> None:
+    """Execute the dataflow: pump all registered outputs until input ends."""
+    from pathway_tpu.internals import config as config_mod
+
+    if persistence_config is not None:
+        config_mod.set_persistence_config(persistence_config)
+    targets = list(G.sinks)
+    if not targets:
+        return
+    GraphRunner(targets).run()
+
+
+def run_all(**kwargs) -> None:
+    run(**kwargs)
+
+
+def capture_table(table) -> CaptureNode:
+    """Attach (or reuse) a capture node for a table and run its subgraph."""
+    node = CaptureNode(G.engine_graph, table._node)
+    GraphRunner([node]).run()
+    return node

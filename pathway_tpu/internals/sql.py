@@ -1,0 +1,346 @@
+"""``pw.sql`` — SQL subset over tables.
+
+The reference lowers a sqlglot-parsed subset (SELECT/WHERE/GROUP BY/HAVING/
+JOIN/UNION/INTERSECT/WITH) onto Table ops (``internals/sql.py``). sqlglot is
+not available in this environment, so this module implements a hand-rolled
+parser for the same core subset; unsupported syntax raises NotImplementedError.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.expression import ColumnExpression
+
+
+_AGGS = {
+    "count": reducers.count,
+    "sum": reducers.sum,
+    "min": reducers.min,
+    "max": reducers.max,
+    "avg": reducers.avg,
+}
+
+
+class _Tokenizer:
+    _token_re = re.compile(
+        r"\s*(?:(?P<num>\d+\.\d+|\d+)|(?P<id>[A-Za-z_][A-Za-z_0-9.]*)"
+        r"|(?P<str>'[^']*')|(?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|\+|-|/|%))"
+    )
+
+    def __init__(self, text: str):
+        self.tokens: list[str] = []
+        pos = 0
+        while pos < len(text):
+            m = self._token_re.match(text, pos)
+            if not m:
+                if text[pos:].strip() == "":
+                    break
+                raise NotImplementedError(f"cannot tokenize SQL at: {text[pos:]!r}")
+            self.tokens.append(m.group(0).strip())
+            pos = m.end()
+        self.i = 0
+
+    def peek(self) -> str | None:
+        return self.tokens[self.i] if self.i < len(self.tokens) else None
+
+    def next(self) -> str:
+        t = self.peek()
+        if t is None:
+            raise NotImplementedError("unexpected end of SQL")
+        self.i += 1
+        return t
+
+    def accept(self, *kw: str) -> bool:
+        t = self.peek()
+        if t is not None and t.upper() in kw:
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, kw: str) -> None:
+        if not self.accept(kw):
+            raise NotImplementedError(f"expected {kw}, got {self.peek()!r}")
+
+
+_KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "AS", "AND", "OR",
+    "NOT", "JOIN", "ON", "UNION", "INTERSECT", "WITH", "INNER", "LEFT",
+    "RIGHT", "OUTER", "FULL", "NULL", "TRUE", "FALSE", "LIKE", "IN", "ALL",
+}
+
+
+def sql(query: str, **tables) -> Any:
+    """Execute a SQL query over the given tables:
+
+    >>> pw.sql("SELECT a, SUM(b) AS s FROM t GROUP BY a", t=my_table)
+    """
+    tk = _Tokenizer(query)
+    return _parse_select(tk, tables)
+
+
+def _parse_select(tk: _Tokenizer, tables: dict):
+    tk.expect("SELECT")
+    # projections
+    projections: list[tuple[str | None, Any]] = []  # (alias, raw expr fn)
+    star = False
+    while True:
+        if tk.accept("*"):
+            star = True
+        else:
+            e = _parse_expr(tk)
+            alias = None
+            if tk.accept("AS"):
+                alias = tk.next()
+            elif tk.peek() and tk.peek().upper() not in _KEYWORDS and tk.peek() not in (",",) and re.fullmatch(r"[A-Za-z_][A-Za-z_0-9]*", tk.peek() or ""):
+                alias = tk.next()
+            projections.append((alias, e))
+        if not tk.accept(","):
+            break
+    tk.expect("FROM")
+    tname = tk.next()
+    if tname not in tables:
+        raise ValueError(f"unknown table {tname!r} in SQL")
+    table = tables[tname]
+    # JOIN
+    while tk.peek() and tk.peek().upper() in ("JOIN", "INNER", "LEFT", "RIGHT", "FULL"):
+        how = "inner"
+        t = tk.next().upper()
+        if t in ("LEFT", "RIGHT"):
+            how = t.lower()
+            tk.accept("OUTER")
+            tk.expect("JOIN")
+        elif t == "FULL":
+            how = "outer"
+            tk.accept("OUTER")
+            tk.expect("JOIN")
+        elif t == "INNER":
+            tk.expect("JOIN")
+        other_name = tk.next()
+        other = tables[other_name]
+        tk.expect("ON")
+        cond = _parse_condition(tk)
+        lcol, rcol = cond
+        l_expr = _resolve_col(lcol, {tname: table, other_name: other})
+        r_expr = _resolve_col(rcol, {tname: table, other_name: other})
+        table = _join_select(table, other, l_expr, r_expr, how)
+    where_expr = None
+    if tk.accept("WHERE"):
+        where_expr = _parse_bool_expr(tk)
+    group_cols: list[str] = []
+    if tk.accept("GROUP"):
+        tk.expect("BY")
+        while True:
+            group_cols.append(tk.next())
+            if not tk.accept(","):
+                break
+    having = None
+    if tk.accept("HAVING"):
+        having = _parse_bool_expr(tk)
+    # UNION / INTERSECT
+    set_op = None
+    if tk.accept("UNION"):
+        tk.accept("ALL")
+        set_op = ("union", _parse_select(tk, tables))
+    elif tk.accept("INTERSECT"):
+        set_op = ("intersect", _parse_select(tk, tables))
+
+    # build
+    if where_expr is not None:
+        table = table.filter(_materialize(where_expr, table))
+    if group_cols:
+        grouped = table.groupby(*[table[c] for c in group_cols])
+        sel = {}
+        for alias, e in projections:
+            name = alias or _default_name(e)
+            sel[name] = _materialize(e, table)
+        result = grouped.reduce(**sel)
+        if having is not None:
+            result = result.filter(_materialize(having, result))
+    elif star:
+        result = table
+    else:
+        sel = {}
+        for alias, e in projections:
+            name = alias or _default_name(e)
+            sel[name] = _materialize(e, table)
+        result = table.select(**sel)
+    if set_op is not None:
+        kind, other = set_op
+        if kind == "union":
+            result = result.concat_reindex(other)
+        else:
+            result = result.intersect(other)
+    return result
+
+
+def _resolve_col(name: str, tables_by_name: dict):
+    if "." in name:
+        tn, cn = name.split(".", 1)
+        return tables_by_name[tn][cn]
+    for t in tables_by_name.values():
+        if name in t.column_names():
+            return t[name]
+    raise ValueError(f"unknown column {name!r} in SQL join condition")
+
+
+def _join_select(left, right, l_expr, r_expr, how):
+    from pathway_tpu.internals import thisclass
+
+    joined = left.join(right, l_expr == r_expr, how=how)
+    cols = {}
+    for n in left.column_names():
+        cols[n] = expr_mod.ColumnReference(thisclass.left, n)
+    for n in right.column_names():
+        if n not in cols:
+            cols[n] = expr_mod.ColumnReference(thisclass.right, n)
+    return joined.select(**cols)
+
+
+# --- tiny expression AST: tuples ("col", name) / ("lit", v) / ("bin", op, l, r)
+# / ("agg", fname, arg) / ("not", e)
+
+
+def _parse_expr(tk: _Tokenizer):
+    return _parse_additive(tk)
+
+
+def _parse_additive(tk):
+    left = _parse_multiplicative(tk)
+    while tk.peek() in ("+", "-"):
+        op = tk.next()
+        right = _parse_multiplicative(tk)
+        left = ("bin", op, left, right)
+    return left
+
+
+def _parse_multiplicative(tk):
+    left = _parse_atom(tk)
+    while tk.peek() in ("*", "/", "%"):
+        op = tk.next()
+        right = _parse_atom(tk)
+        left = ("bin", op, left, right)
+    return left
+
+
+def _parse_atom(tk):
+    t = tk.peek()
+    if t == "(":
+        tk.next()
+        e = _parse_expr(tk)
+        tk.expect(")")
+        return e
+    t = tk.next()
+    if re.fullmatch(r"\d+", t):
+        return ("lit", int(t))
+    if re.fullmatch(r"\d+\.\d+", t):
+        return ("lit", float(t))
+    if t.startswith("'"):
+        return ("lit", t[1:-1])
+    up = t.upper()
+    if up == "NULL":
+        return ("lit", None)
+    if up == "TRUE":
+        return ("lit", True)
+    if up == "FALSE":
+        return ("lit", False)
+    if up.lower() in _AGGS and tk.peek() == "(":
+        tk.next()
+        if tk.peek() == "*":
+            tk.next()
+            tk.expect(")")
+            return ("agg", up.lower(), None)
+        arg = _parse_expr(tk)
+        tk.expect(")")
+        return ("agg", up.lower(), arg)
+    return ("col", t)
+
+
+def _parse_condition(tk):
+    l = tk.next()
+    tk.expect("=")
+    r = tk.next()
+    return (l, r)
+
+
+def _parse_bool_expr(tk):
+    left = _parse_bool_term(tk)
+    while tk.accept("OR"):
+        right = _parse_bool_term(tk)
+        left = ("bin", "OR", left, right)
+    return left
+
+
+def _parse_bool_term(tk):
+    left = _parse_bool_factor(tk)
+    while tk.accept("AND"):
+        right = _parse_bool_factor(tk)
+        left = ("bin", "AND", left, right)
+    return left
+
+
+def _parse_bool_factor(tk):
+    if tk.accept("NOT"):
+        return ("not", _parse_bool_factor(tk))
+    left = _parse_expr(tk)
+    t = tk.peek()
+    if t in ("=", "<>", "!=", "<", "<=", ">", ">="):
+        op = tk.next()
+        right = _parse_expr(tk)
+        return ("bin", op, left, right)
+    return left
+
+
+_BIN_MAP = {
+    "=": "==",
+    "<>": "!=",
+    "!=": "!=",
+    "<": "<",
+    "<=": "<=",
+    ">": ">",
+    ">=": ">=",
+    "+": "+",
+    "-": "-",
+    "*": "*",
+    "/": "/",
+    "%": "%",
+}
+
+
+def _materialize(ast, table) -> ColumnExpression:
+    kind = ast[0]
+    if kind == "lit":
+        return expr_mod.ColumnConstExpression(ast[1])
+    if kind == "col":
+        name = ast[1]
+        if "." in name:
+            name = name.split(".")[-1]
+        return table[name]
+    if kind == "bin":
+        op = ast[1].upper()
+        l = _materialize(ast[2], table)
+        r = _materialize(ast[3], table)
+        if op == "AND":
+            return l & r
+        if op == "OR":
+            return l | r
+        return expr_mod.ColumnBinaryOpExpression(l, r, _BIN_MAP[ast[1]])
+    if kind == "not":
+        return ~_materialize(ast[1], table)
+    if kind == "agg":
+        fname = ast[1]
+        if ast[2] is None:
+            return reducers.count()
+        return _AGGS[fname](_materialize(ast[2], table))
+    raise NotImplementedError(f"SQL node {ast!r}")
+
+
+def _default_name(ast) -> str:
+    if ast[0] == "col":
+        return ast[1].split(".")[-1]
+    if ast[0] == "agg":
+        return ast[1]
+    return "expr"

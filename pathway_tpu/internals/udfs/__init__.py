@@ -1,0 +1,203 @@
+"""UDF system — ``@pw.udf``, executors, caching, retries.
+
+Parity with reference ``internals/udfs/``: ``UDF`` base class, sync/async/auto
+executors, capacity/timeout/retry wrappers, disk & in-memory caches. The async
+executor is the TPU microbatching point: whole epochs' rows resolve together
+(reference async_apply, operators.rs:269-305).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+import typing
+from typing import Any, Callable
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.udfs.caches import (
+    CacheStrategy,
+    DefaultCache,
+    DiskCache,
+    InMemoryCache,
+    with_cache_strategy,
+)
+from pathway_tpu.internals.udfs.executors import (
+    AsyncExecutor,
+    AutoExecutor,
+    Executor,
+    FullyAsyncExecutor,
+    SyncExecutor,
+    async_executor,
+    async_options,
+    auto_executor,
+    fully_async_executor,
+    sync_executor,
+)
+from pathway_tpu.internals.udfs.retries import (
+    AsyncRetryStrategy,
+    ExponentialBackoffRetryStrategy,
+    FixedDelayRetryStrategy,
+    NoRetryStrategy,
+)
+
+__all__ = [
+    "UDF",
+    "udf",
+    "UDFSync",
+    "UDFAsync",
+    "auto_executor",
+    "async_executor",
+    "sync_executor",
+    "fully_async_executor",
+    "async_options",
+    "CacheStrategy",
+    "DefaultCache",
+    "DiskCache",
+    "InMemoryCache",
+    "AsyncRetryStrategy",
+    "ExponentialBackoffRetryStrategy",
+    "FixedDelayRetryStrategy",
+    "NoRetryStrategy",
+    "coerce_async",
+]
+
+
+def coerce_async(fun: Callable) -> Callable:
+    """Wrap a sync callable into a coroutine function."""
+    if asyncio.iscoroutinefunction(fun):
+        return fun
+
+    @functools.wraps(fun)
+    async def wrapper(*args, **kwargs):
+        return fun(*args, **kwargs)
+
+    return wrapper
+
+
+class UDF:
+    """Base class for user-defined functions applied to table rows.
+
+    Subclasses implement ``__wrapped__``; instances are callable on column
+    expressions and build Apply/AsyncApply expression nodes.
+    """
+
+    def __init__(
+        self,
+        *,
+        return_type: Any = None,
+        deterministic: bool = False,
+        propagate_none: bool = False,
+        executor: Executor | None = None,
+        cache_strategy: CacheStrategy | None = None,
+        max_batch_size: int | None = None,
+    ):
+        self.return_type = return_type
+        self.deterministic = deterministic
+        self.propagate_none = propagate_none
+        self.executor = executor if executor is not None else auto_executor()
+        self.cache_strategy = cache_strategy
+        self.max_batch_size = max_batch_size
+
+    def __wrapped__(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def _get_return_type(self) -> Any:
+        if self.return_type is not None:
+            return self.return_type
+        try:
+            hints = typing.get_type_hints(self.__wrapped__)
+            return hints.get("return")
+        except Exception:
+            return None
+
+    def _prepare_fun(self) -> tuple[Callable, bool]:
+        fun = self.__wrapped__
+        is_async = asyncio.iscoroutinefunction(fun)
+        executor = self.executor
+        if isinstance(executor, AutoExecutor):
+            executor = AsyncExecutor() if is_async else SyncExecutor()
+        fun = executor._wrap(fun)
+        if self.cache_strategy is not None:
+            fun = with_cache_strategy(fun, self.cache_strategy)
+        return fun, isinstance(executor, (AsyncExecutor, FullyAsyncExecutor)) or is_async
+
+    def __call__(self, *args, **kwargs) -> expr_mod.ColumnExpression:
+        fun, is_async = self._prepare_fun()
+        rt = self._get_return_type()
+        if isinstance(self.executor, FullyAsyncExecutor):
+            cls = expr_mod.FullyAsyncApplyExpression
+        elif is_async:
+            cls = expr_mod.AsyncApplyExpression
+        else:
+            cls = expr_mod.ApplyExpression
+        return cls(
+            fun,
+            rt,
+            propagate_none=self.propagate_none,
+            deterministic=self.deterministic,
+            args=args,
+            kwargs=kwargs,
+            max_batch_size=self.max_batch_size,
+        )
+
+
+class _FunctionUDF(UDF):
+    def __init__(self, fun: Callable, **kwargs):
+        super().__init__(**kwargs)
+        self._fun = fun
+        functools.update_wrapper(self, fun)
+
+    @property
+    def __wrapped__(self):
+        return self._fun
+
+    @__wrapped__.setter
+    def __wrapped__(self, v):
+        self._fun = v
+
+
+def udf(
+    fun: Callable | None = None,
+    /,
+    *,
+    return_type: Any = None,
+    deterministic: bool = False,
+    propagate_none: bool = False,
+    executor: Executor | None = None,
+    cache_strategy: CacheStrategy | None = None,
+    max_batch_size: int | None = None,
+):
+    """Decorator turning a function into a UDF usable in expressions.
+
+    >>> @pw.udf
+    ... def add_one(x: int) -> int:
+    ...     return x + 1
+    """
+
+    def wrapper(f):
+        return _FunctionUDF(
+            f,
+            return_type=return_type,
+            deterministic=deterministic,
+            propagate_none=propagate_none,
+            executor=executor,
+            cache_strategy=cache_strategy,
+            max_batch_size=max_batch_size,
+        )
+
+    if fun is not None:
+        return wrapper(fun)
+    return wrapper
+
+
+# deprecated aliases kept for parity
+def udf_async(fun=None, **kwargs):
+    if fun is not None:
+        return udf(fun, executor=async_executor(), **kwargs)
+    return udf(executor=async_executor(), **kwargs)
+
+
+UDFSync = UDF
+UDFAsync = UDF

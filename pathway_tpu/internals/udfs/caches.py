@@ -1,0 +1,121 @@
+"""UDF result caches (reference ``internals/udfs/caches.py``).
+
+``DiskCache`` uses a simple sqlite-backed store (the reference uses the
+``diskcache`` package, absent here); ``InMemoryCache`` is an LRU dict.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import os
+import pickle
+import sqlite3
+import threading
+from collections import OrderedDict
+from typing import Any, Callable
+
+from pathway_tpu.engine.value import hash_values
+
+
+class CacheStrategy:
+    def make_key(self, fun_name: str, args, kwargs) -> str:
+        return f"{fun_name}-{hash_values(args, tuple(sorted(kwargs.items())))}"
+
+    def get(self, key: str):  # returns (hit, value)
+        raise NotImplementedError
+
+    def put(self, key: str, value) -> None:
+        raise NotImplementedError
+
+
+class InMemoryCache(CacheStrategy):
+    def __init__(self, max_size: int | None = None):
+        self.max_size = max_size
+        self._data: OrderedDict[str, Any] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+                return True, self._data[key]
+            return False, None
+
+    def put(self, key, value):
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            if self.max_size is not None and len(self._data) > self.max_size:
+                self._data.popitem(last=False)
+
+
+class DiskCache(CacheStrategy):
+    def __init__(self, name: str | None = None, size_limit: int | None = None):
+        self.name = name
+        self.size_limit = size_limit
+        self._conn: sqlite3.Connection | None = None
+        self._lock = threading.Lock()
+
+    def _ensure(self):
+        if self._conn is None:
+            root = os.environ.get(
+                "PATHWAY_PERSISTENT_STORAGE", os.path.join(os.getcwd(), ".pw-cache")
+            )
+            os.makedirs(root, exist_ok=True)
+            path = os.path.join(root, f"udf-cache-{self.name or 'default'}.sqlite")
+            self._conn = sqlite3.connect(path, check_same_thread=False)
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS cache (k TEXT PRIMARY KEY, v BLOB)"
+            )
+            self._conn.commit()
+        return self._conn
+
+    def get(self, key):
+        with self._lock:
+            conn = self._ensure()
+            row = conn.execute("SELECT v FROM cache WHERE k = ?", (key,)).fetchone()
+        if row is None:
+            return False, None
+        return True, pickle.loads(row[0])
+
+    def put(self, key, value):
+        with self._lock:
+            conn = self._ensure()
+            conn.execute(
+                "INSERT OR REPLACE INTO cache (k, v) VALUES (?, ?)",
+                (key, pickle.dumps(value)),
+            )
+            conn.commit()
+
+
+DefaultCache = DiskCache
+
+
+def with_cache_strategy(fun: Callable, cache: CacheStrategy) -> Callable:
+    name = getattr(fun, "__name__", "udf")
+    if asyncio.iscoroutinefunction(fun):
+
+        @functools.wraps(fun)
+        async def async_wrapper(*args, **kwargs):
+            key = cache.make_key(name, args, kwargs)
+            hit, value = cache.get(key)
+            if hit:
+                return value
+            value = await fun(*args, **kwargs)
+            cache.put(key, value)
+            return value
+
+        return async_wrapper
+
+    @functools.wraps(fun)
+    def wrapper(*args, **kwargs):
+        key = cache.make_key(name, args, kwargs)
+        hit, value = cache.get(key)
+        if hit:
+            return value
+        value = fun(*args, **kwargs)
+        cache.put(key, value)
+        return value
+
+    return wrapper

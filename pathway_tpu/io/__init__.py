@@ -1,0 +1,42 @@
+"""``pw.io`` — input/output connectors.
+
+Parity with reference ``python/pathway/io/`` (27 backends). This package
+provides the connector runtime (threads pumping commit-timed batches into the
+engine — reference ``src/connectors/``) and per-backend modules; backends
+needing unavailable services raise a clear error at call time but keep API
+parity.
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.io import (
+    csv,
+    fs,
+    http,
+    jsonlines,
+    kafka,
+    minio,
+    null,
+    plaintext,
+    python,
+    s3,
+    sqlite,
+)
+from pathway_tpu.io._subscribe import subscribe
+from pathway_tpu.io._utils import CsvParserSettings, OnChangeCallback, OnFinishCallback
+
+__all__ = [
+    "csv",
+    "fs",
+    "http",
+    "jsonlines",
+    "kafka",
+    "minio",
+    "null",
+    "plaintext",
+    "python",
+    "s3",
+    "sqlite",
+    "subscribe",
+    "CsvParserSettings",
+]

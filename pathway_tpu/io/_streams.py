@@ -1,0 +1,118 @@
+"""Connector driver infrastructure.
+
+The analog of the reference connector thread loop (``src/connectors/mod.rs``:
+``Connector::run`` pumping entries into input sessions with commit times).
+A connector owns an engine InputNode; on ``start`` it spawns a thread that
+injects batches at increasing even commit times and advances its source
+frontier; ``stop`` requests shutdown.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as time_mod
+from typing import Any, Callable, Iterable
+
+from pathway_tpu.engine.batch import Batch
+from pathway_tpu.engine.graph import Node
+
+
+class BaseConnector:
+    """Owns one InputNode; subclasses implement ``run(ctx)``."""
+
+    def __init__(self, node: Node):
+        self.node = node
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._sched = None
+
+    # -- session API used by run() implementations -------------------------
+    def emit(self, time: int, rows: list[tuple[int, tuple, int]]) -> None:
+        if rows:
+            self._sched.inject(
+                self.node, time, Batch.from_rows(self.node.column_names, rows)
+            )
+
+    def advance(self, new_time: int) -> None:
+        self._sched.advance_source(self.node, new_time)
+
+    def close(self) -> None:
+        self._sched.close_source(self.node)
+
+    def should_stop(self) -> bool:
+        return self._stop.is_set()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, sched) -> None:
+        self._sched = sched
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run_safe, daemon=True)
+        self._thread.start()
+
+    def _run_safe(self):
+        try:
+            self.run()
+        except Exception as exc:  # noqa: BLE001
+            from pathway_tpu.internals.errors import get_global_error_log
+
+            get_global_error_log().log(f"connector error: {exc!r}")
+        finally:
+            self.close()
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+_time_lock = threading.Lock()
+_last_time = [0]
+
+
+def next_commit_time() -> int:
+    """Monotonic even commit time shared by all connectors (reference:
+    ``Timestamp::new_from_current_time``, even-valued)."""
+    with _time_lock:
+        t = int(time_mod.time() * 1000) * 2
+        if t <= _last_time[0]:
+            t = _last_time[0] + 2
+        _last_time[0] = t
+        return t
+
+
+class StaticStreamConnector(BaseConnector):
+    """Replays rows with explicit logical times (markdown ``__time__``)."""
+
+    def __init__(self, node: Node, rows: list[tuple[int, tuple, int, int]], cols):
+        super().__init__(node)
+        # rows: (key, row, time, diff)
+        self.rows = rows
+
+    def run(self):
+        by_time: dict[int, list] = {}
+        for key, row, t, diff in self.rows:
+            by_time.setdefault(t, []).append((key, row, diff))
+        for t in sorted(by_time):
+            self.emit(t, by_time[t])
+            self.advance(t + 1)
+
+
+class CallbackConnector(BaseConnector):
+    """Adapts a generator of (rows, advance_hint) into commits — used by
+    demo streams and the Python ConnectorSubject."""
+
+    def __init__(self, node: Node, generator: Callable, autocommit_ms: int | None):
+        super().__init__(node)
+        self.generator = generator
+        self.autocommit_ms = autocommit_ms
+
+    def run(self):
+        for rows in self.generator(self):
+            if self.should_stop():
+                break
+            t = next_commit_time()
+            self.emit(t, rows)
+            self.advance(t + 1)

@@ -1,0 +1,108 @@
+"""Shared connector plumbing: schema→row conversion, value parsing.
+
+Reference parity: ``python/pathway/io/_utils.py`` + the parser layer of
+``src/connectors/data_format.rs`` (DsvParser, JsonLinesParser, IdentityParser).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from pathway_tpu.engine.value import hash_values
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.json import Json
+
+OnChangeCallback = Callable
+OnFinishCallback = Callable
+
+
+@dataclass
+class CsvParserSettings:
+    delimiter: str = ","
+    quote: str = '"'
+    escape: str | None = None
+    enable_double_quote_escapes: bool = True
+    enable_quoting: bool = True
+    comment_character: str | None = None
+
+
+def parse_value(raw: Any, dtype: dt.DType):
+    """Parse a raw (string or json) value into the dtype's representation."""
+    if raw is None:
+        return None
+    target = dtype.strip_optional()
+    try:
+        if target is dt.INT:
+            return int(raw)
+        if target is dt.FLOAT:
+            return float(raw)
+        if target is dt.BOOL:
+            if isinstance(raw, bool):
+                return raw
+            return str(raw).strip().lower() in ("1", "true", "yes", "on")
+        if target is dt.STR:
+            return str(raw)
+        if target is dt.BYTES:
+            if isinstance(raw, bytes):
+                return raw
+            return str(raw).encode("utf-8")
+        if target is dt.JSON:
+            if isinstance(raw, Json):
+                return raw
+            if isinstance(raw, str):
+                return Json(json.loads(raw))
+            return Json(raw)
+        if target is dt.DATE_TIME_NAIVE or target is dt.DATE_TIME_UTC:
+            import pandas as pd
+
+            ts = pd.Timestamp(raw)
+            from pathway_tpu.internals.datetime_types import DateTimeNaive, DateTimeUtc
+
+            return DateTimeUtc(ts) if ts.tzinfo is not None else DateTimeNaive(ts)
+        if isinstance(target, (dt.List, dt.Tuple)):
+            if isinstance(raw, str):
+                raw = json.loads(raw)
+            return tuple(raw)
+        if isinstance(target, dt.Array):
+            import numpy as np
+
+            if isinstance(raw, str):
+                raw = json.loads(raw)
+            return np.asarray(raw)
+    except (ValueError, TypeError, json.JSONDecodeError):
+        from pathway_tpu.engine.value import ERROR
+
+        return ERROR
+    return raw
+
+
+def row_key(schema, values: dict, fallback) -> int:
+    pk = schema.primary_key_columns()
+    if pk:
+        return hash_values(*[values[c] for c in pk])
+    return hash_values(fallback)
+
+
+def format_value_for_output(v) -> Any:
+    import numpy as np
+    import pandas as pd
+
+    from pathway_tpu.engine.value import ERROR, Pointer
+
+    if v is ERROR:
+        return "Error"
+    if isinstance(v, Pointer):
+        return repr(v)
+    if isinstance(v, Json):
+        return json.loads(str(v))
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, tuple):
+        return [format_value_for_output(x) for x in v]
+    if isinstance(v, pd.Timestamp):
+        return v.isoformat()
+    if isinstance(v, pd.Timedelta):
+        return v.value
+    return v

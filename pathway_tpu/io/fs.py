@@ -1,0 +1,232 @@
+"""Filesystem connector — CSV / jsonlines / plaintext / binary over files and
+directories, static or streaming (directory watching).
+
+Reference parity: ``python/pathway/io/fs`` + ``src/connectors/posix_like.rs``
+(scanner × tokenizer), ``ConnectorMode::{Static,Streaming}``, ``with_metadata``.
+"""
+
+from __future__ import annotations
+
+import csv as csv_mod
+import glob as glob_mod
+import json
+import os
+import time as time_mod
+from typing import Any
+
+from pathway_tpu.engine.operators.core import InputNode
+from pathway_tpu.engine.operators.output import SinkNode
+from pathway_tpu.engine.value import hash_values
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._streams import BaseConnector, next_commit_time
+from pathway_tpu.io._utils import CsvParserSettings, format_value_for_output, parse_value
+
+
+def _list_files(path: str) -> list[str]:
+    if os.path.isdir(path):
+        out = []
+        for root, _dirs, files in os.walk(path):
+            for f in sorted(files):
+                out.append(os.path.join(root, f))
+        return sorted(out)
+    matches = sorted(glob_mod.glob(path))
+    if matches:
+        return matches
+    if os.path.exists(path):
+        return [path]
+    return []
+
+
+def _metadata_for(path: str) -> Json:
+    try:
+        st = os.stat(path)
+        return Json(
+            {
+                "path": os.path.abspath(path),
+                "size": st.st_size,
+                "modified_at": int(st.st_mtime),
+                "seen_at": int(time_mod.time()),
+                "owner": str(st.st_uid),
+            }
+        )
+    except OSError:
+        return Json({"path": path})
+
+
+def _iter_records(path: str, fmt: str, schema, csv_settings: CsvParserSettings | None):
+    """Yield per-file lists of value dicts."""
+    cols = [c for c in schema.column_names() if c != "_metadata"]
+    dtypes = {n: c.dtype for n, c in schema.__columns__.items()}
+    if fmt in ("csv", "dsv"):
+        settings = csv_settings or CsvParserSettings()
+        with open(path, newline="", encoding="utf-8", errors="replace") as f:
+            reader = csv_mod.DictReader(f, delimiter=settings.delimiter, quotechar=settings.quote)
+            for record in reader:
+                yield {c: parse_value(record.get(c), dtypes[c]) for c in cols}
+    elif fmt in ("json", "jsonlines"):
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                yield {c: parse_value(obj.get(c), dtypes[c]) for c in cols}
+    elif fmt == "plaintext":
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                yield {"data": line.rstrip("\n")}
+    elif fmt == "plaintext_by_file":
+        with open(path, encoding="utf-8", errors="replace") as f:
+            yield {"data": f.read()}
+    elif fmt == "binary":
+        with open(path, "rb") as f:
+            yield {"data": f.read()}
+    else:
+        raise ValueError(f"unknown format {fmt!r}")
+
+
+class _FsConnector(BaseConnector):
+    def __init__(
+        self,
+        node,
+        path: str,
+        fmt: str,
+        schema,
+        mode: str,
+        with_metadata: bool,
+        csv_settings,
+        refresh_interval: float = 0.5,
+        autogenerate_key: bool = True,
+    ):
+        super().__init__(node)
+        self.path = path
+        self.fmt = fmt
+        self.schema = schema
+        self.mode = mode
+        self.with_metadata = with_metadata
+        self.csv_settings = csv_settings
+        self.refresh_interval = refresh_interval
+
+    def _read_all(self, seen: dict[str, float]) -> list[tuple[int, tuple, int]]:
+        cols = list(self.node.column_names)
+        rows = []
+        pk = self.schema.primary_key_columns()
+        for fp in _list_files(self.path):
+            try:
+                mtime = os.path.getmtime(fp)
+            except OSError:
+                continue
+            if fp in seen and seen[fp] >= mtime:
+                continue
+            seen[fp] = mtime
+            meta = _metadata_for(fp) if self.with_metadata else None
+            for i, values in enumerate(
+                _iter_records(fp, self.fmt, self.schema, self.csv_settings)
+            ):
+                if self.with_metadata:
+                    values = {**values, "_metadata": meta}
+                if pk:
+                    key = hash_values(*[values[c] for c in pk])
+                else:
+                    key = hash_values(fp, i)
+                rows.append((key, tuple(values[c] for c in cols), 1))
+        return rows
+
+    def run(self):
+        seen: dict[str, float] = {}
+        rows = self._read_all(seen)
+        t = next_commit_time()
+        self.emit(t, rows)
+        self.advance(t + 1)
+        if self.mode == "static":
+            return
+        while not self.should_stop():
+            time_mod.sleep(self.refresh_interval)
+            rows = self._read_all(seen)
+            if rows:
+                t = next_commit_time()
+                self.emit(t, rows)
+                self.advance(t + 1)
+
+
+def read(
+    path: str | os.PathLike,
+    *,
+    format: str = "csv",  # noqa: A002
+    schema: Any | None = None,
+    mode: str = "streaming",
+    csv_settings: CsvParserSettings | None = None,
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
+    refresh_interval: float = 0.5,
+    name: str | None = None,
+    **kwargs,
+) -> Table:
+    path = os.fspath(path)
+    if format in ("plaintext", "plaintext_by_file"):
+        schema = schema_mod.schema_from_types(data=str)
+    elif format == "binary":
+        schema = schema_mod.schema_from_types(data=bytes)
+    elif schema is None:
+        raise ValueError("schema is required for csv/json formats")
+    if with_metadata:
+        schema = schema | schema_mod.schema_from_types(_metadata=dt.JSON)
+    cols = list(schema.column_names())
+    node = InputNode(G.engine_graph, cols, name=f"fs({path})")
+    conn = _FsConnector(
+        node,
+        path,
+        format,
+        schema,
+        mode,
+        with_metadata,
+        csv_settings,
+        refresh_interval,
+    )
+    G.register_connector(conn)
+    table = Table(node, schema, Universe())
+    if persistent_id is not None:
+        from pathway_tpu.persistence import register_persistent_source
+
+        register_persistent_source(persistent_id, conn)
+    return table
+
+
+def write(table: Table, filename: str | os.PathLike, *, format: str = "json", **kwargs) -> None:  # noqa: A002
+    filename = os.fspath(filename)
+    cols = list(table.column_names())
+    f = open(filename, "w", encoding="utf-8")  # noqa: SIM115 - lifetime = run
+    if format == "csv":
+        writer = csv_mod.writer(f)
+        writer.writerow(cols + ["time", "diff"])
+
+        def write_batch(time, batch):
+            for key, row, diff in batch.rows():
+                writer.writerow(
+                    [format_value_for_output(v) for v in row] + [time, diff]
+                )
+            f.flush()
+
+    else:
+
+        def write_batch(time, batch):
+            for key, row, diff in batch.rows():
+                obj = {
+                    c: format_value_for_output(v) for c, v in zip(cols, row)
+                }
+                obj["time"] = time
+                obj["diff"] = diff
+                f.write(json.dumps(obj) + "\n")
+            f.flush()
+
+    node = SinkNode(G.engine_graph, table._node, write_batch, name=f"fs-write({filename})")
+    G.register_sink(node)

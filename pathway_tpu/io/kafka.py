@@ -1,0 +1,149 @@
+"""Kafka connector (reference ``python/pathway/io/kafka``).
+
+No Kafka client library is available in this environment; the API surface is
+kept, backed by either a user-supplied in-process broker stub
+(:class:`InMemoryKafkaBroker`, used by tests and benchmarks to model
+streaming ingest) or a clear error for real clusters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as time_mod
+from collections import defaultdict
+from typing import Any
+
+from pathway_tpu.engine.operators.core import InputNode
+from pathway_tpu.engine.operators.output import SinkNode
+from pathway_tpu.engine.value import hash_values
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._streams import BaseConnector, next_commit_time
+from pathway_tpu.io._utils import parse_value
+
+
+class InMemoryKafkaBroker:
+    """Minimal in-process topic/partition log usable as ``rdkafka_settings``
+    for local testing and throughput benchmarks."""
+
+    def __init__(self):
+        self._topics: dict[str, list[tuple[bytes | None, bytes]]] = defaultdict(list)
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def produce(self, topic: str, value: bytes, key: bytes | None = None) -> None:
+        with self._lock:
+            self._topics[topic].append((key, value))
+
+    def close(self) -> None:
+        self._closed = True
+
+    def poll(self, topic: str, offset: int) -> list[tuple[bytes | None, bytes]]:
+        with self._lock:
+            return self._topics[topic][offset:]
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+class _BrokerConnector(BaseConnector):
+    def __init__(self, node, broker: InMemoryKafkaBroker, topic: str, schema, fmt: str):
+        super().__init__(node)
+        self.broker = broker
+        self.topic = topic
+        self.schema = schema
+        self.fmt = fmt
+        self._counter = 0
+
+    def run(self):
+        import json
+
+        offset = 0
+        cols = list(self.node.column_names)
+        dtypes = {n: c.dtype for n, c in self.schema.__columns__.items()}
+        pk = self.schema.primary_key_columns()
+        while not self.should_stop():
+            entries = self.broker.poll(self.topic, offset)
+            if entries:
+                offset += len(entries)
+                rows = []
+                for key_bytes, value in entries:
+                    if self.fmt == "raw":
+                        values = {"data": value}
+                    else:
+                        obj = json.loads(value)
+                        values = {c: parse_value(obj.get(c), dtypes[c]) for c in cols}
+                    if pk:
+                        key = hash_values(*[values[c] for c in pk])
+                    else:
+                        key = hash_values(self.topic, self._counter)
+                        self._counter += 1
+                    rows.append((key, tuple(values[c] for c in cols), 1))
+                t = next_commit_time()
+                self.emit(t, rows)
+                self.advance(t + 1)
+            elif self.broker.closed:
+                return
+            else:
+                time_mod.sleep(0.01)
+
+
+def read(
+    rdkafka_settings: Any,
+    topic: str | None = None,
+    *,
+    schema: Any | None = None,
+    format: str = "json",  # noqa: A002
+    autocommit_duration_ms: int | None = 1500,
+    persistent_id: str | None = None,
+    **kwargs,
+) -> Table:
+    if isinstance(rdkafka_settings, InMemoryKafkaBroker):
+        from pathway_tpu.internals import schema as schema_mod
+
+        if format == "raw":
+            schema = schema_mod.schema_from_types(data=bytes)
+        cols = list(schema.column_names())
+        node = InputNode(G.engine_graph, cols, name=f"kafka({topic})")
+        conn = _BrokerConnector(node, rdkafka_settings, topic, schema, format)
+        G.register_connector(conn)
+        return Table(node, schema, Universe())
+    raise NotImplementedError(
+        "no Kafka client library in this environment; pass an "
+        "InMemoryKafkaBroker for in-process streaming"
+    )
+
+
+def write(
+    table: Table,
+    rdkafka_settings: Any,
+    topic_name: str | None = None,
+    *,
+    format: str = "json",  # noqa: A002
+    **kwargs,
+) -> None:
+    if isinstance(rdkafka_settings, InMemoryKafkaBroker):
+        import json
+
+        cols = list(table.column_names())
+
+        def write_batch(time, batch):
+            from pathway_tpu.io._utils import format_value_for_output
+
+            for key, row, diff in batch.rows():
+                obj = {c: format_value_for_output(v) for c, v in zip(cols, row)}
+                obj["diff"] = diff
+                rdkafka_settings.produce(topic_name, json.dumps(obj).encode())
+
+        node = SinkNode(G.engine_graph, table._node, write_batch, name=f"kafka-write({topic_name})")
+        G.register_sink(node)
+        return
+    raise NotImplementedError(
+        "no Kafka client library in this environment; pass an InMemoryKafkaBroker"
+    )
+
+
+def read_from_upstash(*args, **kwargs):
+    raise NotImplementedError("Upstash Kafka requires network access")

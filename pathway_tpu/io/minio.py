@@ -1,0 +1,28 @@
+"""MinIO connector (reference ``python/pathway/io/minio``) — S3-compatible."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from pathway_tpu.io import s3
+
+
+@dataclass
+class MinIOSettings:
+    endpoint: str | None = None
+    bucket_name: str | None = None
+    access_key: str | None = None
+    secret_access_key: str | None = None
+    with_path_style: bool = True
+
+    def create_aws_settings(self):
+        return s3.AwsS3Settings(
+            bucket_name=self.bucket_name,
+            access_key=self.access_key,
+            secret_access_key=self.secret_access_key,
+            endpoint=self.endpoint,
+        )
+
+
+def read(path: str, *, minio_settings: MinIOSettings | None = None, **kwargs):
+    return s3.read(path, **kwargs)

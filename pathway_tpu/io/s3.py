@@ -1,0 +1,48 @@
+"""S3 connector (reference ``python/pathway/io/s3``).
+
+No S3 SDK / network egress in this environment; ``AwsS3Settings`` is kept for
+API parity and a ``path`` pointing at a local directory (or a mounted bucket)
+is read through the filesystem scanner — the same scanner×tokenizer split as
+the reference's ``src/connectors/scanner/s3.rs``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from pathway_tpu.io import fs
+
+
+@dataclass
+class AwsS3Settings:
+    bucket_name: str | None = None
+    access_key: str | None = None
+    secret_access_key: str | None = None
+    with_path_style: bool = False
+    region: str | None = None
+    endpoint: str | None = None
+
+    @classmethod
+    def new_from_path(cls, path: str):
+        return cls(bucket_name=path)
+
+
+def read(
+    path: str,
+    *,
+    aws_s3_settings: AwsS3Settings | None = None,
+    format: str = "csv",  # noqa: A002
+    schema: Any | None = None,
+    mode: str = "streaming",
+    **kwargs,
+):
+    if path.startswith("s3://"):
+        raise NotImplementedError(
+            "no S3 SDK/network in this environment; mount the bucket and "
+            "pass a local path"
+        )
+    return fs.read(path, format=format, schema=schema, mode=mode, **kwargs)
+
+
+read_from_csv = read

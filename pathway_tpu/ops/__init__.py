@@ -1,0 +1,1 @@
+"""TPU compute kernels (JAX/XLA/Pallas) used by the engine and stdlib."""

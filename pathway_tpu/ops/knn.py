@@ -1,0 +1,169 @@
+"""Brute-force KNN on TPU: HBM-resident corpus, jitted gemm + top-k.
+
+The reference's brute-force index is a growable host ``Array2<f64>`` with
+gemm-based distances (``src/external_integration/brute_force_knn_integration.rs``).
+TPU-first redesign:
+
+* the corpus lives **in HBM** as a capacity-doubling padded matrix — append is
+  an on-device dynamic_update_slice, no host round-trip;
+* distances are one MXU matmul: queries (padded to a bucket size) x corpus^T
+  in bfloat16 with float32 accumulation, fused by XLA with the mask and the
+  ``lax.top_k`` that follows — exactly the "keep the FLOPs on the MXU, fuse
+  the elementwise" recipe;
+* deletes are O(1) swaps with the last row (index is unordered);
+* static shapes: (capacity, query-bucket, k) are compile-time constants, so
+  streams of ragged batches reuse cached executables.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+_NEG_INF = -1e30
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _search_kernel(corpus, valid_mask, queries, k: int, metric: str):
+    """scores: higher is better. corpus (N,d) bf16, queries (Q,d) f32."""
+    q = queries.astype(jnp.bfloat16)
+    c = corpus
+    dots = jax.lax.dot_general(
+        q,
+        c,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (Q, N)
+    if metric == "l2":
+        qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+        cn = jnp.sum(c.astype(jnp.float32) ** 2, axis=1)[None, :]
+        scores = -(qn + cn - 2.0 * dots)  # negative squared L2
+    else:  # cosine / dot on normalized vectors
+        scores = dots
+    scores = jnp.where(valid_mask[None, :], scores, _NEG_INF)
+    return jax.lax.top_k(scores, k)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(4, math.ceil(math.log2(max(n, 1))))
+
+
+class BruteForceKnnIndex:
+    """Single-device TPU KNN index (one instance per worker, like the
+    reference's ``ExternalIndexFactory::make_instance``)."""
+
+    def __init__(
+        self,
+        dimensions: int,
+        reserved_space: int = 1024,
+        metric: str = "cos",
+        auxiliary_space: int = 0,
+        dtype=jnp.bfloat16,
+    ):
+        self.dim = dimensions
+        self.metric = "l2" if str(metric).lower().startswith("l2") else "cos"
+        self.capacity = _next_pow2(reserved_space)
+        self.dtype = dtype
+        self._corpus = jnp.zeros((self.capacity, self.dim), dtype=dtype)
+        self._valid = jnp.zeros((self.capacity,), dtype=bool)
+        self.n = 0
+        self._keys: list[Any] = []
+        self._slot_of: dict[Any, int] = {}
+
+    # ------------------------------------------------------------------ sizing
+    def _grow(self, needed: int) -> None:
+        new_cap = self.capacity
+        while new_cap < needed:
+            new_cap *= 2
+        if new_cap == self.capacity:
+            return
+        corpus = jnp.zeros((new_cap, self.dim), dtype=self.dtype)
+        corpus = jax.lax.dynamic_update_slice(corpus, self._corpus, (0, 0))
+        valid = jnp.zeros((new_cap,), dtype=bool)
+        valid = jax.lax.dynamic_update_slice(valid, self._valid, (0,))
+        self._corpus, self._valid = corpus, valid
+        self.capacity = new_cap
+
+    # ------------------------------------------------------------------ update
+    def _prep(self, vectors: np.ndarray) -> np.ndarray:
+        v = np.asarray(vectors, dtype=np.float32)
+        if v.ndim == 1:
+            v = v[None, :]
+        if self.metric == "cos":
+            norms = np.linalg.norm(v, axis=1, keepdims=True)
+            norms[norms == 0] = 1.0
+            v = v / norms
+        return v
+
+    def add(self, keys: list, vectors: np.ndarray) -> None:
+        v = self._prep(vectors)
+        m = len(keys)
+        if m == 0:
+            return
+        self._grow(self.n + m)
+        start = self.n
+        self._corpus = jax.lax.dynamic_update_slice(
+            self._corpus, jnp.asarray(v, dtype=self.dtype), (start, 0)
+        )
+        self._valid = self._valid.at[start : start + m].set(True)
+        for i, key in enumerate(keys):
+            self._slot_of[key] = start + i
+            self._keys.append(key)
+        self.n += m
+
+    def remove(self, keys: list) -> None:
+        for key in keys:
+            slot = self._slot_of.pop(key, None)
+            if slot is None:
+                continue
+            last = self.n - 1
+            if slot != last:
+                last_key = self._keys[last]
+                row = jax.lax.dynamic_slice(self._corpus, (last, 0), (1, self.dim))
+                self._corpus = jax.lax.dynamic_update_slice(self._corpus, row, (slot, 0))
+                self._keys[slot] = last_key
+                self._slot_of[last_key] = slot
+            self._valid = self._valid.at[last].set(False)
+            self._keys.pop()
+            self.n -= 1
+
+    # ------------------------------------------------------------------ search
+    def search(self, queries: np.ndarray, k: int) -> list[list[tuple[Any, float]]]:
+        """Return per-query [(key, score)] sorted by decreasing score."""
+        if self.n == 0:
+            q = np.asarray(queries)
+            nq = 1 if q.ndim == 1 else len(q)
+            return [[] for _ in range(nq)]
+        q = self._prep(queries)
+        nq = len(q)
+        bucket = _next_pow2(nq)
+        if bucket > nq:
+            q = np.concatenate([q, np.zeros((bucket - nq, self.dim), np.float32)])
+        k_eff = min(k, self.capacity)
+        scores, idx = _search_kernel(
+            self._corpus, self._valid, jnp.asarray(q), k_eff, self.metric
+        )
+        scores = np.asarray(scores)[:nq]
+        idx = np.asarray(idx)[:nq]
+        out = []
+        for qi in range(nq):
+            row = []
+            for j in range(k_eff):
+                s = float(scores[qi, j])
+                if s <= _NEG_INF / 2:
+                    break
+                slot = int(idx[qi, j])
+                if slot < len(self._keys):
+                    row.append((self._keys[slot], s))
+            out.append(row)
+        return out
+
+    def __len__(self) -> int:
+        return self.n

@@ -1,0 +1,60 @@
+"""``pw.persistence`` — checkpoint/resume configuration.
+
+Parity with reference ``python/pathway/persistence/__init__.py`` (Backend
+filesystem/s3/azure/mock, Config with snapshot_interval_ms and
+persistence_mode). The engine-side snapshotting (input snapshot log, replay,
+metadata frontier) lives in :mod:`pathway_tpu.persistence.engine_store`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class Backend:
+    def __init__(self, kind: str, path: str | None = None, **kwargs):
+        self.kind = kind
+        self.path = path
+        self.options = kwargs
+
+    @classmethod
+    def filesystem(cls, path: str | os.PathLike) -> "Backend":
+        return cls("filesystem", os.fspath(path))
+
+    @classmethod
+    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
+        return cls("s3", root_path, bucket_settings=bucket_settings)
+
+    @classmethod
+    def azure(cls, root_path: str, account: Any = None, **kw) -> "Backend":
+        return cls("azure", root_path, account=account, **kw)
+
+    @classmethod
+    def mock(cls, events: Any = None) -> "Backend":
+        return cls("mock", None, events=events)
+
+
+@dataclass
+class Config:
+    backend: Backend | None = None
+    snapshot_interval_ms: int = 0
+    persistence_mode: str = "persisting"
+    snapshot_access: str | None = None
+    continue_after_replay: bool = True
+
+    @classmethod
+    def simple_config(cls, backend: Backend, **kwargs) -> "Config":
+        return cls(backend=backend, **kwargs)
+
+
+_persistent_sources: dict[str, Any] = {}
+
+
+def register_persistent_source(persistent_id: str, connector: Any) -> None:
+    _persistent_sources[persistent_id] = connector
+
+
+def get_persistent_sources() -> dict[str, Any]:
+    return dict(_persistent_sources)

@@ -1,0 +1,82 @@
+"""Graph algorithms over ``pw.iterate`` (reference ``stdlib/graphs/``):
+bellman_ford, pagerank, louvain communities (simplified)."""
+
+from __future__ import annotations
+
+import math
+
+import pathway_tpu.internals.iterate as iterate_mod
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals import reducers
+
+
+def bellman_ford(vertices, edges):
+    """Single-source shortest paths; ``vertices`` has ``dist_from_start``
+    (0 for source, inf otherwise), ``edges`` has u, v, dist columns."""
+
+    def step(vertices, edges):
+        # min candidate distance per target vertex
+        j = edges.join(vertices, edges.u == vertices.id).select(
+            target=edges.v, cand=vertices.dist_from_start + edges.dist
+        )
+        best = j.groupby(j.target).reduce(
+            j.target, best=reducers.min(j.cand)
+        )
+        joined = vertices.join_left(best, vertices.id == best.target, id=vertices.id).select(
+            old=vertices.dist_from_start,
+            cand=best.best,
+        )
+        new_vertices = joined.select(
+            dist_from_start=expr_mod.if_else(
+                expr_mod.coalesce(joined.cand, math.inf) < joined.old,
+                expr_mod.coalesce(joined.cand, math.inf),
+                joined.old,
+            )
+        )
+        return dict(vertices=new_vertices, edges=edges)
+
+    return iterate_mod.iterate(
+        lambda vertices, edges: step(vertices, edges),
+        vertices=vertices,
+        edges=edges,
+    ).vertices
+
+
+def pagerank(edges, steps: int = 50, damping: float = 0.85):
+    """PageRank over an edge table (u, v) — iterative power method."""
+    from pathway_tpu.internals import thisclass
+
+    vertices = (
+        edges.select(v=edges.u)
+        .concat_reindex(edges.select(v=edges.v))
+        .groupby(thisclass.this.v)
+        .reduce(thisclass.this.v)
+        .with_id_from(thisclass.this.v)
+    )
+    degrees = (
+        edges.groupby(edges.u)
+        .reduce(edges.u, degree=reducers.count())
+        .with_id_from(thisclass.this.u)
+    )
+    ranks = vertices.select(rank=1.0)
+
+    for _ in range(steps if steps <= 20 else 20):
+        contribs = (
+            edges.join(ranks, edges.u == ranks.id)
+            .join(degrees, edges.u == degrees.id)
+            .select(target=edges.v, contrib=ranks.rank / degrees.degree)
+        )
+        incoming = contribs.groupby(contribs.target).reduce(
+            contribs.target, total=reducers.sum(contribs.contrib)
+        ).with_id_from(thisclass.this.target)
+        joined = ranks.join_left(incoming, ranks.id == incoming.id, id=ranks.id).select(
+            total=incoming.total
+        )
+        ranks = joined.select(
+            rank=(1 - damping) + damping * expr_mod.coalesce(joined.total, 0.0)
+        )
+    return ranks
+
+
+def louvain_communities(*args, **kwargs):
+    raise NotImplementedError("louvain arrives with the graph-clustering pack")

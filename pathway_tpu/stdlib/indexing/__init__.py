@@ -1,0 +1,51 @@
+"""``pw.indexing`` — vector/text indexes and the DataIndex retrieval API.
+
+Parity with reference ``python/pathway/stdlib/indexing/``: ``DataIndex``
+(``query`` / ``query_as_of_now``), inner indexes (``BruteForceKnn`` — TPU
+HBM gemm+top-k, ``UsearchKnn`` — approximate (here: same TPU brute force, the
+exact index dominates it on TPU), ``TantivyBM25`` — host-side BM25,
+``LshKnn``), ``HybridIndex`` (RRF fusion), default factories.
+"""
+
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25, TantivyBM25Factory
+from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
+from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex, HybridIndexFactory
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    BruteForceKnnFactory,
+    DistanceMetric,
+    LshKnn,
+    USearchKnn,
+    UsearchKnnFactory,
+)
+from pathway_tpu.stdlib.indexing.retrievers import AbstractRetrieverFactory
+from pathway_tpu.stdlib.indexing.vector_document_index import (
+    default_brute_force_knn_document_index,
+    default_lsh_knn_document_index,
+    default_usearch_knn_document_index,
+    default_vector_document_index,
+)
+from pathway_tpu.stdlib.indexing.full_text_document_index import (
+    default_full_text_document_index,
+)
+
+__all__ = [
+    "DataIndex",
+    "InnerIndex",
+    "BruteForceKnn",
+    "BruteForceKnnFactory",
+    "USearchKnn",
+    "UsearchKnnFactory",
+    "LshKnn",
+    "DistanceMetric",
+    "TantivyBM25",
+    "TantivyBM25Factory",
+    "HybridIndex",
+    "HybridIndexFactory",
+    "AbstractRetrieverFactory",
+    "default_vector_document_index",
+    "default_brute_force_knn_document_index",
+    "default_usearch_knn_document_index",
+    "default_lsh_knn_document_index",
+    "default_full_text_document_index",
+]

@@ -1,0 +1,110 @@
+"""Hybrid retrieval with Reciprocal Rank Fusion (reference
+``stdlib/indexing/hybrid_index.py:14``): fuse rankings from several
+DataIndexes (e.g. vector KNN + BM25)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+
+
+class HybridIndex:
+    def __init__(self, inner_indexes: list, k: float = 60.0):
+        self.inner_indexes = inner_indexes
+        self.k = k
+
+
+class HybridIndexDataIndex:
+    """DataIndex-like facade fusing results of several DataIndexes."""
+
+    def __init__(self, indexes: list, k: float = 60.0):
+        self.indexes = indexes
+        self.k = k
+
+    def query_as_of_now(
+        self,
+        query_column,
+        *,
+        number_of_matches: int = 3,
+        collapse_rows: bool = True,
+        with_distances: bool = False,
+        metadata_filter=None,
+    ):
+        if not collapse_rows:
+            raise NotImplementedError("hybrid index returns collapsed rows")
+        k_rrf = self.k
+        sub_results = [
+            idx.query_as_of_now(
+                query_column,
+                number_of_matches=number_of_matches * 2,
+                collapse_rows=True,
+                with_distances=False,
+                metadata_filter=metadata_filter,
+            )
+            for idx in self.indexes
+        ]
+        data_cols = sub_results[0].column_names()
+        base = sub_results[0]
+        combined = base
+        # zip sub-results per query key (same universe: the query table)
+        packed_cols = {}
+        for i, sub in enumerate(sub_results):
+            for c in data_cols:
+                packed_cols[f"__s{i}_{c}"] = sub[c]
+        packed = base.select(**packed_cols)
+
+        n_idx = len(sub_results)
+
+        def fuse(*tuples_per_index):
+            # tuples_per_index: for each sub-index, the per-column tuples in
+            # rank order; fuse by RRF over the first column's identity
+            scores: dict[Any, float] = {}
+            rows: dict[Any, tuple] = {}
+            per_index_cols = [
+                tuples_per_index[i * len(data_cols) : (i + 1) * len(data_cols)]
+                for i in range(n_idx)
+            ]
+            for cols in per_index_cols:
+                first = cols[0]
+                for rank, ident in enumerate(first):
+                    row = tuple(col[rank] for col in cols)
+                    key = repr(row)
+                    scores[key] = scores.get(key, 0.0) + 1.0 / (k_rrf + rank + 1)
+                    rows[key] = row
+            ranked = sorted(scores.items(), key=lambda kv: -kv[1])[:number_of_matches]
+            fused_cols = []
+            for ci in range(len(data_cols)):
+                fused_cols.append(tuple(rows[key][ci] for key, _s in ranked))
+            return tuple(fused_cols)
+
+        fused = packed.select(
+            __fused=expr_mod.apply_with_type(
+                fuse,
+                dt.ANY_TUPLE,
+                *[packed[f"__s{i}_{c}"] for i in range(n_idx) for c in data_cols],
+            )
+        )
+        return fused.select(
+            **{
+                c: expr_mod.GetExpression(fused["__fused"], ci, check_if_exists=False)
+                for ci, c in enumerate(data_cols)
+            }
+        )
+
+    query = query_as_of_now
+
+
+@dataclass
+class HybridIndexFactory:
+    retriever_factories: list = field(default_factory=list)
+    k: float = 60.0
+
+    def build_index(self, data_column, data_table, metadata_column=None):
+        indexes = [
+            f.build_index(data_column, data_table, metadata_column=metadata_column)
+            for f in self.retriever_factories
+        ]
+        return HybridIndexDataIndex(indexes, self.k)

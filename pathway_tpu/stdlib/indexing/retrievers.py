@@ -1,0 +1,10 @@
+"""Retriever factory protocol (reference ``stdlib/indexing/retrievers.py``)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+
+class AbstractRetrieverFactory(ABC):
+    @abstractmethod
+    def build_index(self, data_column, data_table, metadata_column=None): ...

@@ -1,0 +1,86 @@
+"""Default vector document index constructors (reference
+``stdlib/indexing/vector_document_index.py``)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    DistanceMetric,
+    LshKnn,
+    USearchKnn,
+)
+
+
+def default_vector_document_index(
+    data_column,
+    data_table,
+    *,
+    embedder: Callable | None = None,
+    dimensions: int,
+    metadata_column=None,
+) -> DataIndex:
+    return default_brute_force_knn_document_index(
+        data_column,
+        data_table,
+        embedder=embedder,
+        dimensions=dimensions,
+        metadata_column=metadata_column,
+    )
+
+
+def default_brute_force_knn_document_index(
+    data_column,
+    data_table,
+    *,
+    embedder: Callable | None = None,
+    dimensions: int,
+    metadata_column=None,
+) -> DataIndex:
+    inner = BruteForceKnn(
+        data_column,
+        metadata_column,
+        dimensions=dimensions,
+        reserved_space=1024,
+        metric=DistanceMetric.COS,
+        embedder=embedder,
+    )
+    return DataIndex(data_table, inner)
+
+
+def default_usearch_knn_document_index(
+    data_column,
+    data_table,
+    *,
+    embedder: Callable | None = None,
+    dimensions: int,
+    metadata_column=None,
+) -> DataIndex:
+    inner = USearchKnn(
+        data_column,
+        metadata_column,
+        dimensions=dimensions,
+        reserved_space=1024,
+        metric=DistanceMetric.COS,
+        embedder=embedder,
+    )
+    return DataIndex(data_table, inner)
+
+
+def default_lsh_knn_document_index(
+    data_column,
+    data_table,
+    *,
+    embedder: Callable | None = None,
+    dimensions: int,
+    metadata_column=None,
+) -> DataIndex:
+    inner = LshKnn(
+        data_column,
+        metadata_column,
+        dimensions=dimensions,
+        embedder=embedder,
+    )
+    return DataIndex(data_table, inner)

@@ -1,0 +1,45 @@
+"""KNN classifiers (reference ``stdlib/ml/classifiers.py`` — LSH-based
+kNN voting). Voting over the TPU KNN index results."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+
+def knn_lsh_classifier_train(data, L: int = 20, type: str = "euclidean", **kwargs):  # noqa: A002
+    """Returns a classify(queries, k, labels) callable (API parity)."""
+    n_dim = kwargs.get("d", kwargs.get("n_dimensions"))
+
+    def classify(queries_embedding, labels_column, k: int = 3):
+        index = KNNIndex(
+            kwargs["data_embedding"] if "data_embedding" in kwargs else data.data,
+            data,
+            n_dimensions=n_dim or 0,
+            distance_type="euclidean" if type == "euclidean" else "cosine",
+        )
+        neighbors = index.get_nearest_items(queries_embedding, k=k)
+        label_name = labels_column.name
+
+        def majority(labels):
+            from collections import Counter
+
+            if not labels:
+                return None
+            return Counter(labels).most_common(1)[0][0]
+
+        return neighbors.select(
+            predicted_label=expr_mod.apply_with_type(
+                majority, dt.ANY, neighbors[label_name]
+            )
+        )
+
+    return classify
+
+
+knn_lsh_train = knn_lsh_classifier_train
+
+
+def knn_lsh_classify(classifier, *args, **kwargs):
+    return classifier(*args, **kwargs)

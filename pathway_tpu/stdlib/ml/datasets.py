@@ -1,0 +1,8 @@
+"""Dataset helpers (reference ``stdlib/ml/datasets``) — loaders for local
+files; remote fetching requires network access and raises."""
+
+from __future__ import annotations
+
+
+def load_mnist(*args, **kwargs):
+    raise NotImplementedError("dataset download requires network access")

@@ -1,0 +1,61 @@
+"""Fuzzy join (reference ``stdlib/ml/smart_table_ops/_fuzzy_join.py``):
+match rows of two tables by feature overlap."""
+
+from __future__ import annotations
+
+import enum
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals import thisclass
+
+
+class FuzzyJoinFeatureGeneration(enum.Enum):
+    AUTO = 0
+    TOKENIZE = 1
+
+
+class FuzzyJoinNormalization(enum.Enum):
+    WEIGHT = 0
+    LOG_WEIGHT = 1
+
+
+def smart_fuzzy_join(
+    left,
+    right,
+    left_column=None,
+    right_column=None,
+    **kwargs,
+):
+    """Match rows by shared lowercase tokens, scoring by inverse token
+    frequency; returns (left_id, right_id, weight)."""
+    import re
+
+    def tokens(s):
+        return tuple(t.lower() for t in re.findall(r"[A-Za-z0-9]+", s or ""))
+
+    lcol = left_column if left_column is not None else left[left.column_names()[0]]
+    rcol = right_column if right_column is not None else right[right.column_names()[0]]
+
+    ltok = left.select(
+        lid=left.id, token=expr_mod.apply_with_type(tokens, dt.ANY_TUPLE, lcol)
+    ).flatten(thisclass.this.token)
+    rtok = right.select(
+        rid=right.id, token=expr_mod.apply_with_type(tokens, dt.ANY_TUPLE, rcol)
+    ).flatten(thisclass.this.token)
+    pairs = ltok.join(rtok, ltok.token == rtok.token).select(
+        lid=thisclass.left.lid, rid=thisclass.right.rid
+    )
+    scored = pairs.groupby(pairs.lid, pairs.rid).reduce(
+        pairs.lid, pairs.rid, weight=reducers.count()
+    )
+    best = scored.groupby(thisclass.this.lid).reduce(
+        left_id=thisclass.this.lid,
+        best_match=reducers.argmax(thisclass.this.weight),
+        weight=reducers.max(thisclass.this.weight),
+    )
+    return best
+
+
+fuzzy_match_tables = smart_fuzzy_join

@@ -1,0 +1,113 @@
+"""AsyncTransformer — fully-async row→row transformation with its own output
+universe (reference ``stdlib/utils/async_transformer.py:282``): invoke() runs
+per row; failed rows are filtered out; ``.successful`` / ``.failed`` /
+``.finished`` views.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from abc import ABC, abstractmethod
+from typing import Any, ClassVar
+
+from pathway_tpu.engine.value import ERROR
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+
+
+class AsyncTransformer(ABC):
+    output_schema: ClassVar[Any]
+
+    def __init__(self, input_table: Table, instance=None, **kwargs):
+        self._input_table = input_table
+        self._instance = instance
+
+    @abstractmethod
+    async def invoke(self, *args, **kwargs) -> dict: ...
+
+    def open(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def successful(self) -> Table:
+        return self.result
+
+    @property
+    def failed(self) -> Table:
+        result = self._full_result()
+        # rows whose outputs errored: the apply propagates ERROR, fill_error
+        # turns it into True; clean rows evaluate to False and are dropped
+        cond = expr_mod.fill_error(
+            expr_mod.apply_with_type(
+                lambda *vals: False, bool, *[result[n] for n in result.column_names()]
+            ),
+            True,
+        )
+        return result.filter(cond)
+
+    @property
+    def finished(self) -> Table:
+        return self._full_result()
+
+    _cached: Table | None = None
+
+    def _full_result(self) -> Table:
+        if self._cached is not None:
+            return self._cached
+        self.open()
+        schema = self.output_schema
+        cols = list(self._input_table.column_names())
+        out_cols = list(schema.column_names())
+        transformer = self
+
+        async def call(*vals):
+            kwargs = dict(zip(cols, vals))
+            result = await transformer.invoke(**kwargs)
+            return tuple(result.get(c) for c in out_cols)
+
+        tuple_expr = expr_mod.AsyncApplyExpression(
+            call,
+            dt.ANY_TUPLE,
+            args=tuple(self._input_table[c] for c in cols),
+        )
+        packed = self._input_table.select(__packed=tuple_expr)
+        exprs = {
+            name: expr_mod.GetExpression(
+                packed["__packed"], i, check_if_exists=False
+            )
+            for i, name in enumerate(out_cols)
+        }
+        result = packed.select(**exprs)
+        result = Table(
+            result._node,
+            schema_mod.schema_builder_from_definitions(
+                {
+                    n: schema_mod.ColumnDefinition(
+                        dtype=schema.__columns__[n].dtype, name=n
+                    )
+                    for n in out_cols
+                }
+            ),
+            result._universe,
+        )
+        self._cached = result
+        return result
+
+    @property
+    def result(self) -> Table:
+        result = self._full_result()
+        cond = expr_mod.fill_error(
+            expr_mod.apply_with_type(
+                lambda *vals: True, bool, *[result[n] for n in result.column_names()]
+            ),
+            False,
+        )
+        return result.filter(cond)
+
+    def with_options(self, **kwargs) -> "AsyncTransformer":
+        return self

@@ -1,0 +1,48 @@
+"""Column manipulation helpers (reference ``stdlib/utils/col.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.expression import ColumnReference
+
+
+def unpack_col(column, *unpacked_columns, schema=None):
+    """Expand a tuple column into separate columns."""
+    table = column.table
+    if schema is not None:
+        names = list(schema.column_names())
+    else:
+        names = [
+            c.name if isinstance(c, ColumnReference) else c
+            for c in unpacked_columns
+        ]
+    from pathway_tpu.internals import expression as expr_mod
+
+    exprs = {
+        name: expr_mod.GetExpression(column, i, check_if_exists=False)
+        for i, name in enumerate(names)
+    }
+    return table.select(**exprs)
+
+
+def multiapply_all_rows(*cols, fun, result_col_names):
+    raise NotImplementedError("multiapply_all_rows arrives with row transformers")
+
+
+def apply_all_rows(*cols, fun, result_col_name):
+    raise NotImplementedError("apply_all_rows arrives with row transformers")
+
+
+def groupby_reduce_majority(column, votes_column):
+    table = column.table
+    grouped = table.groupby(column, votes_column).reduce(
+        column, votes_column, _pw_count=_count_reducer()
+    )
+    return grouped
+
+
+def _count_reducer():
+    from pathway_tpu.internals import reducers
+
+    return reducers.count()

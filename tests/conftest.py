@@ -1,0 +1,25 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh so sharding
+paths are exercised without TPU hardware (the driver separately dry-runs the
+multi-chip path; benches use the real chip)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    """Each test gets a clean global graph and error log."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.errors import get_global_error_log
+
+    pw.clear_graph()
+    get_global_error_log().clear()
+    yield

@@ -1,0 +1,507 @@
+"""Core Table-API tests (modeled on reference ``tests/test_common.py``)."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality, assert_table_equality_wo_index
+
+
+def test_select_arithmetic():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    res = t.select(s=t.a + t.b, p=t.a * t.b, d=t.b - t.a)
+    expected = T(
+        """
+        s | p  | d
+        3 | 2  | 1
+        7 | 12 | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_select_keeps_keys():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = t.select(b=t.a * 10)
+    assert_table_equality(res.select(a=res.b // 10), t.select(t.a))
+
+
+def test_with_columns():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    res = t.with_columns(b=t.a + 1)
+    assert res.column_names() == ["a", "b"]
+
+
+def test_filter():
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        4
+        """
+    )
+    assert_table_equality_wo_index(
+        t.filter(t.a % 2 == 0),
+        T(
+            """
+            a
+            2
+            4
+            """
+        ),
+    )
+
+
+def test_filter_chained_same_universe():
+    t = T(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        3 | 30
+        """
+    )
+    f = t.filter(t.a > 1)
+    res = f.select(f.a, f.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            2 | 20
+            3 | 30
+            """
+        ),
+    )
+
+
+def test_rename():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    res = t.rename(new_a=t.a)
+    assert res.column_names() == ["new_a"]
+
+
+def test_concat_reindex():
+    t1 = T(
+        """
+        x
+        1
+        """
+    )
+    t2 = T(
+        """
+        x
+        2
+        """
+    )
+    assert_table_equality_wo_index(
+        t1.concat_reindex(t2),
+        T(
+            """
+            x
+            1
+            2
+            """
+        ),
+    )
+
+
+def test_update_rows():
+    t1 = T(
+        """
+        k | v
+        a | 1
+        b | 2
+        """,
+        id_from=["k"],
+    )
+    t2 = T(
+        """
+        k | v
+        b | 20
+        c | 30
+        """,
+        id_from=["k"],
+    )
+    assert_table_equality_wo_index(
+        t1.update_rows(t2),
+        T(
+            """
+            k | v
+            a | 1
+            b | 20
+            c | 30
+            """,
+            id_from=["k"],
+        ),
+    )
+
+
+def test_update_cells():
+    t1 = T(
+        """
+        k | v | w
+        a | 1 | x
+        b | 2 | y
+        """,
+        id_from=["k"],
+    )
+    t2 = T(
+        """
+        k | v
+        b | 20
+        """,
+        id_from=["k"],
+    )
+    res = t1.update_cells(t2.with_id_from(t2.k))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            k | v  | w
+            a | 1  | x
+            b | 20 | y
+            """,
+            id_from=["k"],
+        ),
+    )
+
+
+def test_difference_intersect():
+    t1 = T(
+        """
+        k | v
+        a | 1
+        b | 2
+        c | 3
+        """,
+        id_from=["k"],
+    )
+    t2 = T(
+        """
+        k | w
+        b | 9
+        c | 9
+        d | 9
+        """,
+        id_from=["k"],
+    )
+    assert_table_equality_wo_index(
+        t1.difference(t2),
+        T(
+            """
+            k | v
+            a | 1
+            """,
+            id_from=["k"],
+        ),
+    )
+    assert_table_equality_wo_index(
+        t1.intersect(t2),
+        T(
+            """
+            k | v
+            b | 2
+            c | 3
+            """,
+            id_from=["k"],
+        ),
+    )
+
+
+def test_flatten():
+    t = T(
+        """
+        w
+        ab
+        c
+        """
+    )
+    assert_table_equality_wo_index(
+        t.flatten(t.w),
+        T(
+            """
+            w
+            a
+            b
+            c
+            """
+        ),
+    )
+
+
+def test_pointer_from_matches_with_id_from():
+    t = T(
+        """
+        k | v
+        a | 1
+        b | 2
+        """
+    )
+    reindexed = t.with_id_from(t.k)
+    ptrs = reindexed.select(p=reindexed.pointer_from(reindexed.k))
+    ids = ptrs.select(ok=ptrs.p == ptrs.id)
+    from tests.utils import _capture_rows
+
+    rows, _ = _capture_rows(ids)
+    assert all(row[0] is True for row in rows.values())
+
+
+def test_ix():
+    t = T(
+        """
+        k | v
+        a | 1
+        b | 2
+        """,
+        id_from=["k"],
+    )
+    ptr = t.select(p=t.pointer_from(t.k))
+    res = ptr.select(v=t.ix(ptr.p).v)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            v
+            1
+            2
+            """
+        ),
+    )
+
+
+def test_this_star_expansion():
+    t = T(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    res = t.select(*pw.this)
+    assert res.column_names() == ["a", "b"]
+    res2 = t.select(*pw.this.without(pw.this.a))
+    assert res2.column_names() == ["b"]
+
+
+def test_if_else_coalesce_require():
+    t = T(
+        """
+        a | b
+        1 | 10
+        2 |
+        """
+    )
+    res = t.select(
+        x=pw.if_else(t.a == 1, t.a * 100, t.a),
+        y=pw.coalesce(t.b, 0),
+        z=pw.require(t.a, t.b),
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            x   | y  | z
+            100 | 10 | 1
+            2   | 0  |
+            """
+        ),
+    )
+
+
+def test_division_by_zero_is_error():
+    t = T(
+        """
+        a | b
+        6 | 2
+        1 | 0
+        """
+    )
+    res = t.select(q=pw.fill_error(t.a // t.b, -1))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            q
+            3
+            -1
+            """
+        ),
+    )
+    log = pw.internals.errors.get_global_error_log()
+    assert any("ZeroDivision" in e["message"] for e in log.entries)
+
+
+def test_apply_and_udf():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+
+    @pw.udf
+    def square(x: int) -> int:
+        return x * x
+
+    res = t.select(s=square(t.a), v=pw.apply_with_type(lambda x: -x, int, t.a))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            s | v
+            1 | -1
+            4 | -2
+            """
+        ),
+    )
+
+
+def test_async_udf():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+
+    @pw.udf
+    async def double(x: int) -> int:
+        return 2 * x
+
+    assert_table_equality_wo_index(
+        t.select(d=double(t.a)),
+        T(
+            """
+            d
+            2
+            4
+            """
+        ),
+    )
+
+
+def test_update_stream_retraction():
+    t = T(
+        """
+        v | __time__ | __diff__
+        1 | 2        | 1
+        2 | 2        | 1
+        1 | 4        | -1
+        """
+    )
+    assert_table_equality_wo_index(
+        t,
+        T(
+            """
+            v
+            2
+            """
+        ),
+    )
+
+
+def test_groupby_incremental_updates():
+    t = T(
+        """
+        g | v | __time__ | __diff__
+        a | 1 | 2        | 1
+        a | 2 | 4        | 1
+        a | 1 | 6        | -1
+        """
+    )
+    res = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            g | s
+            a | 2
+            """
+        ),
+    )
+
+
+def test_string_methods():
+    t = T(
+        """
+        s
+        'Hello World'
+        """
+    )
+    res = t.select(
+        lo=t.s.str.lower(),
+        n=t.s.str.len(),
+        sw=t.s.str.startswith("Hel"),
+        rep=t.s.str.replace("World", "There"),
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            lo            | n  | sw   | rep
+            'hello world' | 11 | True | 'Hello There'
+            """
+        ),
+    )
+
+
+def test_make_tuple_and_get():
+    t = T(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    res = t.select(p=pw.make_tuple(t.a, t.b))
+    res2 = res.select(x=res.p.get(0), y=res.p[1], z=res.p.get(5, -1))
+    assert_table_equality_wo_index(
+        res2,
+        T(
+            """
+            x | y | z
+            1 | 2 | -1
+            """
+        ),
+    )
+
+
+def test_cast_and_to_string():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    res = t.select(f=pw.cast(float, t.a), s=t.a.to_string())
+    from tests.utils import _capture_rows
+
+    rows, _ = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[0] == 1.0 and isinstance(row[0], float)
+    assert row[1] == "1"

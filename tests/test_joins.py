@@ -1,0 +1,171 @@
+"""Join tests (modeled on reference ``tests/test_joins.py``)."""
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality_wo_index
+
+
+def _tables():
+    t1 = T(
+        """
+        a | k
+        1 | x
+        2 | y
+        3 | z
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        10 | y
+        20 | z
+        30 | w
+        """
+    )
+    return t1, t2
+
+
+def test_inner_join():
+    t1, t2 = _tables()
+    res = t1.join(t2, t1.k == t2.k).select(t1.a, t2.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            2 | 10
+            3 | 20
+            """
+        ),
+    )
+
+
+def test_left_join():
+    t1, t2 = _tables()
+    res = t1.join_left(t2, t1.k == t2.k).select(t1.a, t2.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            1 |
+            2 | 10
+            3 | 20
+            """
+        ),
+    )
+
+
+def test_outer_join():
+    t1, t2 = _tables()
+    res = t1.join_outer(t2, t1.k == t2.k).select(t1.a, t2.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            1 |
+            2 | 10
+            3 | 20
+              | 30
+            """
+        ),
+    )
+
+
+def test_join_left_right_placeholders():
+    t1, t2 = _tables()
+    res = t1.join(t2, pw.left.k == pw.right.k).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            2 | 10
+            3 | 20
+            """
+        ),
+    )
+
+
+def test_join_id_preservation():
+    t1, t2 = _tables()
+    res = t1.join(t2, t1.k == t2.k, id=t1.id).select(t1.a, t2.b)
+    # keys must be t1's keys for the matching rows
+    from tests.utils import _capture_rows
+
+    rows, _ = _capture_rows(res)
+    t1_rows, _ = _capture_rows(t1)
+    assert set(rows) <= set(t1_rows)
+
+
+def test_join_incremental_retraction():
+    t1 = T(
+        """
+        a | k | __time__ | __diff__
+        1 | x | 2        | 1
+        2 | y | 2        | 1
+        2 | y | 6        | -1
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        10 | x
+        20 | y
+        """
+    )
+    res = t1.join(t2, t1.k == t2.k).select(t1.a, t2.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            1 | 10
+            """
+        ),
+    )
+
+
+def test_multi_condition_join():
+    t1 = T(
+        """
+        a | b | v
+        1 | 1 | p
+        1 | 2 | q
+        """
+    )
+    t2 = T(
+        """
+        a | b | w
+        1 | 1 | P
+        1 | 3 | R
+        """
+    )
+    res = t1.join(t2, t1.a == t2.a, t1.b == t2.b).select(t1.v, t2.w)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            v | w
+            p | P
+            """
+        ),
+    )
+
+
+def test_join_filter():
+    t1, t2 = _tables()
+    res = (
+        t1.join(t2, t1.k == t2.k)
+        .select(t1.a, t2.b)
+    )
+    filtered = res.filter(res.b > 15)
+    assert_table_equality_wo_index(
+        filtered,
+        T(
+            """
+            a | b
+            3 | 20
+            """
+        ),
+    )

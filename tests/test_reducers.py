@@ -1,0 +1,197 @@
+"""Reducer tests (modeled on reference ``tests/test_reducers.py``)."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality_wo_index, _capture_rows
+
+
+def _t():
+    return T(
+        """
+        g | v | s
+        a | 3 | foo
+        a | 1 | bar
+        b | 2 | baz
+        """
+    )
+
+
+def test_count_sum_min_max_avg():
+    t = _t()
+    res = t.groupby(t.g).reduce(
+        t.g,
+        c=pw.reducers.count(),
+        s=pw.reducers.sum(t.v),
+        mn=pw.reducers.min(t.v),
+        mx=pw.reducers.max(t.v),
+        av=pw.reducers.avg(t.v),
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            g | c | s | mn | mx | av
+            a | 2 | 4 | 1  | 3  | 2.0
+            b | 1 | 2 | 2  | 2  | 2.0
+            """
+        ),
+    )
+
+
+def test_argmin_argmax():
+    t = _t()
+    res = t.groupby(t.g).reduce(
+        t.g, lo=pw.reducers.argmin(t.v), hi=pw.reducers.argmax(t.v)
+    )
+    looked = res.select(
+        res.g, lo_s=t.ix(res.lo).s, hi_s=t.ix(res.hi).s
+    )
+    assert_table_equality_wo_index(
+        looked,
+        T(
+            """
+            g | lo_s | hi_s
+            a | bar  | foo
+            b | baz  | baz
+            """
+        ),
+    )
+
+
+def test_sorted_tuple_and_tuple():
+    t = _t()
+    res = t.groupby(t.g).reduce(t.g, st=pw.reducers.sorted_tuple(t.v))
+    rows, cols = _capture_rows(res)
+    vals = {row[0]: row[1] for row in rows.values()}
+    assert vals["a"] == (1, 3)
+    assert vals["b"] == (2,)
+
+
+def test_unique_and_any():
+    t = T(
+        """
+        g | v
+        a | 7
+        a | 7
+        b | 1
+        """
+    )
+    res = t.groupby(t.g).reduce(t.g, u=pw.reducers.unique(t.v))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            g | u
+            a | 7
+            b | 1
+            """
+        ),
+    )
+
+
+def test_ndarray_reducer():
+    t = _t()
+    res = t.groupby(t.g).reduce(t.g, arr=pw.reducers.ndarray(t.v))
+    rows, _ = _capture_rows(res)
+    vals = {row[0]: row[1] for row in rows.values()}
+    assert sorted(vals["a"].tolist()) == [1, 3]
+
+
+def test_earliest_latest():
+    t = T(
+        """
+        g | v | __time__
+        a | 1 | 2
+        a | 2 | 4
+        a | 3 | 6
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        t.g, e=pw.reducers.earliest(t.v), l=pw.reducers.latest(t.v)
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            g | e | l
+            a | 1 | 3
+            """
+        ),
+    )
+
+
+def test_stateful_many():
+    @pw.reducers.stateful_many
+    def concat_all(state, rows):
+        out = [] if state is None else list(state)
+        for args, cnt in rows:
+            if cnt > 0:
+                out.extend([args[0]] * cnt)
+        return tuple(sorted(out))
+
+    t = _t()
+    res = t.groupby(t.g).reduce(t.g, c=concat_all(t.v))
+    rows, _ = _capture_rows(res)
+    vals = {row[0]: row[1] for row in rows.values()}
+    assert vals["a"] == (1, 3)
+
+
+def test_udf_reducer():
+    class Mean(pw.BaseCustomAccumulator):
+        def __init__(self, s, c):
+            self.s, self.c = s, c
+
+        @classmethod
+        def from_row(cls, row):
+            return cls(row[0], 1)
+
+        def update(self, other):
+            self.s += other.s
+            self.c += other.c
+
+        def compute_result(self):
+            return self.s / self.c
+
+    mean = pw.reducers.udf_reducer(Mean)
+    t = _t()
+    res = t.groupby(t.g).reduce(t.g, m=mean(t.v))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            g | m
+            a | 2.0
+            b | 2.0
+            """
+        ),
+    )
+
+
+def test_reduce_whole_table():
+    t = _t()
+    res = t.reduce(total=pw.reducers.sum(t.v))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            total
+            6
+            """
+        ),
+    )
+
+
+def test_groupby_expression_output():
+    t = _t()
+    res = t.groupby(t.g).reduce(t.g, double=pw.reducers.sum(t.v) * 2)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            g | double
+            a | 8
+            b | 4
+            """
+        ),
+    )

@@ -1,0 +1,287 @@
+"""Temporal tests: windows, temporal joins, behaviors (reference
+``tests/temporal/``)."""
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality_wo_index, _capture_rows
+
+
+def test_tumbling_window():
+    t = T(
+        """
+        t  | v
+        1  | 10
+        2  | 1
+        5  | 3
+        6  | 2
+        11 | 4
+        """
+    )
+    res = t.windowby(t.t, window=pw.temporal.tumbling(duration=5)).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            start | s
+            0     | 11
+            5     | 5
+            10    | 4
+            """
+        ),
+    )
+
+
+def test_sliding_window():
+    t = T(
+        """
+        t | v
+        4 | 1
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=2, duration=4)
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            start | c
+            2     | 1
+            4     | 1
+            """
+        ),
+    )
+
+
+def test_session_window():
+    t = T(
+        """
+        t  | v
+        1  | 1
+        2  | 2
+        10 | 3
+        """
+    )
+    res = t.windowby(t.t, window=pw.temporal.session(max_gap=3)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            start | end | s
+            1     | 2   | 3
+            10    | 10  | 3
+            """
+        ),
+    )
+
+
+def test_windowby_instance():
+    t = T(
+        """
+        t | g | v
+        1 | a | 1
+        2 | a | 2
+        1 | b | 5
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5), instance=t.g
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            s
+            3
+            5
+            """
+        ),
+    )
+
+
+def test_interval_join():
+    t1 = T(
+        """
+        t | a
+        3 | x
+        7 | y
+        """
+    )
+    t2 = T(
+        """
+        t | b
+        2 | p
+        4 | q
+        9 | r
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-1, 1)
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | p
+            x | q
+            """
+        ),
+    )
+
+
+def test_asof_join():
+    t1 = T(
+        """
+        t | a
+        3 | x
+        8 | y
+        """
+    )
+    t2 = T(
+        """
+        t | b
+        1 | p
+        5 | q
+        """
+    )
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, direction="backward"
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | p
+            y | q
+            """
+        ),
+    )
+
+
+def test_window_join():
+    t1 = T(
+        """
+        t | a
+        1 | x
+        6 | y
+        """
+    )
+    t2 = T(
+        """
+        t | b
+        2 | p
+        7 | q
+        """
+    )
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.tumbling(duration=5)
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | p
+            y | q
+            """
+        ),
+    )
+
+
+def test_asof_now_join():
+    t1 = T(
+        """
+        k | a | __time__
+        x | 1 | 4
+        """
+    )
+    t2 = T(
+        """
+        k | b | __time__
+        x | 10 | 2
+        x | 20 | 6
+        """,
+    )
+    # left row arrives at t=4: sees only b=10; b=20 at t=6 must NOT retrigger
+    res = pw.temporal.asof_now_join(t1, t2, t1.k == t2.k).select(
+        pw.left.a, pw.right.b
+    )
+    rows, _ = _capture_rows(res)
+    vals = sorted(tuple(r) for r in rows.values())
+    assert vals == [(1, 10)], vals
+
+
+def test_sort_prev_next():
+    t = T(
+        """
+        v
+        30
+        10
+        20
+        """
+    )
+    ptrs = t.sort(t.v)
+    res = t.select(
+        t.v,
+        nxt=t.ix(ptrs.next, optional=True).v,
+        prv=t.ix(ptrs.prev, optional=True).v,
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            v  | nxt | prv
+            10 | 20  |
+            20 | 30  | 10
+            30 |     | 20
+            """
+        ),
+    )
+
+
+def test_diff():
+    t = T(
+        """
+        t | v
+        1 | 10
+        2 | 13
+        3 | 19
+        """
+    )
+    res = t.diff(t.t, t.v)
+    rows, cols = _capture_rows(res)
+    vi = cols.index("diff_v")
+    vals = sorted(row[vi] for row in rows.values() if row[vi] is not None)
+    assert vals == [3, 6]
+
+
+def test_deduplicate():
+    t = T(
+        """
+        v | __time__
+        1 | 2
+        5 | 4
+        3 | 6
+        8 | 8
+        """
+    )
+    res = t.deduplicate(
+        value=t.v, acceptor=lambda new, old: old is None or new > old
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            v
+            8
+            """
+        ),
+    )

@@ -1,0 +1,67 @@
+"""Test helpers — the analog of the reference's ``tests/utils.py``
+(``assert_table_equality`` family)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.engine.state import values_equal
+from pathway_tpu.internals.run import capture_table
+
+
+def _capture_rows(table) -> dict:
+    cap = capture_table(table)
+    return dict(cap.state.rows), cap.column_names
+
+
+def assert_table_equality(t1, t2) -> None:
+    """Equal contents AND equal keys."""
+    r1, c1 = _capture_rows(t1)
+    r2, c2 = _capture_rows(t2)
+    assert c1 == c2, f"columns differ: {c1} vs {c2}"
+    assert set(r1) == set(r2), (
+        f"key sets differ: {sorted(r1)[:5]}... vs {sorted(r2)[:5]}..."
+    )
+    for k in r1:
+        assert values_equal(r1[k], r2[k]), f"row {k}: {r1[k]} != {r2[k]}"
+
+
+def assert_table_equality_wo_index(t1, t2) -> None:
+    """Equal multisets of rows, ignoring keys."""
+    r1, c1 = _capture_rows(t1)
+    r2, c2 = _capture_rows(t2)
+    assert c1 == c2, f"columns differ: {c1} vs {c2}"
+    rows1 = sorted(map(_canon, r1.values()))
+    rows2 = sorted(map(_canon, r2.values()))
+    assert rows1 == rows2, f"rows differ:\n{rows1}\nvs\n{rows2}"
+
+
+def _canon(row):
+    def one(v):
+        if v is None:
+            return (0, "")
+        if isinstance(v, np.ndarray):
+            return (3, "nd" + repr((v.shape, v.ravel().tolist())))
+        if isinstance(v, bool):
+            return (1, float(v))
+        if isinstance(v, (int, float)):
+            return (1, float(v))
+        if isinstance(v, str):
+            return (2, v)
+        return (4, repr(v))
+
+    return tuple(one(v) for v in row)
+
+
+def run_all_and_collect(table) -> list[tuple]:
+    """Capture the stream of (time, key, row, diff) updates."""
+    cap = capture_table(table)
+    out = []
+    for time, batch in cap.updates:
+        for k, row, diff in batch.rows():
+            out.append((time, k, row, diff))
+    return out
+
+
+T = pw.debug.table_from_markdown
