@@ -1,0 +1,76 @@
+"""Cross-encoder reranker: (query, doc) pair -> relevance score.
+
+TPU-native equivalent of sentence-transformers CrossEncoder as used by the
+reference's CrossEncoderReranker
+(/root/reference/python/pathway/xpacks/llm/rerankers.py:186-249). The pair is
+encoded jointly ([CLS] q [SEP] d [SEP]); the [CLS] hidden state goes through a
+tanh pooler and a scalar head. One jitted call scores a whole padded batch of
+pairs — the rerank stage of the RAG pipeline is a single MXU-bound kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models.tokenizer import HashTokenizer, pad_to_buckets
+from pathway_tpu.models.transformer import (
+    TransformerConfig,
+    MINILM_L6,
+    encode,
+    init_params,
+    _dense_init,
+)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def score_fn(params, head, input_ids, attention_mask, cfg: TransformerConfig):
+    hidden = encode(params, input_ids, attention_mask, cfg)
+    cls = hidden[:, 0, :]
+    pooled = jnp.tanh(cls @ params["pooler"]["w"].astype(jnp.float32)
+                      + params["pooler"]["b"].astype(jnp.float32))
+    return (pooled @ head["w"] + head["b"])[:, 0]
+
+
+class CrossEncoderModel:
+    """Host-facing reranker: [(query, doc)] -> np.ndarray scores."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig = MINILM_L6,
+        params=None,
+        head=None,
+        tokenizer=None,
+        max_length: int = 256,
+        seed: int = 1,
+    ):
+        self.cfg = cfg
+        self.tokenizer = tokenizer or HashTokenizer(max_length=max_length)
+        self.max_length = max_length
+        key = jax.random.PRNGKey(seed)
+        if params is None:
+            params = init_params(key, cfg)
+        self.params = params
+        if head is None:
+            head = {
+                "w": _dense_init(jax.random.fold_in(key, 7),
+                                 (cfg.hidden, 1), jnp.float32),
+                "b": jnp.zeros((1,), jnp.float32),
+            }
+        self.head = head
+
+    def score_batch(self, pairs: list[tuple[str, str]]) -> np.ndarray:
+        if not pairs:
+            return np.zeros((0,), dtype=np.float32)
+        ids, mask = self.tokenizer.encode_pairs(pairs, max_length=self.max_length)
+        ids, mask = pad_to_buckets(ids, mask)
+        out = score_fn(self.params, self.head, jnp.asarray(ids),
+                       jnp.asarray(mask), self.cfg)
+        return np.asarray(out[: len(pairs)])
+
+    def __call__(self, pairs: list[tuple[str, str]]) -> np.ndarray:
+        return self.score_batch(pairs)

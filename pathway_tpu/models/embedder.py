@@ -1,0 +1,85 @@
+"""Sentence embedder: encoder + masked mean pooling + L2 normalise.
+
+This is the TPU-native stand-in for sentence-transformers' MiniLM pipeline
+(reference: SentenceTransformerEmbedder,
+/root/reference/python/pathway/xpacks/llm/embedders.py:270-313 — which calls
+``model.encode`` on CPU/GPU). Here the whole embed step — encode, pool,
+normalise — is one jitted function; batches arrive padded to pow2 buckets so
+each (batch, seq) bucket compiles once and is reused for the stream's life.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models.tokenizer import (
+    HashTokenizer,
+    load_tokenizer,
+    pad_to_buckets,
+)
+from pathway_tpu.models.transformer import (
+    TransformerConfig,
+    MINILM_L6,
+    encode,
+    init_params,
+)
+
+
+def mean_pool(hidden: jax.Array, mask: jax.Array) -> jax.Array:
+    """Masked mean over the sequence axis; hidden (B,S,H), mask (B,S)."""
+    m = mask.astype(jnp.float32)[:, :, None]
+    summed = jnp.sum(hidden * m, axis=1)
+    counts = jnp.clip(jnp.sum(m, axis=1), 1.0, None)
+    return summed / counts
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def embed_fn(params, input_ids, attention_mask, cfg: TransformerConfig):
+    hidden = encode(params, input_ids, attention_mask, cfg)
+    pooled = mean_pool(hidden, attention_mask)
+    return pooled / jnp.clip(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9, None
+    )
+
+
+class SentenceEmbedderModel:
+    """Host-facing embedder: str batch -> np.ndarray (B, H) unit vectors."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig = MINILM_L6,
+        params=None,
+        tokenizer=None,
+        max_length: int = 128,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.tokenizer = tokenizer or HashTokenizer(max_length=max_length)
+        self.max_length = max_length
+        if params is None:
+            params = init_params(jax.random.PRNGKey(seed), cfg)
+        self.params = params
+
+    @classmethod
+    def from_local(cls, path: str, cfg: TransformerConfig = MINILM_L6, **kw):
+        return cls(cfg=cfg, tokenizer=load_tokenizer(path), **kw)
+
+    @property
+    def dim(self) -> int:
+        return self.cfg.hidden
+
+    def embed_batch(self, texts: list[str]) -> np.ndarray:
+        if not texts:
+            return np.zeros((0, self.cfg.hidden), dtype=np.float32)
+        ids, mask = self.tokenizer(texts, max_length=self.max_length)
+        ids, mask = pad_to_buckets(ids, mask)
+        out = embed_fn(self.params, jnp.asarray(ids), jnp.asarray(mask), self.cfg)
+        return np.asarray(out[: len(texts)])
+
+    def __call__(self, texts: list[str]) -> np.ndarray:
+        return self.embed_batch(texts)

@@ -1,0 +1,180 @@
+"""Tokenization for the model family.
+
+Two paths:
+
+* ``HashTokenizer`` — zero-dependency, deterministic hashing tokenizer.
+  Lowercases, splits on non-alphanumerics, maps each word (and its sub-word
+  fallback chunks) into the vocab range with a stable FNV-1a hash. No vocab
+  file needed, so it works in fully air-gapped environments; embedding quality
+  then comes from contrastive training (models/train.py) rather than
+  pretrained wordpieces.
+* ``load_tokenizer(path)`` — if the user has a local HuggingFace tokenizer
+  (e.g. a downloaded all-MiniLM-L6-v2), use it via ``transformers``; the
+  reference's embedders delegate tokenization the same way
+  (/root/reference/python/pathway/xpacks/llm/embedders.py:270-313).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+import numpy as np
+
+PAD_ID = 0
+CLS_ID = 101
+SEP_ID = 102
+UNK_ID = 100
+
+_WORD_RE = re.compile(r"[a-z0-9]+")
+
+
+def _fnv1a(s: str) -> int:
+    h = 0xCBF29CE484222325
+    for b in s.encode("utf-8"):
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class HashTokenizer:
+    """Deterministic hashing tokenizer with a BERT-compatible id layout."""
+
+    def __init__(self, vocab_size: int = 30522, max_length: int = 256):
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        # ids < reserved are for specials: BERT-style 999 for full vocabs,
+        # compact layout for small (test) vocabs
+        self._reserved = 999 if vocab_size >= 2000 else SEP_ID + 1
+        self._span = max(1, vocab_size - self._reserved)
+
+    def _word_id(self, w: str) -> int:
+        return self._reserved + (_fnv1a(w) % self._span)
+
+    def tokenize_ids(self, text: str, max_length: int | None = None) -> list[int]:
+        ml = max_length or self.max_length
+        ids = [CLS_ID]
+        for w in _WORD_RE.findall(text.lower()):
+            if len(ids) >= ml - 1:
+                break
+            ids.append(self._word_id(w))
+        ids.append(SEP_ID)
+        return ids
+
+    def __call__(
+        self,
+        texts: Sequence[str],
+        max_length: int | None = None,
+        pad_to: int | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Batch-encode. Returns (input_ids, attention_mask) int32/int32,
+        padded to ``pad_to`` (or the longest sequence)."""
+        seqs = [self.tokenize_ids(t, max_length) for t in texts]
+        width = pad_to or max((len(s) for s in seqs), default=2)
+        width = max(width, 2)
+        ids = np.full((len(seqs), width), PAD_ID, dtype=np.int32)
+        mask = np.zeros((len(seqs), width), dtype=np.int32)
+        for r, s in enumerate(seqs):
+            s = s[:width]
+            ids[r, : len(s)] = s
+            mask[r, : len(s)] = 1
+        return ids, mask
+
+    def pair(self, a: str, b: str, max_length: int | None = None) -> list[int]:
+        """[CLS] a [SEP] b [SEP] — cross-encoder input layout."""
+        ml = max_length or self.max_length
+        half = (ml - 3) // 2
+        ids = [CLS_ID]
+        for w in _WORD_RE.findall(a.lower())[:half]:
+            ids.append(self._word_id(w))
+        ids.append(SEP_ID)
+        for w in _WORD_RE.findall(b.lower())[: ml - 1 - len(ids)]:
+            ids.append(self._word_id(w))
+        ids.append(SEP_ID)
+        return ids
+
+    def encode_pairs(
+        self,
+        pairs: Sequence[tuple[str, str]],
+        max_length: int | None = None,
+        pad_to: int | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        seqs = [self.pair(a, b, max_length) for a, b in pairs]
+        width = pad_to or max((len(s) for s in seqs), default=2)
+        ids = np.full((len(seqs), width), PAD_ID, dtype=np.int32)
+        mask = np.zeros((len(seqs), width), dtype=np.int32)
+        for r, s in enumerate(seqs):
+            s = s[:width]
+            ids[r, : len(s)] = s
+            mask[r, : len(s)] = 1
+        return ids, mask
+
+
+def bucket_pow2(n: int, lo: int) -> int:
+    """Smallest power of two >= n, floored at lo. Shared padding discipline:
+    every (rows, seq) bucket compiles one executable that streams reuse."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_to_buckets(ids: np.ndarray, mask: np.ndarray,
+                   row_lo: int = 8, seq_lo: int = 16
+                   ) -> tuple[np.ndarray, np.ndarray]:
+    """Pad a tokenized batch up to pow2 (rows, seq) buckets."""
+    rows = bucket_pow2(ids.shape[0], row_lo)
+    seq = bucket_pow2(ids.shape[1], seq_lo)
+    ids = np.pad(ids, ((0, rows - ids.shape[0]), (0, seq - ids.shape[1])))
+    mask = np.pad(mask, ((0, rows - mask.shape[0]), (0, seq - mask.shape[1])))
+    return ids, mask
+
+
+class _HFTokenizerAdapter:
+    """Wraps a transformers tokenizer behind the HashTokenizer interface."""
+
+    def __init__(self, tok, max_length: int = 256):
+        self._tok = tok
+        self.max_length = max_length
+        self.vocab_size = tok.vocab_size
+
+    def __call__(self, texts, max_length=None, pad_to=None):
+        enc = self._tok(
+            list(texts),
+            truncation=True,
+            max_length=max_length or self.max_length,
+            padding="max_length" if pad_to else "longest",
+        )
+        ids = np.asarray(enc["input_ids"], dtype=np.int32)
+        mask = np.asarray(enc["attention_mask"], dtype=np.int32)
+        if pad_to and ids.shape[1] < pad_to:
+            ids = np.pad(ids, ((0, 0), (0, pad_to - ids.shape[1])))
+            mask = np.pad(mask, ((0, 0), (0, pad_to - mask.shape[1])))
+        return ids, mask
+
+    def encode_pairs(self, pairs, max_length=None, pad_to=None):
+        a = [p[0] for p in pairs]
+        b = [p[1] for p in pairs]
+        enc = self._tok(
+            a, b,
+            truncation=True,
+            max_length=max_length or self.max_length,
+            padding="max_length" if pad_to else "longest",
+        )
+        ids = np.asarray(enc["input_ids"], dtype=np.int32)
+        mask = np.asarray(enc["attention_mask"], dtype=np.int32)
+        return ids, mask
+
+
+def load_tokenizer(path_or_name: str | None = None, max_length: int = 256):
+    """Local HF tokenizer when a path is given, HashTokenizer otherwise.
+
+    An explicit ``path_or_name`` that fails to load raises: silently falling
+    back to hash ids against weights trained for the HF vocab would corrupt
+    embeddings with no visible error."""
+    if path_or_name:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(path_or_name, local_files_only=True)
+        return _HFTokenizerAdapter(tok, max_length)
+    return HashTokenizer(max_length=max_length)

@@ -1,0 +1,71 @@
+"""Contrastive training for the embedder (InfoNCE, in-batch negatives).
+
+The reference has no training loop (models are consumed pretrained); this
+framework ships one because the air-gapped HashTokenizer path needs a way to
+learn embeddings from the user's own corpus, and because the multi-chip dry
+run exercises a full dp+tp-sharded optimiser step (driver contract). The step
+is pure and jit-able: under a ``Mesh`` with batch sharded on ``dp`` and params
+on ``tp`` specs (transformer.param_partition_specs), XLA emits the psum for
+gradients across dp and the per-layer tp collectives automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from pathway_tpu.models.embedder import mean_pool
+from pathway_tpu.models.transformer import TransformerConfig, encode, init_params
+
+
+class TrainState(NamedTuple):
+    params: dict
+    opt_state: object
+    step: jax.Array
+
+
+def _embed(params, ids, mask, cfg):
+    hidden = encode(params, ids, mask, cfg)
+    pooled = mean_pool(hidden, mask)
+    return pooled / jnp.clip(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9, None
+    )
+
+
+def contrastive_loss(params, batch, cfg: TransformerConfig,
+                     temperature: float = 0.05):
+    """batch: dict with q_ids/q_mask/d_ids/d_mask; positives on the diagonal,
+    the rest of the batch are negatives (the standard sentence-transformers
+    MultipleNegativesRankingLoss objective)."""
+    q = _embed(params, batch["q_ids"], batch["q_mask"], cfg)
+    d = _embed(params, batch["d_ids"], batch["d_mask"], cfg)
+    logits = (q @ d.T) / temperature  # (B, B)
+    labels = jnp.arange(q.shape[0])
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return jnp.mean(loss)
+
+
+def init_train_state(rng, cfg: TransformerConfig,
+                     learning_rate: float = 2e-5) -> tuple[TrainState, object]:
+    params = init_params(rng, cfg)
+    tx = optax.adamw(learning_rate)
+    return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32)), tx
+
+
+def make_train_step(cfg: TransformerConfig, tx, temperature: float = 0.05):
+    """Returns train_step(state, batch) -> (state, loss). Jit it (optionally
+    with in/out shardings) at the call site."""
+
+    def train_step(state: TrainState, batch):
+        loss, grads = jax.value_and_grad(contrastive_loss)(
+            state.params, batch, cfg, temperature
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return train_step

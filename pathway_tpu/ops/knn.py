@@ -30,9 +30,10 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric"))
-def _search_kernel(corpus, valid_mask, queries, k: int, metric: str):
-    """scores: higher is better. corpus (N,d) bf16, queries (Q,d) f32."""
+def knn_scores(corpus, valid_mask, queries, metric: str):
+    """Masked similarity scores, higher is better; one MXU gemm.
+    corpus (N,d) bf16, queries (Q,d) f32 -> (Q,N) f32. Shared by the
+    single-chip kernel below and parallel/sharded_knn's per-shard kernel."""
     q = queries.astype(jnp.bfloat16)
     c = corpus
     dots = jax.lax.dot_general(
@@ -47,8 +48,12 @@ def _search_kernel(corpus, valid_mask, queries, k: int, metric: str):
         scores = -(qn + cn - 2.0 * dots)  # negative squared L2
     else:  # cosine / dot on normalized vectors
         scores = dots
-    scores = jnp.where(valid_mask[None, :], scores, _NEG_INF)
-    return jax.lax.top_k(scores, k)
+    return jnp.where(valid_mask[None, :], scores, _NEG_INF)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _search_kernel(corpus, valid_mask, queries, k: int, metric: str):
+    return jax.lax.top_k(knn_scores(corpus, valid_mask, queries, metric), k)
 
 
 def _next_pow2(n: int) -> int:
@@ -102,21 +107,38 @@ class BruteForceKnnIndex:
             v = v / norms
         return v
 
-    def add(self, keys: list, vectors: np.ndarray) -> None:
-        v = self._prep(vectors)
+    def _append(self, keys: list, v) -> None:
+        """Shared append: v is an already-normalised (m, d) device array."""
         m = len(keys)
-        if m == 0:
-            return
         self._grow(self.n + m)
         start = self.n
         self._corpus = jax.lax.dynamic_update_slice(
-            self._corpus, jnp.asarray(v, dtype=self.dtype), (start, 0)
+            self._corpus, v.astype(self.dtype), (start, 0)
         )
-        self._valid = self._valid.at[start : start + m].set(True)
+        self._valid = jax.lax.dynamic_update_slice(
+            self._valid, jnp.ones((m,), dtype=bool), (start,)
+        )
         for i, key in enumerate(keys):
             self._slot_of[key] = start + i
             self._keys.append(key)
         self.n += m
+
+    def add(self, keys: list, vectors: np.ndarray) -> None:
+        if not keys:
+            return
+        self._append(keys, jnp.asarray(self._prep(vectors)))
+
+    def add_device(self, keys: list, vectors) -> None:
+        """Fast path: vectors already on device (e.g. straight out of the
+        embedder) — normalise and append without a host round-trip."""
+        if not keys:
+            return
+        v = jnp.asarray(vectors, dtype=jnp.float32)
+        if v.ndim == 1:
+            v = v[None, :]
+        if self.metric == "cos":
+            v = v / jnp.clip(jnp.linalg.norm(v, axis=1, keepdims=True), 1e-9, None)
+        self._append(keys, v)
 
     def remove(self, keys: list) -> None:
         for key in keys:

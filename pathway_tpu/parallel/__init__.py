@@ -1,0 +1,37 @@
+"""pathway_tpu.parallel — device meshes, sharded state, collectives.
+
+The reference's parallelism is row-hash data-parallelism over timely workers
+connected by TCP (/root/reference/src/engine/dataflow/config.rs:63-127,
+external/timely-dataflow/communication/). The TPU-native equivalent keeps the
+worker=chip mapping but moves the data plane onto ICI: corpora live sharded
+across chip HBM, per-chip partial results merge with XLA collectives
+(all_gather / psum_scatter) inside one jitted step — no host round-trips, no
+socket serialisation.
+"""
+
+from pathway_tpu.parallel.mesh import (
+    make_mesh,
+    data_axis,
+    tensor_axis,
+    local_mesh,
+    shard_batch,
+    replicated,
+)
+from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex, sharded_topk_merge
+from pathway_tpu.parallel.distributed import (
+    DistributedConfig,
+    initialize_distributed,
+)
+
+__all__ = [
+    "make_mesh",
+    "data_axis",
+    "tensor_axis",
+    "local_mesh",
+    "shard_batch",
+    "replicated",
+    "ShardedKnnIndex",
+    "sharded_topk_merge",
+    "DistributedConfig",
+    "initialize_distributed",
+]

@@ -1,0 +1,55 @@
+"""Multi-host process bootstrap.
+
+The reference spawns N OS processes joined over localhost TCP
+(/root/reference/python/pathway/cli.py:53-109 `pathway spawn`,
+src/engine/dataflow/config.rs:88-127 PATHWAY_* env topology). The TPU-native
+equivalent is `jax.distributed`: one process per TPU host, chips addressed
+through the runtime, collectives over ICI/DCN. Env contract mirrors the
+reference's so the CLI feels the same:
+
+    PATHWAY_PROCESSES   — total host processes (reference: same name)
+    PATHWAY_PROCESS_ID  — this process's rank
+    PATHWAY_FIRST_PORT  — coordinator port base
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    num_processes: int = 1
+    process_id: int = 0
+    coordinator_address: str | None = None
+
+    @classmethod
+    def from_env(cls) -> "DistributedConfig":
+        n = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+        pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+        port = int(os.environ.get("PATHWAY_FIRST_PORT", "10000"))
+        addr = os.environ.get(
+            "PATHWAY_COORDINATOR", f"127.0.0.1:{port}" if n > 1 else None
+        )
+        return cls(num_processes=n, process_id=pid, coordinator_address=addr)
+
+
+_initialized = False
+
+
+def initialize_distributed(config: DistributedConfig | None = None) -> None:
+    """Idempotent jax.distributed init; no-op single-process."""
+    global _initialized
+    if _initialized:
+        return
+    cfg = config or DistributedConfig.from_env()
+    if cfg.num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
+    _initialized = True

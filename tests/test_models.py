@@ -1,0 +1,115 @@
+"""Tests for the model family (transformer encoder, embedder, cross-encoder,
+tokenizer, contrastive training). Mirrors the reference's xpack test approach
+of exercising the real compute path on tiny shapes (SURVEY.md §4 tier 4)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models import (
+    MINILM_L6,
+    CrossEncoderModel,
+    HashTokenizer,
+    SentenceEmbedderModel,
+    count_params,
+    init_params,
+    init_train_state,
+    make_train_step,
+    param_partition_specs,
+)
+from pathway_tpu.models.transformer import encode
+
+TINY = dataclasses.replace(
+    MINILM_L6, layers=2, hidden=32, heads=4, intermediate=64,
+    vocab_size=500, max_position=64,
+)
+
+
+def test_tokenizer_deterministic_and_padded():
+    tok = HashTokenizer(max_length=16)
+    ids1, mask1 = tok(["hello world", "a much longer sentence with many words"])
+    ids2, _ = tok(["hello world", "a much longer sentence with many words"])
+    np.testing.assert_array_equal(ids1, ids2)
+    assert ids1.shape == mask1.shape
+    assert mask1[0].sum() == 4  # CLS hello world SEP
+    # same word -> same id everywhere
+    a, _ = tok(["cat"])
+    b, _ = tok(["dog cat"])
+    assert a[0, 1] == b[0, 2]
+
+
+def test_tokenizer_pairs():
+    tok = HashTokenizer(max_length=32)
+    ids, mask = tok.encode_pairs([("what is tpu", "tensor processing unit")])
+    assert ids.shape[0] == 1
+    assert mask[0].sum() >= 8
+
+
+def test_encoder_shapes_and_dtype():
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    ids = jnp.ones((2, 8), jnp.int32)
+    mask = jnp.ones((2, 8), jnp.int32)
+    out = encode(params, ids, mask, TINY)
+    assert out.shape == (2, 8, TINY.hidden)
+    assert out.dtype == jnp.float32
+
+
+def test_encoder_mask_invariance():
+    """Padding tokens must not change unmasked positions' pooled output."""
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    tok = HashTokenizer(vocab_size=TINY.vocab_size, max_length=16)
+    m = SentenceEmbedderModel(cfg=TINY, params=params,
+                              tokenizer=tok, max_length=16)
+    e1 = m.embed_batch(["hello world"])
+    e2 = m.embed_batch(["hello world", "a longer other sentence pushing padding"])
+    np.testing.assert_allclose(e1[0], e2[0], atol=2e-2)
+
+
+def test_embedder_unit_norm_and_similarity():
+    tok = HashTokenizer(vocab_size=TINY.vocab_size, max_length=16)
+    m = SentenceEmbedderModel(cfg=TINY, tokenizer=tok, max_length=16)
+    e = m.embed_batch(["same text", "same text", "different words entirely"])
+    np.testing.assert_allclose(np.linalg.norm(e, axis=1), 1.0, atol=1e-5)
+    assert e[0] @ e[1] > 0.999
+    assert e[0] @ e[2] < e[0] @ e[1]
+
+
+def test_cross_encoder_scores():
+    tok = HashTokenizer(vocab_size=TINY.vocab_size, max_length=32)
+    ce = CrossEncoderModel(cfg=TINY, tokenizer=tok, max_length=32)
+    s = ce.score_batch([("q", "a"), ("q", "b"), ("q", "a")])
+    assert s.shape == (3,)
+    assert s[0] == pytest.approx(s[2], abs=1e-5)
+
+
+def test_param_count_minilm_scale():
+    params = init_params(jax.random.PRNGKey(0), MINILM_L6)
+    n = count_params(params)
+    # all-MiniLM-L6-v2 is ~22.7M params; same architecture family
+    assert 20_000_000 < n < 25_000_000
+
+
+def test_partition_specs_cover_params():
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    specs = param_partition_specs(TINY)
+    jax.tree.map(lambda p, s: None, params, specs)  # same tree structure
+
+
+def test_contrastive_training_reduces_loss():
+    state, tx = init_train_state(jax.random.PRNGKey(0), TINY,
+                                 learning_rate=1e-3)
+    step = jax.jit(make_train_step(TINY, tx))
+    tok = HashTokenizer(vocab_size=TINY.vocab_size, max_length=8)
+    qi, qm = tok([f"query {i}" for i in range(4)], pad_to=8)
+    di, dm = tok([f"document {i}" for i in range(4)], pad_to=8)
+    batch = dict(q_ids=jnp.asarray(qi), q_mask=jnp.asarray(qm),
+                 d_ids=jnp.asarray(di), d_mask=jnp.asarray(dm))
+    losses = []
+    for _ in range(5):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
