@@ -61,6 +61,7 @@ from pathway_tpu.internals.schema import (
 )
 from pathway_tpu.internals.table import Joinable, Table
 from pathway_tpu.internals.thisclass import left, right, this
+from pathway_tpu.internals import udfs
 from pathway_tpu.internals.udfs import (
     UDF,
     async_executor,

@@ -64,6 +64,7 @@ def _sub(e: ColumnExpression, m: Mapping[type, Any]) -> ColumnExpression:
             args=tuple(_sub(a, m) for a in e._args),
             kwargs={k: _sub(v, m) for k, v in e._kwargs.items()},
             max_batch_size=e._max_batch_size,
+            batched=e._batched,
         )
         return out
     if isinstance(e, expr_mod.CastExpression):

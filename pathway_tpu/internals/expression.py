@@ -291,6 +291,7 @@ class ApplyExpression(ColumnExpression):
         args: tuple = (),
         kwargs: dict | None = None,
         max_batch_size: int | None = None,
+        batched: bool = False,
     ):
         self._fun = fun
         self._return_type = dt.wrap(return_type) if return_type is not None else dt.ANY
@@ -299,6 +300,11 @@ class ApplyExpression(ColumnExpression):
         self._args = tuple(smart_coerce(a) for a in args)
         self._kwargs = {k: smart_coerce(v) for k, v in (kwargs or {}).items()}
         self._max_batch_size = max_batch_size
+        # batched=True: ``fun`` takes parallel LISTS of argument values for a
+        # whole epoch batch and returns a list of results — the microbatch
+        # that becomes one padded XLA call for TPU-backed UDFs (the analog of
+        # the reference draining a timely batch, operators.rs:269-305)
+        self._batched = batched
         self._check_for_disallowed_types = False
 
     def __repr__(self):

@@ -21,6 +21,7 @@ from pathway_tpu.internals.udfs.caches import (
     DefaultCache,
     DiskCache,
     InMemoryCache,
+    with_batch_cache_strategy,
     with_cache_strategy,
 )
 from pathway_tpu.internals.udfs.executors import (
@@ -92,6 +93,7 @@ class UDF:
         executor: Executor | None = None,
         cache_strategy: CacheStrategy | None = None,
         max_batch_size: int | None = None,
+        batch: bool = False,
     ):
         self.return_type = return_type
         self.deterministic = deterministic
@@ -99,6 +101,10 @@ class UDF:
         self.executor = executor if executor is not None else auto_executor()
         self.cache_strategy = cache_strategy
         self.max_batch_size = max_batch_size
+        # batch=True: ``__wrapped__`` receives parallel lists covering a whole
+        # epoch microbatch and returns a list — one padded XLA call per batch
+        # for TPU-backed UDFs (embedders/rerankers). Must be sync.
+        self.batch = batch
 
     def __wrapped__(self, *args, **kwargs):
         raise NotImplementedError
@@ -124,6 +130,8 @@ class UDF:
         return fun, isinstance(executor, (AsyncExecutor, FullyAsyncExecutor)) or is_async
 
     def __call__(self, *args, **kwargs) -> expr_mod.ColumnExpression:
+        if self.batch:
+            return self._call_batched(args, kwargs)
         fun, is_async = self._prepare_fun()
         rt = self._get_return_type()
         if isinstance(self.executor, FullyAsyncExecutor):
@@ -140,6 +148,27 @@ class UDF:
             args=args,
             kwargs=kwargs,
             max_batch_size=self.max_batch_size,
+        )
+
+    def _call_batched(self, args, kwargs) -> expr_mod.ColumnExpression:
+        fun = self.__wrapped__
+        if inspect.iscoroutinefunction(fun):
+            raise TypeError("batch=True UDFs must have a sync __wrapped__")
+        if self.cache_strategy is not None:
+            fun = with_batch_cache_strategy(fun, self.cache_strategy)
+        rt = self._get_return_type()
+        # a batched __wrapped__ is hinted list[X]; the per-row type is X
+        if self.return_type is None and typing.get_origin(rt) is list:
+            (rt,) = typing.get_args(rt)
+        return expr_mod.ApplyExpression(
+            fun,
+            rt,
+            propagate_none=self.propagate_none,
+            deterministic=self.deterministic,
+            args=args,
+            kwargs=kwargs,
+            max_batch_size=self.max_batch_size,
+            batched=True,
         )
 
 
@@ -168,12 +197,17 @@ def udf(
     executor: Executor | None = None,
     cache_strategy: CacheStrategy | None = None,
     max_batch_size: int | None = None,
+    batch: bool = False,
 ):
     """Decorator turning a function into a UDF usable in expressions.
 
     >>> @pw.udf
     ... def add_one(x: int) -> int:
     ...     return x + 1
+
+    With ``batch=True`` the function receives parallel lists covering a whole
+    epoch microbatch and returns a list of results — one padded XLA call per
+    batch for TPU-backed UDFs.
     """
 
     def wrapper(f):
@@ -185,6 +219,7 @@ def udf(
             executor=executor,
             cache_strategy=cache_strategy,
             max_batch_size=max_batch_size,
+            batch=batch,
         )
 
     if fun is not None:

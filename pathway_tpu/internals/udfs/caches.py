@@ -119,3 +119,46 @@ def with_cache_strategy(fun: Callable, cache: CacheStrategy) -> Callable:
         return value
 
     return wrapper
+
+
+def with_batch_cache_strategy(fun: Callable, cache: CacheStrategy) -> Callable:
+    """Row-level cache around a batched UDF: each row of the batch is keyed
+    independently; only cache misses are recomputed, in one sub-batch call."""
+    name = getattr(fun, "__name__", "udf")
+
+    @functools.wraps(fun)
+    def wrapper(*arg_lists, **kwarg_lists):
+        n = len(arg_lists[0]) if arg_lists else len(next(iter(kwarg_lists.values())))
+        out: list[Any] = [None] * n
+        miss: list[int] = []
+        keys: list[str] = []
+        for i in range(n):
+            row_args = tuple(col[i] for col in arg_lists)
+            row_kwargs = {k: v[i] for k, v in kwarg_lists.items()}
+            key = cache.make_key(name, row_args, row_kwargs)
+            keys.append(key)
+            hit, value = cache.get(key)
+            if hit:
+                out[i] = value
+            else:
+                miss.append(i)
+        if miss:
+            # dedupe identical rows within the batch: compute each key once
+            first_of: dict[str, int] = {}
+            unique: list[int] = []
+            for i in miss:
+                if keys[i] not in first_of:
+                    first_of[keys[i]] = i
+                    unique.append(i)
+            sub_args = [[col[i] for i in unique] for col in arg_lists]
+            sub_kwargs = {k: [v[i] for i in unique] for k, v in kwarg_lists.items()}
+            results = fun(*sub_args, **sub_kwargs)
+            by_key = {}
+            for i, r in zip(unique, results):
+                cache.put(keys[i], r)
+                by_key[keys[i]] = r
+            for i in miss:
+                out[i] = by_key[keys[i]]
+        return out
+
+    return wrapper

@@ -18,13 +18,26 @@ from pathway_tpu.engine.graph import Node
 
 
 class BaseConnector:
-    """Owns one InputNode; subclasses implement ``run(ctx)``."""
+    """Owns one InputNode; subclasses implement ``run(ctx)``.
+
+    Live (wall-clock-timed) connectors set ``heartbeat_ms``: while the source
+    is idle a heartbeat thread keeps advancing its frontier so OTHER sources'
+    later events can be processed — the analog of the reference's autocommit
+    timer advancing time without data (``src/connectors/mod.rs:207``,
+    ``advance_time``). ``commit_rows``/``heartbeat`` share a mutex so a
+    commit's time can never fall behind an interleaved heartbeat advance.
+    """
+
+    heartbeat_ms: int | None = None
 
     def __init__(self, node: Node):
         self.node = node
         self._thread: threading.Thread | None = None
+        self._hb_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._sched = None
+        self._time_mutex = threading.Lock()
+        self._closed = False
 
     # -- session API used by run() implementations -------------------------
     def emit(self, time: int, rows: list[tuple[int, tuple, int]]) -> None:
@@ -34,10 +47,24 @@ class BaseConnector:
             )
 
     def advance(self, new_time: int) -> None:
+        if self._closed:
+            return
         self._sched.advance_source(self.node, new_time)
 
+    def commit_rows(self, rows: list[tuple[int, tuple, int]]) -> int:
+        """Atomically emit ``rows`` at a fresh commit time and advance the
+        frontier past it (safe against the heartbeat)."""
+        with self._time_mutex:
+            t = next_commit_time()
+            self.emit(t, rows)
+            self.advance(t + 1)
+            return t
+
     def close(self) -> None:
-        self._sched.close_source(self.node)
+        with self._time_mutex:
+            self._closed = True
+            if self._sched is not None:
+                self._sched.close_source(self.node)
 
     def should_stop(self) -> bool:
         return self._stop.is_set()
@@ -48,6 +75,17 @@ class BaseConnector:
         self._stop.clear()
         self._thread = threading.Thread(target=self._run_safe, daemon=True)
         self._thread.start()
+        if self.heartbeat_ms is not None:
+            self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+            self._hb_thread.start()
+
+    def _heartbeat_loop(self) -> None:
+        interval = (self.heartbeat_ms or 500) / 1000.0
+        while not self._stop.wait(interval):
+            with self._time_mutex:
+                if self._closed:
+                    return
+                self.advance(next_commit_time() + 1)
 
     def _run_safe(self):
         try:
@@ -104,6 +142,8 @@ class CallbackConnector(BaseConnector):
     """Adapts a generator of (rows, advance_hint) into commits — used by
     demo streams and the Python ConnectorSubject."""
 
+    heartbeat_ms = 500
+
     def __init__(self, node: Node, generator: Callable, autocommit_ms: int | None):
         super().__init__(node)
         self.generator = generator
@@ -113,6 +153,4 @@ class CallbackConnector(BaseConnector):
         for rows in self.generator(self):
             if self.should_stop():
                 break
-            t = next_commit_time()
-            self.emit(t, rows)
-            self.advance(t + 1)
+            self.commit_rows(rows)

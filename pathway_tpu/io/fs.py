@@ -114,6 +114,8 @@ class _FsConnector(BaseConnector):
         self.with_metadata = with_metadata
         self.csv_settings = csv_settings
         self.refresh_interval = refresh_interval
+        if mode != "static":
+            self.heartbeat_ms = 500
 
     def _read_all(self, seen: dict[str, float]) -> list[tuple[int, tuple, int]]:
         cols = list(self.node.column_names)
@@ -143,18 +145,14 @@ class _FsConnector(BaseConnector):
     def run(self):
         seen: dict[str, float] = {}
         rows = self._read_all(seen)
-        t = next_commit_time()
-        self.emit(t, rows)
-        self.advance(t + 1)
+        self.commit_rows(rows)
         if self.mode == "static":
             return
         while not self.should_stop():
             time_mod.sleep(self.refresh_interval)
             rows = self._read_all(seen)
             if rows:
-                t = next_commit_time()
-                self.emit(t, rows)
-                self.advance(t + 1)
+                self.commit_rows(rows)
 
 
 def read(

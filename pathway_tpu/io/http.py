@@ -103,6 +103,8 @@ class PathwayWebserver:
 
 
 class _RestConnector(BaseConnector):
+    heartbeat_ms = 500
+
     def __init__(self, node, schema, webserver: PathwayWebserver, route: str, methods, delete_completed_queries: bool):
         super().__init__(node)
         self.schema = schema
@@ -123,14 +125,10 @@ class _RestConnector(BaseConnector):
         with self._pending_lock:
             self._pending[key] = (fut, loop)
         row = tuple(values[c] for c in cols)
-        t = next_commit_time()
-        self.emit(t, [(key, row, 1)])
-        self.advance(t + 1)
+        self.commit_rows([(key, row, 1)])
         result = await fut
         if self.delete_completed:
-            t = next_commit_time()
-            self.emit(t, [(key, row, -1)])
-            self.advance(t + 1)
+            self.commit_rows([(key, row, -1)])
         return result
 
     def resolve(self, key: int, result: Any) -> None:

@@ -49,6 +49,8 @@ class InMemoryKafkaBroker:
 
 
 class _BrokerConnector(BaseConnector):
+    heartbeat_ms = 500
+
     def __init__(self, node, broker: InMemoryKafkaBroker, topic: str, schema, fmt: str):
         super().__init__(node)
         self.broker = broker
@@ -81,9 +83,7 @@ class _BrokerConnector(BaseConnector):
                         key = hash_values(self.topic, self._counter)
                         self._counter += 1
                     rows.append((key, tuple(values[c] for c in cols), 1))
-                t = next_commit_time()
-                self.emit(t, rows)
-                self.advance(t + 1)
+                self.commit_rows(rows)
             elif self.broker.closed:
                 return
             else:

@@ -70,6 +70,8 @@ class ConnectorSubject(ABC):
 
 
 class _PythonConnector(BaseConnector):
+    heartbeat_ms = 500
+
     def __init__(self, node, subject: ConnectorSubject, schema):
         super().__init__(node)
         self.subject = subject
@@ -101,9 +103,7 @@ class _PythonConnector(BaseConnector):
             elif diff < 0 and key in self._emitted_keys:
                 row = self._emitted_keys.pop(key)
             rows.append((key, row, diff))
-        t = next_commit_time()
-        self.emit(t, rows)
-        self.advance(t + 1)
+        self.commit_rows(rows)
 
     def run(self):
         self.subject._connector = self

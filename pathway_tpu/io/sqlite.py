@@ -22,6 +22,8 @@ class _SqliteConnector(BaseConnector):
         self.table_name = table_name
         self.schema = schema
         self.mode = mode
+        if mode != "static":
+            self.heartbeat_ms = 500
 
     def _snapshot(self):
         cols = list(self.node.column_names)
@@ -57,9 +59,7 @@ class _SqliteConnector(BaseConnector):
                     rows.append((k, row, 1))
             prev = cur
             if rows:
-                t = next_commit_time()
-                self.emit(t, rows)
-                self.advance(t + 1)
+                self.commit_rows(rows)
             if self.mode == "static" or self.should_stop():
                 return
             time_mod.sleep(0.5)

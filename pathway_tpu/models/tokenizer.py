@@ -110,13 +110,7 @@ class HashTokenizer:
         return ids, mask
 
 
-def bucket_pow2(n: int, lo: int) -> int:
-    """Smallest power of two >= n, floored at lo. Shared padding discipline:
-    every (rows, seq) bucket compiles one executable that streams reuse."""
-    b = lo
-    while b < n:
-        b *= 2
-    return b
+from pathway_tpu.ops import next_pow2 as bucket_pow2  # shared padding discipline
 
 
 def pad_to_buckets(ids: np.ndarray, mask: np.ndarray,

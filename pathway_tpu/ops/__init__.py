@@ -1,1 +1,15 @@
-"""TPU compute kernels (JAX/XLA/Pallas) used by the engine and stdlib."""
+"""pathway_tpu.ops — jitted TPU kernels (KNN distance+top-k) and the shared
+padding discipline.
+
+Padding policy: everything entering a jitted call is padded to a power-of-two
+bucket so each (batch, seq) shape compiles once and the executable is reused
+for the stream's life.
+"""
+
+import math
+
+
+def next_pow2(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(n, floor). ``floor`` must be a power of
+    two; it sets the minimum bucket so tiny batches share one executable."""
+    return max(floor, 1 << math.ceil(math.log2(max(n, 1))))

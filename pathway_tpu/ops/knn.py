@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import functools
 import math
+
+from pathway_tpu.ops import next_pow2
 from typing import Any
 
 import numpy as np
@@ -56,8 +58,6 @@ def _search_kernel(corpus, valid_mask, queries, k: int, metric: str):
     return jax.lax.top_k(knn_scores(corpus, valid_mask, queries, metric), k)
 
 
-def _next_pow2(n: int) -> int:
-    return 1 << max(4, math.ceil(math.log2(max(n, 1))))
 
 
 class BruteForceKnnIndex:
@@ -74,7 +74,7 @@ class BruteForceKnnIndex:
     ):
         self.dim = dimensions
         self.metric = "l2" if str(metric).lower().startswith("l2") else "cos"
-        self.capacity = _next_pow2(reserved_space)
+        self.capacity = next_pow2(reserved_space, 16)
         self.dtype = dtype
         self._corpus = jnp.zeros((self.capacity, self.dim), dtype=dtype)
         self._valid = jnp.zeros((self.capacity,), dtype=bool)
@@ -165,7 +165,7 @@ class BruteForceKnnIndex:
             return [[] for _ in range(nq)]
         q = self._prep(queries)
         nq = len(q)
-        bucket = _next_pow2(nq)
+        bucket = next_pow2(nq, 16)
         if bucket > nq:
             q = np.concatenate([q, np.zeros((bucket - nq, self.dim), np.float32)])
         k_eff = min(k, self.capacity)

@@ -18,19 +18,32 @@ from __future__ import annotations
 
 import functools
 import math
+
+from pathway_tpu.ops import next_pow2
 from typing import Any
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pathway_tpu.ops.knn import knn_scores
 from pathway_tpu.parallel.mesh import DATA_AXIS
 
 _NEG_INF = -1e30
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_rows(corpus, valid, slots, vecs, vmask):
+    """Scatter a small dirty batch into the sharded corpus in place (buffers
+    donated; XLA keeps the DATA_AXIS sharding and routes each row to its
+    owning chip)."""
+    return corpus.at[slots].set(vecs.astype(corpus.dtype)), valid.at[slots].set(vmask)
 
 
 @functools.partial(
@@ -62,7 +75,7 @@ def _sharded_search(corpus, valid, queries, k: int, metric: str,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P()),
         out_specs=(P(), P()),
-        check_rep=False,
+        check_vma=False,
     )(corpus, valid[:, None], queries)
 
 
@@ -100,7 +113,7 @@ class ShardedKnnIndex:
         self.metric = "l2" if str(metric).lower().startswith("l2") else "cos"
         self.dtype = dtype
         per = max(64, int(math.ceil(reserved_space / self.dp)))
-        self.shard_rows = 1 << max(6, math.ceil(math.log2(per)))
+        self.shard_rows = next_pow2(per, 64)
         self._alloc(self.shard_rows)
         # host-side row bookkeeping, like the reference's KeyToU64IdMapper
         # (external_integration/mod.rs:253)
@@ -180,26 +193,37 @@ class ShardedKnnIndex:
         self._host_dirty.append((slot, None))
 
     def _flush(self):
+        """Apply pending adds/removes as one jitted scatter into the sharded
+        corpus — O(dirty rows) device traffic, never a full-corpus host
+        round-trip. The update batch is padded to a pow2 bucket (duplicate
+        rows of the first entry, which scatter the same value, so duplicate
+        indices stay deterministic) to bound recompiles."""
         if not self._host_dirty:
             return
-        corpus = np.array(self._corpus)
-        valid = np.array(self._valid)
-        for slot, vec in self._host_dirty:
-            if vec is None:
-                valid[slot] = False
-            else:
+        n_dirty = len(self._host_dirty)
+        bucket = next_pow2(n_dirty, 64)
+        slots = np.zeros((bucket,), dtype=np.int32)
+        vecs = np.zeros((bucket, self.dim), dtype=np.float32)
+        vmask = np.zeros((bucket,), dtype=bool)
+        for i, (slot, vec) in enumerate(self._host_dirty):
+            slots[i] = slot
+            if vec is not None:
                 v = vec
                 if self.metric == "cos":
                     n = np.linalg.norm(v)
                     if n > 0:
                         v = v / n
-                corpus[slot] = v.astype(corpus.dtype)
-                valid[slot] = True
+                vecs[i] = v
+                vmask[i] = True
+        # pad with copies of row 0 (idempotent duplicate writes)
+        slots[n_dirty:] = slots[0]
+        vecs[n_dirty:] = vecs[0]
+        vmask[n_dirty:] = vmask[0]
         self._host_dirty.clear()
-        shd = NamedSharding(self.mesh, P(DATA_AXIS, None))
-        shd1 = NamedSharding(self.mesh, P(DATA_AXIS))
-        self._corpus = jax.device_put(jnp.asarray(corpus), shd)
-        self._valid = jax.device_put(jnp.asarray(valid), shd1)
+        self._corpus, self._valid = _scatter_rows(
+            self._corpus, self._valid, jnp.asarray(slots),
+            jnp.asarray(vecs).astype(self._corpus.dtype), jnp.asarray(vmask),
+        )
 
     def search(self, queries: np.ndarray, k: int):
         """queries (Q, d) -> list of [(key, score), ...] per query."""
@@ -211,7 +235,7 @@ class ShardedKnnIndex:
             n = np.linalg.norm(q, axis=1, keepdims=True)
             q = q / np.clip(n, 1e-9, None)
         Q = q.shape[0]
-        qb = 1 << max(0, math.ceil(math.log2(max(Q, 1))))
+        qb = next_pow2(Q)
         qpad = np.zeros((qb, self.dim), dtype=np.float32)
         qpad[:Q] = q
         sc, idx = sharded_topk_merge(self.mesh, self._corpus, self._valid,

@@ -1,0 +1,4 @@
+"""pathway_tpu.xpacks — extension packs (LLM/RAG toolkit).
+
+Parity with reference ``python/pathway/xpacks/``.
+"""

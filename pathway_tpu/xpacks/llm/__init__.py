@@ -1,0 +1,69 @@
+"""pathway_tpu.xpacks.llm — the LLM/RAG toolkit (reference
+``python/pathway/xpacks/llm/``), TPU-first.
+
+The dense stages of the RAG pipeline — sentence embedding, cross-encoder
+reranking, KNN retrieval — run as batched XLA programs on the MXU
+(``pathway_tpu.models``, ``pathway_tpu.ops.knn``); API-client components
+(OpenAI/LiteLLM/Gemini/Cohere) keep the reference's async-UDF shape.
+"""
+
+from pathway_tpu.xpacks.llm import (
+    embedders,
+    llms,
+    parsers,
+    prompts,
+    rerankers,
+    splitters,
+)
+from pathway_tpu.xpacks.llm.document_store import DocumentStore, SlidesDocumentStore
+from pathway_tpu.xpacks.llm.question_answering import (
+    AdaptiveRAGQuestionAnswerer,
+    BaseContextProcessor,
+    BaseQuestionAnswerer,
+    BaseRAGQuestionAnswerer,
+    DeckRetriever,
+    SimpleContextProcessor,
+    SummaryQuestionAnswerer,
+    answer_with_geometric_rag_strategy,
+    answer_with_geometric_rag_strategy_from_index,
+)
+from pathway_tpu.xpacks.llm.servers import (
+    BaseRestServer,
+    DocumentStoreServer,
+    QARestServer,
+    QASummaryRestServer,
+    serve_callable,
+)
+from pathway_tpu.xpacks.llm.vector_store import (
+    SlidesVectorStoreServer,
+    VectorStoreClient,
+    VectorStoreServer,
+)
+
+__all__ = [
+    "embedders",
+    "llms",
+    "parsers",
+    "prompts",
+    "rerankers",
+    "splitters",
+    "DocumentStore",
+    "SlidesDocumentStore",
+    "AdaptiveRAGQuestionAnswerer",
+    "BaseContextProcessor",
+    "BaseQuestionAnswerer",
+    "BaseRAGQuestionAnswerer",
+    "DeckRetriever",
+    "SimpleContextProcessor",
+    "SummaryQuestionAnswerer",
+    "answer_with_geometric_rag_strategy",
+    "answer_with_geometric_rag_strategy_from_index",
+    "BaseRestServer",
+    "DocumentStoreServer",
+    "QARestServer",
+    "QASummaryRestServer",
+    "serve_callable",
+    "SlidesVectorStoreServer",
+    "VectorStoreClient",
+    "VectorStoreServer",
+]

@@ -1,0 +1,56 @@
+"""Shared helpers for the LLM xpack (reference ``xpacks/llm/_utils.py``)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, TypedDict
+
+from pathway_tpu.internals.json import Json
+
+logger = logging.getLogger(__name__)
+
+
+class Doc(TypedDict, total=False):
+    """A retrieved document chunk: ``text`` plus arbitrary metadata."""
+
+    text: str
+    metadata: dict
+    dist: float
+
+
+def _coerce_sync(fun):
+    """Run an async callable synchronously if needed."""
+    import asyncio
+    import inspect
+
+    if inspect.iscoroutinefunction(fun):
+        def wrapper(*args, **kwargs):
+            return asyncio.run(fun(*args, **kwargs))
+
+        return wrapper
+    return fun
+
+
+def unwrap_udf(udf_or_callable):
+    """Return the raw callable behind a UDF (or the callable itself)."""
+    wrapped = getattr(udf_or_callable, "__wrapped__", None)
+    if wrapped is not None and not isinstance(wrapped, type):
+        return wrapped
+    return udf_or_callable
+
+
+def _unwrap_json(value: Any) -> Any:
+    if isinstance(value, Json):
+        return value.value
+    return value
+
+
+def _to_dict(doc: Any) -> dict:
+    doc = _unwrap_json(doc)
+    if isinstance(doc, dict):
+        return {k: _unwrap_json(v) for k, v in doc.items()}
+    return {"text": str(doc)}
+
+
+def combine_metadata(docs: list[Any]) -> list[dict]:
+    return [_to_dict(d) for d in docs]

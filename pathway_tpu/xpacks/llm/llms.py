@@ -1,0 +1,213 @@
+"""Chat-LLM UDFs (reference ``xpacks/llm/llms.py:27-707``).
+
+``BaseChat`` subclasses are UDFs mapping a message list (or ``pw.Json``) to a
+completion string. API clients (OpenAI/LiteLLM/Cohere) are async and gated on
+their SDKs; ``HFPipelineChat`` runs a local ``transformers`` pipeline (CPU —
+chats are not the TPU hot path; the embedder/reranker are).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import pathway_tpu as pw
+from pathway_tpu.internals import udfs
+from pathway_tpu.internals.json import Json
+
+logger = logging.getLogger(__name__)
+
+
+def _messages_to_list(messages: Any) -> list[dict]:
+    if isinstance(messages, Json):
+        messages = messages.value
+    if isinstance(messages, str):
+        return [{"role": "user", "content": messages}]
+    out = []
+    for m in messages:
+        if isinstance(m, Json):
+            m = m.value
+        out.append(dict(m))
+    return out
+
+
+def _prep_message_log(messages: list[dict], verbose: bool) -> str:
+    if verbose:
+        return str(messages)
+    return str([
+        {**m, "content": m.get("content", "")[:100]} for m in messages
+    ])
+
+
+class BaseChat(pw.UDF):
+    """Base chat UDF (reference ``BaseChat``, llms.py:27)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        """Whether the underlying API accepts ``arg_name`` as a call kwarg."""
+        return True
+
+
+class OpenAIChat(BaseChat):
+    """OpenAI chat-completions client (reference ``OpenAIChat``,
+    llms.py:84-311)."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        model: str | None = "gpt-4o-mini",
+        verbose: bool = False,
+        **openai_kwargs,
+    ):
+        executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
+        super().__init__(executor=executor, cache_strategy=cache_strategy)
+        self.kwargs = dict(openai_kwargs)
+        self.verbose = verbose
+        if model is not None:
+            self.kwargs["model"] = model
+
+    async def __wrapped__(self, messages: list[dict] | Json, **kwargs) -> str | None:
+        try:
+            import openai
+        except ImportError as exc:  # pragma: no cover - gated dependency
+            raise ImportError("OpenAIChat requires the `openai` package") from exc
+        messages = _messages_to_list(messages)
+        kwargs = {**self.kwargs, **kwargs}
+        logger.info("OpenAIChat: %s", _prep_message_log(messages, self.verbose))
+        api_kwargs = {
+            k: kwargs.pop(k)
+            for k in ("api_key", "base_url", "organization")
+            if k in kwargs
+        }
+        client = openai.AsyncOpenAI(**api_kwargs)
+        ret = await client.chat.completions.create(messages=messages, **kwargs)
+        return ret.choices[0].message.content
+
+
+class LiteLLMChat(BaseChat):
+    """LiteLLM multi-provider chat (reference ``LiteLLMChat``,
+    llms.py:313-439)."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        model: str | None = None,
+        verbose: bool = False,
+        **litellm_kwargs,
+    ):
+        executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
+        super().__init__(executor=executor, cache_strategy=cache_strategy)
+        self.kwargs = dict(litellm_kwargs)
+        self.verbose = verbose
+        if model is not None:
+            self.kwargs["model"] = model
+
+    def __wrapped__(self, messages: list[dict] | Json, **kwargs) -> str | None:
+        try:
+            import litellm
+        except ImportError as exc:  # pragma: no cover - gated dependency
+            raise ImportError("LiteLLMChat requires the `litellm` package") from exc
+        messages = _messages_to_list(messages)
+        ret = litellm.completion(messages=messages, **{**self.kwargs, **kwargs})
+        return ret.choices[0]["message"]["content"]
+
+
+class HFPipelineChat(BaseChat):
+    """Local HuggingFace ``transformers`` text-generation pipeline (reference
+    ``HFPipelineChat``, llms.py:441-542). Runs host-side."""
+
+    def __init__(
+        self,
+        model: str | None = None,
+        call_kwargs: dict = {},
+        device: str = "cpu",
+        batch_size: int | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        **pipeline_kwargs,
+    ):
+        super().__init__(cache_strategy=cache_strategy)
+        try:
+            import transformers
+        except ImportError as exc:  # pragma: no cover - gated dependency
+            raise ImportError(
+                "HFPipelineChat requires the `transformers` package"
+            ) from exc
+        self.pipeline = transformers.pipeline(
+            "text-generation", model=model, device=device, **pipeline_kwargs
+        )
+        self.tokenizer = self.pipeline.tokenizer
+        self.call_kwargs = dict(call_kwargs)
+        if batch_size is not None:
+            self.call_kwargs["batch_size"] = batch_size
+
+    def crop_to_max_length(self, input_string: str, max_prompt_length: int = 500) -> str:
+        tokens = self.tokenizer.tokenize(input_string)
+        if len(tokens) > max_prompt_length:
+            tokens = tokens[-max_prompt_length:]
+            return self.tokenizer.convert_tokens_to_string(tokens)
+        return input_string
+
+    def __wrapped__(self, messages: list[dict] | Json | str, **kwargs) -> str | None:
+        if isinstance(messages, (Json, list)):
+            messages_decoded: Any = _messages_to_list(messages)
+        else:
+            messages_decoded = messages
+        output = self.pipeline(messages_decoded, **{**self.call_kwargs, **kwargs})
+        result = output[0]["generated_text"]
+        if isinstance(result, list):  # chat format: last message is the reply
+            result = result[-1]["content"]
+        return result
+
+
+class CohereChat(BaseChat):
+    """Cohere chat client with RAG-style cited generation (reference
+    ``CohereChat``, llms.py:544-684)."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        model: str | None = "command",
+        **cohere_kwargs,
+    ):
+        executor = udfs.async_executor(capacity=capacity, retry_strategy=retry_strategy)
+        super().__init__(executor=executor, cache_strategy=cache_strategy)
+        self.kwargs = dict(cohere_kwargs)
+        if model is not None:
+            self.kwargs["model"] = model
+
+    def __wrapped__(
+        self, messages: list[dict] | Json, documents: list[dict] | Json | None = None,
+        **kwargs,
+    ) -> tuple[str, list[dict]]:
+        try:
+            import cohere
+        except ImportError as exc:  # pragma: no cover - gated dependency
+            raise ImportError("CohereChat requires the `cohere` package") from exc
+        messages = _messages_to_list(messages)
+        docs = None
+        if documents is not None:
+            docs = documents.value if isinstance(documents, Json) else list(documents)
+        kwargs = {**self.kwargs, **kwargs}
+        client = cohere.Client()
+        message = messages[-1]["content"]
+        chat_history = messages[:-1]
+        ret = client.chat(
+            message=message, chat_history=chat_history, documents=docs, **kwargs
+        )
+        cited_docs = [dict(c.__dict__) for c in (ret.citations or [])]
+        return ret.text, cited_docs
+
+
+@pw.udf
+def prompt_chat_single_qa(question: str) -> Json:
+    """Wrap a plain question string into a one-message chat (reference
+    ``prompt_chat_single_qa``, llms.py:686)."""
+    return Json([{"role": "user", "content": question}])

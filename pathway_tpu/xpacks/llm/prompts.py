@@ -1,0 +1,70 @@
+"""Prompt templates for RAG question answering (reference
+``xpacks/llm/prompts.py`` — templates re-written, same roles: short QA over
+retrieved context, strict-JSON citation variant, summarization).
+"""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+
+BASE_PROMPT_TEMPLATE = (
+    "Answer the question using only the context below. "
+    "Reply with a short answer; if the context does not contain the answer, "
+    "reply exactly `No information found.`\n\n"
+    "Context:\n{context}\n\nQuestion: {query}\nAnswer:"
+)
+
+STRICT_JSON_PROMPT_TEMPLATE = (
+    "You answer questions from provided context documents only.\n"
+    "Respond with a single JSON object: "
+    '{{"answer": "<short answer or `No information found.`>"}}.\n\n'
+    "Context:\n{context}\n\nQuestion: {query}\nJSON:"
+)
+
+SUMMARIZE_TEMPLATE = (
+    "Summarize the following texts into one concise paragraph, keeping the "
+    "key facts:\n\n{text}\n\nSummary:"
+)
+
+
+@pw.udf
+def prompt_qa(query: str, context: str) -> str:
+    """Build the default QA prompt (reference ``prompt_qa``)."""
+    return BASE_PROMPT_TEMPLATE.format(context=context, query=query)
+
+
+@pw.udf
+def prompt_short_qa(query: str, context: str) -> str:
+    return (
+        "Give the shortest possible factual answer (a few words) based only "
+        f"on this context:\n{context}\n\nQuestion: {query}\nAnswer:"
+    )
+
+
+@pw.udf
+def prompt_citing_qa(query: str, context: str) -> str:
+    return (
+        "Answer from the context and cite the source file of each fact in "
+        f"brackets.\n\nContext:\n{context}\n\nQuestion: {query}\nAnswer:"
+    )
+
+
+@pw.udf
+def prompt_summarize(text_list: list[str]) -> str:
+    return SUMMARIZE_TEMPLATE.format(text="\n\n".join(text_list))
+
+
+@pw.udf
+def prompt_query_rewrite_hyde(query: str) -> str:
+    return (
+        "Write a short hypothetical passage that would answer the question "
+        f"below (used for retrieval only).\nQuestion: {query}\nPassage:"
+    )
+
+
+@pw.udf
+def prompt_query_rewrite(query: str) -> str:
+    return (
+        "Rewrite the user question as a concise search query, keeping all "
+        f"named entities.\nQuestion: {query}\nSearch query:"
+    )

@@ -1,0 +1,181 @@
+"""Reranker UDFs (reference ``xpacks/llm/rerankers.py:15-345``).
+
+``CrossEncoderReranker`` is the TPU hot path: in the reference it scores one
+(query, doc) pair at a time through a torch CrossEncoder
+(``rerankers.py:186-249``); here a whole engine microbatch of pairs is scored
+in one jitted XLA call (``pathway_tpu.models.cross_encoder``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.internals import udfs
+from pathway_tpu.internals.json import Json
+from pathway_tpu.xpacks.llm.llms import BaseChat
+
+# ruff: noqa: E501
+
+
+@pw.udf
+def rerank_topk_filter(
+    docs: list[Any], scores: list[float], k: int = 5
+) -> tuple[list[Any], list[float]]:
+    """Keep the top-``k`` docs by rerank score (reference
+    ``rerank_topk_filter``, rerankers.py:15)."""
+    if not docs:
+        return [], []
+    order = np.argsort(scores)[::-1][:k]
+    docs_sorted = [docs[i] for i in order]
+    scores_sorted = [float(scores[i]) for i in order]
+    return docs_sorted, scores_sorted
+
+
+class CrossEncoderReranker(pw.UDF):
+    """TPU-native cross-encoder reranker (reference ``CrossEncoderReranker``,
+    rerankers.py:186-249). Batched: one padded XLA dispatch per microbatch."""
+
+    def __init__(
+        self,
+        model_name: Any = "minilm-l6",
+        *,
+        max_batch_size: int | None = 512,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        custom_kwargs: dict = {},
+    ):
+        super().__init__(
+            deterministic=True,
+            batch=True,
+            max_batch_size=max_batch_size,
+            cache_strategy=cache_strategy,
+            return_type=float,
+        )
+        from pathway_tpu.models import CrossEncoderModel, MINILM_L6, MINILM_L12
+
+        presets = {"minilm-l6": MINILM_L6, "minilm-l12": MINILM_L12}
+        if isinstance(model_name, CrossEncoderModel):
+            self.model = model_name
+        else:
+            kwargs = dict(custom_kwargs)
+            if model_name in presets:
+                kwargs.setdefault("cfg", presets[model_name])
+            self.model = CrossEncoderModel(**kwargs)
+
+    def __wrapped__(self, doc: list[str], query: list[str], **kwargs) -> list[float]:
+        pairs = [(q or "", d or "") for q, d in zip(query, doc)]
+        scores = self.model.score_batch(pairs)
+        return [float(s) for s in scores]
+
+    def __call__(self, doc, query, **kwargs):
+        return super().__call__(doc, query, **kwargs)
+
+
+class EncoderReranker(pw.UDF):
+    """Bi-encoder reranker: cosine of (query, doc) embeddings (reference
+    ``EncoderReranker``, rerankers.py:251-317). Batched on TPU."""
+
+    def __init__(
+        self,
+        model_name: Any = "minilm-l6",
+        *,
+        max_batch_size: int | None = 1024,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        custom_kwargs: dict = {},
+    ):
+        super().__init__(
+            deterministic=True,
+            batch=True,
+            max_batch_size=max_batch_size,
+            cache_strategy=cache_strategy,
+            return_type=float,
+        )
+        from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+        self.embedder = SentenceTransformerEmbedder(model_name, **custom_kwargs)
+
+    def __wrapped__(self, doc: list[str], query: list[str], **kwargs) -> list[float]:
+        model = self.embedder.model
+        # embeddings are unit-norm, so dot product == cosine similarity
+        q = model.embed_batch([x or "" for x in query])
+        d = model.embed_batch([x or "" for x in doc])
+        return [float(s) for s in np.sum(q * d, axis=1)]
+
+
+class LLMReranker(pw.UDF):
+    """Ask a chat model to rate doc relevance 1-5 (reference ``LLMReranker``,
+    rerankers.py:58-184)."""
+
+    prompt_template = (
+        "Rate how relevant the document is to the query on a scale 1 to 5. "
+        "Reply with a single digit.\n\nQuery: {query}\n\nDocument: {doc}\n\nRating:"
+    )
+
+    def __init__(
+        self,
+        llm: BaseChat,
+        *,
+        retry_strategy: udfs.AsyncRetryStrategy | None = None,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        use_logit_bias: bool | None = None,
+    ):
+        super().__init__(cache_strategy=cache_strategy, return_type=float)
+        self.llm = llm
+        self.use_logit_bias = use_logit_bias
+
+    def __wrapped__(self, doc: str, query: str, **kwargs) -> float:
+        from pathway_tpu.xpacks.llm._utils import _coerce_sync
+
+        prompt = self.prompt_template.format(query=query, doc=doc)
+        response = _coerce_sync(self.llm.__wrapped__)(
+            [{"role": "user", "content": prompt}], **kwargs
+        )
+        digits = [c for c in str(response) if c.isdigit()]
+        if not digits:
+            raise ValueError(f"reranker got non-numeric response: {response!r}")
+        return float(digits[0])
+
+
+class FlashRankReranker(pw.UDF):
+    """FlashRank listwise reranker (reference ``FlashRankReranker``,
+    rerankers.py:319-345). Gated on the ``flashrank`` package."""
+
+    def __init__(
+        self,
+        model_name: str = "ms-marco-TinyBERT-L-2-v2",
+        *,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        max_length: int = 512,
+    ):
+        super().__init__(cache_strategy=cache_strategy, return_type=float)
+        try:
+            from flashrank import Ranker
+        except ImportError as exc:  # pragma: no cover - gated dependency
+            raise ImportError(
+                "FlashRankReranker requires the `flashrank` package"
+            ) from exc
+        self.ranker = Ranker(model_name=model_name, max_length=max_length)
+
+    def __wrapped__(self, doc: str, query: str) -> float:
+        from flashrank import RerankRequest
+
+        results = self.ranker.rerank(
+            RerankRequest(query=query, passages=[{"text": doc}])
+        )
+        return float(results[0]["score"])
+
+
+@pw.udf
+def unwrap_doc_texts(docs: list[Any]) -> list[str]:
+    """Extract text fields from retrieved doc dicts/Jsons."""
+    out = []
+    for d in docs or []:
+        if isinstance(d, Json):
+            d = d.value
+        if isinstance(d, dict):
+            out.append(str(d.get("text", "")))
+        else:
+            out.append(str(d))
+    return out

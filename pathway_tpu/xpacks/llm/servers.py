@@ -1,0 +1,188 @@
+"""REST servers for document stores and QA pipelines (reference
+``xpacks/llm/servers.py:16-291``).
+
+Each endpoint is a ``rest_connector`` route: requests become rows of a query
+table, the handler builds the answering sub-graph once at definition time,
+and responses resolve through the dataflow.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable
+
+import pathway_tpu as pw
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+
+logger = logging.getLogger(__name__)
+
+
+class BaseRestServer:
+    """Route registry over a shared webserver (reference ``BaseRestServer``,
+    servers.py:16)."""
+
+    def __init__(self, host: str, port: int, **rest_kwargs):
+        from pathway_tpu.io.http import PathwayWebserver
+
+        self.host = host
+        self.port = port
+        self.webserver = PathwayWebserver(host, port)
+        self.rest_kwargs = rest_kwargs
+        self._thread: threading.Thread | None = None
+
+    def serve(
+        self,
+        route: str,
+        schema: type,
+        handler: Callable[[Table], Table],
+        documentation: Any = None,
+        **additional_kwargs,
+    ) -> None:
+        from pathway_tpu.io.http import rest_connector
+
+        queries, writer = rest_connector(
+            webserver=self.webserver,
+            route=route,
+            schema=schema,
+            methods=additional_kwargs.pop("methods", ("GET", "POST")),
+            delete_completed_queries=True,
+        )
+        writer(handler(queries))
+
+    def run(
+        self,
+        threaded: bool = False,
+        with_cache: bool = True,
+        cache_backend=None,
+        terminate_on_error: bool = False,
+        **kwargs,
+    ):
+        """Start serving (reference ``run``, servers.py:68)."""
+
+        def run_pipeline():
+            pw.run(
+                monitoring_level=pw.MonitoringLevel.NONE,
+                terminate_on_error=terminate_on_error,
+            )
+
+        if threaded:
+            t = threading.Thread(target=run_pipeline, daemon=True, name=f"RestServer:{self.port}")
+            t.start()
+            self._thread = t
+            return t
+        run_pipeline()
+
+
+class DocumentStoreServer(BaseRestServer):
+    """Serves a DocumentStore (reference ``DocumentStoreServer``,
+    servers.py:92): /v1/retrieve, /v1/statistics, /v1/inputs."""
+
+    def __init__(self, host: str, port: int, document_store, **rest_kwargs):
+        super().__init__(host, port, **rest_kwargs)
+        self.serve(
+            "/v1/retrieve", document_store.RetrieveQuerySchema,
+            document_store.retrieve_query,
+        )
+        self.serve(
+            "/v1/statistics", document_store.StatisticsQuerySchema,
+            document_store.statistics_query,
+        )
+        self.serve(
+            "/v1/inputs", document_store.InputsQuerySchema,
+            document_store.inputs_query,
+        )
+
+
+class QARestServer(BaseRestServer):
+    """Serves a BaseQuestionAnswerer (reference ``QARestServer``,
+    servers.py:140): /v1/pw_ai_answer, /v1/retrieve, /v1/statistics,
+    /v1/pw_list_documents (+ v2 aliases)."""
+
+    def __init__(self, host: str, port: int, rag_question_answerer, **rest_kwargs):
+        super().__init__(host, port, **rest_kwargs)
+        self.serve(
+            "/v1/pw_ai_answer", rag_question_answerer.AnswerQuerySchema,
+            rag_question_answerer.answer_query,
+        )
+        self.serve(
+            "/v2/answer", rag_question_answerer.AnswerQuerySchema,
+            rag_question_answerer.answer_query,
+        )
+        self.serve(
+            "/v1/retrieve", rag_question_answerer.RetrieveQuerySchema,
+            rag_question_answerer.retrieve,
+        )
+        self.serve(
+            "/v2/retrieve", rag_question_answerer.RetrieveQuerySchema,
+            rag_question_answerer.retrieve,
+        )
+        self.serve(
+            "/v1/statistics", rag_question_answerer.StatisticsQuerySchema,
+            rag_question_answerer.statistics,
+        )
+        self.serve(
+            "/v1/pw_list_documents", rag_question_answerer.InputsQuerySchema,
+            rag_question_answerer.list_documents,
+        )
+        self.serve(
+            "/v2/list_documents", rag_question_answerer.InputsQuerySchema,
+            rag_question_answerer.list_documents,
+        )
+
+
+class QASummaryRestServer(QARestServer):
+    """QA server plus summarization endpoint (reference
+    ``QASummaryRestServer``, servers.py:193)."""
+
+    def __init__(self, host: str, port: int, rag_question_answerer, **rest_kwargs):
+        super().__init__(host, port, rag_question_answerer, **rest_kwargs)
+        self.serve(
+            "/v1/pw_ai_summary", rag_question_answerer.SummarizeQuerySchema,
+            rag_question_answerer.summarize_query,
+        )
+        self.serve(
+            "/v2/summarize", rag_question_answerer.SummarizeQuerySchema,
+            rag_question_answerer.summarize_query,
+        )
+
+
+def serve_callable(
+    route: str,
+    schema: type | None = None,
+    host: str = "0.0.0.0",  # noqa: S104
+    port: int = 8000,
+    **rest_kwargs,
+):
+    """Expose an ad-hoc (async) function as a REST endpoint inside the
+    dataflow (reference ``serve_callable``, servers.py:227)."""
+
+    def decorator(callable_func):
+        server = BaseRestServer(host, port, **rest_kwargs)
+        nonlocal schema
+        if schema is None:
+            import inspect
+
+            params = [
+                p for p in inspect.signature(callable_func).parameters.values()
+                if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+            ]
+            schema = schema_mod.schema_from_types(
+                **{
+                    p.name: (p.annotation if p.annotation is not inspect.Parameter.empty else str)
+                    for p in params
+                }
+            )
+
+        fn_udf = pw.udf(callable_func)
+
+        def handler(queries: Table) -> Table:
+            cols = [queries[c] for c in queries.column_names()]
+            return queries.select(result=fn_udf(*cols))
+
+        server.serve(route, schema, handler)
+        callable_func._pw_server = server
+        return callable_func
+
+    return decorator

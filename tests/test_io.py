@@ -175,3 +175,48 @@ def test_csv_write(tmp_path):
     pw.run()
     content = out.read_text()
     assert "1" in content and "x" in content
+
+
+def test_idle_source_does_not_stall_other_sources():
+    """A quiescent streaming source must keep advancing its frontier
+    (heartbeat autocommit) so other sources' later events are processed
+    (reference: autocommit advance_time, src/connectors/mod.rs:207)."""
+    import threading
+    import time as time_mod
+
+    import pathway_tpu as pw
+
+    class Idle(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(x=1)
+            self.commit()
+            time_mod.sleep(30)  # stays open, no more data
+            self.close()
+
+    class Late(pw.io.python.ConnectorSubject):
+        def run(self):
+            time_mod.sleep(1.5)  # commits AFTER the idle source went quiet
+            self.next(x=2)
+            self.commit()
+            self.close()
+
+    class S(pw.Schema):
+        x: int
+
+    idle = pw.io.python.read(Idle(), schema=S)
+    late = pw.io.python.read(Late(), schema=S)
+    got = []
+    idle_got = []
+    pw.io.subscribe(idle, on_change=lambda key, row, time, is_addition: idle_got.append(row["x"]))
+    pw.io.subscribe(late, on_change=lambda key, row, time, is_addition: got.append(row["x"]))
+    t = threading.Thread(
+        target=lambda: pw.run(monitoring_level=pw.MonitoringLevel.NONE), daemon=True
+    )
+    t.start()
+    deadline = time_mod.time() + 15
+    while time_mod.time() < deadline and not got:
+        time_mod.sleep(0.2)
+    for c in pw.G.connectors:
+        c._stop.set()
+        c.close()
+    assert got == [2], f"late source's row never processed: {got}"

@@ -1,0 +1,322 @@
+"""LLM xpack tests — mock LLMs/embedders, full pipelines over them
+(reference ``python/pathway/xpacks/llm/tests/``: mocks.py fake models,
+test_vector_store.py / test_document_store.py / test_rag.py)."""
+
+import dataclasses
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.json import Json, unwrap_json
+from pathway_tpu.models import MINILM_L6, SentenceEmbedderModel
+from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+from pathway_tpu.xpacks.llm import (
+    BaseRAGQuestionAnswerer,
+    AdaptiveRAGQuestionAnswerer,
+    DocumentStore,
+    embedders,
+    llms,
+    rerankers,
+    splitters,
+    parsers,
+)
+from tests.utils import _capture_rows
+
+TINY = dataclasses.replace(
+    MINILM_L6, layers=2, hidden=32, heads=4, intermediate=64,
+    vocab_size=500, max_position=64,
+)
+
+
+# -- mocks (reference tests/mocks.py) ---------------------------------------
+
+@pw.udf
+def fake_embeddings_model(x: str) -> np.ndarray:
+    return np.array([1.0, 1.0, 0.0]) if "foo" in (x or "") else np.array([0.0, 1.0, 1.0])
+
+
+class IdentityMockChat(llms.BaseChat):
+    def __wrapped__(self, messages, **kwargs) -> str:
+        msgs = llms._messages_to_list(messages)
+        return "mock: " + msgs[-1]["content"]
+
+
+class NoInfoThenAnswerChat(llms.BaseChat):
+    """Returns 'No information' until enough context docs are present."""
+
+    def __init__(self, min_context_words: int):
+        super().__init__()
+        self.min_context_words = min_context_words
+
+    def __wrapped__(self, messages, **kwargs) -> str:
+        msgs = llms._messages_to_list(messages)
+        content = msgs[-1]["content"]
+        if len(content.split()) >= self.min_context_words:
+            return "the answer"
+        return "No information found."
+
+
+def _docs_table():
+    return pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {
+                "data": [
+                    "foo bar baz documents about foo",
+                    "completely different animal text",
+                ],
+                "_metadata": [
+                    Json({"path": "a.txt", "modified_at": 1}),
+                    Json({"path": "b.txt", "modified_at": 2}),
+                ],
+            }
+        )
+    )
+
+
+def _store(**kwargs):
+    return DocumentStore(
+        _docs_table(),
+        retriever_factory=BruteForceKnnFactory(
+            dimensions=3, embedder=fake_embeddings_model
+        ),
+        **kwargs,
+    )
+
+
+# -- embedders ---------------------------------------------------------------
+
+def test_sentence_transformer_embedder_batched():
+    model = SentenceEmbedderModel(cfg=TINY, max_length=16)
+    emb = embedders.SentenceTransformerEmbedder(model)
+    assert emb.get_embedding_dimension() == TINY.hidden
+    t = pw.debug.table_from_pandas(
+        pd.DataFrame({"text": ["hello world", "tpu native framework"]})
+    )
+    res = t.select(vec=emb(t.text))
+    rows, cols = _capture_rows(res)
+    vi = cols.index("vec")
+    for row in rows.values():
+        v = np.asarray(row[vi])
+        assert v.shape == (TINY.hidden,)
+        np.testing.assert_allclose(np.linalg.norm(v), 1.0, atol=1e-3)
+
+
+def test_embedder_batch_cache():
+    calls = []
+
+    class CountingEmbedder(embedders.BaseEmbedder):
+        def __init__(self):
+            super().__init__(batch=True, cache_strategy=pw.udfs.InMemoryCache())
+
+        def __wrapped__(self, input, **kwargs):
+            calls.append(list(input))
+            return [np.ones(3) for _ in input]
+
+    emb = CountingEmbedder()
+    t = pw.debug.table_from_pandas(pd.DataFrame({"text": ["a", "a", "b"]}))
+    res = t.select(vec=emb(t.text))
+    _capture_rows(res)
+    # "a" computed once thanks to the row-level cache over the batch
+    seen = [x for batch in calls for x in batch]
+    assert sorted(set(seen)) == ["a", "b"]
+    assert len(seen) == 2
+
+
+# -- rerankers ---------------------------------------------------------------
+
+def test_cross_encoder_reranker_scores():
+    reranker = rerankers.CrossEncoderReranker(
+        model_name="minilm-l6", custom_kwargs={"cfg": TINY, "max_length": 32}
+    )
+    t = pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {"doc": ["foo article", "bar piece"], "query": ["foo", "foo"]}
+        )
+    )
+    res = t.select(score=reranker(pw.this.doc, pw.this.query))
+    rows, cols = _capture_rows(res)
+    si = cols.index("score")
+    for row in rows.values():
+        assert isinstance(row[si], float)
+
+
+def test_rerank_topk_filter():
+    t = pw.debug.table_from_pandas(pd.DataFrame({"x": [1]}))
+    res = t.select(
+        out=rerankers.rerank_topk_filter(
+            ("a", "b", "c", "d"), (0.1, 0.9, 0.5, 0.2), 2
+        )
+    )
+    rows, cols = _capture_rows(res)
+    oi = cols.index("out")
+    (docs, scores) = list(rows.values())[0][oi]
+    assert list(docs) == ["b", "c"]
+    assert list(scores) == [0.9, 0.5]
+
+
+def test_llm_reranker():
+    class DigitChat(llms.BaseChat):
+        def __wrapped__(self, messages, **kwargs) -> str:
+            return "4"
+
+    rr = rerankers.LLMReranker(DigitChat())
+    t = pw.debug.table_from_pandas(pd.DataFrame({"d": ["doc"], "q": ["q"]}))
+    res = t.select(score=rr(pw.this.d, pw.this.q))
+    rows, cols = _capture_rows(res)
+    assert list(rows.values())[0][cols.index("score")] == 4.0
+
+
+# -- splitters / parsers -----------------------------------------------------
+
+def test_token_count_splitter():
+    sp = splitters.TokenCountSplitter(min_tokens=3, max_tokens=10)
+    chunks = sp.__wrapped__(
+        "One two three four five. Six seven eight nine ten. "
+        "Eleven twelve thirteen fourteen fifteen."
+    )
+    assert len(chunks) >= 2
+    for text, meta in chunks:
+        assert len(text.split()) <= 12
+        assert isinstance(meta, dict)
+
+
+def test_parse_utf8():
+    p = parsers.ParseUtf8()
+    out = p.__wrapped__("hello".encode())
+    assert out == [("hello", {})]
+
+
+# -- document store ----------------------------------------------------------
+
+def test_document_store_retrieve():
+    store = _store()
+    queries = pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {
+                "query": ["foo"],
+                "k": [1],
+                "metadata_filter": [None],
+                "filepath_globpattern": [None],
+            }
+        )
+    )
+    res = store.retrieve_query(queries)
+    rows, cols = _capture_rows(res)
+    ri = cols.index("result")
+    docs = unwrap_json(list(rows.values())[0][ri])
+    assert len(docs) == 1
+    assert "foo" in docs[0]["text"]
+    assert docs[0]["metadata"]["path"] == "a.txt"
+
+
+def test_document_store_statistics():
+    store = _store()
+    queries = pw.debug.table_from_pandas(pd.DataFrame({"_dummy": [1]})).without("_dummy")
+    res = store.statistics_query(queries)
+    rows, cols = _capture_rows(res)
+    stats = unwrap_json(list(rows.values())[0][cols.index("result")])
+    assert stats["file_count"] == 2
+    assert stats["last_modified"] == 2
+
+
+def test_document_store_inputs():
+    store = _store()
+    queries = pw.debug.table_from_pandas(
+        pd.DataFrame({"metadata_filter": [None], "filepath_globpattern": [None]})
+    )
+    res = store.inputs_query(queries)
+    rows, cols = _capture_rows(res)
+    inputs = unwrap_json(list(rows.values())[0][cols.index("result")])
+    assert sorted(i["path"] for i in inputs) == ["a.txt", "b.txt"]
+
+
+def test_document_store_with_splitter():
+    store = _store(splitter=splitters.TokenCountSplitter(min_tokens=1, max_tokens=3))
+    queries = pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {
+                "query": ["foo"],
+                "k": [2],
+                "metadata_filter": [None],
+                "filepath_globpattern": [None],
+            }
+        )
+    )
+    res = store.retrieve_query(queries)
+    rows, cols = _capture_rows(res)
+    docs = unwrap_json(list(rows.values())[0][cols.index("result")])
+    assert len(docs) == 2
+
+
+# -- RAG QA ------------------------------------------------------------------
+
+def test_base_rag_answer():
+    store = _store()
+    qa = BaseRAGQuestionAnswerer(IdentityMockChat(), store, search_topk=2)
+    queries = pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {
+                "prompt": ["what about foo?"],
+                "filters": [None],
+                "model": [None],
+                "return_context_docs": [True],
+            }
+        )
+    )
+    res = qa.answer_query(queries)
+    rows, cols = _capture_rows(res)
+    result = unwrap_json(list(rows.values())[0][cols.index("result")])
+    assert result["response"].startswith("mock: ")
+    assert "what about foo?" in result["response"]
+    assert len(result["context_docs"]) == 2
+
+
+def test_base_rag_summarize():
+    store = _store()
+    qa = BaseRAGQuestionAnswerer(IdentityMockChat(), store)
+    queries = pw.debug.table_from_pandas(
+        pd.DataFrame({"text_list": [("alpha", "beta")], "model": [None]})
+    )
+    res = qa.summarize_query(queries)
+    rows, cols = _capture_rows(res)
+    result = unwrap_json(list(rows.values())[0][cols.index("result")])
+    assert "response" in result
+
+
+def test_adaptive_rag_escalates():
+    store = _store()
+    qa = AdaptiveRAGQuestionAnswerer(
+        NoInfoThenAnswerChat(min_context_words=20),
+        store,
+        n_starting_documents=1,
+        factor=2,
+        max_iterations=3,
+    )
+    queries = pw.debug.table_from_pandas(
+        pd.DataFrame({"prompt": ["what about foo?"], "filters": [None]})
+    )
+    res = qa.answer_query(queries)
+    rows, cols = _capture_rows(res)
+    result = unwrap_json(list(rows.values())[0][cols.index("result")])
+    assert result["response"] == "the answer"
+
+
+def test_statistics_and_inputs_preserve_query_keys():
+    """Response rows must keep the query rows' keys so REST futures
+    correlate (regression: pair-keyed join broke /v1/statistics)."""
+    store = _store()
+    stats_q = pw.debug.table_from_pandas(pd.DataFrame({"_d": [1]})).without("_d")
+    res = store.statistics_query(stats_q)
+    qrows, _ = _capture_rows(stats_q)
+    rrows, _ = _capture_rows(res)
+    assert set(qrows) == set(rrows)
+
+    in_q = pw.debug.table_from_pandas(
+        pd.DataFrame({"metadata_filter": [None], "filepath_globpattern": [None]})
+    )
+    res2 = store.inputs_query(in_q)
+    qrows2, _ = _capture_rows(in_q)
+    rrows2, _ = _capture_rows(res2)
+    assert set(qrows2) == set(rrows2)
