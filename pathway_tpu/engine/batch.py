@@ -138,6 +138,16 @@ def consolidate(batch: Batch | None) -> Batch | None:
     if batch is None or len(batch) == 0:
         return None
     rh = row_hashes(batch)
+    native = _get_native_consolidate()
+    if native is not None:
+        idx, summed = native(batch.keys, rh, batch.diffs)
+        if len(idx) == 0:
+            return None
+        if len(idx) == len(batch) and np.array_equal(summed, batch.diffs):
+            return batch
+        out = batch.take(idx.astype(np.int64))
+        out.diffs = summed.copy()
+        return out
     combo = np.empty(len(batch), dtype=[("k", np.uint64), ("r", np.uint64)])
     combo["k"] = batch.keys
     combo["r"] = rh
@@ -155,3 +165,20 @@ def consolidate(batch: Batch | None) -> Batch | None:
     out = batch.take(idx)
     out.diffs = summed[keep]
     return out
+
+
+_native_consolidate = False
+
+
+def _get_native_consolidate():
+    global _native_consolidate
+    if _native_consolidate is False:
+        try:
+            from pathway_tpu import native as _native_mod
+
+            _native_consolidate = (
+                _native_mod.consolidate_pairs_native if _native_mod.AVAILABLE else None
+            )
+        except Exception:  # noqa: BLE001
+            _native_consolidate = None
+    return _native_consolidate

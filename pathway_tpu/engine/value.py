@@ -3,7 +3,7 @@
 Parity target: reference ``src/engine/value.rs`` (``Key`` = 128-bit xxh3 of
 value bytes, ``Value`` 18-variant enum, ``ShardPolicy``). TPU-first redesign:
 
-* ``Key`` is a **64-bit** xxh3 hash (numpy ``uint64``) so whole key columns are
+* ``Key`` is a **64-bit** XXH64 hash (numpy ``uint64``) so whole key columns are
   dense vectors — usable directly in jitted gather/scatter/sort kernels and
   cheap to exchange between workers. The reference uses u128 for collision
   headroom at its scale; at 64 bits collision probability for 10^9 keys is
@@ -239,7 +239,7 @@ def hash_one(value: Any) -> int:
     """64-bit hash of a single value."""
     buf = bytearray()
     serialize_value(value, buf)
-    return xxhash.xxh3_64_intdigest(bytes(buf))
+    return xxhash.xxh64_intdigest(bytes(buf))
 
 
 def _mix_scalar(h: int, idx: int) -> int:
@@ -310,16 +310,42 @@ def hash_keys_with(keys: np.ndarray, salt: int) -> np.ndarray:
 
 
 def hash_value_column(col: np.ndarray) -> np.ndarray:
-    """Per-row 64-bit hashes of a value column (``hash_one`` per row)."""
+    """Per-row 64-bit hashes of a value column (``hash_one`` per row).
+    Uses the C++ native column hasher when available (same canonical
+    serialization + XXH64, so keys are identical either way)."""
     if col.dtype != object:
         col = col.astype(object)
+    native = _get_native()
+    if native is not None:
+        return native(col)
     out = np.empty(len(col), dtype=np.uint64)
-    digest = xxhash.xxh3_64_intdigest
+    digest = xxhash.xxh64_intdigest
     for i, v in enumerate(col):
         buf = bytearray()
         serialize_value(v, buf)
         out[i] = digest(bytes(buf))
     return out
+
+
+_native_hash_col = False
+
+
+def _get_native():
+    """Lazy-bind the native column hasher (avoids an import cycle: native's
+    per-row fallback imports this module)."""
+    global _native_hash_col
+    if _native_hash_col is False:
+        try:
+            from pathway_tpu import native as _native_mod
+
+            if _native_mod.AVAILABLE:
+                _native_mod.lib.set_pointer_type(Pointer)
+                _native_hash_col = _native_mod.hash_object_column_native
+            else:
+                _native_hash_col = None
+        except Exception:  # noqa: BLE001
+            _native_hash_col = None
+    return _native_hash_col
 
 
 def keys_for_value_columns(cols: list[np.ndarray], n: int) -> np.ndarray:

@@ -1,0 +1,357 @@
+// pathway_tpu native runtime — CPython extension.
+//
+// The role the Rust engine core plays in the reference (value hashing/keys:
+// src/engine/value.rs:28-57; delta consolidation: differential-dataflow
+// consolidation) is played here by a small C++ extension on the host hot
+// paths: canonical value serialization + XXH64 keying over whole columns,
+// and (key,row-hash) delta consolidation for batches. Dense math stays in
+// XLA; this covers the irregular host-side inner loops.
+//
+// XXH64 implemented from the public algorithm specification
+// (github.com/Cyan4973/xxHash — public domain); must produce identical
+// digests to python-xxhash's xxh64 so native and Python key paths agree.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <algorithm>
+
+// ---------------------------------------------------------------- XXH64
+static const uint64_t P1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t P3 = 0x165667B19E3779F9ULL;
+static const uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+static inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86-64/arm64)
+}
+static inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+static inline uint64_t xxh_round(uint64_t acc, uint64_t input) {
+  acc += input * P2;
+  acc = rotl64(acc, 31);
+  return acc * P1;
+}
+static inline uint64_t merge_round(uint64_t acc, uint64_t val) {
+  val = xxh_round(0, val);
+  acc ^= val;
+  return acc * P1 + P4;
+}
+
+static uint64_t xxh64(const uint8_t* data, size_t len, uint64_t seed) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2;
+    uint64_t v2 = seed + P2;
+    uint64_t v3 = seed + 0;
+    uint64_t v4 = seed - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = xxh_round(v1, read64(p)); p += 8;
+      v2 = xxh_round(v2, read64(p)); p += 8;
+      v3 = xxh_round(v3, read64(p)); p += 8;
+      v4 = xxh_round(v4, read64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = merge_round(h, v1);
+    h = merge_round(h, v2);
+    h = merge_round(h, v3);
+    h = merge_round(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    h ^= xxh_round(0, read64(p));
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= (uint64_t)read32(p) * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P5;
+    h = rotl64(h, 11) * P1;
+    p++;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+// --------------------------------------------- canonical value serialization
+// Byte-for-byte identical to engine/value.py serialize_value for the types
+// handled natively; exotic types (ndarray, Json, datetimes, PyObjectWrapper)
+// signal a fallback to the Python encoder.
+
+static PyObject* g_pointer_type = nullptr;  // set by set_pointer_type()
+
+struct SerializeError {};
+
+static bool serialize(PyObject* v, std::string& out);
+
+static inline void put_u32(std::string& out, uint32_t x) {
+  out.append(reinterpret_cast<const char*>(&x), 4);
+}
+static inline void put_u64(std::string& out, uint64_t x) {
+  out.append(reinterpret_cast<const char*>(&x), 8);
+}
+
+static bool serialize(PyObject* v, std::string& out) {
+  if (v == Py_None) {
+    out.push_back('\x00');
+  } else if (PyBool_Check(v)) {
+    out.push_back('\x01');
+    out.push_back(v == Py_True ? '\x01' : '\x00');
+  } else if (PyLong_Check(v)) {
+    int overflow = 0;
+    long long ll = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (!overflow) {
+      out.push_back('\x02');
+      put_u64(out, (uint64_t)ll);
+    } else {
+      return false;  // bigint: rare — python fallback
+    }
+  } else if (PyFloat_Check(v)) {
+    double d = PyFloat_AS_DOUBLE(v);
+    out.push_back('\x03');
+    out.append(reinterpret_cast<const char*>(&d), 8);
+  } else if (PyUnicode_Check(v)) {
+    Py_ssize_t n;
+    const char* s = PyUnicode_AsUTF8AndSize(v, &n);
+    if (s == nullptr) throw SerializeError{};
+    out.push_back('\x04');
+    put_u32(out, (uint32_t)n);
+    out.append(s, (size_t)n);
+  } else if (PyBytes_Check(v)) {
+    out.push_back('\x05');
+    put_u32(out, (uint32_t)PyBytes_GET_SIZE(v));
+    out.append(PyBytes_AS_STRING(v), (size_t)PyBytes_GET_SIZE(v));
+  } else if (g_pointer_type != nullptr &&
+             PyObject_TypeCheck(v, (PyTypeObject*)g_pointer_type)) {
+    PyObject* val = PyObject_GetAttrString(v, "value");
+    if (val == nullptr) throw SerializeError{};
+    uint64_t k = PyLong_AsUnsignedLongLongMask(val);
+    Py_DECREF(val);
+    if (PyErr_Occurred()) throw SerializeError{};
+    out.push_back('\x06');
+    put_u64(out, k);
+  } else if (PyTuple_Check(v) || PyList_Check(v)) {
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(v);
+    out.push_back('\x07');
+    put_u32(out, (uint32_t)n);
+    PyObject** items = PySequence_Fast_ITEMS(v);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      if (!serialize(items[i], out)) return false;
+    }
+  } else {
+    return false;  // exotic type -> python fallback
+  }
+  return true;
+}
+
+// hash_object_column(seq, out_buffer) -> list_of_fallback_indices
+// out_buffer: writable buffer of n*8 bytes receiving LE uint64 digests.
+static PyObject* py_hash_object_column(PyObject*, PyObject* args) {
+  PyObject* seq;
+  Py_buffer out_buf;
+  if (!PyArg_ParseTuple(args, "Ow*", &seq, &out_buf)) return nullptr;
+  PyObject* fast = PySequence_Fast(seq, "expected a sequence");
+  if (fast == nullptr) {
+    PyBuffer_Release(&out_buf);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  if ((Py_ssize_t)out_buf.len < n * 8) {
+    PyBuffer_Release(&out_buf);
+    Py_DECREF(fast);
+    PyErr_SetString(PyExc_ValueError, "output buffer too small");
+    return nullptr;
+  }
+  uint64_t* out = reinterpret_cast<uint64_t*>(out_buf.buf);
+  PyObject** items = PySequence_Fast_ITEMS(fast);
+  PyObject* fallback = PyList_New(0);
+  std::string buf;
+  buf.reserve(64);
+  try {
+    for (Py_ssize_t i = 0; i < n; i++) {
+      buf.clear();
+      if (serialize(items[i], buf)) {
+        out[i] = xxh64(reinterpret_cast<const uint8_t*>(buf.data()),
+                       buf.size(), 0);
+      } else {
+        PyObject* idx = PyLong_FromSsize_t(i);
+        PyList_Append(fallback, idx);
+        Py_DECREF(idx);
+      }
+    }
+  } catch (SerializeError&) {
+    PyBuffer_Release(&out_buf);
+    Py_DECREF(fast);
+    Py_DECREF(fallback);
+    return nullptr;
+  }
+  PyBuffer_Release(&out_buf);
+  Py_DECREF(fast);
+  return fallback;
+}
+
+// xxh64_digest(bytes_like, seed=0) -> int
+static PyObject* py_xxh64(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  unsigned long long seed = 0;
+  if (!PyArg_ParseTuple(args, "y*|K", &buf, &seed)) return nullptr;
+  uint64_t h = xxh64(reinterpret_cast<const uint8_t*>(buf.buf),
+                     (size_t)buf.len, (uint64_t)seed);
+  PyBuffer_Release(&buf);
+  return PyLong_FromUnsignedLongLong(h);
+}
+
+// consolidate_pairs(keys_u64, rowh_u64, diffs_i64) -> (idx_bytes, diff_bytes)
+// Groups rows by (key, row_hash), sums diffs, drops zero groups; returns the
+// first-occurrence index (uint64 LE) and summed diff (int64 LE) per kept
+// group, ordered by first occurrence.
+static PyObject* py_consolidate_pairs(PyObject*, PyObject* args) {
+  Py_buffer kb, rb, db;
+  if (!PyArg_ParseTuple(args, "y*y*y*", &kb, &rb, &db)) return nullptr;
+  size_t n = kb.len / 8;
+  if (rb.len / 8 != (Py_ssize_t)n || db.len / 8 != (Py_ssize_t)n) {
+    PyBuffer_Release(&kb); PyBuffer_Release(&rb); PyBuffer_Release(&db);
+    PyErr_SetString(PyExc_ValueError, "length mismatch");
+    return nullptr;
+  }
+  const uint64_t* keys = reinterpret_cast<const uint64_t*>(kb.buf);
+  const uint64_t* rowh = reinterpret_cast<const uint64_t*>(rb.buf);
+  const int64_t* diffs = reinterpret_cast<const int64_t*>(db.buf);
+
+  std::vector<uint32_t> order(n);
+  for (size_t i = 0; i < n; i++) order[i] = (uint32_t)i;
+  std::sort(order.begin(), order.end(), [&](uint32_t a, uint32_t b) {
+    if (keys[a] != keys[b]) return keys[a] < keys[b];
+    if (rowh[a] != rowh[b]) return rowh[a] < rowh[b];
+    return a < b;
+  });
+
+  std::vector<uint64_t> first;
+  std::vector<int64_t> summed;
+  first.reserve(n);
+  summed.reserve(n);
+  size_t i = 0;
+  while (i < n) {
+    size_t j = i;
+    int64_t s = 0;
+    uint32_t f = order[i];
+    while (j < n && keys[order[j]] == keys[order[i]] &&
+           rowh[order[j]] == rowh[order[i]]) {
+      s += diffs[order[j]];
+      if (order[j] < f) f = order[j];
+      j++;
+    }
+    if (s != 0) {
+      first.push_back(f);
+      summed.push_back(s);
+    }
+    i = j;
+  }
+  // order kept groups by first occurrence for deterministic batch layout
+  std::vector<uint32_t> gorder(first.size());
+  for (size_t g = 0; g < gorder.size(); g++) gorder[g] = (uint32_t)g;
+  std::sort(gorder.begin(), gorder.end(), [&](uint32_t a, uint32_t b) {
+    return first[a] < first[b];
+  });
+  PyObject* idx_bytes = PyBytes_FromStringAndSize(nullptr, first.size() * 8);
+  PyObject* diff_bytes = PyBytes_FromStringAndSize(nullptr, summed.size() * 8);
+  if (idx_bytes && diff_bytes) {
+    uint64_t* ip = reinterpret_cast<uint64_t*>(PyBytes_AS_STRING(idx_bytes));
+    int64_t* dp = reinterpret_cast<int64_t*>(PyBytes_AS_STRING(diff_bytes));
+    for (size_t g = 0; g < gorder.size(); g++) {
+      ip[g] = first[gorder[g]];
+      dp[g] = summed[gorder[g]];
+    }
+  }
+  PyBuffer_Release(&kb); PyBuffer_Release(&rb); PyBuffer_Release(&db);
+  if (!idx_bytes || !diff_bytes) {
+    Py_XDECREF(idx_bytes); Py_XDECREF(diff_bytes);
+    return nullptr;
+  }
+  PyObject* ret = PyTuple_Pack(2, idx_bytes, diff_bytes);
+  Py_DECREF(idx_bytes);
+  Py_DECREF(diff_bytes);
+  return ret;
+}
+
+// split_lines(bytes) -> bytes of uint64 LE (start,end) offset pairs per line,
+// skipping a trailing empty line — the tokenizer core for jsonlines/plaintext
+// readers (reference: src/connectors/data_tokenize.rs).
+static PyObject* py_split_lines(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return nullptr;
+  const char* data = reinterpret_cast<const char*>(buf.buf);
+  size_t n = (size_t)buf.len;
+  std::vector<uint64_t> offs;
+  size_t start = 0;
+  for (size_t i = 0; i < n; i++) {
+    if (data[i] == '\n') {
+      offs.push_back(start);
+      offs.push_back(i);
+      start = i + 1;
+    }
+  }
+  if (start < n) {
+    offs.push_back(start);
+    offs.push_back(n);
+  }
+  PyObject* out = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(offs.data()), offs.size() * 8);
+  PyBuffer_Release(&buf);
+  return out;
+}
+
+static PyObject* py_set_pointer_type(PyObject*, PyObject* args) {
+  PyObject* t;
+  if (!PyArg_ParseTuple(args, "O", &t)) return nullptr;
+  Py_XINCREF(t);
+  Py_XDECREF(g_pointer_type);
+  g_pointer_type = t;
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"hash_object_column", py_hash_object_column, METH_VARARGS,
+     "hash a sequence of values into an n*8-byte output buffer; returns "
+     "indices needing python fallback"},
+    {"xxh64_digest", py_xxh64, METH_VARARGS, "xxh64 of a bytes-like"},
+    {"consolidate_pairs", py_consolidate_pairs, METH_VARARGS,
+     "group (key,row_hash) deltas, sum diffs, drop zeros"},
+    {"split_lines", py_split_lines, METH_VARARGS,
+     "newline tokenizer returning (start,end) offset pairs"},
+    {"set_pointer_type", py_set_pointer_type, METH_VARARGS,
+     "register the engine Pointer type"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_native",
+    "pathway_tpu native host runtime (hashing, consolidation, tokenizing)",
+    -1, methods};
+
+PyMODINIT_FUNC PyInit__native(void) { return PyModule_Create(&moduledef); }
