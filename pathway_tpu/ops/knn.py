@@ -58,6 +58,21 @@ def _search_kernel(corpus, valid_mask, queries, k: int, metric: str):
     return jax.lax.top_k(knn_scores(corpus, valid_mask, queries, metric), k)
 
 
+def _use_pallas(capacity: int) -> bool:
+    """The fused Pallas kernel pays off once the (Q, N) score matrix would be
+    HBM-traffic-bound; below that XLA's fused gemm+top_k is fine. TPU only."""
+    import os
+
+    if os.environ.get("PATHWAY_DISABLE_PALLAS"):
+        return False
+    if capacity < 8192:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
 
 
 class BruteForceKnnIndex:
@@ -169,9 +184,16 @@ class BruteForceKnnIndex:
         if bucket > nq:
             q = np.concatenate([q, np.zeros((bucket - nq, self.dim), np.float32)])
         k_eff = min(k, self.capacity)
-        scores, idx = _search_kernel(
-            self._corpus, self._valid, jnp.asarray(q), k_eff, self.metric
-        )
+        if _use_pallas(self.capacity):
+            from pathway_tpu.ops.pallas_knn import fused_topk
+
+            scores, idx = fused_topk(
+                self._corpus, self._valid, jnp.asarray(q), k_eff, self.metric
+            )
+        else:
+            scores, idx = _search_kernel(
+                self._corpus, self._valid, jnp.asarray(q), k_eff, self.metric
+            )
         scores = np.asarray(scores)[:nq]
         idx = np.asarray(idx)[:nq]
         out = []

@@ -1,0 +1,124 @@
+"""Fused KNN top-k Pallas kernel.
+
+The XLA path (`ops.knn`) materializes the full (Q, N) score matrix in HBM
+before `lax.top_k` — at corpus scale that matrix IS the HBM-bandwidth
+bottleneck (N=1M, Q=256 → 1 GB per search). This kernel tiles the corpus
+through VMEM and keeps a running (Q, K) top-k accumulator in VMEM scratch,
+so HBM traffic is one read of the corpus and one (Q, K) write: the
+streaming-RAG search shape (reference brute-force index:
+``src/external_integration/brute_force_knn_integration.rs:53-140``,
+re-designed TPU-first).
+
+Selection inside the kernel is K rounds of masked max over the concatenated
+(accumulator ‖ tile-scores) candidates — pure VPU ops (max / compare /
+select / iota), no sort or gather, so it lowers cleanly on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _make_kernel(k: int, metric: str, tile: int, q_rows: int):
+    def kernel(q_ref, c_ref, v_ref, out_vals_ref, out_idx_ref,
+               acc_vals_ref, acc_idx_ref):
+        step = pl.program_id(0)
+        nsteps = pl.num_programs(0)
+
+        @pl.when(step == 0)
+        def _init():
+            acc_vals_ref[:] = jnp.full((q_rows, k), _NEG_INF, jnp.float32)
+            acc_idx_ref[:] = jnp.zeros((q_rows, k), jnp.int32)
+
+        q = q_ref[:]                      # (Q, d) f32
+        c = c_ref[:]                      # (tile, d) bf16
+        valid = v_ref[:]                  # (tile, 1) bool/int32
+        dots = jax.lax.dot_general(
+            q.astype(jnp.bfloat16), c,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                 # (Q, tile)
+        if metric == "l2":
+            qn = jnp.sum(q * q, axis=1, keepdims=True)              # (Q, 1)
+            cf = c.astype(jnp.float32)
+            cn = jnp.sum(cf * cf, axis=1, keepdims=True)            # (tile,1)
+            scores = -(qn + cn.T - 2.0 * dots)
+        else:
+            scores = dots
+        vmask = (valid[:, 0] != 0)[None, :]                         # (1,tile)
+        scores = jnp.where(vmask, scores, _NEG_INF)
+
+        base = step * tile
+        tile_idx = base + jax.lax.broadcasted_iota(jnp.int32, (q_rows, tile), 1)
+
+        cand_vals = jnp.concatenate([acc_vals_ref[:], scores], axis=1)
+        cand_idx = jnp.concatenate([acc_idx_ref[:], tile_idx], axis=1)
+        width = k + tile
+        col = jax.lax.broadcasted_iota(jnp.int32, (q_rows, width), 1)
+
+        new_vals = []
+        new_idx = []
+        for _ in range(k):
+            m = jnp.max(cand_vals, axis=1, keepdims=True)           # (Q,1)
+            is_max = cand_vals == m
+            pos = jnp.min(jnp.where(is_max, col, width), axis=1, keepdims=True)
+            sel = col == pos
+            new_vals.append(m[:, 0])
+            new_idx.append(jnp.sum(jnp.where(sel, cand_idx, 0), axis=1))
+            cand_vals = jnp.where(sel, _NEG_INF, cand_vals)
+        acc_vals_ref[:] = jnp.stack(new_vals, axis=1)
+        acc_idx_ref[:] = jnp.stack(new_idx, axis=1).astype(jnp.int32)
+
+        @pl.when(step == nsteps - 1)
+        def _emit():
+            out_vals_ref[:] = acc_vals_ref[:]
+            out_idx_ref[:] = acc_idx_ref[:]
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "metric", "tile", "interpret")
+)
+def fused_topk(corpus, valid, queries, k: int, metric: str = "cos",
+               tile: int = 2048, interpret: bool = False):
+    """corpus (N, d) bf16, valid (N,) bool, queries (Q, d) f32 →
+    (scores (Q, k) f32, indices (Q, k) i32). N must be a multiple of
+    ``tile`` (the index pads its capacity to pow2, so it is)."""
+    n, d = corpus.shape
+    q_rows = queries.shape[0]
+    tile = min(tile, n)
+    assert n % tile == 0, (n, tile)
+    grid = (n // tile,)
+    kernel = _make_kernel(k, metric, tile, q_rows)
+    out_vals, out_idx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((q_rows, d), lambda i: (0, 0)),
+            pl.BlockSpec((tile, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((q_rows, k), lambda i: (0, 0)),
+            pl.BlockSpec((q_rows, k), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q_rows, k), jnp.float32),
+            jax.ShapeDtypeStruct((q_rows, k), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((q_rows, k), jnp.float32),
+            pltpu.VMEM((q_rows, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(queries.astype(jnp.float32), corpus,
+      valid.astype(jnp.int32).reshape(-1, 1))
+    return out_vals, out_idx

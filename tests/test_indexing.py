@@ -177,3 +177,27 @@ def test_legacy_knnindex_api():
     rows, cols = _capture_rows(res)
     di = cols.index("doc")
     assert all(len(row[di]) == 2 for row in rows.values())
+
+
+def test_pallas_fused_topk_matches_xla():
+    """Fused Pallas corpus-tiled top-k (interpret mode on CPU) must agree
+    with the XLA gemm+top_k path."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops.knn import knn_scores
+    from pathway_tpu.ops.pallas_knn import fused_topk
+
+    rng = np.random.default_rng(7)
+    N, d, Q, K = 256, 32, 8, 4
+    corpus = jnp.asarray(rng.normal(size=(N, d)), dtype=jnp.bfloat16)
+    valid = np.ones(N, bool)
+    valid[50:60] = False
+    q = jnp.asarray(rng.normal(size=(Q, d)).astype(np.float32))
+    for metric in ("cos", "l2"):
+        vals, idx = fused_topk(
+            corpus, jnp.asarray(valid), q, K, metric, tile=64, interpret=True
+        )
+        ref = np.asarray(knn_scores(corpus, jnp.asarray(valid), q, metric))
+        ref_idx = np.argsort(-ref, axis=1)[:, :K]
+        for i in range(Q):
+            assert set(np.asarray(idx)[i]) == set(ref_idx[i])
