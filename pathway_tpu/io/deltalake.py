@@ -1,0 +1,55 @@
+"""Delta Lake connector (reference ``python/pathway/io/deltalake``; engine
+``DeltaTableReader``/``DeltaTableWriter`` data_storage.rs:1924,1621). Gated
+on the ``deltalake`` package."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.operators.output import SinkNode
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._utils import format_value_for_output
+
+
+def _require_deltalake():
+    try:
+        import deltalake  # noqa: F401
+
+        return deltalake
+    except ImportError as exc:  # pragma: no cover - gated dependency
+        raise ImportError("pw.io.deltalake requires the `deltalake` package") from exc
+
+
+def read(uri: str, schema: Any, *, mode: str = "streaming",
+         autocommit_duration_ms: int | None = 1500, **kwargs):
+    dl = _require_deltalake()
+    import pandas as pd  # noqa: F401
+
+    import pathway_tpu as pw
+
+    table = dl.DeltaTable(uri)
+    df = table.to_pandas()
+    cols = list(schema.column_names())
+    return pw.debug.table_from_pandas(df[cols], schema=schema)
+
+
+def write(table, uri: str, *, partition_columns=None,
+          min_commit_frequency: int | None = 60_000, **kwargs) -> None:
+    dl = _require_deltalake()
+    cols = list(table.column_names())
+
+    def write_batch(time, batch):
+        import pandas as pd
+
+        rows = []
+        for _key, row, diff in batch.rows():
+            doc = {c: format_value_for_output(v) for c, v in zip(cols, row)}
+            doc["time"] = time
+            doc["diff"] = diff
+            rows.append(doc)
+        if rows:
+            dl.write_deltalake(uri, pd.DataFrame(rows), mode="append",
+                               partition_by=partition_columns)
+
+    node = SinkNode(G.engine_graph, table._node, write_batch, name=f"deltalake({uri})")
+    G.register_sink(node)

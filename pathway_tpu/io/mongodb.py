@@ -1,0 +1,33 @@
+"""MongoDB sink (reference ``python/pathway/io/mongodb``; engine
+``MongoWriter`` data_storage.rs:2232, ``BsonFormatter``). Gated on
+``pymongo``."""
+
+from __future__ import annotations
+
+from pathway_tpu.engine.operators.output import SinkNode
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._utils import format_value_for_output
+
+
+def write(table, connection_string: str, database: str, collection: str,
+          *, max_batch_size: int | None = None, **kwargs) -> None:
+    try:
+        import pymongo
+    except ImportError as exc:  # pragma: no cover - gated dependency
+        raise ImportError("pw.io.mongodb requires the `pymongo` package") from exc
+    client = pymongo.MongoClient(connection_string)
+    coll = client[database][collection]
+    cols = list(table.column_names())
+
+    def write_batch(time, batch):
+        docs = []
+        for _key, row, diff in batch.rows():
+            doc = {c: format_value_for_output(v) for c, v in zip(cols, row)}
+            doc["time"] = time
+            doc["diff"] = diff
+            docs.append(doc)
+        if docs:
+            coll.insert_many(docs)
+
+    node = SinkNode(G.engine_graph, table._node, write_batch, name=f"mongodb({collection})")
+    G.register_sink(node)

@@ -1,0 +1,55 @@
+"""NATS connector (reference ``python/pathway/io/nats``; engine
+``NatsReader``/``NatsWriter`` data_storage.rs:2271,2345). Gated on
+``nats-py``."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from pathway_tpu.engine.operators.output import SinkNode
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.io._utils import format_value_for_output
+
+
+def _require_nats():
+    try:
+        import nats  # noqa: F401
+
+        return nats
+    except ImportError as exc:  # pragma: no cover - gated dependency
+        raise ImportError("pw.io.nats requires the `nats-py` package") from exc
+
+
+def read(uri: str, topic: str, *, schema: Any, format: str = "json", **kwargs):
+    _require_nats()
+    raise NotImplementedError(
+        "live NATS subscriptions need a reachable NATS server; wrap your "
+        "subscription in a pw.io.python.ConnectorSubject"
+    )
+
+
+def write(table, uri: str, topic: str, *, format: str = "json", **kwargs) -> None:
+    nats_mod = _require_nats()
+    import asyncio
+
+    cols = list(table.column_names())
+    state: dict = {}
+
+    def _client():
+        if "nc" not in state:
+            loop = asyncio.new_event_loop()
+            nc = loop.run_until_complete(nats_mod.connect(uri))
+            state["nc"] = nc
+            state["loop"] = loop
+        return state["nc"], state["loop"]
+
+    def write_batch(time, batch):
+        nc, loop = _client()
+        for _key, row, diff in batch.rows():
+            payload = {c: format_value_for_output(v) for c, v in zip(cols, row)}
+            payload["diff"] = diff
+            loop.run_until_complete(nc.publish(topic, json.dumps(payload).encode()))
+
+    node = SinkNode(G.engine_graph, table._node, write_batch, name=f"nats({topic})")
+    G.register_sink(node)
