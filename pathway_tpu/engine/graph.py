@@ -48,6 +48,47 @@ class Node:
     def reset(self) -> None:
         """Drop run-scoped state (engine graphs can be executed repeatedly)."""
 
+    # --- operator persistence (reference: operator_snapshot.rs) ---
+    # attribute names holding this operator's run-scoped state; () = either
+    # stateless or not snapshottable (see is_stateful / _persist_exempt)
+    _state_attrs: tuple[str, ...] = ()
+    # nodes whose reset() clears run outputs rather than dataflow state
+    # (capture/subscribe/sink) — replay-safe, never force degradation
+    _persist_exempt: bool = False
+
+    def is_stateful(self) -> bool:
+        cls = type(self)
+        return cls.reset is not Node.reset and not self._persist_exempt
+
+    def state_snapshot(self):
+        """Picklable operator state for operator-persisting mode, or None if
+        this operator is stateless / not snapshottable."""
+        if not self._state_attrs:
+            return None
+        import logging
+        import pickle
+
+        try:
+            return pickle.dumps(
+                {a: getattr(self, a) for a in self._state_attrs},
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+        except Exception as exc:  # non-picklable state (e.g. closures)
+            logging.getLogger("pathway_tpu").warning(
+                "operator %s state not snapshottable (%s); next run will "
+                "fall back to input-snapshot replay",
+                self,
+                exc,
+            )
+            return None
+
+    def state_restore(self, state) -> None:
+        """Restore state produced by :meth:`state_snapshot`."""
+        import pickle
+
+        for attr, value in pickle.loads(state).items():
+            setattr(self, attr, value)
+
 
 class EngineGraph:
     def __init__(self, parent: "EngineGraph | None" = None):

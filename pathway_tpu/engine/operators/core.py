@@ -58,6 +58,8 @@ class InputNode(Node):
 class StatefulNode(Node):
     """Base for operators that materialize their output (chaining diffs)."""
 
+    _state_attrs = ("_in_states",)
+
     def __init__(self, graph, inputs, column_names, name=""):
         super().__init__(graph, inputs, column_names, name)
         self._in_states = [TableState(i.column_names) for i in inputs]
@@ -149,6 +151,8 @@ class FusedNode(Node):
         self._parts: list[TableState] = [TableState(i.column_names) for i in inputs]
         self._emitted: dict[int, tuple] = {}
 
+    _state_attrs = ("_parts", "_emitted")
+
     def reset(self):
         self._parts = [TableState(i.column_names) for i in self.inputs]
         self._emitted = {}
@@ -224,6 +228,8 @@ class ConcatNode(Node):
         super().__init__(graph, inputs, inputs[0].column_names, name)
         self._seen: list[MultisetState] = [MultisetState() for _ in inputs]
 
+    _state_attrs = ("_seen",)
+
     def reset(self):
         self._seen = [MultisetState() for _ in self.inputs]
 
@@ -259,6 +265,8 @@ class UniverseOpNode(StatefulNode):
         super().__init__(graph, inputs, inputs[0].column_names, name or f"Universe[{mode}]")
         self.mode = mode
         self._emitted: dict[int, tuple] = {}
+
+    _state_attrs = ("_in_states", "_emitted")
 
     def reset(self):
         super().reset()
@@ -306,6 +314,8 @@ class UpdateRowsNode(StatefulNode):
     def __init__(self, graph, left, right, name="UpdateRows"):
         super().__init__(graph, [left, right], left.column_names, name)
         self._emitted: dict[int, tuple] = {}
+
+    _state_attrs = ("_in_states", "_emitted")
 
     def reset(self):
         super().reset()
@@ -357,6 +367,8 @@ class UpdateCellsNode(StatefulNode):
         super().__init__(graph, [left, right], left.column_names, name)
         self.update_columns = set(update_columns)
         self._emitted: dict[int, tuple] = {}
+
+    _state_attrs = ("_in_states", "_emitted")
 
     def reset(self):
         super().reset()
@@ -414,6 +426,8 @@ class IxNode(StatefulNode):
         self.ptr_column = ptr_column
         self.optional = optional
         self._emitted: dict[int, tuple] = {}
+
+    _state_attrs = ("_in_states", "_emitted")
 
     def reset(self):
         super().reset()

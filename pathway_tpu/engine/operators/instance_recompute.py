@@ -46,6 +46,8 @@ class InstanceRecomputeNode(Node):
         ]
         self._emitted: dict[Any, dict[int, tuple]] = defaultdict(dict)
 
+    _state_attrs = ("_states", "_emitted")
+
     def reset(self):
         self._states = [defaultdict(dict) for _ in self.inputs]
         self._emitted = defaultdict(dict)

@@ -51,6 +51,8 @@ class JoinNode(Node):
         self._right: dict[Any, dict[int, tuple]] = defaultdict(dict)
         self._emitted: dict[Any, dict[int, tuple]] = defaultdict(dict)
 
+    _state_attrs = ("_left", "_right", "_emitted")
+
     def reset(self):
         self._left = defaultdict(dict)
         self._right = defaultdict(dict)

@@ -17,6 +17,8 @@ class CaptureNode(Node):
     """Materializes the final table (used by debug/compute-and-print paths
     and as the engine's ``ExportedTable``)."""
 
+    _persist_exempt = True  # output-side state; rebuilt by the run itself
+
     def __init__(self, graph, input_node, name="Capture"):
         super().__init__(graph, [input_node], input_node.column_names, name)
         self.state = TableState(input_node.column_names)
@@ -37,6 +39,8 @@ class CaptureNode(Node):
 
 class SubscribeNode(Node):
     """Calls back per delta row, per time flush and at end-of-stream."""
+
+    _persist_exempt = True
 
     def __init__(
         self,

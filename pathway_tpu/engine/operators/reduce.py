@@ -50,6 +50,8 @@ class GroupbyNode(Node):
         self._groups: dict[int, dict[str, Any]] = {}
         self._emitted: dict[int, tuple] = {}
 
+    _state_attrs = ("_groups", "_emitted")
+
     def reset(self):
         self._groups = {}
         self._emitted = {}
@@ -139,6 +141,8 @@ class DeduplicateNode(Node):
         self.instance_col = instance_col
         self.acceptor = acceptor
         self._accepted: dict[Any, tuple[int, tuple]] = {}  # instance -> (key, row)
+
+    _state_attrs = ("_accepted",)
 
     def reset(self):
         self._accepted = {}

@@ -31,6 +31,8 @@ class BufferNode(Node):
         self._held: dict[int, list[tuple[tuple, int]]] = {}
         self._watermark: Any = None
 
+    _state_attrs = ("_held", "_watermark")
+
     def reset(self):
         self._held = {}
         self._watermark = None
@@ -109,6 +111,8 @@ class ForgetNode(Node):
         self._alive: dict[int, list[tuple]] = {}
         self._watermark: Any = None
 
+    _state_attrs = ("_alive", "_watermark")
+
     def reset(self):
         self._alive = {}
         self._watermark = None
@@ -174,6 +178,8 @@ class FreezeNode(Node):
         self.time_col = time_col
         self._watermark: Any = None
 
+    _state_attrs = ("_watermark",)
+
     def reset(self):
         self._watermark = None
 
@@ -219,6 +225,8 @@ class SortNode(Node):
         self.instance_col = instance_col
         self._rows: dict[int, tuple] = {}  # key -> (sort_value, instance)
         self._emitted: dict[int, tuple] = {}
+
+    _state_attrs = ("_rows", "_emitted")
 
     def reset(self):
         self._rows = {}

@@ -20,11 +20,56 @@ class GraphRunner:
     def __init__(self, targets: list[Node]):
         self.targets = targets
 
+    def _op_signature(self, idx: int, node: Node) -> str:
+        return f"{idx}:{node.name}:{','.join(node.column_names)}"
+
     def run(self) -> None:
+        from pathway_tpu.internals import config as config_mod
+
         sched = Scheduler(G.engine_graph, self.targets)
         involved = {n.id for n in sched.order}
         for node in sched.order:
             node.reset()
+        manager = None
+        pcfg = config_mod.get_persistence_config()
+        if pcfg is not None and getattr(pcfg, "backend", None) is not None:
+            from pathway_tpu.persistence.engine_store import PersistenceManager
+
+            manager = PersistenceManager(
+                pcfg,
+                worker_id=config_mod.pathway_config.process_id,
+                total_workers=config_mod.pathway_config.processes,
+            )
+            if not manager.replay_inputs:
+                # operator-persisting mode: restore stateful operator
+                # snapshots instead of replaying input logs. All-or-nothing:
+                # restoring some operators while others start empty would
+                # silently drop pre-restart data, so any stateful node
+                # without a stored snapshot degrades the whole run to
+                # input-snapshot replay (safe, possibly slower).
+                staged: list[tuple[Node, bytes]] = []
+                missing: list[Node] = []
+                for idx, node in enumerate(sched.order):
+                    if not node.is_stateful():
+                        continue
+                    state = manager.load_operator_state(self._op_signature(idx, node))
+                    if state is None:
+                        missing.append(node)
+                    else:
+                        staged.append((node, state))
+                if missing:
+                    if manager.metadata.current.finalized_time is not None:
+                        import logging
+
+                        logging.getLogger("pathway_tpu").warning(
+                            "operator_persisting: no stored state for %s; "
+                            "falling back to input-snapshot replay",
+                            ", ".join(map(str, missing[:5])),
+                        )
+                    manager.force_input_replay()
+                else:
+                    for node, state in staged:
+                        node.state_restore(state)
         # static sources
         static = [
             (node, provider)
@@ -34,6 +79,9 @@ class GraphRunner:
         for node, _ in static:
             sched.register_source(node, 0)
         connectors = [c for c in G.connectors if c.node.id in involved]
+        if manager is not None:
+            for c in connectors:
+                c.setup_persistence(manager)
         for c in connectors:
             sched.register_source(c.node, 0)
         for node, provider in static:
@@ -67,6 +115,27 @@ class GraphRunner:
         finally:
             for c in connectors:
                 c.stop()
+        if manager is not None:
+            final_time = max(sched.current_time, 0)
+            if manager.mode == "operator_persisting":
+                # save even when this run degraded to input replay, so the
+                # next run can restore
+                for idx, node in enumerate(sched.order):
+                    if not node.is_stateful():
+                        continue
+                    state = node.state_snapshot()
+                    if state is not None:
+                        manager.save_operator_state(
+                            self._op_signature(idx, node), state
+                        )
+            manager.finalize(
+                final_time,
+                offsets={
+                    c.persistent_id: c.current_offset()
+                    for c in connectors
+                    if c.persistent_id is not None
+                },
+            )
         for node in sched.order:
             if isinstance(node, SubscribeNode):
                 node.finish()

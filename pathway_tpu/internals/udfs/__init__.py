@@ -23,6 +23,7 @@ from pathway_tpu.internals.udfs.caches import (
     InMemoryCache,
     with_batch_cache_strategy,
     with_cache_strategy,
+    with_deferred_cache,
 )
 from pathway_tpu.internals.udfs.executors import (
     AsyncExecutor,
@@ -127,6 +128,8 @@ class UDF:
         fun = executor._wrap(fun)
         if self.cache_strategy is not None:
             fun = with_cache_strategy(fun, self.cache_strategy)
+        else:
+            fun = with_deferred_cache(fun)
         return fun, isinstance(executor, (AsyncExecutor, FullyAsyncExecutor)) or is_async
 
     def __call__(self, *args, **kwargs) -> expr_mod.ColumnExpression:

@@ -59,7 +59,7 @@ class DiskCache(CacheStrategy):
 
     def _ensure(self):
         if self._conn is None:
-            root = os.environ.get(
+            root = _persistence_cache_root() or os.environ.get(
                 "PATHWAY_PERSISTENT_STORAGE", os.path.join(os.getcwd(), ".pw-cache")
             )
             os.makedirs(root, exist_ok=True)
@@ -90,6 +90,68 @@ class DiskCache(CacheStrategy):
 
 
 DefaultCache = DiskCache
+
+
+def _persistence_cache_root() -> str | None:
+    """Root UDF caches in the active persistence store so cached results
+    survive restarts alongside snapshots (reference
+    ``PersistenceMode::UdfCaching``, ``src/connectors/mod.rs:114``)."""
+    from pathway_tpu.internals import config as config_mod
+
+    pcfg = config_mod.get_persistence_config()
+    backend = getattr(pcfg, "backend", None)
+    if backend is not None and getattr(backend, "kind", None) == "filesystem":
+        return os.path.join(backend.path, "udf-caches")
+    return None
+
+
+def maybe_default_cache(existing: CacheStrategy | None) -> CacheStrategy | None:
+    """In udf_caching persistence mode every UDF gets a DiskCache unless it
+    configured its own strategy."""
+    if existing is not None:
+        return existing
+    from pathway_tpu.internals import config as config_mod
+
+    pcfg = config_mod.get_persistence_config()
+    mode = (getattr(pcfg, "persistence_mode", None) or "").lower()
+    if mode == "udf_caching":
+        return DiskCache()
+    return None
+
+
+def with_deferred_cache(fun: Callable) -> Callable:
+    """Wrap ``fun`` so that, if udf_caching persistence mode is active when
+    the dataflow actually runs (config is set at ``pw.run`` time, after UDF
+    expressions are built), calls go through a per-UDF DiskCache. The target
+    is resolved once on first call and rebound, so steady-state overhead is
+    one dict lookup per row."""
+    state: dict[str, Callable] = {}
+
+    def resolve() -> Callable:
+        target = state.get("fn")
+        if target is None:
+            cache = maybe_default_cache(None)
+            if cache is not None and isinstance(cache, DiskCache) and cache.name is None:
+                # distinct sqlite file per UDF: two UDFs that share a bare
+                # __name__ must not share cached results
+                cache.name = f"{getattr(fun, '__module__', '?')}.{getattr(fun, '__qualname__', getattr(fun, '__name__', 'udf'))}"
+            target = with_cache_strategy(fun, cache) if cache is not None else fun
+            state["fn"] = target
+        return target
+
+    if asyncio.iscoroutinefunction(fun):
+
+        @functools.wraps(fun)
+        async def async_wrapper(*args, **kwargs):
+            return await resolve()(*args, **kwargs)
+
+        return async_wrapper
+
+    @functools.wraps(fun)
+    def wrapper(*args, **kwargs):
+        return resolve()(*args, **kwargs)
+
+    return wrapper
 
 
 def with_cache_strategy(fun: Callable, cache: CacheStrategy) -> Callable:

@@ -38,6 +38,27 @@ class BaseConnector:
         self._sched = None
         self._time_mutex = threading.Lock()
         self._closed = False
+        self.persistent_id: str | None = None
+        self._persistence = None  # PersistenceManager when persistence is on
+        self._snapshot_writer = None
+
+    # -- persistence hooks (reference: Reader::seek + SnapshotEvent log) ----
+    def setup_persistence(self, manager) -> None:
+        self._persistence = manager
+        if self.persistent_id is not None:
+            self._snapshot_writer = manager.writer_for(self.persistent_id)
+
+    def current_offset(self):
+        """Reader position to store with each snapshot chunk; None = source
+        is not seekable (replay alone restores it)."""
+        return None
+
+    def seek_offset(self, offset) -> None:
+        """Fast-forward the reader past data already in the snapshot."""
+
+    def on_replay(self, rows) -> None:
+        """Rebuild connector-side state (e.g. upsert maps) from the
+        consolidated snapshot rows about to be re-emitted."""
 
     # -- session API used by run() implementations -------------------------
     def emit(self, time: int, rows: list[tuple[int, tuple, int]]) -> None:
@@ -57,6 +78,9 @@ class BaseConnector:
         with self._time_mutex:
             t = next_commit_time()
             self.emit(t, rows)
+            if self._snapshot_writer is not None:
+                self._snapshot_writer.write_rows(rows)
+                self._snapshot_writer.advance(t, offset=self.current_offset())
             self.advance(t + 1)
             return t
 
@@ -73,6 +97,23 @@ class BaseConnector:
     def start(self, sched) -> None:
         self._sched = sched
         self._stop.clear()
+        if self._persistence is not None and self.persistent_id is not None:
+            # replay-then-resume (reference connectors/mod.rs:296-425):
+            # emit the consolidated snapshot at one fresh commit time, seek
+            # the reader past logged data, then read realtime updates.
+            rows, offset = self._persistence.rewind(self.persistent_id)
+            if rows:
+                self.on_replay(rows)
+            if rows and self._persistence.replay_inputs:
+                with self._time_mutex:
+                    t = next_commit_time()
+                    self.emit(t, rows)
+                    self.advance(t + 1)
+            if offset is not None:
+                self.seek_offset(offset)
+            if not self._persistence.continue_after_replay:
+                self.close()
+                return
         self._thread = threading.Thread(target=self._run_safe, daemon=True)
         self._thread.start()
         if self.heartbeat_ms is not None:

@@ -114,8 +114,19 @@ class _FsConnector(BaseConnector):
         self.with_metadata = with_metadata
         self.csv_settings = csv_settings
         self.refresh_interval = refresh_interval
+        self._seen: dict[str, float] = {}
         if mode != "static":
             self.heartbeat_ms = 500
+
+    # persistence: the reader offset is the seen-files map (path -> mtime) —
+    # the posix analog of the reference's per-source OffsetAntichain
+    # (src/connectors/offset.rs); stored with every snapshot chunk.
+    def current_offset(self):
+        return dict(self._seen)
+
+    def seek_offset(self, offset) -> None:
+        if isinstance(offset, dict):
+            self._seen.update(offset)
 
     def _read_all(self, seen: dict[str, float]) -> list[tuple[int, tuple, int]]:
         cols = list(self.node.column_names)
@@ -143,14 +154,14 @@ class _FsConnector(BaseConnector):
         return rows
 
     def run(self):
-        seen: dict[str, float] = {}
-        rows = self._read_all(seen)
-        self.commit_rows(rows)
+        rows = self._read_all(self._seen)
+        if rows or self._persistence is None:
+            self.commit_rows(rows)
         if self.mode == "static":
             return
         while not self.should_stop():
             time_mod.sleep(self.refresh_interval)
-            rows = self._read_all(seen)
+            rows = self._read_all(self._seen)
             if rows:
                 self.commit_rows(rows)
 

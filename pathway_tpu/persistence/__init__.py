@@ -54,7 +54,40 @@ _persistent_sources: dict[str, Any] = {}
 
 def register_persistent_source(persistent_id: str, connector: Any) -> None:
     _persistent_sources[persistent_id] = connector
+    connector.persistent_id = persistent_id
 
 
 def get_persistent_sources() -> dict[str, Any]:
     return dict(_persistent_sources)
+
+
+from pathway_tpu.persistence.backends import (  # noqa: E402
+    FilesystemBackend,
+    MemoryBackend,
+    MockBackend,
+    PersistenceBackend,
+    S3Backend,
+)
+from pathway_tpu.persistence.engine_store import PersistenceManager  # noqa: E402
+from pathway_tpu.persistence.snapshot import (  # noqa: E402
+    SnapshotLogReader,
+    SnapshotLogWriter,
+)
+from pathway_tpu.persistence.state import MetadataAccessor, StoredMetadata  # noqa: E402
+
+__all__ = [
+    "Backend",
+    "Config",
+    "FilesystemBackend",
+    "MemoryBackend",
+    "MetadataAccessor",
+    "MockBackend",
+    "PersistenceBackend",
+    "PersistenceManager",
+    "S3Backend",
+    "SnapshotLogReader",
+    "SnapshotLogWriter",
+    "StoredMetadata",
+    "register_persistent_source",
+    "get_persistent_sources",
+]
