@@ -1,0 +1,6 @@
+"""``python -m pathway_tpu`` → CLI (reference ``python/pathway/__main__.py``)."""
+
+from pathway_tpu.cli import main
+
+if __name__ == "__main__":
+    main()

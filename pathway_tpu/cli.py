@@ -1,0 +1,177 @@
+"""Command-line interface — ``pathway-tpu spawn`` process launcher.
+
+Parity with the reference CLI (``python/pathway/cli.py:53-175``): ``spawn``
+launches N host processes with the ``PATHWAY_THREADS / PATHWAY_PROCESSES /
+PATHWAY_FIRST_PORT / PATHWAY_PROCESS_ID / PATHWAY_RUN_ID`` env contract, and
+``spawn-from-env`` re-reads the same flags from ``PATHWAY_SPAWN_ARGS``.
+
+TPU-native difference: worker processes join through ``jax.distributed``
+(coordinator at ``127.0.0.1:first_port``) instead of timely's TCP cluster
+(reference ``src/engine/dataflow/config.rs:63-127``); the env names are kept
+so reference deployment scripts keep working. The git-repository bootstrap
+mode of the reference (``cli.py:30-66``, clones a repo into a temp venv) is
+supported when GitPython is importable and gated off otherwise — this build
+has zero network egress.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import subprocess
+import sys
+import tempfile
+import uuid
+import venv
+from pathlib import Path
+
+import click
+
+import pathway_tpu as pw
+
+
+def plural(n: int, singular: str, plural_form: str) -> str:
+    return f"{n} {singular if n == 1 else plural_form}"
+
+
+def get_temporary_paths(temp_root: tempfile.TemporaryDirectory) -> tuple[Path, Path]:
+    root = Path(temp_root.name)
+    return root / "repository", root / "venv"
+
+
+def checkout_repository(repository_url: str | None, branch: str | None):
+    """Clone ``repository_url`` into a temp dir with a fresh venv (reference
+    ``cli.py:30-50``). Requires GitPython + network; errors out cleanly
+    when unavailable."""
+    if repository_url is None:
+        return None
+    try:
+        import git
+    except ImportError:
+        logging.error("To run the code from a Git repository please install GitPython")
+        raise SystemExit(1)
+    temp_root_directory = tempfile.TemporaryDirectory()
+    repository_path, venv_path = get_temporary_paths(temp_root_directory)
+    repository = git.Repo.clone_from(repository_url, repository_path)
+    if branch is not None:
+        repository.git.checkout(branch)
+    venv.create(venv_path, with_pip=True)
+    return temp_root_directory
+
+
+def spawn_program(
+    *,
+    threads: int,
+    processes: int,
+    first_port: int,
+    repository_url: str | None,
+    branch: str | None,
+    program: str,
+    arguments: tuple[str, ...],
+    env_base: dict[str, str],
+) -> None:
+    """Launch ``processes`` copies of ``program`` with the worker-topology env
+    contract (reference ``cli.py:53-109``)."""
+    temp_root_directory = checkout_repository(repository_url, branch)
+    if temp_root_directory is not None:
+        repository_path, venv_path = get_temporary_paths(temp_root_directory)
+        requirements_path = repository_path / "requirements.txt"
+        if program.startswith("python"):
+            program = os.fspath(venv_path / "bin" / program)
+        if requirements_path.exists():
+            pip_path = venv_path / "bin" / "pip"
+            handle = subprocess.run(
+                [os.fspath(pip_path), "install", "-r", os.fspath(requirements_path)],
+                stderr=subprocess.STDOUT,
+            )
+            if handle.returncode != 0:
+                logging.error("Failed to install requirements")
+                raise RuntimeError("Failed to install dependencies")
+        os.chdir(repository_path)
+
+    processes_str = plural(processes, "process", "processes")
+    workers_str = plural(processes * threads, "total worker", "total workers")
+    click.echo(f"Preparing {processes_str} ({workers_str})", err=True)
+    run_id = uuid.uuid4()
+    process_handles: list[subprocess.Popen] = []
+    try:
+        for process_id in range(processes):
+            env = env_base.copy()
+            env["PATHWAY_THREADS"] = str(threads)
+            env["PATHWAY_PROCESSES"] = str(processes)
+            env["PATHWAY_FIRST_PORT"] = str(first_port)
+            env["PATHWAY_PROCESS_ID"] = str(process_id)
+            env["PATHWAY_RUN_ID"] = str(run_id)
+            handle = subprocess.Popen([program, *arguments], env=env)
+            process_handles.append(handle)
+        for handle in process_handles:
+            handle.wait()
+    finally:
+        for handle in process_handles:
+            handle.terminate()
+    # non-zero (incl. signal-killed, negative returncode) in any worker is a
+    # failed run — don't let a clean worker's 0 mask it via max()
+    sys.exit(0 if all(h.returncode == 0 for h in process_handles) else 1)
+
+
+@click.group
+@click.version_option(version=pw.__version__, prog_name="pathway-tpu")
+def cli() -> None:
+    pass
+
+
+@cli.command(
+    context_settings={"allow_interspersed_args": False, "show_default": True}
+)
+@click.option("-t", "--threads", metavar="N", type=int, default=1,
+              help="number of logical workers (chips) per process")
+@click.option("-n", "--processes", metavar="N", type=int, default=1,
+              help="number of host processes")
+@click.option("--first-port", type=int, metavar="PORT", default=10000,
+              help="coordinator / first communication port")
+@click.option("--record", is_flag=True,
+              help="record data in the input connectors")
+@click.option("--record-path", type=str, default="record",
+              help="directory in which the record is saved")
+@click.option("--repository-url", type=str,
+              help="github repository to spawn the program from")
+@click.option("--branch", type=str, help="branch if not the default")
+@click.argument("program")
+@click.argument("arguments", nargs=-1)
+def spawn(threads, processes, first_port, record, record_path,
+          repository_url, branch, program, arguments):
+    """Launch PROGRAM as a multi-process pathway-tpu run."""
+    env = os.environ.copy()
+    if record:
+        env["PATHWAY_REPLAY_STORAGE"] = record_path
+        env["PATHWAY_SNAPSHOT_ACCESS"] = "record"
+    spawn_program(
+        threads=threads,
+        processes=processes,
+        first_port=first_port,
+        repository_url=repository_url,
+        branch=branch,
+        program=program,
+        arguments=arguments,
+        env_base=env,
+    )
+
+
+@cli.command(context_settings={"allow_interspersed_args": False})
+@click.argument("program")
+@click.argument("arguments", nargs=-1)
+def spawn_from_env(program, arguments):
+    """Like ``spawn`` but flags come from $PATHWAY_SPAWN_ARGS (reference
+    ``cli.py`` spawn-from-env)."""
+    spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "")
+    argv = [*shlex.split(spawn_args), program, *arguments]
+    spawn.main(args=argv, standalone_mode=True)
+
+
+def main() -> None:
+    cli.main()
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,11 @@ class Node:
         self.inputs = list(inputs)
         self.column_names = list(column_names)
         self.name = name or type(self).__name__
-        self.trace = None  # user frame attribution
+        # user-frame attribution (reference internals/trace.py): captured at
+        # build time, used to re-point engine errors at the user's code line
+        from pathway_tpu.internals.trace import capture_trace
+
+        self.trace = capture_trace(skip=2)
         graph.add_node(self)
 
     def __repr__(self):

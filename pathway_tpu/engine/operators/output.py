@@ -32,9 +32,39 @@ class CaptureNode(Node):
         (batch,) = ins
         if batch is None or len(batch) == 0:
             return None
+        self._maybe_terminate_on_error(batch)
         self.state.apply(batch)
         self.updates.append((time, batch))
         return batch
+
+    def _maybe_terminate_on_error(self, batch) -> None:
+        maybe_terminate_on_error(batch)
+
+
+def maybe_terminate_on_error(batch) -> None:
+    """Reference semantics (src/engine/error.rs DataError::ErrorInOutput):
+    ERROR values propagate through the dataflow as sentinels, but one
+    reaching any output (capture, sink, subscribe) aborts the run unless
+    terminate_on_error=False."""
+    from pathway_tpu.engine.value import ERROR
+    from pathway_tpu.internals import config as config_mod
+
+    if not config_mod.pathway_config.terminate_on_error:
+        return
+    for _key, row, _diff in batch.rows():
+        if any(v is ERROR for v in row):
+            from pathway_tpu.internals.errors import (
+                EngineError,
+                get_global_error_log,
+            )
+
+            entries = get_global_error_log().entries
+            detail = entries[-1]["message"] if entries else "ERROR value"
+            raise EngineError(
+                f"error value reached output table ({detail}); set "
+                "terminate_on_error=False or use pw.fill_error(...) to "
+                "tolerate it"
+            )
 
 
 class SubscribeNode(Node):
@@ -96,5 +126,12 @@ class SinkNode(Node):
     def step(self, time, ins):
         (batch,) = ins
         if batch is not None and len(batch) > 0:
+            maybe_terminate_on_error(batch)
             self.write_batch(time, batch)
         return batch
+
+    def finish(self) -> None:
+        """End-of-run flush hook (writers with background queues)."""
+        flush = getattr(self.write_batch, "finish", None)
+        if flush is not None:
+            flush()

@@ -1,0 +1,96 @@
+"""Operator probes — per-node runtime statistics.
+
+The analog of the reference's prober machinery (`src/engine/graph.rs:533`
+``ProberStats``/``OperatorStats``, ``src/engine/progress_reporter.rs:17-90``):
+the scheduler times every operator step and counts rows; snapshots feed the
+console dashboard (``internals/monitoring.py``), the Prometheus endpoint
+(``internals/http_server.py``) and ``pw.run``'s final summary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+
+@dataclasses.dataclass
+class OperatorStats:
+    name: str
+    rows_in: int = 0
+    rows_out: int = 0
+    epochs: int = 0
+    total_time_s: float = 0.0
+    last_active_time: float = 0.0
+
+    @property
+    def lag_s(self) -> float:
+        return max(0.0, time.time() - self.last_active_time)
+
+
+@dataclasses.dataclass
+class ConnectorStats:
+    name: str
+    rows_read: int = 0
+    commits: int = 0
+    finished: bool = False
+
+
+class SchedulerStats:
+    """Thread-safe stats registry attached to a live scheduler."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.operators: dict[int, OperatorStats] = {}
+        # keyed by connector node id (names may collide across connectors)
+        self.connectors: dict[int, ConnectorStats] = {}
+        self.current_time: int = -1
+        self.epochs_total: int = 0
+        self.started_at: float = time.time()
+        self.finished: bool = False
+
+    def operator(self, node_id: int, name: str) -> OperatorStats:
+        with self._lock:
+            stats = self.operators.get(node_id)
+            if stats is None:
+                stats = self.operators[node_id] = OperatorStats(name=name)
+            return stats
+
+    def connector(self, node_id: int, name: str) -> ConnectorStats:
+        with self._lock:
+            stats = self.connectors.get(node_id)
+            if stats is None:
+                stats = self.connectors[node_id] = ConnectorStats(name=name)
+            return stats
+
+    def record_connector_commit(self, node_id: int, name: str, n_rows: int) -> None:
+        stats = self.connector(node_id, name)
+        with self._lock:
+            stats.rows_read += n_rows
+            stats.commits += 1
+
+    def connector_finished(self, node_id: int, name: str) -> None:
+        self.connector(node_id, name).finished = True
+
+    def record_step(
+        self, node_id: int, name: str, rows_in: int, rows_out: int, dt: float
+    ) -> None:
+        stats = self.operator(node_id, name)
+        with self._lock:
+            stats.rows_in += rows_in
+            stats.rows_out += rows_out
+            stats.epochs += 1
+            stats.total_time_s += dt
+            stats.last_active_time = time.time()
+
+    def snapshot(self) -> dict:
+        """Plain-dict snapshot for renderers/exporters."""
+        with self._lock:
+            return {
+                "current_time": self.current_time,
+                "epochs_total": self.epochs_total,
+                "uptime_s": time.time() - self.started_at,
+                "finished": self.finished,
+                "operators": [dataclasses.asdict(s) for s in self.operators.values()],
+                "connectors": [dataclasses.asdict(s) for s in self.connectors.values()],
+            }

@@ -11,11 +11,13 @@ dataflow progress tracking in the single-dimension case.
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
 from typing import Any, Callable
 
 from pathway_tpu.engine.batch import Batch, concat_batches, consolidate
 from pathway_tpu.engine.graph import EngineGraph, Node
+from pathway_tpu.engine.probes import SchedulerStats
 
 
 class Scheduler:
@@ -36,6 +38,7 @@ class Scheduler:
         self._async_inflight = 0
         self._stopped = False
         self.current_time: int = -1
+        self.stats = SchedulerStats()
 
     # ------------------------------------------------------------------ inputs
     def register_source(self, node: Node, initial_time: int = 0) -> None:
@@ -122,17 +125,37 @@ class Scheduler:
 
     def _run_epoch(self, t: int, injected: dict[int, list[Batch]]) -> None:
         self.current_time = t
+        self.stats.current_time = t
+        self.stats.epochs_total += 1
         outputs: dict[int, Batch | None] = {}
         for node in self.order:
             ins = [
                 outputs.get(i.id) if i.id in self._order_ids else None
                 for i in node.inputs
             ]
-            out = node.step(t, ins)
+            started = time.perf_counter()
+            try:
+                out = node.step(t, ins)
+            except Exception as exc:
+                from pathway_tpu.internals.trace import add_error_trace
+
+                raise add_error_trace(exc, node.trace)
             extra = injected.get(node.id)
             if extra:
                 out = concat_batches([out] + extra) if out is not None else concat_batches(extra)
-            outputs[node.id] = consolidate(out) if out is not None else None
+            result = consolidate(out) if out is not None else None
+            outputs[node.id] = result
+            rows_in = sum(len(b) for b in ins if b is not None) + sum(
+                len(b) for b in (extra or [])
+            )
+            if rows_in or result is not None:
+                self.stats.record_step(
+                    node.id,
+                    node.name,
+                    rows_in,
+                    len(result) if result is not None else 0,
+                    time.perf_counter() - started,
+                )
         # epoch complete: notify operators; collect late emissions
         for node in self.order:
             for future_t, batch in node.on_time_end(t):

@@ -1,0 +1,105 @@
+"""Per-process Prometheus metrics endpoint.
+
+Parity with reference ``src/engine/http_server.rs:25-215``: a plain-text
+Prometheus exposition endpoint served per process on port
+``20000 + process_id`` (same scheme), fed by the scheduler's probe stats.
+Implemented on the stdlib ``http.server`` (the reference uses hyper) — the
+metrics names mirror ``metrics_from_stats``: input/output latency analogue,
+per-operator row counters, epoch counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+BASE_PORT = 20000
+
+
+def metrics_from_stats(snapshot: dict) -> str:
+    """Render a SchedulerStats snapshot in Prometheus text format."""
+    lines: list[str] = []
+    seen_help: set[str] = set()
+
+    def gauge(name: str, value, help_text: str, labels: str = "") -> None:
+        if name not in seen_help:
+            seen_help.add(name)
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {value}")
+
+    gauge("pathway_logical_time", snapshot["current_time"],
+          "Current committed logical time")
+    gauge("pathway_epochs_total", snapshot["epochs_total"],
+          "Epochs processed since start")
+    gauge("pathway_uptime_seconds", f"{snapshot['uptime_s']:.3f}",
+          "Seconds since the run started")
+    gauge("pathway_run_finished", int(snapshot["finished"]),
+          "Whether the dataflow has finished")
+    for op in snapshot["operators"]:
+        label = '{operator="%s"}' % op["name"].replace('"', "'")
+        gauge("pathway_operator_rows_in_total", op["rows_in"],
+              "Rows consumed per operator", label)
+        gauge("pathway_operator_rows_out_total", op["rows_out"],
+              "Rows produced per operator", label)
+        gauge("pathway_operator_time_seconds_total",
+              f"{op['total_time_s']:.6f}",
+              "Wall seconds spent per operator", label)
+        lag = max(0.0, time.time() - op["last_active_time"]) if op["last_active_time"] else 0.0
+        gauge("pathway_operator_lag_seconds", f"{lag:.3f}",
+              "Seconds since the operator was last active", label)
+    for c in snapshot["connectors"]:
+        label = '{connector="%s"}' % c["name"].replace('"', "'")
+        gauge("pathway_connector_rows_read_total", c["rows_read"],
+              "Rows ingested per connector", label)
+        gauge("pathway_connector_commits_total", c["commits"],
+              "Commits per connector", label)
+        gauge("pathway_connector_finished", int(c["finished"]),
+              "Whether the connector reached end of stream", label)
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Background HTTP server exposing ``/metrics`` (and ``/`` alias)."""
+
+    def __init__(self, stats, process_id: int = 0, port: int | None = None):
+        self.stats = stats
+        self.port = port if port is not None else BASE_PORT + process_id
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        stats = self.stats
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                if self.path not in ("/", "/metrics", "/status"):
+                    self.send_error(404)
+                    return
+                body = metrics_from_stats(stats.snapshot()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pathway-tpu:metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

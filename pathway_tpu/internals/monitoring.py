@@ -1,12 +1,16 @@
-"""Monitoring — levels + console dashboard (reference ``internals/monitoring.py``).
+"""Monitoring — levels + rich live console dashboard.
 
-The rich-based live dashboard fed by engine probes arrives with the
-observability subsystem; MonitoringLevel is part of the run() surface now.
+Parity with reference ``internals/monitoring.py`` (``StatsMonitor:165``, rich
+Live table fed by engine probes): renders connector ingest counters and
+per-operator row/latency stats from the scheduler's ``SchedulerStats``
+(``engine/probes.py``) on a background thread while ``pw.run`` pumps the
+dataflow. ``MonitoringLevel`` mirrors the reference enum surface.
 """
 
 from __future__ import annotations
 
 import enum
+import threading
 
 
 class MonitoringLevel(enum.Enum):
@@ -15,3 +19,108 @@ class MonitoringLevel(enum.Enum):
     NONE = 2
     IN_OUT = 3
     ALL = 4
+
+
+def _resolve(level: "MonitoringLevel | None", interactive: bool) -> "MonitoringLevel":
+    if level is None or level in (MonitoringLevel.AUTO, MonitoringLevel.AUTO_ALL):
+        if not interactive:
+            return MonitoringLevel.NONE
+        return (
+            MonitoringLevel.ALL
+            if level == MonitoringLevel.AUTO_ALL
+            else MonitoringLevel.IN_OUT
+        )
+    return level
+
+
+class StatsMonitor:
+    """Background renderer of scheduler stats (reference ``StatsMonitor``)."""
+
+    def __init__(self, stats, level: MonitoringLevel, refresh_s: float = 1.0):
+        self.stats = stats
+        self.level = level
+        self.refresh_s = refresh_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---------------------------------------------------------------- render
+    def _render(self):
+        from rich.table import Table as RichTable
+
+        snap = self.stats.snapshot()
+        table = RichTable(title="pathway-tpu progress dashboard")
+        table.add_column("operator")
+        table.add_column("rows in", justify="right")
+        table.add_column("rows out", justify="right")
+        table.add_column("epochs", justify="right")
+        table.add_column("time [s]", justify="right")
+        for c in snap["connectors"]:
+            table.add_row(
+                f"[cyan]{c['name']}[/cyan]",
+                str(c["rows_read"]),
+                "-",
+                str(c["commits"]),
+                "done" if c["finished"] else "live",
+            )
+        ops = snap["operators"]
+        if self.level != MonitoringLevel.ALL:
+            # IN_OUT: endpoints only, like the reference's default dashboard
+            ops = [
+                o
+                for o in ops
+                if any(
+                    k in o["name"].lower()
+                    for k in ("input", "output", "capture", "subscribe", "connector")
+                )
+            ]
+        for o in ops:
+            table.add_row(
+                o["name"],
+                str(o["rows_in"]),
+                str(o["rows_out"]),
+                str(o["epochs"]),
+                f"{o['total_time_s']:.3f}",
+            )
+        table.caption = (
+            f"logical time {snap['current_time']}, "
+            f"{snap['epochs_total']} epochs, up {snap['uptime_s']:.1f}s"
+        )
+        return table
+
+    def _loop(self) -> None:
+        from rich.live import Live
+
+        with Live(self._render(), refresh_per_second=4, transient=False) as live:
+            while not self._stop.wait(self.refresh_s):
+                live.update(self._render())
+            live.update(self._render())
+
+    # ---------------------------------------------------------------- control
+    def start(self) -> None:
+        if self.level == MonitoringLevel.NONE:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="pathway-tpu:monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def maybe_start_monitor(stats, level) -> StatsMonitor | None:
+    """Start a dashboard when the level (after AUTO resolution against TTY
+    state) asks for one; returns None otherwise."""
+    import sys
+
+    if isinstance(level, str):
+        level = MonitoringLevel[level.upper()]
+    resolved = _resolve(level, interactive=sys.stderr.isatty())
+    if resolved == MonitoringLevel.NONE:
+        return None
+    monitor = StatsMonitor(stats, resolved)
+    monitor.start()
+    return monitor

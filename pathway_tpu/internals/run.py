@@ -16,17 +16,40 @@ from pathway_tpu.engine.scheduler import Scheduler
 from pathway_tpu.internals.parse_graph import G
 
 
-class GraphRunner:
-    def __init__(self, targets: list[Node]):
-        self.targets = targets
+# stats of the most recent completed run (inspection / tests / dashboards)
+LAST_RUN_STATS = None
 
+
+class GraphRunner:
     def _op_signature(self, idx: int, node: Node) -> str:
         return f"{idx}:{node.name}:{','.join(node.column_names)}"
 
+    def __init__(
+        self,
+        targets: list[Node],
+        *,
+        monitoring_level=None,
+        with_http_server: bool = False,
+    ):
+        self.targets = targets
+        self.monitoring_level = monitoring_level
+        self.with_http_server = with_http_server
+
     def run(self) -> None:
         from pathway_tpu.internals import config as config_mod
+        from pathway_tpu.internals.http_server import MetricsServer
+        from pathway_tpu.internals.monitoring import maybe_start_monitor
 
         sched = Scheduler(G.engine_graph, self.targets)
+        global LAST_RUN_STATS
+        LAST_RUN_STATS = sched.stats
+        monitor = maybe_start_monitor(sched.stats, self.monitoring_level)
+        metrics_server = None
+        if self.with_http_server:
+            metrics_server = MetricsServer(
+                sched.stats, process_id=config_mod.pathway_config.process_id
+            )
+            metrics_server.start()
         involved = {n.id for n in sched.order}
         for node in sched.order:
             node.reset()
@@ -115,6 +138,11 @@ class GraphRunner:
         finally:
             for c in connectors:
                 c.stop()
+            sched.stats.finished = True
+            if monitor is not None:
+                monitor.stop()
+            if metrics_server is not None:
+                metrics_server.stop()
         if manager is not None:
             final_time = max(sched.current_time, 0)
             if manager.mode == "operator_persisting":
@@ -137,8 +165,9 @@ class GraphRunner:
                 },
             )
         for node in sched.order:
-            if isinstance(node, SubscribeNode):
-                node.finish()
+            finish = getattr(node, "finish", None)
+            if finish is not None:
+                finish()
 
 
 def run(
@@ -161,7 +190,16 @@ def run(
     targets = list(G.sinks)
     if not targets:
         return
-    GraphRunner(targets).run()
+    prev_terminate = config_mod.pathway_config.terminate_on_error
+    config_mod.pathway_config.terminate_on_error = terminate_on_error
+    try:
+        GraphRunner(
+            targets,
+            monitoring_level=monitoring_level,
+            with_http_server=with_http_server,
+        ).run()
+    finally:
+        config_mod.pathway_config.terminate_on_error = prev_terminate
 
 
 def run_all(**kwargs) -> None:

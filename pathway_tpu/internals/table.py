@@ -216,6 +216,20 @@ class Table(Joinable):
         u = self._universe.subset()
         return Table(out, schema, u)
 
+    def split(self, split_expression) -> tuple["Table", "Table"]:
+        """Split into (matching, non-matching) tables with provably-disjoint
+        key subsets (reference ``table.py:531-568``)."""
+        from pathway_tpu.internals import universe as universe_mod
+
+        expression = expr_mod.smart_coerce(split_expression)
+        positive = self.filter(expression)
+        negative = self.filter(~expression)
+        # filter() already registers each side as a subset of self; record
+        # the disjointness promise (reference also concats to assert
+        # equality, but that adds an unused node to the graph)
+        universe_mod.promise_are_pairwise_disjoint(positive, negative)
+        return positive, negative
+
     def copy(self) -> "Table":
         return self.select(*[self[c] for c in self.column_names()])
 

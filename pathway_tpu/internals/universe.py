@@ -118,9 +118,21 @@ def register_equal(a: Universe, b: Universe) -> None:
     GLOBAL_SOLVER.register_as_equal(a, b)
 
 
-def promise_are_pairwise_disjoint(*universes: Universe) -> None:
+def _as_universe(x) -> Universe:
+    return x if isinstance(x, Universe) else x._universe
+
+
+def promise_are_pairwise_disjoint(*tables_or_universes) -> None:
     pass  # disjointness recorded for documentation; concat checks at runtime
 
 
-def promise_is_subset_of(sub: Universe, sup: Universe) -> None:
-    register_subset(sub, sup)
+def promise_are_equal(*tables_or_universes) -> None:
+    """Declare the arguments (tables or universes) share one key set
+    (reference ``pathway.universes.promise_are_equal``)."""
+    us = [_as_universe(x) for x in tables_or_universes]
+    for other in us[1:]:
+        register_equal(us[0], other)
+
+
+def promise_is_subset_of(sub, sup) -> None:
+    register_subset(_as_universe(sub), _as_universe(sup))

@@ -10,33 +10,65 @@ parity.
 from __future__ import annotations
 
 from pathway_tpu.io import (
+    airbyte,
+    bigquery,
     csv,
+    debezium,
+    deltalake,
+    elasticsearch,
     fs,
+    gdrive,
     http,
     jsonlines,
     kafka,
+    logstash,
     minio,
+    mongodb,
+    nats,
     null,
     plaintext,
+    postgres,
+    pubsub,
+    pyfilesystem,
     python,
+    redpanda,
     s3,
+    s3_csv,
+    slack,
     sqlite,
 )
 from pathway_tpu.io._subscribe import subscribe
 from pathway_tpu.io._utils import CsvParserSettings, OnChangeCallback, OnFinishCallback
 
 __all__ = [
+    "airbyte",
+    "bigquery",
     "csv",
+    "debezium",
+    "deltalake",
+    "elasticsearch",
     "fs",
+    "gdrive",
     "http",
     "jsonlines",
     "kafka",
+    "logstash",
     "minio",
+    "mongodb",
+    "nats",
     "null",
     "plaintext",
+    "postgres",
+    "pubsub",
+    "pyfilesystem",
     "python",
+    "redpanda",
     "s3",
+    "s3_csv",
+    "slack",
     "sqlite",
     "subscribe",
     "CsvParserSettings",
+    "OnChangeCallback",
+    "OnFinishCallback",
 ]

@@ -82,13 +82,23 @@ class BaseConnector:
                 self._snapshot_writer.write_rows(rows)
                 self._snapshot_writer.advance(t, offset=self.current_offset())
             self.advance(t + 1)
+            if self._sched is not None:
+                self._sched.stats.record_connector_commit(
+                    self.node.id, self._stat_name(), len(rows)
+                )
             return t
+
+    def _stat_name(self) -> str:
+        return f"{type(self).__name__}[{self.node.name}]"
 
     def close(self) -> None:
         with self._time_mutex:
             self._closed = True
             if self._sched is not None:
                 self._sched.close_source(self.node)
+                self._sched.stats.connector_finished(
+                    self.node.id, self._stat_name()
+                )
 
     def should_stop(self) -> bool:
         return self._stop.is_set()

@@ -1,0 +1,121 @@
+"""Airbyte source connector (reference ``python/pathway/io/airbyte``:
+runs an Airbyte connector via `airbyte-serverless` (PyPI venv or docker) and
+streams its record messages as a ``data: Json`` column, incremental state
+kept between polls).
+
+This build has no network/docker egress, so the runner is pluggable: pass
+``_source`` (any object with ``extract(streams) -> iterable`` yielding
+Airbyte RECORD message dicts) to use an in-process source; otherwise the
+``airbyte_serverless`` package is required, matching the reference's local
+execution type."""
+
+from __future__ import annotations
+
+import os
+import time as time_mod
+from typing import Any, Sequence
+
+from pathway_tpu.engine.operators.core import InputNode
+from pathway_tpu.engine.value import hash_values
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._streams import BaseConnector
+
+
+def _make_serverless_source(config_file_path, streams, env_vars, enforce_method):
+    try:
+        import yaml
+        from airbyte_serverless.sources import DockerizedSource  # type: ignore
+    except ImportError as exc:
+        raise ImportError(
+            "pw.io.airbyte.read needs the airbyte-serverless package for "
+            "local/docker execution (or pass _source=... for an in-process "
+            "source)"
+        ) from exc
+    with open(config_file_path) as f:
+        config = yaml.safe_load(f)
+    source_config = config["source"]
+    return DockerizedSource(
+        connector=source_config["docker_image"],
+        config=source_config.get("config", {}),
+        streams=",".join(streams),
+    )
+
+
+class _AirbyteConnector(BaseConnector):
+    def __init__(self, node, source, streams: Sequence[str], mode: str,
+                 refresh_interval_ms: int):
+        super().__init__(node)
+        self.source = source
+        self.streams = list(streams)
+        self.mode = mode
+        self.refresh_interval = refresh_interval_ms / 1000.0
+        self._counter = 0
+        if mode != "static":
+            self.heartbeat_ms = 500
+
+    def _poll_once(self) -> list[tuple[int, tuple, int]]:
+        rows = []
+        for message in self.source.extract(self.streams):
+            record = message.get("record") if isinstance(message, dict) else None
+            if record is None:
+                continue
+            if self.streams and record.get("stream") not in self.streams:
+                continue
+            key = hash_values("airbyte", self._counter)
+            self._counter += 1
+            rows.append((key, (Json(record.get("data", {})),), 1))
+        return rows
+
+    def run(self) -> None:
+        rows = self._poll_once()
+        self.commit_rows(rows)
+        if self.mode == "static":
+            return
+        while not self.should_stop():
+            time_mod.sleep(self.refresh_interval)
+            rows = self._poll_once()
+            if rows:
+                self.commit_rows(rows)
+
+
+def read(
+    config_file_path: "os.PathLike | str" = "",
+    streams: Sequence[str] = (),
+    *,
+    execution_type: str = "local",
+    mode: str = "streaming",
+    env_vars: dict[str, str] | None = None,
+    service_user_credentials_file: str | None = None,
+    gcp_region: str = "europe-west1",
+    gcp_job_name: str | None = None,
+    enforce_method: str | None = None,
+    refresh_interval_ms: int = 60000,
+    persistent_id: str | None = None,
+    _source=None,
+) -> Table:
+    """Stream Airbyte RECORD messages of the selected ``streams`` into a
+    ``data: Json`` table (reference ``io/airbyte/__init__.py:107``)."""
+    if _source is None:
+        if execution_type != "local":
+            raise NotImplementedError(
+                "remote (GCP) Airbyte execution requires cloud access; use "
+                "execution_type='local' or pass _source=..."
+            )
+        _source = _make_serverless_source(
+            config_file_path, streams, env_vars, enforce_method
+        )
+    schema = schema_mod.schema_from_types(data=dt.JSON)
+    cols = list(schema.column_names())
+    node = InputNode(G.engine_graph, cols, name=f"airbyte({','.join(streams)})")
+    conn = _AirbyteConnector(node, _source, streams, mode, refresh_interval_ms)
+    G.register_connector(conn)
+    if persistent_id is not None:
+        from pathway_tpu.persistence import register_persistent_source
+
+        register_persistent_source(str(persistent_id), conn)
+    return Table(node, schema, Universe())

@@ -207,9 +207,130 @@ def rest_connector(
     return table, RestServerResponseWriter(conn)
 
 
+class RetryPolicy:
+    """Exponential-backoff retry schedule (reference ``io/http`` RetryPolicy)."""
+
+    def __init__(self, first_delay_ms: int = 1000, backoff_factor: float = 2.0,
+                 jitter_ms: int = 0):
+        self.first_delay_ms = first_delay_ms
+        self.backoff_factor = backoff_factor
+        self.jitter_ms = jitter_ms
+
+    @classmethod
+    def default(cls) -> "RetryPolicy":
+        return cls()
+
+    def delays_s(self, n_retries: int):
+        delay = self.first_delay_ms
+        for _ in range(n_retries):
+            yield delay / 1000.0
+            delay = delay * self.backoff_factor + self.jitter_ms
+
+
+def _urllib_sender(method: str, headers: dict, connect_timeout_ms: int | None,
+                   request_timeout_ms: int | None):
+    import urllib.request
+
+    timeout = (request_timeout_ms or connect_timeout_ms or 30000) / 1000.0
+
+    def send(url: str, payload: bytes) -> int:
+        req = urllib.request.Request(url, data=payload, method=method,
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status
+
+    return send
+
+
 def read(url: str, *args, **kwargs):
     raise NotImplementedError("streaming HTTP read requires network access")
 
 
-def write(table: Table, url: str, *args, **kwargs):
-    raise NotImplementedError("HTTP sink requires network access")
+def write(
+    table: Table,
+    url: str,
+    *,
+    method: str = "POST",
+    format: str = "json",  # noqa: A002 — reference keyword
+    n_retries: int = 0,
+    retry_policy: RetryPolicy | None = None,
+    connect_timeout_ms: int | None = None,
+    request_timeout_ms: int | None = None,
+    headers: dict | None = None,
+    _sender=None,
+) -> None:
+    """POST each change of ``table`` to ``url`` as JSON (row fields plus
+    ``time``/``diff``), with retry/backoff — reference ``pw.io.http.write``.
+    ``_sender(url, payload) -> status`` is injectable for offline tests."""
+    from pathway_tpu.engine.operators.output import SinkNode
+
+    if format != "json":
+        raise ValueError("pw.io.http.write supports format='json'")
+    policy = retry_policy or RetryPolicy.default()
+    hdrs = {"Content-Type": "application/json", **(headers or {})}
+    sender = _sender or _urllib_sender(
+        method, hdrs, connect_timeout_ms, request_timeout_ms
+    )
+    cols = table.column_names()
+
+    class _QueuedHttpWriter:
+        """Sends on a dedicated thread so retry backoff never stalls the
+        scheduler epoch loop (the reference runs writers on output joiner
+        threads, dataflow.rs:3579-3617). The first send failure (after
+        retries) is re-raised into the dataflow on the next batch or at
+        end-of-run flush."""
+
+        def __init__(self):
+            import queue
+
+            self._queue: queue.Queue = queue.Queue(maxsize=1024)
+            self._error: Exception | None = None
+            self._thread = threading.Thread(
+                target=self._loop, name=f"pathway-tpu:http-sink", daemon=True
+            )
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                body = self._queue.get()
+                if body is None:
+                    return
+                delays = policy.delays_s(n_retries)
+                while True:
+                    try:
+                        sender(url, body)
+                        break
+                    except Exception as exc:
+                        delay = next(delays, None)
+                        if delay is None:
+                            if self._error is None:
+                                self._error = exc
+                            break
+                        import time as time_mod
+
+                        time_mod.sleep(delay)
+
+        def _check(self):
+            if self._error is not None:
+                exc, self._error = self._error, None
+                raise exc
+
+        def __call__(self, time, batch):
+            self._check()
+            for _key, row, diff in batch.rows():
+                payload = {
+                    c: format_value_for_output(v) for c, v in zip(cols, row)
+                }
+                payload["time"] = time
+                payload["diff"] = diff
+                self._queue.put(json.dumps(payload).encode())
+
+        def finish(self):
+            self._queue.put(None)
+            self._thread.join(timeout=60)
+            self._check()
+
+    node = SinkNode(
+        G.engine_graph, table._node, _QueuedHttpWriter(), name=f"http({url})"
+    )
+    G.register_sink(node)

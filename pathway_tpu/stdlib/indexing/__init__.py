@@ -28,8 +28,18 @@ from pathway_tpu.stdlib.indexing.vector_document_index import (
 from pathway_tpu.stdlib.indexing.full_text_document_index import (
     default_full_text_document_index,
 )
+from pathway_tpu.stdlib.indexing.sorting import (
+    SortedIndex,
+    build_sorted_index,
+    retrieve_prev_next_values,
+    sort_from_index,
+)
 
 __all__ = [
+    "SortedIndex",
+    "build_sorted_index",
+    "retrieve_prev_next_values",
+    "sort_from_index",
     "DataIndex",
     "InnerIndex",
     "BruteForceKnn",

@@ -1,4 +1,8 @@
-"""pathway_tpu.xpacks — extension packs (LLM/RAG toolkit).
+"""pathway_tpu.xpacks — extension packs (LLM/RAG toolkit, enterprise connectors).
 
 Parity with reference ``python/pathway/xpacks/``.
 """
+
+from pathway_tpu.xpacks import connectors
+
+__all__ = ["connectors"]

@@ -1,0 +1,5 @@
+"""Enterprise connectors xpack (reference ``python/pathway/xpacks/connectors``)."""
+
+from pathway_tpu.xpacks.connectors import sharepoint
+
+__all__ = ["sharepoint"]

@@ -1,0 +1,54 @@
+"""CLI tests — spawn env contract (reference ``python/pathway/tests/cli/``)."""
+
+import subprocess
+import sys
+
+
+PRINT_ENV = (
+    "import os;"
+    "print(os.environ['PATHWAY_PROCESS_ID'], os.environ['PATHWAY_PROCESSES'],"
+    " os.environ['PATHWAY_THREADS'], os.environ['PATHWAY_FIRST_PORT'])"
+)
+
+
+def test_spawn_sets_topology_env(tmp_path):
+    script = tmp_path / "prog.py"
+    script.write_text(PRINT_ENV)
+    out = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu", "spawn", "-t", "2", "-n", "2",
+         "--first-port", "12345", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    lines = sorted(out.stdout.strip().splitlines())
+    assert lines == ["0 2 2 12345", "1 2 2 12345"]
+
+
+def test_spawn_record_flag(tmp_path):
+    script = tmp_path / "prog.py"
+    script.write_text(
+        "import os;"
+        "print(os.environ.get('PATHWAY_REPLAY_STORAGE'),"
+        " os.environ.get('PATHWAY_SNAPSHOT_ACCESS'))"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu", "spawn", "--record",
+         "--record-path", "recdir", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "recdir record"
+
+
+def test_spawn_from_env(tmp_path):
+    script = tmp_path / "prog.py"
+    script.write_text(PRINT_ENV)
+    out = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu", "spawn-from-env",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=60,
+        env={"PATHWAY_SPAWN_ARGS": "-t 3", "PATH": "/usr/bin:/bin",
+             "HOME": "/root"},
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "0 1 3 10000"

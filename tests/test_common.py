@@ -505,3 +505,20 @@ def test_cast_and_to_string():
     (row,) = rows.values()
     assert row[0] == 1.0 and isinstance(row[0], float)
     assert row[1] == "1"
+
+
+def test_table_split():
+    t = T(
+        """
+        label | outdegree
+        1     | 3
+        7     | 0
+        """
+    )
+    positive, negative = t.split(t.outdegree == 0)
+    from tests.utils import _capture_rows
+
+    pos_rows, _ = _capture_rows(positive)
+    neg_rows, _ = _capture_rows(negative)
+    assert list(pos_rows.values()) == [(7, 0)]
+    assert list(neg_rows.values()) == [(1, 3)]

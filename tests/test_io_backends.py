@@ -1,0 +1,273 @@
+"""Tests for the wider io backend set (reference python/pathway/io/):
+http/logstash/slack/bigquery/pubsub sinks with injected senders,
+pyfilesystem/gdrive object-store readers with fake providers, airbyte with an
+in-process source, redpanda/s3_csv aliases."""
+
+import datetime
+
+import pathway_tpu as pw
+
+from tests.utils import T, _capture_rows
+
+
+def _run_sinks():
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+
+def test_http_write_posts_json(monkeypatch):
+    sent = []
+    t = T(
+        """
+        a | b
+        1 | x
+        2 | y
+        """
+    )
+    pw.io.http.write(
+        t, "http://example.invalid/sink", _sender=lambda url, body: sent.append((url, body))
+    )
+    _run_sinks()
+    assert len(sent) == 2
+    import json
+
+    payloads = sorted((json.loads(b) for _u, b in sent), key=lambda p: p["a"])
+    assert payloads[0]["a"] == 1 and payloads[0]["b"] == "x"
+    assert payloads[0]["diff"] == 1 and "time" in payloads[0]
+
+
+def test_http_write_retries_then_raises():
+    calls = []
+
+    def flaky(url, body):
+        calls.append(1)
+        raise ConnectionError("down")
+
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    pw.io.http.write(
+        t,
+        "http://example.invalid/sink",
+        n_retries=2,
+        retry_policy=pw.io.http.RetryPolicy(first_delay_ms=1),
+        _sender=flaky,
+    )
+    try:
+        _run_sinks()
+        raised = False
+    except Exception:
+        raised = True
+    assert raised and len(calls) == 3
+
+
+def test_logstash_write_delegates():
+    sent = []
+    t = T(
+        """
+        a
+        5
+        """
+    )
+    pw.io.logstash.write(
+        t, "http://logstash.invalid:8080", _sender=lambda u, b: sent.append(u)
+    )
+    _run_sinks()
+    assert sent == ["http://logstash.invalid:8080"]
+
+
+def test_slack_send_alerts():
+    sent = []
+    t = T(
+        """
+        message
+        alert-1
+        alert-2
+        """
+    )
+    pw.io.slack.send_alerts(
+        t.message, "C000", "xoxb-token", _sender=lambda p: sent.append(p)
+    )
+    _run_sinks()
+    assert sorted(p["text"] for p in sent) == ["alert-1", "alert-2"]
+    assert all(p["channel"] == "C000" for p in sent)
+
+
+def test_bigquery_write_inserts_rows():
+    inserted = []
+
+    class FakeClient:
+        def insert_rows_json(self, table_ref, rows):
+            inserted.append((table_ref, rows))
+            return []
+
+    t = T(
+        """
+        a | b
+        1 | u
+        """
+    )
+    pw.io.bigquery.write(t, "animals", "measurements", _client=FakeClient())
+    _run_sinks()
+    assert inserted[0][0] == "animals.measurements"
+    (row,) = inserted[0][1]
+    assert row["a"] == 1 and row["b"] == "u" and row["diff"] == 1
+
+
+def test_pubsub_write_publishes_binary():
+    published = []
+
+    class FakePublisher:
+        def topic_path(self, project, topic):
+            return f"projects/{project}/topics/{topic}"
+
+        def publish(self, path, data, **attrs):
+            published.append((path, data, attrs))
+
+    t = T(
+        """
+        data
+        payload
+        """
+    )
+    pw.io.pubsub.write(t, FakePublisher(), "proj", "blobs")
+    _run_sinks()
+    (path, data, attrs) = published[0]
+    assert path == "projects/proj/topics/blobs"
+    assert data == b"payload"
+    assert attrs["pathway_diff"] == "1"
+
+
+class FakeFS:
+    """Minimal PyFilesystem duck-type."""
+
+    class _Info:
+        def __init__(self, name, modified, size):
+            self.name = name
+            self.modified = modified
+            self.size = size
+
+    def __init__(self, files: dict[str, bytes]):
+        self.files = dict(files)
+
+    class _Walk:
+        def __init__(self, outer):
+            self.outer = outer
+
+        def files(self, path):
+            return [p for p in self.outer.files if p.startswith(path.rstrip("/"))]
+
+    @property
+    def walk(self):
+        return FakeFS._Walk(self)
+
+    def getinfo(self, path, namespaces=None):
+        data = self.files[path]
+        return FakeFS._Info(
+            path.rsplit("/", 1)[-1],
+            datetime.datetime(2026, 1, 1),
+            len(data),
+        )
+
+    def readbytes(self, path):
+        return self.files[path]
+
+
+def test_pyfilesystem_read_static():
+    source = FakeFS({"/docs/a.txt": b"hello", "/docs/b.txt": b"world"})
+    t = pw.io.pyfilesystem.read(source, path="/docs", mode="static", with_metadata=True)
+    rows, cols = _capture_rows(t)
+    datas = sorted(row[cols.index("data")] for row in rows.values())
+    assert datas == [b"hello", b"world"]
+    meta = next(iter(rows.values()))[cols.index("_metadata")]
+    assert meta["size"] in (5, 5)
+
+
+class FakeDrive:
+    def __init__(self):
+        self.files = {
+            "id1": {"id": "id1", "name": "doc.txt", "mimeType": "text/plain",
+                    "modifiedTime": "2026-01-01T00:00:00Z", "size": "5"},
+            "id2": {"id": "id2", "name": "big.bin", "mimeType": "application/pdf",
+                    "modifiedTime": "2026-01-01T00:00:00Z", "size": "99999"},
+        }
+
+    def list_files(self, object_id):
+        return list(self.files.values())
+
+    def download(self, file_id):
+        return b"x" * int(self.files[file_id]["size"])
+
+
+def test_gdrive_read_with_size_limit_and_pattern():
+    t = pw.io.gdrive.read(
+        "folder-id",
+        mode="static",
+        object_size_limit=1000,
+        with_metadata=True,
+        file_name_pattern="*.txt",
+        _client=FakeDrive(),
+    )
+    rows, cols = _capture_rows(t)
+    assert len(rows) == 1
+    (row,) = rows.values()
+    assert row[cols.index("data")] == b"xxxxx"
+    assert row[cols.index("_metadata")]["name"] == "doc.txt"
+
+
+class FakeAirbyteSource:
+    def extract(self, streams):
+        return [
+            {"record": {"stream": "users", "data": {"id": 1, "name": "ann"}}},
+            {"record": {"stream": "users", "data": {"id": 2, "name": "bob"}}},
+            {"record": {"stream": "other", "data": {"id": 3}}},
+            {"state": {}},
+        ]
+
+
+def test_airbyte_read_records():
+    t = pw.io.airbyte.read(streams=["users"], mode="static", _source=FakeAirbyteSource())
+    rows, cols = _capture_rows(t)
+    from pathway_tpu.internals.json import unwrap_json
+
+    names = sorted(unwrap_json(row[0])["name"] for row in rows.values())
+    assert names == ["ann", "bob"]
+
+
+def test_redpanda_is_kafka_alias():
+    assert pw.io.redpanda.read is pw.io.kafka.read
+    assert pw.io.redpanda.write is pw.io.kafka.write
+
+
+def test_s3_csv_read(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("a,b\n1,x\n2,y\n")
+    t = pw.io.s3_csv.read(
+        str(tmp_path), schema=pw.schema_from_types(a=int, b=str), mode="static"
+    )
+    rows, cols = _capture_rows(t)
+    assert sorted(rows.values()) == [(1, "x"), (2, "y")]
+
+
+class FakeSharePoint:
+    def list_files(self, root_path, recursive):
+        return [
+            {"path": "/sites/docs/a.pdf", "name": "a.pdf",
+             "modified_at": "2026-01-01", "size": 3},
+        ]
+
+    def download(self, path):
+        return b"pdf"
+
+
+def test_sharepoint_read():
+    from pathway_tpu.xpacks.connectors import sharepoint
+
+    t = sharepoint.read(root_path="/sites/docs", mode="static",
+                        with_metadata=True, _client=FakeSharePoint())
+    rows, cols = _capture_rows(t)
+    (row,) = rows.values()
+    assert row[cols.index("data")] == b"pdf"
+    assert row[cols.index("_metadata")]["name"] == "a.pdf"

@@ -1,0 +1,66 @@
+"""Observability tests — probes, dashboard renderer, Prometheus endpoint
+(reference: src/engine/progress_reporter.rs, http_server.rs,
+internals/monitoring.py)."""
+
+import urllib.request
+
+import pathway_tpu as pw
+from pathway_tpu.engine.probes import SchedulerStats
+from pathway_tpu.internals import run as run_mod
+from pathway_tpu.internals.http_server import MetricsServer, metrics_from_stats
+from pathway_tpu.internals.monitoring import MonitoringLevel, StatsMonitor
+
+from tests.utils import T, _capture_rows
+
+
+def test_scheduler_collects_operator_stats():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    result = t.select(c=pw.this.a + pw.this.b)
+    rows, _ = _capture_rows(result)
+    assert len(rows) == 2
+    snap = run_mod.LAST_RUN_STATS.snapshot()
+    assert snap["epochs_total"] >= 1
+    assert any(op["rows_out"] >= 2 for op in snap["operators"])
+    assert snap["finished"]
+
+
+def test_metrics_text_format():
+    stats = SchedulerStats()
+    stats.record_step(1, "select", 10, 10, 0.001)
+    stats.record_connector_commit(99, "CsvReader[input]", 42)
+    text = metrics_from_stats(stats.snapshot())
+    assert "# TYPE pathway_logical_time gauge" in text
+    assert 'pathway_operator_rows_in_total{operator="select"} 10' in text
+    assert 'pathway_connector_rows_read_total{connector="CsvReader[input]"} 42' in text
+    assert 'pathway_connector_commits_total{connector="CsvReader[input]"} 1' in text
+
+
+def test_metrics_http_endpoint():
+    stats = SchedulerStats()
+    stats.record_step(7, "reduce", 5, 1, 0.002)
+    server = MetricsServer(stats, port=0)  # ephemeral port
+    server.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5
+        ).read().decode()
+        assert 'pathway_operator_rows_out_total{operator="reduce"} 1' in body
+    finally:
+        server.stop()
+
+
+def test_stats_monitor_renders():
+    stats = SchedulerStats()
+    stats.record_step(1, "input:csv", 3, 3, 0.0)
+    stats.record_step(2, "select", 3, 3, 0.0)
+    monitor = StatsMonitor(stats, MonitoringLevel.ALL)
+    table = monitor._render()
+    assert table.row_count == 2
+    monitor_inout = StatsMonitor(stats, MonitoringLevel.IN_OUT)
+    assert monitor_inout._render().row_count == 1

@@ -1,0 +1,132 @@
+"""Sorted-index stdlib tests (reference stdlib/indexing/sorting.py)."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing import (
+    build_sorted_index,
+    retrieve_prev_next_values,
+    sort_from_index,
+)
+
+from tests.utils import T, _capture_rows
+
+
+def _key_of(table):
+    rows, cols = _capture_rows(table)
+    return {k: v[cols.index("key")] for k, v in rows.items()}
+
+
+def test_build_sorted_index_is_valid_bst():
+    t = T(
+        """
+        key | instance
+        3.0 | 0
+        1.0 | 0
+        2.0 | 0
+        5.0 | 1
+        4.0 | 1
+        """
+    )
+    result = build_sorted_index(t)
+    rows, cols = _capture_rows(result["index"])
+    ki, li, ri, pi, ii = (cols.index(c) for c in ("key", "left", "right", "parent", "instance"))
+    for k, row in rows.items():
+        if row[li] is not None:
+            child = rows[row[li].value]
+            assert child[ki] < row[ki] and child[ii] == row[ii]
+            assert child[pi].value == k
+        if row[ri] is not None:
+            child = rows[row[ri].value]
+            assert child[ki] > row[ki] and child[ii] == row[ii]
+            assert child[pi].value == k
+    oracle_rows, oracle_cols = _capture_rows(result["oracle"])
+    roots = {row[oracle_cols.index("instance")] for row in oracle_rows.values()}
+    assert roots == {0, 1}
+    for row in oracle_rows.values():
+        root = rows[row[oracle_cols.index("root")].value]
+        assert root[pi] is None
+
+
+def test_sort_from_index_inorder():
+    t = T(
+        """
+        key
+        3.0
+        1.0
+        4.0
+        2.0
+        5.0
+        """
+    )
+    result = build_sorted_index(t)
+    pn = sort_from_index(result["index"])
+    rows, _ = _capture_rows(pn)
+    key_rows, key_cols = _capture_rows(t)
+    key_of = {k: v[key_cols.index("key")] for k, v in key_rows.items()}
+    heads = [k for k, (p, n) in rows.items() if p is None]
+    assert len(heads) == 1
+    order, k = [], heads[0]
+    while k is not None:
+        order.append(key_of[k])
+        nxt = rows[k][1]
+        k = nxt.value if nxt is not None else None
+    assert order == sorted(order) and len(order) == 5
+
+
+def test_retrieve_prev_next_values_skips_nones():
+    t = T(
+        """
+        a | v
+        1 | 10
+        2 |
+        3 |
+        4 | 40
+        """
+    )
+    srt = t.sort(pw.this.a)
+    ordered = t.select(prev=srt.prev, next=srt.next, value=pw.this.v)
+    res = retrieve_prev_next_values(ordered)
+    rows, _ = _capture_rows(res)
+    a_rows, a_cols = _capture_rows(t)
+    by_a = {v[a_cols.index("a")]: rows[k] for k, v in a_rows.items()}
+    assert by_a[1] == (10, 10)
+    assert by_a[2] == (10, 40)
+    assert by_a[3] == (10, 40)
+    assert by_a[4] == (40, 40)
+
+
+def test_retrieve_prev_next_values_explicit_column():
+    t = T(
+        """
+        a | metric
+        1 | 7
+        2 |
+        """
+    )
+    srt = t.sort(pw.this.a)
+    ordered = t.select(prev=srt.prev, next=srt.next, metric=pw.this.metric)
+    res = retrieve_prev_next_values(ordered, value=ordered.metric)
+    rows, _ = _capture_rows(res)
+    a_rows, a_cols = _capture_rows(t)
+    by_a = {v[a_cols.index("a")]: rows[k] for k, v in a_rows.items()}
+    assert by_a[2] == (7, None)
+
+
+def test_sorted_index_incremental_update():
+    """Streaming insert keeps the BST contract (recompute-and-diff path)."""
+    import pathway_tpu.io.python as pw_python
+
+    class Subject(pw_python.ConnectorSubject):
+        def run(self):
+            for key in [3.0, 1.0, 2.0]:
+                self.next(key=key, instance=0)
+                self.commit()
+
+    t = pw_python.read(
+        Subject(), schema=pw.schema_from_types(key=float, instance=int)
+    )
+    result = build_sorted_index(t)
+    pn = sort_from_index(result["index"])
+    rows, _ = _capture_rows(pn)
+    assert len(rows) == 3
+    heads = [k for k, (p, n) in rows.items() if p is None]
+    assert len(heads) == 1

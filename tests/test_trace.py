@@ -1,0 +1,64 @@
+"""User-frame tracing tests (reference internals/trace.py +
+graph_runner error re-attribution)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.trace import Trace, capture_trace, trace_user_frame
+
+from tests.utils import T, _capture_rows
+
+
+def test_capture_trace_points_at_this_file():
+    trace = capture_trace(skip=1)
+    assert trace.user_frame is not None
+    assert trace.user_frame.filename.endswith("test_trace.py")
+    assert "test_capture_trace_points_at_this_file" in trace.user_frame.function
+
+
+def test_nodes_carry_user_trace():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    result = t.select(b=pw.this.a + 1)
+    trace = result._node.trace
+    assert trace is not None and trace.user_frame is not None
+    assert trace.user_frame.filename.endswith("test_trace.py")
+
+
+def test_engine_error_points_at_user_line(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TERMINATE_ON_ERROR", "1")
+    t = T(
+        """
+        a
+        1
+        """
+    )
+
+    def boom(x):
+        raise RuntimeError("boom")
+
+    result = t.select(b=pw.apply(boom, pw.this.a))
+    with pytest.raises(Exception) as excinfo:
+        _capture_rows(result)
+    assert "test_trace.py" in str(excinfo.value) or "boom" in str(excinfo.value)
+
+
+def test_trace_user_frame_decorator():
+    @trace_user_frame
+    def fails():
+        raise ValueError("inner")
+
+    with pytest.raises(ValueError) as excinfo:
+        fails()
+    assert "called in" in str(excinfo.value)
+    assert "test_trace.py" in str(excinfo.value)
+
+
+def test_trace_message_includes_source_line():
+    trace = capture_trace(skip=1)  # THIS-MARKER
+    assert "THIS-MARKER" in trace.user_frame.line
+    assert "test_trace.py" in trace.message()
