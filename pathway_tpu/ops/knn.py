@@ -53,19 +53,57 @@ def knn_scores(corpus, valid_mask, queries, metric: str):
     return jnp.where(valid_mask[None, :], scores, _NEG_INF)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric"))
-def _search_kernel(corpus, valid_mask, queries, k: int, metric: str):
-    return jax.lax.top_k(knn_scores(corpus, valid_mask, queries, metric), k)
+def _normalize(v):
+    """Device-side unit-normalise (zero vectors map to ~0, not NaN)."""
+    return v / jnp.clip(jnp.linalg.norm(v, axis=1, keepdims=True), 1e-9, None)
 
 
-def _use_pallas(capacity: int) -> bool:
-    """The fused Pallas kernel pays off once the (Q, N) score matrix would be
-    HBM-traffic-bound; below that XLA's fused gemm+top_k is fine. TPU only."""
+@functools.partial(
+    jax.jit, static_argnames=("k", "metric", "normalize", "bucket")
+)
+def _search_kernel(corpus, valid_mask, queries, k: int, metric: str,
+                   normalize: bool = False, bucket: int = 0):
+    """One fused dispatch for the whole search: cast, normalise (optional),
+    pad the query axis to ``bucket`` rows, gemm + top_k."""
+    q = queries.astype(jnp.float32)
+    if normalize:
+        q = _normalize(q)
+    if bucket > q.shape[0]:
+        q = jnp.pad(q, ((0, bucket - q.shape[0]), (0, 0)))
+    return jax.lax.top_k(knn_scores(corpus, valid_mask, q, metric), k)
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0, 1), static_argnames=("normalize",)
+)
+def _append_kernel(corpus, valid, v, start, normalize: bool):
+    """One fused dispatch for the whole append: normalise (optional), cast,
+    and write both the corpus rows and the valid flags. Donating corpus/valid
+    makes the update in-place in HBM; a single dispatch also matters on a
+    tunneled host where every eager op is a round trip."""
+    v = v.astype(jnp.float32)
+    if normalize:
+        v = _normalize(v)
+    corpus = jax.lax.dynamic_update_slice(
+        corpus, v.astype(corpus.dtype), (start, 0)
+    )
+    valid = jax.lax.dynamic_update_slice(
+        valid, jnp.ones((v.shape[0],), dtype=bool), (start,)
+    )
+    return corpus, valid
+
+
+def _use_pallas() -> bool:
+    """Opt-in only. Measured head-to-head on v5e (bf16 corpus, d=128, k=10):
+    XLA's fused gemm+top_k beats the Pallas kernel at every shape tried —
+    6.7ms vs 7.9ms at N=131072/Q=16, 14ms vs 72ms at N=262144/Q=256 — because
+    the kernel's k-round masked-max selection is VPU-bound and rescans the
+    whole (q_tile, tile) score block k times. The kernel's one-pass HBM
+    traffic only wins if that selection gets ~10x cheaper; until then it
+    stays available for experiments via PATHWAY_FORCE_PALLAS=1. TPU only."""
     import os
 
-    if os.environ.get("PATHWAY_DISABLE_PALLAS"):
-        return False
-    if capacity < 8192:
+    if not os.environ.get("PATHWAY_FORCE_PALLAS"):
         return False
     try:
         return jax.default_backend() == "tpu"
@@ -122,16 +160,15 @@ class BruteForceKnnIndex:
             v = v / norms
         return v
 
-    def _append(self, keys: list, v) -> None:
-        """Shared append: v is an already-normalised (m, d) device array."""
+    def _append(self, keys: list, v, normalize: bool) -> None:
+        """Shared append: v is a (m, d) array; normalised on device iff
+        ``normalize`` (host callers pre-normalise in _prep)."""
         m = len(keys)
         self._grow(self.n + m)
         start = self.n
-        self._corpus = jax.lax.dynamic_update_slice(
-            self._corpus, v.astype(self.dtype), (start, 0)
-        )
-        self._valid = jax.lax.dynamic_update_slice(
-            self._valid, jnp.ones((m,), dtype=bool), (start,)
+        self._corpus, self._valid = _append_kernel(
+            self._corpus, self._valid, jnp.asarray(v),
+            jnp.int32(start), normalize=normalize,
         )
         for i, key in enumerate(keys):
             self._slot_of[key] = start + i
@@ -141,19 +178,17 @@ class BruteForceKnnIndex:
     def add(self, keys: list, vectors: np.ndarray) -> None:
         if not keys:
             return
-        self._append(keys, jnp.asarray(self._prep(vectors)))
+        self._append(keys, self._prep(vectors), normalize=False)
 
     def add_device(self, keys: list, vectors) -> None:
         """Fast path: vectors already on device (e.g. straight out of the
         embedder) — normalise and append without a host round-trip."""
         if not keys:
             return
-        v = jnp.asarray(vectors, dtype=jnp.float32)
+        v = jnp.asarray(vectors)
         if v.ndim == 1:
             v = v[None, :]
-        if self.metric == "cos":
-            v = v / jnp.clip(jnp.linalg.norm(v, axis=1, keepdims=True), 1e-9, None)
-        self._append(keys, v)
+        self._append(keys, v, normalize=self.metric == "cos")
 
     def remove(self, keys: list) -> None:
         for key in keys:
@@ -172,34 +207,44 @@ class BruteForceKnnIndex:
             self.n -= 1
 
     # ------------------------------------------------------------------ search
-    def search(self, queries: np.ndarray, k: int) -> list[list[tuple[Any, float]]]:
-        """Return per-query [(key, score)] sorted by decreasing score."""
-        if self.n == 0:
-            q = np.asarray(queries)
-            nq = 1 if q.ndim == 1 else len(q)
-            return [[] for _ in range(nq)]
-        q = self._prep(queries)
-        nq = len(q)
+    def search_device(self, queries, k: int):
+        """Dispatch-only search: queries may live on device (straight out of
+        the embedder); returns device ``(scores (Qb,k), idx (Qb,k))`` with the
+        query axis padded to its pow2 bucket. No host synchronisation — a
+        streaming pipeline can dispatch many searches and drain results with
+        one ``jax.device_get`` (device→host fetches dominate end-to-end
+        latency when the host is remote from the chip)."""
+        q = jnp.asarray(queries)
+        if q.ndim == 1:
+            q = q[None, :]
+        nq = q.shape[0]
         bucket = next_pow2(nq, 16)
-        if bucket > nq:
-            q = np.concatenate([q, np.zeros((bucket - nq, self.dim), np.float32)])
         k_eff = min(k, self.capacity)
-        if _use_pallas(self.capacity):
+        normalize = self.metric == "cos"
+        if _use_pallas():
             from pathway_tpu.ops.pallas_knn import fused_topk
 
-            scores, idx = fused_topk(
-                self._corpus, self._valid, jnp.asarray(q), k_eff, self.metric
-            )
+            q = q.astype(jnp.float32)
+            if normalize:
+                q = _normalize(q)
+            if bucket > nq:
+                q = jnp.pad(q, ((0, bucket - nq), (0, 0)))
+            scores, idx = fused_topk(self._corpus, self._valid, q, k_eff,
+                                     self.metric)
         else:
-            scores, idx = _search_kernel(
-                self._corpus, self._valid, jnp.asarray(q), k_eff, self.metric
-            )
+            scores, idx = _search_kernel(self._corpus, self._valid, q, k_eff,
+                                         self.metric, normalize=normalize,
+                                         bucket=bucket)
+        return scores, idx
+
+    def resolve(self, scores, idx, nq: int, k: int) -> list[list[tuple[Any, float]]]:
+        """Map fetched (host) score/index arrays back to [(key, score)] rows."""
         scores = np.asarray(scores)[:nq]
         idx = np.asarray(idx)[:nq]
         out = []
         for qi in range(nq):
             row = []
-            for j in range(k_eff):
+            for j in range(scores.shape[1]):
                 s = float(scores[qi, j])
                 if s <= _NEG_INF / 2:
                     break
@@ -208,6 +253,17 @@ class BruteForceKnnIndex:
                     row.append((self._keys[slot], s))
             out.append(row)
         return out
+
+    def search(self, queries: np.ndarray, k: int) -> list[list[tuple[Any, float]]]:
+        """Return per-query [(key, score)] sorted by decreasing score."""
+        if not isinstance(queries, (np.ndarray, jax.Array)):
+            queries = np.asarray(queries)
+        nq = 1 if queries.ndim == 1 else queries.shape[0]
+        if self.n == 0:
+            return [[] for _ in range(nq)]
+        # one round trip for both result arrays
+        scores, idx = jax.device_get(self.search_device(queries, k))
+        return self.resolve(scores, idx, nq, k)
 
     def __len__(self) -> int:
         return self.n

@@ -29,8 +29,8 @@ _NEG_INF = -1e30
 def _make_kernel(k: int, metric: str, tile: int, q_rows: int):
     def kernel(q_ref, c_ref, v_ref, out_vals_ref, out_idx_ref,
                acc_vals_ref, acc_idx_ref):
-        step = pl.program_id(0)
-        nsteps = pl.num_programs(0)
+        step = pl.program_id(1)
+        nsteps = pl.num_programs(1)
 
         @pl.when(step == 0)
         def _init():
@@ -62,19 +62,32 @@ def _make_kernel(k: int, metric: str, tile: int, q_rows: int):
         cand_idx = jnp.concatenate([acc_idx_ref[:], tile_idx], axis=1)
         width = k + tile
         col = jax.lax.broadcasted_iota(jnp.int32, (q_rows, width), 1)
+        col_k = jax.lax.broadcasted_iota(jnp.int32, (q_rows, k), 1)
 
-        new_vals = []
-        new_idx = []
-        for _ in range(k):
-            m = jnp.max(cand_vals, axis=1, keepdims=True)           # (Q,1)
-            is_max = cand_vals == m
+        # k rounds of masked max, as a fori_loop so the (Q, k+tile) candidate
+        # buffer is carried (reused) rather than unrolled k times — the
+        # unrolled form blows the 16M scoped-VMEM stack at tile=2048, k=10.
+        def round_body(j, carry):
+            cand, out_v, out_i = carry
+            m = jnp.max(cand, axis=1, keepdims=True)                # (Q,1)
+            is_max = cand == m
             pos = jnp.min(jnp.where(is_max, col, width), axis=1, keepdims=True)
             sel = col == pos
-            new_vals.append(m[:, 0])
-            new_idx.append(jnp.sum(jnp.where(sel, cand_idx, 0), axis=1))
-            cand_vals = jnp.where(sel, _NEG_INF, cand_vals)
-        acc_vals_ref[:] = jnp.stack(new_vals, axis=1)
-        acc_idx_ref[:] = jnp.stack(new_idx, axis=1).astype(jnp.int32)
+            midx = jnp.sum(jnp.where(sel, cand_idx, 0), axis=1, keepdims=True)
+            slot = col_k == j                                       # (Q,k)
+            out_v = jnp.where(slot, m, out_v)
+            out_i = jnp.where(slot, midx, out_i)
+            cand = jnp.where(sel, _NEG_INF, cand)
+            return cand, out_v, out_i
+
+        _, new_vals, new_idx = jax.lax.fori_loop(
+            0, k, round_body,
+            (cand_vals,
+             jnp.full((q_rows, k), _NEG_INF, jnp.float32),
+             jnp.zeros((q_rows, k), jnp.int32)),
+        )
+        acc_vals_ref[:] = new_vals
+        acc_idx_ref[:] = new_idx
 
         @pl.when(step == nsteps - 1)
         def _emit():
@@ -84,6 +97,14 @@ def _make_kernel(k: int, metric: str, tile: int, q_rows: int):
     return kernel
 
 
+# Max query rows resident in one kernel instance. The selection loop's wide
+# (q_tile, k+tile) temporaries consume vector registers proportional to
+# q_tile x tile; q_tile=128 at tile=2048 spills ~129MB of scoped VMEM and
+# fails to compile on v5e, while 16/32/64 all compile and run within 1% of
+# each other (measured N=262144, Q=256).
+_Q_TILE = 64
+
+
 @functools.partial(
     jax.jit, static_argnames=("k", "metric", "tile", "interpret")
 )
@@ -91,34 +112,45 @@ def fused_topk(corpus, valid, queries, k: int, metric: str = "cos",
                tile: int = 2048, interpret: bool = False):
     """corpus (N, d) bf16, valid (N,) bool, queries (Q, d) f32 →
     (scores (Q, k) f32, indices (Q, k) i32). N must be a multiple of
-    ``tile`` (the index pads its capacity to pow2, so it is)."""
+    ``tile`` (the index pads its capacity to pow2, so it is). The query
+    axis is tiled over the grid in blocks of ``_Q_TILE``."""
     n, d = corpus.shape
     q_rows = queries.shape[0]
     tile = min(tile, n)
     assert n % tile == 0, (n, tile)
-    grid = (n // tile,)
-    kernel = _make_kernel(k, metric, tile, q_rows)
+    q_tile = min(_Q_TILE, q_rows)
+    pad = (-q_rows) % q_tile
+    if pad:
+        queries = jnp.concatenate(
+            [queries, jnp.zeros((pad, d), queries.dtype)]
+        )
+    q_padded = q_rows + pad
+    grid = (q_padded // q_tile, n // tile)
+    kernel = _make_kernel(k, metric, tile, q_tile)
     out_vals, out_idx = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((q_rows, d), lambda i: (0, 0)),
-            pl.BlockSpec((tile, d), lambda i: (i, 0)),
-            pl.BlockSpec((tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((q_tile, d), lambda qi, i: (qi, 0)),
+            pl.BlockSpec((tile, d), lambda qi, i: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda qi, i: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((q_rows, k), lambda i: (0, 0)),
-            pl.BlockSpec((q_rows, k), lambda i: (0, 0)),
+            pl.BlockSpec((q_tile, k), lambda qi, i: (qi, 0)),
+            pl.BlockSpec((q_tile, k), lambda qi, i: (qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((q_rows, k), jnp.float32),
-            jax.ShapeDtypeStruct((q_rows, k), jnp.int32),
+            jax.ShapeDtypeStruct((q_padded, k), jnp.float32),
+            jax.ShapeDtypeStruct((q_padded, k), jnp.int32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((q_rows, k), jnp.float32),
-            pltpu.VMEM((q_rows, k), jnp.int32),
+            pltpu.VMEM((q_tile, k), jnp.float32),
+            pltpu.VMEM((q_tile, k), jnp.int32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
         interpret=interpret,
     )(queries.astype(jnp.float32), corpus,
       valid.astype(jnp.int32).reshape(-1, 1))
-    return out_vals, out_idx
+    return out_vals[:q_rows], out_idx[:q_rows]

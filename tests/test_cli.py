@@ -4,10 +4,13 @@ import subprocess
 import sys
 
 
+# single os.write so concurrent workers can't interleave mid-line on the
+# shared stdout pipe (atomic for writes < PIPE_BUF)
 PRINT_ENV = (
     "import os;"
-    "print(os.environ['PATHWAY_PROCESS_ID'], os.environ['PATHWAY_PROCESSES'],"
-    " os.environ['PATHWAY_THREADS'], os.environ['PATHWAY_FIRST_PORT'])"
+    "os.write(1, (' '.join([os.environ['PATHWAY_PROCESS_ID'],"
+    " os.environ['PATHWAY_PROCESSES'], os.environ['PATHWAY_THREADS'],"
+    " os.environ['PATHWAY_FIRST_PORT']]) + '\\n').encode())"
 )
 
 

@@ -188,16 +188,28 @@ def test_pallas_fused_topk_matches_xla():
     from pathway_tpu.ops.pallas_knn import fused_topk
 
     rng = np.random.default_rng(7)
-    N, d, Q, K = 256, 32, 8, 4
+    # Q=8: single q-tile, no padding. Q=80: multiple q-tiles + nonzero pad
+    # (exercises the per-q-tile block index maps and scratch re-init).
+    import pathway_tpu.ops.pallas_knn as pallas_knn
+
+    N, d, K = 256, 32, 4
     corpus = jnp.asarray(rng.normal(size=(N, d)), dtype=jnp.bfloat16)
     valid = np.ones(N, bool)
     valid[50:60] = False
-    q = jnp.asarray(rng.normal(size=(Q, d)).astype(np.float32))
-    for metric in ("cos", "l2"):
-        vals, idx = fused_topk(
-            corpus, jnp.asarray(valid), q, K, metric, tile=64, interpret=True
-        )
-        ref = np.asarray(knn_scores(corpus, jnp.asarray(valid), q, metric))
-        ref_idx = np.argsort(-ref, axis=1)[:, :K]
-        for i in range(Q):
-            assert set(np.asarray(idx)[i]) == set(ref_idx[i])
+    for Q, q_tile in ((8, 64), (80, 32)):
+        q = jnp.asarray(rng.normal(size=(Q, d)).astype(np.float32))
+        old_q_tile = pallas_knn._Q_TILE
+        pallas_knn._Q_TILE = q_tile
+        try:
+            for metric in ("cos", "l2"):
+                vals, idx = fused_topk(
+                    corpus, jnp.asarray(valid), q, K, metric, tile=64,
+                    interpret=True,
+                )
+                assert idx.shape == (Q, K)
+                ref = np.asarray(knn_scores(corpus, jnp.asarray(valid), q, metric))
+                ref_idx = np.argsort(-ref, axis=1)[:, :K]
+                for i in range(Q):
+                    assert set(np.asarray(idx)[i]) == set(ref_idx[i])
+        finally:
+            pallas_knn._Q_TILE = old_q_tile
