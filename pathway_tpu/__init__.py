@@ -79,7 +79,17 @@ from pathway_tpu.internals.config import set_license_key, set_monitoring_config
 from pathway_tpu import debug  # noqa: E402
 from pathway_tpu import io  # noqa: E402
 from pathway_tpu import persistence  # noqa: E402
-from pathway_tpu.stdlib import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils  # noqa: E402
+from pathway_tpu.stdlib import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils, viz  # noqa: E402
+from pathway_tpu.internals.interactive import LiveTable  # noqa: E402
+from pathway_tpu.internals.row_transformer import (  # noqa: E402
+    ClassArg,
+    attribute,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer  # noqa: E402
 from pathway_tpu.internals.sql import sql  # noqa: E402

@@ -131,8 +131,12 @@ def _layer_norm(x, scale, bias, eps):
     return y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
 
 
-def _attention(x, lp, mask_bias, cfg: TransformerConfig):
-    """x: (B, S, H) in compute dtype; lp: one layer's param slice."""
+def _attention(x, lp, mask_bias, cfg: TransformerConfig, core=None):
+    """x: (B, S, H) in compute dtype; lp: one layer's param slice.
+
+    ``core(q, k, v) -> (B, nh, S, hd) f32`` swaps the dense softmax-attention
+    inner for an alternative (the sequence-parallel ring core in
+    ``parallel/ring_attention.py``); it owns scaling and masking."""
     B, S, H = x.shape
     nh, hd = cfg.heads, cfg.head_dim
     qkv = jnp.einsum("bsh,hk->bsk", x, lp["qkv_w"].astype(cfg.dtype),
@@ -142,20 +146,23 @@ def _attention(x, lp, mask_bias, cfg: TransformerConfig):
     q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
-    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
-                        preferred_element_type=jnp.float32)
-    scores = scores / math.sqrt(hd) + mask_bias
-    probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-    ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, v,
-                     preferred_element_type=jnp.float32).astype(cfg.dtype)
+    if core is not None:
+        ctx = core(q, k, v).astype(cfg.dtype)
+    else:
+        scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores / math.sqrt(hd) + mask_bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, v,
+                         preferred_element_type=jnp.float32).astype(cfg.dtype)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H)
     out = jnp.einsum("bsh,hk->bsk", ctx, lp["attn_out_w"].astype(cfg.dtype),
                      preferred_element_type=jnp.float32)
     return out + lp["attn_out_b"].astype(jnp.float32)
 
 
-def _layer(x, lp, mask_bias, cfg: TransformerConfig):
-    attn = _attention(x, lp, mask_bias, cfg)
+def _layer(x, lp, mask_bias, cfg: TransformerConfig, core=None):
+    attn = _attention(x, lp, mask_bias, cfg, core=core)
     x = _layer_norm(x.astype(jnp.float32) + attn, lp["ln1_scale"],
                     lp["ln1_bias"], cfg.layer_norm_eps).astype(cfg.dtype)
     h = jnp.einsum("bsh,hi->bsi", x, lp["mlp_in_w"].astype(cfg.dtype),

@@ -22,6 +22,10 @@ from pathway_tpu.parallel.distributed import (
     DistributedConfig,
     initialize_distributed,
 )
+from pathway_tpu.parallel.ring_attention import (
+    ring_attention_core,
+    encode_sequence_parallel,
+)
 
 __all__ = [
     "make_mesh",
@@ -34,4 +38,6 @@ __all__ = [
     "sharded_topk_merge",
     "DistributedConfig",
     "initialize_distributed",
+    "ring_attention_core",
+    "encode_sequence_parallel",
 ]

@@ -1,0 +1,56 @@
+"""Live Bokeh plots over streaming tables.
+
+Reference parity: `stdlib/viz/plotting.py:35` ``plot(table,
+plotting_function, sorting_col)`` — a user function receives a Bokeh
+``ColumnDataSource`` and returns a figure; the source is updated from the
+table's change stream so the figure animates as the computation progresses.
+
+Bokeh/panel are optional: on headless TPU hosts ``plot`` raises a clear
+ImportError naming the extras instead of failing at some deeper import.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def plot(table, plotting_function: Callable, sorting_col=None):
+    """Build a live plot of the table.
+
+    ``plotting_function(source) -> bokeh.models.Plot`` receives a
+    ``ColumnDataSource`` whose columns follow the table's columns; the
+    returned figure re-renders on every engine time advancement.
+    """
+    try:
+        import panel as pn
+        from bokeh.models import ColumnDataSource
+    except ImportError as e:
+        raise ImportError(
+            "pw.Table.plot needs the optional viz dependencies; "
+            "install bokeh and panel"
+        ) from e
+
+    import pathway_tpu as pw
+
+    column_names = table.schema.column_names()
+    source = ColumnDataSource(data={c: [] for c in column_names})
+    fig = plotting_function(source)
+    rows: dict[Any, dict] = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            rows[key] = row
+        else:
+            rows.pop(key, None)
+
+    def on_time_end(time):
+        ordered = list(rows.values())
+        if sorting_col is not None:
+            name = getattr(sorting_col, "name", sorting_col)
+            ordered.sort(key=lambda r: r[name])
+        source.data = {
+            c: [r.get(c) for r in ordered] for c in column_names
+        }
+
+    pw.io.subscribe(table, on_change=on_change, on_time_end=on_time_end)
+    return pn.Column(pn.pane.Bokeh(fig))

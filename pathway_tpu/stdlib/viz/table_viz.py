@@ -1,0 +1,100 @@
+"""Table display: live widget when panel/bokeh are installed, static HTML
+snapshot otherwise.
+
+Mirrors the reference's jupyter integration (`stdlib/viz/table_viz.py:26`
+``show`` + ``_repr_mimebundle_``) with an explicit no-dependency fallback:
+this framework targets headless TPU hosts where panel is usually absent, so
+``show`` must degrade to something useful instead of ImportError-ing the
+whole notebook cell.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def _dtype_label(dtype: Any) -> str:
+    s = str(dtype)
+    return s.removeprefix("<class '").removesuffix("'>")
+
+
+def _snapshot_dataframe(table):
+    from pathway_tpu.debug import table_to_pandas
+
+    return table_to_pandas(table)
+
+
+def _frame_for_display(df, include_id: bool, short_pointers: bool):
+    if not include_id:
+        return df.reset_index(drop=True)
+    if short_pointers:
+        df = df.copy()
+        df.index = [str(i)[:12] for i in df.index]
+    return df
+
+
+def show(table, *, include_id: bool = True, short_pointers: bool = True):
+    """Display a table. With panel installed, returns a live-updating panel
+    widget fed by ``io.subscribe``; without it, computes the current static
+    snapshot and returns an HTML object (works in plain Jupyter).
+
+    Reference parity: ``pw.Table.show`` / cell-magic display
+    (stdlib/viz/table_viz.py:26-140).
+    """
+    try:
+        import panel as pn
+    except ImportError:
+        pn = None
+
+    if pn is None:
+        df = _frame_for_display(
+            _snapshot_dataframe(table), include_id, short_pointers
+        )
+        html = df.to_html(max_rows=100)
+        try:  # inside IPython, return a rich display object
+            from IPython.display import HTML
+
+            return HTML(html)
+        except ImportError:
+            return html
+
+    import pandas as pd
+
+    import pathway_tpu as pw
+
+    column_names = table.schema.column_names()
+    rows: dict[Any, dict] = {}
+    widget = pn.widgets.Tabulator(
+        pd.DataFrame(columns=column_names), disabled=True
+    )
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            rows[key] = row
+        else:
+            rows.pop(key, None)
+
+    def on_time_end(time):
+        widget.value = _frame_for_display(
+            pd.DataFrame.from_dict(rows, orient="index"),
+            include_id, short_pointers,
+        )
+
+    pw.io.subscribe(table, on_change=on_change, on_time_end=on_time_end)
+    return pn.Column(widget)
+
+
+def _repr_mimebundle_(self, include=None, exclude=None):
+    """Rich notebook repr: schema summary without forcing a compute."""
+    cols = {
+        name: _dtype_label(cdef.dtype)
+        for name, cdef in self.schema.columns().items()
+    }
+    head = "".join(
+        f"<tr><td>{n}</td><td><tt>{t}</tt></td></tr>" for n, t in cols.items()
+    )
+    html = (
+        "<table><thead><tr><th>column</th><th>dtype</th></tr></thead>"
+        f"<tbody>{head}</tbody></table>"
+    )
+    return {"text/html": html, "text/plain": repr(self)}

@@ -1,0 +1,187 @@
+"""@pw.transformer row-transformer tests (reference test patterns:
+python/pathway/tests/test_row_transformer*.py — simple per-row compute,
+cross-row pointer access, recursion, two-table transformers)."""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from tests.utils import _capture_rows
+
+
+def test_simple_output_attribute():
+    @pw.transformer
+    class add_one:
+        class table(pw.ClassArg):
+            a = pw.input_attribute()
+
+            @pw.output_attribute
+            def result(self) -> int:
+                return self.a + 1
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        5
+        """
+    )
+    out = add_one(table=t).table
+    rows, cols = _capture_rows(out)
+    assert sorted(r[cols.index("result")] for r in rows.values()) == [2, 6]
+
+
+def test_cross_row_pointer_access():
+    """A row reads another row's *computed* attribute through a pointer."""
+
+    @pw.transformer
+    class chained:
+        class table(pw.ClassArg):
+            val = pw.input_attribute()
+            next_id = pw.input_attribute()
+
+            @pw.output_attribute
+            def doubled(self) -> int:
+                return self.val * 2
+
+            @pw.output_attribute
+            def next_doubled(self) -> int:
+                if self.next_id is None:
+                    return -1
+                return self.transformer.table[self.next_id].doubled
+
+    t = pw.debug.table_from_markdown(
+        """
+        name | val
+        x    | 10
+        y    | 20
+        """
+    ).with_id_from(pw.this.name)
+    t = t.select(
+        pw.this.val,
+        next_id=pw.if_else(
+            pw.this.val == 10, t.pointer_from("y"), None
+        ),
+    )
+    out = chained(table=t).table
+    rows, cols = _capture_rows(out)
+    got = {r[cols.index("doubled")]: r[cols.index("next_doubled")]
+           for r in rows.values()}
+    assert got == {20: 40, 40: -1}
+
+
+def test_recursive_fibonacci():
+    @pw.transformer
+    class fib:
+        class series(pw.ClassArg):
+            n = pw.input_attribute()
+
+            @pw.output_attribute
+            def result(self) -> int:
+                if self.n <= 1:
+                    return self.n
+                return (
+                    self.transformer.series[self.pointer_from(self.n - 1)].result
+                    + self.transformer.series[self.pointer_from(self.n - 2)].result
+                )
+
+    t = pw.debug.table_from_markdown(
+        """
+        n
+        0
+        1
+        2
+        3
+        4
+        5
+        6
+        """
+    ).with_id_from(pw.this.n)
+    out = fib(series=t).series
+    rows, cols = _capture_rows(out)
+    assert sorted(r[cols.index("result")] for r in rows.values()) == [
+        0, 1, 1, 2, 3, 5, 8,
+    ]
+
+
+def test_two_tables_and_private_attribute():
+    """Non-output `attribute` is usable but not exported; two class-args."""
+
+    @pw.transformer
+    class join_like:
+        class prices(pw.ClassArg):
+            price = pw.input_attribute()
+
+            @pw.attribute
+            def with_vat(self) -> float:
+                return self.price * 1.23
+
+            @pw.output_attribute
+            def gross(self) -> float:
+                return self.with_vat
+
+        class orders(pw.ClassArg):
+            product_id = pw.input_attribute()
+            qty = pw.input_attribute()
+
+            @pw.output_attribute
+            def total(self) -> float:
+                return (
+                    self.qty
+                    * self.transformer.prices[self.product_id].gross
+                )
+
+    prices = pw.debug.table_from_markdown(
+        """
+        name | price
+        pen  | 100
+        ink  | 10
+        """
+    ).with_id_from(pw.this.name)
+    prices = prices.select(pw.this.price)
+    orders_raw = pw.debug.table_from_markdown(
+        """
+        product | qty
+        pen     | 2
+        ink     | 5
+        """
+    )
+    orders = orders_raw.select(
+        product_id=orders_raw.pointer_from(pw.this.product),
+        qty=pw.this.qty,
+    )
+    res = join_like(prices=prices, orders=orders)
+    rows, cols = _capture_rows(res.orders)
+    assert sorted(
+        round(r[cols.index("total")], 2) for r in rows.values()
+    ) == [61.5, 246.0]
+    prows, pcols = _capture_rows(res.prices)
+    assert pcols == ["gross"]  # with_vat not exported
+
+
+def test_missing_pointer_gives_error_value():
+    @pw.transformer
+    class deref:
+        class table(pw.ClassArg):
+            target = pw.input_attribute()
+
+            @pw.output_attribute
+            def val(self) -> int:
+                return self.transformer.table[self.target].target
+
+    t_raw = pw.debug.table_from_markdown(
+        """
+        x
+        1
+        """
+    )
+    t = t_raw.select(target=t_raw.pointer_from("nonexistent"))
+    out = deref(table=t).table
+    # the dangling pointer becomes an ERROR value, which by default refuses
+    # to reach an output table; fill_error() tolerates it (reference
+    # error-containment semantics)
+    import pytest
+
+    from pathway_tpu.internals.errors import EngineError
+
+    with pytest.raises(EngineError, match="error value"):
+        _capture_rows(out)
