@@ -134,6 +134,11 @@ def iterate(
     from pathway_tpu.internals.table import Table
     from pathway_tpu.internals import schema as schema_mod
 
+    if iteration_limit is not None and not isinstance(iteration_limit, int):
+        raise TypeError(
+            "iteration_limit must be an int; pass tables as keyword "
+            "arguments: pw.iterate(body, t=t)"
+        )
     names = list(kwargs.keys())
     outer_tables: list[Table] = [kwargs[n] for n in names]
 
@@ -153,6 +158,13 @@ def iterate(
         result = body(**dict(zip(names, sub_tables)))
         if isinstance(result, dict):
             result_items = list(result.items())
+        elif isinstance(result, Table):
+            if len(names) != 1:
+                raise ValueError(
+                    "iterate body returned a single table but was given "
+                    f"{len(names)} tables; return a dict instead"
+                )
+            result_items = [(names[0], result)]
         else:
             result_items = [(n, getattr(result, n)) for n in names]
     finally:

@@ -43,7 +43,10 @@ def bellman_ford(vertices, edges):
 
 
 def pagerank(edges, steps: int = 50, damping: float = 0.85):
-    """PageRank over an edge table (u, v) — iterative power method."""
+    """PageRank over an edge table with ``u``/``v`` endpoint columns —
+    iterative power method. Returns a table keyed by vertex (id =
+    ``pointer_from(v)``) with columns ``v`` (the vertex value) and ``rank``.
+    """
     from pathway_tpu.internals import thisclass
 
     vertices = (
@@ -53,27 +56,28 @@ def pagerank(edges, steps: int = 50, damping: float = 0.85):
         .reduce(thisclass.this.v)
         .with_id_from(thisclass.this.v)
     )
-    degrees = (
-        edges.groupby(edges.u)
-        .reduce(edges.u, degree=reducers.count())
-        .with_id_from(thisclass.this.u)
+    degrees = edges.groupby(edges.u).reduce(
+        edges.u, degree=reducers.count()
     )
-    ranks = vertices.select(rank=1.0)
+    ranks = vertices.select(vertices.v, rank=1.0)
 
-    for _ in range(steps if steps <= 20 else 20):
-        contribs = (
-            edges.join(ranks, edges.u == ranks.id)
-            .join(degrees, edges.u == degrees.id)
-            .select(target=edges.v, contrib=ranks.rank / degrees.degree)
+    for _ in range(steps):
+        with_rank = edges.join(ranks, edges.u == ranks.v).select(
+            u=edges.u, target=edges.v, rank=ranks.rank
+        )
+        contribs = with_rank.join(degrees, with_rank.u == degrees.u).select(
+            target=with_rank.target,
+            contrib=with_rank.rank / degrees.degree,
         )
         incoming = contribs.groupby(contribs.target).reduce(
             contribs.target, total=reducers.sum(contribs.contrib)
-        ).with_id_from(thisclass.this.target)
-        joined = ranks.join_left(incoming, ranks.id == incoming.id, id=ranks.id).select(
-            total=incoming.total
         )
+        joined = ranks.join_left(
+            incoming, ranks.v == incoming.target, id=ranks.id
+        ).select(ranks.v, total=incoming.total)
         ranks = joined.select(
-            rank=(1 - damping) + damping * expr_mod.coalesce(joined.total, 0.0)
+            joined.v,
+            rank=(1 - damping) + damping * expr_mod.coalesce(joined.total, 0.0),
         )
     return ranks
 

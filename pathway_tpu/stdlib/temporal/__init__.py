@@ -217,17 +217,15 @@ class WindowGroupedTable:
                     flat["__windows"].get(1),
                 ),
             )
-        # apply behavior: delay/cutoff on window end vs time column
+        # apply behavior: delay/cutoff on window end vs time column.
+        # Lateness operators (freeze/forget) must see the RAW stream: their
+        # watermark is derived from observed rows, and a buffer placed before
+        # them would lag it — late rows released together with the buffered
+        # batch would sneak past the cutoff (the reference's time_column
+        # operators share the timely frontier, so order doesn't matter there).
         if self._behavior is not None and isinstance(self._behavior, CommonBehavior):
             b = self._behavior
-            time_col = tagged[self._time_expr.name] if isinstance(self._time_expr, ColumnReference) else None
-            tcol = None
-            time_ref = _ensure_time_col(tagged, self._time_expr)
-            tagged = time_ref
-            if b.delay is not None:
-                tagged = tagged._buffer(
-                    tagged._pw_window_start + b.delay, tagged["__time_value"]
-                )
+            tagged = _ensure_time_col(tagged, self._time_expr)
             if b.cutoff is not None:
                 if b.keep_results:
                     tagged = tagged._freeze(
@@ -237,6 +235,10 @@ class WindowGroupedTable:
                     tagged = tagged._forget(
                         tagged._pw_window_end + b.cutoff, tagged["__time_value"]
                     )
+            if b.delay is not None:
+                tagged = tagged._buffer(
+                    tagged._pw_window_start + b.delay, tagged["__time_value"]
+                )
         elif self._behavior is not None and isinstance(self._behavior, ExactlyOnceBehavior):
             shift = self._behavior.shift
             tagged = _ensure_time_col(tagged, self._time_expr)
@@ -245,8 +247,8 @@ class WindowGroupedTable:
                 if shift is not None
                 else tagged._pw_window_end
             )
-            tagged = tagged._buffer(thr, tagged["__time_value"])
             tagged = tagged._freeze(thr, tagged["__time_value"])
+            tagged = tagged._buffer(thr, tagged["__time_value"])
 
         grouped = tagged.groupby(
             tagged._pw_window,
@@ -262,23 +264,47 @@ class WindowGroupedTable:
         new_kwargs = {}
         from pathway_tpu.internals import reducers as red_mod
 
-        for name, e in kwargs.items():
+        instance_name = (
+            self._instance.name
+            if isinstance(self._instance, ColumnReference)
+            else None
+        )
+        for name, e in _named_reduce_args(args, kwargs).items():
             e = expr_mod.smart_coerce(e)
             e = substitute(e, {thisclass.this: tagged})
-            new_kwargs[name] = _window_meta_rewrite(e, tagged)
+            new_kwargs[name] = _window_meta_rewrite(e, tagged, instance_name)
         result = grouped.reduce(**new_kwargs)
         return result
 
 
-def _window_meta_rewrite(e, tagged):
-    """Map _pw_window_start/_pw_window_end refs to grouping-compatible
-    reducers (they are constant within a group → use `any`)."""
+def _named_reduce_args(args, kwargs) -> dict:
+    """Positional reduce args (column references, e.g. the window-key
+    columns) project under their own names, like ``Table.reduce``."""
+    named = {}
+    for a in args:
+        if not isinstance(a, ColumnReference):
+            raise ValueError(
+                "positional windowby(...).reduce arguments must be column "
+                "references; use keyword arguments for computed values"
+            )
+        if a.name in named or a.name in kwargs:
+            raise ValueError(f"duplicate reduce column {a.name!r}")
+        named[a.name] = a
+    named.update(kwargs)
+    return named
+
+
+def _window_meta_rewrite(e, tagged, instance_name=None):
+    """Map refs that are constant within a window group — the _pw_window*
+    meta columns and the instance column — to `any(...)` reducers."""
     from pathway_tpu.internals import reducers as red_mod
 
     if isinstance(e, ColumnReference):
-        if e.name in ("_pw_window_start", "_pw_window_end", "_pw_window"):
-            if e._table is tagged or e._table is None or e._table is thisclass.this:
-                return red_mod.any(tagged[e.name])
+        constant_cols = ("_pw_window_start", "_pw_window_end", "_pw_window")
+        if e.name in constant_cols or (
+            instance_name is not None and e.name == instance_name
+        ):
+            return red_mod.any(tagged[e.name])
         return e
     import copy
 
@@ -470,7 +496,7 @@ def _intervals_over_grouped(table, time_expr, window: IntervalsOverWindow, insta
             tagged = Table(node, schema, Universe())
             grouped = tagged.groupby(tagged._pw_window)
             new_kwargs = {}
-            for name, e in kwargs.items():
+            for name, e in _named_reduce_args(args, kwargs).items():
                 e = expr_mod.smart_coerce(e)
                 e = substitute(e, {thisclass.this: tagged})
                 new_kwargs[name] = _window_meta_rewrite_io(e, tagged)

@@ -522,3 +522,50 @@ def test_table_split():
     neg_rows, _ = _capture_rows(negative)
     assert list(pos_rows.values()) == [(7, 0)]
     assert list(neg_rows.values()) == [(1, 3)]
+
+
+def test_hmm_reducer_decodes_most_likely_path():
+    """stdlib.ml.hmm.create_hmm_reducer: Viterbi decode over a grouped
+    observation stream (reference stdlib/ml/hmm.py manul example shape)."""
+    import numpy as np
+    import networkx as nx
+    from functools import partial
+
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.ml.hmm import create_hmm_reducer
+
+    def emission(observation, state):
+        table = {
+            ("HUNGRY", "GRUMPY"): 0.9,
+            ("HUNGRY", "HAPPY"): 0.1,
+            ("FULL", "GRUMPY"): 0.3,
+            ("FULL", "HAPPY"): 0.7,
+        }
+        return float(np.log(table[(state, observation)]))
+
+    g = nx.DiGraph()
+    for s in ("HUNGRY", "FULL"):
+        g.add_node(s, calc_emission_log_ppb=partial(emission, state=s))
+    g.add_edge("HUNGRY", "HUNGRY", log_transition_ppb=np.log(0.4))
+    g.add_edge("HUNGRY", "FULL", log_transition_ppb=np.log(0.6))
+    g.add_edge("FULL", "FULL", log_transition_ppb=np.log(0.5))
+    g.add_edge("FULL", "HUNGRY", log_transition_ppb=np.log(0.5))
+
+    t = pw.debug.table_from_markdown(
+        """
+        grp | observation
+        a   | HAPPY
+        a   | HAPPY
+        a   | GRUMPY
+        a   | GRUMPY
+        """
+    )
+    reducer = create_hmm_reducer(g, beam_size=2, num_results_kept=3)
+    res = t.groupby(t.grp).reduce(t.grp, decoded=reducer(t.observation))
+    from tests.utils import _capture_rows
+
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    decoded = row[cols.index("decoded")]
+    assert len(decoded) == 3  # truncated by num_results_kept
+    assert decoded[-1] == "HUNGRY"  # grumpy tail decodes to hungry

@@ -1,0 +1,444 @@
+"""Behavior tests widening coverage to match the reference test strategy
+(SURVEY.md §4): SQL, iterate + graph algorithms, temporal behaviors,
+intervals_over, UDF caching/retries, error-value ops, Json, expression
+namespaces, interpolate."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import _capture_rows
+
+
+# --------------------------------------------------------------------------- #
+# SQL
+
+
+def test_sql_select_where_groupby():
+    t = pw.debug.table_from_markdown(
+        """
+        city | value
+        a    | 1
+        a    | 3
+        b    | 10
+        """
+    )
+    res = pw.sql(
+        "SELECT city, SUM(value) AS total FROM tab GROUP BY city", tab=t
+    )
+    rows, cols = _capture_rows(res)
+    got = {r[cols.index("city")]: r[cols.index("total")] for r in rows.values()}
+    assert got == {"a": 4, "b": 10}
+
+
+def test_sql_join_and_where():
+    left = pw.debug.table_from_markdown(
+        """
+        k | x
+        1 | 10
+        2 | 20
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        k | y
+        1 | 100
+        2 | 200
+        """
+    )
+    res = pw.sql(
+        "SELECT a.x AS x, b.y AS y FROM a JOIN b ON a.k = b.k WHERE a.x > 10",
+        a=left, b=right,
+    )
+    rows, cols = _capture_rows(res)
+    assert [(r[cols.index("x")], r[cols.index("y")]) for r in rows.values()] \
+        == [(20, 200)]
+
+
+# --------------------------------------------------------------------------- #
+# iterate + graph algorithms
+
+
+def test_pagerank_ranks_hub_highest():
+    edges = pw.debug.table_from_markdown(
+        """
+        u | v
+        a | c
+        b | c
+        d | c
+        c | a
+        """
+    )
+    from pathway_tpu.stdlib.graphs import pagerank
+
+    res = pagerank(edges)
+    rows, cols = _capture_rows(res)
+    ranks = {r[cols.index("v")]: r[cols.index("rank")] for r in rows.values()}
+    assert set(ranks) == {"a", "b", "c", "d"}
+    # c receives three in-links: it must carry the top rank, and a (fed by
+    # c's whole rank) must beat the leaf nodes b, d
+    assert max(ranks, key=ranks.get) == "c"
+    assert ranks["a"] > ranks["b"] == ranks["d"]
+
+
+def test_iterate_collatz_converges():
+    def collatz_step(t):
+        return t.select(
+            n=pw.if_else(
+                t.n == 1,
+                t.n,
+                pw.if_else(t.n % 2 == 0, t.n // 2, 3 * t.n + 1),
+            )
+        )
+
+    t = pw.debug.table_from_markdown(
+        """
+        n
+        7
+        12
+        1
+        """
+    )
+    res = pw.iterate(collatz_step, t=t)
+    rows, cols = _capture_rows(res)
+    assert all(r[cols.index("n")] == 1 for r in rows.values())
+
+
+# --------------------------------------------------------------------------- #
+# temporal behaviors / intervals_over
+
+
+def test_common_behavior_cutoff_drops_late_rows():
+    t = pw.debug.table_from_markdown(
+        """
+        t  | v | __time__
+        0  | 1 | 2
+        2  | 1 | 2
+        12 | 1 | 4
+        4  | 1 | 8
+        """
+    )
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.common_behavior(cutoff=1),
+    ).reduce(count=pw.reducers.count())
+    rows, cols = _capture_rows(res)
+    counts = sorted(r[cols.index("count")] for r in rows.values())
+    # the t=4 row arrives after the watermark passed its window + cutoff:
+    # it must NOT be added to the [0, 10) window
+    assert counts == [1, 2]
+
+
+def test_exactly_once_behavior_freezes_results():
+    t = pw.debug.table_from_markdown(
+        """
+        t  | __time__
+        1  | 2
+        2  | 2
+        11 | 4
+        3  | 6
+        """
+    )
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=10),
+        behavior=pw.temporal.exactly_once_behavior(),
+    ).reduce(count=pw.reducers.count())
+    rows, cols = _capture_rows(res)
+    counts = sorted(r[cols.index("count")] for r in rows.values())
+    # [0,10) window emitted exactly once when the watermark passed it (2 rows
+    # at that point); the late t=3 row must not retro-update it to 3
+    assert counts == [1, 2]
+
+
+def test_intervals_over_collects_neighbors():
+    t = pw.debug.table_from_markdown(
+        """
+        t | v
+        1 | 10
+        2 | 20
+        3 | 30
+        7 | 70
+        """
+    )
+    res = pw.temporal.windowby(
+        t,
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=pw.debug.table_from_markdown(
+                """
+                at
+                2
+                7
+                """
+            ).at,
+            lower_bound=-1,
+            upper_bound=1,
+        ),
+    ).reduce(
+        pw.this._pw_window_location,
+        vs=pw.reducers.sorted_tuple(pw.this.v),
+    )
+    rows, cols = _capture_rows(res)
+    got = {r[cols.index("_pw_window_location")]: r[cols.index("vs")]
+           for r in rows.values()}
+    assert got[2] == (10, 20, 30)
+    assert got[7] == (70,)
+
+
+# --------------------------------------------------------------------------- #
+# UDF caching & retries
+
+
+def test_udf_in_memory_cache_deduplicates_calls():
+    calls = []
+
+    @pw.udf(cache_strategy=pw.udfs.InMemoryCache())
+    def expensive(x: int) -> int:
+        calls.append(x)
+        return x * 2
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        3
+        3
+        3
+        4
+        """
+    )
+    res = t.select(y=expensive(t.a))
+    rows, cols = _capture_rows(res)
+    assert sorted(r[cols.index("y")] for r in rows.values()) == [6, 6, 6, 8]
+    assert sorted(set(calls)) == [3, 4]
+    assert len(calls) <= 3  # 3 cached after first call
+
+
+def test_udf_retry_strategy_retries_transient_failure():
+    attempts = {"n": 0}
+
+    @pw.udf(
+        executor=pw.udfs.async_executor(
+            retry_strategy=pw.udfs.FixedDelayRetryStrategy(
+                max_retries=3, delay_ms=1
+            )
+        )
+    )
+    async def flaky(x: int) -> int:
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("transient")
+        return x + 1
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    res = t.select(y=flaky(t.a))
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("y")] == 2
+    assert attempts["n"] == 3
+
+
+def test_udf_disk_cache_persists_across_runs(tmp_path):
+    calls = []
+
+    def make_udf():
+        @pw.udf(cache_strategy=pw.udfs.DiskCache(name="f"))
+        def f(x: int) -> int:
+            calls.append(x)
+            return x * 10
+
+        return f
+
+    import os
+
+    old = os.environ.get("PATHWAY_PERSISTENT_STORAGE")
+    os.environ["PATHWAY_PERSISTENT_STORAGE"] = str(tmp_path)
+    try:
+        t = pw.debug.table_from_markdown("a\n5\n")
+        _capture_rows(t.select(y=make_udf()(t.a)))
+        pw.clear_graph()
+        t = pw.debug.table_from_markdown("a\n5\n")
+        rows, cols = _capture_rows(t.select(y=make_udf()(t.a)))
+        (row,) = rows.values()
+        assert row[cols.index("y")] == 50
+        assert calls == [5]  # second run served from disk
+    finally:
+        if old is None:
+            os.environ.pop("PATHWAY_PERSISTENT_STORAGE", None)
+        else:
+            os.environ["PATHWAY_PERSISTENT_STORAGE"] = old
+
+
+# --------------------------------------------------------------------------- #
+# error-value ops
+
+
+def test_fill_error_replaces_error_values():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        6 | 2
+        6 | 0
+        """
+    )
+    res = t.select(q=pw.fill_error(t.a // t.b, -1))
+    rows, cols = _capture_rows(res)
+    assert sorted(r[cols.index("q")] for r in rows.values()) == [-1, 3]
+
+
+def test_unwrap_raises_on_none():
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    res = t.select(b=pw.unwrap(pw.if_else(t.a > 0, t.a, None)))
+    rows, cols = _capture_rows(res)
+    assert [r[cols.index("b")] for r in rows.values()] == [1]
+
+
+def test_global_error_log_collects_messages():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 0
+        """
+    )
+    res = t.select(q=pw.fill_error(t.a // t.b, 0))
+    _capture_rows(res)
+    entries = pw.internals.errors.get_global_error_log().entries
+    assert any("division" in e["message"].lower() or "zero" in
+               e["message"].lower() for e in entries)
+
+
+# --------------------------------------------------------------------------- #
+# Json + expression namespaces
+
+
+def test_json_get_and_as_typed():
+    import json as json_lib
+
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=pw.Json),
+        rows=[(pw.Json({"a": {"b": 7}, "s": "x"}),)],
+    )
+    res = t.select(
+        b=t.data.get("a").get("b").as_int(),
+        s=t.data.get("s").as_str(),
+        missing=t.data.get("nope").get("deep"),
+    )
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("b")] == 7
+    assert row[cols.index("s")] == "x"
+
+
+def test_num_namespace_round_and_abs():
+    t = pw.debug.table_from_markdown(
+        """
+        x
+        -2.7
+        """
+    )
+    res = t.select(a=t.x.num.abs(), r=t.x.num.round(1))
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("a")] == pytest.approx(2.7)
+    assert row[cols.index("r")] == pytest.approx(-2.7)
+
+
+def test_dt_namespace_extracts_parts():
+    t = pw.debug.table_from_markdown(
+        """
+        ts
+        2024-03-05T10:30:00
+        """
+    ).select(d=pw.this.ts.dt.strptime("%Y-%m-%dT%H:%M:%S"))
+    res = t.select(y=t.d.dt.year(), m=t.d.dt.month(), day=t.d.dt.day())
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert (row[cols.index("y")], row[cols.index("m")],
+            row[cols.index("day")]) == (2024, 3, 5)
+
+
+# --------------------------------------------------------------------------- #
+# interpolate
+
+
+def test_interpolate_linear_fills_gaps():
+    t = pw.debug.table_from_markdown(
+        """
+        t | v
+        0 | 0.0
+        2 |
+        4 | 4.0
+        """
+    )
+    from pathway_tpu.stdlib.statistical import interpolate
+
+    res = interpolate(t, t.t, t.v)
+    rows, cols = _capture_rows(res)
+    by_t = {r[cols.index("t")]: r[cols.index("v")] for r in rows.values()}
+    assert by_t[2] == pytest.approx(2.0)
+
+
+def test_windowby_instance_column_in_reduce():
+    """Positional instance column in windowby reduce (the canonical
+    reference pattern) projects via an implicit any() rewrite."""
+    t = pw.debug.table_from_markdown(
+        """
+        k | t
+        a | 1
+        a | 2
+        b | 1
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=10), instance=t.k
+    ).reduce(t.k, count=pw.reducers.count())
+    rows, cols = _capture_rows(res)
+    got = {r[cols.index("k")]: r[cols.index("count")] for r in rows.values()}
+    assert got == {"a": 2, "b": 1}
+
+
+def test_hmm_reducer_sorts_by_order_key():
+    """Interleaved repeated observations decode in time order when an
+    ordering column is supplied."""
+    import numpy as np
+    import networkx as nx
+    from functools import partial
+
+    from pathway_tpu.stdlib.ml.hmm import create_hmm_reducer
+
+    def emission(observation, state):
+        return 0.0 if observation == state else float(np.log(0.05))
+
+    g = nx.DiGraph()
+    for s in ("X", "Y"):
+        g.add_node(s, calc_emission_log_ppb=partial(emission, state=s))
+    for a in ("X", "Y"):
+        for b in ("X", "Y"):
+            g.add_edge(a, b, log_transition_ppb=float(np.log(0.5)))
+
+    t = pw.debug.table_from_markdown(
+        """
+        grp | t | obs
+        a   | 1 | X
+        a   | 2 | Y
+        a   | 3 | X
+        """
+    )
+    reducer = create_hmm_reducer(g)
+    res = t.groupby(t.grp).reduce(t.grp, decoded=reducer(t.obs, t.t))
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    # near-deterministic emissions: decode mirrors the time-ordered stream
+    assert row[cols.index("decoded")] == ("X", "Y", "X")
