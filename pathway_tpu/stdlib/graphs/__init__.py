@@ -82,5 +82,102 @@ def pagerank(edges, steps: int = 50, damping: float = 0.85):
     return ranks
 
 
-def louvain_communities(*args, **kwargs):
-    raise NotImplementedError("louvain arrives with the graph-clustering pack")
+def _louvain_partition(adj: dict, resolution: float, levels: int) -> dict:
+    """Greedy-modularity Louvain on an undirected weighted adjacency map
+    {node: {nbr: w}}. Deterministic (sorted node order). Returns
+    {node: community_label}."""
+    mapping = {n: n for n in adj}  # original node -> current supernode
+
+    for _ in range(levels):
+        nodes = sorted(adj, key=repr)
+        m2 = sum(sum(nb.values()) for nb in adj.values())  # 2m (both dirs)
+        if m2 == 0:
+            break
+        degree = {n: sum(adj[n].values()) for n in nodes}
+        comm = {n: n for n in nodes}
+        comm_degree = dict(degree)
+
+        moved = True
+        passes = 0
+        while moved and passes < 10:
+            moved = False
+            passes += 1
+            for n in nodes:
+                cn = comm[n]
+                comm_degree[cn] -= degree[n]
+                # weight from n into each neighbouring community
+                links: dict = {}
+                for nbr, w in adj[n].items():
+                    if nbr == n:
+                        continue
+                    links[comm[nbr]] = links.get(comm[nbr], 0.0) + w
+                best_c, best_gain = cn, 0.0
+                base = links.get(cn, 0.0) - resolution * comm_degree[cn] * degree[n] / m2
+                for c, w_in in sorted(links.items(), key=lambda kv: repr(kv[0])):
+                    gain = w_in - resolution * comm_degree[c] * degree[n] / m2
+                    if gain > base and gain > best_gain:
+                        best_gain, best_c = gain, c
+                comm[n] = best_c
+                comm_degree[best_c] += degree[n]
+                if best_c != cn:
+                    moved = True
+
+        # relabel communities by their smallest member for determinism
+        members: dict = {}
+        for n, c in comm.items():
+            members.setdefault(c, []).append(n)
+        label = {c: min(ns, key=repr) for c, ns in members.items()}
+        comm = {n: label[c] for n, c in comm.items()}
+        mapping = {orig: comm[sup] for orig, sup in mapping.items()}
+        if len(set(comm.values())) == len(adj):
+            break  # no merge happened: converged
+
+        # aggregate: communities become supernodes
+        new_adj: dict = {}
+        for n, nbrs in adj.items():
+            cn = comm[n]
+            row = new_adj.setdefault(cn, {})
+            for nbr, w in nbrs.items():
+                row[comm[nbr]] = row.get(comm[nbr], 0.0) + w
+        adj = new_adj
+
+    return mapping
+
+
+def louvain_communities(edges, weight=None, resolution: float = 1.0,
+                        levels: int = 3):
+    """Community detection by greedy modularity (Louvain method) over an
+    edge table with ``u``/``v`` columns and optional ``weight``.
+
+    Reference capability: ``stdlib/graphs/louvain_communities`` (dataflow
+    implementation over WeightedGraph). Here the whole graph is decoded by a
+    stateful whole-table reducer on every consolidation — incremental in the
+    replay sense (retractions re-cluster) — and flattened back into a table
+    keyed by vertex with columns ``v`` (vertex) and ``community`` (the
+    smallest member of the vertex's community, a deterministic label).
+    """
+    from pathway_tpu.internals import thisclass
+
+    w_expr = weight if weight is not None else expr_mod.ColumnConstExpression(1.0)
+    packed = edges.select(u=edges.u, v=edges.v, w=w_expr)
+
+    def cluster(_state, rows):
+        adj: dict = {}
+        # the engine pre-filters to positive net counts (StatefulAcc.compute)
+        for (u, v, w), count in rows:
+            ww = float(w) * count
+            adj.setdefault(u, {})[v] = adj.get(u, {}).get(v, 0.0) + ww
+            adj.setdefault(v, {})[u] = adj.get(v, {}).get(u, 0.0) + ww
+        if not adj:
+            return ()
+        mapping = _louvain_partition(adj, resolution, levels)
+        return tuple(sorted(mapping.items(), key=lambda kv: repr(kv[0])))
+
+    assign_reducer = reducers.stateful_many(cluster)
+    assignments = packed.groupby().reduce(
+        pairs=assign_reducer(packed.u, packed.v, packed.w)
+    )
+    flat = assignments.flatten(assignments.pairs)
+    return flat.select(
+        v=flat.pairs.get(0), community=flat.pairs.get(1)
+    ).with_id_from(thisclass.this.v)

@@ -442,3 +442,25 @@ def test_hmm_reducer_sorts_by_order_key():
     (row,) = rows.values()
     # near-deterministic emissions: decode mirrors the time-ordered stream
     assert row[cols.index("decoded")] == ("X", "Y", "X")
+
+
+def test_louvain_finds_two_cliques():
+    """Two 4-cliques joined by a single bridge edge must split into two
+    communities."""
+    from pathway_tpu.stdlib.graphs import louvain_communities
+
+    rows = []
+    for group in (["a1", "a2", "a3", "a4"], ["b1", "b2", "b3", "b4"]):
+        for i in range(len(group)):
+            for j in range(i + 1, len(group)):
+                rows.append(f"{group[i]} | {group[j]}")
+    rows.append("a1 | b1")  # bridge
+    edges = pw.debug.table_from_markdown("u | v\n" + "\n".join(rows))
+    res = louvain_communities(edges)
+    out, cols = _capture_rows(res)
+    comm = {r[cols.index("v")]: r[cols.index("community")]
+            for r in out.values()}
+    a_comms = {comm[f"a{i}"] for i in range(1, 5)}
+    b_comms = {comm[f"b{i}"] for i in range(1, 5)}
+    assert len(a_comms) == 1 and len(b_comms) == 1
+    assert a_comms != b_comms
