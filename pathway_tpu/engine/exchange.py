@@ -1,0 +1,345 @@
+"""Multi-process row exchange — the TCP cluster data plane.
+
+The reference scales across processes with timely's zero-copy TCP exchange
+channels: rows hop to the worker that owns their shard (low key bits) before
+every stateful operator, and progress (frontier) gossip rides the same
+sockets (``external/timely-dataflow/communication/``, SURVEY.md §2.5). This
+module is the engine's equivalent:
+
+* ``PeerMesh`` — a full mesh of length-prefixed pickle sockets between the
+  ``PATHWAY_PROCESSES`` processes on localhost (``PATHWAY_FIRST_PORT + pid``),
+  with one reader thread per peer feeding shared buffers.
+* ``ExchangeContext`` — epoch-aligned primitives on top of the mesh:
+  ``control_allgather`` (lockstep scheduler rounds: agree on the next global
+  epoch time and on termination) and ``exchange`` (per-operator data barrier:
+  each process contributes its outbound shards for one (exchange, time) and
+  collects everyone else's).
+* ``ExchangeNode`` — spliced in front of every stateful operator by
+  ``splice_exchanges``; routes each row to ``shard_of_key(routing_key) %
+  processes``. Groupbys route by the group key, joins by the join key (both
+  sides agree), everything else by row key — the reference's ``Shard``
+  trait mapping (src/engine/dataflow/shard.rs).
+
+Tensor traffic (embeddings, KNN merges) does NOT go through here — that
+rides ICI via jit collectives (``pathway_tpu.parallel``). This plane carries
+irregular host rows, exactly like the reference's byte-serialized exchange
+channels.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time as time_mod
+from collections import defaultdict
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.engine.batch import Batch, concat_batches
+from pathway_tpu.engine.graph import Node
+from pathway_tpu.engine.value import keys_for_value_columns, shard_of_keys
+
+_LEN = struct.Struct("<Q")
+
+
+class PeerMesh:
+    """Full TCP mesh between localhost processes; one socket per peer pair."""
+
+    def __init__(self, process_id: int, processes: int, first_port: int,
+                 host: str = "127.0.0.1", connect_timeout: float = 60.0):
+        self.process_id = process_id
+        self.processes = processes
+        self.peers = [p for p in range(processes) if p != process_id]
+        self._socks: dict[int, socket.socket] = {}
+        self._send_locks: dict[int, threading.Lock] = {}
+        self.lock = threading.Condition()
+        # shared buffers filled by reader threads
+        self.data: dict[tuple, list] = defaultdict(list)   # (ex, t) -> batches
+        self.done: dict[tuple, set] = defaultdict(set)     # (ex, t) -> peers
+        self.ctl: dict[int, dict[int, Any]] = defaultdict(dict)  # round -> {peer: payload}
+        self.closed = False
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, first_port + process_id))
+        srv.listen(processes)
+        self._srv = srv
+
+        accepted: dict[int, socket.socket] = {}
+
+        def acceptor():
+            for _ in range(len([p for p in self.peers if p > process_id])):
+                conn, _ = srv.accept()
+                hello = _recv_msg(conn)
+                accepted[hello[1]] = conn
+
+        at = threading.Thread(target=acceptor, daemon=True)
+        at.start()
+
+        # deterministic direction: lower pid dials higher pid
+        for p in self.peers:
+            if p < process_id:
+                deadline = time_mod.time() + connect_timeout
+                while True:
+                    try:
+                        s = socket.create_connection(
+                            (host, first_port + p), timeout=2.0
+                        )
+                        break
+                    except OSError:
+                        if time_mod.time() > deadline:
+                            raise TimeoutError(f"cannot reach peer {p}")
+                        time_mod.sleep(0.05)
+                _send_msg(s, ("hello", process_id))
+                self._socks[p] = s
+        at.join(timeout=connect_timeout)
+        for p, s in accepted.items():
+            self._socks[p] = s
+        missing = set(self.peers) - set(self._socks)
+        if missing:
+            raise TimeoutError(f"peers never connected: {missing}")
+        for p, s in self._socks.items():
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._send_locks[p] = threading.Lock()
+            threading.Thread(
+                target=self._reader, args=(p, s), daemon=True
+            ).start()
+
+    def _reader(self, peer: int, sock: socket.socket) -> None:
+        try:
+            while True:
+                msg = _recv_msg(sock)
+                kind = msg[0]
+                with self.lock:
+                    if kind == "data":
+                        _, ex, t, payload = msg
+                        self.data[(ex, t)].append(payload)
+                    elif kind == "done":
+                        _, ex, t = msg
+                        self.done[(ex, t)].add(peer)
+                    elif kind == "ctl":
+                        _, rnd, payload = msg
+                        self.ctl[rnd][peer] = payload
+                    self.lock.notify_all()
+        except (OSError, EOFError):
+            with self.lock:
+                self.closed = True
+                self.lock.notify_all()
+
+    def send(self, peer: int, msg: tuple) -> None:
+        with self._send_locks[peer]:
+            _send_msg(self._socks[peer], msg)
+
+    def close(self) -> None:
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def _send_msg(sock: socket.socket, msg: tuple) -> None:
+    blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def _recv_msg(sock: socket.socket):
+    header = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(header)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("peer closed")
+        buf += chunk
+    return buf
+
+
+class ExchangeContext:
+    """Epoch-aligned collectives over a PeerMesh."""
+
+    def __init__(self, mesh: PeerMesh):
+        self.mesh = mesh
+        self.process_id = mesh.process_id
+        self.processes = mesh.processes
+        self._n_exchanges = 0
+
+    def next_exchange_id(self) -> int:
+        ex = self._n_exchanges
+        self._n_exchanges += 1
+        return ex
+
+    # ---------------------------------------------------------------- control
+    def control_allgather(self, rnd: int, payload, timeout: float = 300.0):
+        """Send payload for lockstep round ``rnd``; return {pid: payload}
+        for ALL processes (self included)."""
+        for p in self.mesh.peers:
+            self.mesh.send(p, ("ctl", rnd, payload))
+        deadline = time_mod.time() + timeout
+        with self.mesh.lock:
+            while True:
+                got = self.mesh.ctl.get(rnd, {})
+                if len(got) == len(self.mesh.peers):
+                    out = dict(got)
+                    del self.mesh.ctl[rnd]
+                    out[self.process_id] = payload
+                    return out
+                if self.mesh.closed:
+                    raise ConnectionError("peer mesh closed mid-round")
+                if not self.mesh.lock.wait(timeout=1.0) and \
+                        time_mod.time() > deadline:
+                    raise TimeoutError(f"control round {rnd} timed out")
+
+    # ------------------------------------------------------------------- data
+    def exchange(self, ex: int, t: int, outbound: dict[int, Batch],
+                 timeout: float = 300.0) -> list[Batch]:
+        """Contribute per-peer batches for (exchange ex, time t); block until
+        every peer's DONE marker for the same (ex, t) arrives; return the
+        batches peers sent here."""
+        for p in self.mesh.peers:
+            b = outbound.get(p)
+            if b is not None and len(b):
+                self.mesh.send(p, ("data", ex, t, _pack_batch(b)))
+            self.mesh.send(p, ("done", ex, t))
+        deadline = time_mod.time() + timeout
+        with self.mesh.lock:
+            while True:
+                if self.mesh.done.get((ex, t), set()) >= set(self.mesh.peers):
+                    payloads = self.mesh.data.pop((ex, t), [])
+                    del self.mesh.done[(ex, t)]
+                    return [_unpack_batch(p) for p in payloads]
+                if self.mesh.closed:
+                    raise ConnectionError("peer mesh closed mid-exchange")
+                if not self.mesh.lock.wait(timeout=1.0) and \
+                        time_mod.time() > deadline:
+                    raise TimeoutError(f"exchange {ex}@{t} timed out")
+
+    def close(self) -> None:
+        self.mesh.close()
+
+
+def _pack_batch(b: Batch):
+    return (b.keys, b.cols, b.diffs)
+
+
+def _unpack_batch(p) -> Batch:
+    keys, cols, diffs = p
+    return Batch(keys, cols, diffs)
+
+
+# --------------------------------------------------------------------------- #
+# exchange operator + splice pass
+
+
+class ExchangeNode(Node):
+    """Route rows to their owner process before a stateful operator.
+
+    ``routing`` is None (route by row key) or a list of column names whose
+    values hash to the routing key (group/join keys)."""
+
+    def __init__(self, graph, input_node, ctx: ExchangeContext,
+                 routing: list[str] | None, name="Exchange"):
+        super().__init__(graph, [input_node], input_node.column_names, name)
+        self.ctx = ctx
+        self.ex_id = ctx.next_exchange_id()
+        self.routing = routing
+
+    def _routing_keys(self, batch: Batch) -> np.ndarray:
+        if self.routing is None:
+            return batch.keys
+        return keys_for_value_columns(
+            [batch.cols[c] for c in self.routing], len(batch)
+        )
+
+    def step(self, time, ins):
+        (batch,) = ins
+        n = self.ctx.processes
+        me = self.ctx.process_id
+        local = None
+        outbound: dict[int, Batch] = {}
+        if batch is not None and len(batch):
+            shards = shard_of_keys(self._routing_keys(batch), n)
+            local_mask = shards == me
+            if local_mask.all():
+                local = batch
+            else:
+                local = batch.take(local_mask)
+                for p in range(n):
+                    if p == me:
+                        continue
+                    m = shards == p
+                    if m.any():
+                        outbound[p] = batch.take(m)
+        received = self.ctx.exchange(self.ex_id, time, outbound)
+        parts = [b for b in [local, *received] if b is not None and len(b)]
+        if not parts:
+            return None
+        return concat_batches(parts)
+
+
+def splice_exchanges(graph, order: list[Node],
+                     ctx: ExchangeContext) -> list[tuple[Node, int, Node]]:
+    """Insert ExchangeNodes in front of every stateful operator's inputs.
+
+    Must be deterministic across processes: the graph build is identical on
+    every process (same program), and this pass walks the same topo order,
+    so exchange ids line up. Returns the list of (node, input_index,
+    original_input) rewirings so the caller can undo them on teardown — the
+    graph is the user's global object and must not keep exchanges bound to
+    a dead mesh across runs."""
+    from pathway_tpu.engine.operators.join import JoinNode
+    from pathway_tpu.engine.operators.reduce import GroupbyNode
+    from pathway_tpu.internals.iterate import IterateNode
+
+    spliced: list[tuple[Node, int, Node]] = []
+    for node in list(order):
+        if isinstance(node, ExchangeNode):
+            continue
+        if isinstance(node, IterateNode):
+            raise NotImplementedError(
+                "pw.iterate is not yet supported in multi-process mode: the "
+                "fixpoint subgraph runs per-process without row exchange, "
+                "which would silently shard-split groups. Run iterate "
+                "pipelines with PATHWAY_PROCESSES=1."
+            )
+        if isinstance(node, GroupbyNode):
+            routings: list[list[str] | None] = [
+                [node.instance_col] if node.instance_col else node.group_cols
+            ]
+        elif isinstance(node, JoinNode):
+            routings = [node.left_on, node.right_on]
+        elif node.is_stateful():
+            routings = [None] * len(node.inputs)
+        else:
+            continue
+        for i, inp in enumerate(node.inputs):
+            if i >= len(routings):
+                routing = None
+            else:
+                routing = routings[i]
+            if isinstance(inp, ExchangeNode):
+                continue
+            ex = ExchangeNode(
+                graph, inp, ctx, routing,
+                name=f"Exchange->{node.name}",
+            )
+            node.inputs[i] = ex
+            spliced.append((node, i, inp))
+    return spliced
+
+
+def unsplice_exchanges(spliced: list[tuple[Node, int, Node]]) -> None:
+    """Undo a splice pass: restore original inputs (teardown of one run)."""
+    for node, i, orig in spliced:
+        node.inputs[i] = orig

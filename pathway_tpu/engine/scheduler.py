@@ -21,8 +21,17 @@ from pathway_tpu.engine.probes import SchedulerStats
 
 
 class Scheduler:
-    def __init__(self, graph: EngineGraph, targets: list[Node] | None = None):
+    def __init__(self, graph: EngineGraph, targets: list[Node] | None = None,
+                 exchange_ctx=None):
         self.graph = graph
+        self.exchange_ctx = exchange_ctx
+        self._spliced = []
+        if exchange_ctx is not None:
+            from pathway_tpu.engine.exchange import splice_exchanges
+
+            self._spliced = splice_exchanges(
+                graph, graph.topo_order(targets), exchange_ctx
+            )
         self.order = graph.topo_order(targets)
         self._order_ids = {n.id for n in self.order}
         self._lock = threading.Condition()
@@ -90,6 +99,8 @@ class Scheduler:
 
     def run(self) -> None:
         """Process events until all sources are closed and queues drain."""
+        if self.exchange_ctx is not None:
+            return self._run_multiprocess()
         while True:
             with self._lock:
                 while True:
@@ -107,6 +118,57 @@ class Scheduler:
                     self._lock.wait(timeout=0.5)
                 t = ready[0]
                 injected = self._pending.pop(t)
+            self._run_epoch(t, injected)
+
+    def teardown_exchanges(self) -> None:
+        """Close the peer mesh and restore the user graph's original wiring
+        (the graph is global; exchanges bound to a dead mesh must not leak
+        into later runs)."""
+        if self.exchange_ctx is None:
+            return
+        from pathway_tpu.engine.exchange import unsplice_exchanges
+
+        unsplice_exchanges(self._spliced)
+        self._spliced = []
+        self.exchange_ctx.close()
+
+    def _run_multiprocess(self) -> None:
+        """Lockstep multi-process loop: every round, all processes agree on
+        the globally smallest ready epoch time and run that epoch together
+        (ExchangeNodes inside the epoch barrier per-operator). A process
+        with no local events still runs the epoch — it must serve its side
+        of every exchange. Replaces timely's distributed progress tracking
+        for the totally-ordered single-dimension case."""
+        ctx = self.exchange_ctx
+        rnd = 0
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                ready = self._ready_times()
+                local_t = ready[0] if ready else None
+                frontier = min(self._source_frontiers.values(), default=None)
+                live = bool(self._source_frontiers)
+                inflight = self._async_inflight > 0
+            states = ctx.control_allgather(
+                rnd, (local_t, frontier, live, inflight)
+            )
+            rnd += 1
+            times = [s[0] for s in states.values() if s[0] is not None]
+            frontiers = [s[1] for s in states.values() if s[1] is not None]
+            # a time is globally safe only below every process's source
+            # frontier — a peer's source may still emit earlier events that
+            # will be exchanged into this process's operators
+            global_frontier = min(frontiers) if frontiers else None
+            t = min(times) if times else None
+            if t is None or (global_frontier is not None
+                             and t >= global_frontier):
+                if any(s[2] or s[3] for s in states.values()) or times:
+                    time.sleep(0.02)
+                    continue
+                return
+            with self._lock:
+                injected = self._pending.pop(t, {})
             self._run_epoch(t, injected)
 
     def run_available(self) -> bool:

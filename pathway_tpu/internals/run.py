@@ -40,7 +40,17 @@ class GraphRunner:
         from pathway_tpu.internals.http_server import MetricsServer
         from pathway_tpu.internals.monitoring import maybe_start_monitor
 
-        sched = Scheduler(G.engine_graph, self.targets)
+        exchange_ctx = None
+        n_proc = config_mod.pathway_config.processes
+        pid = config_mod.pathway_config.process_id
+        if n_proc > 1:
+            from pathway_tpu.engine.exchange import ExchangeContext, PeerMesh
+
+            exchange_ctx = ExchangeContext(
+                PeerMesh(pid, n_proc, config_mod.pathway_config.first_port)
+            )
+        sched = Scheduler(G.engine_graph, self.targets,
+                          exchange_ctx=exchange_ctx)
         global LAST_RUN_STATS
         LAST_RUN_STATS = sched.stats
         monitor = maybe_start_monitor(sched.stats, self.monitoring_level)
@@ -93,15 +103,21 @@ class GraphRunner:
                 else:
                     for node, state in staged:
                         node.state_restore(state)
-        # static sources
+        # static sources (multi-process: injected on process 0 only; the
+        # exchange layer routes rows to their owner shards)
         static = [
             (node, provider)
             for node, provider in G.static_sources.values()
             if node.id in involved
         ]
+        if exchange_ctx is not None and pid != 0:
+            static = []
         for node, _ in static:
             sched.register_source(node, 0)
         connectors = [c for c in G.connectors if c.node.id in involved]
+        if exchange_ctx is not None and pid != 0:
+            # non-shardable connectors run on process 0 only
+            connectors = [c for c in connectors if c.shardable]
         if manager is not None:
             for c in connectors:
                 c.setup_persistence(manager)
@@ -116,7 +132,11 @@ class GraphRunner:
             c.start(sched)
         try:
             sched.run()
-            # end-of-stream: flush buffers repeatedly until quiescent
+            # end-of-stream: flush buffers repeatedly until quiescent.
+            # Multi-process: the "anyone flushed?" decision must be global —
+            # a process that flushed nothing still has to serve exchanges
+            # for peers that did.
+            flush_round = 1 << 40  # disjoint from the scheduler's rounds
             while True:
                 flushed = False
                 for node in sched.order:
@@ -132,12 +152,19 @@ class GraphRunner:
                             node, t, Batch.from_rows(node.column_names, rows)
                         )
                         flushed = True
+                if exchange_ctx is not None:
+                    states = exchange_ctx.control_allgather(
+                        flush_round, flushed
+                    )
+                    flush_round += 1
+                    flushed = any(states.values())
                 if not flushed:
                     break
                 sched.run()
         finally:
             for c in connectors:
                 c.stop()
+            sched.teardown_exchanges()
             sched.stats.finished = True
             if monitor is not None:
                 monitor.stop()

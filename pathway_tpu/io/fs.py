@@ -128,11 +128,20 @@ class _FsConnector(BaseConnector):
         if isinstance(offset, dict):
             self._seen.update(offset)
 
+    shardable = True  # files partition across processes by path hash
+
     def _read_all(self, seen: dict[str, float]) -> list[tuple[int, tuple, int]]:
+        from pathway_tpu.internals import config as config_mod
+        from pathway_tpu.engine.value import shard_of_key
+
+        n_proc = config_mod.pathway_config.processes
+        pid = config_mod.pathway_config.process_id
         cols = list(self.node.column_names)
         rows = []
         pk = self.schema.primary_key_columns()
         for fp in _list_files(self.path):
+            if n_proc > 1 and shard_of_key(hash_values(fp), n_proc) != pid:
+                continue
             try:
                 mtime = os.path.getmtime(fp)
             except OSError:
@@ -212,6 +221,12 @@ def read(
 
 def write(table: Table, filename: str | os.PathLike, *, format: str = "json", **kwargs) -> None:  # noqa: A002
     filename = os.fspath(filename)
+    from pathway_tpu.internals import config as config_mod
+
+    if config_mod.pathway_config.processes > 1:
+        # each process writes its own shard (reference cluster mode: every
+        # worker owns its output partition)
+        filename = f"{filename}.{config_mod.pathway_config.process_id}"
     cols = list(table.column_names())
     f = open(filename, "w", encoding="utf-8")  # noqa: SIM115 - lifetime = run
     if format == "csv":
