@@ -13,3 +13,24 @@ def next_pow2(n: int, floor: int = 1) -> int:
     """Smallest power of two >= max(n, floor). ``floor`` must be a power of
     two; it sets the minimum bucket so tiny batches share one executable."""
     return max(floor, 1 << math.ceil(math.log2(max(n, 1))))
+
+
+def canonical_metric(metric) -> str:
+    """Normalise a metric name: anything starting with "l2" means squared
+    L2; everything else is cosine/dot on normalised vectors."""
+    return "l2" if str(metric).lower().startswith("l2") else "cos"
+
+
+def prep_host_vectors(vectors, metric: str):
+    """Host-side (numpy) prep shared by the vector indexes: (m, d) float32,
+    unit-normalised for cosine (zero vectors pass through unscaled)."""
+    import numpy as np
+
+    v = np.asarray(vectors, dtype=np.float32)
+    if v.ndim == 1:
+        v = v[None, :]
+    if metric == "cos":
+        norms = np.linalg.norm(v, axis=1, keepdims=True)
+        norms[norms == 0] = 1.0
+        v = v / norms
+    return v

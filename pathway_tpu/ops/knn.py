@@ -20,7 +20,7 @@ from __future__ import annotations
 import functools
 import math
 
-from pathway_tpu.ops import next_pow2
+from pathway_tpu.ops import canonical_metric, next_pow2, prep_host_vectors
 from typing import Any
 
 import numpy as np
@@ -126,7 +126,7 @@ class BruteForceKnnIndex:
         dtype=jnp.bfloat16,
     ):
         self.dim = dimensions
-        self.metric = "l2" if str(metric).lower().startswith("l2") else "cos"
+        self.metric = canonical_metric(metric)
         self.capacity = next_pow2(reserved_space, 16)
         self.dtype = dtype
         self._corpus = jnp.zeros((self.capacity, self.dim), dtype=dtype)
@@ -151,14 +151,7 @@ class BruteForceKnnIndex:
 
     # ------------------------------------------------------------------ update
     def _prep(self, vectors: np.ndarray) -> np.ndarray:
-        v = np.asarray(vectors, dtype=np.float32)
-        if v.ndim == 1:
-            v = v[None, :]
-        if self.metric == "cos":
-            norms = np.linalg.norm(v, axis=1, keepdims=True)
-            norms[norms == 0] = 1.0
-            v = v / norms
-        return v
+        return prep_host_vectors(vectors, self.metric)
 
     def _append(self, keys: list, v, normalize: bool) -> None:
         """Shared append: v is a (m, d) array; normalised on device iff

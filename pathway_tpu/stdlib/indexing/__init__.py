@@ -13,6 +13,8 @@ from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex, HybridIndexFac
 from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     BruteForceKnn,
     BruteForceKnnFactory,
+    IvfKnn,
+    IvfKnnFactory,
     DistanceMetric,
     LshKnn,
     USearchKnn,
@@ -44,6 +46,8 @@ __all__ = [
     "InnerIndex",
     "BruteForceKnn",
     "BruteForceKnnFactory",
+    "IvfKnn",
+    "IvfKnnFactory",
     "USearchKnn",
     "UsearchKnnFactory",
     "LshKnn",

@@ -106,6 +106,61 @@ class USearchKnn(BruteForceKnn):
         self.expansion_search = expansion_search
 
 
+class _IvfIndexFactory(ExternalIndexFactory):
+    def __init__(self, dimensions, n_cells, nprobe, metric, train_after):
+        self.dimensions = dimensions
+        self.n_cells = n_cells
+        self.nprobe = nprobe
+        self.metric = metric
+        self.train_after = train_after
+
+    def make_instance(self):
+        from pathway_tpu.ops.ivf import IvfFlatIndex
+
+        return IvfFlatIndex(
+            dimensions=self.dimensions,
+            n_cells=self.n_cells,
+            nprobe=self.nprobe,
+            metric=self.metric,
+            train_after=self.train_after,
+        )
+
+
+class IvfKnn(BruteForceKnn):
+    """Approximate KNN: IVF-Flat on TPU (``ops/ivf.py``) — the TPU-native
+    ANN filling the reference's uSearch HNSW role. Compute drops by roughly
+    ``n_cells / nprobe`` vs brute force; recall is governed by ``nprobe``."""
+
+    def __init__(
+        self,
+        data_column: ColumnReference,
+        metadata_column=None,
+        *,
+        dimensions: int,
+        n_cells: int = 64,
+        nprobe: int = 8,
+        metric: DistanceMetric | str = DistanceMetric.COS,
+        train_after: int | None = None,
+        embedder: Callable | None = None,
+    ):
+        super().__init__(
+            data_column,
+            metadata_column,
+            dimensions=dimensions,
+            metric=metric,
+            embedder=embedder,
+        )
+        self.n_cells = n_cells
+        self.nprobe = nprobe
+        self.train_after = train_after
+
+    def make_factory(self):
+        return _IvfIndexFactory(
+            self.dimensions, self.n_cells, self.nprobe, self.metric,
+            self.train_after,
+        )
+
+
 class LshKnn(BruteForceKnn):
     """LSH-bucketed KNN (reference ``LshKnn:262`` — bucketing reduces the
     candidate set; the TPU gemm already scans the full corpus faster, so the
@@ -147,6 +202,29 @@ class BruteForceKnnFactory:
             dimensions=self.dimensions or 0,
             reserved_space=self.reserved_space,
             metric=self.metric,
+            embedder=self.embedder,
+        )
+        return DataIndex(data_table, inner)
+
+
+@dataclass
+class IvfKnnFactory:
+    dimensions: int | None = None
+    n_cells: int = 64
+    nprobe: int = 8
+    metric: DistanceMetric | str = DistanceMetric.COS
+    train_after: int | None = None
+    embedder: Callable | None = None
+
+    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
+        inner = IvfKnn(
+            data_column,
+            metadata_column,
+            dimensions=self.dimensions or 0,
+            n_cells=self.n_cells,
+            nprobe=self.nprobe,
+            metric=self.metric,
+            train_after=self.train_after,
             embedder=self.embedder,
         )
         return DataIndex(data_table, inner)

@@ -213,3 +213,52 @@ def test_pallas_fused_topk_matches_xla():
                     assert set(np.asarray(idx)[i]) == set(ref_idx[i])
         finally:
             pallas_knn._Q_TILE = old_q_tile
+
+
+def test_ivf_knn_index_recall_and_deletes():
+    """IVF-Flat ANN (ops/ivf.py): recall vs brute force on clustered data,
+    delete correctness, and retrain-triggered rebuild."""
+    from pathway_tpu.ops.ivf import IvfFlatIndex
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    rng = np.random.default_rng(0)
+    D, N, Q, K = 16, 1500, 16, 5
+    centers = rng.normal(size=(8, D)) * 3
+    vecs = (centers[rng.integers(0, 8, N)]
+            + rng.normal(size=(N, D))).astype(np.float32)
+    queries = (centers[rng.integers(0, 8, Q)]
+               + rng.normal(size=(Q, D))).astype(np.float32)
+    keys = [f"k{i}" for i in range(N)]
+
+    ivf = IvfFlatIndex(dimensions=D, n_cells=8, nprobe=3, train_after=256)
+    bf = BruteForceKnnIndex(dimensions=D, reserved_space=N)
+    for s in range(0, N, 300):
+        ivf.add(keys[s:s + 300], vecs[s:s + 300])
+        bf.add(keys[s:s + 300], vecs[s:s + 300])
+    assert ivf._trained
+
+    hits_ivf = ivf.search(queries, K)
+    hits_bf = bf.search(queries, K)
+    recall = np.mean([
+        len({k for k, _ in hi} & {k for k, _ in hb}) / K
+        for hi, hb in zip(hits_ivf, hits_bf)
+    ])
+    assert recall > 0.7, recall
+
+    ivf.remove(keys[:50])
+    assert len(ivf) == N - 50
+    assert all(k != "k0" for k, _ in ivf.search(vecs[:1], K)[0])
+
+
+def test_ivf_knn_in_dataflow():
+    """IvfKnn through DataIndex.query_as_of_now."""
+    from pathway_tpu.stdlib.indexing import DataIndex, IvfKnn
+
+    docs, queries, _ = _vec_tables()
+    index = DataIndex(
+        docs, IvfKnn(docs.vec, dimensions=8, n_cells=4, nprobe=4)
+    )
+    res = index.query_as_of_now(queries.qvec, number_of_matches=2)
+    rows, cols = _capture_rows(res)
+    di = cols.index("doc")
+    assert all(len(row[di]) == 2 for row in rows.values())
