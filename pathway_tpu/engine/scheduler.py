@@ -22,7 +22,7 @@ from pathway_tpu.engine.probes import SchedulerStats
 
 class Scheduler:
     def __init__(self, graph: EngineGraph, targets: list[Node] | None = None,
-                 exchange_ctx=None):
+                 exchange_ctx=None, threads: int | None = None):
         self.graph = graph
         self.exchange_ctx = exchange_ctx
         self._spliced = []
@@ -34,6 +34,34 @@ class Scheduler:
             )
         self.order = graph.topo_order(targets)
         self._order_ids = {n.id for n in self.order}
+        # PATHWAY_THREADS > 1: step independent operators (same topo level)
+        # concurrently — the in-process analog of the reference's worker
+        # threads. numpy/jax kernels release the GIL, so dense operators
+        # genuinely overlap; results are deterministic because a level only
+        # starts after every producer level finished.
+        from pathway_tpu.internals import config as config_mod
+
+        if threads is None:
+            threads = config_mod.pathway_config.threads
+        self._n_threads = max(1, threads)
+        self._pool = None
+        self._levels: list[list[Node]] | None = None
+        if self._n_threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._n_threads,
+                thread_name_prefix="pathway:work",
+            )
+            level_of: dict[int, int] = {}
+            levels: dict[int, list[Node]] = {}
+            for n in self.order:
+                lvl = 1 + max(
+                    (level_of.get(i.id, 0) for i in n.inputs), default=0
+                )
+                level_of[n.id] = lvl
+                levels.setdefault(lvl, []).append(n)
+            self._levels = [levels[k] for k in sorted(levels)]
         self._lock = threading.Condition()
         # time -> node_id -> [Batch]; injected events (inputs + late emissions)
         self._pending: dict[int, dict[int, list[Batch]]] = defaultdict(
@@ -120,6 +148,12 @@ class Scheduler:
                 injected = self._pending.pop(t)
             self._run_epoch(t, injected)
 
+    def shutdown(self) -> None:
+        """Release the worker pool (run.py teardown)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=True, cancel_futures=True)
+            self._pool = None
+
     def teardown_exchanges(self) -> None:
         """Close the peer mesh and restore the user graph's original wiring
         (the graph is global; exchanges bound to a dead mesh must not leak
@@ -185,39 +219,67 @@ class Scheduler:
             self._run_epoch(t, injected)
             ran = True
 
+    def _step_node(self, node: Node, t: int,
+                   outputs: dict[int, "Batch | None"],
+                   injected: dict[int, list[Batch]]) -> None:
+        ins = [
+            outputs.get(i.id) if i.id in self._order_ids else None
+            for i in node.inputs
+        ]
+        started = time.perf_counter()
+        try:
+            out = node.step(t, ins)
+        except Exception as exc:
+            from pathway_tpu.internals.trace import add_error_trace
+
+            raise add_error_trace(exc, node.trace)
+        extra = injected.get(node.id)
+        if extra:
+            out = concat_batches([out] + extra) if out is not None else concat_batches(extra)
+        result = consolidate(out) if out is not None else None
+        outputs[node.id] = result
+        rows_in = sum(len(b) for b in ins if b is not None) + sum(
+            len(b) for b in (extra or [])
+        )
+        if rows_in or result is not None:
+            self.stats.record_step(
+                node.id,
+                node.name,
+                rows_in,
+                len(result) if result is not None else 0,
+                time.perf_counter() - started,
+            )
+
     def _run_epoch(self, t: int, injected: dict[int, list[Batch]]) -> None:
         self.current_time = t
         self.stats.current_time = t
         self.stats.epochs_total += 1
         outputs: dict[int, Batch | None] = {}
-        for node in self.order:
-            ins = [
-                outputs.get(i.id) if i.id in self._order_ids else None
-                for i in node.inputs
-            ]
-            started = time.perf_counter()
-            try:
-                out = node.step(t, ins)
-            except Exception as exc:
-                from pathway_tpu.internals.trace import add_error_trace
-
-                raise add_error_trace(exc, node.trace)
-            extra = injected.get(node.id)
-            if extra:
-                out = concat_batches([out] + extra) if out is not None else concat_batches(extra)
-            result = consolidate(out) if out is not None else None
-            outputs[node.id] = result
-            rows_in = sum(len(b) for b in ins if b is not None) + sum(
-                len(b) for b in (extra or [])
-            )
-            if rows_in or result is not None:
-                self.stats.record_step(
-                    node.id,
-                    node.name,
-                    rows_in,
-                    len(result) if result is not None else 0,
-                    time.perf_counter() - started,
-                )
+        if self._pool is not None and self._levels is not None:
+            for level in self._levels:
+                if len(level) == 1:
+                    self._step_node(level[0], t, outputs, injected)
+                    continue
+                futures = [
+                    self._pool.submit(
+                        self._step_node, node, t, outputs, injected
+                    )
+                    for node in level
+                ]
+                # wait for the WHOLE level even on failure: abandoned
+                # siblings would keep stepping (and, in cluster mode, block
+                # in exchanges) while the caller unwinds and tears down
+                errors = []
+                for f in futures:
+                    try:
+                        f.result()
+                    except Exception as exc:  # noqa: BLE001
+                        errors.append(exc)
+                if errors:
+                    raise errors[0]
+        else:
+            for node in self.order:
+                self._step_node(node, t, outputs, injected)
         # epoch complete: notify operators; collect late emissions
         for node in self.order:
             for future_t, batch in node.on_time_end(t):

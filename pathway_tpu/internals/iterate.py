@@ -104,7 +104,9 @@ class IterateNode(Node):
             CaptureNode(self.subgraph, o) for o in self.sub_outputs
         ] if not hasattr(self, "_captures") else self._captures
         self._captures = captures
-        sched = Scheduler(self.subgraph, captures)
+        # one Scheduler per fixpoint round: run single-threaded (a thread
+        # pool per round would leak workers; the subgraph is small anyway)
+        sched = Scheduler(self.subgraph, captures, threads=1)
         for n in sched.order:
             n.reset()
         for inp, rows in zip(self.sub_inputs, currents):

@@ -165,6 +165,7 @@ class GraphRunner:
             for c in connectors:
                 c.stop()
             sched.teardown_exchanges()
+            sched.shutdown()
             sched.stats.finished = True
             if monitor is not None:
                 monitor.stop()

@@ -569,3 +569,36 @@ def test_hmm_reducer_decodes_most_likely_path():
     decoded = row[cols.index("decoded")]
     assert len(decoded) == 3  # truncated by num_results_kept
     assert decoded[-1] == "HUNGRY"  # grumpy tail decodes to hungry
+
+
+def test_multithreaded_epoch_matches_single(monkeypatch):
+    """PATHWAY_THREADS>1 steps independent operators concurrently; results
+    must match the sequential scheduler exactly."""
+    import pathway_tpu as pw
+    from tests.utils import _capture_rows
+
+    def pipeline():
+        t = pw.debug.table_from_markdown(
+            """
+            g | v
+            a | 1
+            a | 2
+            b | 3
+            b | 4
+            c | 5
+            """
+        )
+        # two independent subgraphs (parallelizable levels) joined at the end
+        sums = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+        maxs = t.groupby(t.g).reduce(t.g, m=pw.reducers.max(t.v))
+        joined = sums.join(maxs, sums.g == maxs.g).select(
+            sums.g, sums.s, maxs.m
+        )
+        return _capture_rows(joined)
+
+    ref_rows, ref_cols = pipeline()
+    pw.clear_graph()
+    monkeypatch.setenv("PATHWAY_THREADS", "4")
+    par_rows, par_cols = pipeline()
+    assert par_cols == ref_cols
+    assert par_rows == ref_rows

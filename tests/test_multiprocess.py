@@ -206,3 +206,49 @@ def test_two_process_streaming_updates(tmp_path):
         net[(r["word"], r["c"])] = net.get((r["word"], r["c"]), 0) + r["diff"]
     final = {w: c for (w, c), d in net.items() if d > 0}
     assert final == {"alpha": 2, "beta": 1, "gamma": 1}
+
+
+def test_two_process_recovery_resume(tmp_path):
+    """Persistence + cluster mode (the reference's recovery rig shape,
+    integration_tests/wordcount): a 2-process persistent run, then a second
+    2-process run with extra input resumes from snapshots and produces
+    combined counts."""
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "a.jsonl").write_text(
+        "".join(json.dumps({"word": w}) + "\n" for w in ["cat", "dog", "cat"])
+    )
+
+    script_tpl = textwrap.dedent(
+        """
+        import pathway_tpu as pw
+
+        class S(pw.Schema):
+            word: str
+
+        t = pw.io.jsonlines.read("src", schema=S, mode="static",
+                                 persistent_id="words-src")
+        counts = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+        pw.io.jsonlines.write(counts, "OUT")
+        pw.run(persistence_config=pw.persistence.Config.simple_config(
+            pw.persistence.Backend.filesystem("store")))
+        """
+    )
+    _spawn(script_tpl.replace("OUT", "out1.jsonl"), tmp_path, processes=2)
+    rows = _read_shards(tmp_path, "out1.jsonl", 2)
+    net: dict[tuple, int] = {}
+    for r in rows:
+        net[(r["word"], r["c"])] = net.get((r["word"], r["c"]), 0) + r["diff"]
+    assert {w: c for (w, c), d in net.items() if d > 0} == {"cat": 2, "dog": 1}
+
+    (src / "b.jsonl").write_text(
+        "".join(json.dumps({"word": w}) + "\n" for w in ["cat", "bird"])
+    )
+    _spawn(script_tpl.replace("OUT", "out2.jsonl"), tmp_path, processes=2)
+    rows = _read_shards(tmp_path, "out2.jsonl", 2)
+    net = {}
+    for r in rows:
+        net[(r["word"], r["c"])] = net.get((r["word"], r["c"]), 0) + r["diff"]
+    assert {w: c for (w, c), d in net.items() if d > 0} == {
+        "cat": 3, "dog": 1, "bird": 1,
+    }
