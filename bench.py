@@ -12,12 +12,14 @@ is ~2800 docs/sec (fp16, batch 256, seq 128); 4x => 11200 docs/sec. Recall is
 exact by construction here (brute-force index), so vs_baseline is
 docs_per_sec / 11200.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line to stdout: {"metric", "value", "unit", "vs_baseline"}.
+Diagnostics (e.g. a degraded-device warning) go to stderr.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -52,13 +54,14 @@ def main() -> None:
     # compute in the real pipeline; the benchmark isolates the device path).
     # Every ingested batch is DISTINCT — identical dispatches can be deduped
     # by the runtime, which would inflate the measurement.
-    n_unique = N_REPS * N_BATCHES + 1
+    # +2: one warmup batch and one probe batch precede the timed windows
+    n_unique = N_REPS * N_BATCHES + 2
     all_ids = rng.integers(1000, cfg.vocab_size, size=(n_unique, BATCH, SEQ))
     mask = jnp.ones((BATCH, SEQ), dtype=jnp.int32)
 
     index = BruteForceKnnIndex(
         dimensions=cfg.hidden,
-        reserved_space=BATCH * (N_REPS * N_BATCHES + 1),
+        reserved_space=BATCH * n_unique,
         metric="cos",
     )
 
@@ -73,6 +76,36 @@ def main() -> None:
     index.search(emb[:8], k=TOP_K)
     jax.block_until_ready(emb)
 
+    # probe the chip: under heavy contention (shared dev chip) a batch can
+    # run 100x slower than steady state; shrink the workload so the bench
+    # still completes and reports an honest (noisier) rate within budget
+    t0 = time.perf_counter()
+    jax.device_get(ingest_batch(0)[:1])
+    per_batch = time.perf_counter() - t0
+    n_batches, n_reps = N_BATCHES, N_REPS
+    budget_s = 240.0
+    if per_batch * N_BATCHES * N_REPS > budget_s:
+        raw = int(budget_s / (per_batch * N_REPS))
+        if raw >= 3:
+            n_batches = raw
+        else:
+            # floor of 3 batches; shed reps (and accept blowing the budget
+            # only in the extreme per_batch > budget/3 case)
+            n_batches = 3
+            n_reps = max(1, int(budget_s / (per_batch * n_batches)))
+        print(
+            json.dumps(
+                {
+                    "warning": "degraded_device_detected",
+                    "probe_batch_seconds": round(per_batch, 2),
+                    "reduced_to_batches": n_batches,
+                    "reduced_to_reps": n_reps,
+                }
+            ),
+            file=sys.stderr,
+            flush=True,
+        )
+
     # steady state: ingest stream with interleaved retrievals. Searches are
     # dispatched asynchronously (the subscriber pattern — results drain to the
     # sink without stalling ingest) and all device→host fetches happen as ONE
@@ -82,19 +115,19 @@ def main() -> None:
     # a single window 2-3x, and the max is the least-noise estimate of the
     # device's steady-state rate.
     docs_per_sec = 0.0
-    for rep in range(N_REPS):
+    for rep in range(n_reps):
         start = time.perf_counter()
         last = None
         pending = []
-        for b in range(N_BATCHES):
-            last = ingest_batch(rep * N_BATCHES + b)
+        for b in range(n_batches):
+            last = ingest_batch(1 + rep * n_batches + b)
             if b % QUERY_EVERY == 0:
                 pending.append(index.search_device(last[:8], k=TOP_K))
         results = jax.device_get((pending, last))  # drains the whole stream
         elapsed = time.perf_counter() - start
         for scores, idx in results[0]:
             assert scores.shape[1] == TOP_K
-        docs_per_sec = max(docs_per_sec, BATCH * N_BATCHES / elapsed)
+        docs_per_sec = max(docs_per_sec, BATCH * n_batches / elapsed)
     print(
         json.dumps(
             {
