@@ -76,6 +76,7 @@ from pathway_tpu.internals import config as _config
 from pathway_tpu.internals.config import set_license_key, set_monitoring_config
 
 # submodule namespaces (populated lazily to avoid import cycles)
+from pathway_tpu import asynchronous  # noqa: E402
 from pathway_tpu import debug  # noqa: E402
 from pathway_tpu import io  # noqa: E402
 from pathway_tpu import persistence  # noqa: E402

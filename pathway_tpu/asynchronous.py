@@ -1,0 +1,19 @@
+"""DEPRECATED — content moved to ``pathway_tpu.udfs``.
+
+Reference parity: ``python/pathway/asynchronous.py`` (deprecated alias
+module forwarding to ``pathway.internals.udfs``). Kept so code written
+against the old import path keeps working with a warning.
+"""
+
+from warnings import warn
+
+from pathway_tpu.internals import udfs
+
+
+def __getattr__(name):
+    warn(
+        "pathway_tpu.asynchronous is deprecated; use pathway_tpu.udfs.",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    return getattr(udfs, name)

@@ -225,10 +225,39 @@ def serialize_value(value: Any, out: bytearray) -> None:
         # arbitrary python object — fall back to pickle (PyObjectWrapper parity)
         import pickle
 
-        b = pickle.dumps(value, protocol=4)
+        try:
+            b = pickle.dumps(value, protocol=4)
+        except Exception:  # noqa: BLE001 - unpicklable (e.g. local class):
+            # hash by identity token. Only consolidation equality is
+            # affected; routing uses row keys, never object-column hashes.
+            b = struct.pack("<Q", _identity_token(value))
         out += _TAG_OBJ
         out += struct.pack("<I", len(b))
         out += b
+
+
+# Identity tokens for unpicklable objects: raw id() would falsely equate two
+# distinct objects when CPython reuses a freed address; a weakref-guarded
+# monotonic token stays unique for the life of each object.
+_identity_tokens: dict[int, tuple] = {}
+_identity_counter = [0]
+
+
+def _identity_token(obj) -> int:
+    import weakref
+
+    addr = id(obj)
+    entry = _identity_tokens.get(addr)
+    if entry is not None and entry[0]() is obj:
+        return entry[1]
+    _identity_counter[0] += 1
+    tok = _identity_counter[0] & 0xFFFFFFFFFFFFFFFF
+    try:
+        ref = weakref.ref(obj)
+    except TypeError:
+        ref = (lambda o: (lambda: o))(obj)  # unweakrefable: pin it
+    _identity_tokens[addr] = (ref, tok)
+    return tok
 
 
 SHARD_BITS = 16

@@ -28,6 +28,25 @@ class CsvParserSettings:
     comment_character: str | None = None
 
 
+_ABSENT = object()
+
+
+def parse_record_fields(record: dict, cols: list[str],
+                        dtypes: dict[str, Any], schema) -> dict:
+    """Parse one record into column values with schema-default semantics
+    shared by every schema-driven connector: an ABSENT field takes the
+    column's default_value (when it has one); an explicit null stays None."""
+    defaults = schema.default_values()
+    out = {}
+    for c in cols:
+        raw = record.get(c, _ABSENT)
+        if raw is _ABSENT and c in defaults:
+            out[c] = defaults[c]
+        else:
+            out[c] = parse_value(None if raw is _ABSENT else raw, dtypes[c])
+    return out
+
+
 def parse_value(raw: Any, dtype: dt.DType):
     """Parse a raw (string or json) value into the dtype's representation."""
     if raw is None:

@@ -17,7 +17,7 @@ from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._streams import BaseConnector
-from pathway_tpu.io._utils import parse_value
+from pathway_tpu.io._utils import parse_record_fields, parse_value
 from pathway_tpu.io.kafka import InMemoryKafkaBroker
 
 
@@ -37,7 +37,7 @@ class _DebeziumConnector(BaseConnector):
 
         cols = list(self.node.column_names)
         dtypes = {n: c.dtype for n, c in self.schema.__columns__.items()}
-        values = {c: parse_value(record.get(c), dtypes[c]) for c in cols}
+        values = parse_record_fields(record, cols, dtypes, self.schema)
         pk = self.schema.primary_key_columns()
         if pk:
             key = hash_values(*[values[c] for c in pk])

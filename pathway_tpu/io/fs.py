@@ -24,7 +24,12 @@ from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._streams import BaseConnector, next_commit_time
-from pathway_tpu.io._utils import CsvParserSettings, format_value_for_output, parse_value
+from pathway_tpu.io._utils import (
+    CsvParserSettings,
+    format_value_for_output,
+    parse_record_fields,
+    parse_value,
+)
 
 
 def _list_files(path: str) -> list[str]:
@@ -59,7 +64,9 @@ def _metadata_for(path: str) -> Json:
 
 
 def _iter_records(path: str, fmt: str, schema, csv_settings: CsvParserSettings | None):
-    """Yield per-file lists of value dicts."""
+    """Yield per-file lists of value dicts. Absent fields take the schema
+    column's default_value when it has one; explicit nulls stay None
+    (reference parser semantics, shared via parse_record_fields)."""
     cols = [c for c in schema.column_names() if c != "_metadata"]
     dtypes = {n: c.dtype for n, c in schema.__columns__.items()}
     if fmt in ("csv", "dsv"):
@@ -67,7 +74,7 @@ def _iter_records(path: str, fmt: str, schema, csv_settings: CsvParserSettings |
         with open(path, newline="", encoding="utf-8", errors="replace") as f:
             reader = csv_mod.DictReader(f, delimiter=settings.delimiter, quotechar=settings.quote)
             for record in reader:
-                yield {c: parse_value(record.get(c), dtypes[c]) for c in cols}
+                yield parse_record_fields(record, cols, dtypes, schema)
     elif fmt in ("json", "jsonlines"):
         with open(path, encoding="utf-8", errors="replace") as f:
             for line in f:
@@ -78,7 +85,7 @@ def _iter_records(path: str, fmt: str, schema, csv_settings: CsvParserSettings |
                     obj = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                yield {c: parse_value(obj.get(c), dtypes[c]) for c in cols}
+                yield parse_record_fields(obj, cols, dtypes, schema)
     elif fmt == "plaintext":
         with open(path, encoding="utf-8", errors="replace") as f:
             for line in f:

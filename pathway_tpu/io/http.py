@@ -26,7 +26,7 @@ from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._streams import BaseConnector, next_commit_time
-from pathway_tpu.io._utils import format_value_for_output, parse_value
+from pathway_tpu.io._utils import format_value_for_output, parse_record_fields, parse_value
 
 
 class EndpointDocumentation:
@@ -118,7 +118,7 @@ class _RestConnector(BaseConnector):
     async def _handle(self, payload: dict):
         cols = list(self.node.column_names)
         dtypes = {n: c.dtype for n, c in self.schema.__columns__.items()}
-        values = {c: parse_value(payload.get(c), dtypes[c]) for c in cols}
+        values = parse_record_fields(payload, cols, dtypes, self.schema)
         key = hash_values(str(uuid.uuid4()))
         loop = asyncio.get_event_loop()
         fut: asyncio.Future = loop.create_future()

@@ -20,7 +20,7 @@ from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._streams import BaseConnector, next_commit_time
-from pathway_tpu.io._utils import parse_value
+from pathway_tpu.io._utils import parse_record_fields, parse_value
 
 
 class InMemoryKafkaBroker:
@@ -76,7 +76,7 @@ class _BrokerConnector(BaseConnector):
                         values = {"data": value}
                     else:
                         obj = json.loads(value)
-                        values = {c: parse_value(obj.get(c), dtypes[c]) for c in cols}
+                        values = parse_record_fields(obj, cols, dtypes, self.schema)
                     if pk:
                         key = hash_values(*[values[c] for c in pk])
                     else:

@@ -20,7 +20,7 @@ from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._streams import BaseConnector, next_commit_time
-from pathway_tpu.io._utils import parse_value
+from pathway_tpu.io._utils import parse_record_fields, parse_value
 
 
 class ConnectorSubject(ABC):
@@ -114,7 +114,7 @@ class _PythonConnector(BaseConnector):
         rows = []
         for key_override, values, diff in buffer:
             self._processed += 1
-            parsed = {c: parse_value(values.get(c), dtypes[c]) for c in cols}
+            parsed = parse_record_fields(values, cols, dtypes, self.schema)
             if key_override is not None:
                 key = key_override
             elif pk:

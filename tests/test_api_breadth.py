@@ -1,0 +1,228 @@
+"""Second breadth batch: datetime/duration arithmetic, schema machinery,
+universe promises, py-object wrapping, Table.split, run_all, self-joins,
+demo generators — reference tests/test_common.py + expressions/ patterns."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import _capture_rows
+
+
+def test_duration_arithmetic_and_components():
+    t = pw.debug.table_from_markdown(
+        """
+        a                   | b
+        2024-03-05T10:00:00 | 2024-03-05T12:30:00
+        """
+    ).select(
+        a=pw.this.a.dt.strptime("%Y-%m-%dT%H:%M:%S"),
+        b=pw.this.b.dt.strptime("%Y-%m-%dT%H:%M:%S"),
+    )
+    res = t.select(
+        delta_h=(t.b - t.a).dt.hours(),
+        delta_m=(t.b - t.a).dt.minutes(),
+    )
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("delta_h")] == 2
+    assert row[cols.index("delta_m")] == 150
+
+
+def test_schema_defaults_and_primary_key(tmp_path):
+    import json
+
+    class S(pw.Schema):
+        name: str = pw.column_definition(primary_key=True)
+        score: int = pw.column_definition(default_value=7)
+
+    class SNull(pw.Schema):
+        name: str = pw.column_definition(primary_key=True)
+        score: int | None = pw.column_definition(default_value=7)
+
+    p = tmp_path / "in"
+    p.mkdir()
+    (p / "a.jsonl").write_text(
+        json.dumps({"name": "x", "score": 1}) + "\n"
+        + json.dumps({"name": "y"}) + "\n"
+        + json.dumps({"name": "z", "score": None}) + "\n"
+    )
+    t = pw.io.jsonlines.read(str(p), schema=SNull, mode="static")
+    rows, cols = _capture_rows(t)
+    got = {r[cols.index("name")]: r[cols.index("score")] for r in rows.values()}
+    # absent -> default; explicit null -> None (NOT the default)
+    assert got == {"x": 1, "y": 7, "z": None}
+    # primary-key keying: same name → same key across reads
+    from pathway_tpu.engine.value import hash_values
+
+    assert set(rows) == {hash_values("x"), hash_values("y"), hash_values("z")}
+
+
+def test_universe_promises_enable_restrict():
+    big = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    small = big.filter(big.a <= 2)
+    # restrict big to small's universe (requires subset knowledge — filter
+    # establishes it automatically)
+    res = big.restrict(small)
+    rows, _ = _capture_rows(res)
+    assert len(rows) == 2
+
+
+def test_wrap_py_object_travels_through_engine():
+    class Thing:
+        def __init__(self, v):
+            self.v = v
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    wrapped = t.select(
+        obj=pw.apply_with_type(lambda a: pw.wrap_py_object(Thing(a)), object, t.a)
+    )
+    out = wrapped.select(
+        v=pw.apply_with_type(lambda o: pw.unwrap_py_object(o).v, int, wrapped.obj)
+    )
+    rows, cols = _capture_rows(out)
+    assert sorted(r[cols.index("v")] for r in rows.values()) == [1, 2]
+
+
+def test_table_split():
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        5
+        9
+        """
+    )
+    lo, hi = t.split(t.a < 6)
+    lo_rows, _ = _capture_rows(lo)
+    hi_rows, _ = _capture_rows(hi)
+    assert len(lo_rows) == 2 and len(hi_rows) == 1
+
+
+def test_self_join_different_columns():
+    t = pw.debug.table_from_markdown(
+        """
+        emp  | mgr
+        ann  | bob
+        bob  | cyn
+        cyn  | cyn
+        """
+    )
+    t2 = t.copy() if hasattr(t, "copy") else t.select(emp2=t.emp, mgr2=t.mgr)
+    if hasattr(t, "copy"):
+        j = t.join(t2, t.mgr == t2.emp).select(emp=t.emp, grand=t2.mgr)
+    else:
+        j = t.join(t2, t.mgr == t2.emp2).select(emp=t.emp, grand=t2.mgr2)
+    rows, cols = _capture_rows(j)
+    got = {r[cols.index("emp")]: r[cols.index("grand")] for r in rows.values()}
+    assert got == {"ann": "cyn", "bob": "cyn", "cyn": "cyn"}
+
+
+def test_run_all_executes_registered_sinks(tmp_path):
+    import json
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        4
+        """
+    )
+    out = tmp_path / "out.jsonl"
+    pw.io.jsonlines.write(t.select(b=t.a * 2), str(out))
+    pw.run_all()
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert rows[0]["b"] == 8
+
+
+def test_demo_generators_produce_tables():
+    t = pw.demo.range_stream(
+        nb_rows=5, input_rate=50.0, autocommit_duration_ms=10
+    )
+    # static capture of a bounded demo stream
+    import threading
+    import time
+
+    res = t.reduce(total=pw.reducers.sum(t.value))
+
+    seen = {}
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            seen.update(row)
+
+    pw.io.subscribe(res, on_change=on_change)
+
+    def stopper():
+        time.sleep(2.5)
+        for c in pw.G.connectors:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stopper, daemon=True).start()
+    pw.run()
+    assert seen.get("total") == 0 + 1 + 2 + 3 + 4
+
+
+def test_flatten_two_tables_same_source():
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(name=str, tags=tuple),
+        rows=[("a", ("x", "y")), ("b", ("z",))],
+    )
+    flat = t.flatten(t.tags)
+    rows, cols = _capture_rows(flat)
+    assert sorted(r[cols.index("tags")] for r in rows.values()) == ["x", "y", "z"]
+    names = [r[cols.index("name")] for r in rows.values()]
+    assert sorted(names) == ["a", "a", "b"]
+
+
+def test_concat_disjoint_and_duplicate_key_error():
+    a = pw.debug.table_from_markdown(
+        """
+        v
+        1
+        """
+    )
+    b = pw.debug.table_from_markdown(
+        """
+        v
+        2
+        """
+    )
+    # same auto-keys on both sides: plain concat must refuse / error rows,
+    # concat_reindex must succeed
+    ok = a.concat_reindex(b)
+    rows, _ = _capture_rows(ok)
+    assert len(rows) == 2
+
+
+def test_groupby_multiple_columns():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b | v
+        x | 1 | 10
+        x | 1 | 20
+        x | 2 | 30
+        y | 1 | 40
+        """
+    )
+    res = t.groupby(t.a, t.b).reduce(t.a, t.b, s=pw.reducers.sum(t.v))
+    rows, cols = _capture_rows(res)
+    got = {
+        (r[cols.index("a")], r[cols.index("b")]): r[cols.index("s")]
+        for r in rows.values()
+    }
+    assert got == {("x", 1): 30, ("x", 2): 30, ("y", 1): 40}
