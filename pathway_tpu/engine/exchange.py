@@ -7,8 +7,11 @@ sockets (``external/timely-dataflow/communication/``, SURVEY.md §2.5). This
 module is the engine's equivalent:
 
 * ``PeerMesh`` — a full mesh of length-prefixed pickle sockets between the
-  ``PATHWAY_PROCESSES`` processes on localhost (``PATHWAY_FIRST_PORT + pid``),
-  with one reader thread per peer feeding shared buffers.
+  ``PATHWAY_PROCESSES`` processes on localhost (``PATHWAY_FIRST_PORT + pid``).
+  Message receipt is PULL-based: the thread waiting for a message drains the
+  sockets itself (``poll`` + select). The engine is lockstep, so exactly one
+  thread waits at a time — no reader threads to starve, crash, or race (an
+  earlier reader-thread design hung under load in this environment).
 * ``ExchangeContext`` — epoch-aligned primitives on top of the mesh:
   ``control_allgather`` (lockstep scheduler rounds: agree on the next global
   epoch time and on termination) and ``exchange`` (per-operator data barrier:
@@ -43,6 +46,18 @@ from pathway_tpu.engine.graph import Node
 from pathway_tpu.engine.value import keys_for_value_columns, shard_of_keys
 
 _LEN = struct.Struct("<Q")
+
+import os as _os
+
+_DEBUG = bool(_os.environ.get("PATHWAY_EXCHANGE_DEBUG"))
+
+
+def _dbg(msg: str) -> None:
+    if _DEBUG:
+        import sys
+
+        print(f"[exchange pid={_os.getpid()}] {msg}", file=sys.stderr,
+              flush=True)
 
 
 class PeerMesh:
@@ -101,37 +116,97 @@ class PeerMesh:
         missing = set(self.peers) - set(self._socks)
         if missing:
             raise TimeoutError(f"peers never connected: {missing}")
+        self._peer_of_sock: dict[socket.socket, int] = {}
         for p, s in self._socks.items():
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # bound every socket op: a peer that stalls mid-message (without
+            # closing) must surface as an error, not an unbounded block
+            s.settimeout(600.0)
             self._send_locks[p] = threading.Lock()
-            threading.Thread(
-                target=self._reader, args=(p, s), daemon=True
-            ).start()
+            self._peer_of_sock[s] = p
+        self._recv_lock = threading.Lock()
 
-    def _reader(self, peer: int, sock: socket.socket) -> None:
-        try:
-            while True:
-                msg = _recv_msg(sock)
-                kind = msg[0]
+    def _store(self, peer: int, msg: tuple) -> None:
+        kind = msg[0]
+        if _DEBUG:
+            _dbg(f"recv {kind} {msg[1:3] if len(msg) > 2 else msg[1:]} "
+                 f"from {peer}")
+        with self.lock:
+            if kind == "data":
+                _, ex, t, payload = msg
+                self.data[(ex, t)].append(payload)
+            elif kind == "done":
+                _, ex, t = msg
+                self.done[(ex, t)].add(peer)
+            elif kind == "ctl":
+                _, rnd, payload = msg
+                self.ctl[rnd][peer] = payload
+
+    def poll(self, timeout: float) -> bool:
+        """Drain any ready peer messages into the buffers (pull model: the
+        thread WAITING for a message receives it itself — the engine is
+        lockstep, so exactly one thread ever waits at a time; no reader
+        threads to starve or crash). Returns True if anything arrived."""
+        import select
+
+        with self._recv_lock:
+            try:
+                ready, _, _ = select.select(
+                    list(self._peer_of_sock), [], [], timeout
+                )
+                for s in ready:
+                    self._store(self._peer_of_sock[s], _recv_msg(s))
+                return bool(ready)
+            except (OSError, EOFError):
                 with self.lock:
-                    if kind == "data":
-                        _, ex, t, payload = msg
-                        self.data[(ex, t)].append(payload)
-                    elif kind == "done":
-                        _, ex, t = msg
-                        self.done[(ex, t)].add(peer)
-                    elif kind == "ctl":
-                        _, rnd, payload = msg
-                        self.ctl[rnd][peer] = payload
-                    self.lock.notify_all()
-        except (OSError, EOFError):
-            with self.lock:
-                self.closed = True
-                self.lock.notify_all()
+                    self.closed = True
+                raise ConnectionError("peer mesh closed") from None
+
+    def _try_drain(self) -> None:
+        """Opportunistic non-blocking drain (used mid-send so two peers
+        simultaneously sending large payloads cannot deadlock on full
+        socket buffers — each keeps consuming while it produces)."""
+        if self._recv_lock.acquire(blocking=False):
+            try:
+                import select
+
+                while True:
+                    ready, _, _ = select.select(
+                        list(self._peer_of_sock), [], [], 0
+                    )
+                    if not ready:
+                        return
+                    for s in ready:
+                        self._store(self._peer_of_sock[s], _recv_msg(s))
+            except (OSError, EOFError):
+                with self.lock:
+                    self.closed = True
+            finally:
+                self._recv_lock.release()
 
     def send(self, peer: int, msg: tuple) -> None:
+        if _DEBUG:
+            _dbg(f"send {msg[0]} "
+                 f"{msg[1:3] if len(msg) > 2 else msg[1:]} to {peer}")
+        self.send_blob(peer, _encode(msg))
+
+    def send_blob(self, peer: int, blob: bytes) -> None:
+        """Send a pre-encoded frame, draining inbound traffic whenever the
+        peer's receive window stalls our send (head-of-line deadlock
+        avoidance for mutual large transfers)."""
+        import select
+
+        sock = self._socks[peer]
         with self._send_locks[peer]:
-            _send_msg(self._socks[peer], msg)
+            sent = 0
+            while sent < len(blob):
+                _, writable, _ = select.select([], [sock], [], 0.2)
+                if writable:
+                    sent += sock.send(blob[sent:])
+                else:
+                    self._try_drain()
+                    if self.closed:
+                        raise ConnectionError("peer mesh closed mid-send")
 
     def close(self) -> None:
         for s in self._socks.values():
@@ -145,9 +220,13 @@ class PeerMesh:
             pass
 
 
-def _send_msg(sock: socket.socket, msg: tuple) -> None:
+def _encode(msg: tuple) -> bytes:
     blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(blob)) + blob)
+    return _LEN.pack(len(blob)) + blob
+
+
+def _send_msg(sock: socket.socket, msg: tuple) -> None:
+    sock.sendall(_encode(msg))
 
 
 def _recv_msg(sock: socket.socket):
@@ -184,11 +263,13 @@ class ExchangeContext:
     def control_allgather(self, rnd: int, payload, timeout: float = 300.0):
         """Send payload for lockstep round ``rnd``; return {pid: payload}
         for ALL processes (self included)."""
+        if _DEBUG:
+            _dbg(f"ctl rnd={rnd} payload={payload}")
         for p in self.mesh.peers:
             self.mesh.send(p, ("ctl", rnd, payload))
         deadline = time_mod.time() + timeout
-        with self.mesh.lock:
-            while True:
+        while True:
+            with self.mesh.lock:
                 got = self.mesh.ctl.get(rnd, {})
                 if len(got) == len(self.mesh.peers):
                     out = dict(got)
@@ -197,33 +278,46 @@ class ExchangeContext:
                     return out
                 if self.mesh.closed:
                     raise ConnectionError("peer mesh closed mid-round")
-                if not self.mesh.lock.wait(timeout=1.0) and \
-                        time_mod.time() > deadline:
-                    raise TimeoutError(f"control round {rnd} timed out")
+            self.mesh.poll(0.25)
+            if time_mod.time() > deadline:
+                raise TimeoutError(f"control round {rnd} timed out")
 
     # ------------------------------------------------------------------- data
     def exchange(self, ex: int, t: int, outbound: dict[int, Batch],
-                 timeout: float = 300.0) -> list[Batch]:
+                 timeout: float = 300.0,
+                 broadcast: Batch | None = None) -> list[Batch]:
         """Contribute per-peer batches for (exchange ex, time t); block until
         every peer's DONE marker for the same (ex, t) arrives; return the
-        batches peers sent here."""
-        for p in self.mesh.peers:
-            b = outbound.get(p)
-            if b is not None and len(b):
-                self.mesh.send(p, ("data", ex, t, _pack_batch(b)))
-            self.mesh.send(p, ("done", ex, t))
+        batches peers sent here. ``broadcast`` sends ONE batch to every peer
+        (encoded once, not per peer)."""
+        if _DEBUG:
+            _dbg(f"exchange ex={ex} t={t} "
+                 f"out={ {p: len(b) for p, b in outbound.items()} } "
+                 f"bcast={len(broadcast) if broadcast is not None else 0}")
+        done_blob = _encode(("done", ex, t))
+        if broadcast is not None and len(broadcast):
+            data_blob = _encode(("data", ex, t, _pack_batch(broadcast)))
+            for p in self.mesh.peers:
+                self.mesh.send_blob(p, data_blob)
+                self.mesh.send_blob(p, done_blob)
+        else:
+            for p in self.mesh.peers:
+                b = outbound.get(p)
+                if b is not None and len(b):
+                    self.mesh.send(p, ("data", ex, t, _pack_batch(b)))
+                self.mesh.send_blob(p, done_blob)
         deadline = time_mod.time() + timeout
-        with self.mesh.lock:
-            while True:
+        while True:
+            with self.mesh.lock:
                 if self.mesh.done.get((ex, t), set()) >= set(self.mesh.peers):
                     payloads = self.mesh.data.pop((ex, t), [])
                     del self.mesh.done[(ex, t)]
                     return [_unpack_batch(p) for p in payloads]
                 if self.mesh.closed:
                     raise ConnectionError("peer mesh closed mid-exchange")
-                if not self.mesh.lock.wait(timeout=1.0) and \
-                        time_mod.time() > deadline:
-                    raise TimeoutError(f"exchange {ex}@{t} timed out")
+            self.mesh.poll(0.25)
+            if time_mod.time() > deadline:
+                raise TimeoutError(f"exchange {ex}@{t} timed out")
 
     def close(self) -> None:
         self.mesh.close()
@@ -245,11 +339,13 @@ def _unpack_batch(p) -> Batch:
 class ExchangeNode(Node):
     """Route rows to their owner process before a stateful operator.
 
-    ``routing`` is None (route by row key) or a list of column names whose
-    values hash to the routing key (group/join keys)."""
+    ``routing`` is None (route by row key), a list of column names whose
+    values hash to the routing key (group/join keys), or the string
+    ``"broadcast"`` — every process receives every row (the reference's
+    per-worker external-index instances see the full add-stream)."""
 
     def __init__(self, graph, input_node, ctx: ExchangeContext,
-                 routing: list[str] | None, name="Exchange"):
+                 routing, name="Exchange"):
         super().__init__(graph, [input_node], input_node.column_names, name)
         self.ctx = ctx
         self.ex_id = ctx.next_exchange_id()
@@ -268,6 +364,15 @@ class ExchangeNode(Node):
         me = self.ctx.process_id
         local = None
         outbound: dict[int, Batch] = {}
+        if self.routing == "broadcast":
+            if batch is not None and len(batch):
+                local = batch
+            received = self.ctx.exchange(
+                self.ex_id, time, {}, broadcast=local
+            )
+            parts = [b for b in [local, *received]
+                     if b is not None and len(b)]
+            return concat_batches(parts) if parts else None
         if batch is not None and len(batch):
             shards = shard_of_keys(self._routing_keys(batch), n)
             local_mask = shards == me
@@ -298,6 +403,7 @@ def splice_exchanges(graph, order: list[Node],
     original_input) rewirings so the caller can undo them on teardown — the
     graph is the user's global object and must not keep exchanges bound to
     a dead mesh across runs."""
+    from pathway_tpu.engine.operators.external_index import ExternalIndexNode
     from pathway_tpu.engine.operators.join import JoinNode
     from pathway_tpu.engine.operators.reduce import GroupbyNode
     from pathway_tpu.internals.iterate import IterateNode
@@ -313,7 +419,13 @@ def splice_exchanges(graph, order: list[Node],
                 "which would silently shard-split groups. Run iterate "
                 "pipelines with PATHWAY_PROCESSES=1."
             )
-        if isinstance(node, GroupbyNode):
+        if isinstance(node, ExternalIndexNode):
+            # index additions broadcast so every process's index instance
+            # holds the full corpus (reference: one instance per worker fed
+            # the whole add-stream); queries stay sharded by row key and
+            # are each answered exactly once, against the complete index
+            routings = ["broadcast", None]
+        elif isinstance(node, GroupbyNode):
             routings: list[list[str] | None] = [
                 [node.instance_col] if node.instance_col else node.group_cols
             ]
@@ -334,6 +446,7 @@ def splice_exchanges(graph, order: list[Node],
                 graph, inp, ctx, routing,
                 name=f"Exchange->{node.name}",
             )
+            _dbg(f"splice ex={ex.ex_id} -> {node.name}[{i}] routing={routing}")
             node.inputs[i] = ex
             spliced.append((node, i, inp))
     return spliced

@@ -173,6 +173,8 @@ class Scheduler:
         with no local events still runs the epoch — it must serve its side
         of every exchange. Replaces timely's distributed progress tracking
         for the totally-ordered single-dimension case."""
+        from pathway_tpu.engine import exchange as exchange_mod
+
         ctx = self.exchange_ctx
         rnd = 0
         while True:
@@ -187,6 +189,8 @@ class Scheduler:
             states = ctx.control_allgather(
                 rnd, (local_t, frontier, live, inflight)
             )
+            if exchange_mod._DEBUG:
+                exchange_mod._dbg(f"round {rnd} states={states}")
             rnd += 1
             times = [s[0] for s in states.values() if s[0] is not None]
             frontiers = [s[1] for s in states.values() if s[1] is not None]

@@ -252,3 +252,80 @@ def test_two_process_recovery_resume(tmp_path):
     assert {w: c for (w, c), d in net.items() if d > 0} == {
         "cat": 3, "dog": 1, "bird": 1,
     }
+
+
+def test_two_process_knn_sees_full_corpus(tmp_path):
+    """External-index additions broadcast to every process: a query owned by
+    either process must retrieve the exact nearest doc regardless of which
+    process read that doc's file. Queries arrive AFTER the docs (as-of-now
+    semantics: a query only sees documents committed before it)."""
+    import numpy as np
+
+    data = tmp_path / "docs"
+    data.mkdir()
+    rng = np.random.default_rng(5)
+    vecs = rng.normal(size=(20, 8))
+    for i in range(20):
+        (data / f"doc{i}.jsonl").write_text(
+            json.dumps({"doc": f"d{i}", "vec": vecs[i].tolist()}) + "\n"
+        )
+    qdir = tmp_path / "qs"
+    qdir.mkdir()
+    # query payloads staged OUTSIDE the watched dir; the feeder moves them
+    # in once the docs are ingested
+    staged = tmp_path / "staged"
+    staged.mkdir()
+    for i, qi in enumerate((3, 7, 11, 16)):
+        (staged / f"q{i}.jsonl").write_text(
+            json.dumps({"qid": f"q{qi}", "qvec": (vecs[qi] + 1e-3).tolist()})
+            + "\n"
+        )
+
+    script = textwrap.dedent(
+        """
+        import os, shutil, threading, time
+        import pathway_tpu as pw
+        from pathway_tpu.stdlib.indexing import BruteForceKnn, DataIndex
+
+        class D(pw.Schema):
+            doc: str
+            vec: list
+
+        class Q(pw.Schema):
+            qid: str
+            qvec: list
+
+        docs = pw.io.jsonlines.read("docs", schema=D, mode="streaming")
+        qs = pw.io.jsonlines.read("qs", schema=Q, mode="streaming")
+        index = DataIndex(docs, BruteForceKnn(docs.vec, dimensions=8))
+        res = index.query_as_of_now(qs.qvec, number_of_matches=1).select(
+            pw.this.doc
+        )
+        joined = qs.join(res, qs.id == res.id, id=qs.id).select(
+            qs.qid, hit=res.doc
+        )
+        pw.io.jsonlines.write(joined, "out.jsonl")
+
+        def feeder():
+            time.sleep(2.5)  # all doc files ingested + broadcast by now
+            if os.environ["PATHWAY_PROCESS_ID"] == "0":
+                for f in sorted(os.listdir("staged")):
+                    shutil.move(os.path.join("staged", f),
+                                os.path.join("qs", f))
+            time.sleep(2.5)
+            for c in pw.G.connectors:
+                c._stop.set()
+                c.close()
+
+        threading.Thread(target=feeder, daemon=True).start()
+        pw.run()
+        """
+    )
+    _spawn(script, tmp_path, processes=2)
+    rows = [r for r in _read_shards(tmp_path, "out.jsonl", 2) if r["diff"] > 0]
+    assert len(rows) == 4
+    for r in rows:
+        hit = r["hit"]
+        if isinstance(hit, (list, tuple)):
+            hit = hit[0]
+        assert hit == f"d{r['qid'][1:]}", rows
