@@ -157,12 +157,45 @@ def _parse_select(tk: _Tokenizer, tables: dict):
         for alias, e in projections:
             name = alias or _default_name(e)
             sel[name] = _materialize(e, table)
+        hidden: list[str] = []
+        if having is not None:
+            ast_to_name = {
+                repr(e): (alias or _default_name(e))
+                for alias, e in projections
+            }
+            # aggregates inside HAVING evaluate in the reduce, not on the
+            # reduced table: reuse a projection alias when the identical
+            # aggregate is already projected, otherwise add a hidden column
+            def lift(ast):
+                if not isinstance(ast, tuple):
+                    return ast
+                if ast[0] == "agg":
+                    name = ast_to_name.get(repr(ast))
+                    if name is None:
+                        name = f"__having_{len(hidden)}"
+                        hidden.append(name)
+                        sel[name] = _materialize(ast, table)
+                        ast_to_name[repr(ast)] = name
+                    return ("col", name)
+                return tuple(lift(a) for a in ast)
+
+            having = lift(having)
         result = grouped.reduce(**sel)
         if having is not None:
             result = result.filter(_materialize(having, result))
+            if hidden:
+                result = result.without(*hidden)
     elif star:
+        if having is not None:
+            raise NotImplementedError(
+                "HAVING requires GROUP BY (use WHERE for row filters)"
+            )
         result = table
     else:
+        if having is not None:
+            raise NotImplementedError(
+                "HAVING requires GROUP BY (use WHERE for row filters)"
+            )
         sel = {}
         for alias, e in projections:
             name = alias or _default_name(e)

@@ -1,0 +1,169 @@
+"""Third breadth batch: retraction-heavy streams, AsyncTransformer edges,
+ordered.diff, sorting, SQL edge cases, JSON ops — reference test areas
+(test_common.py retraction patterns, test_async_transformer.py,
+ordered/diff, test_sql.py, test_json.py)."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import _capture_rows
+
+
+def test_update_rows_retraction_stream():
+    """Streaming upserts: later rows with the same key replace earlier ones
+    and the diff stream carries the retractions."""
+    t = pw.debug.table_from_markdown(
+        """
+        k | v | __time__ | __diff__
+        a | 1 | 2        | 1
+        b | 5 | 2        | 1
+        a | 1 | 4        | -1
+        a | 9 | 4        | 1
+        """
+    ).with_id_from(pw.this.k)
+    res = t.groupby(t.k).reduce(t.k, s=pw.reducers.sum(t.v))
+    rows, cols = _capture_rows(res)
+    got = {r[cols.index("k")]: r[cols.index("s")] for r in rows.values()}
+    assert got == {"a": 9, "b": 5}
+
+
+def test_async_transformer_failed_rows_filtered():
+    class Upper(pw.AsyncTransformer):
+        output_schema = pw.schema_from_types(out=str)
+
+        async def invoke(self, text: str) -> dict:
+            if text.startswith("bad"):
+                raise ValueError("nope")
+            return {"out": text.upper()}
+
+    t = pw.debug.table_from_markdown(
+        """
+        text
+        hello
+        bad_row
+        world
+        """
+    )
+    result = Upper(input_table=t).successful
+    rows, cols = _capture_rows(result)
+    got = sorted(r[cols.index("out")] for r in rows.values())
+    assert got == ["HELLO", "WORLD"]
+
+
+def test_ordered_diff_computes_deltas():
+    t = pw.debug.table_from_markdown(
+        """
+        t | v
+        1 | 10
+        2 | 13
+        3 | 11
+        """
+    )
+    from pathway_tpu.stdlib.ordered import diff
+
+    res = diff(t, t.t, t.v)
+    rows, cols = _capture_rows(res)
+    name = [c for c in cols if "diff" in c][0]
+    vals = sorted(
+        r[cols.index(name)] for r in rows.values()
+        if r[cols.index(name)] is not None
+    )
+    assert 3 in vals and -2 in vals
+
+
+def test_sort_produces_prev_next_chain():
+    t = pw.debug.table_from_markdown(
+        """
+        v
+        30
+        10
+        20
+        """
+    )
+    res = t.sort(t.v)
+    rows, cols = _capture_rows(res)
+    pi, ni = cols.index("prev"), cols.index("next")
+    nones_prev = sum(1 for r in rows.values() if r[pi] is None)
+    nones_next = sum(1 for r in rows.values() if r[ni] is None)
+    assert nones_prev == 1 and nones_next == 1  # one head, one tail
+
+
+def test_sql_having_and_order():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 1
+        a | 2
+        b | 10
+        """
+    )
+    res = pw.sql(
+        "SELECT g, SUM(v) AS s FROM tab GROUP BY g HAVING SUM(v) > 5", tab=t
+    )
+    rows, cols = _capture_rows(res)
+    assert [(r[cols.index("g")], r[cols.index("s")]) for r in rows.values()] \
+        == [("b", 10)]
+
+
+def test_sql_union():
+    a = pw.debug.table_from_markdown("v\n1\n")
+    b = pw.debug.table_from_markdown("v\n2\n")
+    res = pw.sql("SELECT v FROM a UNION ALL SELECT v FROM b", a=a, b=b)
+    rows, cols = _capture_rows(res)
+    assert sorted(r[cols.index("v")] for r in rows.values()) == [1, 2]
+
+
+def test_json_array_and_float_coercion():
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=pw.Json),
+        rows=[(pw.Json({"xs": [1, 2, 3], "f": 2.5}),)],
+    )
+    res = t.select(
+        n=pw.apply_with_type(lambda j: len(j["xs"]), int, t.data),
+        second=t.data.get("xs").get(1).as_int(),
+        f=t.data.get("f").as_float(),
+    )
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("n")] == 3
+    assert row[cols.index("second")] == 2
+    assert row[cols.index("f")] == 2.5
+
+
+def test_subscribe_sees_time_ordered_diffs():
+    t = pw.debug.table_from_markdown(
+        """
+        v | __time__
+        1 | 2
+        2 | 4
+        """
+    )
+    seen: list[tuple] = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: seen.append(
+            (time, row["v"], is_addition)
+        ),
+    )
+    pw.run()
+    assert [s[1] for s in seen] == [1, 2]
+    assert seen[0][0] < seen[1][0]
+
+
+def test_groupby_instance_colocation_key():
+    """ref_scalar_with_instance: same instance -> same shard bits."""
+    from pathway_tpu.engine.value import ref_scalar_with_instance, SHARD_MASK
+
+    a = ref_scalar_with_instance("x", instance="inst1")
+    b = ref_scalar_with_instance("y", instance="inst1")
+    assert a.value & SHARD_MASK == b.value & SHARD_MASK
+    assert a.value != b.value
+    # different instances spread over shards (statistically: 64 instances
+    # into 2^16 shard slots must produce more than one distinct slot)
+    slots = {
+        ref_scalar_with_instance("x", instance=f"i{n}").value & SHARD_MASK
+        for n in range(64)
+    }
+    assert len(slots) > 1
