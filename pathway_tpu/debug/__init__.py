@@ -172,6 +172,7 @@ def table_from_rows(
     cols = list(schema.column_names())
     pk = schema.primary_key_columns()
     out = []
+    seen: dict = {}
     for row in rows:
         if is_stream:
             *vals, time, diff = row
@@ -182,6 +183,14 @@ def table_from_rows(
             key = hash_values(*[values[c] for c in pk])
         else:
             key = hash_values(*vals)
+            if not is_stream:
+                # duplicate static rows are distinct rows: salt repeats with
+                # their occurrence index (first occurrence keeps the plain
+                # content hash for backward-compatible keys)
+                n = seen.get(key, 0)
+                seen[key] = n + 1
+                if n:
+                    key = hash_values(*vals, n)
         out.append((key, tuple(vals), time, diff))
     return _static_table_from_keyed_rows(cols, schema, out, stream=is_stream)
 

@@ -496,19 +496,24 @@ class FlattenNode(Node):
     hash(key, index). Stateless — retraction of the input row retracts all
     derived rows identically."""
 
-    def __init__(self, graph, input_node, flatten_column: str, name="Flatten"):
-        super().__init__(graph, [input_node], input_node.column_names, name)
+    def __init__(self, graph, input_node, flatten_column: str, name="Flatten",
+                 origin_column: str | None = None):
+        in_names = list(input_node.column_names)
+        out_names = in_names + [origin_column] if origin_column else in_names
+        super().__init__(graph, [input_node], out_names, name)
         self.flatten_column = flatten_column
+        self.origin_column = origin_column
+        self._in_names = in_names
 
     def step(self, time, ins):
         (batch,) = ins
         if batch is None or len(batch) == 0:
             return None
-        names = self.column_names
+        names = self._in_names
         fcol = self.flatten_column
+        idx = names.index(fcol)
         rows = []
         for k, row, d in batch.rows():
-            idx = names.index(fcol)
             value = row[idx]
             if value is ERROR:
                 continue
@@ -526,7 +531,9 @@ class FlattenNode(Node):
                 new_row = tuple(
                     item if i == idx else row[i] for i in range(len(row))
                 )
+                if self.origin_column:
+                    new_row = new_row + (Pointer(int(k)),)
                 rows.append((new_key, new_row, d))
         if not rows:
             return None
-        return Batch.from_rows(names, rows)
+        return Batch.from_rows(self.column_names, rows)

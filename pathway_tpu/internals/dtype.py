@@ -416,7 +416,9 @@ def wrap(input_type: Any) -> DType:
         return _SIMPLE_FROM_HINT[input_type]
     origin = typing.get_origin(input_type)
     args = typing.get_args(input_type)
-    if origin is typing.Union:
+    import types as _types
+
+    if origin is typing.Union or origin is _types.UnionType:
         non_none = [a for a in args if a is not type(None)]
         has_none = len(non_none) != len(args)
         if len(non_none) == 1:

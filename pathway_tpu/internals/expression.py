@@ -520,6 +520,12 @@ class IxExpression(ColumnExpression):
     def __repr__(self):
         return f"ix({self._key_expr!r}).{self._column}"
 
+    @property
+    def name(self) -> str:
+        """Column name this lookup projects — lets ``t.select(other.ix(k).col)``
+        work positionally like a plain reference, as in the reference API."""
+        return self._column
+
     def _deps(self):
         return (self._key_expr,)
 

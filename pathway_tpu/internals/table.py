@@ -167,12 +167,27 @@ class Table(Joinable):
         """Add/replace columns, keeping existing ones."""
         return self._select_impl(args, kwargs, keep_old=True)
 
+    def __add__(self, other) -> "Table":
+        """Concatenate the columns of two same-universe tables (reference
+        ``Table.__add__``); duplicate column names are rejected."""
+        if not isinstance(other, Table):
+            return NotImplemented
+        dup = set(self.column_names()) & set(other.column_names())
+        if dup:
+            raise ValueError(
+                f"columns {sorted(dup)} appear on both sides of `+`; "
+                "rename one side first"
+            )
+        return self.with_columns(**{n: other[n] for n in other.column_names()})
+
     def _select_impl(self, args, kwargs, keep_old: bool) -> "Table":
         exprs: dict[str, ColumnExpression] = {}
         args = expand_star_args(args, self)
         for a in args:
             a = self._desugar(a) if isinstance(a, ColumnExpression) else a
             if isinstance(a, ColumnReference):
+                exprs[a.name] = a
+            elif isinstance(a, ColumnExpression) and getattr(a, "name", None):
                 exprs[a.name] = a
             else:
                 raise ValueError(
@@ -474,7 +489,9 @@ class Table(Joinable):
     def flatten(self, to_flatten: ColumnReference, *, origin_id: str | None = None) -> "Table":
         to_flatten = self._desugar(to_flatten)
         name = to_flatten.name
-        node = core_ops.FlattenNode(G.engine_graph, self._node, name)
+        node = core_ops.FlattenNode(
+            G.engine_graph, self._node, name, origin_column=origin_id
+        )
         cols = dict(self._schema.__columns__)
         inner = cols[name].dtype
         if isinstance(inner, dt.List):
@@ -486,6 +503,10 @@ class Table(Joinable):
         else:
             new_dt = dt.ANY
         cols[name] = schema_mod.ColumnDefinition(dtype=new_dt, name=name)
+        if origin_id is not None:
+            cols[origin_id] = schema_mod.ColumnDefinition(
+                dtype=dt.Pointer(self._schema), name=origin_id
+            )
         schema = schema_mod.schema_builder_from_definitions(cols)
         return Table(node, schema, Universe())
 

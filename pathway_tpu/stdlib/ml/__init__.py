@@ -1,7 +1,22 @@
 """``pw.ml`` — classic ML utilities (reference ``stdlib/ml/``): the legacy
 ``KNNIndex`` API (``ml/index.py``), classifiers, smart-table fuzzy join."""
 
-from pathway_tpu.stdlib.ml import classifiers, datasets, hmm, index, smart_table_ops
+from pathway_tpu.stdlib.ml import (
+    classifiers,
+    datasets,
+    hmm,
+    index,
+    smart_table_ops,
+    utils,
+)
 from pathway_tpu.stdlib.ml.index import KNNIndex
 
-__all__ = ["KNNIndex", "classifiers", "datasets", "hmm", "index", "smart_table_ops"]
+__all__ = [
+    "KNNIndex",
+    "classifiers",
+    "datasets",
+    "hmm",
+    "index",
+    "smart_table_ops",
+    "utils",
+]

@@ -1,7 +1,7 @@
 """``pw.utils`` helpers (reference ``python/pathway/stdlib/utils/``)."""
 
-from pathway_tpu.stdlib.utils import col, filtering
+from pathway_tpu.stdlib.utils import bucketing, col, filtering
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
 from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer
 
-__all__ = ["AsyncTransformer", "pandas_transformer", "col", "filtering"]
+__all__ = ["AsyncTransformer", "pandas_transformer", "bucketing", "col", "filtering"]

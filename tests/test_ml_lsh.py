@@ -1,0 +1,225 @@
+"""LSH classifier / clustering / col-helper parity tests — reference
+``stdlib/ml/classifiers/test_lsh.py`` and ``stdlib/utils`` behavior."""
+
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml._lsh import (
+    generate_cosine_lsh_bucketer,
+    generate_euclidean_lsh_bucketer,
+    lsh,
+)
+from pathway_tpu.stdlib.ml.classifiers import (
+    clustering_via_lsh,
+    knn_lsh_classifier_train,
+    knn_lsh_classify,
+    knn_lsh_euclidean_classifier_train,
+)
+from tests.utils import _capture_rows
+
+
+def _two_cluster_tables():
+    gen = np.random.default_rng(7)
+    a = gen.normal(0.0, 0.05, size=(8, 4))
+    b = gen.normal(1.0, 0.05, size=(8, 4)) + np.array([0, 0, 2.0, 2.0])
+    full = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=np.ndarray, label=str),
+        rows=[(row, "lo") for row in a] + [(row, "hi") for row in b],
+    )
+    data = full.select(full.data)
+    labels = full.select(full.label)
+    queries = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=np.ndarray),
+        rows=[(np.full(4, 0.02),), (np.array([1.0, 1.0, 3.0, 3.0]),)],
+    )
+    return data, labels, queries
+
+
+def test_bucketer_euclidean_shape_and_locality():
+    bucketer = generate_euclidean_lsh_bucketer(d=4, M=3, L=5, A=2.0)
+    near1 = bucketer(np.zeros(4))
+    near2 = bucketer(np.full(4, 0.01))
+    far = bucketer(np.full(4, 50.0))
+    assert near1.shape == (5,)
+    assert (near1 == near2).all()
+    assert (near1 != far).any()
+    # deterministic across construction with the same seed
+    again = generate_euclidean_lsh_bucketer(d=4, M=3, L=5, A=2.0)(np.zeros(4))
+    assert (again == near1).all()
+
+
+def test_bucketer_cosine_band_packing():
+    bucketer = generate_cosine_lsh_bucketer(d=6, M=4, L=3)
+    out = bucketer(np.ones(6))
+    assert out.shape == (3,)
+    assert ((0 <= out) & (out < 2**4)).all()
+
+
+def test_lsh_flattens_per_band():
+    data = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=np.ndarray),
+        rows=[(np.zeros(4),), (np.ones(4),)],
+    )
+    bucketer = generate_euclidean_lsh_bucketer(d=4, M=2, L=3, A=1.0)
+    flat = lsh(data, bucketer)
+    rows, cols = _capture_rows(flat)
+    assert set(cols) == {"origin_id", "bucketing", "band", "data"}
+    assert len(rows) == 2 * 3
+    bands = sorted(r[cols.index("bucketing")] for r in rows.values())
+    assert bands == [0, 0, 1, 1, 2, 2]
+
+
+def test_knn_lsh_classifier_end_to_end():
+    data, labels, queries = _two_cluster_tables()
+    model = knn_lsh_classifier_train(data, L=4, type="euclidean", d=4, M=2, A=4.0)
+    predictions = knn_lsh_classify(model, labels, queries, k=3)
+    rows, cols = _capture_rows(predictions)
+    got = [r[cols.index("predicted_label")] for r in rows.values()]
+    assert sorted(x for x in got if x is not None) == ["hi", "lo"]
+
+
+def test_knn_lsh_cosine_and_euclidean_trainers():
+    data, labels, queries = _two_cluster_tables()
+    model = knn_lsh_euclidean_classifier_train(data, d=4, M=2, L=4, A=4.0)
+    knns = model(queries, k=2)
+    rows, cols = _capture_rows(knns)
+    for r in rows.values():
+        assert len(r[cols.index("knns_ids")]) <= 2
+
+    model_cos = knn_lsh_classifier_train(data, L=4, type="cosine", d=4, M=3)
+    with_d = model_cos(queries, k=2, with_distances=True)
+    rows, cols = _capture_rows(with_d)
+    for r in rows.values():
+        for _, dist in r[cols.index("knns_ids_with_dists")]:
+            assert dist >= -1e-6
+
+
+def test_knn_lsh_classifier_rejects_unknown_type():
+    data, _, _ = _two_cluster_tables()
+    with pytest.raises(ValueError):
+        knn_lsh_classifier_train(data, L=2, type="manhattan", d=4, M=2, A=1.0)
+
+
+def test_clustering_via_lsh_separates_blobs():
+    gen = np.random.default_rng(3)
+    a = gen.normal(0.0, 0.03, size=(6, 4))
+    b = a + 8.0
+    data = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=np.ndarray),
+        rows=[(row,) for row in np.vstack([a, b])],
+    )
+    bucketer = generate_euclidean_lsh_bucketer(d=4, M=2, L=4, A=4.0)
+    clustered = clustering_via_lsh(data, bucketer, k=2)
+    rows, cols = _capture_rows(clustered)
+    labels = [r[cols.index("label")] for r in rows.values()]
+    assert len(rows) == 12
+    assert len(set(labels)) == 2
+
+
+def test_classifier_accuracy_counts_matches():
+    from pathway_tpu.stdlib.ml.utils import classifier_accuracy
+
+    exact = pw.debug.table_from_markdown(
+        """
+        label
+        a
+        a
+        b
+        """
+    )
+    predicted = exact.select(predicted_label=pw.this.label)
+    # flip nothing: all three match
+    acc = classifier_accuracy(predicted, exact)
+    rows, cols = _capture_rows(acc)
+    by_match = {r[cols.index("value")]: r[cols.index("cnt")] for r in rows.values()}
+    assert by_match == {True: 3}
+
+
+def test_apply_all_rows_and_majority():
+    from pathway_tpu.stdlib.utils.col import apply_all_rows, groupby_reduce_majority
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    shifted = apply_all_rows(
+        t.a, fun=lambda xs: [x + sum(xs) for x in xs], result_col_name="res"
+    )
+    rows, cols = _capture_rows(shifted)
+    assert sorted(r[cols.index("res")] for r in rows.values()) == [7, 8, 9]
+
+    votes = pw.debug.table_from_markdown(
+        """
+        grp | vote
+        x   | 1
+        x   | 1
+        x   | 2
+        y   | 5
+        """
+    )
+    maj = groupby_reduce_majority(votes.grp, votes.vote)
+    rows, cols = _capture_rows(maj)
+    got = {r[cols.index("grp")]: r[cols.index("majority")] for r in rows.values()}
+    assert got == {"x": 1, "y": 5}
+
+
+def test_unpack_col_dict_and_flatten_column():
+    from pathway_tpu.stdlib.utils.col import flatten_column, unpack_col_dict
+
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=dict),
+        rows=[
+            ({"field_a": 13, "field_b": "foo", "field_c": False},),
+            ({"field_a": 17, "field_c": True, "field_d": 3.4},),
+        ],
+    )
+
+    class DataSchema(pw.Schema):
+        field_a: int
+        field_b: str | None
+        field_c: bool
+        field_d: float | None
+
+    out = unpack_col_dict(t.data, schema=DataSchema)
+    rows, cols = _capture_rows(out)
+    by_a = {r[cols.index("field_a")]: r for r in rows.values()}
+    assert by_a[13][cols.index("field_b")] == "foo"
+    assert by_a[17][cols.index("field_b")] is None
+    assert by_a[17][cols.index("field_d")] == pytest.approx(3.4)
+
+    t2 = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(xs=tuple),
+        rows=[((1, 2),), ((3,),)],
+    )
+    with pytest.warns(DeprecationWarning):
+        flat = flatten_column(t2.xs)
+    rows, cols = _capture_rows(flat)
+    assert sorted(r[cols.index("xs")] for r in rows.values()) == [1, 2, 3]
+
+
+def test_truncate_to_minutes():
+    from pathway_tpu.stdlib.utils.bucketing import truncate_to_minutes
+
+    t = datetime.datetime(2024, 5, 1, 10, 30, 45, 123456)
+    assert truncate_to_minutes(t) == datetime.datetime(2024, 5, 1, 10, 30)
+
+
+def test_load_mnist_sample_offline():
+    from pathway_tpu.stdlib.ml.datasets import load_mnist_sample
+
+    X_train, y_train, X_test, y_test = load_mnist_sample(sample_size=70)
+    rows, cols = _capture_rows(X_train)
+    assert len(rows) == 60
+    (vec,) = rows[next(iter(rows))]
+    assert np.asarray(vec).shape == (784,)
+    rows, _ = _capture_rows(y_test)
+    assert len(rows) == 10
