@@ -272,6 +272,12 @@ class ExpressionEvaluator:
                 return None  # handled below
 
             if inst is None:
+                if not args:
+                    # pointer_from() with no args addresses the single
+                    # global-reduce row (key 0 = hash_values of nothing)
+                    out = np.empty(n, dtype=object)
+                    out[:] = [Pointer(hash_values()) for _ in range(n)]
+                    return out
                 return _rowwise(lambda *vals: Pointer(hash_values(*vals)), *args)
             from pathway_tpu.engine.value import ref_scalar_with_instance
 

@@ -588,3 +588,33 @@ def apply_async_with_type(fun, result_type, *args, **kwargs) -> AsyncApplyExpres
 def apply_fully_async(fun: Callable, *args, **kwargs) -> FullyAsyncApplyExpression:
     ret = typing.get_type_hints(fun).get("return") if callable(fun) else None
     return FullyAsyncApplyExpression(fun, ret, args=args, kwargs=kwargs)
+
+
+_CHILD_EXPR_ATTRS = (
+    "_left", "_right", "_expr", "_if", "_then", "_else", "_val",
+    "_obj", "_index", "_default", "_replacement", "_instance", "_key_expr",
+)
+
+
+def map_child_expressions(e, fn):
+    """Shallow-copy ``e`` with ``fn`` applied to every direct child
+    ColumnExpression (single attrs, ``_args`` tuple, ``_kwargs`` values).
+    The single registry of child attributes for all expression rewriters."""
+    import copy
+
+    e = copy.copy(e)
+    for attr in _CHILD_EXPR_ATTRS:
+        if hasattr(e, attr):
+            v = getattr(e, attr)
+            if isinstance(v, ColumnExpression):
+                setattr(e, attr, fn(v))
+    if hasattr(e, "_args"):
+        e._args = tuple(
+            fn(a) if isinstance(a, ColumnExpression) else a for a in e._args
+        )
+    if hasattr(e, "_kwargs") and isinstance(e._kwargs, dict):
+        e._kwargs = {
+            k: (fn(v) if isinstance(v, ColumnExpression) else v)
+            for k, v in e._kwargs.items()
+        }
+    return e

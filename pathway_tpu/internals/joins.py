@@ -126,8 +126,6 @@ class JoinResult:
         left, right = self._left, self._right
 
         def rw(e):
-            import copy
-
             if isinstance(e, ColumnReference):
                 t = e._table
                 if t is thisclass.left or t is left:
@@ -146,24 +144,7 @@ class JoinResult:
                 raise ValueError(
                     f"reference to table not part of this join: {e!r}"
                 )
-            e = copy.copy(e)
-            for attr in ("_left", "_right", "_expr", "_if", "_then", "_else",
-                         "_val", "_obj", "_index", "_default", "_replacement",
-                         "_instance", "_key_expr"):
-                if hasattr(e, attr):
-                    v = getattr(e, attr)
-                    if isinstance(v, ColumnExpression):
-                        setattr(e, attr, rw(v))
-            if hasattr(e, "_args"):
-                e._args = tuple(
-                    rw(a) if isinstance(a, ColumnExpression) else a for a in e._args
-                )
-            if hasattr(e, "_kwargs") and isinstance(e._kwargs, dict):
-                e._kwargs = {
-                    k: (rw(v) if isinstance(v, ColumnExpression) else v)
-                    for k, v in e._kwargs.items()
-                }
-            return e
+            return expr_mod.map_child_expressions(e, rw)
 
         return rw(e)
 
@@ -196,14 +177,69 @@ class JoinResult:
                 raise ValueError(f"bad positional select argument {a!r}")
         return exprs
 
+    def _contains_ix(self, e) -> bool:
+        if isinstance(e, expr_mod.IxExpression):
+            return True
+        return any(
+            isinstance(d, ColumnExpression) and self._contains_ix(d)
+            for d in e._deps()
+        )
+
+    def _raw_table(self):
+        """Materialize the join output as a real table with uniquely
+        prefixed left/right columns plus both ids — the base for selects
+        that need the full table machinery (e.g. ix lowering)."""
+        cols: dict[str, ColumnExpression] = {}
+        for n in self._left.column_names():
+            cols[f"__jl_{n}"] = ColumnReference(thisclass.left, n)
+        cols["__jl_id"] = ColumnReference(thisclass.left, "id")
+        for n in self._right.column_names():
+            cols[f"__jr_{n}"] = ColumnReference(thisclass.right, n)
+        cols["__jr_id"] = ColumnReference(thisclass.right, "id")
+        return self.select(**cols)
+
+    def _rewrite_to_table(self, e, base):
+        """Rewrite join-side references into the raw join table's columns,
+        leaving ix targets intact for table-level lowering."""
+        import copy
+
+        left, right = self._left, self._right
+
+        def rw(e):
+            if isinstance(e, ColumnReference):
+                t = e._table
+                if t is thisclass.left or t is left:
+                    return base[
+                        "__jl_id" if e._name == "id" else f"__jl_{e._name}"
+                    ]
+                if t is thisclass.right or t is right:
+                    return base[
+                        "__jr_id" if e._name == "id" else f"__jr_{e._name}"
+                    ]
+                if t is thisclass.this:
+                    if e._name in left.column_names():
+                        return base[f"__jl_{e._name}"]
+                    if e._name in right.column_names():
+                        return base[f"__jr_{e._name}"]
+                    raise ValueError(f"unknown column {e._name!r} in join select")
+                return e
+            return expr_mod.map_child_expressions(e, rw)
+
+        return rw(e)
+
     def select(self, *args, **kwargs):
         from pathway_tpu.internals.table import Table
         from pathway_tpu.engine.operators.core import RowwiseNode
 
-        node = self._build()
         exprs = self._expand_select_args(args)
         for name, e in kwargs.items():
             exprs[name] = expr_mod.smart_coerce(e)
+        if any(self._contains_ix(e) for e in exprs.values()):
+            base = self._raw_table()
+            return base.select(
+                **{n: self._rewrite_to_table(e, base) for n, e in exprs.items()}
+            )
+        node = self._build()
         rewritten = {n: self._rewrite_sel(e) for n, e in exprs.items()}
         out = RowwiseNode(G.engine_graph, node, rewritten)
         defs = {}

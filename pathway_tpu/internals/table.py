@@ -489,6 +489,11 @@ class Table(Joinable):
     def flatten(self, to_flatten: ColumnReference, *, origin_id: str | None = None) -> "Table":
         to_flatten = self._desugar(to_flatten)
         name = to_flatten.name
+        if origin_id is not None and origin_id in self.column_names():
+            raise ValueError(
+                f"flatten: origin_id {origin_id!r} collides with an existing "
+                "column; pick a different name"
+            )
         node = core_ops.FlattenNode(
             G.engine_graph, self._node, name, origin_column=origin_id
         )
@@ -860,27 +865,9 @@ def _rewrite(e: ColumnExpression, prefix_of: dict[int, str], ix_nodes, base: Tab
 
 
 def _rewrite_generic(e, prefix_of, ix_nodes, base):
-    import copy
-
-    e = copy.copy(e)
-    for attr in ("_left", "_right", "_expr", "_if", "_then", "_else", "_val",
-                 "_obj", "_index", "_default", "_replacement", "_instance",
-                 "_key_expr"):
-        if hasattr(e, attr):
-            v = getattr(e, attr)
-            if isinstance(v, ColumnExpression):
-                setattr(e, attr, _rewrite(v, prefix_of, ix_nodes, base))
-    if hasattr(e, "_args"):
-        e._args = tuple(
-            _rewrite(a, prefix_of, ix_nodes, base) if isinstance(a, ColumnExpression) else a
-            for a in e._args
-        )
-    if hasattr(e, "_kwargs") and isinstance(e._kwargs, dict):
-        e._kwargs = {
-            k: (_rewrite(v, prefix_of, ix_nodes, base) if isinstance(v, ColumnExpression) else v)
-            for k, v in e._kwargs.items()
-        }
-    return e
+    return expr_mod.map_child_expressions(
+        e, lambda v: _rewrite(v, prefix_of, ix_nodes, base)
+    )
 
 
 def _infer_schema(table: Table, exprs: dict[str, ColumnExpression]):

@@ -1,5 +1,6 @@
 """Graph algorithms over ``pw.iterate`` (reference ``stdlib/graphs/``):
-bellman_ford, pagerank, louvain communities (simplified)."""
+bellman_ford, pagerank, louvain communities; Graph/WeightedGraph
+containers with cluster contraction and exact modularity."""
 
 from __future__ import annotations
 
@@ -8,26 +9,50 @@ import math
 import pathway_tpu.internals.iterate as iterate_mod
 from pathway_tpu.internals import expression as expr_mod
 from pathway_tpu.internals import reducers
+from pathway_tpu.stdlib.graphs.common import (
+    Cluster,
+    Clustering,
+    Edge,
+    Vertex,
+    Weight,
+)
+from pathway_tpu.stdlib.graphs.graph import Graph, WeightedGraph, exact_modularity
+from pathway_tpu.stdlib.graphs.louvain import (
+    louvain_communities_fixed_iterations,
+    louvain_level_fixed_iterations,
+)
 
 
 def bellman_ford(vertices, edges):
-    """Single-source shortest paths; ``vertices`` has ``dist_from_start``
-    (0 for source, inf otherwise), ``edges`` has u, v, dist columns."""
+    """Single-source shortest paths (reference
+    ``stdlib/graphs/bellman_ford/impl.py:42``).  ``vertices`` carries either
+    ``is_source`` (bool, reference API) or a prebuilt ``dist_from_source`` /
+    ``dist_from_start`` float column; ``edges`` has u, v, dist columns.
+    Returns a table with ``dist_from_source`` on the vertex universe."""
+    names = vertices.column_names()
+    if "is_source" in names:
+        vertices = vertices.select(
+            dist_from_source=expr_mod.if_else(vertices.is_source, 0.0, math.inf)
+        )
+    elif "dist_from_start" in names:
+        vertices = vertices.select(dist_from_source=vertices.dist_from_start)
+    else:
+        vertices = vertices.select(dist_from_source=vertices.dist_from_source)
 
     def step(vertices, edges):
         # min candidate distance per target vertex
         j = edges.join(vertices, edges.u == vertices.id).select(
-            target=edges.v, cand=vertices.dist_from_start + edges.dist
+            target=edges.v, cand=vertices.dist_from_source + edges.dist
         )
         best = j.groupby(j.target).reduce(
             j.target, best=reducers.min(j.cand)
         )
         joined = vertices.join_left(best, vertices.id == best.target, id=vertices.id).select(
-            old=vertices.dist_from_start,
+            old=vertices.dist_from_source,
             cand=best.best,
         )
         new_vertices = joined.select(
-            dist_from_start=expr_mod.if_else(
+            dist_from_source=expr_mod.if_else(
                 expr_mod.coalesce(joined.cand, math.inf) < joined.old,
                 expr_mod.coalesce(joined.cand, math.inf),
                 joined.old,

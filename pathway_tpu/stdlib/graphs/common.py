@@ -1,0 +1,35 @@
+"""Graph schema vocabulary (reference ``stdlib/graphs/common.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.api import Pointer
+from pathway_tpu.internals.schema import Schema
+
+
+class Vertex(Schema):
+    pass
+
+
+class Edge(Schema):
+    """An edge holds pointers to its endpoint vertices."""
+
+    u: Pointer[Any]
+    v: Pointer[Any]
+
+
+class Weight(Schema):
+    """Weight column mixin for Vertex / Edge tables."""
+
+    weight: float
+
+
+class Cluster(Vertex, Schema):
+    pass
+
+
+class Clustering(Schema):
+    """Cluster membership: vertex (row id) belongs to cluster ``c``."""
+
+    c: Pointer[Any]

@@ -15,13 +15,19 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     BruteForceKnnFactory,
     IvfKnn,
     IvfKnnFactory,
+    KnnIndexFactory,
+    LshKnnFactory,
     DistanceMetric,
     LshKnn,
     USearchKnn,
     UsearchKnnFactory,
 )
-from pathway_tpu.stdlib.indexing.retrievers import AbstractRetrieverFactory
+from pathway_tpu.stdlib.indexing.retrievers import (
+    AbstractRetrieverFactory,
+    InnerIndexFactory,
+)
 from pathway_tpu.stdlib.indexing.vector_document_index import (
+    VectorDocumentIndex,
     default_brute_force_knn_document_index,
     default_lsh_knn_document_index,
     default_usearch_knn_document_index,
@@ -51,6 +57,10 @@ __all__ = [
     "USearchKnn",
     "UsearchKnnFactory",
     "LshKnn",
+    "LshKnnFactory",
+    "KnnIndexFactory",
+    "InnerIndexFactory",
+    "VectorDocumentIndex",
     "DistanceMetric",
     "TantivyBM25",
     "TantivyBM25Factory",

