@@ -158,6 +158,50 @@ def spawn(threads, processes, first_port, record, record_path,
     )
 
 
+@cli.command(
+    context_settings={"allow_interspersed_args": False, "show_default": True}
+)
+@click.option("-t", "--threads", metavar="N", type=int, default=1,
+              help="number of logical workers (chips) per process")
+@click.option("-n", "--processes", metavar="N", type=int, default=1,
+              help="number of host processes")
+@click.option("--first-port", type=int, metavar="PORT", default=10000,
+              help="coordinator / first communication port")
+@click.option("--record-path", type=str, default="record",
+              help="directory from which the record is replayed")
+@click.option("--mode", type=click.Choice(["batch", "speedrun"]),
+              default="batch", help="replay mode")
+@click.option("--continue-after-replay", is_flag=True,
+              help="keep processing live data after the replay finishes")
+@click.option("--repository-url", type=str,
+              help="github repository to spawn the program from")
+@click.option("--branch", type=str, help="branch if not the default")
+@click.argument("program")
+@click.argument("arguments", nargs=-1)
+def replay(threads, processes, first_port, record_path, mode,
+           continue_after_replay, repository_url, branch, program, arguments):
+    """Replay PROGRAM against a recorded input stream (reference
+    ``cli.py:replay``)."""
+    env = os.environ.copy()
+    env["PATHWAY_REPLAY_STORAGE"] = record_path
+    env["PATHWAY_SNAPSHOT_ACCESS"] = "replay"
+    env["PATHWAY_PERSISTENCE_MODE"] = (
+        "speedrun_replay" if mode == "speedrun" else mode
+    )
+    if continue_after_replay:
+        env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "true"
+    spawn_program(
+        threads=threads,
+        processes=processes,
+        first_port=first_port,
+        repository_url=repository_url,
+        branch=branch,
+        program=program,
+        arguments=arguments,
+        env_base=env,
+    )
+
+
 @cli.command(context_settings={"allow_interspersed_args": False})
 @click.argument("program")
 @click.argument("arguments", nargs=-1)

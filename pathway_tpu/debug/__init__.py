@@ -35,6 +35,8 @@ __all__ = [
     "table_to_pandas",
     "compute_and_print",
     "compute_and_print_update_stream",
+    "table_to_dicts",
+    "StreamGenerator",
 ]
 
 
@@ -333,3 +335,74 @@ def compute_and_print_update_stream(
             ] + [str(time), str(diff)]
             print("\t".join(cells))
             count += 1
+
+
+def table_to_dicts(table: Table, **kwargs):
+    """Return ``(keys, {column: {key: value}})`` for a computed table
+    (reference ``debug/__init__.py:61``)."""
+    cap = capture_table(table)
+    keys = list(cap.state.rows.keys())
+    names = list(cap.column_names)
+    columns = {
+        name: {key: cap.state.rows[key][i] for key in keys}
+        for i, name in enumerate(names)
+    }
+    return keys, columns
+
+
+class StreamGenerator:
+    """Builds artificial streaming tables batch by batch (reference
+    ``debug/__init__.py:496``).  Single-process: worker ids are accepted for
+    API parity and ignored; batches become consecutive engine epochs."""
+
+    def table_from_list_of_batches(self, batches, schema):
+        """Each batch is a list of ``{column: value}`` rows; batch ``i``
+        arrives at engine time ``2*(i+1)``."""
+        cols = list(schema.column_names())
+        rows = []
+        for i, batch in enumerate(batches):
+            t = 2 * (i + 1)
+            for values in batch:
+                rows.append(tuple(values[c] for c in cols) + (t, 1))
+        return table_from_rows(schema, rows, is_stream=True)
+
+    def table_from_list_of_batches_by_workers(self, batches, schema):
+        """Each batch maps worker id → rows; workers are collapsed."""
+        flat = [
+            [values for rows in batch.values() for values in rows]
+            for batch in batches
+        ]
+        return self.table_from_list_of_batches(flat, schema)
+
+    def table_from_pandas(self, df, id_from=None, unsafe_trusted_ids=False,
+                          schema=None):
+        """Honors ``_time`` / ``_diff`` columns (``_worker`` ignored)."""
+        df = df.copy()
+        if "_time" not in df:
+            df["_time"] = 2
+        if "_diff" not in df:
+            df["_diff"] = 1
+        value_cols = [c for c in df.columns if c not in ("_time", "_diff", "_worker")]
+        if schema is None:
+            from pathway_tpu.internals.schema import schema_from_types
+
+            schema = schema_from_types(
+                **{c: _dtype_from_pandas(df[c]) for c in value_cols}
+            )
+        rows = [
+            tuple(row[c] for c in value_cols) + (int(row["_time"]), int(row["_diff"]))
+            for _, row in df.iterrows()
+        ]
+        return table_from_rows(schema, rows, is_stream=True)
+
+
+def _dtype_from_pandas(series) -> type:
+    import pandas as pd
+
+    if pd.api.types.is_integer_dtype(series):
+        return int
+    if pd.api.types.is_float_dtype(series):
+        return float
+    if pd.api.types.is_bool_dtype(series):
+        return bool
+    return str

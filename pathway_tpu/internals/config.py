@@ -43,6 +43,9 @@ class PathwayConfig:
     snapshot_access: str | None = field(
         default_factory=lambda: os.environ.get("PATHWAY_SNAPSHOT_ACCESS")
     )
+    continue_after_replay: bool = field(
+        default_factory=lambda: _env_bool("PATHWAY_CONTINUE_AFTER_REPLAY", False)
+    )
     process_id: int = field(
         default_factory=lambda: int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
     )
@@ -74,7 +77,32 @@ def set_persistence_config(cfg: Any) -> None:
 
 
 def get_persistence_config() -> Any:
-    return _persistence_config
+    """Explicitly set persistence config, else one auto-built from the
+    PATHWAY_REPLAY_STORAGE family of env vars (``pathway spawn --record`` /
+    ``pathway replay``)."""
+    if _persistence_config is not None:
+        return _persistence_config
+    if pathway_config.replay_storage:
+        from pathway_tpu import persistence as persistence_mod
+
+        return persistence_mod.Config(
+            backend=persistence_mod.Backend.filesystem(
+                pathway_config.replay_storage
+            ),
+            persistence_mode=pathway_config.persistence_mode or "persisting",
+            snapshot_access=pathway_config.snapshot_access,
+            # replay-only runs stop at the end of the log unless asked to
+            # continue; record / recovery runs must keep reading live data
+            continue_after_replay=(
+                True
+                if (
+                    pathway_config.continue_after_replay
+                    or pathway_config.snapshot_access != "replay"
+                )
+                else None
+            ),
+        )
+    return None
 
 
 def set_license_key(key: str | None) -> None:

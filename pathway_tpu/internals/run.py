@@ -119,7 +119,12 @@ class GraphRunner:
             # non-shardable connectors run on process 0 only
             connectors = [c for c in connectors if c.shardable]
         if manager is not None:
-            for c in connectors:
+            for i, c in enumerate(connectors):
+                if c.persistent_id is None:
+                    # auto-generate stable ids (reference: generated
+                    # persistent ids) so record/replay covers every source;
+                    # registration order is deterministic per program
+                    c.persistent_id = f"_pw_auto_{i}_{type(c).__name__}"
                 c.setup_persistence(manager)
         for c in connectors:
             sched.register_source(c.node, 0)

@@ -49,7 +49,7 @@ class BaseConnector:
     # -- persistence hooks (reference: Reader::seek + SnapshotEvent log) ----
     def setup_persistence(self, manager) -> None:
         self._persistence = manager
-        if self.persistent_id is not None:
+        if self.persistent_id is not None and manager.do_record:
             self._snapshot_writer = manager.writer_for(self.persistent_id)
 
     def current_offset(self):
@@ -111,7 +111,11 @@ class BaseConnector:
     def start(self, sched) -> None:
         self._sched = sched
         self._stop.clear()
-        if self._persistence is not None and self.persistent_id is not None:
+        if (
+            self._persistence is not None
+            and self.persistent_id is not None
+            and self._persistence.do_replay
+        ):
             # replay-then-resume (reference connectors/mod.rs:296-425):
             # emit the consolidated snapshot at one fresh commit time, seek
             # the reader past logged data, then read realtime updates.

@@ -51,18 +51,22 @@ class InMemoryKafkaBroker:
 class _BrokerConnector(BaseConnector):
     heartbeat_ms = 500
 
-    def __init__(self, node, broker: InMemoryKafkaBroker, topic: str, schema, fmt: str):
+    def __init__(self, node, broker: InMemoryKafkaBroker, topic: str, schema, fmt: str,
+                 start_from_latest: bool = False):
         super().__init__(node)
         self.broker = broker
         self.topic = topic
         self.schema = schema
         self.fmt = fmt
+        self.start_from_latest = start_from_latest
         self._counter = 0
 
     def run(self):
         import json
 
-        offset = 0
+        offset = (
+            len(self.broker.poll(self.topic, 0)) if self.start_from_latest else 0
+        )
         cols = list(self.node.column_names)
         dtypes = {n: c.dtype for n, c in self.schema.__columns__.items()}
         pk = self.schema.primary_key_columns()
@@ -98,6 +102,7 @@ def read(
     format: str = "json",  # noqa: A002
     autocommit_duration_ms: int | None = 1500,
     persistent_id: str | None = None,
+    start_from_latest: bool = False,
     **kwargs,
 ) -> Table:
     if isinstance(rdkafka_settings, InMemoryKafkaBroker):
@@ -107,7 +112,8 @@ def read(
             schema = schema_mod.schema_from_types(data=bytes)
         cols = list(schema.column_names())
         node = InputNode(G.engine_graph, cols, name=f"kafka({topic})")
-        conn = _BrokerConnector(node, rdkafka_settings, topic, schema, format)
+        conn = _BrokerConnector(node, rdkafka_settings, topic, schema, format,
+                                start_from_latest=start_from_latest)
         G.register_connector(conn)
         return Table(node, schema, Universe())
     raise NotImplementedError(
@@ -147,3 +153,49 @@ def write(
 
 def read_from_upstash(*args, **kwargs):
     raise NotImplementedError("Upstash Kafka requires network access")
+
+
+def simple_read(
+    server: str,
+    topic: str,
+    *,
+    read_only_new: bool = False,
+    schema=None,
+    format: str = "raw",  # noqa: A002
+    autocommit_duration_ms: int | None = 1500,
+    json_field_paths: dict | None = None,
+    parallel_readers: int | None = None,
+    persistent_id: str | None = None,
+    **kwargs,
+):
+    """Read from Kafka with just a server address and topic (reference
+    ``io/kafka/__init__.py:299``); starts from the beginning unless
+    ``read_only_new``."""
+    if isinstance(server, InMemoryKafkaBroker):
+        return read(
+            server,
+            topic=topic,
+            schema=schema,
+            format=format,
+            autocommit_duration_ms=autocommit_duration_ms,
+            persistent_id=persistent_id,
+            start_from_latest=read_only_new,
+            **kwargs,
+        )
+    rdkafka_settings = {
+        "bootstrap.servers": server,
+        "group.id": f"pathway-simple-{topic}",
+        "session.timeout.ms": "60000",
+        "auto.offset.reset": "latest" if read_only_new else "earliest",
+    }
+    return read(
+        rdkafka_settings,
+        topic=topic,
+        schema=schema,
+        format=format,
+        autocommit_duration_ms=autocommit_duration_ms,
+        json_field_paths=json_field_paths,
+        parallel_readers=parallel_readers,
+        persistent_id=persistent_id,
+        **kwargs,
+    )

@@ -46,3 +46,58 @@ def read(
 
 
 read_from_csv = read
+
+
+class _VendorS3Settings:
+    """Shared shape of third-party S3-compatible vendor settings; subclasses
+    set ``_ENDPOINT_TEMPLATE`` (reference ``io/s3/__init__.py:22,57``)."""
+
+    _ENDPOINT_TEMPLATE: str | None = None
+
+    def __init__(self, bucket_name=None, *, access_key=None,
+                 secret_access_key=None, region=None):
+        self.bucket_name = bucket_name
+        self.access_key = access_key
+        self.secret_access_key = secret_access_key
+        self.region = region
+
+    def _to_aws(self) -> AwsS3Settings:
+        endpoint = (
+            self._ENDPOINT_TEMPLATE.format(region=self.region)
+            if self.region and self._ENDPOINT_TEMPLATE
+            else None
+        )
+        return AwsS3Settings(
+            bucket_name=self.bucket_name,
+            access_key=self.access_key,
+            secret_access_key=self.secret_access_key,
+            region=self.region,
+            endpoint=endpoint,
+        )
+
+
+class DigitalOceanS3Settings(_VendorS3Settings):
+    """Digital Ocean Spaces connection settings."""
+
+    _ENDPOINT_TEMPLATE = "https://{region}.digitaloceanspaces.com"
+
+
+class WasabiS3Settings(_VendorS3Settings):
+    """Wasabi S3 connection settings."""
+
+    _ENDPOINT_TEMPLATE = "https://s3.{region}.wasabisys.com"
+
+
+def read_from_digital_ocean(path: str, do_s3_settings: DigitalOceanS3Settings,
+                            format: str, **kwargs):  # noqa: A002
+    """Read from a Digital Ocean Spaces bucket (reference
+    ``io/s3/__init__.py:304``)."""
+    return read(path, aws_s3_settings=do_s3_settings._to_aws(),
+                format=format, **kwargs)
+
+
+def read_from_wasabi(path: str, wasabi_s3_settings: WasabiS3Settings,
+                     format: str, **kwargs):  # noqa: A002
+    """Read from a Wasabi S3 bucket (reference ``io/s3/__init__.py:366``)."""
+    return read(path, aws_s3_settings=wasabi_s3_settings._to_aws(),
+                format=format, **kwargs)

@@ -42,7 +42,7 @@ class Config:
     snapshot_interval_ms: int = 0
     persistence_mode: str = "persisting"
     snapshot_access: str | None = None
-    continue_after_replay: bool = True
+    continue_after_replay: bool | None = None  # None = mode-based default
 
     @classmethod
     def simple_config(cls, backend: Backend, **kwargs) -> "Config":
@@ -91,3 +91,16 @@ __all__ = [
     "register_persistent_source",
     "get_persistent_sources",
 ]
+
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def get_persistence_engine_config(persistence_config):
+    """Yield the engine-level persistence config for a run (reference
+    ``persistence/__init__.py:165``); None passes through."""
+    if persistence_config is None:
+        yield None
+        return
+    yield persistence_config

@@ -51,6 +51,11 @@ class PersistenceManager:
     def __init__(self, config: Any, worker_id: int = 0, total_workers: int = 1):
         self.config = config
         self.mode = (getattr(config, "persistence_mode", None) or "persisting").lower()
+        # reference SnapshotAccess: record = write-only, replay = read-only,
+        # full/None = both (crash recovery)
+        self.snapshot_access = (
+            getattr(config, "snapshot_access", None) or "full"
+        ).lower()
         self.backend = make_backend(config.backend)
         self.metadata = MetadataAccessor(self.backend, worker_id, total_workers)
         self.worker_id = worker_id
@@ -60,6 +65,16 @@ class PersistenceManager:
         self._forced_input_replay = False
 
     # ---------------------------------------------------------------- sources
+    @property
+    def do_replay(self) -> bool:
+        """Whether stored snapshots are read back at startup."""
+        return self.snapshot_access in ("full", "replay")
+
+    @property
+    def do_record(self) -> bool:
+        """Whether new input data is appended to the snapshot log."""
+        return self.snapshot_access in ("full", "record")
+
     @property
     def replay_inputs(self) -> bool:
         """Input-snapshot modes replay the log through the graph; operator
@@ -75,9 +90,10 @@ class PersistenceManager:
 
     @property
     def continue_after_replay(self) -> bool:
-        if self.mode in ("speedrun_replay", "batch"):
-            return False
-        return getattr(self.config, "continue_after_replay", True)
+        explicit = getattr(self.config, "continue_after_replay", None)
+        if explicit is not None:
+            return explicit
+        return self.mode not in ("speedrun_replay", "batch")
 
     def writer_for(self, persistent_id: str) -> SnapshotLogWriter:
         if persistent_id not in self._writers:

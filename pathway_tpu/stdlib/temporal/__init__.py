@@ -43,19 +43,43 @@ __all__ = [
     "asof_join_outer",
     "asof_now_join",
     "window_join",
+    "window_join_inner",
+    "window_join_left",
+    "window_join_right",
+    "window_join_outer",
+    "asof_now_join_inner",
+    "asof_now_join_left",
     "common_behavior",
     "exactly_once_behavior",
+    "apply_temporal_behavior",
+    "Behavior",
     "CommonBehavior",
     "ExactlyOnceBehavior",
+    "Direction",
+    "utils",
+    "time_utils",
+    "inactivity_detection",
+    "utc_now",
 ]
+
+from pathway_tpu.stdlib.temporal import time_utils, utils  # noqa: E402  (cycle-safe tail imports)
+from pathway_tpu.stdlib.temporal.time_utils import (  # noqa: E402
+    inactivity_detection,
+    utc_now,
+)
 
 
 # ---------------------------------------------------------------------------
 # behaviors
 
 
+class Behavior:
+    """Superclass of temporal behaviors (reference
+    ``temporal_behavior.py:Behavior``)."""
+
+
 @dataclass
-class CommonBehavior:
+class CommonBehavior(Behavior):
     delay: Any = None
     cutoff: Any = None
     keep_results: bool = True
@@ -66,7 +90,7 @@ def common_behavior(delay=None, cutoff=None, keep_results: bool = True) -> Commo
 
 
 @dataclass
-class ExactlyOnceBehavior:
+class ExactlyOnceBehavior(Behavior):
     shift: Any = None
 
 
@@ -1006,3 +1030,54 @@ def asof_now_join(left_table, right_table, *on, id=None, how="inner"):
     )
     jr._build = lambda: node  # reuse JoinResult.select over this node
     return jr
+
+
+Direction = _Direction
+
+
+def apply_temporal_behavior(table, behavior):
+    """Lower a ``CommonBehavior`` onto a table carrying a ``_pw_time``
+    column: delay buffers, cutoff freezes+forgets (reference
+    ``temporal_behavior.py:101``)."""
+    if behavior is not None:
+        if not isinstance(behavior, CommonBehavior):
+            raise TypeError(
+                "apply_temporal_behavior expects a CommonBehavior (use "
+                "common_behavior(...)); exactly_once_behavior applies only "
+                "inside windowby"
+            )
+        time_col = table["_pw_time"]
+        if behavior.delay is not None:
+            table = table._buffer(time_col + behavior.delay, time_col)
+        if behavior.cutoff is not None:
+            # same lowering as windowby: freeze drops late arrivals; results
+            # are retracted past the cutoff only when keep_results is False
+            threshold = table["_pw_time"] + behavior.cutoff
+            table = table._freeze(threshold, table["_pw_time"])
+            if not behavior.keep_results:
+                table = table._forget(threshold, table["_pw_time"])
+    return table
+
+
+def window_join_inner(left_table, right_table, t_left, t_right, window, *on):
+    return window_join(left_table, right_table, t_left, t_right, window, *on, how="inner")
+
+
+def window_join_left(left_table, right_table, t_left, t_right, window, *on):
+    return window_join(left_table, right_table, t_left, t_right, window, *on, how="left")
+
+
+def window_join_right(left_table, right_table, t_left, t_right, window, *on):
+    return window_join(left_table, right_table, t_left, t_right, window, *on, how="right")
+
+
+def window_join_outer(left_table, right_table, t_left, t_right, window, *on):
+    return window_join(left_table, right_table, t_left, t_right, window, *on, how="outer")
+
+
+def asof_now_join_inner(left_table, right_table, *on, id=None):  # noqa: A002
+    return asof_now_join(left_table, right_table, *on, id=id, how="inner")
+
+
+def asof_now_join_left(left_table, right_table, *on, id=None):  # noqa: A002
+    return asof_now_join(left_table, right_table, *on, id=id, how="left")

@@ -54,3 +54,20 @@ def _to_dict(doc: Any) -> dict:
 
 def combine_metadata(docs: list[Any]) -> list[dict]:
     return [_to_dict(d) for d in docs]
+
+
+def post_json(url: str, payload: dict, headers: dict | None = None,
+              timeout: float | None = None):
+    """POST JSON, return decoded JSON response — the one HTTP helper shared
+    by VectorStoreClient and RAGClient."""
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:  # noqa: S310
+        return json.loads(resp.read().decode())

@@ -5,7 +5,12 @@ retrieved context, strict-JSON citation variant, summarization).
 
 from __future__ import annotations
 
+import functools
+import re
+from abc import ABC, abstractmethod
+
 import pathway_tpu as pw
+from pathway_tpu.internals.udfs import udf as pw_udf
 
 BASE_PROMPT_TEMPLATE = (
     "Answer the question using only the context below. "
@@ -68,3 +73,158 @@ def prompt_query_rewrite(query: str) -> str:
         "Rewrite the user question as a concise search query, keeping all "
         f"named entities.\nQuestion: {query}\nSearch query:"
     )
+
+
+# ---------------------------------------------------------------------------
+# prompt template classes (reference ``prompts.py:11-99``; implemented
+# without pydantic — validation happens in __init__)
+
+
+class BasePromptTemplate(ABC):
+    """A prompt template that can be instantiated as a UDF
+    (reference ``prompts.py:11``)."""
+
+    @abstractmethod
+    def as_udf(self, **kwargs): ...
+
+
+class FunctionPromptTemplate(BasePromptTemplate):
+    """Wraps a callable or UDF as a prompt template
+    (reference ``prompts.py:19``)."""
+
+    def __init__(self, function_template=None, **kwargs):
+        if function_template is None:
+            function_template = kwargs.pop("template", None)
+        if function_template is None:
+            raise ValueError("function_template is required")
+        self.function_template = function_template
+
+    def as_udf(self, **kwargs):
+        from pathway_tpu.internals.udfs import UDF
+
+        if isinstance(self.function_template, UDF):
+            return self.function_template
+        return pw_udf(functools.partial(self.function_template, **kwargs))
+
+
+class StringPromptTemplate(BasePromptTemplate):
+    """A ``str.format`` template over ``context``/``query`` columns
+    (reference ``prompts.py:34``)."""
+
+    def __init__(self, template: str):
+        self.template = template
+
+    def format(self, **kwargs) -> str:
+        return self.template.format(**kwargs)
+
+    def as_udf(self, **kwargs):
+        def udf_formatter(context: str, query: str) -> str:
+            return self.format(query=query, context=context, **kwargs)
+
+        return pw_udf(udf_formatter)
+
+
+class RAGPromptTemplate(StringPromptTemplate):
+    """StringPromptTemplate validated to carry exactly ``{context}`` and
+    ``{query}`` placeholders (reference ``prompts.py:61``)."""
+
+    def __init__(self, template: str):
+        if "{context}" not in template or "{query}" not in template:
+            raise ValueError(
+                "Template must contain `{context}` and `{query}` placeholders."
+            )
+        try:
+            template.format(context=" ", query=" ")
+        except KeyError:
+            raise ValueError(
+                "RAG prompt template expects `context` and `query` placeholders only."
+            )
+        super().__init__(template)
+
+
+class RAGFunctionPromptTemplate(FunctionPromptTemplate):
+    """FunctionPromptTemplate validated to accept context/query kwargs
+    (reference ``prompts.py:79``)."""
+
+    def __init__(self, function_template=None, **kwargs):
+        super().__init__(function_template, **kwargs)
+        from pathway_tpu.internals.udfs import UDF
+
+        fn = (
+            self.function_template.__wrapped__
+            if isinstance(self.function_template, UDF)
+            else self.function_template
+        )
+        try:
+            fn(query=" ", context=" ")
+        except TypeError as e:
+            raise ValueError(
+                "RAG prompt template expects `context` and `query` placeholders "
+                "only.\n" + str(e)
+            )
+
+
+def prompt_qa_geometric_rag(
+    query: str,
+    docs,
+    information_not_found_response: str = "No information found.",
+    additional_rules: str = "",
+    strict_prompt: bool = False,
+) -> str:
+    """Citation-style QA prompt over numbered sources (reference
+    ``prompts.py:194``); ``strict_prompt`` requests parsable-JSON answers
+    for local models."""
+    pieces = []
+    for i, doc in enumerate(docs, 1):
+        text = doc if isinstance(doc, str) else doc["text"]
+        pieces.append(f"Source {i}: {text}")
+    context_str = "\n".join(pieces)
+    if strict_prompt:
+        head = (
+            "Use the below articles to answer the subsequent question. If the "
+            f'answer cannot be found in the articles, write "'
+            f'{information_not_found_response}" Do not explain.\n'
+            "ONLY RESPOND IN PARSABLE JSON WITH THE ONLY KEY `answer`.\n"
+            "When referencing information from a source, cite the appropriate "
+            "source(s) using their corresponding numbers. Every answer should "
+            "include at least one source citation."
+        )
+    else:
+        head = (
+            "Use the below articles to answer the subsequent question. If the "
+            f'answer cannot be found in the articles, write "'
+            f'{information_not_found_response}" Do not answer in full '
+            "sentences.\nWhen referencing information from a source, cite the "
+            "appropriate source(s) using their corresponding numbers. Every "
+            "answer should include at least one source citation."
+        )
+    return (
+        f"{head}\n{additional_rules}\n"
+        f"Sources:\n{context_str}\n"
+        f"Query: {query}\nAnswer:"
+    )
+
+
+def parse_cited_response(response_text: str, docs):
+    """Split a cited answer into (clean_text, cited_docs); citations are
+    ``[n]`` markers resolved against ``docs`` (reference ``prompts.py:316``)."""
+    cited_idx = sorted(
+        {int(cite[1:-1]) - 1 for cite in re.findall(r"\[\d+\]", response_text)}
+    )
+    citations = [docs[i] for i in cited_idx if 0 <= i < len(docs)]
+    clean = re.sub(r"\s*\[\d+\]", "", response_text).strip()
+    return clean, citations
+
+
+DEFAULT_JSON_TABLE_PARSE_PROMPT = (
+    "Explain the given table in JSON format in detail. Do not skip any "
+    "information in the table."
+)
+DEFAULT_MD_TABLE_PARSE_PROMPT = (
+    "Explain the given table in markdown format in detail. Do not skip any "
+    "information in the table."
+)
+DEFAULT_IMAGE_PARSE_PROMPT = (
+    "Explain the given image in detail. List all the objects and their "
+    "attributes you can see."
+)

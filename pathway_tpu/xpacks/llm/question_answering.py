@@ -422,3 +422,101 @@ class DeckRetriever(BaseQuestionAnswerer):
             filepath_globpattern=None,
         )
         return self.indexer.retrieve_query(queries)
+
+
+def send_post_request(url: str, data: dict, headers: dict | None = None,
+                      timeout: int | None = None):
+    """POST JSON and return the decoded JSON response (reference
+    ``question_answering.py:send_post_request``)."""
+    from pathway_tpu.xpacks.llm._utils import post_json
+
+    return post_json(url, data, headers, timeout)
+
+
+class RAGClient:
+    """HTTP client for RAG apps served by ``QARestServer`` /
+    ``QASummaryRestServer`` (reference ``question_answering.py:854``)."""
+
+    def __init__(
+        self,
+        host: str | None = None,
+        port: int | None = None,
+        url: str | None = None,
+        timeout: int | None = 90,
+        additional_headers: dict | None = None,
+    ):
+        from pathway_tpu.xpacks.llm.vector_store import VectorStoreClient
+
+        err = "Either (`host` and `port`) or `url` must be provided, but not both."
+        if url is not None:
+            if host is not None or port is not None:
+                raise ValueError(err)
+            self.url = url
+        else:
+            if host is None:
+                raise ValueError(err)
+            port = port or 80
+            protocol = "https" if port == 443 else "http"
+            self.url = f"{protocol}://{host}:{port}"
+
+        self.timeout = timeout
+        self.additional_headers = additional_headers or {}
+        self.index_client = VectorStoreClient(
+            url=self.url,
+            timeout=self.timeout or 90,
+            additional_headers=self.additional_headers,
+        )
+
+    def retrieve(self, query: str, k: int = 3, metadata_filter: str | None = None,
+                 filepath_globpattern: str | None = None):
+        """Closest documents from the store for ``query``."""
+        return self.index_client.query(
+            query=query, k=k, metadata_filter=metadata_filter,
+            filepath_globpattern=filepath_globpattern,
+        )
+
+    def statistics(self):
+        """Index statistics."""
+        return self.index_client.get_vectorstore_statistics()
+
+    def pw_ai_answer(self, prompt: str, filters: str | None = None,
+                     model: str | None = None):
+        """RAG answer for ``prompt`` with optional metadata ``filters``."""
+        payload: dict = {"prompt": prompt}
+        if filters:
+            payload["filters"] = filters
+        if model:
+            payload["model"] = model
+        return send_post_request(
+            f"{self.url}/v1/pw_ai_answer", payload, self.additional_headers,
+            timeout=self.timeout,
+        )
+
+    answer = pw_ai_answer
+
+    def pw_ai_summary(self, text_list, model: str | None = None):
+        """Summarize ``text_list`` server-side."""
+        payload: dict = {"text_list": list(text_list)}
+        if model:
+            payload["model"] = model
+        return send_post_request(
+            f"{self.url}/v1/pw_ai_summary", payload, self.additional_headers,
+            timeout=self.timeout,
+        )
+
+    summarize = pw_ai_summary
+
+    def pw_list_documents(self, filters: str | None = None, keys=("path",)):
+        """List indexed documents, projecting metadata to ``keys``."""
+        payload: dict = {}
+        if filters:
+            payload["metadata_filter"] = filters
+        response = send_post_request(
+            f"{self.url}/v1/pw_list_documents", payload, self.additional_headers,
+            timeout=self.timeout,
+        )
+        if not response:
+            return []
+        if keys:
+            return [{k: v for k, v in dc.items() if k in keys} for dc in response]
+        return response

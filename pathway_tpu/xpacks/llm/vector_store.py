@@ -215,16 +215,11 @@ class VectorStoreClient:
         self.additional_headers = additional_headers or {}
 
     def _post(self, route: str, payload: dict) -> Any:
-        import urllib.request
+        from pathway_tpu.xpacks.llm._utils import post_json
 
-        req = urllib.request.Request(
-            self.url + route,
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json", **self.additional_headers},
-            method="POST",
+        return post_json(
+            self.url + route, payload, self.additional_headers, self.timeout
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:  # noqa: S310
-            return json.loads(resp.read().decode())
 
     def query(
         self, query: str, k: int = 3, metadata_filter: str | None = None,

@@ -1,0 +1,190 @@
+"""Parity extras: temporal utils/time_utils, prompt templates, RAG client
+surface, StreamGenerator, optional_imports, cli replay, s3 settings."""
+
+from __future__ import annotations
+
+import datetime
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import _capture_rows
+
+
+def test_temporal_utils_types_and_origin():
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.stdlib.temporal.utils import (
+        check_joint_types,
+        get_default_origin,
+        zero_length_interval,
+    )
+
+    assert get_default_origin(dt.INT) == 0
+    origin = get_default_origin(dt.DATE_TIME_NAIVE)
+    assert origin.weekday() == 0  # Monday-aligned week windows
+    assert zero_length_interval(int) == 0
+    assert zero_length_interval(datetime.timedelta) == datetime.timedelta(0)
+
+    t = pw.debug.table_from_markdown(
+        """
+        t | d
+        1 | 2
+        """
+    )
+    check_joint_types({"t": (t.t, __import__(
+        "pathway_tpu.stdlib.temporal.utils", fromlist=["TimeEventType"]
+    ).TimeEventType)})
+    from pathway_tpu.stdlib.temporal.utils import IntervalType, TimeEventType
+
+    with pytest.raises(TypeError):
+        check_joint_types(
+            {
+                "a": (t.t, TimeEventType),
+                "b": (datetime.timedelta(seconds=1), IntervalType),
+            }
+        )
+
+
+def test_apply_temporal_behavior_buffers_results():
+    from pathway_tpu.stdlib.temporal import (
+        Behavior,
+        CommonBehavior,
+        apply_temporal_behavior,
+        common_behavior,
+    )
+
+    assert isinstance(common_behavior(), Behavior)
+    assert isinstance(common_behavior(), CommonBehavior)
+
+    t = pw.debug.table_from_markdown(
+        """
+        v | _pw_time | __time__
+        a | 2        | 2
+        b | 4        | 4
+        """
+    )
+    out = apply_temporal_behavior(t, common_behavior(delay=0))
+    rows, cols = _capture_rows(out)
+    assert len(rows) == 2
+
+
+def test_window_and_asof_now_join_wrappers_exist():
+    from pathway_tpu.stdlib.temporal import (
+        Direction,
+        asof_now_join_inner,
+        asof_now_join_left,
+        window_join_inner,
+        window_join_left,
+        window_join_outer,
+        window_join_right,
+    )
+
+    assert Direction.BACKWARD == "backward"
+    assert callable(window_join_inner) and callable(asof_now_join_left)
+
+
+def test_stream_generator_epochs_ordered():
+    from pathway_tpu.debug import StreamGenerator
+    from pathway_tpu.internals.run import capture_table
+
+    g = StreamGenerator()
+    t = g.table_from_list_of_batches_by_workers(
+        [{0: [{"a": 1}], 1: [{"a": 2}]}, {0: [{"a": 3}]}],
+        pw.schema_from_types(a=int),
+    )
+    agg = t.reduce(s=pw.reducers.sum(t.a))
+    cap = capture_table(agg)
+    (row,) = cap.state.rows.values()
+    assert row[0] == 6
+
+
+def test_stream_generator_pandas_time_diff():
+    import pandas as pd
+
+    from pathway_tpu.debug import StreamGenerator, table_to_dicts
+
+    g = StreamGenerator()
+    df = pd.DataFrame(
+        {"a": [1, 2, 2], "_time": [2, 2, 4], "_diff": [1, 1, -1]}
+    )
+    t = g.table_from_pandas(df)
+    keys, columns = table_to_dicts(t)
+    assert sorted(columns["a"].values()) == [1]
+
+
+def test_prompt_templates_as_udf_runs_in_table():
+    from pathway_tpu.xpacks.llm.prompts import RAGPromptTemplate
+
+    template = RAGPromptTemplate(template="C:{context}|Q:{query}")
+    udf = template.as_udf()
+    t = pw.debug.table_from_markdown(
+        """
+        context | query
+        facts   | what
+        """
+    )
+    out = t.select(prompt=udf(context=pw.this.context, query=pw.this.query))
+    rows, cols = _capture_rows(out)
+    (row,) = rows.values()
+    assert row[0] == "C:facts|Q:what"
+
+
+def test_rag_client_url_validation():
+    from pathway_tpu.xpacks.llm.question_answering import RAGClient
+
+    client = RAGClient(host="localhost", port=8080)
+    assert client.url == "http://localhost:8080"
+    client2 = RAGClient(url="https://example.com")
+    assert client2.url == "https://example.com"
+    with pytest.raises(ValueError):
+        RAGClient(url="https://example.com", host="x")
+    with pytest.raises(ValueError):
+        RAGClient()
+
+
+def test_optional_imports_decorates_error():
+    from pathway_tpu.optional_import import optional_imports
+
+    with pytest.raises(ImportError, match=r"pathway_tpu\[extra\]"):
+        with optional_imports("extra"):
+            raise ImportError("no module")
+
+
+def test_cli_replay_command_registered():
+    from pathway_tpu.cli import cli
+
+    assert set(cli.commands) >= {"spawn", "replay", "spawn-from-env"}
+    replay = cli.commands["replay"]
+    names = {p.name for p in replay.params}
+    assert {"record_path", "mode", "continue_after_replay", "program"} <= names
+
+
+def test_s3_vendor_settings_endpoints():
+    from pathway_tpu.io.s3 import DigitalOceanS3Settings, WasabiS3Settings
+
+    do = DigitalOceanS3Settings("b", access_key="k", secret_access_key="s",
+                                region="fra1")
+    assert "digitaloceanspaces" in do._to_aws().endpoint
+    wa = WasabiS3Settings("b", access_key="k", secret_access_key="s",
+                          region="eu-central-1")
+    assert "wasabisys" in wa._to_aws().endpoint
+
+
+def test_expression_printer_renders_tables():
+    from pathway_tpu.internals.expression_printer import get_expression_info
+
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    info = get_expression_info(t.a + t.b)
+    assert "<table1>.a" in info and "<table1>.b" in info
+    assert "columns [a, b]" in info
+
+
+def test_utc_now_schema():
+    from pathway_tpu.stdlib.temporal.time_utils import TimestampSchema
+
+    assert TimestampSchema.column_names() == ["timestamp_utc"]

@@ -315,3 +315,49 @@ def test_python_connector_persistence(tmp_path):
     # "replay" the subject with the same prefix + new items
     run_once(["a", "b", "a", "c"], tmp_path / "o2.jsonl")
     assert _final_counts(tmp_path / "o2.jsonl") == {"a": 2, "b": 1, "c": 1}
+
+
+def test_env_record_then_replay_roundtrip(tmp_path, monkeypatch):
+    """PATHWAY_SNAPSHOT_ACCESS=record writes snapshots for sources without
+    explicit persistent ids; =replay recomputes identical results with the
+    original inputs gone."""
+    import json
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals import config as config_mod
+
+    in_dir = tmp_path / "in"
+    in_dir.mkdir()
+    (in_dir / "d.jsonl").write_text(
+        "".join(json.dumps({"word": w}) + "\n" for w in ["a", "b", "a"])
+    )
+
+    class S(pw.Schema):
+        word: str
+
+    def build_and_run(out):
+        t = pw.io.jsonlines.read(str(in_dir), schema=S, mode="static")
+        counts = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+        pw.io.jsonlines.write(counts, str(out))
+        pw.run()
+        return {
+            json.loads(l)["word"]: json.loads(l)["c"]
+            for l in open(out)
+            if json.loads(l)["diff"] > 0
+        }
+
+    monkeypatch.setattr(
+        config_mod.pathway_config, "replay_storage", str(tmp_path / "rec")
+    )
+    monkeypatch.setattr(config_mod.pathway_config, "snapshot_access", "record")
+    recorded = build_and_run(tmp_path / "o1.jsonl")
+    assert recorded == {"a": 2, "b": 1}
+
+    pw.clear_graph()
+    (in_dir / "d.jsonl").unlink()
+    monkeypatch.setattr(config_mod.pathway_config, "snapshot_access", "replay")
+    monkeypatch.setattr(
+        config_mod.pathway_config, "persistence_mode", "batch"
+    )
+    replayed = build_and_run(tmp_path / "o2.jsonl")
+    assert replayed == recorded
