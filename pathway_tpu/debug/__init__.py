@@ -389,9 +389,15 @@ class StreamGenerator:
             schema = schema_from_types(
                 **{c: _dtype_from_pandas(df[c]) for c in value_cols}
             )
+        # per-column extraction: iterrows() would upcast mixed-dtype rows
+        # (int columns silently becoming float64)
+        col_values = {c: df[c].tolist() for c in value_cols}
+        times = df["_time"].tolist()
+        diffs = df["_diff"].tolist()
         rows = [
-            tuple(row[c] for c in value_cols) + (int(row["_time"]), int(row["_diff"]))
-            for _, row in df.iterrows()
+            tuple(col_values[c][i] for c in value_cols)
+            + (int(times[i]), int(diffs[i]))
+            for i in range(len(df))
         ]
         return table_from_rows(schema, rows, is_stream=True)
 

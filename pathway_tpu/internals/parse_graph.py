@@ -39,6 +39,12 @@ class ParseGraph:
         from pathway_tpu import persistence as _p
 
         _p._persistent_sources.clear()
+        # graph-scoped memos must not pin the old graph (or leak its nodes)
+        import sys
+
+        tu = sys.modules.get("pathway_tpu.stdlib.temporal.time_utils")
+        if tu is not None:
+            tu._utc_now_memo.clear()
 
 
 G = ParseGraph()

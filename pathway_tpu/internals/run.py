@@ -119,12 +119,18 @@ class GraphRunner:
             # non-shardable connectors run on process 0 only
             connectors = [c for c in connectors if c.shardable]
         if manager is not None:
-            for i, c in enumerate(connectors):
+            seen_ids: dict[str, int] = {}
+            for c in connectors:
                 if c.persistent_id is None:
-                    # auto-generate stable ids (reference: generated
-                    # persistent ids) so record/replay covers every source;
-                    # registration order is deterministic per program
-                    c.persistent_id = f"_pw_auto_{i}_{type(c).__name__}"
+                    # auto-generate ids from stable per-connector identity
+                    # (node name + columns), not list position — adding or
+                    # filtering other connectors must not shift a source's
+                    # id between record and replay
+                    sig = f"{c.node.name}:{','.join(c.node.column_names)}"
+                    n = seen_ids.get(sig, 0)
+                    seen_ids[sig] = n + 1
+                    suffix = f"#{n}" if n else ""
+                    c.persistent_id = f"_pw_auto_{sig}{suffix}"
                 c.setup_persistence(manager)
         for c in connectors:
             sched.register_source(c.node, 0)

@@ -155,8 +155,10 @@ class RAGFunctionPromptTemplate(FunctionPromptTemplate):
             if isinstance(self.function_template, UDF)
             else self.function_template
         )
+        import inspect
+
         try:
-            fn(query=" ", context=" ")
+            inspect.signature(fn).bind(query=" ", context=" ")
         except TypeError as e:
             raise ValueError(
                 "RAG prompt template expects `context` and `query` placeholders "

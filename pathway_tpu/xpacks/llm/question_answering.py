@@ -463,7 +463,7 @@ class RAGClient:
         self.additional_headers = additional_headers or {}
         self.index_client = VectorStoreClient(
             url=self.url,
-            timeout=self.timeout or 90,
+            timeout=self.timeout,
             additional_headers=self.additional_headers,
         )
 
