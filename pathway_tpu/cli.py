@@ -213,6 +213,38 @@ def spawn_from_env(program, arguments):
     spawn.main(args=argv, standalone_mode=True)
 
 
+@cli.group()
+def airbyte() -> None:
+    """Airbyte connector scaffolding (reference ``cli.py:airbyte``)."""
+
+
+@airbyte.command("create-source")
+@click.argument("connection")
+@click.option(
+    "--image",
+    default="airbyte/source-faker:0.1.4",
+    help="any public Docker Airbyte source image",
+)
+def create_source(connection, image):
+    """Write a starter YAML connection config for an Airbyte source.
+    Running the source itself needs docker + network (gated here); the
+    scaffold is generated locally."""
+    import pathlib
+
+    path = pathlib.Path(connection)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(
+        "source:\n"
+        f"  docker_image: {image}\n"
+        "  config:\n"
+        "    # fill in source-specific configuration here\n"
+        "streams: []\n"
+    )
+    click.echo(
+        f"Connection `{path.stem}` with source `{image}` created successfully"
+    )
+
+
 def main() -> None:
     cli.main()
 

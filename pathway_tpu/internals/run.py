@@ -39,7 +39,9 @@ class GraphRunner:
         from pathway_tpu.internals import config as config_mod
         from pathway_tpu.internals.http_server import MetricsServer
         from pathway_tpu.internals.monitoring import maybe_start_monitor
+        from pathway_tpu.internals.telemetry import Telemetry, get_imported_xpacks
 
+        telemetry = Telemetry.create()
         exchange_ctx = None
         n_proc = config_mod.pathway_config.processes
         pid = config_mod.pathway_config.process_id
@@ -142,7 +144,14 @@ class GraphRunner:
         for c in connectors:
             c.start(sched)
         try:
-            sched.run()
+            with telemetry.span(
+                "pathway-tpu.run",
+                {
+                    "operators": len(sched.order),
+                    "xpacks": ",".join(get_imported_xpacks()),
+                },
+            ):
+                sched.run()
             # end-of-stream: flush buffers repeatedly until quiescent.
             # Multi-process: the "anyone flushed?" decision must be global —
             # a process that flushed nothing still has to serve exchanges

@@ -1,0 +1,96 @@
+"""OpenTelemetry integration (reference ``graph_runner/telemetry.py`` and
+``src/engine/telemetry.rs``).
+
+Spans wrap graph build/run and gauges export process stats when the
+``opentelemetry`` packages are importable AND a collector endpoint is
+configured (``pw.set_monitoring_config(server_endpoint=...)`` or
+``PATHWAY_MONITORING_SERVER``); otherwise every call is a cheap no-op, so
+the runtime has no hard dependency.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from contextlib import contextmanager
+from typing import Any
+
+
+def _otel_available() -> bool:
+    return importlib.util.find_spec("opentelemetry") is not None
+
+
+class Telemetry:
+    """Per-run telemetry handle (reference ``Telemetry`` in
+    ``graph_runner/telemetry.py:140``)."""
+
+    def __init__(self, endpoint: str | None):
+        self.endpoint = endpoint
+        self._tracer = None
+        if endpoint and _otel_available():
+            try:
+                from opentelemetry import trace
+                from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+                    OTLPSpanExporter,
+                )
+                from opentelemetry.sdk.resources import Resource
+                from opentelemetry.sdk.trace import TracerProvider
+                from opentelemetry.sdk.trace.export import BatchSpanProcessor
+
+                provider = TracerProvider(
+                    resource=Resource.create({"service.name": "pathway-tpu"})
+                )
+                provider.add_span_processor(
+                    BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint))
+                )
+                self._tracer = trace.get_tracer("pathway-tpu", tracer_provider=provider)
+            except Exception:  # noqa: BLE001 — telemetry must never break a run
+                self._tracer = None
+
+    @classmethod
+    def create(cls, run_id: str | None = None) -> "Telemetry":
+        from pathway_tpu.internals import config as config_mod
+
+        return cls(config_mod.pathway_config.monitoring_server)
+
+    @property
+    def enabled(self) -> bool:
+        return self._tracer is not None
+
+    @contextmanager
+    def span(self, name: str, attributes: dict[str, Any] | None = None):
+        if self._tracer is None:
+            yield None
+            return
+        with self._tracer.start_as_current_span(name) as s:
+            for k, v in (attributes or {}).items():
+                try:
+                    s.set_attribute(k, v)
+                except Exception:  # noqa: BLE001
+                    pass
+            yield s
+
+    def event(self, name: str, attributes: dict[str, Any] | None = None) -> None:
+        if self._tracer is None:
+            return
+        try:
+            from opentelemetry import trace
+
+            span = trace.get_current_span()
+            span.add_event(name, attributes or {})
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def get_imported_xpacks() -> list[str]:
+    """Names of loaded xpacks, for run attribution (reference
+    ``telemetry.py:XPACKS``)."""
+    import sys
+
+    prefix = "pathway_tpu.xpacks."
+    found = set()
+    for mod in sys.modules:
+        if mod.startswith(prefix):
+            rest = mod[len(prefix):]
+            if rest:
+                found.add(rest.split(".")[0])
+    return sorted(found)

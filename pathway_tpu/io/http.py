@@ -20,6 +20,7 @@ from pathway_tpu.engine.operators.core import InputNode
 from pathway_tpu.engine.operators.output import SubscribeNode
 from pathway_tpu.engine.value import Pointer, hash_values
 from pathway_tpu.internals import dtype as dt
+from pathway_tpu.io.python import ConnectorSubject as _PyConnectorSubject
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.json import Json, unwrap_json
 from pathway_tpu.internals.parse_graph import G
@@ -27,6 +28,20 @@ from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._streams import BaseConnector, next_commit_time
 from pathway_tpu.io._utils import format_value_for_output, parse_record_fields, parse_value
+
+
+class EndpointExamples:
+    """Named request examples for endpoint documentation (reference
+    ``io/http/_server.py:89``)."""
+
+    def __init__(self):
+        self.examples_by_id: dict = {}
+
+    def add_example(self, id, summary, values):  # noqa: A002
+        if id in self.examples_by_id:
+            raise ValueError(f"duplicate example id {id!r}")
+        self.examples_by_id[id] = {"summary": summary, "value": values}
+        return None
 
 
 class EndpointDocumentation:
@@ -334,3 +349,52 @@ def write(
         G.engine_graph, table._node, _QueuedHttpWriter(), name=f"http({url})"
     )
     G.register_sink(node)
+
+
+
+class HttpStreamingSubject(_PyConnectorSubject):
+    """Streams a long-lived HTTP response line by line into a table
+    (reference ``io/http/_streaming.py:13``).  Instantiate and pass to
+    ``pw.io.python.read``; subclass and override ``run`` for custom
+    protocols."""
+
+    def __init__(self, url, *, sender=None, payload=None, headers=None,
+                 delimiter=None, response_mapper=None):
+        super().__init__()
+        self._url = url
+        self._sender = sender
+        self._payload = payload
+        self._headers = headers
+        self._delimiter = delimiter
+        self._response_mapper = response_mapper
+
+    def run(self) -> None:
+        send = self._sender or _urllib_stream_sender
+        for line in send(self._url, headers=self._headers, data=self._payload,
+                         delimiter=self._delimiter):
+            if self._response_mapper:
+                line = self._response_mapper(line)
+            self.next_bytes(line if isinstance(line, bytes) else line.encode())
+            self.commit()
+
+
+def _urllib_stream_sender(url, *, headers=None, data=None, delimiter=None):
+    import urllib.request
+
+    req = urllib.request.Request(url, headers=headers or {},
+                                 data=data, method="GET" if data is None else "POST")
+    with urllib.request.urlopen(req) as resp:  # noqa: S310
+        sep = delimiter if delimiter is not None else b"\n"
+        if isinstance(sep, str):
+            sep = sep.encode()
+        buf = b""
+        while True:
+            chunk = resp.read(8192)
+            if not chunk:
+                break
+            buf += chunk
+            while sep in buf:
+                line, buf = buf.split(sep, 1)
+                yield line
+        if buf:
+            yield buf

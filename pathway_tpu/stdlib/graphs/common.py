@@ -33,3 +33,20 @@ class Clustering(Schema):
     """Cluster membership: vertex (row id) belongs to cluster ``c``."""
 
     c: Pointer[Any]
+
+
+class Dist(Schema):
+    """Edge length for shortest paths (reference ``bellman_ford/impl.py``)."""
+
+    dist: float
+
+
+class DistFromSource(Schema):
+    dist_from_source: float
+
+
+class PageRankResult(Schema):
+    """Reference ``pagerank/impl.py:Result`` (rank is a damped probability
+    mass, a float)."""
+
+    rank: float

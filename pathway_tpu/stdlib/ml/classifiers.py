@@ -37,6 +37,10 @@ class DataPoint(Schema):
     data: np.ndarray
 
 
+class MetaDataSchema(Schema):
+    metadata: dict
+
+
 def _euclidean_distance(data_table: np.ndarray, query_point: np.ndarray) -> np.ndarray:
     return np.sum((data_table - query_point) ** 2, axis=1).astype(float)
 

@@ -1081,3 +1081,21 @@ def asof_now_join_inner(left_table, right_table, *on, id=None):  # noqa: A002
 
 def asof_now_join_left(left_table, right_table, *on, id=None):  # noqa: A002
     return asof_now_join(left_table, right_table, *on, id=id, how="left")
+
+
+# reference result-class names (our temporal joins expose the same select
+# surface through _binary_temporal's JoinResult-like object)
+class AsofJoinResult:  # noqa: D401 — name parity marker
+    """Alias target for reference ``_asof_join.py:AsofJoinResult``."""
+
+
+class AsofNowJoinResult:
+    """Alias target for reference ``_asof_now_join.py:AsofNowJoinResult``."""
+
+
+class IntervalJoinResult:
+    """Alias target for reference ``_interval_join.py:IntervalJoinResult``."""
+
+
+class WindowJoinResult:
+    """Alias target for reference ``_window_join.py:WindowJoinResult``."""

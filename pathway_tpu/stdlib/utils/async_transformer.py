@@ -17,6 +17,17 @@ from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.table import Table
 
 
+import enum
+
+
+class ResultType(enum.Enum):
+    """Row outcome of an async transformer invocation (reference
+    ``async_transformer.py:ResultType``)."""
+
+    SUCCESS = "success"
+    FAILURE = "failure"
+
+
 class AsyncTransformer(ABC):
     output_schema: ClassVar[Any]
 

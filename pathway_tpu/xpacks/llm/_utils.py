@@ -71,3 +71,20 @@ def post_json(url: str, payload: dict, headers: dict | None = None,
     )
     with urllib.request.urlopen(req, timeout=timeout) as resp:  # noqa: S310
         return json.loads(resp.read().decode())
+
+
+def get_func_arg_names(func):
+    """Positional/keyword parameter names of ``func`` (reference
+    ``xpacks/llm/_utils.py:74``); *args/**kwargs placeholders excluded."""
+    import inspect
+
+    kinds = (
+        inspect.Parameter.POSITIONAL_ONLY,
+        inspect.Parameter.POSITIONAL_OR_KEYWORD,
+        inspect.Parameter.KEYWORD_ONLY,
+    )
+    return [
+        p.name
+        for p in inspect.signature(func).parameters.values()
+        if p.kind in kinds
+    ]

@@ -15,6 +15,91 @@ import pathway_tpu as pw
 logger = logging.getLogger(__name__)
 
 
+def _default_vision_llm():
+    """Lazy ``DEFAULT_VISION_LLM`` (reference ``parsers.py:45``): an
+    OpenAIChat on the default vision model with disk cache + backoff; built
+    on first use so importing parsers never constructs network clients."""
+    from pathway_tpu.internals import udfs
+    from pathway_tpu.xpacks.llm import llms
+    from pathway_tpu.xpacks.llm.constants import DEFAULT_VISION_MODEL
+
+    return llms.OpenAIChat(
+        model=DEFAULT_VISION_MODEL,
+        cache_strategy=udfs.DiskCache(),
+        retry_strategy=udfs.ExponentialBackoffRetryStrategy(max_retries=4),
+        verbose=True,
+    )
+
+
+class _LazyVisionLLM:
+    _inner = None
+
+    def _resolve(self):
+        if type(self)._inner is None:
+            type(self)._inner = _default_vision_llm()
+        return type(self)._inner
+
+    def __call__(self, *args, **kwargs):
+        return self._resolve()(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._resolve(), name)
+
+
+DEFAULT_VISION_LLM = _LazyVisionLLM()
+
+
+async def parse_images(images, llm, parse_prompt: str, *, run_mode: str = "parallel",
+                       parse_details: bool = False, detail_parse_schema=None,
+                       parse_image_details_fn=None,
+                       max_image_size: int = 15 * 1024 * 1024,
+                       downsize_horizontal_width: int = 1920):
+    """Describe a list of PIL images with a vision LLM (reference
+    ``parsers.py:parse_images``): downscale oversized images, base64-encode,
+    and fan the prompts out (``run_mode``: "parallel" | "sequential")."""
+    import asyncio
+
+    from pathway_tpu.xpacks.llm._parser_utils import (
+        img_to_b64,
+        maybe_downscale,
+        parse,
+        parse_image_details,
+    )
+
+    if run_mode not in ("parallel", "sequential"):
+        raise ValueError(
+            f"run_mode must be 'parallel' or 'sequential', got {run_mode!r}"
+        )
+    b64_images = [
+        img_to_b64(maybe_downscale(img, max_image_size, downsize_horizontal_width))
+        for img in images
+    ]
+    if run_mode == "sequential":
+        parsed = [await parse(b64, llm, parse_prompt) for b64 in b64_images]
+    else:
+        parsed = list(
+            await asyncio.gather(*(parse(b64, llm, parse_prompt) for b64 in b64_images))
+        )
+    details: list = []
+    if parse_details:
+        if detail_parse_schema is None:
+            raise ValueError(
+                "parse_details=True requires detail_parse_schema"
+            )
+        detail_fn = parse_image_details_fn or parse_image_details
+        if run_mode == "sequential":
+            details = [
+                await detail_fn(b64, detail_parse_schema) for b64 in b64_images
+            ]
+        else:
+            details = list(
+                await asyncio.gather(
+                    *(detail_fn(b64, detail_parse_schema) for b64 in b64_images)
+                )
+            )
+    return parsed, details
+
+
 class ParseUtf8(pw.UDF):
     """Decode UTF-8 text (reference ``ParseUtf8``, parsers.py:53)."""
 

@@ -188,3 +188,63 @@ def test_utc_now_schema():
     from pathway_tpu.stdlib.temporal.time_utils import TimestampSchema
 
     assert TimestampSchema.column_names() == ["timestamp_utc"]
+
+
+def test_endpoint_examples_and_streaming_subject():
+    from pathway_tpu.io.http import EndpointExamples, HttpStreamingSubject
+
+    ex = EndpointExamples()
+    ex.add_example("default", "the default", {"q": "hi"})
+    with pytest.raises(ValueError):
+        ex.add_example("default", "dup", {})
+    subj = HttpStreamingSubject(
+        "http://localhost:1/never", sender=lambda *a, **k: iter([b"x"])
+    )
+    assert hasattr(subj, "run")
+
+
+def test_vision_parse_images_roundtrip():
+    import asyncio
+
+    import numpy as np
+    import PIL.Image
+
+    from pathway_tpu.xpacks.llm._parser_utils import img_to_b64, maybe_downscale
+    from pathway_tpu.xpacks.llm.parsers import parse_images
+
+    img = PIL.Image.fromarray(np.zeros((300, 400, 3), dtype=np.uint8))
+    assert len(img_to_b64(img)) > 100
+    small = maybe_downscale(img, max_image_size=1000, downsize_horizontal_width=32)
+    assert small.size[0] == 32
+
+    async def fake_llm(messages, model=None):
+        return f"described:{model}"
+
+    parsed, details = asyncio.run(parse_images([img, img], fake_llm, "desc"))
+    assert parsed == ["described:gpt-4o", "described:gpt-4o"]
+    assert details == []
+
+
+def test_telemetry_noop_and_xpacks():
+    from pathway_tpu.internals.telemetry import Telemetry, get_imported_xpacks
+
+    t = Telemetry(endpoint=None)
+    assert not t.enabled
+    with t.span("x", {"k": 1}) as s:
+        assert s is None
+    t.event("e")
+    assert "llm" in get_imported_xpacks()
+
+
+def test_cli_airbyte_create_source(tmp_path):
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli
+
+    dest = tmp_path / "connections" / "faker.yaml"
+    result = CliRunner().invoke(
+        cli, ["airbyte", "create-source", str(dest), "--image", "airbyte/source-x:1"]
+    )
+    assert result.exit_code == 0, result.output
+    assert "created successfully" in result.output
+    assert "airbyte/source-x:1" in dest.read_text()
