@@ -68,27 +68,142 @@ class StatefulNode(Node):
         self._in_states = [TableState(i.column_names) for i in self.inputs]
 
 
+def _contains_nondeterministic(expr) -> bool:
+    from pathway_tpu.internals import expression as expr_mod
+
+    if isinstance(
+        expr, (expr_mod.ApplyExpression, expr_mod.AsyncApplyExpression)
+    ) and not getattr(expr, "_deterministic", True):
+        return True
+    return any(
+        _contains_nondeterministic(d)
+        for d in expr._deps()
+        if hasattr(d, "_deps")
+    )
+
+
 class RowwiseNode(Node):
     """Vectorized expression evaluation over input deltas (select/with_columns).
 
-    Stateless: a delta row in produces a delta row out with the same key and
-    diff — expressions are deterministic functions of the row.
+    Normally stateless: a delta row in produces a delta row out with the same
+    key and diff. When any expression contains a NON-DETERMINISTIC UDF
+    (``deterministic=False``), the node caches each inserted row's outputs so
+    a later retraction replays the exact values produced at insertion —
+    re-running the UDF could yield different values, and the retraction would
+    then fail to cancel downstream state (reference
+    ``map_named_async_with_consistent_deletions``, ``operators.rs:320-380``).
     """
 
     def __init__(self, graph, input_node, expressions: dict[str, Any], name="Rowwise"):
         super().__init__(graph, [input_node], list(expressions.keys()), name)
         self.expressions = expressions
+        self._nondet = any(
+            _contains_nondeterministic(e) for e in expressions.values()
+        )
+        # key -> [refcount, {out_col: value}]
+        self._replay_cache: dict[int, list] = {}
+
+    _state_attrs = ("_replay_cache",)
+
+    def is_stateful(self) -> bool:  # only when the cache is load-bearing
+        return self._nondet
+
+    def reset(self):
+        super().reset()
+        self._replay_cache = {}
 
     def step(self, time, ins):
         (batch,) = ins
         if batch is None or len(batch) == 0:
             return None
-        env = EvalEnv(batch.cols, batch.keys, len(batch))
-        ev = ExpressionEvaluator(env)
-        out_cols = {}
-        for name, expr in self.expressions.items():
-            out_cols[name] = ev.eval(expr)
-        return Batch(batch.keys, out_cols, batch.diffs)
+        if not self._nondet:
+            env = EvalEnv(batch.cols, batch.keys, len(batch))
+            ev = ExpressionEvaluator(env)
+            out_cols = {}
+            for name, expr in self.expressions.items():
+                out_cols[name] = ev.eval(expr)
+            return Batch(batch.keys, out_cols, batch.diffs)
+        return self._step_consistent(batch)
+
+    def _step_consistent(self, batch):
+        from pathway_tpu.engine.value import hash_values
+
+        names = list(self.expressions.keys())
+        in_names = self.inputs[0].column_names
+        n = len(batch)
+        keys = batch.keys
+        diffs = batch.diffs
+        in_rows = [
+            tuple(batch.cols[c][i] for c in in_names) for i in range(n)
+        ]
+        # cache entries are keyed by (row key, input-row hash): a key
+        # re-inserted with different content gets its own entry, and the
+        # retraction (which carries the original input row) finds the value
+        # produced at that row's insertion
+        ckeys = []
+        for i in range(n):
+            try:
+                rh = hash_values(*in_rows[i])
+            except Exception:  # noqa: BLE001 — unhashable exotic values
+                rh = 0
+            ckeys.append((int(keys[i]), rh))
+
+        # plan in row order against simulated cache membership, so a
+        # same-batch insert-then-delete replays the insert's fresh value and
+        # a delete-then-insert recomputes after eviction
+        membership = {
+            ck: entry[0] for ck, entry in self._replay_cache.items()
+        }
+        live = np.zeros(n, dtype=bool)
+        for i in range(n):
+            ck = ckeys[i]
+            d = int(diffs[i])
+            present = membership.get(ck, 0) > 0
+            if present:
+                membership[ck] = membership.get(ck, 0) + d
+            elif d > 0:
+                live[i] = True
+                membership[ck] = d
+            else:
+                # retraction with no cached insertion (e.g. restart without
+                # operator state): best-effort live recompute
+                live[i] = True
+
+        out_cols = {name: np.empty(n, dtype=object) for name in names}
+        live_idx = np.nonzero(live)[0]
+        if len(live_idx):
+            sub = batch.take(live)
+            env = EvalEnv(sub.cols, sub.keys, len(sub))
+            ev = ExpressionEvaluator(env)
+            for name, expr in self.expressions.items():
+                vals = ev.eval(expr)
+                for j, i in enumerate(live_idx):
+                    out_cols[name][i] = vals[j]
+
+        for i in range(n):
+            ck = ckeys[i]
+            d = int(diffs[i])
+            entry = self._replay_cache.get(ck)
+            if live[i]:
+                if d > 0:
+                    if entry is None:
+                        self._replay_cache[ck] = [
+                            d, {name: out_cols[name][i] for name in names}
+                        ]
+                    else:
+                        # identical row re-inserted: replay the stored value
+                        # so every copy downstream is byte-identical
+                        for name in names:
+                            out_cols[name][i] = entry[1][name]
+                        entry[0] += d
+                # live deletions (fallback path) emit the recomputed value
+            else:
+                for name in names:
+                    out_cols[name][i] = entry[1][name]
+                entry[0] += d
+                if entry[0] <= 0:
+                    del self._replay_cache[ck]
+        return Batch(keys, out_cols, diffs)
 
 
 class FilterNode(Node):

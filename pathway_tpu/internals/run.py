@@ -186,6 +186,7 @@ class GraphRunner:
                 c.stop()
             sched.teardown_exchanges()
             sched.shutdown()
+            telemetry.shutdown()
             sched.stats.finished = True
             if monitor is not None:
                 monitor.stop()

@@ -26,6 +26,7 @@ class Telemetry:
     def __init__(self, endpoint: str | None):
         self.endpoint = endpoint
         self._tracer = None
+        self._provider = None
         if endpoint and _otel_available():
             try:
                 from opentelemetry import trace
@@ -43,8 +44,10 @@ class Telemetry:
                     BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint))
                 )
                 self._tracer = trace.get_tracer("pathway-tpu", tracer_provider=provider)
+                self._provider = provider
             except Exception:  # noqa: BLE001 — telemetry must never break a run
                 self._tracer = None
+                self._provider = None
 
     @classmethod
     def create(cls, run_id: str | None = None) -> "Telemetry":
@@ -69,6 +72,17 @@ class Telemetry:
                     pass
             yield s
 
+    def shutdown(self) -> None:
+        """Flush queued spans and stop the exporter — short runs would
+        otherwise exit before BatchSpanProcessor's export interval."""
+        if self._provider is not None:
+            try:
+                self._provider.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            self._provider = None
+            self._tracer = None
+
     def event(self, name: str, attributes: dict[str, Any] | None = None) -> None:
         if self._tracer is None:
             return
@@ -88,7 +102,7 @@ def get_imported_xpacks() -> list[str]:
 
     prefix = "pathway_tpu.xpacks."
     found = set()
-    for mod in sys.modules:
+    for mod in list(sys.modules):
         if mod.startswith(prefix):
             rest = mod[len(prefix):]
             if rest:

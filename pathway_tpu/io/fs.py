@@ -122,6 +122,10 @@ class _FsConnector(BaseConnector):
         self.csv_settings = csv_settings
         self.refresh_interval = refresh_interval
         self._seen: dict[str, float] = {}
+        # primary-keyed sources are upsert sessions (reference
+        # SessionType::Upsert): later rows with an existing key retract the
+        # previous row instead of duplicating the key
+        self._emitted_pk: dict[int, tuple] = {}
         if mode != "static":
             self.heartbeat_ms = 500
 
@@ -135,6 +139,12 @@ class _FsConnector(BaseConnector):
         if isinstance(offset, dict):
             self._seen.update(offset)
 
+    def on_replay(self, rows) -> None:
+        if self.schema.primary_key_columns():
+            for key, row, diff in rows:
+                if diff > 0:
+                    self._emitted_pk[key] = row
+
     shardable = True  # files partition across processes by path hash
 
     def _read_all(self, seen: dict[str, float]) -> list[tuple[int, tuple, int]]:
@@ -147,7 +157,11 @@ class _FsConnector(BaseConnector):
         rows = []
         pk = self.schema.primary_key_columns()
         for fp in _list_files(self.path):
-            if n_proc > 1 and shard_of_key(hash_values(fp), n_proc) != pid:
+            # keyless sources shard whole files by path; primary-keyed
+            # (upsert) sources must shard by KEY so one process owns all
+            # versions of a key across files — every process scans every
+            # file and keeps its key shard
+            if n_proc > 1 and not pk and shard_of_key(hash_values(fp), n_proc) != pid:
                 continue
             try:
                 mtime = os.path.getmtime(fp)
@@ -162,11 +176,20 @@ class _FsConnector(BaseConnector):
             ):
                 if self.with_metadata:
                     values = {**values, "_metadata": meta}
+                row = tuple(values[c] for c in cols)
                 if pk:
                     key = hash_values(*[values[c] for c in pk])
+                    if n_proc > 1 and shard_of_key(key, n_proc) != pid:
+                        continue
+                    old = self._emitted_pk.get(key)
+                    if old == row:
+                        continue
+                    if old is not None:
+                        rows.append((key, old, -1))
+                    self._emitted_pk[key] = row
                 else:
                     key = hash_values(fp, i)
-                rows.append((key, tuple(values[c] for c in cols), 1))
+                rows.append((key, row, 1))
         return rows
 
     def run(self):

@@ -381,6 +381,8 @@ class HttpStreamingSubject(_PyConnectorSubject):
 def _urllib_stream_sender(url, *, headers=None, data=None, delimiter=None):
     import urllib.request
 
+    if isinstance(data, str):
+        data = data.encode()
     req = urllib.request.Request(url, headers=headers or {},
                                  data=data, method="GET" if data is None else "POST")
     with urllib.request.urlopen(req) as resp:  # noqa: S310

@@ -1,0 +1,103 @@
+"""Multi-worker re-runs of core pipelines (reference test strategy: the
+same tests execute under ``PATHWAY_THREADS>1``; tests that cannot, skip —
+``tests/utils.py:36-50``).  Covers joins, groupby, flatten, LSH classify,
+and the non-deterministic UDF cache under the threaded scheduler."""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import _capture_rows, run_all_and_collect
+
+
+@pytest.fixture(autouse=True)
+def _two_workers(monkeypatch):
+    monkeypatch.setenv("PATHWAY_THREADS", "2")
+
+
+def test_join_groupby_threads():
+    orders = pw.debug.table_from_markdown(
+        """
+        item | qty
+        a    | 2
+        b    | 3
+        a    | 5
+        """
+    )
+    prices = pw.debug.table_from_markdown(
+        """
+        item | price
+        a    | 10
+        b    | 100
+        """
+    )
+    j = orders.join(prices, orders.item == prices.item).select(
+        item=orders.item, cost=orders.qty * prices.price
+    )
+    total = j.groupby(j.item).reduce(j.item, total=pw.reducers.sum(j.cost))
+    rows, cols = _capture_rows(total)
+    got = {r[cols.index("item")]: r[cols.index("total")] for r in rows.values()}
+    assert got == {"a": 70, "b": 300}
+
+
+def test_flatten_and_ix_threads():
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(xs=tuple),
+        rows=[((1, 2, 3),), ((4,),)],
+    )
+    flat = t.flatten(t.xs, origin_id="origin")
+    back = flat.select(flat.xs, first=t.ix(flat.origin).xs)
+    rows, cols = _capture_rows(back)
+    for r in rows.values():
+        assert r[cols.index("xs")] in r[cols.index("first")]
+
+
+def test_nondeterministic_cache_threads():
+    counter = itertools.count()
+
+    @pw.udf(deterministic=False)
+    def stamp(x: int) -> int:
+        return x * 100 + next(counter)
+
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(x=int),
+        rows=[(1, 2, 1), (2, 2, 1), (1, 4, -1)],
+        is_stream=True,
+    )
+    out = t.select(y=stamp(t.x))
+    updates = [(row, diff) for _t, _k, row, diff in run_all_and_collect(out)]
+    inserted_for_1 = [r for r, d in updates if d > 0 and r[0] // 100 == 1]
+    deleted_for_1 = [r for r, d in updates if d < 0]
+    assert deleted_for_1 == inserted_for_1
+
+
+def test_knn_classify_threads():
+    gen = np.random.default_rng(5)
+    full = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=np.ndarray, label=str),
+        rows=[(gen.normal(0, 0.05, 4), "lo") for _ in range(6)]
+        + [(gen.normal(0, 0.05, 4) + 4, "hi") for _ in range(6)],
+    )
+    data, labels = full.select(full.data), full.select(full.label)
+    queries = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(data=np.ndarray),
+        rows=[(np.full(4, 0.01),), (np.full(4, 4.01),)],
+    )
+    from pathway_tpu.stdlib.ml.classifiers import (
+        knn_lsh_classifier_train,
+        knn_lsh_classify,
+    )
+
+    model = knn_lsh_classifier_train(data, L=4, type="euclidean", d=4, M=2, A=4.0)
+    pred = knn_lsh_classify(model, labels, queries, k=3)
+    rows, cols = _capture_rows(pred)
+    got = sorted(
+        r[cols.index("predicted_label")]
+        for r in rows.values()
+        if r[cols.index("predicted_label")] is not None
+    )
+    assert got == ["hi", "lo"]

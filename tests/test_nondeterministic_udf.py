@@ -1,0 +1,128 @@
+"""Consistent-deletion semantics for non-deterministic UDFs — reference
+``map_named_async_with_consistent_deletions`` (``operators.rs:320-380``)."""
+
+from __future__ import annotations
+
+import itertools
+
+import pathway_tpu as pw
+from tests.utils import run_all_and_collect
+
+
+def _streamed_insert_delete():
+    """One row inserted at t=2 and retracted at t=4."""
+    return pw.debug.table_from_rows(
+        schema=pw.schema_from_types(x=int),
+        rows=[(7, 2, 1), (7, 4, -1)],
+        is_stream=True,
+    )
+
+
+def test_nondeterministic_udf_retraction_replays_cached_value():
+    counter = itertools.count()
+
+    @pw.udf(deterministic=False)
+    def stamp(x: int) -> int:
+        return x * 1000 + next(counter)
+
+    t = _streamed_insert_delete()
+    out = t.select(y=stamp(t.x))
+    updates = [(row, diff) for _t, _k, row, diff in run_all_and_collect(out)]
+    inserts = [row for row, diff in updates if diff > 0]
+    deletes = [row for row, diff in updates if diff < 0]
+    assert len(inserts) == 1 and len(deletes) == 1
+    # the retraction must carry the value produced at insertion, even though
+    # re-running the UDF would have produced a different stamp
+    assert inserts[0] == deletes[0]
+    # the UDF really is non-deterministic across calls
+    assert next(counter) >= 1
+
+
+def test_deterministic_udf_keeps_stateless_path():
+    @pw.udf(deterministic=True)
+    def double(x: int) -> int:
+        return 2 * x
+
+    t = _streamed_insert_delete()
+    out = t.select(y=double(t.x))
+    node = out._node
+    assert not node.is_stateful()
+    updates = [(row, diff) for _t, _k, row, diff in run_all_and_collect(out)]
+    assert ((14,), 1) in updates and ((14,), -1) in updates
+
+
+def test_nondeterministic_cache_refcounts_and_evicts():
+    counter = itertools.count()
+
+    @pw.udf(deterministic=False)
+    def stamp(x: int) -> int:
+        return next(counter)
+
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(x=int),
+        rows=[(1, 2, 1), (1, 4, -1), (1, 6, 1), (1, 8, -1)],
+        is_stream=True,
+    )
+    out = t.select(y=stamp(t.x))
+    updates = run_all_and_collect(out)
+    by_time: dict = {}
+    for tm, _k, row, diff in updates:
+        by_time.setdefault(tm, []).append((row[0], diff))
+    times = sorted(by_time)
+    assert len(times) == 4
+    first_val = by_time[times[0]][0][0]
+    assert by_time[times[1]] == [(first_val, -1)]
+    second_val = by_time[times[2]][0][0]
+    # after eviction the second insertion recomputes (fresh stamp)
+    assert second_val != first_val
+    assert by_time[times[3]] == [(second_val, -1)]
+    # cache drained after the final retraction
+    assert out._node._replay_cache == {}
+
+
+def test_same_batch_insert_delete_consistent():
+    """An insert and its retraction arriving in ONE batch must cancel: the
+    retraction replays the value computed for the insert in that batch."""
+    counter = itertools.count()
+
+    @pw.udf(deterministic=False)
+    def stamp(x: int) -> int:
+        return x * 100 + next(counter)
+
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(x=int),
+        rows=[(1, 2, 1), (1, 2, -1), (2, 2, 1)],
+        is_stream=True,
+    )
+    out = t.select(y=stamp(t.x))
+    updates = [(row, diff) for _t, _k, row, diff in run_all_and_collect(out)]
+    net: dict = {}
+    for row, diff in updates:
+        net[row] = net.get(row, 0) + diff
+    net = {k: v for k, v in net.items() if v != 0}
+    assert len(net) == 1  # only the x=2 row survives
+    assert out._node._replay_cache and len(out._node._replay_cache) == 1
+
+
+def test_update_same_key_distinct_rows():
+    """Key updated (retract old row, insert new row): the retraction uses
+    the OLD row's cached value, the insert computes fresh."""
+    counter = itertools.count()
+
+    @pw.udf(deterministic=False)
+    def stamp(x: int) -> int:
+        return x * 100 + next(counter)
+
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(k=str, x=int),
+        rows=[("a", 1, 2, 1), ("a", 1, 4, -1), ("a", 5, 4, 1)],
+        is_stream=True,
+    )
+    out = t.select(t.k, y=stamp(t.x))
+    updates = [(row, diff) for _t, _k, row, diff in run_all_and_collect(out)]
+    net: dict = {}
+    for row, diff in updates:
+        net[row] = net.get(row, 0) + diff
+    net = {k: v for k, v in net.items() if v != 0}
+    (survivor,) = net
+    assert survivor[1] // 100 == 5  # the new row's value survives
