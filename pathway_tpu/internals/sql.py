@@ -79,10 +79,38 @@ def sql(query: str, **tables) -> Any:
     >>> pw.sql("SELECT a, SUM(b) AS s FROM t GROUP BY a", t=my_table)
     """
     tk = _Tokenizer(query)
+    tables = dict(tables)
+    # WITH name AS ( select ) [, name2 AS ( select )] ... — CTEs become
+    # additional named tables visible to the main select
+    if tk.accept("WITH"):
+        while True:
+            name = tk.next()
+            tk.expect("AS")
+            tk.expect("(")
+            tables[name] = _parse_select(tk, tables)
+            tk.expect(")")
+            if not tk.accept(","):
+                break
     return _parse_select(tk, tables)
 
 
 def _parse_select(tk: _Tokenizer, tables: dict):
+    """One SELECT plus a left-associative chain of set operations."""
+    result = _parse_single_select(tk, tables)
+    while True:
+        if tk.accept("UNION"):
+            kind = "union_all" if tk.accept("ALL") else "union"
+            result = _apply_set_op(result, kind, _parse_single_select(tk, tables))
+        elif tk.accept("INTERSECT"):
+            result = _apply_set_op(
+                result, "intersect", _parse_single_select(tk, tables)
+            )
+        else:
+            break
+    return result
+
+
+def _parse_single_select(tk: _Tokenizer, tables: dict):
     tk.expect("SELECT")
     # projections
     projections: list[tuple[str | None, Any]] = []  # (alias, raw expr fn)
@@ -141,12 +169,6 @@ def _parse_select(tk: _Tokenizer, tables: dict):
     if tk.accept("HAVING"):
         having = _parse_bool_expr(tk)
     # UNION / INTERSECT
-    set_op = None
-    if tk.accept("UNION"):
-        tk.accept("ALL")
-        set_op = ("union", _parse_select(tk, tables))
-    elif tk.accept("INTERSECT"):
-        set_op = ("intersect", _parse_select(tk, tables))
 
     # build
     if where_expr is not None:
@@ -200,14 +222,44 @@ def _parse_select(tk: _Tokenizer, tables: dict):
         for alias, e in projections:
             name = alias or _default_name(e)
             sel[name] = _materialize(e, table)
-        result = table.select(**sel)
-    if set_op is not None:
-        kind, other = set_op
-        if kind == "union":
-            result = result.concat_reindex(other)
+
+        def has_agg(ast):
+            if not isinstance(ast, tuple):
+                return False
+            if ast[0] == "agg":
+                return True
+            return any(has_agg(a) for a in ast)
+
+        if any(has_agg(e) for _alias, e in projections):
+            # global aggregate (SELECT COUNT(*) FROM t without GROUP BY)
+            result = table.reduce(**sel)
         else:
-            result = result.intersect(other)
+            result = table.select(**sel)
     return result
+
+
+def _distinct_by_content(t):
+    """Content-keyed distinct rows: groupby on every column both dedups and
+    keys the output by row content, so equal rows on the two sides of a set
+    op share a key."""
+    cols = t.column_names()
+    return t.groupby(*[t[c] for c in cols]).reduce(*[t[c] for c in cols])
+
+
+def _apply_set_op(result, kind: str, other):
+    """SQL set semantics: by ROW CONTENT with dedup (except UNION ALL)."""
+    cols = result.column_names()
+    if other.column_names() != cols:
+        raise ValueError(
+            f"set operation column mismatch: {cols} vs {other.column_names()}"
+        )
+    if kind == "union_all":
+        return result.concat_reindex(other)
+    left = _distinct_by_content(result)
+    right = _distinct_by_content(other)
+    if kind == "union":
+        return left.update_rows(right)
+    return left.intersect(right)
 
 
 def _resolve_col(name: str, tables_by_name: dict):

@@ -167,3 +167,70 @@ def test_groupby_instance_colocation_key():
         for n in range(64)
     }
     assert len(slots) > 1
+
+
+def test_sql_set_ops_content_semantics():
+    """SQL UNION dedups by row content, UNION ALL keeps duplicates,
+    INTERSECT matches content not keys."""
+    t1 = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | x
+        2 | y
+        3 | x
+        """
+    )
+    t2 = pw.debug.table_from_markdown(
+        """
+        a | b
+        2 | y
+        4 | z
+        """
+    )
+    assert len(_capture_rows(pw.sql(
+        "SELECT * FROM t1 UNION SELECT * FROM t2", t1=t1, t2=t2))[0]) == 4
+    assert len(_capture_rows(pw.sql(
+        "SELECT * FROM t1 UNION ALL SELECT * FROM t2", t1=t1, t2=t2))[0]) == 5
+    rows, cols = _capture_rows(pw.sql(
+        "SELECT * FROM t1 INTERSECT SELECT * FROM t2", t1=t1, t2=t2))
+    (row,) = rows.values()
+    assert row == (2, "y")
+
+
+def test_sql_with_cte_and_global_aggregates():
+    t1 = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    rows, cols = _capture_rows(pw.sql(
+        "SELECT COUNT(*) AS n, SUM(a) AS s FROM t1", t1=t1))
+    (row,) = rows.values()
+    assert row == (3, 6)
+
+    rows, cols = _capture_rows(pw.sql(
+        "WITH big AS (SELECT * FROM t1 WHERE a >= 2), "
+        "top AS (SELECT * FROM big WHERE a >= 3) "
+        "SELECT COUNT(*) AS n FROM top",
+        t1=t1,
+    ))
+    (row,) = rows.values()
+    assert row == (1,)
+
+
+def test_sql_set_ops_dedup_and_left_associativity():
+    tA = pw.debug.table_from_markdown("\na\n1\n1\n")
+    tB = pw.debug.table_from_markdown("\na\n2\n")
+    tC = pw.debug.table_from_markdown("\na\n3\n")
+    # duplicates inside one side dedup instead of crashing
+    assert len(_capture_rows(pw.sql(
+        "SELECT * FROM tA UNION SELECT * FROM tB", tA=tA, tB=tB))[0]) == 2
+    # (A UNION ALL B) UNION C — left-associative, final UNION dedups
+    assert len(_capture_rows(pw.sql(
+        "SELECT * FROM tA UNION ALL SELECT * FROM tB UNION SELECT * FROM tC",
+        tA=tA, tB=tB, tC=tC))[0]) == 3
+    assert len(_capture_rows(pw.sql(
+        "SELECT * FROM tA INTERSECT SELECT * FROM tA", tA=tA))[0]) == 1
