@@ -48,4 +48,17 @@ __all__ = [
     "contrastive_loss",
     "make_train_step",
     "init_train_state",
+    "MoEConfig",
+    "init_moe_params",
+    "moe_ffn",
+    "moe_partition_specs",
+    "encode_pipelined",
 ]
+
+from pathway_tpu.models.moe import (  # noqa: E402
+    MoEConfig,
+    init_moe_params,
+    moe_ffn,
+    moe_partition_specs,
+)
+from pathway_tpu.models.pipeline import encode_pipelined  # noqa: E402

@@ -1,0 +1,106 @@
+"""Mixture-of-Experts FFN with expert parallelism (Switch-style top-1
+routing with capacity).
+
+The reference has no model-parallel code (SURVEY §2.11 — models are opaque
+external libraries); this block extends the flagship family beyond it.
+Experts are stacked on a leading axis so the whole block runs as three
+einsums — dispatch, expert FFN, combine — and the expert axis shards over
+an ``ep`` mesh axis: each device holds ``E / ep`` experts and the dispatched
+token blocks move over ICI via the all-to-all XLA inserts for the sharded
+einsum (the jax-native analog of Switch Transformer's MoE layer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models.transformer import TransformerConfig, _dense_init
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+
+
+def init_moe_params(rng: jax.Array, cfg: TransformerConfig, moe: MoEConfig) -> dict:
+    """Router + stacked expert FFN weights: experts on the leading axis
+    (the ``ep`` sharding axis)."""
+    ks = jax.random.split(rng, 3)
+    h, f, e = cfg.hidden, cfg.intermediate, moe.n_experts
+    return {
+        "router_w": _dense_init(ks[0], (h, e), jnp.float32),
+        "expert_in_w": _dense_init(ks[1], (e, h, f), jnp.float32),
+        "expert_in_b": jnp.zeros((e, f), jnp.float32),
+        "expert_out_w": _dense_init(ks[2], (e, f, h), jnp.float32),
+        "expert_out_b": jnp.zeros((e, h), jnp.float32),
+    }
+
+
+def moe_partition_specs(moe: MoEConfig, ep_axis: str = "ep") -> dict:
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "router_w": P(None, None),
+        "expert_in_w": P(ep_axis, None, None),
+        "expert_in_b": P(ep_axis, None),
+        "expert_out_w": P(ep_axis, None, None),
+        "expert_out_b": P(ep_axis, None),
+    }
+
+
+def moe_ffn(x: jax.Array, mp: dict, cfg: TransformerConfig, moe: MoEConfig):
+    """Top-1 routed MoE FFN over tokens.
+
+    x: (B, S, H).  Returns (y, aux_loss): y (B, S, H) f32 where each token is
+    processed by its top-1 expert (dropped tokens — over expert capacity —
+    pass through as zeros, standard Switch behavior), and the load-balancing
+    auxiliary loss.
+    """
+    B, S, H = x.shape
+    T = B * S
+    E = moe.n_experts
+    # capacity per expert, padded up so the dispatch tensor is static
+    C = max(1, int(moe.capacity_factor * T / E))
+
+    tokens = x.reshape(T, H).astype(jnp.float32)
+    logits = tokens @ mp["router_w"]  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)          # (T,)
+    expert = jnp.argmax(probs, axis=-1)     # (T,)
+
+    # position of each token within its expert's queue (first-come order)
+    one_hot = jax.nn.one_hot(expert, E, dtype=jnp.float32)       # (T, E)
+    pos = jnp.cumsum(one_hot, axis=0) * one_hot                   # (T, E)
+    pos = jnp.sum(pos, axis=-1) - 1.0                             # (T,)
+    keep = pos < C
+    gate = gate * keep
+
+    # dispatch (T, E, C) one-hot: token t -> slot (expert[t], pos[t])
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)  # (T, C)
+    dispatch = one_hot[:, :, None] * slot[:, None, :] * keep[:, None, None]
+    combine = dispatch * gate[:, None, None]
+
+    # expert compute: (E, C, H) blocks; the E axis shards over ep
+    xs = jnp.einsum("tec,th->ech", dispatch, tokens,
+                    preferred_element_type=jnp.float32)
+    hdn = jnp.einsum("ech,ehf->ecf", xs, mp["expert_in_w"],
+                     preferred_element_type=jnp.float32)
+    hdn = jax.nn.gelu(hdn + mp["expert_in_b"][:, None, :])
+    out = jnp.einsum("ecf,efh->ech", hdn, mp["expert_out_w"],
+                     preferred_element_type=jnp.float32)
+    out = out + mp["expert_out_b"][:, None, :]
+    y = jnp.einsum("tec,ech->th", combine, out,
+                   preferred_element_type=jnp.float32)
+
+    # Switch load-balancing loss: fraction of tokens * router probability
+    # mass per expert, scaled by E (1.0 at perfect balance)
+    frac_tokens = jnp.mean(one_hot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs) * moe.router_aux_weight
+
+    return y.reshape(B, S, H), aux

@@ -177,13 +177,11 @@ def _layer(x, lp, mask_bias, cfg: TransformerConfig, core=None):
     return x
 
 
-def encode(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
-           cfg: TransformerConfig) -> jax.Array:
-    """Full encoder forward. Returns final hidden states (B, S, H) float32.
-
-    Static shapes only; the S dimension is the caller's padded bucket size
-    (the UDF microbatcher pads to pow2 buckets so executables are reused).
-    """
+def embed_inputs(params: dict, input_ids: jax.Array,
+                 attention_mask: jax.Array, cfg: TransformerConfig):
+    """Shared embedding preamble: (embedded activations in compute dtype,
+    additive attention mask bias). Used by the sequential, pipelined, and
+    sequence-parallel encoders so the paths cannot diverge."""
     B, S = input_ids.shape
     emb = params["embeddings"]
     x = emb["word"][input_ids] + emb["position"][jnp.arange(S)][None, :, :]
@@ -192,6 +190,17 @@ def encode(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
     x = x.astype(cfg.dtype)
     mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9
                           ).astype(jnp.float32)
+    return x, mask_bias
+
+
+def encode(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
+           cfg: TransformerConfig) -> jax.Array:
+    """Full encoder forward. Returns final hidden states (B, S, H) float32.
+
+    Static shapes only; the S dimension is the caller's padded bucket size
+    (the UDF microbatcher pads to pow2 buckets so executables are reused).
+    """
+    x, mask_bias = embed_inputs(params, input_ids, attention_mask, cfg)
 
     def body(carry, lp):
         return _layer(carry, lp, mask_bias, cfg), None
