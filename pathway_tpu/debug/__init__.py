@@ -97,7 +97,7 @@ def table_from_markdown(
     for cells in rows_raw:
         record = dict(zip(header, cells))
         values = {c: _parse_value(record[c]) for c in value_cols}
-        rid = record.get("id") if has_id else (record.get("") if has_id else None)
+        rid = record.get(header[0]) if has_id else None
         time = int(record["__time__"]) if "__time__" in record else 0
         diff = int(record["__diff__"]) if "__diff__" in record else 1
         parsed_rows.append((rid, values, time, diff))

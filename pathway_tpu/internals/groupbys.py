@@ -28,7 +28,8 @@ from pathway_tpu.internals.universe import Universe
 
 
 class GroupedTable:
-    def __init__(self, table, grouping: list, instance=None, by_id: bool = False):
+    def __init__(self, table, grouping: list, instance=None, by_id: bool = False,
+                 sort_by=None):
         from pathway_tpu.internals.table import Table
 
         self._table = table
@@ -38,6 +39,7 @@ class GroupedTable:
         ]
         self._instance = instance
         self._by_id = by_id
+        self._sort_by = sort_by
 
     def _desugar(self, e):
         from pathway_tpu.internals.desugaring import substitute
@@ -98,7 +100,12 @@ class GroupedTable:
             if red.needs_id or red.needs_order:
                 cname = f"__a{arg_counter}"
                 arg_counter += 1
-                prelude_exprs[cname] = ColumnReference(self._table, "id")
+                # order-sensitive reducers (tuple) honour groupby(sort_by=...);
+                # id-consuming reducers (argmin/argmax) always get the row id
+                if red.needs_order and not red.needs_id and self._sort_by is not None:
+                    prelude_exprs[cname] = self._sort_by
+                else:
+                    prelude_exprs[cname] = ColumnReference(self._table, "id")
                 arg_cols.append(cname)
             kwargs_r = {k: v for k, v in r._kwargs.items()}
             reducer_specs.append((out_name, red.name, arg_cols, kwargs_r))

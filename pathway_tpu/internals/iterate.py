@@ -158,6 +158,7 @@ def iterate(
             sub_inputs.append(inode)
             sub_tables.append(Table(inode, t._schema, Universe()))
         result = body(**dict(zip(names, sub_tables)))
+        returned_bare_table = isinstance(result, Table)
         if isinstance(result, dict):
             result_items = list(result.items())
         elif isinstance(result, Table):
@@ -192,7 +193,10 @@ def iterate(
             iteration_limit,
         )
         results[n] = Table(node, out_by_name[n]._schema, Universe())
-    if len(names) == 1:
+    # mirror the body's return shape (reference behavior): a bare table
+    # comes back bare; a dict/namespace keeps attribute access even for one
+    # table
+    if len(names) == 1 and returned_bare_table:
         return results[names[0]]
     return results
 

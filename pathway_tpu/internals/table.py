@@ -388,6 +388,21 @@ class Table(Joinable):
         )
         return Table(node, schema, self._universe.subset())
 
+    def having(self, *indexers) -> "Table":
+        """Keep rows whose every ``ix_ref`` indexer resolves to an existing
+        row of its target table (reference ``Table.having`` /
+        ``HavingContext``)."""
+        result = self
+        for proxy in indexers:
+            # probe a constant-true marker column on the target so the test
+            # is ROW EXISTENCE — a nullable first column must not matter
+            marker = proxy.table.select(__having_probe__=True)
+            probe = expr_mod.IxExpression(
+                marker, proxy.key_expr, "__having_probe__", optional=True
+            )
+            result = result.filter(probe.is_not_none())
+        return result
+
     def restrict(self, other: "Table") -> "Table":
         node = core_ops.UniverseOpNode(
             G.engine_graph, [self._node, other._node], "restrict"
@@ -442,11 +457,17 @@ class Table(Joinable):
 
         grouping = [self._desugar(a) for a in args]
         inst = self._desugar(expr_mod.smart_coerce(instance)) if instance is not None else None
+        sort_expr = (
+            self._desugar(expr_mod.smart_coerce(sort_by))
+            if sort_by is not None
+            else None
+        )
         if id is not None:
             id_ref = self._desugar(id)
             grouping = [id_ref]
-            return GroupedTable(self, grouping, inst, by_id=True)
-        return GroupedTable(self, grouping, inst)
+            return GroupedTable(self, grouping, inst, by_id=True,
+                                sort_by=sort_expr)
+        return GroupedTable(self, grouping, inst, sort_by=sort_expr)
 
     def reduce(self, *args, **kwargs) -> "Table":
         return self.groupby().reduce(*args, **kwargs)
@@ -725,7 +746,46 @@ class Table(Joinable):
 
     @staticmethod
     def from_columns(*args, **kwargs) -> "Table":
-        raise NotImplementedError("use pw.debug.table_from_pandas")
+        """Build a table from same-universe column references (reference
+        ``Table.from_columns``)."""
+        exprs: dict[str, ColumnReference] = {}
+        for a in args:
+            if not isinstance(a, ColumnReference):
+                raise ValueError(
+                    f"from_columns takes column references, got {a!r}"
+                )
+            if a.name in exprs:
+                raise ValueError(
+                    f"from_columns: duplicate column name {a.name!r}"
+                )
+            exprs[a.name] = a
+        for name, a in kwargs.items():
+            if not isinstance(a, ColumnReference):
+                raise ValueError(
+                    f"from_columns takes column references, got {a!r}"
+                )
+            if name in exprs:
+                raise ValueError(
+                    f"from_columns: duplicate column name {name!r}"
+                )
+            exprs[name] = a
+        if not exprs:
+            raise ValueError("from_columns needs at least one column")
+        from pathway_tpu.internals.universe import GLOBAL_SOLVER as solver
+
+        refs = list(exprs.values())
+        first = refs[0]
+        for other in refs[1:]:
+            if other.table._universe is first.table._universe:
+                continue
+            if not solver.query_are_equal(
+                first.table._universe, other.table._universe
+            ):
+                raise ValueError(
+                    "from_columns requires columns from the same universe; "
+                    "use with_universe_of / promise_universes_are_equal first"
+                )
+        return first.table.select(**exprs)
 
     @staticmethod
     def _from_error_log(log) -> "Table":

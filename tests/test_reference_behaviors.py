@@ -1,0 +1,204 @@
+"""Behavior scenarios ported from the reference test suite
+(``python/pathway/tests/test_common.py`` patterns): broadcasting through
+global reduces, optional ix_ref, from_columns, iterate limits and result
+shape, markdown id columns, groupby sort_by, having, update_cells."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import _capture_rows
+
+
+def t(md):
+    return pw.debug.table_from_markdown(md)
+
+
+def test_broadcasting_single_row_reduce():
+    tab = t("""
+    a
+    1
+    2
+    """)
+    total = tab.reduce(s=pw.reducers.sum(tab.a))
+    out = tab.select(frac=tab.a / total.ix_ref().s)
+    rows, _ = _capture_rows(out)
+    assert sorted(round(r[0], 2) for r in rows.values()) == [0.33, 0.67]
+
+
+def test_ix_ref_optional_missing_key():
+    tab = t("""
+    k | v
+    a | 1
+    """).with_id_from(pw.this.k)
+    q = t("""
+    k
+    a
+    b
+    """)
+    out = q.select(hit=tab.ix_ref(q.k, optional=True).v)
+    rows, _ = _capture_rows(out)
+    assert sorted((r[0] is None, r[0]) for r in rows.values()) == [
+        (False, 1), (True, None)
+    ]
+
+
+def test_from_columns_same_universe():
+    tab = t("""
+    a
+    1
+    """)
+    tb = t("""
+    b
+    2
+    """).with_universe_of(tab)
+    out = pw.Table.from_columns(tab.a, tb.b)
+    rows, cols = _capture_rows(out)
+    assert cols == ["a", "b"]
+    assert list(rows.values()) == [(1, 2)]
+
+
+def test_concat_requires_disjoint_universes():
+    t1 = t("""
+    a
+    1
+    """)
+    t2 = t("""
+    a
+    2
+    """)
+    # same positional keys → reference raises too; concat_reindex is the
+    # content-safe variant
+    with pytest.raises(Exception):
+        _capture_rows(pw.Table.concat(t1, t2))
+    rows, _ = _capture_rows(t1.concat_reindex(t2))
+    assert len(rows) == 2
+
+
+def test_iterate_with_limit_and_result_shape():
+    def step(tab):
+        return dict(tab=tab.select(v=pw.if_else(tab.v < 10, tab.v * 2, tab.v)))
+
+    tab = t("""
+    v
+    1
+    3
+    """)
+    result = pw.iterate(step, iteration_limit=2, tab=tab)
+    rows, _ = _capture_rows(result.tab)  # dict return keeps the namespace
+    assert sorted(r[0] for r in rows.values()) == [4, 12]
+
+    def bare(tab):
+        return tab.select(v=pw.if_else(tab.v < 10, tab.v * 2, tab.v))
+
+    out = pw.iterate(bare, tab=t("""
+    v
+    1
+    """))
+    rows, _ = _capture_rows(out)  # bare-table return stays bare
+    assert sorted(r[0] for r in rows.values()) == [16]
+
+
+def test_markdown_explicit_id_column_update_cells():
+    base = t("""
+      | a | b
+    1 | 1 | x
+    2 | 2 | y
+    """)
+    upd = t("""
+      | a
+    2 | 20
+    """)
+    out = base.update_cells(upd.promise_universe_is_subset_of(base))
+    rows, _ = _capture_rows(out)
+    assert sorted(tuple(r) for r in rows.values()) == [(1, "x"), (20, "y")]
+
+
+def test_groupby_sort_by_orders_tuples():
+    tab = t("""
+    g | t | v
+    x | 2 | b
+    x | 1 | a
+    x | 3 | c
+    """)
+    res = tab.groupby(tab.g, sort_by=tab.t).reduce(
+        tab.g, seq=pw.reducers.tuple(tab.v)
+    )
+    (row,) = _capture_rows(res)[0].values()
+    assert row[1] == ("a", "b", "c")
+
+
+def test_having_filters_missing_keys():
+    queries = t("""
+    q
+    1
+    3
+    """)
+    data = t("""
+    k
+    1
+    2
+    """).with_id_from(pw.this.k)
+    res = queries.having(data.ix_ref(queries.q, optional=True))
+    rows, _ = _capture_rows(res)
+    assert sorted(r[0] for r in rows.values()) == [1]
+
+
+def test_groupby_instance_colocates():
+    tab = t("""
+    g | i | v
+    x | 1 | 1
+    x | 1 | 2
+    y | 1 | 5
+    """)
+    out = tab.groupby(tab.g, instance=tab.i).reduce(
+        tab.g, s=pw.reducers.sum(tab.v)
+    )
+    rows, _ = _capture_rows(out)
+    assert sorted(r[1] for r in rows.values()) == [3, 5]
+
+
+def test_json_nested_access():
+    tab = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(j=dict),
+        rows=[({"a": {"b": 5}, "xs": [1, 2]},)],
+    )
+    out = tab.select(
+        b=tab.j["a"]["b"].as_int(),
+        first=tab.j["xs"][0],
+        missing=tab.j.get("nope", default=7),
+    )
+    (row,) = _capture_rows(out)[0].values()
+    assert row == (5, 1, 7)
+
+
+def test_having_key_exists_with_null_value():
+    target = t("""
+    k | v
+    a |
+    """).with_id_from(pw.this.k)
+    q = t("""
+    k
+    a
+    b
+    """)
+    res = q.having(target.ix_ref(q.k, optional=True))
+    rows, _ = _capture_rows(res)
+    # existence is what counts, not the (null) value
+    assert sorted(r[0] for r in rows.values()) == ["a"]
+
+
+def test_from_columns_validations():
+    t1 = t("""
+    a
+    1
+    2
+    """)
+    t2 = t1.filter(t1.a >= 2)
+    with pytest.raises(ValueError, match="universe"):
+        pw.Table.from_columns(t1.a, b=t2.a)
+    with pytest.raises(ValueError, match="duplicate"):
+        pw.Table.from_columns(t1.a, t1.a)
+    with pytest.raises(ValueError, match="column references"):
+        pw.Table.from_columns(x=5)
