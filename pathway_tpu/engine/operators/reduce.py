@@ -161,12 +161,18 @@ class DeduplicateNode(Node):
             inst = row[ii]
             value = row[vi]
             prev = self._accepted.get(inst)
-            prev_value = prev[1][vi] if prev is not None else None
-            try:
-                accept = self.acceptor(value, prev_value)
-            except Exception as exc:  # noqa: BLE001
-                get_global_error_log().log(f"deduplicate acceptor error: {exc}")
-                continue
+            if prev is None:
+                # first value for an instance is accepted unconditionally —
+                # the acceptor compares against a previous acceptance only
+                accept = True
+            else:
+                try:
+                    accept = self.acceptor(value, prev[1][vi])
+                except Exception as exc:  # noqa: BLE001
+                    get_global_error_log().log(
+                        f"deduplicate acceptor error: {exc}"
+                    )
+                    continue
             if accept:
                 if prev is not None:
                     rows.append((prev[0], prev[1], -1))

@@ -225,13 +225,16 @@ class SortedTupleAcc(_MultisetAcc):
 
 
 class TupleAcc(_MultisetAcc):
-    """Ordered tuple by (time, key) of arrival; args = (value, order_key)."""
+    """Ordered tuple: by (time, key) of arrival, or by the user's
+    ``groupby(sort_by=...)`` key first (time as tie-break) when
+    ``user_order`` is set; args = (value, order_key)."""
 
-    __slots__ = ("skip_nones", "_times")
+    __slots__ = ("skip_nones", "user_order", "_times")
 
-    def __init__(self, skip_nones: bool = False):
+    def __init__(self, skip_nones: bool = False, user_order: bool = False):
         super().__init__()
         self.skip_nones = skip_nones
+        self.user_order = user_order
         self._times: dict[Any, int] = {}
 
     def add(self, args, diff, time):
@@ -254,7 +257,8 @@ class TupleAcc(_MultisetAcc):
             if v is None and self.skip_nones:
                 continue
             t = self._times.get(hk, 0)
-            items.extend([((t, order), v)] * max(c, 0))
+            sort_key = (order, t) if self.user_order else (t, order)
+            items.extend([(sort_key, v)] * max(c, 0))
         try:
             items.sort(key=lambda t: t[0])
         except TypeError:
@@ -361,7 +365,10 @@ def make_accumulator(name: str, kwargs: dict) -> Accumulator:
     if name == "sorted_tuple":
         return SortedTupleAcc(skip_nones=kwargs.get("skip_nones", False))
     if name == "tuple":
-        return TupleAcc(skip_nones=kwargs.get("skip_nones", False))
+        return TupleAcc(
+            skip_nones=kwargs.get("skip_nones", False),
+            user_order=kwargs.get("user_order", False),
+        )
     if name == "ndarray":
         return NdarrayAcc(skip_nones=kwargs.get("skip_nones", False))
     if name == "stateful":

@@ -97,6 +97,7 @@ class GroupedTable:
                 prelude_exprs[cname] = a
                 arg_cols.append(cname)
             red = r._reducer
+            kwargs_r = {k: v for k, v in r._kwargs.items()}
             if red.needs_id or red.needs_order:
                 cname = f"__a{arg_counter}"
                 arg_counter += 1
@@ -104,10 +105,11 @@ class GroupedTable:
                 # id-consuming reducers (argmin/argmax) always get the row id
                 if red.needs_order and not red.needs_id and self._sort_by is not None:
                     prelude_exprs[cname] = self._sort_by
+                    # the user's key must dominate arrival time, not tie-break it
+                    kwargs_r["user_order"] = True
                 else:
                     prelude_exprs[cname] = ColumnReference(self._table, "id")
                 arg_cols.append(cname)
-            kwargs_r = {k: v for k, v in r._kwargs.items()}
             reducer_specs.append((out_name, red.name, arg_cols, kwargs_r))
 
         env_node, rewritten = _prepare_env(self._table, prelude_exprs)

@@ -748,18 +748,13 @@ class Table(Joinable):
     def from_columns(*args, **kwargs) -> "Table":
         """Build a table from same-universe column references (reference
         ``Table.from_columns``)."""
+        import itertools
+
         exprs: dict[str, ColumnReference] = {}
-        for a in args:
-            if not isinstance(a, ColumnReference):
-                raise ValueError(
-                    f"from_columns takes column references, got {a!r}"
-                )
-            if a.name in exprs:
-                raise ValueError(
-                    f"from_columns: duplicate column name {a.name!r}"
-                )
-            exprs[a.name] = a
-        for name, a in kwargs.items():
+        named = itertools.chain(
+            ((getattr(a, "name", None), a) for a in args), kwargs.items()
+        )
+        for name, a in named:
             if not isinstance(a, ColumnReference):
                 raise ValueError(
                     f"from_columns takes column references, got {a!r}"

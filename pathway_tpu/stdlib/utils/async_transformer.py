@@ -31,6 +31,12 @@ class ResultType(enum.Enum):
 class AsyncTransformer(ABC):
     output_schema: ClassVar[Any]
 
+    def __init_subclass__(cls, /, output_schema=None, **kwargs):
+        # reference API: class X(pw.AsyncTransformer, output_schema=Schema)
+        super().__init_subclass__(**kwargs)
+        if output_schema is not None:
+            cls.output_schema = output_schema
+
     def __init__(self, input_table: Table, instance=None, **kwargs):
         self._input_table = input_table
         self._instance = instance

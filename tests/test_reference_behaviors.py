@@ -202,3 +202,68 @@ def test_from_columns_validations():
         pw.Table.from_columns(t1.a, t1.a)
     with pytest.raises(ValueError, match="column references"):
         pw.Table.from_columns(x=5)
+
+
+def test_deduplicate_first_value_auto_accepted():
+    tab = t("""
+    v | __time__
+    1 | 2
+    3 | 4
+    2 | 6
+    5 | 8
+    """)
+    res = tab.deduplicate(value=tab.v, acceptor=lambda new, old: new > old)
+    rows, _ = _capture_rows(res)
+    assert [r[0] for r in rows.values()] == [5]
+
+
+def test_async_transformer_class_keyword_schema():
+    import asyncio
+
+    class Doubler(pw.AsyncTransformer,
+                  output_schema=pw.schema_from_types(ret=int)):
+        async def invoke(self, value: int):
+            await asyncio.sleep(0.001)
+            return dict(ret=value * 2)
+
+    tab = t("""
+    value
+    2
+    3
+    """)
+    res = Doubler(input_table=tab).successful
+    rows, _ = _capture_rows(res)
+    assert sorted(r[0] for r in rows.values()) == [4, 6]
+
+
+def test_subscribe_time_end_and_end_callbacks():
+    rows_seen, time_ends, ended = [], [], []
+    tab = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(x=int),
+        rows=[(1, 2, 1), (2, 4, 1)], is_stream=True)
+    pw.io.subscribe(
+        tab,
+        on_change=lambda key, row, time, is_addition: rows_seen.append(
+            (row["x"], is_addition)
+        ),
+        on_time_end=lambda time: time_ends.append(time),
+        on_end=lambda: ended.append(True),
+    )
+    pw.run()
+    assert sorted(r[0] for r in rows_seen) == [1, 2]
+    assert len(time_ends) >= 2 and ended
+
+
+def test_groupby_sort_by_across_epochs():
+    tab = t("""
+    g | t | v | __time__
+    x | 3 | c | 2
+    x | 1 | a | 4
+    x | 2 | b | 4
+    """)
+    res = tab.groupby(tab.g, sort_by=tab.t).reduce(
+        tab.g, seq=pw.reducers.tuple(tab.v)
+    )
+    (row,) = _capture_rows(res)[0].values()
+    # the sort key dominates arrival time
+    assert row[1] == ("a", "b", "c")
