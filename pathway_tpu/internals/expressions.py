@@ -182,6 +182,10 @@ class DateTimeNamespace(_Namespace):
     def year(self):
         return self._call("dt.year", return_type=dt.INT)
 
+    def weekday(self):
+        """Monday=0 .. Sunday=6 (reference ``dt.weekday``)."""
+        return self.day_of_week()
+
     def day_of_week(self):
         return self._call("dt.day_of_week", return_type=dt.INT)
 

@@ -267,3 +267,75 @@ def test_groupby_sort_by_across_epochs():
     (row,) = _capture_rows(res)[0].values()
     # the sort key dominates arrival time
     assert row[1] == ("a", "b", "c")
+
+
+def test_batch4_windows_joins_methods():
+    tab = t("""
+    t  | v
+    1  | 1
+    2  | 2
+    10 | 5
+    """)
+    res = tab.windowby(tab.t, window=pw.temporal.session(max_gap=3)).reduce(
+        s=pw.reducers.sum(pw.this.v))
+    rows, _ = _capture_rows(res)
+    assert sorted(r[0] for r in rows.values()) == [3, 5]
+
+    l = t("""
+    k | a
+    1 | x
+    2 | y
+    """)
+    r = t("""
+    k | b
+    2 | p
+    3 | q
+    """)
+    res = l.join_outer(r, l.k == r.k).select(
+        k=pw.coalesce(l.k, r.k), a=l.a, b=r.b)
+    rows, _ = _capture_rows(res)
+    assert sorted(tuple(x) for x in rows.values()) == [
+        (1, "x", None), (2, "y", "p"), (3, None, "q")]
+
+    tab2 = t("""
+    a
+    1
+    2
+    3
+    """)
+    good, bad = tab2.split(tab2.a >= 2)
+    assert sorted(r[0] for r in _capture_rows(good)[0].values()) == [2, 3]
+    assert sorted(r[0] for r in _capture_rows(bad)[0].values()) == [1]
+
+
+def test_datetime_namespace_breadth():
+    import datetime
+
+    tab = t("""
+    ts
+    2024-03-05T10:30:45
+    """).select(d=pw.this.ts.dt.strptime("%Y-%m-%dT%H:%M:%S"))
+    out = tab.select(
+        y=tab.d.dt.year(), mo=tab.d.dt.month(),
+        wd=tab.d.dt.weekday(),
+        fmt=tab.d.dt.strftime("%Y/%m/%d"),
+        floor=tab.d.dt.floor("1h"),
+    )
+    (row,) = _capture_rows(out)[0].values()
+    assert row[0] == 2024 and row[1] == 3 and row[2] == 1
+    assert row[3] == "2024/03/05"
+
+    tz = t("""
+    ts
+    2024-03-05T10:30:45+0000
+    """).select(d=pw.this.ts.dt.strptime("%Y-%m-%dT%H:%M:%S%z"))
+    out = tz.select(local=tz.d.dt.to_naive_in_timezone("Europe/Warsaw"))
+    (row,) = _capture_rows(out)[0].values()
+    assert row[0].hour == 11
+
+    dur = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(d=datetime.timedelta),
+        rows=[(datetime.timedelta(days=2, hours=3),)])
+    out = dur.select(h=dur.d.dt.hours(), days=dur.d.dt.days())
+    (row,) = _capture_rows(out)[0].values()
+    assert row == (51, 2)
