@@ -1,0 +1,113 @@
+"""Crash-consistency torture test — the scaled analog of the reference's
+``integration_tests/wordcount`` recovery rig (kill/restart with persistent
+storage, exactly-once final counts)."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROG = r"""
+import json, os, sys, threading, time
+import pathway_tpu as pw
+
+class S(pw.Schema):
+    word: str
+
+src = os.environ["WC_SRC"]
+out = os.environ["WC_OUT"]
+
+t = pw.io.jsonlines.read(src, schema=S, mode="streaming",
+                         refresh_interval=0.1, persistent_id="words")
+counts = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+pw.io.jsonlines.write(counts, out)
+
+# stop the (otherwise endless) streaming run once a marker file appears
+def stopper():
+    while not os.path.exists(os.environ["WC_STOP"]):
+        time.sleep(0.1)
+    for c in pw.G.connectors:
+        c._stop.set()
+        c.close()
+
+threading.Thread(target=stopper, daemon=True).start()
+pw.run()
+"""
+
+
+def _final_counts(path):
+    net: dict = {}
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            net[rec["word"]] = net.get(rec["word"], 0) + (
+                rec["c"] * (1 if rec["diff"] > 0 else -1)
+            )
+    return {k: v for k, v in net.items() if v}
+
+
+@pytest.mark.timeout(120)
+def test_sigkill_midrun_then_restart_exactly_once(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    store = tmp_path / "store"
+    prog = tmp_path / "prog.py"
+    prog.write_text(PROG)
+    stop_marker = tmp_path / "stop"
+
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        WC_SRC=str(src),
+        WC_OUT=str(tmp_path / "out1.jsonl"),
+        WC_STOP=str(stop_marker),
+        PATHWAY_REPLAY_STORAGE=str(store),
+        JAX_PLATFORMS="cpu",
+    )
+
+    # phase 1: stream two files in, then SIGKILL without warning
+    (src / "a.jsonl").write_text(
+        "".join(json.dumps({"word": w}) + "\n" for w in ["cat", "dog", "cat"])
+    )
+    p = subprocess.Popen([sys.executable, str(prog)], env=env)
+    try:
+        deadline = time.time() + 60
+        out1 = tmp_path / "out1.jsonl"
+        while time.time() < deadline:
+            if out1.exists() and _final_counts(out1).get("cat") == 2:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("phase 1 never produced counts")
+        # more data arrives, give the connector a beat to commit it
+        (src / "b.jsonl").write_text(
+            "".join(json.dumps({"word": w}) + "\n" for w in ["cat", "bird"])
+        )
+        while time.time() < deadline:
+            if _final_counts(out1).get("cat") == 3:
+                break
+            time.sleep(0.2)
+        os.kill(p.pid, signal.SIGKILL)
+    finally:
+        p.wait(timeout=30)
+
+    # phase 2: restart against the same store with the inputs still on disk
+    # plus one new file; final counts must be exactly-once across the crash
+    (src / "c.jsonl").write_text(json.dumps({"word": "dog"}) + "\n")
+    env["WC_OUT"] = str(tmp_path / "out2.jsonl")
+    stop_marker.write_text("")  # makes run() terminate after quiescing
+
+    p2 = subprocess.Popen([sys.executable, str(prog)], env=env)
+    p2.wait(timeout=60)
+    assert p2.returncode == 0
+
+    counts = _final_counts(tmp_path / "out2.jsonl")
+    assert counts == {"cat": 3, "dog": 2, "bird": 1}
