@@ -370,7 +370,10 @@ def make_accumulator(name: str, kwargs: dict) -> Accumulator:
             user_order=kwargs.get("user_order", False),
         )
     if name == "ndarray":
-        return NdarrayAcc(skip_nones=kwargs.get("skip_nones", False))
+        return NdarrayAcc(
+            skip_nones=kwargs.get("skip_nones", False),
+            user_order=kwargs.get("user_order", False),
+        )
     if name == "stateful":
         return StatefulAcc(kwargs["combine_fn"])
     factory = REDUCER_FACTORIES.get(name)

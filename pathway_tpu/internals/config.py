@@ -94,12 +94,8 @@ def get_persistence_config() -> Any:
             # replay-only runs stop at the end of the log unless asked to
             # continue; record / recovery runs must keep reading live data
             continue_after_replay=(
-                True
-                if (
-                    pathway_config.continue_after_replay
-                    or pathway_config.snapshot_access != "replay"
-                )
-                else None
+                pathway_config.continue_after_replay
+                or pathway_config.snapshot_access != "replay"
             ),
         )
     return None

@@ -55,7 +55,11 @@ def fingerprint(obj, *, format: str = "hex", seed=""):  # noqa: A002
         return big % (2**63)
     if format == "u32":
         return big % (2**32)
-    if format in ("integer", "i32"):
+    if format == "integer":
+        # non-negative 31-bit (reference format table distinguishes this
+        # from signed 'i32')
+        return big % (2**31)
+    if format == "i32":
         return big % (2**32) - (2**31)
     if format == "u16":
         return big % (2**16)

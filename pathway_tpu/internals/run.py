@@ -184,6 +184,10 @@ class GraphRunner:
         finally:
             for c in connectors:
                 c.stop()
+                # stop/close requests consumed by this run must not leak
+                # into a later pw.run() on the same graph; requests issued
+                # after this point (pre-start of the next run) survive
+                c.reset_after_run()
             sched.teardown_exchanges()
             sched.shutdown()
             telemetry.shutdown()

@@ -42,6 +42,7 @@ class BaseConnector:
         self._sched = None
         self._time_mutex = threading.Lock()
         self._closed = False
+        self._sched_closed = False
         self.persistent_id: str | None = None
         self._persistence = None  # PersistenceManager when persistence is on
         self._snapshot_writer = None
@@ -98,7 +99,8 @@ class BaseConnector:
     def close(self) -> None:
         with self._time_mutex:
             self._closed = True
-            if self._sched is not None:
+            if self._sched is not None and not self._sched_closed:
+                self._sched_closed = True
                 self._sched.close_source(self.node)
                 self._sched.stats.connector_finished(
                     self.node.id, self._stat_name()
@@ -109,8 +111,19 @@ class BaseConnector:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, sched) -> None:
-        self._sched = sched
-        self._stop.clear()
+        # A stop()/close() issued BEFORE startup (e.g. a supervisor that
+        # decides at launch the run should quiesce after one pass) must
+        # survive into the run: never clear _stop here, and downgrade a
+        # pre-scheduler close() to a stop request so the connector still
+        # performs its initial read, then exits and closes its source
+        # properly now that a scheduler is attached. Done under _time_mutex
+        # so a concurrent close() can't interleave between the check and
+        # the downgrade.
+        with self._time_mutex:
+            self._sched = sched
+            if self._closed and not self._sched_closed:
+                self._closed = False
+                self._stop.set()
         if (
             self._persistence is not None
             and self.persistent_id is not None
@@ -164,6 +177,20 @@ class BaseConnector:
         if self._thread is not None:
             self._thread.join(timeout=10)
 
+    def reset_after_run(self) -> None:
+        """Called by the runner after teardown: stop/close requests consumed
+        by the finished run are cleared so a subsequent ``pw.run()`` on the
+        same graph streams afresh. Requests issued AFTER this point (before
+        the next run starts) survive into it — that is the crash-recovery
+        pre-start-quiesce path."""
+        with self._time_mutex:
+            self._stop.clear()
+            self._closed = False
+            self._sched_closed = False
+            self._sched = None
+            self._thread = None
+            self._hb_thread = None
+
 
 _time_lock = threading.Lock()
 _last_time = [0]
@@ -210,6 +237,8 @@ class CallbackConnector(BaseConnector):
 
     def run(self):
         for rows in self.generator(self):
+            # commit the batch already pulled even when a stop arrived, so a
+            # pre-start quiesce still emits one pass (fs-connector contract)
+            self.commit_rows(rows)
             if self.should_stop():
                 break
-            self.commit_rows(rows)

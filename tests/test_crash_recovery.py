@@ -111,3 +111,66 @@ def test_sigkill_midrun_then_restart_exactly_once(tmp_path):
 
     counts = _final_counts(tmp_path / "out2.jsonl")
     assert counts == {"cat": 3, "dog": 2, "bird": 1}
+
+
+@pytest.mark.timeout(300)
+def test_kill_restart_cycles_exactly_once(tmp_path):
+    """Torture rig: repeated SIGKILL at varied points mid-stream, new data
+    arriving between crashes, then one graceful run — final counts must be
+    exactly-once (analog of the reference's
+    ``integration_tests/wordcount/test_recovery.py`` kill/restart loop)."""
+    import random
+
+    rng = random.Random(7)
+    src = tmp_path / "src"
+    src.mkdir()
+    store = tmp_path / "store"
+    prog = tmp_path / "prog.py"
+    prog.write_text(PROG)
+    stop_marker = tmp_path / "stop"
+
+    vocab = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    expected: dict[str, int] = {}
+
+    def add_file(name: str, n: int) -> None:
+        words = [rng.choice(vocab) for _ in range(n)]
+        for w in words:
+            expected[w] = expected.get(w, 0) + 1
+        (src / name).write_text(
+            "".join(json.dumps({"word": w}) + "\n" for w in words)
+        )
+
+    add_file("f0.jsonl", 2000)
+    add_file("f1.jsonl", 2000)
+
+    def env_for(cycle: int) -> dict:
+        return dict(
+            os.environ,
+            PYTHONPATH=REPO,
+            WC_SRC=str(src),
+            WC_OUT=str(tmp_path / f"out{cycle}.jsonl"),
+            WC_STOP=str(stop_marker),
+            PATHWAY_REPLAY_STORAGE=str(store),
+            JAX_PLATFORMS="cpu",
+        )
+
+    kill_delays = [1.0, 2.5, 4.0, 1.5]
+    for cycle, delay in enumerate(kill_delays):
+        p = subprocess.Popen([sys.executable, str(prog)], env=env_for(cycle))
+        try:
+            time.sleep(delay)
+            os.kill(p.pid, signal.SIGKILL)
+        finally:
+            p.wait(timeout=30)
+        # stream more data in between crashes
+        add_file(f"g{cycle}.jsonl", 500)
+
+    # final graceful run: quiesce after one full pass, then exit cleanly
+    stop_marker.write_text("")
+    final = len(kill_delays)
+    p = subprocess.Popen([sys.executable, str(prog)], env=env_for(final))
+    p.wait(timeout=120)
+    assert p.returncode == 0
+
+    counts = _final_counts(tmp_path / f"out{final}.jsonl")
+    assert counts == expected

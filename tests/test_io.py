@@ -220,3 +220,41 @@ def test_idle_source_does_not_stall_other_sources():
         c._stop.set()
         c.close()
     assert got == [2], f"late source's row never processed: {got}"
+
+
+def test_streaming_rerun_same_graph_streams_again(tmp_path):
+    # regression: run() teardown stops connectors; a second pw.run() on the
+    # same graph must stream afresh (not exit instantly or hang)
+    import json as json_mod
+    import threading
+    import time as time_mod
+
+    (tmp_path / "a.jsonl").write_text(json_mod.dumps({"word": "cat"}) + "\n")
+
+    class S(pw.Schema):
+        word: str
+
+    t = pw.io.jsonlines.read(
+        str(tmp_path), schema=S, mode="streaming", refresh_interval=0.05
+    )
+    seen: list = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: seen.append(row)
+    )
+
+    def stop_soon():
+        time_mod.sleep(0.8)
+        for c in pw.G.connectors:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stop_soon, daemon=True).start()
+    pw.run()
+    assert seen and seen[0]["word"] == "cat"
+
+    (tmp_path / "b.jsonl").write_text(json_mod.dumps({"word": "dog"}) + "\n")
+    threading.Thread(target=stop_soon, daemon=True).start()
+    start = time_mod.time()
+    pw.run()
+    assert time_mod.time() - start > 0.5, "second run exited without streaming"
+    assert any(r["word"] == "dog" for r in seen)

@@ -361,3 +361,17 @@ def test_env_record_then_replay_roundtrip(tmp_path, monkeypatch):
     )
     replayed = build_and_run(tmp_path / "o2.jsonl")
     assert replayed == recorded
+
+
+def test_env_replay_defaults_to_stop_at_end_of_log(monkeypatch, tmp_path):
+    # PATHWAY_SNAPSHOT_ACCESS=replay without an explicit persistence mode or
+    # continue flag must resolve continue_after_replay to False (replay-only
+    # runs stop at end of log, per the docstring)
+    from pathway_tpu.internals import config as config_mod
+
+    monkeypatch.setattr(config_mod.pathway_config, "replay_storage", str(tmp_path))
+    monkeypatch.setattr(config_mod.pathway_config, "snapshot_access", "replay")
+    monkeypatch.setattr(config_mod.pathway_config, "persistence_mode", None)
+    monkeypatch.setattr(config_mod.pathway_config, "continue_after_replay", False)
+    cfg = config_mod.get_persistence_config()
+    assert cfg.continue_after_replay is False

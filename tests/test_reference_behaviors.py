@@ -339,3 +339,31 @@ def test_datetime_namespace_breadth():
     out = dur.select(h=dur.d.dt.hours(), days=dur.d.dt.days())
     (row,) = _capture_rows(out)[0].values()
     assert row == (51, 2)
+
+
+def test_groupby_sort_by_orders_ndarray_across_epochs():
+    # regression: ndarray reducer must honor sort_by (user_order) the same
+    # way tuple does, even when rows arrive across epochs out of key order
+    tab = t("""
+    g | t | v | __time__
+    x | 3 | 30 | 2
+    x | 1 | 10 | 4
+    x | 2 | 20 | 4
+    """)
+    res = tab.groupby(tab.g, sort_by=tab.t).reduce(
+        tab.g, arr=pw.reducers.ndarray(tab.v)
+    )
+    (row,) = _capture_rows(res)[0].values()
+    assert row[1].tolist() == [10, 20, 30]
+
+
+def test_fingerprint_integer_format_nonnegative():
+    from pathway_tpu.internals.fingerprints import fingerprint
+
+    vals = [fingerprint(x, format="integer") for x in ("a", "b", 42, b"xyz")]
+    assert all(0 <= v < 2**31 for v in vals)
+    # i32 stays signed and distinct from 'integer'
+    assert any(
+        fingerprint(x, format="i32") != fingerprint(x, format="integer")
+        for x in ("a", "b", 42)
+    )
