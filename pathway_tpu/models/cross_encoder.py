@@ -28,8 +28,9 @@ from pathway_tpu.models.transformer import (
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def score_fn(params, head, input_ids, attention_mask, cfg: TransformerConfig):
-    hidden = encode(params, input_ids, attention_mask, cfg)
+def score_fn(params, head, input_ids, attention_mask, cfg: TransformerConfig,
+             token_type_ids=None):
+    hidden = encode(params, input_ids, attention_mask, cfg, token_type_ids)
     cls = hidden[:, 0, :]
     pooled = jnp.tanh(cls @ params["pooler"]["w"].astype(jnp.float32)
                       + params["pooler"]["b"].astype(jnp.float32))
@@ -63,13 +64,41 @@ class CrossEncoderModel:
             }
         self.head = head
 
+    @classmethod
+    def from_pretrained(cls, path: str, max_length: int = 256, **kw):
+        """Load a local HF cross-encoder checkpoint (e.g.
+        ms-marco-MiniLM-L-6-v2: BertForSequenceClassification with a 1-label
+        classifier head) plus its tokenizer."""
+        from pathway_tpu.models.checkpoint import load_encoder_checkpoint
+        from pathway_tpu.models.tokenizer import load_tokenizer
+
+        params, cfg, head = load_encoder_checkpoint(path)
+        if head is None:
+            raise ValueError(f"{path!r} has no classifier head — not a cross-encoder")
+        import jax.numpy as _jnp
+
+        head = {"w": _jnp.asarray(head["w"]), "b": _jnp.asarray(head["b"])}
+        init = dict(
+            cfg=cfg,
+            params=params,
+            head=head,
+            tokenizer=load_tokenizer(path, max_length=max_length),
+            max_length=max_length,
+        )
+        init.update(kw)  # explicit caller overrides win
+        return cls(**init)
+
     def score_batch(self, pairs: list[tuple[str, str]]) -> np.ndarray:
         if not pairs:
             return np.zeros((0,), dtype=np.float32)
-        ids, mask = self.tokenizer.encode_pairs(pairs, max_length=self.max_length)
+        ids, mask, types = self.tokenizer.encode_pairs(
+            pairs, max_length=self.max_length, return_types=True
+        )
         ids, mask = pad_to_buckets(ids, mask)
+        types2 = np.zeros_like(ids)
+        types2[: types.shape[0], : types.shape[1]] = types
         out = score_fn(self.params, self.head, jnp.asarray(ids),
-                       jnp.asarray(mask), self.cfg)
+                       jnp.asarray(mask), self.cfg, jnp.asarray(types2))
         return np.asarray(out[: len(pairs)])
 
     def __call__(self, pairs: list[tuple[str, str]]) -> np.ndarray:

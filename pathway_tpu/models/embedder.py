@@ -80,6 +80,23 @@ class SentenceEmbedderModel:
     def from_local(cls, path: str, cfg: TransformerConfig = MINILM_L6, **kw):
         return cls(cfg=cfg, tokenizer=load_tokenizer(path), **kw)
 
+    @classmethod
+    def from_pretrained(cls, path: str, max_length: int = 128, **kw):
+        """Load a local HF checkpoint dir (config + weights + tokenizer) —
+        real all-MiniLM-L6-v2 weights in the fused-QKV pytree, WordPiece
+        tokenization via the local tokenizer files."""
+        from pathway_tpu.models.checkpoint import load_encoder_checkpoint
+
+        params, cfg, _ = load_encoder_checkpoint(path)
+        init = dict(
+            cfg=cfg,
+            params=params,
+            tokenizer=load_tokenizer(path, max_length=max_length),
+            max_length=max_length,
+        )
+        init.update(kw)  # explicit caller overrides win
+        return cls(**init)
+
     @property
     def dim(self) -> int:
         return self.cfg.hidden

@@ -28,7 +28,8 @@ from pathway_tpu.models.transformer import (
 
 def encode_pipelined(params: dict, input_ids: jax.Array,
                      attention_mask: jax.Array, cfg: TransformerConfig,
-                     mesh: Mesh, n_microbatches: int = 2) -> jax.Array:
+                     mesh: Mesh, n_microbatches: int = 2,
+                     token_type_ids: jax.Array | None = None) -> jax.Array:
     """Encoder forward with the layer stack pipelined over the mesh's
     ``pp`` axis. ``input_ids``/``attention_mask``: (B, S); B must divide
     into ``n_microbatches``. Returns (B, S, H) float32."""
@@ -47,7 +48,8 @@ def encode_pipelined(params: dict, input_ids: jax.Array,
 
     # embeddings + final reshape are replicated host-side of the pipeline:
     # only the layer stack is staged
-    x, mask_bias = embed_inputs(params, input_ids, attention_mask, cfg)
+    x, mask_bias = embed_inputs(params, input_ids, attention_mask, cfg,
+                                token_type_ids)
 
     xs = x.reshape(n_microbatches, mb, S, cfg.hidden)
     biases = mask_bias.reshape(n_microbatches, mb, 1, 1, S)

@@ -98,7 +98,8 @@ class HashTokenizer:
         pairs: Sequence[tuple[str, str]],
         max_length: int | None = None,
         pad_to: int | None = None,
-    ) -> tuple[np.ndarray, np.ndarray]:
+        return_types: bool = False,
+    ):
         seqs = [self.pair(a, b, max_length) for a, b in pairs]
         width = pad_to or max((len(s) for s in seqs), default=2)
         ids = np.full((len(seqs), width), PAD_ID, dtype=np.int32)
@@ -107,7 +108,18 @@ class HashTokenizer:
             s = s[:width]
             ids[r, : len(s)] = s
             mask[r, : len(s)] = 1
-        return ids, mask
+        if not return_types:
+            return ids, mask
+        # segment ids: 0 through the first [SEP] inclusive, 1 after (BERT
+        # pair layout)
+        types = np.zeros_like(ids)
+        for r, s in enumerate(seqs):
+            try:
+                first_sep = s.index(SEP_ID)
+            except ValueError:
+                continue
+            types[r, first_sep + 1 : len(s)] = 1
+        return ids, mask, types
 
 
 from pathway_tpu.ops import next_pow2 as bucket_pow2  # shared padding discipline
@@ -146,7 +158,8 @@ class _HFTokenizerAdapter:
             mask = np.pad(mask, ((0, 0), (0, pad_to - mask.shape[1])))
         return ids, mask
 
-    def encode_pairs(self, pairs, max_length=None, pad_to=None):
+    def encode_pairs(self, pairs, max_length=None, pad_to=None,
+                     return_types=False):
         a = [p[0] for p in pairs]
         b = [p[1] for p in pairs]
         enc = self._tok(
@@ -157,7 +170,13 @@ class _HFTokenizerAdapter:
         )
         ids = np.asarray(enc["input_ids"], dtype=np.int32)
         mask = np.asarray(enc["attention_mask"], dtype=np.int32)
-        return ids, mask
+        if not return_types:
+            return ids, mask
+        if "token_type_ids" in enc:
+            types = np.asarray(enc["token_type_ids"], dtype=np.int32)
+        else:
+            types = np.zeros_like(ids)
+        return ids, mask, types
 
 
 def load_tokenizer(path_or_name: str | None = None, max_length: int = 256):

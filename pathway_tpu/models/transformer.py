@@ -167,7 +167,9 @@ def _layer(x, lp, mask_bias, cfg: TransformerConfig, core=None):
                     lp["ln1_bias"], cfg.layer_norm_eps).astype(cfg.dtype)
     h = jnp.einsum("bsh,hi->bsi", x, lp["mlp_in_w"].astype(cfg.dtype),
                    preferred_element_type=jnp.float32)
-    h = jax.nn.gelu(h + lp["mlp_in_b"].astype(jnp.float32))
+    # exact (erf) gelu: BERT-family checkpoints are trained with it, and the
+    # tanh approximation costs ~1e-3 drift per layer against HF outputs
+    h = jax.nn.gelu(h + lp["mlp_in_b"].astype(jnp.float32), approximate=False)
     h = jnp.einsum("bsi,ih->bsh", h.astype(cfg.dtype),
                    lp["mlp_out_w"].astype(cfg.dtype),
                    preferred_element_type=jnp.float32)
@@ -178,14 +180,21 @@ def _layer(x, lp, mask_bias, cfg: TransformerConfig, core=None):
 
 
 def embed_inputs(params: dict, input_ids: jax.Array,
-                 attention_mask: jax.Array, cfg: TransformerConfig):
+                 attention_mask: jax.Array, cfg: TransformerConfig,
+                 token_type_ids: jax.Array | None = None):
     """Shared embedding preamble: (embedded activations in compute dtype,
     additive attention mask bias). Used by the sequential, pipelined, and
-    sequence-parallel encoders so the paths cannot diverge."""
+    sequence-parallel encoders so the paths cannot diverge.
+
+    ``token_type_ids`` defaults to all-zeros (single-segment); cross-encoder
+    pair inputs pass segment ids so pretrained type embeddings apply."""
     B, S = input_ids.shape
     emb = params["embeddings"]
     x = emb["word"][input_ids] + emb["position"][jnp.arange(S)][None, :, :]
-    x = x + emb["type"][jnp.zeros((B, S), jnp.int32)]
+    if token_type_ids is None:
+        x = x + emb["type"][jnp.zeros((B, S), jnp.int32)]
+    else:
+        x = x + emb["type"][token_type_ids]
     x = _layer_norm(x, emb["ln_scale"], emb["ln_bias"], cfg.layer_norm_eps)
     x = x.astype(cfg.dtype)
     mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e9
@@ -194,13 +203,15 @@ def embed_inputs(params: dict, input_ids: jax.Array,
 
 
 def encode(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
-           cfg: TransformerConfig) -> jax.Array:
+           cfg: TransformerConfig,
+           token_type_ids: jax.Array | None = None) -> jax.Array:
     """Full encoder forward. Returns final hidden states (B, S, H) float32.
 
     Static shapes only; the S dimension is the caller's padded bucket size
     (the UDF microbatcher pads to pow2 buckets so executables are reused).
     """
-    x, mask_bias = embed_inputs(params, input_ids, attention_mask, cfg)
+    x, mask_bias = embed_inputs(params, input_ids, attention_mask, cfg,
+                                token_type_ids)
 
     def body(carry, lp):
         return _layer(carry, lp, mask_bias, cfg), None

@@ -85,9 +85,17 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         elif isinstance(model, str) and model in presets:
             self.model = SentenceEmbedderModel(cfg=presets[model], **init_kwargs)
         elif isinstance(model, str):
-            # local HF-format directory (air-gapped deployments load real
-            # all-MiniLM weights this way); preset fallback otherwise
-            self.model = SentenceEmbedderModel.from_local(model, **init_kwargs)
+            # local HF-format directory: load real pretrained weights
+            # (all-MiniLM etc.) when the dir has a checkpoint, else just the
+            # tokenizer (air-gapped deployments with only tokenizer files)
+            from pathway_tpu.models.checkpoint import has_checkpoint_weights
+
+            if has_checkpoint_weights(model):
+                self.model = SentenceEmbedderModel.from_pretrained(
+                    model, **init_kwargs
+                )
+            else:
+                self.model = SentenceEmbedderModel.from_local(model, **init_kwargs)
         else:
             raise TypeError(f"unsupported model spec: {model!r}")
         self.device = device

@@ -60,9 +60,18 @@ class CrossEncoderReranker(pw.UDF):
             self.model = model_name
         else:
             kwargs = dict(custom_kwargs)
+            from pathway_tpu.models.checkpoint import has_checkpoint_weights
+
             if model_name in presets:
                 kwargs.setdefault("cfg", presets[model_name])
-            self.model = CrossEncoderModel(**kwargs)
+                self.model = CrossEncoderModel(**kwargs)
+            elif isinstance(model_name, str) and has_checkpoint_weights(model_name):
+                # local HF cross-encoder checkpoint (ms-marco-MiniLM style)
+                self.model = CrossEncoderModel.from_pretrained(
+                    model_name, **kwargs
+                )
+            else:
+                self.model = CrossEncoderModel(**kwargs)
 
     def __wrapped__(self, doc: list[str], query: list[str], **kwargs) -> list[float]:
         pairs = [(q or "", d or "") for q, d in zip(query, doc)]
