@@ -50,7 +50,11 @@ def cast_params_for_inference(params, cfg: TransformerConfig):
     )
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
 def embed_fn(params, input_ids, attention_mask, cfg: TransformerConfig):
+    """One fused executable for the whole embed step. MUST stay jitted: on a
+    tunneled/relayed chip each eager op costs a full dispatch round trip
+    (~150ms measured), turning a 15ms batch into seconds."""
     hidden = encode(params, input_ids, attention_mask, cfg)
     pooled = mean_pool(hidden, attention_mask)
     return pooled / jnp.clip(
