@@ -40,13 +40,53 @@ class ObjectStoreConnector(BaseConnector):
         self.refresh_interval = refresh_interval
         # object id -> (version, emitted row tuple)
         self._live: dict[str, tuple[Any, tuple]] = {}
+        self._cache = None  # CachedObjectStorage when persistence is on
+        self._replayed_rows: dict[int, tuple] = {}
         if mode != "static":
             self.heartbeat_ms = 500
 
-    # persistence not wired for object stores yet (no persistent_id param,
-    # matching this build's gdrive/pyfilesystem surface); the base class's
-    # None offset + replay-only restore would duplicate rows, so the
-    # connectors don't register as persistent sources.
+    # -- persistence (reference ``cached_object_storage.rs``: downloaded
+    # objects are cached by URI so restarts replay the exact bytes the
+    # crashed run saw, and replay-only runs never touch the source) --------
+    def setup_persistence(self, manager) -> None:
+        super().setup_persistence(manager)
+        if self.persistent_id is not None:
+            from pathway_tpu.persistence.cached_objects import (
+                CachedObjectStorage,
+            )
+
+            self._cache = CachedObjectStorage(manager.backend)
+
+    def current_offset(self):
+        """The live-object version map — with the replayed rows this fully
+        reconstructs connector state on restart."""
+        return {oid: version for oid, (version, _row) in self._live.items()}
+
+    def on_replay(self, rows) -> None:
+        for key, row, diff in rows:
+            if diff > 0:
+                self._replayed_rows[key] = row
+
+    def seek_offset(self, offset) -> None:
+        if not isinstance(offset, dict):
+            return
+        # rebuild _live from (oid -> version) + the replayed row payloads so
+        # the first scan after restart re-emits nothing that was snapshotted
+        # and can still retract rows when objects change/disappear later
+        for oid, version in offset.items():
+            row = self._replayed_rows.get(hash_values(oid))
+            if row is not None:
+                self._live[oid] = (version, row)
+
+    def _fetch(self, oid: str, version: Any) -> bytes:
+        if self._cache is not None:
+            cached = self._cache.get_version(oid, version)
+            if cached is not None:
+                return cached
+        data = self.provider.fetch(oid)
+        if self._cache is not None:
+            self._cache.put(oid, version, data)
+        return data
 
     def _scan(self) -> list[tuple[int, tuple, int]]:
         listing = self.provider.list_objects()
@@ -56,7 +96,7 @@ class ObjectStoreConnector(BaseConnector):
             if prev is not None and prev[0] == version:
                 continue
             try:
-                data = self.provider.fetch(oid)
+                data = self._fetch(oid, version)
             except Exception:
                 continue  # object vanished between list and fetch
             row = (data, Json(meta)) if self.with_metadata else (data,)
@@ -69,6 +109,8 @@ class ObjectStoreConnector(BaseConnector):
             if oid not in listing:
                 version, row = self._live.pop(oid)
                 deltas.append((hash_values(oid), row, -1))
+                if self._cache is not None:
+                    self._cache.remove(oid)
         return deltas
 
     def run(self) -> None:

@@ -125,3 +125,54 @@ def format_value_for_output(v) -> Any:
     if isinstance(v, pd.Timedelta):
         return v.value
     return v
+
+
+def _iter_lines(data: bytes):
+    """'\n'-separated lines, mirroring text-file iteration (the final
+    newline does not produce an empty trailing line; '\r' is preserved)."""
+    lines = data.decode("utf-8", errors="replace").split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()
+    return lines
+
+
+def iter_records_from_bytes(data: bytes, fmt: str, schema,
+                            csv_settings: "CsvParserSettings | None" = None):
+    """Yield per-record value dicts from raw object bytes — the ONE parser
+    half of the reference's scanner x tokenizer split
+    (``src/connectors/posix_like.rs``). Both the filesystem scanner
+    (``io/fs.py``) and object-store scanners (S3, MinIO) that fetch whole
+    blobs feed through here, so the formats cannot drift apart."""
+    import csv as csv_mod
+    import io as io_mod
+
+    cols = [c for c in schema.column_names() if c != "_metadata"]
+    dtypes = {n: c.dtype for n, c in schema.__columns__.items()}
+    if fmt in ("csv", "dsv"):
+        settings = csv_settings or CsvParserSettings()
+        text = data.decode("utf-8", errors="replace")
+        reader = csv_mod.DictReader(
+            io_mod.StringIO(text), delimiter=settings.delimiter,
+            quotechar=settings.quote,
+        )
+        for record in reader:
+            yield parse_record_fields(record, cols, dtypes, schema)
+    elif fmt in ("json", "jsonlines"):
+        for line in _iter_lines(data):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            yield parse_record_fields(obj, cols, dtypes, schema)
+    elif fmt == "plaintext":
+        for line in _iter_lines(data):
+            yield {"data": line}
+    elif fmt == "plaintext_by_file":
+        yield {"data": data.decode("utf-8", errors="replace")}
+    elif fmt == "binary":
+        yield {"data": data}
+    else:
+        raise ValueError(f"unknown format {fmt!r}")

@@ -27,8 +27,7 @@ from pathway_tpu.io._streams import BaseConnector, next_commit_time
 from pathway_tpu.io._utils import (
     CsvParserSettings,
     format_value_for_output,
-    parse_record_fields,
-    parse_value,
+    iter_records_from_bytes,
 )
 
 
@@ -64,40 +63,14 @@ def _metadata_for(path: str) -> Json:
 
 
 def _iter_records(path: str, fmt: str, schema, csv_settings: CsvParserSettings | None):
-    """Yield per-file lists of value dicts. Absent fields take the schema
-    column's default_value when it has one; explicit nulls stay None
-    (reference parser semantics, shared via parse_record_fields)."""
-    cols = [c for c in schema.column_names() if c != "_metadata"]
-    dtypes = {n: c.dtype for n, c in schema.__columns__.items()}
-    if fmt in ("csv", "dsv"):
-        settings = csv_settings or CsvParserSettings()
-        with open(path, newline="", encoding="utf-8", errors="replace") as f:
-            reader = csv_mod.DictReader(f, delimiter=settings.delimiter, quotechar=settings.quote)
-            for record in reader:
-                yield parse_record_fields(record, cols, dtypes, schema)
-    elif fmt in ("json", "jsonlines"):
-        with open(path, encoding="utf-8", errors="replace") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    obj = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                yield parse_record_fields(obj, cols, dtypes, schema)
-    elif fmt == "plaintext":
-        with open(path, encoding="utf-8", errors="replace") as f:
-            for line in f:
-                yield {"data": line.rstrip("\n")}
-    elif fmt == "plaintext_by_file":
-        with open(path, encoding="utf-8", errors="replace") as f:
-            yield {"data": f.read()}
-    elif fmt == "binary":
-        with open(path, "rb") as f:
-            yield {"data": f.read()}
-    else:
-        raise ValueError(f"unknown format {fmt!r}")
+    """Yield per-file value dicts via the shared byte parser
+    (``iter_records_from_bytes``) so local files and object-store blobs
+    parse identically. The connector materializes each file's rows anyway,
+    so slurping costs no extra memory. Absent fields take the schema
+    column's default_value; explicit nulls stay None."""
+    with open(path, "rb") as f:
+        data = f.read()
+    yield from iter_records_from_bytes(data, fmt, schema, csv_settings)
 
 
 class _FsConnector(BaseConnector):

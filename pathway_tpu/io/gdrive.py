@@ -113,11 +113,13 @@ def read(
     service_user_credentials_file: str | None = None,
     with_metadata: bool = False,
     file_name_pattern: list | str | None = None,
+    persistent_id: str | None = None,
     _client=None,
 ) -> Table:
     """Read a Drive file/folder (recursively) as binary rows. ``_client``
     (duck-typed ``list_files``/``download``) is injectable for offline
-    tests."""
+    tests. With ``persistent_id``, downloads are cached by URI for
+    deterministic replay."""
     client = _client or _GDriveClient(service_user_credentials_file)
     schema = schema_mod.schema_from_types(data=bytes)
     if with_metadata:
@@ -129,4 +131,8 @@ def read(
         node, provider, mode, with_metadata, float(refresh_interval)
     )
     G.register_connector(conn)
+    if persistent_id is not None:
+        from pathway_tpu.persistence import register_persistent_source
+
+        register_persistent_source(persistent_id, conn)
     return Table(node, schema, Universe())

@@ -1,9 +1,14 @@
-"""Kafka connector (reference ``python/pathway/io/kafka``).
+"""Kafka connector (reference ``python/pathway/io/kafka`` +
+``src/connectors/data_storage.rs:692,1258`` KafkaReader/KafkaWriter).
 
-No Kafka client library is available in this environment; the API surface is
-kept, backed by either a user-supplied in-process broker stub
-(:class:`InMemoryKafkaBroker`, used by tests and benchmarks to model
-streaming ingest) or a clear error for real clusters.
+Two backends behind one API:
+
+* a dict of ``rdkafka_settings`` drives a REAL ``confluent_kafka``
+  Consumer/Producer (gated import — the library is not in the baked image,
+  but any environment that has it, or a test that injects a stub module into
+  ``sys.modules``, gets the full read/write/seek path);
+* an in-process :class:`InMemoryKafkaBroker` models streaming ingest for
+  tests and benchmarks without a cluster.
 """
 
 from __future__ import annotations
@@ -59,23 +64,33 @@ class _BrokerConnector(BaseConnector):
         self.schema = schema
         self.fmt = fmt
         self.start_from_latest = start_from_latest
-        self._counter = 0
+        self._offset = 0
+        self._started = False
+
+    # persistence: the broker log position IS the reader offset — stored
+    # with every snapshot chunk so a restart resumes past replayed data
+    # instead of re-reading the topic from 0 (which would double every row)
+    def current_offset(self):
+        return self._offset
+
+    def seek_offset(self, offset) -> None:
+        if isinstance(offset, int):
+            self._offset = offset
 
     def run(self):
         import json
 
-        offset = (
-            len(self.broker.poll(self.topic, 0)) if self.start_from_latest else 0
-        )
+        if self.start_from_latest and self._offset == 0:
+            self._offset = len(self.broker.poll(self.topic, 0))
         cols = list(self.node.column_names)
         dtypes = {n: c.dtype for n, c in self.schema.__columns__.items()}
         pk = self.schema.primary_key_columns()
         while not self.should_stop():
-            entries = self.broker.poll(self.topic, offset)
+            entries = self.broker.poll(self.topic, self._offset)
             if entries:
-                offset += len(entries)
+                base = self._offset
                 rows = []
-                for key_bytes, value in entries:
+                for i, (key_bytes, value) in enumerate(entries):
                     if self.fmt == "raw":
                         values = {"data": value}
                     else:
@@ -84,14 +99,148 @@ class _BrokerConnector(BaseConnector):
                     if pk:
                         key = hash_values(*[values[c] for c in pk])
                     else:
-                        key = hash_values(self.topic, self._counter)
-                        self._counter += 1
+                        # log-position keys: stable across restarts
+                        key = hash_values(self.topic, base + i)
                     rows.append((key, tuple(values[c] for c in cols), 1))
+                self._offset = base + len(entries)
                 self.commit_rows(rows)
             elif self.broker.closed:
                 return
             else:
                 time_mod.sleep(0.01)
+
+
+def _confluent():
+    """Gated confluent_kafka import (same pattern as postgres/mongo/gdrive):
+    importable -> real client; otherwise a clear error. Tests exercise the
+    real code path by injecting a stub module into ``sys.modules``."""
+    try:
+        import confluent_kafka  # type: ignore
+
+        return confluent_kafka
+    except ImportError as exc:
+        raise ImportError(
+            "reading a real Kafka cluster requires the confluent_kafka "
+            "client, which is not available in this environment; pass an "
+            "InMemoryKafkaBroker for in-process streaming"
+        ) from exc
+
+
+class _KafkaConnector(BaseConnector):
+    """Real consumer loop (reference ``KafkaReader::read``,
+    ``data_storage.rs:692``): poll -> parse -> commit at a fresh engine time;
+    the reader offset stored with each snapshot chunk is the per-partition
+    position map, and ``seek_offset`` resumes past replayed data."""
+
+    heartbeat_ms = 500
+
+    def __init__(self, node, settings: dict, topic: str, schema, fmt: str,
+                 start_from_latest: bool = False, poll_timeout_s: float = 0.2):
+        super().__init__(node)
+        self.settings = dict(settings)
+        self.topic = topic
+        self.schema = schema
+        self.fmt = fmt
+        self.start_from_latest = start_from_latest
+        self.poll_timeout_s = poll_timeout_s
+        self._positions: dict[int, int] = {}  # partition -> next offset
+        self._seek_to: dict[int, int] = {}
+        self._consumer = None
+
+    # -- persistence hooks (per-partition offsets, the analog of the
+    # reference's OffsetAntichain for Kafka sources) ------------------------
+    def current_offset(self):
+        return dict(self._positions)
+
+    def seek_offset(self, offset) -> None:
+        if isinstance(offset, dict):
+            self._seek_to = {int(p): int(o) for p, o in offset.items()}
+            self._positions.update(self._seek_to)
+
+    def _make_consumer(self):
+        ck = _confluent()
+        settings = dict(self.settings)
+        settings.setdefault("group.id", f"pathway-{self.topic}")
+        settings.setdefault(
+            "auto.offset.reset",
+            "latest" if self.start_from_latest else "earliest",
+        )
+        settings.setdefault("enable.auto.commit", "false")
+        consumer = ck.Consumer(settings)
+
+        if self._seek_to:
+            # seek inside on_assign so partitions NOT in the saved map (no
+            # messages before the crash, or newly added) still flow through
+            # normal subscription instead of being silently dropped
+            def on_assign(cons, partitions):
+                for p in partitions:
+                    if p.partition in self._seek_to:
+                        p.offset = self._seek_to[p.partition] + 1
+                cons.assign(partitions)
+
+            consumer.subscribe([self.topic], on_assign=on_assign)
+        else:
+            consumer.subscribe([self.topic])
+        return consumer
+
+    def _parse(self, msg, cols, dtypes, pk):
+        """(key, row) or None for malformed payloads (logged, skipped —
+        one bad message must not kill the stream)."""
+        import json
+
+        try:
+            if self.fmt == "raw":
+                values = {"data": msg.value()}
+            else:
+                obj = json.loads(msg.value())
+                values = parse_record_fields(obj, cols, dtypes, self.schema)
+            if pk:
+                key = hash_values(*[values[c] for c in pk])
+            else:
+                # offset-based keys: deterministic across restarts so
+                # replay + reread can never duplicate a message
+                key = hash_values(self.topic, msg.partition(), msg.offset())
+            return key, tuple(values[c] for c in cols)
+        except Exception as exc:  # noqa: BLE001
+            from pathway_tpu.internals.errors import get_global_error_log
+
+            get_global_error_log().log(
+                f"kafka: skipping malformed message at "
+                f"{msg.partition()}:{msg.offset()}: {exc!r}"
+            )
+            return None
+
+    MAX_DRAIN = 1024  # messages per commit: amortize commit-time/snapshot cost
+
+    def run(self):
+        self._consumer = self._make_consumer()
+        cols = list(self.node.column_names)
+        dtypes = {n: c.dtype for n, c in self.schema.__columns__.items()}
+        pk = self.schema.primary_key_columns()
+        try:
+            while not self.should_stop():
+                msg = self._consumer.poll(self.poll_timeout_s)
+                if msg is None:
+                    continue
+                # drain everything already buffered into ONE commit
+                rows = []
+                while msg is not None and len(rows) < self.MAX_DRAIN:
+                    if msg.error():
+                        from pathway_tpu.internals.errors import (
+                            get_global_error_log,
+                        )
+
+                        get_global_error_log().log(f"kafka error: {msg.error()}")
+                    else:
+                        parsed = self._parse(msg, cols, dtypes, pk)
+                        if parsed is not None:
+                            rows.append((parsed[0], parsed[1], 1))
+                        self._positions[msg.partition()] = msg.offset()
+                    msg = self._consumer.poll(0)
+                if rows:
+                    self.commit_rows(rows)
+        finally:
+            self._consumer.close()
 
 
 def read(
@@ -105,21 +254,33 @@ def read(
     start_from_latest: bool = False,
     **kwargs,
 ) -> Table:
-    if isinstance(rdkafka_settings, InMemoryKafkaBroker):
-        from pathway_tpu.internals import schema as schema_mod
+    from pathway_tpu.internals import schema as schema_mod
 
-        if format == "raw":
-            schema = schema_mod.schema_from_types(data=bytes)
-        cols = list(schema.column_names())
-        node = InputNode(G.engine_graph, cols, name=f"kafka({topic})")
+    if format == "raw":
+        schema = schema_mod.schema_from_types(data=bytes)
+    if schema is None:
+        raise ValueError("schema is required for json-format Kafka reads")
+    cols = list(schema.column_names())
+    node = InputNode(G.engine_graph, cols, name=f"kafka({topic})")
+    if isinstance(rdkafka_settings, InMemoryKafkaBroker):
         conn = _BrokerConnector(node, rdkafka_settings, topic, schema, format,
                                 start_from_latest=start_from_latest)
-        G.register_connector(conn)
-        return Table(node, schema, Universe())
-    raise NotImplementedError(
-        "no Kafka client library in this environment; pass an "
-        "InMemoryKafkaBroker for in-process streaming"
-    )
+    elif isinstance(rdkafka_settings, dict):
+        _confluent()  # fail fast with a clear error when the client is absent
+        conn = _KafkaConnector(node, rdkafka_settings, topic, schema, format,
+                               start_from_latest=start_from_latest)
+    else:
+        raise TypeError(
+            f"rdkafka_settings must be a settings dict or an "
+            f"InMemoryKafkaBroker, got {type(rdkafka_settings).__name__}"
+        )
+    G.register_connector(conn)
+    table = Table(node, schema, Universe())
+    if persistent_id is not None:
+        from pathway_tpu.persistence import register_persistent_source
+
+        register_persistent_source(persistent_id, conn)
+    return table
 
 
 def write(
@@ -130,29 +291,77 @@ def write(
     format: str = "json",  # noqa: A002
     **kwargs,
 ) -> None:
-    if isinstance(rdkafka_settings, InMemoryKafkaBroker):
-        import json
+    import json
 
-        cols = list(table.column_names())
+    cols = list(table.column_names())
+
+    def encode_row(row, diff) -> bytes:
+        from pathway_tpu.io._utils import format_value_for_output
+
+        if format == "raw":
+            (v,) = row
+            return v if isinstance(v, bytes) else str(v).encode()
+        obj = {c: format_value_for_output(v) for c, v in zip(cols, row)}
+        obj["diff"] = diff
+        return json.dumps(obj).encode()
+
+    if isinstance(rdkafka_settings, InMemoryKafkaBroker):
 
         def write_batch(time, batch):
-            from pathway_tpu.io._utils import format_value_for_output
-
             for key, row, diff in batch.rows():
-                obj = {c: format_value_for_output(v) for c, v in zip(cols, row)}
-                obj["diff"] = diff
-                rdkafka_settings.produce(topic_name, json.dumps(obj).encode())
+                rdkafka_settings.produce(topic_name, encode_row(row, diff))
 
-        node = SinkNode(G.engine_graph, table._node, write_batch, name=f"kafka-write({topic_name})")
-        G.register_sink(node)
-        return
-    raise NotImplementedError(
-        "no Kafka client library in this environment; pass an InMemoryKafkaBroker"
+    elif isinstance(rdkafka_settings, dict):
+        ck = _confluent()
+        producer = ck.Producer(dict(rdkafka_settings))
+
+        def write_batch(time, batch):
+            # reference KafkaWriter (data_storage.rs:1258): produce the
+            # batch, then flush so a commit is durable before the frontier
+            # advances past it
+            for key, row, diff in batch.rows():
+                producer.produce(topic_name, encode_row(row, diff))
+            producer.flush()
+
+    else:
+        raise TypeError(
+            f"rdkafka_settings must be a settings dict or an "
+            f"InMemoryKafkaBroker, got {type(rdkafka_settings).__name__}"
+        )
+    node = SinkNode(G.engine_graph, table._node, write_batch, name=f"kafka-write({topic_name})")
+    G.register_sink(node)
+
+
+def read_from_upstash(
+    endpoint: str,
+    username: str,
+    password: str,
+    topic: str,
+    *,
+    read_only_new: bool = False,
+    schema=None,
+    format: str = "raw",  # noqa: A002
+    **kwargs,
+):
+    """Read from Upstash-hosted Kafka (reference ``io/kafka/__init__.py``
+    upstash wrapper): SASL-SCRAM settings over the standard reader."""
+    rdkafka_settings = {
+        "bootstrap.servers": endpoint,
+        "security.protocol": "SASL_SSL",
+        "sasl.mechanism": "SCRAM-SHA-256",
+        "sasl.username": username,
+        "sasl.password": password,
+        "group.id": f"pathway-upstash-{topic}",
+        "auto.offset.reset": "latest" if read_only_new else "earliest",
+    }
+    return read(
+        rdkafka_settings,
+        topic=topic,
+        schema=schema,
+        format=format,
+        start_from_latest=read_only_new,
+        **kwargs,
     )
-
-
-def read_from_upstash(*args, **kwargs):
-    raise NotImplementedError("Upstash Kafka requires network access")
 
 
 def simple_read(

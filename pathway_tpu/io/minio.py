@@ -21,8 +21,12 @@ class MinIOSettings:
             access_key=self.access_key,
             secret_access_key=self.secret_access_key,
             endpoint=self.endpoint,
+            with_path_style=self.with_path_style,
         )
 
 
 def read(path: str, *, minio_settings: MinIOSettings | None = None, **kwargs):
-    return s3.read(path, **kwargs)
+    """Read from a MinIO bucket through the S3 scanner; a local path without
+    settings still goes through the filesystem reader."""
+    aws = minio_settings.create_aws_settings() if minio_settings else None
+    return s3.read(path, aws_s3_settings=aws, **kwargs)

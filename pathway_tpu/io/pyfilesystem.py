@@ -76,16 +76,27 @@ def read(
     refresh_interval: float = 30,
     mode: str = "streaming",
     with_metadata: bool = False,
+    persistent_id: str | None = None,
+    _provider=None,
 ) -> Table:
     """Read every file under ``path`` of the PyFilesystem ``source`` into a
-    single binary ``data`` column (plus ``_metadata`` when requested)."""
+    single binary ``data`` column (plus ``_metadata`` when requested).
+    With ``persistent_id``, downloaded objects are cached by URI in the
+    persistence backend so restarts replay deterministically. ``_provider``
+    (duck-typed ``list_objects``/``fetch``) is injectable for offline
+    tests."""
     schema = schema_mod.schema_from_types(data=bytes)
     if with_metadata:
         schema = schema | schema_mod.schema_from_types(_metadata=dt.JSON)
     cols = list(schema.column_names())
     node = InputNode(G.engine_graph, cols, name=f"pyfilesystem({path or '/'})")
     conn = ObjectStoreConnector(
-        node, _PyFsProvider(source, path), mode, with_metadata, refresh_interval
+        node, _provider or _PyFsProvider(source, path), mode, with_metadata,
+        refresh_interval,
     )
     G.register_connector(conn)
+    if persistent_id is not None:
+        from pathway_tpu.persistence import register_persistent_source
+
+        register_persistent_source(persistent_id, conn)
     return Table(node, schema, Universe())

@@ -1,17 +1,30 @@
-"""S3 connector (reference ``python/pathway/io/s3``).
+"""S3 connector (reference ``python/pathway/io/s3`` +
+``src/connectors/scanner/s3.rs``).
 
-No S3 SDK / network egress in this environment; ``AwsS3Settings`` is kept for
-API parity and a ``path`` pointing at a local directory (or a mounted bucket)
-is read through the filesystem scanner — the same scanner×tokenizer split as
-the reference's ``src/connectors/scanner/s3.rs``.
+Real object reading through a boto3 client (gated import — same pattern as
+``persistence/backends.py:S3Backend``; tests inject a stub client). The
+scanner half lists bucket objects and tracks them by ETag (the S3 analog of
+the posix scanner's mtime map); the tokenizer half parses downloaded blobs
+with the shared ``iter_records_from_bytes``. A local path (or a mounted
+bucket) still goes through the filesystem scanner.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import time as time_mod
+from dataclasses import dataclass, field
 from typing import Any
 
+from pathway_tpu.engine.operators.core import InputNode
+from pathway_tpu.engine.value import hash_values
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io import fs
+from pathway_tpu.io._streams import BaseConnector
+from pathway_tpu.io._utils import iter_records_from_bytes
 
 
 @dataclass
@@ -22,10 +35,185 @@ class AwsS3Settings:
     with_path_style: bool = False
     region: str | None = None
     endpoint: str | None = None
+    # escape hatch for tests / custom stacks: a ready client object with the
+    # boto3 surface (list_objects_v2 / get_object)
+    client: Any = field(default=None, repr=False, compare=False)
 
     @classmethod
     def new_from_path(cls, path: str):
         return cls(bucket_name=path)
+
+    def create_client(self):
+        """boto3 S3 client for these settings (gated import, like
+        ``persistence/backends.py:148``)."""
+        if self.client is not None:
+            return self.client
+        try:
+            import boto3  # type: ignore
+        except ImportError as exc:
+            raise ImportError(
+                "reading s3:// paths requires boto3, which is not available "
+                "in this environment; mount the bucket and pass a local "
+                "path, or supply AwsS3Settings(client=...)"
+            ) from exc
+        kw: dict[str, Any] = {}
+        if self.endpoint:
+            kw["endpoint_url"] = self.endpoint
+        if self.region:
+            kw["region_name"] = self.region
+        if self.access_key and self.secret_access_key:
+            kw["aws_access_key_id"] = self.access_key
+            kw["aws_secret_access_key"] = self.secret_access_key
+        if self.with_path_style:
+            from botocore.config import Config  # type: ignore
+
+            kw["config"] = Config(s3={"addressing_style": "path"})
+        return boto3.client("s3", **kw)
+
+
+def _split_s3_path(path: str, settings: AwsS3Settings | None) -> tuple[str, str]:
+    """(bucket, key prefix) from ``s3://bucket/prefix`` or a bare prefix
+    combined with ``settings.bucket_name``."""
+    if path.startswith("s3://"):
+        rest = path[len("s3://") :]
+        bucket, _, prefix = rest.partition("/")
+        return bucket, prefix
+    if settings is not None and settings.bucket_name:
+        return settings.bucket_name, path.lstrip("/")
+    raise ValueError(
+        f"cannot resolve bucket for {path!r}: use s3://bucket/prefix or set "
+        f"AwsS3Settings.bucket_name"
+    )
+
+
+class _S3ScanConnector(BaseConnector):
+    """Object scanner: list-by-prefix, detect new/changed objects by ETag,
+    download + parse (reference ``scanner/s3.rs:60`` S3Scanner)."""
+
+    shardable = True
+
+    def __init__(self, node, client, bucket: str, prefix: str, fmt: str,
+                 schema, mode: str, with_metadata: bool, csv_settings,
+                 refresh_interval: float = 1.0, downloader=None):
+        super().__init__(node)
+        self.client = client
+        self.bucket = bucket
+        self.prefix = prefix
+        self.fmt = fmt
+        self.schema = schema
+        self.mode = mode
+        self.with_metadata = with_metadata
+        self.csv_settings = csv_settings
+        self.refresh_interval = refresh_interval
+        self._seen: dict[str, str] = {}  # object key -> etag
+        self._emitted_pk: dict[int, tuple] = {}
+        if mode != "static":
+            self.heartbeat_ms = 500
+
+    # persistence offset = the seen map (key -> etag), like fs's mtime map
+    def current_offset(self):
+        return dict(self._seen)
+
+    def seek_offset(self, offset) -> None:
+        if isinstance(offset, dict):
+            self._seen.update(offset)
+
+    def on_replay(self, rows) -> None:
+        if self.schema.primary_key_columns():
+            for key, row, diff in rows:
+                if diff > 0:
+                    self._emitted_pk[key] = row
+
+    def _list_objects(self) -> list[dict]:
+        out: list[dict] = []
+        token = None
+        while True:
+            kw = {"Bucket": self.bucket, "Prefix": self.prefix}
+            if token:
+                kw["ContinuationToken"] = token
+            resp = self.client.list_objects_v2(**kw)
+            out.extend(resp.get("Contents", []))
+            if not resp.get("IsTruncated"):
+                return out
+            token = resp.get("NextContinuationToken")
+
+    def _read_new(self) -> list[tuple[int, tuple, int]]:
+        from pathway_tpu.internals import config as config_mod
+        from pathway_tpu.engine.value import shard_of_key
+
+        n_proc = config_mod.pathway_config.processes
+        pid = config_mod.pathway_config.process_id
+        cols = list(self.node.column_names)
+        pk = self.schema.primary_key_columns()
+        rows: list[tuple[int, tuple, int]] = []
+        for obj in self._list_objects():
+            key_name = obj["Key"]
+            if key_name.endswith("/"):
+                continue  # folder marker
+            uri = f"s3://{self.bucket}/{key_name}"
+            if (
+                n_proc > 1
+                and not pk
+                and shard_of_key(hash_values(uri), n_proc) != pid
+            ):
+                continue
+            etag = str(obj.get("ETag", obj.get("LastModified", "")))
+            if self._seen.get(key_name) == etag:
+                continue
+            try:
+                body = self.client.get_object(
+                    Bucket=self.bucket, Key=key_name
+                )["Body"].read()
+            except Exception as exc:  # noqa: BLE001
+                # vanished between list and get, or a transient S3 error —
+                # skip (NOT marked seen, so the next scan retries); one bad
+                # object must not kill the stream
+                from pathway_tpu.internals.errors import get_global_error_log
+
+                get_global_error_log().log(f"s3: fetch {uri} failed: {exc!r}")
+                continue
+            self._seen[key_name] = etag
+            meta = None
+            if self.with_metadata:
+                meta = Json(
+                    {
+                        "path": uri,
+                        "size": int(obj.get("Size", len(body))),
+                        "seen_at": int(time_mod.time()),
+                    }
+                )
+            for i, values in enumerate(
+                iter_records_from_bytes(body, self.fmt, self.schema, self.csv_settings)
+            ):
+                if self.with_metadata:
+                    values = {**values, "_metadata": meta}
+                row = tuple(values[c] for c in cols)
+                if pk:
+                    key = hash_values(*[values[c] for c in pk])
+                    if n_proc > 1 and shard_of_key(key, n_proc) != pid:
+                        continue
+                    old = self._emitted_pk.get(key)
+                    if old == row:
+                        continue
+                    if old is not None:
+                        rows.append((key, old, -1))
+                    self._emitted_pk[key] = row
+                else:
+                    key = hash_values(uri, i)
+                rows.append((key, row, 1))
+        return rows
+
+    def run(self):
+        rows = self._read_new()
+        if rows or self._persistence is None:
+            self.commit_rows(rows)
+        if self.mode == "static":
+            return
+        while not self.should_stop():
+            time_mod.sleep(self.refresh_interval)
+            rows = self._read_new()
+            if rows:
+                self.commit_rows(rows)
 
 
 def read(
@@ -35,14 +223,47 @@ def read(
     format: str = "csv",  # noqa: A002
     schema: Any | None = None,
     mode: str = "streaming",
+    csv_settings=None,
+    with_metadata: bool = False,
+    persistent_id: str | None = None,
+    refresh_interval: float = 1.0,
     **kwargs,
 ):
-    if path.startswith("s3://"):
-        raise NotImplementedError(
-            "no S3 SDK/network in this environment; mount the bucket and "
-            "pass a local path"
+    if path.startswith("s3://") or (
+        aws_s3_settings is not None and aws_s3_settings.bucket_name
+        and not path.startswith(("/", "./"))
+    ):
+        bucket, prefix = _split_s3_path(path, aws_s3_settings)
+        client = (aws_s3_settings or AwsS3Settings()).create_client()
+        if format in ("plaintext", "plaintext_by_file"):
+            schema = schema_mod.schema_from_types(data=str)
+        elif format == "binary":
+            schema = schema_mod.schema_from_types(data=bytes)
+        elif schema is None:
+            raise ValueError("schema is required for csv/json formats")
+        if with_metadata:
+            from pathway_tpu.internals import dtype as dt
+
+            schema = schema | schema_mod.schema_from_types(_metadata=dt.JSON)
+        cols = list(schema.column_names())
+        node = InputNode(G.engine_graph, cols, name=f"s3({bucket}/{prefix})")
+        conn = _S3ScanConnector(
+            node, client, bucket, prefix, format, schema, mode,
+            with_metadata, csv_settings, refresh_interval,
         )
-    return fs.read(path, format=format, schema=schema, mode=mode, **kwargs)
+        G.register_connector(conn)
+        table = Table(node, schema, Universe())
+        if persistent_id is not None:
+            from pathway_tpu.persistence import register_persistent_source
+
+            register_persistent_source(persistent_id, conn)
+        return table
+    return fs.read(
+        path, format=format, schema=schema, mode=mode,
+        csv_settings=csv_settings, with_metadata=with_metadata,
+        persistent_id=persistent_id, refresh_interval=refresh_interval,
+        **kwargs,
+    )
 
 
 read_from_csv = read

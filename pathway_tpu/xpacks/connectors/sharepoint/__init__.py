@@ -96,9 +96,12 @@ def read(
     object_size_limit: int | None = None,
     with_metadata: bool = False,
     refresh_interval: int = 30,
+    persistent_id: str | None = None,
     _client=None,
 ) -> Table:
-    """Read a SharePoint document library as binary rows."""
+    """Read a SharePoint document library as binary rows. With
+    ``persistent_id``, downloads are cached by URI for deterministic
+    replay."""
     client = _client or _office365_client(url, tenant, client_id, cert_path, thumbprint)
     schema = schema_mod.schema_from_types(data=bytes)
     if with_metadata:
@@ -110,4 +113,8 @@ def read(
         node, provider, mode, with_metadata, float(refresh_interval)
     )
     G.register_connector(conn)
+    if persistent_id is not None:
+        from pathway_tpu.persistence import register_persistent_source
+
+        register_persistent_source(persistent_id, conn)
     return Table(node, schema, Universe())
