@@ -326,6 +326,65 @@ def config4_streaming_engine() -> dict:
     }
 
 
+def config_wordcount_streaming() -> dict:
+    """Engine streaming throughput on the reference's claim-to-fame shape
+    (wordcount vs Flink/Spark, ``/root/reference/README.md:245-251``):
+    jsonlines files arriving over time -> groupby/count -> subscriber."""
+    import os
+    import shutil
+    import threading
+
+    import pathway_tpu as pw
+
+    pw.clear_graph()
+    src = "/tmp/pathway_bench_wc"
+    shutil.rmtree(src, ignore_errors=True)
+    os.makedirs(src)
+
+    class S(pw.Schema):
+        word: str
+
+    t = pw.io.jsonlines.read(src, schema=S, mode="streaming", refresh_interval=0.02)
+    counts = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    seen: list = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: seen.append(1)
+    )
+    groups: list = []
+    pw.io.subscribe(
+        counts, on_change=lambda key, row, time, is_addition: groups.append(1)
+    )
+    n_rows, n_files = 400_000, 10
+
+    def feeder():
+        per = n_rows // n_files
+        for fi in range(n_files):
+            tmp = f"{src}/f{fi}.jsonl.tmp"
+            with open(tmp, "w") as f:
+                for i in range(per):
+                    f.write('{"word": "w%d"}\n' % (i % 5000))
+            os.replace(tmp, f"{src}/f{fi}.jsonl")
+        deadline = time.time() + 240
+        while time.time() < deadline and len(seen) < n_rows:
+            time.sleep(0.02)
+        for c in pw.G.connectors:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=feeder, daemon=True).start()
+    t0 = time.perf_counter()
+    pw.run()
+    rate = len(seen) / (time.perf_counter() - t0)
+    shutil.rmtree(src, ignore_errors=True)
+    diag(phase="wordcount", streaming_rows_per_sec=round(rate, 1))
+    return {
+        "metric": "wordcount_streaming_rows_per_sec",
+        "value": round(rate, 1),
+        "unit": "rows/s",
+        "detail": {"rows": n_rows, "files": n_files},
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -347,6 +406,7 @@ def main() -> None:
         (config2_recall_and_latency, (jax, jnp, cfg, BruteForceKnnIndex)),
         (config3_rerank_latency, (cfg,)),
         (config4_streaming_engine, ()),
+        (config_wordcount_streaming, ()),
     ):
         try:
             extra.append(fn(*args))

@@ -63,22 +63,57 @@ class GroupbyNode(Node):
             return ref_scalar_with_instance(*gvals, instance=instance).value
         return hash_values(*gvals)
 
+    def _group_keys_vec(self, batch: Batch) -> "np.ndarray | None":
+        """Whole-batch group keys through the native column hasher — the
+        per-row ``_group_key`` dominated wordcount-class profiles; one
+        columnar pass is ~30x cheaper. None = fall back per-row (pointer
+        fast-path with non-pointer values)."""
+        from pathway_tpu.engine import value as value_mod
+
+        gcols = [batch.cols[c] for c in self.group_cols]
+        if self.key_is_pointer_group_col and len(gcols) == 1:
+            col = gcols[0]
+            try:
+                return np.fromiter(
+                    (v.value for v in col), dtype=np.uint64, count=len(col)
+                )
+            except AttributeError:
+                return None
+        n = len(batch)
+        if self.instance_col is not None:
+            icol = np.asarray(batch.cols[self.instance_col], dtype=object)
+            main = value_mod.keys_for_value_columns(gcols + [icol], n)
+            return value_mod.keys_with_instance(main, icol)
+        return value_mod.keys_for_value_columns(gcols, n)
+
     def step(self, time, ins):
         (batch,) = ins
         if batch is None or len(batch) == 0:
             return None
         in_names = self.inputs[0].column_names
+        gks_vec = self._group_keys_vec(batch)
+        if (
+            gks_vec is not None
+            and self.instance_col is None
+            and all(rname == "count" for _, rname, _, _ in self.reducers)
+        ):
+            affected = self._accumulate_count_fast(time, batch, gks_vec)
+        else:
+            affected = self._accumulate_rowwise(time, batch, gks_vec, in_names)
+        return self._emit_affected(affected)
+
+    def _accumulate_rowwise(self, time, batch, gks_vec, in_names) -> set[int]:
         gidx = [in_names.index(c) for c in self.group_cols]
         iidx = in_names.index(self.instance_col) if self.instance_col else None
         ridx = [[in_names.index(c) for c in argcols] for _, _, argcols, _ in self.reducers]
         affected: set[int] = set()
-        for key, row, diff in batch.rows():
-            gvals = tuple(row[i] for i in gidx)
+        for i, (key, row, diff) in enumerate(batch.rows()):
+            gvals = tuple(row[i2] for i2 in gidx)
             if any(v is ERROR for v in gvals):
                 get_global_error_log().log("Error value in grouping column")
                 continue
             inst = row[iidx] if iidx is not None else None
-            gk = self._group_key(gvals, inst)
+            gk = int(gks_vec[i]) if gks_vec is not None else self._group_key(gvals, inst)
             grp = self._groups.get(gk)
             if grp is None:
                 grp = {
@@ -95,6 +130,48 @@ class GroupbyNode(Node):
                 args = tuple(row[i] for i in idxs)
                 acc.add(args, diff, time)
             affected.add(gk)
+        return affected
+
+    def _accumulate_count_fast(self, time, batch, gks) -> set[int]:
+        """Columnar path for count-only reductions (the wordcount shape):
+        diffs sum per unique group key in numpy, so the Python loop runs
+        over GROUPS (thousands) instead of rows (millions). Accumulator
+        state stays identical to the row-wise path — ``CountAcc.add`` with
+        a summed diff equals many unit adds."""
+        uniq, first_idx, inverse = np.unique(
+            gks, return_index=True, return_inverse=True
+        )
+        sums = np.zeros(len(uniq), dtype=np.int64)
+        np.add.at(sums, inverse, batch.diffs)
+        gcols = [batch.cols[c] for c in self.group_cols]
+        affected: set[int] = set()
+        for j in range(len(uniq)):
+            gk = int(uniq[j])
+            d = int(sums[j])
+            grp = self._groups.get(gk)
+            if grp is None:
+                if d == 0:
+                    continue  # net no-op on a group that never existed
+                gvals = tuple(col[int(first_idx[j])] for col in gcols)
+                if any(v is ERROR for v in gvals):
+                    get_global_error_log().log("Error value in grouping column")
+                    continue
+                grp = {
+                    "gvals": gvals,
+                    "count": 0,
+                    "accs": [
+                        make_accumulator(rname, kw)
+                        for _, rname, _, kw in self.reducers
+                    ],
+                }
+                self._groups[gk] = grp
+            grp["count"] += d
+            for acc in grp["accs"]:
+                acc.add((), d, time)
+            affected.add(gk)
+        return affected
+
+    def _emit_affected(self, affected: set[int]):
         rows = []
         for gk in affected:
             grp = self._groups.get(gk)

@@ -308,7 +308,12 @@ def ref_scalar_with_instance(*values: Any, instance: Any) -> Pointer:
 
 
 def keys_with_instance(keys: np.ndarray, instance_col: np.ndarray) -> np.ndarray:
-    inst = hash_value_column(np.asarray(instance_col, dtype=object))
+    """Vectorized ``ref_scalar_with_instance`` low-bit replacement: the
+    instance hash must be ``hash_values(instance)`` (idx-mixed), NOT the raw
+    per-value hash, so results agree bit-for-bit with the scalar path."""
+    inst = keys_for_value_columns(
+        [np.asarray(instance_col, dtype=object)], len(keys)
+    )
     return (keys & np.uint64(~SHARD_MASK & 0xFFFFFFFFFFFFFFFF)) | (
         inst & np.uint64(SHARD_MASK)
     )

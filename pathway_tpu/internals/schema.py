@@ -125,11 +125,23 @@ class SchemaMetaclass(type):
         return {n: c.dtype for n, c in cls.__columns__.items()}
 
     def default_values(cls) -> dict[str, Any]:
-        return {
-            n: c.default_value
-            for n, c in cls.__columns__.items()
-            if c.has_default_value
-        }
+        # cached per schema class: connectors call this once per RECORD on
+        # the parse hot path (schema classes are never mutated after build).
+        # Wrapped read-only so a caller mutating the result cannot corrupt
+        # every later record's defaults.
+        cached = cls.__dict__.get("_default_values_cache")
+        if cached is None:
+            import types as _types
+
+            cached = _types.MappingProxyType(
+                {
+                    n: c.default_value
+                    for n, c in cls.__columns__.items()
+                    if c.has_default_value
+                }
+            )
+            cls._default_values_cache = cached
+        return cached
 
     def with_types(cls, **kwargs) -> "SchemaMetaclass":
         columns = dict(cls.__columns__)

@@ -122,13 +122,20 @@ class _FsConnector(BaseConnector):
 
     def _read_all(self, seen: dict[str, float]) -> list[tuple[int, tuple, int]]:
         from pathway_tpu.internals import config as config_mod
-        from pathway_tpu.engine.value import shard_of_key
+        from pathway_tpu.engine.value import (
+            keys_for_value_columns,
+            shard_of_key,
+        )
+
+        import numpy as np
 
         n_proc = config_mod.pathway_config.processes
         pid = config_mod.pathway_config.process_id
         cols = list(self.node.column_names)
-        rows = []
         pk = self.schema.primary_key_columns()
+        # collect rows + key sources, then hash keys in ONE columnar native
+        # pass — per-row hash_values dominated wordcount-class profiles
+        entries: list[tuple[tuple, tuple]] = []  # (row, key source values)
         for fp in _list_files(self.path):
             # keyless sources shard whole files by path; primary-keyed
             # (upsert) sources must shard by KEY so one process owns all
@@ -150,19 +157,44 @@ class _FsConnector(BaseConnector):
                 if self.with_metadata:
                     values = {**values, "_metadata": meta}
                 row = tuple(values[c] for c in cols)
-                if pk:
-                    key = hash_values(*[values[c] for c in pk])
-                    if n_proc > 1 and shard_of_key(key, n_proc) != pid:
-                        continue
-                    old = self._emitted_pk.get(key)
-                    if old == row:
-                        continue
-                    if old is not None:
-                        rows.append((key, old, -1))
-                    self._emitted_pk[key] = row
-                else:
-                    key = hash_values(fp, i)
+                keysrc = (
+                    tuple(values[c] for c in pk) if pk else (fp, i)
+                )
+                entries.append((row, keysrc))
+        if not entries:
+            return []
+        n = len(entries)
+        n_keycols = len(entries[0][1])
+
+        def key_col(j: int) -> np.ndarray:
+            # np.empty + assignment, NOT np.array(list): equal-length
+            # list/tuple pk values would collapse into a 2-D array and hash
+            # as row slices instead of values
+            col = np.empty(n, dtype=object)
+            for i, e in enumerate(entries):
+                col[i] = e[1][j]
+            return col
+
+        keys = keys_for_value_columns(
+            [key_col(j) for j in range(n_keycols)], n
+        )
+        rows: list[tuple[int, tuple, int]] = []
+        if pk:
+            for (row, _src), key_np in zip(entries, keys):
+                key = int(key_np)
+                if n_proc > 1 and shard_of_key(key, n_proc) != pid:
+                    continue
+                old = self._emitted_pk.get(key)
+                if old == row:
+                    continue
+                if old is not None:
+                    rows.append((key, old, -1))
+                self._emitted_pk[key] = row
                 rows.append((key, row, 1))
+        else:
+            rows = [
+                (int(k), row, 1) for (row, _src), k in zip(entries, keys)
+            ]
         return rows
 
     def run(self):

@@ -258,3 +258,36 @@ def test_streaming_rerun_same_graph_streams_again(tmp_path):
     pw.run()
     assert time_mod.time() - start > 0.5, "second run exited without streaming"
     assert any(r["word"] == "dog" for r in seen)
+
+
+def test_fs_list_primary_key_hashes_match_scalar(tmp_path):
+    # regression: equal-length list pk values must not collapse into a 2-D
+    # numpy array in the columnar key pass (keys would differ from
+    # hash_values and vary with batch composition)
+    import json as json_mod
+
+    from pathway_tpu.engine.value import hash_values
+
+    (tmp_path / "a.jsonl").write_text(
+        json_mod.dumps({"coord": [1, 2], "v": 1})
+        + "\n"
+        + json_mod.dumps({"coord": [3, 4], "v": 2})
+        + "\n"
+    )
+
+    class S(pw.Schema):
+        coord: list = pw.column_definition(primary_key=True)
+        v: int
+
+    t = pw.io.jsonlines.read(str(tmp_path), schema=S, mode="static")
+    rows = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: rows.append(
+            int(key.value) if hasattr(key, "value") else int(key)
+        ),
+    )
+    pw.run()
+    assert sorted(rows) == sorted(
+        [hash_values([1, 2]), hash_values([3, 4])]
+    )
