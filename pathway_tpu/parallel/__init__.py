@@ -18,6 +18,7 @@ from pathway_tpu.parallel.mesh import (
     replicated,
 )
 from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex, sharded_topk_merge
+from pathway_tpu.parallel.sharded_ivf import ShardedIvfIndex, sharded_ivf_topk_merge
 from pathway_tpu.parallel.distributed import (
     DistributedConfig,
     initialize_distributed,
@@ -36,6 +37,8 @@ __all__ = [
     "replicated",
     "ShardedKnnIndex",
     "sharded_topk_merge",
+    "ShardedIvfIndex",
+    "sharded_ivf_topk_merge",
     "DistributedConfig",
     "initialize_distributed",
     "ring_attention_core",

@@ -37,6 +37,23 @@ def make_mesh(devices=None, dp: int | None = None, tp: int = 1) -> Mesh:
     return Mesh(arr, (DATA_AXIS, TENSOR_AXIS))
 
 
+class MeshRef:
+    """Hashable Mesh wrapper so a Mesh can be a jit static arg (shared by
+    the sharded index kernels)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __hash__(self):
+        return hash(
+            (tuple(d.id for d in self.mesh.devices.flat),
+             tuple(self.mesh.shape.items()))
+        )
+
+    def __eq__(self, other):
+        return isinstance(other, MeshRef) and self.mesh == other.mesh
+
+
 def local_mesh() -> Mesh:
     """1-chip degenerate mesh (bench path: one real TPU)."""
     return make_mesh(jax.devices()[:1], dp=1, tp=1)

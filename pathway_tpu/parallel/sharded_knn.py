@@ -79,17 +79,7 @@ def _sharded_search(corpus, valid, queries, k: int, metric: str,
     )(corpus, valid[:, None], queries)
 
 
-class _MeshRef:
-    """Hashable wrapper so a Mesh can be a jit static arg."""
-
-    def __init__(self, mesh: Mesh):
-        self.mesh = mesh
-
-    def __hash__(self):
-        return hash(tuple(d.id for d in self.mesh.devices.flat))
-
-    def __eq__(self, other):
-        return isinstance(other, _MeshRef) and self.mesh == other.mesh
+from pathway_tpu.parallel.mesh import MeshRef as _MeshRef  # noqa: E402
 
 
 def sharded_topk_merge(mesh: Mesh, corpus, valid, queries, k: int,

@@ -182,3 +182,74 @@ def test_ring_attention_core_vs_softmax():
     )(q, k, v, maskj)
     # compare only queries that attend to something real (all of them here)
     assert np.abs(np.asarray(out) - np.asarray(ref)).max() < 1e-5
+
+
+def test_sharded_ivf_full_probe_is_exact():
+    # nprobe == n_cells scans every cell: results must match numpy exact
+    from pathway_tpu.parallel import ShardedIvfIndex
+
+    mesh = make_mesh(tp=1)
+    dim, n = 16, 256
+    idx = ShardedIvfIndex(mesh, dimensions=dim, n_cells=4, nprobe=4,
+                          cell_capacity=32)
+    rng = np.random.default_rng(1)
+    vecs = rng.normal(size=(n, dim))
+    idx.add([f"k{i}" for i in range(n)], vecs)
+    q = rng.normal(size=(3, dim))
+    res = idx.search(q, k=5)
+    vn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+    qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+    scores = qn @ vn.T
+    for r in range(3):
+        expect = set(np.argsort(-scores[r])[:5])
+        got = {int(key[1:]) for key, _ in res[r]}
+        assert got == expect
+
+
+def test_sharded_ivf_pruned_recall_reasonable():
+    # nprobe < n_cells prunes; trained clustering must keep recall@10 high
+    from pathway_tpu.parallel import ShardedIvfIndex
+
+    mesh = make_mesh(tp=1)
+    dim, n = 16, 2048
+    rng = np.random.default_rng(2)
+    # clustered corpus (IVF's intended shape)
+    centers = rng.normal(size=(32, dim)) * 4
+    vecs = centers[rng.integers(0, 32, n)] + rng.normal(size=(n, dim))
+    idx = ShardedIvfIndex(mesh, dimensions=dim, n_cells=8, nprobe=4,
+                          cell_capacity=64, train_after=32)
+    idx.add([f"k{i}" for i in range(n)], vecs)
+    assert idx._trained
+    nq = 16
+    q = centers[rng.integers(0, 32, nq)] + rng.normal(size=(nq, dim))
+    res = idx.search(q, k=10)
+    vn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+    qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+    scores = qn @ vn.T
+    hits = 0
+    for r in range(nq):
+        expect = set(np.argsort(-scores[r])[:10].tolist())
+        got = {int(key[1:]) for key, _ in res[r]}
+        hits += len(expect & got)
+    recall = hits / (nq * 10)
+    assert recall >= 0.8, recall
+
+
+def test_sharded_ivf_remove_and_upsert():
+    from pathway_tpu.parallel import ShardedIvfIndex
+
+    mesh = make_mesh(tp=1)
+    dim = 8
+    idx = ShardedIvfIndex(mesh, dimensions=dim, n_cells=2, nprobe=2,
+                          cell_capacity=16)
+    rng = np.random.default_rng(3)
+    vecs = rng.normal(size=(32, dim))
+    idx.add([f"k{i}" for i in range(32)], vecs)
+    idx.remove(["k0", "k1"])
+    assert len(idx) == 30
+    res = idx.search(vecs[0][None, :], k=5)
+    assert all(key not in ("k0", "k1") for key, _ in res[0])
+    # upsert moves the key
+    idx.add(["k2"], -vecs[2][None, :])
+    res2 = idx.search(-vecs[2][None, :], k=1)
+    assert res2[0][0][0] == "k2"
