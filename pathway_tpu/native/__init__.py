@@ -62,6 +62,25 @@ def _load():
     global AVAILABLE, lib
     if os.environ.get("PATHWAY_DISABLE_NATIVE"):
         return
+    # a pip-built extension (setup.py) is preferred when it is at least as
+    # new as the source; a stale binary (source edited after `pip install
+    # -e .`) falls through to the JIT path, which content-hashes the source
+    # and rebuilds
+    try:
+        import importlib
+        import importlib.util
+
+        spec = importlib.util.find_spec("pathway_tpu.native._native")
+        if (
+            spec is not None
+            and spec.origin
+            and os.path.getmtime(spec.origin) >= os.path.getmtime(_SRC)
+        ):
+            lib = importlib.import_module("pathway_tpu.native._native")
+            AVAILABLE = True
+            return
+    except (ImportError, OSError):
+        pass
     path = _build_path()
     if not os.path.exists(path):
         tmp = path + f".tmp{os.getpid()}"

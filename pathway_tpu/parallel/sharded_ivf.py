@@ -31,7 +31,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pathway_tpu.parallel.mesh import DATA_AXIS
+from pathway_tpu.parallel.mesh import DATA_AXIS, MeshRef as _MeshRef
 
 _NEG_INF = -1e30
 
@@ -66,7 +66,6 @@ def _local_ivf_topk(cells, valid, centroids, q, k: int, nprobe: int,
     return top_sc, local_slot
 
 
-from pathway_tpu.parallel.mesh import MeshRef as _MeshRef  # noqa: E402
 
 
 @functools.partial(
@@ -181,12 +180,10 @@ class ShardedIvfIndex:
             return int(np.argmin(d))
         return int(np.argmax(cents @ vec))
 
-    def _insert_prepped(self, key, vec: np.ndarray) -> None:
-        """Slot-allocation invariant lives HERE only: pick the least-loaded
-        shard, that shard's nearest cell, a free slot (growing on overflow),
-        then update cells/valid/key maps/shard counts together."""
-        shard = int(np.argmin(self._shard_count))
-        cell = self._cell_of(shard, vec)
+    def _place(self, key, vec: np.ndarray, shard: int, cell: int) -> None:
+        """Slot-allocation invariant lives HERE only: a free slot in the
+        chosen (shard, cell), growing on overflow, then cells/valid/key
+        maps/shard counts updated together."""
         gcell = shard * self.n_cells + cell
         free = np.nonzero(~self._h_valid[gcell])[0]
         if len(free) == 0:
@@ -200,15 +197,47 @@ class ShardedIvfIndex:
         self._loc[key] = g
         self._shard_count[shard] += 1
 
+    def _insert_batch(self, keys: list, vecs: np.ndarray) -> None:
+        """Batched insert: shards chosen so final loads balance, then ONE
+        centroid gemm per shard assigns cells (vs a per-vector gemm)."""
+        counts = list(self._shard_count)
+        shards = np.empty(len(keys), dtype=np.int64)
+        for i in range(len(keys)):
+            s = int(np.argmin(counts))
+            counts[s] += 1
+            shards[i] = s
+        for s in np.unique(shards):
+            idx = np.nonzero(shards == s)[0]
+            c0 = int(s) * self.n_cells
+            cents = self._h_centroids[c0 : c0 + self.n_cells]
+            block = vecs[idx]
+            if self.metric == "l2":
+                d2 = (
+                    np.sum(block * block, axis=1, keepdims=True)
+                    + np.sum(cents * cents, axis=1)[None, :]
+                    - 2.0 * block @ cents.T
+                )
+                cells = np.argmin(d2, axis=1)
+            else:
+                cells = np.argmax(block @ cents.T, axis=1)
+            for j, i in enumerate(idx):
+                self._place(keys[int(i)], vecs[int(i)], int(s), int(cells[j]))
+
     def add(self, keys: list, vectors) -> None:
         if not keys:
             return
         v = self._prep(vectors)
         self._seed(v)
-        for i, key in enumerate(keys):
-            if key in self._loc:
-                self.remove([key])
-            self._insert_prepped(key, v[i])
+        if len(set(keys)) != len(keys):
+            # duplicate keys in one batch: last occurrence wins (upsert)
+            last = {k: i for i, k in enumerate(keys)}
+            keep = sorted(last.values())
+            keys = [keys[i] for i in keep]
+            v = v[keep]
+        existing = [k for k in keys if k in self._loc]
+        if existing:
+            self.remove(existing)
+        self._insert_batch(keys, v)
         if not self._trained:
             self._pending.append(v)
             self._maybe_train()
@@ -268,8 +297,8 @@ class ShardedIvfIndex:
         self._loc.clear()
         self._shard_count = [0] * self.dp
         # re-add without re-normalizing (vectors are already prepped)
-        for i, key in enumerate(keys):
-            self._insert_prepped(key, vecs[i])
+        if keys:
+            self._insert_batch(keys, vecs)
         self._dev = None
 
     def remove(self, keys: list) -> None:

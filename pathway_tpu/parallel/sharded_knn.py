@@ -33,7 +33,7 @@ except ImportError:  # pragma: no cover - older jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pathway_tpu.ops.knn import knn_scores
-from pathway_tpu.parallel.mesh import DATA_AXIS
+from pathway_tpu.parallel.mesh import DATA_AXIS, MeshRef as _MeshRef
 
 _NEG_INF = -1e30
 
@@ -79,7 +79,6 @@ def _sharded_search(corpus, valid, queries, k: int, metric: str,
     )(corpus, valid[:, None], queries)
 
 
-from pathway_tpu.parallel.mesh import MeshRef as _MeshRef  # noqa: E402
 
 
 def sharded_topk_merge(mesh: Mesh, corpus, valid, queries, k: int,
