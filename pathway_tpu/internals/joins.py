@@ -22,6 +22,8 @@ from pathway_tpu.internals.expression import (
     ColumnReference,
 )
 from pathway_tpu.internals.parse_graph import G
+
+builtins_id = id  # the join API shadows `id` with its keyword argument
 from pathway_tpu.internals.type_interpreter import infer_dtype
 from pathway_tpu.internals.universe import Universe
 
@@ -47,6 +49,11 @@ def join(
 class JoinResult:
     """Lazy join — materialized by ``select``/``reduce``."""
 
+    # class-level default: construction paths that bypass __init__ (e.g.
+    # specialized temporal joins building the object piecemeal) still
+    # dealias safely as a no-op
+    _aliases: dict = {}
+
     def __init__(self, left, right, on, id_, how, left_instance, right_instance):
         from pathway_tpu.internals.table import Table
 
@@ -68,10 +75,145 @@ class JoinResult:
             right_exprs.append(self._bind(substitute(right_instance, {thisclass.this: right}), right))
         self._left_on = left_exprs
         self._right_on = right_exprs
+        # chained joins: original-side tables from earlier links resolve
+        # through this map into the materialized base's prefixed columns —
+        # {id(table): (table, name -> base column name)}
+        self._aliases: dict[int, tuple[Any, Any]] = {}
 
     @staticmethod
     def _bind(e, table):
         return substitute(e, {thisclass.this: table})
+
+    @staticmethod
+    def _demangle(name: str) -> str:
+        """Strip (possibly nested) __jl_/__jr_ chain prefixes."""
+        while name.startswith(("__jl_", "__jr_")):
+            name = name[len("__jl_"):]
+        return name
+
+    def _resolve_chain_side(self, name: str) -> str | None:
+        """Original-name lookup against the materialized chain base: the
+        base's columns carry __jl_/__jr_ prefixes; pw.left.a / pw.this.a on
+        a chained join must find them by their ORIGINAL name."""
+        for cand in (f"__jl_{name}", f"__jr_{name}"):
+            if cand in self._left.column_names():
+                return cand
+        for col in self._left.column_names():
+            if (
+                col.startswith(("__jl_", "__jr_"))
+                and not col.endswith("_id")
+                and self._demangle(col) == name
+            ):
+                return col
+        return None
+
+    def _dealias(self, e):
+        """Rewrite references to aliased prior-join tables (and pw.left /
+        pw.this by original name) into this join's left (base) table
+        columns; everything else passes through."""
+        if not self._aliases or not isinstance(e, ColumnExpression):
+            return e
+
+        def rw(x):
+            if isinstance(x, ColumnReference):
+                t = x._table
+                entry = (
+                    self._aliases.get(builtins_id(t)) if t is not None else None
+                )
+                if entry is not None:
+                    return ColumnReference(self._left, entry[1](x._name))
+                if t is thisclass.left or t is thisclass.this:
+                    resolved = self._resolve_chain_side(x._name)
+                    if resolved is not None:
+                        return ColumnReference(self._left, resolved)
+                return x
+            if isinstance(x, ColumnExpression):
+                return expr_mod.map_child_expressions(x, rw)
+            return x
+
+        return rw(e)
+
+    def _output_columns(self) -> dict[str, ColumnReference]:
+        """name -> side reference for 'all columns' materializations
+        (filter/reduce/groupby); chained joins demangle the base's
+        prefixed columns back to their original names."""
+        exprs: dict[str, ColumnReference] = {}
+        for n in self._left.column_names():
+            if self._aliases and n.startswith(("__jl_", "__jr_")):
+                if n.endswith("_id"):
+                    continue  # internal id columns never leak
+                out = self._demangle(n)
+                if out not in exprs:
+                    exprs[out] = ColumnReference(thisclass.left, n)
+            else:
+                exprs[n] = ColumnReference(thisclass.left, n)
+        for n in self._right.column_names():
+            if n not in exprs:
+                exprs[n] = ColumnReference(thisclass.right, n)
+        return exprs
+
+    # ---- chaining: reference JoinResult.join (a JoinResult is joinable) ----
+    def join(self, other, *on, id=None, how="inner",  # noqa: A002
+             left_instance=None, right_instance=None):
+        """Chain another join: this join materializes as the LEFT side;
+        references to the original left/right tables in later conditions
+        and selects keep resolving through the alias map."""
+        if hasattr(how, "value"):
+            how = how.value
+        base = self._raw_table()
+        amap: dict[int, tuple[Any, Any]] = {
+            builtins_id(self._left): (
+                self._left,
+                lambda n: "__jl_id" if n == "id" else f"__jl_{n}",
+            ),
+            builtins_id(self._right): (
+                self._right,
+                lambda n: "__jr_id" if n == "id" else f"__jr_{n}",
+            ),
+        }
+        for tid, (tbl, f) in self._aliases.items():
+            amap[tid] = (tbl, (lambda g: lambda n: f"__jl_{g(n)}")(f))
+
+        def rw(x):
+            if isinstance(x, ColumnReference):
+                t = x._table
+                entry = amap.get(builtins_id(t)) if t is not None else None
+                if entry is not None:
+                    return base[entry[1](x._name)]
+                return x
+            if isinstance(x, ColumnExpression):
+                return expr_mod.map_child_expressions(x, rw)
+            return x
+
+        if self._left is self._right:
+            # self-join: one table on both sides is ambiguous by object
+            # identity — refs must use pw.left/pw.right, so alias nothing
+            # and let unknown-table references fail loudly
+            amap.pop(builtins_id(self._left), None)
+        on2 = [rw(c) for c in on]
+        id2 = rw(id) if isinstance(id, ColumnExpression) else id
+        li2 = (
+            rw(left_instance)
+            if isinstance(left_instance, ColumnExpression)
+            else left_instance
+        )
+        ri2 = (
+            rw(right_instance)
+            if isinstance(right_instance, ColumnExpression)
+            else right_instance
+        )
+        jr = JoinResult(base, other, on2, id2, how, li2, ri2)
+        jr._aliases = amap
+        return jr
+
+    def join_left(self, other, *on, **kw):
+        return self.join(other, *on, how="left", **kw)
+
+    def join_right(self, other, *on, **kw):
+        return self.join(other, *on, how="right", **kw)
+
+    def join_outer(self, other, *on, **kw):
+        return self.join(other, *on, how="outer", **kw)
 
     def _build(self):
         """Create the engine join node producing prefixed columns."""
@@ -234,6 +376,7 @@ class JoinResult:
         exprs = self._expand_select_args(args)
         for name, e in kwargs.items():
             exprs[name] = expr_mod.smart_coerce(e)
+        exprs = {n: self._dealias(e) for n, e in exprs.items()}
         if any(self._contains_ix(e) for e in exprs.values()):
             base = self._raw_table()
             return base.select(
@@ -279,25 +422,26 @@ class JoinResult:
         return infer_dtype(e, left)
 
     def filter(self, expression):
+        left_cols = {
+            n: e
+            for n, e in self._output_columns().items()
+            if e._table is thisclass.left
+        }
         return self.select(
-            *[ColumnReference(thisclass.left, n) for n in self._left.column_names()],
+            **left_cols,
             __join_filter__=expression,
         ).filter(ColumnReference(thisclass.this, "__join_filter__")).without(
             "__join_filter__"
         )
 
     def reduce(self, *args, **kwargs):
-        return self.select(
-            *[ColumnReference(thisclass.left, n) for n in self._left.column_names()]
-        ).reduce(*args, **kwargs)
+        left_cols = {
+            n: e
+            for n, e in self._output_columns().items()
+            if e._table is thisclass.left
+        }
+        return self.select(**left_cols).reduce(*args, **kwargs)
 
     def groupby(self, *args, **kwargs):
-        full = self.select(
-            *[ColumnReference(thisclass.left, n) for n in self._left.column_names()],
-            **{
-                n: ColumnReference(thisclass.right, n)
-                for n in self._right.column_names()
-                if n not in self._left.column_names()
-            },
-        )
+        full = self.select(**self._output_columns())
         return full.groupby(*args, **kwargs)

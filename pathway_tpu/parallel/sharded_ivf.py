@@ -172,14 +172,6 @@ class ShardedIvfIndex:
                                                       size=seed.shape)
         self._h_centroids = seed.astype(np.float32)
 
-    def _cell_of(self, shard: int, vec: np.ndarray) -> int:
-        c0 = shard * self.n_cells
-        cents = self._h_centroids[c0 : c0 + self.n_cells]
-        if self.metric == "l2":
-            d = np.sum((cents - vec) ** 2, axis=1)
-            return int(np.argmin(d))
-        return int(np.argmax(cents @ vec))
-
     def _place(self, key, vec: np.ndarray, shard: int, cell: int) -> None:
         """Slot-allocation invariant lives HERE only: a free slot in the
         chosen (shard, cell), growing on overflow, then cells/valid/key

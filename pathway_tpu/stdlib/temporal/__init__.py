@@ -241,6 +241,10 @@ class WindowGroupedTable:
                     flat["__windows"].get(1),
                 ),
             )
+        # reference parity: the grouped view exposes the colocation key as
+        # ``_pw_instance`` alongside the window columns
+        inst_src = "__inst" if isinstance(window, SessionWindow) else "__winst"
+        tagged = tagged.with_columns(_pw_instance=tagged[inst_src])
         # apply behavior: delay/cutoff on window end vs time column.
         # Lateness operators (freeze/forget) must see the RAW stream: their
         # watermark is derived from observed rows, and a buffer placed before
@@ -324,7 +328,9 @@ def _window_meta_rewrite(e, tagged, instance_name=None):
     from pathway_tpu.internals import reducers as red_mod
 
     if isinstance(e, ColumnReference):
-        constant_cols = ("_pw_window_start", "_pw_window_end", "_pw_window")
+        constant_cols = (
+            "_pw_window_start", "_pw_window_end", "_pw_window", "_pw_instance",
+        )
         if e.name in constant_cols or (
             instance_name is not None and e.name == instance_name
         ):

@@ -1,30 +1,12 @@
 """Build shim: compiles the C++ host runtime as an optional extension.
 
-The package also self-builds ``_native.cpp`` at first import when no
-prebuilt extension is present (``pathway_tpu/native/__init__.py``), so a
+``Extension(optional=True)`` makes setuptools downgrade a failed build to a
+warning; the package also self-builds ``_native.cpp`` at first import when
+no prebuilt extension is present (``pathway_tpu/native/__init__.py``), so a
 failed extension build degrades to the JIT path — never a broken install.
 """
 
 from setuptools import Extension, setup
-from setuptools.command.build_ext import build_ext
-
-
-class OptionalBuildExt(build_ext):
-    """Extension build failures must not fail the install (the runtime
-    JIT-compiles the same source on first import as a fallback)."""
-
-    def run(self):
-        try:
-            super().run()
-        except Exception as exc:  # noqa: BLE001
-            print(f"warning: native extension build skipped: {exc}")
-
-    def build_extension(self, ext):
-        try:
-            super().build_extension(ext)
-        except Exception as exc:  # noqa: BLE001
-            print(f"warning: building {ext.name} failed: {exc}")
-
 
 setup(
     ext_modules=[
@@ -35,5 +17,4 @@ setup(
             optional=True,
         )
     ],
-    cmdclass={"build_ext": OptionalBuildExt},
 )

@@ -1,0 +1,478 @@
+"""Expression semantics matrix — arithmetic/comparison/string/datetime/json
+behaviors pinned against the reference's expression tests (``test_common.py``,
+``test_expressions``): operator precedence, None propagation, division
+semantics, ERROR handling, casts, containers."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows
+
+
+def _one(table, *names):
+    rows, cols = _capture_rows(table)
+    (row,) = rows.values()
+    if len(names) == 1:
+        return row[cols.index(names[0])]
+    return tuple(row[cols.index(n)] for n in names)
+
+
+# ------------------------------------------------------------- arithmetic
+def test_integer_division_floors_negative():
+    t = T(
+        """
+        a  | b
+        -7 | 2
+        """
+    )
+    assert _one(t.select(q=t.a // t.b), "q") == -4
+
+
+def test_modulo_sign_follows_python():
+    t = T(
+        """
+        a  | b
+        -7 | 3
+        """
+    )
+    assert _one(t.select(m=t.a % t.b), "m") == 2
+
+
+def test_true_division_yields_float():
+    t = T(
+        """
+        a | b
+        7 | 2
+        """
+    )
+    assert _one(t.select(q=t.a / t.b), "q") == 3.5
+
+
+def test_int_float_mixed_arithmetic_promotes():
+    t = T(
+        """
+        a | b
+        3 | 0.5
+        """
+    )
+    v = _one(t.select(x=t.a * t.b + 1), "x")
+    assert isinstance(v, float) and v == 2.5
+
+
+def test_division_by_zero_is_error_value():
+    t = T(
+        """
+        a | b
+        1 | 0
+        """
+    )
+    res = t.select(q=pw.fill_error(t.a // t.b, -99))
+    assert _one(res, "q") == -99
+
+
+def test_unary_negation_and_abs_expression():
+    t = T(
+        """
+        a
+        -5
+        """
+    )
+    assert _one(t.select(x=-t.a), "x") == 5
+
+
+def test_pow_operator():
+    t = T(
+        """
+        a
+        3
+        """
+    )
+    assert _one(t.select(x=t.a**2), "x") == 9
+
+
+def test_operator_precedence_in_one_expression():
+    t = T(
+        """
+        a | b
+        2 | 3
+        """
+    )
+    assert _one(t.select(x=t.a + t.b * 2 - 1), "x") == 7
+
+
+# ------------------------------------------------------------ comparisons
+def test_chained_boolean_operators():
+    t = T(
+        """
+        a | b
+        2 | 3
+        5 | 1
+        """
+    )
+    res = t.filter((t.a > 1) & (t.b > 2) | (t.a == 5))
+    rows, _ = _capture_rows(res)
+    assert len(rows) == 2
+
+
+def test_boolean_not():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = t.filter(~(t.a == 1))
+    rows, _ = _capture_rows(res)
+    assert [r[0] for r in rows.values()] == [2]
+
+
+def test_string_comparison_lexicographic():
+    t = T(
+        """
+        s
+        apple
+        banana
+        """
+    )
+    res = t.filter(t.s < "b")
+    rows, _ = _capture_rows(res)
+    assert [r[0] for r in rows.values()] == ["apple"]
+
+
+def test_equality_across_none():
+    t = T(
+        """
+        a | b
+        1 |
+        """
+    )
+    assert _one(t.select(x=t.a.is_not_none(), y=t.b.is_none()), "x") is True
+
+
+# -------------------------------------------------------------- optionals
+def test_coalesce_chain_takes_first_non_none():
+    t = T(
+        """
+        a | b | c
+          |   | 3
+        """
+    )
+    assert _one(t.select(x=pw.coalesce(t.a, t.b, t.c)), "x") == 3
+
+
+def test_if_else_branches_rowwise():
+    t = T(
+        """
+        a
+        1
+        5
+        """
+    )
+    res = t.select(x=pw.if_else(t.a > 3, t.a * 10, t.a))
+    rows, _ = _capture_rows(res)
+    assert sorted(r[0] for r in rows.values()) == [1, 50]
+
+
+def test_unwrap_raises_error_value_on_none():
+    t = T(
+        """
+        a
+        """
+        + "\n1\n"
+    )
+    res = t.select(x=pw.unwrap(t.a))
+    assert _one(res, "x") == 1
+
+
+def test_fill_error_passthrough_when_no_error():
+    t = T(
+        """
+        a
+        4
+        """
+    )
+    assert _one(t.select(x=pw.fill_error(t.a * 2, -1)), "x") == 8
+
+
+# ----------------------------------------------------------------- string
+def test_str_slice_and_upper():
+    t = T(
+        """
+        s
+        hello
+        """
+    )
+    res = t.select(u=t.s.str.upper(), sub=t.s.str.slice(1, 3))
+    u, sub = _one(res, "u", "sub")
+    assert u == "HELLO" and sub == "el"
+
+
+def test_str_find_and_count():
+    t = T(
+        """
+        s
+        banana
+        """
+    )
+    res = t.select(i=t.s.str.find("na"), c=t.s.str.count("a"))
+    i, c = _one(res, "i", "c")
+    assert i == 2 and c == 3
+
+
+def test_str_strip_split_join_roundtrip():
+    t = T(
+        """
+        s
+        "  a,b,c  "
+        """
+    )
+    res = t.select(parts=t.s.str.strip().str.split(","))
+    parts = _one(res, "parts")
+    assert list(parts) == ["a", "b", "c"]
+
+
+def test_str_parse_int_and_float():
+    t = T(
+        """
+        s    | f
+        "42" | 2.5
+        """
+    )
+    res = t.select(i=t.s.str.parse_int(), g=t.f)
+    i, g = _one(res, "i", "g")
+    assert i == 42 and g == 2.5
+
+
+def test_string_concat_operator():
+    t = T(
+        """
+        a | b
+        foo | bar
+        """
+    )
+    assert _one(t.select(s=t.a + t.b), "s") == "foobar"
+
+
+def test_string_multiplication():
+    t = T(
+        """
+        a
+        ab
+        """
+    )
+    assert _one(t.select(s=t.a * 3), "s") == "ababab"
+
+
+# --------------------------------------------------------------- datetime
+def test_dt_components():
+    t = T(
+        """
+        s
+        2024-03-05T06:07:08
+        """
+    )
+    d = t.select(d=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S"))
+    res = d.select(
+        y=d.d.dt.year(), mo=d.d.dt.month(), day=d.d.dt.day(),
+        h=d.d.dt.hour(), mi=d.d.dt.minute(), s=d.d.dt.second(),
+    )
+    assert _one(res, "y", "mo", "day", "h", "mi", "s") == (2024, 3, 5, 6, 7, 8)
+
+
+def test_dt_strftime_roundtrip():
+    t = T(
+        """
+        s
+        2024-12-31T23:59:00
+        """
+    )
+    d = t.select(d=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S"))
+    res = d.select(out=d.d.dt.strftime("%Y/%m/%d %H:%M"))
+    assert _one(res, "out") == "2024/12/31 23:59"
+
+
+def test_duration_arithmetic_days():
+    t = T(
+        """
+        a                   | b
+        2024-01-03T00:00:00 | 2024-01-01T12:00:00
+        """
+    )
+    d = t.select(
+        a=pw.this.a.dt.strptime("%Y-%m-%dT%H:%M:%S"),
+        b=pw.this.b.dt.strptime("%Y-%m-%dT%H:%M:%S"),
+    )
+    res = d.select(h=(d.a - d.b).dt.hours())
+    assert _one(res, "h") == 36
+
+
+def test_dt_weekday_and_round():
+    t = T(
+        """
+        s
+        2024-03-05T10:31:00
+        """
+    )
+    d = t.select(d=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S"))
+    res = d.select(wd=d.d.dt.weekday())
+    assert _one(res, "wd") == 1  # Tuesday
+
+
+# ------------------------------------------------------------------- json
+def test_json_get_nested_and_types():
+    import pathway_tpu as pw
+
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    t2 = t.select(
+        j=pw.apply_with_type(
+            lambda _: pw.Json({"x": {"y": 5}, "arr": [1, 2], "s": "hi"}),
+            pw.Json,
+            pw.this.a,
+        )
+    )
+    res = t2.select(
+        y=t2.j.get("x").get("y").as_int(),
+        a0=t2.j.get("arr").get(0).as_int(),
+        s=t2.j.get("s").as_str(),
+    )
+    assert _one(res, "y", "a0", "s") == (5, 1, "hi")
+
+
+def test_json_missing_key_yields_none():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    t2 = t.select(
+        j=pw.apply_with_type(lambda _: pw.Json({"x": 1}), pw.Json, pw.this.a)
+    )
+    res = t2.select(m=t2.j.get("nope").as_int())
+    assert _one(res, "m") is None
+
+
+# ------------------------------------------------------------- containers
+def test_tuple_indexing_and_len():
+    t = T(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    t2 = t.select(tup=pw.make_tuple(t.a, t.b, t.a + t.b))
+    res = t2.select(first=t2.tup[0], last=t2.tup[-1])
+    assert _one(res, "first", "last") == (1, 3)
+
+
+def test_ndarray_elementwise_in_expression():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    t2 = t.select(
+        v=pw.apply_with_type(
+            lambda _: np.array([1.0, 2.0]), np.ndarray, pw.this.a
+        )
+    )
+    res = t2.select(s=pw.apply_with_type(lambda v: float(v.sum()), float, t2.v))
+    assert _one(res, "s") == 3.0
+
+
+def test_apply_receives_python_values():
+    t = T(
+        """
+        a | s
+        2 | xy
+        """
+    )
+    res = t.select(
+        out=pw.apply_with_type(
+            lambda a, s: f"{s}{a}", str, pw.this.a, pw.this.s
+        )
+    )
+    assert _one(res, "out") == "xy2"
+
+
+def test_cast_int_to_float_and_back():
+    t = T(
+        """
+        a
+        3
+        """
+    )
+    res = t.select(f=pw.cast(float, t.a))
+    f = _one(res, "f")
+    assert isinstance(f, float) and f == 3.0
+    res2 = t.select(f=pw.cast(float, t.a)).select(i=pw.cast(int, pw.this.f))
+    assert _one(res2, "i") == 3
+
+
+def test_to_string_of_various_types():
+    t = T(
+        """
+        a | f   | s
+        1 | 2.5 | x
+        """
+    )
+    res = t.select(
+        sa=t.a.to_string(), sf=t.f.to_string(), ss=t.s.to_string()
+    )
+    sa, sf, ss = _one(res, "sa", "sf", "ss")
+    assert sa == "1" and sf == "2.5" and ss == "x"
+
+
+# --------------------------------------------------------------- pointers
+def test_pointer_from_values_stable():
+    t = T(
+        """
+        a | b
+        1 | x
+        """
+    )
+    res = t.select(p=t.pointer_from(t.a, t.b), q=t.pointer_from(t.a, t.b))
+    p, q = _one(res, "p", "q")
+    assert p == q
+
+
+def test_with_id_from_changes_keys_deterministically():
+    t = T(
+        """
+        a | b
+        1 | x
+        2 | y
+        """
+    )
+    r1 = t.with_id_from(t.a)
+    r2 = t.with_id_from(t.a)
+    k1, _ = _capture_rows(r1)
+    k2, _ = _capture_rows(r2)
+    assert set(k1) == set(k2)
+
+
+def test_ix_lookup_by_pointer():
+    base = T(
+        """
+        a | v
+        1 | 10
+        2 | 20
+        """
+    )
+    keyed = base.with_id_from(base.a)
+    probe = T(
+        """
+        a
+        2
+        """
+    )
+    res = probe.select(v=keyed.ix(keyed.pointer_from(probe.a)).v)
+    assert _one(res, "v") == 20

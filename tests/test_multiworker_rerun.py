@@ -101,3 +101,170 @@ def test_knn_classify_threads():
         if r[cols.index("predicted_label")] is not None
     )
     assert got == ["hi", "lo"]
+
+
+def test_tumbling_window_threads():
+    t = pw.debug.table_from_markdown(
+        """
+        t | v
+        1 | 1
+        4 | 2
+        6 | 4
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5)
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    rows, _ = _capture_rows(res)
+    assert sorted(r[0] for r in rows.values()) == [3, 4]
+
+
+def test_interval_join_threads():
+    t1 = pw.debug.table_from_markdown(
+        """
+        t | a
+        3 | x
+        """
+    )
+    t2 = pw.debug.table_from_markdown(
+        """
+        t | b
+        2 | p
+        9 | q
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-1, 1)
+    ).select(pw.left.a, pw.right.b)
+    rows, _ = _capture_rows(res)
+    assert [tuple(r) for r in rows.values()] == [("x", "p")]
+
+
+def test_outer_join_retraction_threads():
+    left = pw.debug.table_from_markdown(
+        """
+        a | k | __time__
+        1 | x | 2
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        b | k | __time__
+        5 | x | 4
+        """
+    )
+    res = left.join_outer(right, left.k == right.k).select(left.a, right.b)
+    rows, _ = _capture_rows(res)
+    assert [tuple(r) for r in rows.values()] == [(1, 5)]
+
+
+def test_iterate_threads():
+    def logic(t):
+        return t.select(n=pw.if_else(t.n >= 5, t.n, t.n + 1))
+
+    t = pw.debug.table_from_markdown(
+        """
+        n
+        1
+        5
+        """
+    )
+    res = pw.iterate(logic, t=t)
+    rows, _ = _capture_rows(res.t if hasattr(res, "t") else res)
+    assert sorted(r[0] for r in rows.values()) == [5, 5]
+
+
+def test_sort_prev_next_threads():
+    t = pw.debug.table_from_markdown(
+        """
+        v
+        3
+        1
+        2
+        """
+    )
+    s = t.sort(t.v)
+    merged = t.with_columns(prev=s.prev, next=s.next)
+    rows, cols = _capture_rows(merged)
+    vi = cols.index("v")
+    ni = cols.index("next")
+    by_v = {r[vi]: r for r in rows.values()}
+    assert by_v[3][ni] is None  # max has no next
+
+
+def test_update_cells_threads():
+    base = pw.debug.table_from_markdown(
+        """
+          | a  | b
+        1 | 10 | x
+        2 | 20 | y
+        """
+    )
+    upd = pw.debug.table_from_markdown(
+        """
+          | a
+        2 | 99
+        """
+    )
+    out = base.update_cells(upd.promise_universe_is_subset_of(base))
+    rows, cols = _capture_rows(out)
+    got = sorted(tuple(r) for r in rows.values())
+    assert got == [(10, "x"), (99, "y")]
+
+
+def test_knn_index_threads():
+    import pandas as pd
+
+    from pathway_tpu.stdlib.indexing import BruteForceKnn, DataIndex
+
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(12, 8))
+    docs = pw.debug.table_from_pandas(
+        pd.DataFrame({"doc": [f"d{i}" for i in range(12)],
+                      "vec": [v for v in vecs]})
+    )
+    queries = pw.debug.table_from_pandas(
+        pd.DataFrame({"qvec": [vecs[3] + 1e-4]})
+    )
+    index = DataIndex(docs, BruteForceKnn(docs.vec, dimensions=8))
+    res = index.query_as_of_now(queries.qvec, number_of_matches=1)
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("doc")][0] == "d3"
+
+
+def test_concat_groupby_chain_threads():
+    t1 = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 1
+        """
+    )
+    t2 = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 2
+        b | 5
+        """
+    )
+    both = t1.concat_reindex(t2)
+    res = both.groupby(both.g).reduce(both.g, s=pw.reducers.sum(both.v))
+    rows, _ = _capture_rows(res)
+    got = sorted(tuple(r) for r in rows.values())
+    assert got == [("a", 3), ("b", 5)]
+
+
+def test_deduplicate_threads():
+    t = pw.debug.table_from_markdown(
+        """
+        v | __time__
+        1 | 2
+        3 | 4
+        2 | 6
+        """
+    )
+    res = pw.stdlib.stateful.deduplicate(
+        t, value=t.v, acceptor=lambda new, old: new > old
+    )
+    rows, cols = _capture_rows(res)
+    assert sorted(r[cols.index("v")] for r in rows.values()) == [3]

@@ -1,0 +1,565 @@
+"""Temporal edge cases — scenarios derived from the reference's
+``tests/temporal/`` suite (empty/shifted/non-symmetric intervals, float
+bounds, non-overlapping times, window boundary arithmetic, late data +
+behaviors, asof direction matrix)."""
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows, assert_table_equality_wo_index
+
+
+def _times(spec):
+    return T(spec)
+
+
+# ----------------------------------------------------------- interval join
+def test_interval_join_empty_interval_point_match():
+    # [0, 0]: only exact time equality pairs
+    t1 = _times(
+        """
+        t | a
+        3 | x
+        5 | y
+        """
+    )
+    t2 = _times(
+        """
+        t | b
+        3 | p
+        6 | q
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(0, 0)
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | p
+            """
+        ),
+    )
+
+
+def test_interval_join_shifted_interval():
+    # [2, 3]: right must be 2..3 AFTER left
+    t1 = _times(
+        """
+        t | a
+        1 | x
+        """
+    )
+    t2 = _times(
+        """
+        t | b
+        2 | p
+        3 | q
+        4 | r
+        5 | s
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(2, 3)
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | q
+            x | r
+            """
+        ),
+    )
+
+
+def test_interval_join_non_symmetric_negative():
+    # [-3, -1]: right strictly BEFORE left
+    t1 = _times(
+        """
+        t | a
+        5 | x
+        """
+    )
+    t2 = _times(
+        """
+        t | b
+        1 | p
+        3 | q
+        5 | r
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-3, -1)
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | q
+            """
+        ),
+    )
+
+
+def test_interval_join_float_bounds():
+    t1 = _times(
+        """
+        t   | a
+        1.0 | x
+        """
+    )
+    t2 = _times(
+        """
+        t    | b
+        1.4  | p
+        1.6  | q
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-0.5, 0.5)
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | p
+            """
+        ),
+    )
+
+
+def test_interval_join_non_overlapping_times_inner_empty():
+    t1 = _times(
+        """
+        t | a
+        1 | x
+        """
+    )
+    t2 = _times(
+        """
+        t  | b
+        10 | p
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-1, 1)
+    ).select(pw.left.a, pw.right.b)
+    rows, _ = _capture_rows(res)
+    assert rows == {}
+
+
+def test_interval_join_outer_pads_unmatched():
+    t1 = _times(
+        """
+        t | a
+        1 | x
+        """
+    )
+    t2 = _times(
+        """
+        t  | b
+        10 | p
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-1, 1), how="outer"
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x |
+              | p
+            """
+        ),
+    )
+
+
+def test_interval_join_with_extra_on_condition():
+    t1 = _times(
+        """
+        t | k | a
+        1 | u | x
+        1 | v | y
+        """
+    )
+    t2 = _times(
+        """
+        t | k | b
+        1 | u | p
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(0, 0), t1.k == t2.k
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | p
+            """
+        ),
+    )
+
+
+def test_interval_join_expression_select():
+    t1 = _times(
+        """
+        t | a
+        2 | 10
+        """
+    )
+    t2 = _times(
+        """
+        t | b
+        2 | 7
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(0, 0)
+    ).select(s=pw.left.a + pw.right.b, dt=pw.right.t - pw.left.t)
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("s")] == 17
+    assert row[cols.index("dt")] == 0
+
+
+# ----------------------------------------------------------------- windows
+def test_tumbling_window_boundary_belongs_to_next():
+    t = _times(
+        """
+        t | v
+        0 | 1
+        4 | 2
+        5 | 4
+        9 | 8
+        10 | 16
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5)
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            s
+            3
+            12
+            16
+            """
+        ),
+    )
+
+
+def test_tumbling_window_origin_shifts_boundaries():
+    t = _times(
+        """
+        t | v
+        0 | 1
+        4 | 2
+        5 | 4
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5, origin=4)
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    # windows [-1, 4), [4, 9): 0 in first... origin=4 -> [4,9) holds 4,5
+    rows, _ = _capture_rows(res)
+    got = sorted(r[0] for r in rows.values())
+    assert got == [1, 6]
+
+
+def test_sliding_window_row_in_multiple_windows():
+    t = _times(
+        """
+        t | v
+        3 | 1
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=2, duration=4)
+    ).reduce(
+        start=pw.this._pw_window_start, s=pw.reducers.sum(pw.this.v)
+    )
+    rows, cols = _capture_rows(res)
+    starts = sorted(r[cols.index("start")] for r in rows.values())
+    assert starts == [0, 2]  # windows [0,4) and [2,6) both contain t=3
+
+
+def test_session_window_merges_across_gap_chain():
+    t = _times(
+        """
+        t  | v
+        1  | 1
+        3  | 2
+        5  | 4
+        20 | 8
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.session(max_gap=3)
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    rows, _ = _capture_rows(res)
+    assert sorted(r[0] for r in rows.values()) == [7, 8]
+
+
+def test_session_window_predicate_variant():
+    t = _times(
+        """
+        t  | v
+        1  | 1
+        2  | 2
+        10 | 4
+        """
+    )
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.session(predicate=lambda a, b: abs(a - b) <= 2),
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    rows, _ = _capture_rows(res)
+    assert sorted(r[0] for r in rows.values()) == [3, 4]
+
+
+def test_windowby_instance_separates_groups():
+    t = _times(
+        """
+        t | g | v
+        1 | a | 1
+        2 | a | 2
+        1 | b | 4
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5), instance=t.g
+    ).reduce(pw.this._pw_instance, s=pw.reducers.sum(pw.this.v))
+    rows, cols = _capture_rows(res)
+    got = sorted(
+        (r[cols.index("_pw_instance")], r[cols.index("s")])
+        for r in rows.values()
+    )
+    assert got == [("a", 3), ("b", 4)]
+
+
+def test_window_late_data_updates_result():
+    t = _times(
+        """
+        t | v | __time__
+        1 | 1 | 2
+        2 | 2 | 4
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5)
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    # late row at engine time 4 lands in the same window: final sum = 3
+    rows, _ = _capture_rows(res)
+    assert [r[0] for r in rows.values()] == [3]
+
+
+def test_window_cutoff_behavior_ignores_very_late_rows():
+    t = _times(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        20 | 5 | 4
+        2  | 9 | 20
+        """
+    )
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=5),
+        behavior=pw.temporal.common_behavior(cutoff=1),
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    rows, _ = _capture_rows(res)
+    # the t=2 row arrives after the first window's cutoff passed: dropped
+    assert sorted(r[0] for r in rows.values()) == [1, 5]
+
+
+def test_window_keep_results_false_forgets_closed_windows():
+    t = _times(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        50 | 5 | 40
+        """
+    )
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=5),
+        behavior=pw.temporal.common_behavior(cutoff=0, keep_results=False),
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    rows, _ = _capture_rows(res)
+    # the first window is forgotten once the frontier passes its cutoff
+    assert sorted(r[0] for r in rows.values()) == [5]
+
+
+# ------------------------------------------------------------------- asof
+def test_asof_join_takes_latest_at_or_before():
+    t1 = _times(
+        """
+        t | a
+        5 | x
+        """
+    )
+    t2 = _times(
+        """
+        t | b
+        1 | p
+        4 | q
+        6 | r
+        """
+    )
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | q
+            """
+        ),
+    )
+
+
+def test_asof_join_left_keeps_unmatched():
+    t1 = _times(
+        """
+        t | a
+        1 | x
+        """
+    )
+    t2 = _times(
+        """
+        t | b
+        5 | p
+        """
+    )
+    res = pw.temporal.asof_join_left(
+        t1, t2, t1.t, t2.t
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x |
+            """
+        ),
+    )
+
+
+def test_asof_join_with_key_condition():
+    t1 = _times(
+        """
+        t | k | a
+        5 | u | x
+        5 | v | y
+        """
+    )
+    t2 = _times(
+        """
+        t | k | b
+        3 | u | p
+        4 | v | q
+        """
+    )
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, t1.k == t2.k
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | p
+            y | q
+            """
+        ),
+    )
+
+
+def test_asof_join_update_shifts_match():
+    # a later-arriving closer right row retracts the earlier match
+    t1 = _times(
+        """
+        t | a | __time__
+        5 | x | 2
+        """
+    )
+    t2 = _times(
+        """
+        t | b | __time__
+        1 | p | 2
+        4 | q | 6
+        """
+    )
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | q
+            """
+        ),
+    )
+
+
+# ------------------------------------------------------------ window join
+def test_window_join_same_tumbling_window_pairs():
+    t1 = _times(
+        """
+        t | a
+        1 | x
+        6 | y
+        """
+    )
+    t2 = _times(
+        """
+        t | b
+        2 | p
+        3 | q
+        7 | r
+        """
+    )
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.tumbling(duration=5)
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | p
+            x | q
+            y | r
+            """
+        ),
+    )
+
+
+def test_diff_computes_deltas_in_time_order():
+    t = _times(
+        """
+        t | v
+        1 | 10
+        2 | 13
+        3 | 11
+        """
+    )
+    res = t.diff(pw.this.t, pw.this.v)
+    rows, cols = _capture_rows(res)
+    di = cols.index("diff_v")
+    got = sorted(r[di] for r in rows.values() if r[di] is not None)
+    assert got == [-2, 3]

@@ -1,0 +1,296 @@
+"""Universe reasoning + iterate edge cases (reference
+``test_universe_solver``-adjacent behaviors, ``update_cells`` universe
+errors, iterate with universe growth/shrink)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows, assert_table_equality_wo_index
+
+
+# ------------------------------------------------------------ update_cells
+def test_update_cells_disjoint_update_rejected_or_ignored():
+    base = T(
+        """
+          | a
+        1 | 10
+        """
+    )
+    upd = T(
+        """
+          | a
+        9 | 99
+        """
+    )
+    # an update over keys outside base's universe must not silently invent
+    # rows: either it raises at build time or the extra key never appears
+    try:
+        out = base.update_cells(upd.promise_universe_is_subset_of(base))
+        rows, _ = _capture_rows(out)
+        assert len(rows) == 1
+    except (ValueError, KeyError, AssertionError):
+        pass
+
+
+def test_update_cells_partial_columns():
+    base = T(
+        """
+          | a  | b
+        1 | 10 | x
+        2 | 20 | y
+        """
+    )
+    upd = T(
+        """
+          | a
+        2 | 99
+        """
+    )
+    out = base.update_cells(upd.promise_universe_is_subset_of(base))
+    assert_table_equality_wo_index(
+        out,
+        T(
+            """
+            a  | b
+            10 | x
+            99 | y
+            """
+        ),
+    )
+
+
+def test_update_rows_adds_new_keys():
+    base = T(
+        """
+          | a
+        1 | 10
+        """
+    )
+    upd = T(
+        """
+          | a
+        1 | 11
+        5 | 50
+        """
+    )
+    out = base.update_rows(upd)
+    assert_table_equality_wo_index(
+        out,
+        T(
+            """
+            a
+            11
+            50
+            """
+        ),
+    )
+
+
+def test_with_universe_of_reindexes():
+    base = T(
+        """
+          | a
+        1 | 10
+        2 | 20
+        """
+    )
+    other = T(
+        """
+          | b
+        1 | x
+        2 | y
+        """
+    )
+    out = other.with_universe_of(base)
+    rows_o, _ = _capture_rows(out)
+    rows_b, _ = _capture_rows(base)
+    assert set(rows_o) == set(rows_b)
+
+
+def test_restrict_to_subset_universe():
+    base = T(
+        """
+          | a
+        1 | 10
+        2 | 20
+        3 | 30
+        """
+    )
+    small = T(
+        """
+          | z
+        1 | p
+        3 | q
+        """
+    )
+    out = base.restrict(small.promise_universe_is_subset_of(base))
+    rows, _ = _capture_rows(out)
+    assert sorted(r[0] for r in rows.values()) == [10, 30]
+
+
+def test_intersect_and_difference():
+    t1 = T(
+        """
+          | a
+        1 | 10
+        2 | 20
+        """
+    )
+    t2 = T(
+        """
+          | b
+        2 | x
+        3 | y
+        """
+    )
+    inter = t1.intersect(t2)
+    rows, _ = _capture_rows(inter)
+    assert [r[0] for r in rows.values()] == [20]
+    diff = t1.difference(t2)
+    rows2, _ = _capture_rows(diff)
+    assert [r[0] for r in rows2.values()] == [10]
+
+
+def test_concat_reindex_disjoint_union():
+    t1 = T(
+        """
+        a
+        1
+        """
+    )
+    t2 = T(
+        """
+        a
+        2
+        """
+    )
+    out = t1.concat_reindex(t2)
+    rows, _ = _capture_rows(out)
+    assert sorted(r[0] for r in rows.values()) == [1, 2]
+
+
+# ----------------------------------------------------------------- iterate
+def test_iterate_collatz_total_stopping():
+    def logic(t):
+        return t.select(
+            n=pw.if_else(
+                t.n == 1,
+                t.n,
+                pw.if_else(t.n % 2 == 0, t.n // 2, 3 * t.n + 1),
+            )
+        )
+
+    t = T(
+        """
+        n
+        7
+        12
+        1
+        """
+    )
+    res = pw.iterate(logic, t=t)
+    rows, _ = _capture_rows(res.t if hasattr(res, "t") else res)
+    assert all(r[0] == 1 for r in rows.values())
+
+
+def test_iterate_with_limit_stops_early():
+    def logic(t):
+        return t.select(n=t.n + 1)
+
+    t = T(
+        """
+        n
+        0
+        """
+    )
+    res = pw.iterate(logic, iteration_limit=3, t=t)
+    rows, _ = _capture_rows(res.t if hasattr(res, "t") else res)
+    assert [r[0] for r in rows.values()] == [3]
+
+
+def test_iterate_universe_can_shrink():
+    # each round drops rows below the max: the fixpoint keeps only the max
+    def logic(t):
+        m = t.reduce(m=pw.reducers.max(t.n))
+        joined = t.join(m, t.n == m.m).select(t.n)
+        return joined.with_id_from(joined.n)
+
+    t0 = T(
+        """
+        n
+        1
+        5
+        3
+        """
+    )
+    res = pw.iterate(logic, t=t0.with_id_from(t0.n))
+    rows, _ = _capture_rows(res.t if hasattr(res, "t") else res)
+    assert [r[0] for r in rows.values()] == [5]
+
+
+def test_iterate_two_tables_converge_together():
+    def logic(a, b):
+        na = a.select(v=pw.if_else(a.v < 10, a.v + 1, a.v))
+        nb = b.select(v=pw.if_else(b.v > 0, b.v - 1, b.v))
+        return dict(a=na, b=nb)
+
+    a0 = T(
+        """
+        v
+        7
+        """
+    )
+    b0 = T(
+        """
+        v
+        2
+        """
+    )
+    res = pw.iterate(logic, a=a0, b=b0)
+    ra, _ = _capture_rows(res.a)
+    rb, _ = _capture_rows(res.b)
+    assert [r[0] for r in ra.values()] == [10]
+    assert [r[0] for r in rb.values()] == [0]
+
+
+# ------------------------------------------------------------ flatten etc
+def test_flatten_preserves_origin_association():
+    t = T(
+        """
+        k | n
+        a | 2
+        b | 1
+        """
+    )
+    t2 = t.select(t.k, parts=pw.apply_with_type(
+        lambda n: tuple(range(n)), tuple, t.n
+    ))
+    flat = t2.flatten(t2.parts)
+    rows, cols = _capture_rows(flat)
+    got = sorted(
+        (r[cols.index("k")], r[cols.index("parts")]) for r in rows.values()
+    )
+    assert got == [("a", 0), ("a", 1), ("b", 0)]
+
+
+def test_groupby_after_reindex_consistent():
+    t = T(
+        """
+        g | v
+        a | 1
+        a | 2
+        b | 3
+        """
+    )
+    re = t.with_id_from(t.g, t.v)
+    res = re.groupby(re.g).reduce(re.g, s=pw.reducers.sum(re.v))
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            g | s
+            a | 3
+            b | 3
+            """
+        ),
+    )
