@@ -202,7 +202,15 @@ class Scheduler:
             if t is None or (global_frontier is not None
                              and t >= global_frontier):
                 if any(s[2] or s[3] for s in states.values()) or times:
-                    time.sleep(0.02)
+                    # wait for LOCAL progress (inject/advance notify the
+                    # condition) instead of a flat poll — a new local event
+                    # starts the next control round immediately, so commit
+                    # latency is bounded by peers' wait timeout, not by a
+                    # fixed sleep on every hop (reference parks on channels,
+                    # dataflow.rs:5595-5648)
+                    with self._lock:
+                        if not self._stopped:
+                            self._lock.wait(timeout=0.02)
                     continue
                 return
             with self._lock:

@@ -101,7 +101,6 @@ class JoinResult:
         for col in self._left.column_names():
             if (
                 col.startswith(("__jl_", "__jr_"))
-                and not col.endswith("_id")
                 and self._demangle(col) == name
             ):
                 return col
@@ -140,9 +139,9 @@ class JoinResult:
         exprs: dict[str, ColumnReference] = {}
         for n in self._left.column_names():
             if self._aliases and n.startswith(("__jl_", "__jr_")):
-                if n.endswith("_id"):
-                    continue  # internal id columns never leak
                 out = self._demangle(n)
+                if out == "id":
+                    continue  # internal id columns never leak
                 if out not in exprs:
                     exprs[out] = ColumnReference(thisclass.left, n)
             else:
@@ -180,6 +179,20 @@ class JoinResult:
                 entry = amap.get(builtins_id(t)) if t is not None else None
                 if entry is not None:
                     return base[entry[1](x._name)]
+                if t is thisclass.left or t is thisclass.this:
+                    # pw.left/pw.this in a chained ON condition refer to the
+                    # chain's left side (= the materialized base) by
+                    # ORIGINAL column name
+                    for cand in (f"__jl_{x._name}", f"__jr_{x._name}"):
+                        if cand in base.column_names():
+                            return base[cand]
+                    for col in base.column_names():
+                        if (
+                            col.startswith(("__jl_", "__jr_"))
+                            and self._demangle(col) == x._name
+                            and x._name != "id"
+                        ):
+                            return base[col]
                 return x
             if isinstance(x, ColumnExpression):
                 return expr_mod.map_child_expressions(x, rw)
@@ -293,24 +306,40 @@ class JoinResult:
     def _expand_select_args(self, args) -> dict[str, ColumnExpression]:
         exprs: dict[str, ColumnExpression] = {}
         left, right = self._left, self._right
+        # chained joins: star expansion demangles the base's prefixed
+        # columns back to original names (and never leaks internal ids)
+        out_cols = self._output_columns() if self._aliases else None
         for a in args:
+            if a is thisclass.this or a is thisclass.left or a is thisclass.right:
+                # bare pw.this / pw.left / pw.right = all columns of that side
+                a = thisclass._StarMarker(a, excluded=())
             if isinstance(a, thisclass._StarMarker):
                 src = a.placeholder
                 if src is thisclass.left:
-                    for n in left.column_names():
-                        if n not in a.excluded:
-                            exprs[n] = ColumnReference(thisclass.left, n)
+                    if out_cols is not None:
+                        for n, ref in out_cols.items():
+                            if ref._table is thisclass.left and n not in a.excluded:
+                                exprs[n] = ref
+                    else:
+                        for n in left.column_names():
+                            if n not in a.excluded:
+                                exprs[n] = ColumnReference(thisclass.left, n)
                 elif src is thisclass.right:
                     for n in right.column_names():
                         if n not in a.excluded:
                             exprs[n] = ColumnReference(thisclass.right, n)
                 else:  # pw.this in a join select: all columns from both
-                    for n in left.column_names():
-                        if n not in a.excluded:
-                            exprs[n] = ColumnReference(thisclass.left, n)
-                    for n in right.column_names():
-                        if n not in a.excluded and n not in exprs:
-                            exprs[n] = ColumnReference(thisclass.right, n)
+                    if out_cols is not None:
+                        for n, ref in out_cols.items():
+                            if n not in a.excluded:
+                                exprs[n] = ref
+                    else:
+                        for n in left.column_names():
+                            if n not in a.excluded:
+                                exprs[n] = ColumnReference(thisclass.left, n)
+                        for n in right.column_names():
+                            if n not in a.excluded and n not in exprs:
+                                exprs[n] = ColumnReference(thisclass.right, n)
             elif isinstance(a, thisclass._WithoutHelper):
                 exprs.update(self._expand_select_args(list(a)))
             elif isinstance(a, ColumnReference):

@@ -282,13 +282,8 @@ class WindowGroupedTable:
             tagged._pw_window,
             sort_by=None,
         )
-        # substitute special refs in reduce args
-        sub_map_extra = {
-            "_pw_window": tagged._pw_window,
-            "_pw_window_start": tagged._pw_window_start,
-            "_pw_window_end": tagged._pw_window_end,
-            "_pw_instance": None,
-        }
+        # substitute special refs in reduce args (_window_meta_rewrite maps
+        # the _pw_* meta columns to any(...) reducers)
         new_kwargs = {}
         from pathway_tpu.internals import reducers as red_mod
 

@@ -688,3 +688,93 @@ def test_chained_join_with_instances_rewrites():
             """
         ),
     )
+
+
+def test_chained_join_user_id_suffix_column_survives():
+    # regression: user columns ending in _id must not be dropped by the
+    # internal-id filter on chained joins
+    t1 = T(
+        """
+        user_id | k
+        7       | x
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        1 | x
+        """
+    )
+    t3 = T(
+        """
+        c | k
+        2 | x
+        """
+    )
+    out = (
+        t1.join(t2, t1.k == t2.k).join(t3, t1.k == t3.k).filter(t1.user_id == 7)
+    )
+    rows, cols = _capture_rows(out)
+    assert "user_id" in cols
+    assert len(rows) == 1
+
+
+def test_chained_join_pw_left_in_on_condition():
+    t1 = T(
+        """
+        a | k
+        1 | x
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        5 | x
+        """
+    )
+    t3 = T(
+        """
+        c | a2
+        9 | 1
+        """
+    )
+    res = (
+        t1.join(t2, t1.k == t2.k)
+        .join(t3, pw.left.a == pw.right.a2)
+        .select(pw.this.a, pw.this.b, pw.this.c)
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b | c
+            1 | 5 | 9
+            """
+        ),
+    )
+
+
+def test_chained_join_star_select_demangles():
+    t1 = T(
+        """
+        a | k
+        1 | x
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        5 | x
+        """
+    )
+    t3 = T(
+        """
+        c | k
+        9 | x
+        """
+    )
+    res = t1.join(t2, t1.k == t2.k).join(t3, t1.k == t3.k).select(pw.this)
+    rows, cols = _capture_rows(res)
+    assert not any(c.startswith("__j") for c in cols)
+    assert {"a", "b", "c", "k"} <= set(cols)
+    assert len(rows) == 1

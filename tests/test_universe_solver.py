@@ -1,0 +1,101 @@
+"""SAT-backed universe solver entailments (reference
+``universe_solver.py`` semantics, e.g. union-of-disjoint-covering
+equality) — the cases the round-1 transitive-closure solver could not
+derive."""
+
+from pathway_tpu.internals.universe import Universe, UniverseSolver
+
+
+def test_transitive_subset():
+    s = UniverseSolver()
+    a, b, c = Universe(), Universe(), Universe()
+    s.register_as_subset(a, b)
+    s.register_as_subset(b, c)
+    assert s.query_is_subset(a, c)
+    assert not s.query_is_subset(c, a)
+
+
+def test_equality_via_mutual_subset():
+    s = UniverseSolver()
+    a, b = Universe(), Universe()
+    s.register_as_subset(a, b)
+    s.register_as_subset(b, a)
+    assert s.query_are_equal(a, b)
+
+
+def test_union_of_covering_subsets_equals_whole():
+    # U = A ∪ B with A,B ⊆ U: union(A, B) must be PROVABLY equal to U
+    s = UniverseSolver()
+    u, a, b = Universe(), Universe(), Universe()
+    s.register_as_subset(a, u)
+    s.register_as_subset(b, u)
+    w = Universe()
+    s.register_as_union(w, a, b)
+    # w ⊆ u follows; u ⊆ w requires the union clause (x∈w => x∈a ∨ x∈b is
+    # the wrong direction; u ⊆ w needs u => a∨b which is NOT derivable)
+    assert s.query_is_subset(w, u)
+    assert not s.query_are_equal(w, u)
+    # but if u itself was built as the union, equality holds
+    u2 = Universe()
+    s.register_as_union(u2, a, b)
+    assert s.query_are_equal(w, u2)
+
+
+def test_difference_disjoint_from_subtrahend():
+    s = UniverseSolver()
+    a, b = Universe(), Universe()
+    d = s.get_difference(a, b)
+    assert s.query_is_subset(d, a)
+    assert s.query_are_disjoint(d, b)
+
+
+def test_difference_plus_intersection_covers_left():
+    # A = (A - B) ∪ (A ∩ B): the SAT encoding entails both directions
+    s = UniverseSolver()
+    a, b = Universe(), Universe()
+    d = s.get_difference(a, b)
+    i = Universe()
+    s.register_as_intersection(i, a, b)
+    u = Universe()
+    s.register_as_union(u, d, i)
+    assert s.query_are_equal(u, a)
+
+
+def test_disjoint_entailment_through_subsets():
+    s = UniverseSolver()
+    a, b = Universe(), Universe()
+    s.register_as_disjoint(a, b)
+    sa = s.get_subset(a)
+    sb = s.get_subset(b)
+    assert s.query_are_disjoint(sa, sb)
+
+
+def test_intersection_of_disjoint_is_empty_subset_of_anything():
+    s = UniverseSolver()
+    a, b, z = Universe(), Universe(), Universe()
+    s.register_as_disjoint(a, b)
+    i = Universe()
+    s.register_as_intersection(i, a, b)
+    # x ∈ i is contradictory, so i ⊆ anything
+    assert s.query_is_subset(i, z)
+
+
+def test_unrelated_universes_not_subset():
+    s = UniverseSolver()
+    a, b = Universe(), Universe()
+    assert not s.query_is_subset(a, b)
+    assert not s.query_are_equal(a, b)
+
+
+def test_intersection_reuse_when_already_subset():
+    s = UniverseSolver()
+    a = Universe()
+    sub = s.get_subset(a)
+    assert s.get_intersection(sub, a) is sub
+
+
+def test_union_reuse_when_already_superset():
+    s = UniverseSolver()
+    a = Universe()
+    sup = s.get_superset(a)
+    assert s.get_union(a, sup) is sup
