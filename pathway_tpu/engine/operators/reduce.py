@@ -128,7 +128,10 @@ class GroupbyNode(Node):
             grp["count"] += diff
             for acc, idxs in zip(grp["accs"], ridx):
                 args = tuple(row[i] for i in idxs)
-                acc.add(args, diff, time)
+                if getattr(acc, "wants_key", False):
+                    acc.add(args, diff, time, key)
+                else:
+                    acc.add(args, diff, time)
             affected.add(gk)
         return affected
 

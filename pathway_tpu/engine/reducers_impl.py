@@ -273,40 +273,84 @@ class NdarrayAcc(TupleAcc):
 
 
 class EarliestAcc(Accumulator):
-    """Tracks each (time, value) arrival as its own multiset entry, so
-    re-insertion of a seen value at a later time is ordered correctly."""
+    """Earliest/latest need to know WHICH insertion a retraction cancels;
+    value-based matching guesses wrong whenever duplicates were inserted at
+    different times (FIFO eviction retracts the OLD copy). The groupby
+    passes each row's engine key (``wants_key``), and entries are kept per
+    row key, so a retraction cancels exactly its row's insertion time."""
 
-    __slots__ = ("_entries",)
+    wants_key = True
+
+    __slots__ = ("_by_key", "_live")
 
     def __init__(self):
-        self._entries: dict[Any, list] = {}  # (time, hashable) -> [args, t, count]
+        # row key -> list of [args, insert_time, count]
+        self._by_key: dict[Any, list[list]] = {}
+        self._live = 0
 
-    def add(self, args, diff, time):
-        hk = (time, _hashable(args))
-        entry = self._entries.get(hk)
-        if entry is None:
-            entry = [args, time, 0]
-            self._entries[hk] = entry
-        entry[2] += diff
-        if entry[2] == 0:
-            del self._entries[hk]
+    def add(self, args, diff, time, key=None):
+        lst = self._by_key.setdefault(key, [])
+        self._live += diff
+        if diff > 0:
+            remaining = diff
+            h = _hashable(args)
+            # settle out-of-order retraction debt first
+            for e in lst:
+                if remaining == 0:
+                    break
+                if e[2] < 0 and _hashable(e[0]) == h:
+                    take = min(remaining, -e[2])
+                    e[2] += take
+                    remaining -= take
+            if remaining:
+                for e in lst:
+                    if e[1] == time and e[2] > 0 and _hashable(e[0]) == h:
+                        e[2] += remaining
+                        break
+                else:
+                    lst.append([args, time, remaining])
+            self._by_key[key] = [e for e in lst if e[2] != 0]
+            if not self._by_key[key]:
+                del self._by_key[key]
+            return
+        # retraction: cancel this row key's matching-value entries (oldest
+        # first), one multiplicity unit at a time (consolidate may sum
+        # several retractions into one diff)
+        remaining = -diff
+        h = _hashable(args)
+        for e in sorted(lst, key=lambda e: e[1]):
+            if remaining == 0:
+                break
+            if e[2] > 0 and _hashable(e[0]) == h:
+                take = min(remaining, e[2])
+                e[2] -= take
+                remaining -= take
+        if remaining:
+            # out-of-order retraction (deletion seen before its insertion):
+            # record the debt; a later insertion with matching value cancels
+            lst.append([args, time, -remaining])
+        self._by_key[key] = [e for e in lst if e[2] != 0]
+        if not self._by_key[key]:
+            del self._by_key[key]
 
     def is_empty(self):
-        return not self._entries
+        return self._live <= 0
+
+    def _best(self, select):
+        live = [
+            e for lst in self._by_key.values() for e in lst if e[2] > 0
+        ]
+        if not live:
+            return ERROR
+        return select(live, key=lambda e: e[1])[0][0]
 
     def compute(self):
-        if not self._entries:
-            return ERROR
-        best = min(self._entries.values(), key=lambda e: e[1])
-        return best[0][0]
+        return self._best(min)
 
 
 class LatestAcc(EarliestAcc):
     def compute(self):
-        if not self._entries:
-            return ERROR
-        best = max(self._entries.values(), key=lambda e: e[1])
-        return best[0][0]
+        return self._best(max)
 
 
 class StatefulAcc(Accumulator):

@@ -692,9 +692,14 @@ class Table(Joinable):
         return Table(node, schema, query_table._universe)
 
     def _gradual_broadcast(self, threshold_table, lower, value, upper) -> "Table":
-        # LSH bucketer support (reference table.py:631) — approximation:
-        # broadcast the single-row apx value to all rows via cross join
-        from pathway_tpu.engine.operators.join import JoinNode
+        """LSH bucketer support (reference table.py:631): every row carries
+        an ``apx_value`` that updates ONLY when the threshold band moves
+        past the row's assigned value — small band movements touch nothing
+        (reference gradual_broadcast.rs:65), unlike a cross-join broadcast
+        which would retract the whole table per update."""
+        from pathway_tpu.engine.operators.gradual_broadcast import (
+            GradualBroadcastNode,
+        )
 
         lower = threshold_table._desugar(expr_mod.smart_coerce(lower))
         value = threshold_table._desugar(expr_mod.smart_coerce(value))
@@ -703,34 +708,16 @@ class Table(Joinable):
             threshold_table, {"__l__": lower, "__v__": value, "__u__": upper}
         )
         tnode = core_ops.RowwiseNode(G.engine_graph, env_node, rw)
-        # attach constant join keys on both sides
         left_env, left_rw = _prepare_env(
             self, {n: ColumnReference(self, n) for n in self.column_names()}
         )
-        left_prep = core_ops.RowwiseNode(
-            G.engine_graph,
-            left_env,
-            {**left_rw, "__jk__": expr_mod.ColumnConstExpression(0)},
+        left_prep = core_ops.RowwiseNode(G.engine_graph, left_env, left_rw)
+        node = GradualBroadcastNode(G.engine_graph, left_prep, tnode)
+        # reference `_gradual_broadcast` returns self + apx_value (same
+        # universe); the node output already carries all input columns
+        schema = self._schema | schema_mod.schema_from_types(
+            apx_value=dt.Optional(dt.FLOAT)
         )
-        right_prep = core_ops.RowwiseNode(
-            G.engine_graph,
-            tnode,
-            {
-                "__v__": ColumnReference(None, "__v__"),
-                "__jk__": expr_mod.ColumnConstExpression(0),
-            },
-        )
-        node = JoinNode(
-            G.engine_graph,
-            left_prep,
-            right_prep,
-            ["__jk__"],
-            ["__jk__"],
-            "left",
-            [("apx_value", "right", "__v__")],
-            key_mode="left",
-        )
-        schema = schema_mod.schema_from_types(apx_value=dt.Optional(dt.FLOAT))
         return Table(node, schema, self._universe)
 
     # ------------------------------------------------------------------ misc

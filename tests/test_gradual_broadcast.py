@@ -1,0 +1,118 @@
+"""Gradual broadcast operator (reference gradual_broadcast.rs:65): rows
+keep their assigned apx value while it stays inside the threshold band —
+small band movements must NOT retract the table."""
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows, run_all_and_collect
+
+
+def test_gradual_broadcast_attaches_value():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    thr = T(
+        """
+        l   | v   | u
+        0.0 | 5.0 | 10.0
+        """
+    )
+    out = t._gradual_broadcast(thr, thr.l, thr.v, thr.u)
+    rows, cols = _capture_rows(out)
+    assert all(r[cols.index("apx_value")] == 5.0 for r in rows.values())
+    assert len(rows) == 2
+
+
+def test_gradual_broadcast_small_move_touches_nothing():
+    t = T(
+        """
+        a | __time__
+        1 | 2
+        2 | 2
+        """
+    )
+    thr = T(
+        """
+        l   | v   | u    | __time__ | __diff__
+        0.0 | 5.0 | 10.0 | 2        | 1
+        0.0 | 5.0 | 10.0 | 4        | -1
+        1.0 | 6.0 | 11.0 | 4        | 1
+        """
+    )
+    out = t._gradual_broadcast(thr, thr.l, thr.v, thr.u)
+    updates = run_all_and_collect(out)
+    # rows assigned 5.0 at time 2; the band moves to [1, 11] at time 4 and
+    # 5.0 is still inside: NO retraction/update traffic after time 2
+    later = [u for u in updates if u[0] > 2]
+    assert later == [], later
+    rows, cols = _capture_rows(out)
+    assert all(r[cols.index("apx_value")] == 5.0 for r in rows.values())
+
+
+def test_gradual_broadcast_band_escape_updates_rows():
+    t = T(
+        """
+        a | __time__
+        1 | 2
+        """
+    )
+    thr = T(
+        """
+        l    | v    | u    | __time__ | __diff__
+        0.0  | 5.0  | 10.0 | 2        | 1
+        0.0  | 5.0  | 10.0 | 4        | -1
+        20.0 | 25.0 | 30.0 | 4        | 1
+        """
+    )
+    out = t._gradual_broadcast(thr, thr.l, thr.v, thr.u)
+    rows, cols = _capture_rows(out)
+    # 5.0 left the band: the row updates to the new value
+    assert [r[cols.index("apx_value")] for r in rows.values()] == [25.0]
+
+
+def test_gradual_broadcast_new_rows_get_current_value():
+    t = T(
+        """
+        a | __time__
+        1 | 2
+        2 | 6
+        """
+    )
+    thr = T(
+        """
+        l    | v    | u    | __time__ | __diff__
+        0.0  | 5.0  | 10.0 | 2        | 1
+        0.0  | 5.0  | 10.0 | 4        | -1
+        2.0  | 7.0  | 12.0 | 4        | 1
+        """
+    )
+    out = t._gradual_broadcast(thr, thr.l, thr.v, thr.u)
+    rows, cols = _capture_rows(out)
+    ai = cols.index("a")
+    vi = cols.index("apx_value")
+    by_a = {r[ai]: r[vi] for r in rows.values()}
+    # old row keeps 5.0 (inside [2,12]); the later row gets the current 7.0
+    assert by_a == {1: 5.0, 2: 7.0}
+
+
+def test_gradual_broadcast_row_deletion_retracts():
+    t = T(
+        """
+        a | __time__ | __diff__
+        1 | 2        | 1
+        2 | 2        | 1
+        1 | 4        | -1
+        """
+    )
+    thr = T(
+        """
+        l   | v   | u
+        0.0 | 5.0 | 10.0
+        """
+    )
+    out = t._gradual_broadcast(thr, thr.l, thr.v, thr.u)
+    rows, cols = _capture_rows(out)
+    assert len(rows) == 1

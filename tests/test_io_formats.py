@@ -1,0 +1,301 @@
+"""Connector format matrix — csv settings, plaintext/binary, metadata,
+schema coercion, bad-input tolerance, write formats (reference
+``io/fs`` + parser tests)."""
+
+import json
+
+import pathway_tpu as pw
+from tests.utils import _capture_rows
+
+
+class WordSchema(pw.Schema):
+    word: str
+
+
+class WN(pw.Schema):
+    word: str
+    n: int
+
+
+def _static(tmp_path, fname, content, **kw):
+    mode = "wb" if isinstance(content, bytes) else "w"
+    with open(tmp_path / fname, mode) as f:
+        f.write(content)
+    return pw.io.fs.read(str(tmp_path), mode="static", **kw)
+
+
+def test_csv_custom_delimiter(tmp_path):
+    from pathway_tpu.io._utils import CsvParserSettings
+
+    t = _static(
+        tmp_path, "a.csv", "word;n\ncat;1\n",
+        format="csv", schema=WN,
+        csv_settings=CsvParserSettings(delimiter=";"),
+    )
+    rows, cols = _capture_rows(t)
+    (row,) = rows.values()
+    assert row[cols.index("word")] == "cat" and row[cols.index("n")] == 1
+
+
+def test_csv_quoted_fields_with_delimiter_inside(tmp_path):
+    t = _static(
+        tmp_path, "a.csv", 'word,n\n"a,b",2\n', format="csv", schema=WN
+    )
+    rows, cols = _capture_rows(t)
+    (row,) = rows.values()
+    assert row[cols.index("word")] == "a,b"
+
+
+def test_csv_missing_column_uses_default(tmp_path):
+    class S(pw.Schema):
+        word: str
+        n: int = pw.column_definition(default_value=7)
+
+    t = _static(tmp_path, "a.csv", "word\ncat\n", format="csv", schema=S)
+    rows, cols = _capture_rows(t)
+    (row,) = rows.values()
+    assert row[cols.index("n")] == 7
+
+
+def test_jsonlines_skips_bad_lines(tmp_path):
+    t = _static(
+        tmp_path, "a.jsonl",
+        '{"word": "ok"}\nnot json at all\n{"word": "also"}\n',
+        format="json", schema=WordSchema,
+    )
+    rows, _ = _capture_rows(t)
+    assert sorted(r[0] for r in rows.values()) == ["also", "ok"]
+
+
+def test_jsonlines_type_coercion_from_strings(tmp_path):
+    t = _static(
+        tmp_path, "a.jsonl", '{"word": "x", "n": "42"}\n',
+        format="json", schema=WN,
+    )
+    rows, cols = _capture_rows(t)
+    (row,) = rows.values()
+    assert row[cols.index("n")] == 42
+
+
+def test_plaintext_one_row_per_line(tmp_path):
+    t = _static(tmp_path, "a.txt", "alpha\nbeta\n", format="plaintext")
+    rows, _ = _capture_rows(t)
+    assert sorted(r[0] for r in rows.values()) == ["alpha", "beta"]
+
+
+def test_plaintext_by_file_one_row_per_file(tmp_path):
+    t = _static(
+        tmp_path, "a.txt", "alpha\nbeta\n", format="plaintext_by_file"
+    )
+    rows, _ = _capture_rows(t)
+    (row,) = rows.values()
+    assert row[0] == "alpha\nbeta\n"
+
+
+def test_binary_reads_bytes(tmp_path):
+    t = _static(tmp_path, "a.bin", b"\x00\x01\x02", format="binary")
+    rows, _ = _capture_rows(t)
+    (row,) = rows.values()
+    assert row[0] == b"\x00\x01\x02"
+
+
+def test_with_metadata_attaches_path(tmp_path):
+    t = _static(
+        tmp_path, "a.jsonl", '{"word": "x"}\n',
+        format="json", schema=WordSchema, with_metadata=True,
+    )
+    rows, cols = _capture_rows(t)
+    (row,) = rows.values()
+    meta = row[cols.index("_metadata")]
+    obj = json.loads(str(meta))
+    assert obj["path"].endswith("a.jsonl")
+    assert obj["size"] > 0
+
+
+def test_primary_key_upsert_across_files(tmp_path):
+    class S(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    (tmp_path / "a.jsonl").write_text('{"k": "x", "v": 1}\n')
+    (tmp_path / "b.jsonl").write_text('{"k": "x", "v": 2}\n')
+    t = pw.io.jsonlines.read(str(tmp_path), schema=S, mode="static")
+    rows, cols = _capture_rows(t)
+    # one row per key: the later file's version wins
+    assert len(rows) == 1
+    (row,) = rows.values()
+    assert row[cols.index("v")] == 2
+
+
+def test_write_csv_roundtrip(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "a.jsonl").write_text('{"word": "cat", "n": 1}\n')
+    t = pw.io.jsonlines.read(str(src), schema=WN, mode="static")
+    out = tmp_path / "out.csv"
+    pw.io.csv.write(t, str(out))
+    pw.run()
+    content = out.read_text()
+    assert "cat" in content and "word" in content.splitlines()[0]
+
+
+def test_write_jsonlines_includes_time_and_diff(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "a.jsonl").write_text('{"word": "cat"}\n')
+    t = pw.io.jsonlines.read(str(src), schema=WordSchema, mode="static")
+    out = tmp_path / "out.jsonl"
+    pw.io.jsonlines.write(t, str(out))
+    pw.run()
+    rec = json.loads(out.read_text().splitlines()[0])
+    assert rec["word"] == "cat" and rec["diff"] == 1 and "time" in rec
+
+
+def test_subscribe_sees_additions_in_diff_order(tmp_path):
+    t = pw.debug.table_from_markdown(
+        """
+        v | __time__ | __diff__
+        1 | 2        | 1
+        1 | 4        | -1
+        2 | 4        | 1
+        """
+    )
+    events = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (row["v"], is_addition)
+        ),
+    )
+    pw.run()
+    assert (1, True) in events and (1, False) in events and (2, True) in events
+    assert events.index((1, True)) < events.index((1, False))
+
+
+def test_null_write_consumes_stream():
+    t = pw.debug.table_from_markdown(
+        """
+        v
+        1
+        """
+    )
+    pw.io.null.write(t)
+    pw.run()  # must not raise
+
+
+def test_python_connector_subject_types(tmp_path):
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next_json({"word": "a", "n": 1})
+            self.next_json({"word": "b", "n": 2})
+
+    t = pw.io.python.read(Subj(), schema=WN)
+    seen = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: seen.append(row)
+    )
+    import threading
+    import time as time_mod
+
+    conns = list(pw.G.connectors)
+
+    def stop():
+        deadline = time_mod.time() + 20
+        while time_mod.time() < deadline and len(seen) < 2:
+            time_mod.sleep(0.02)
+        for c in conns:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stop, daemon=True).start()
+    pw.run()
+    assert sorted((r["word"], r["n"]) for r in seen) == [("a", 1), ("b", 2)]
+
+
+def test_demo_range_stream_bounded():
+    t = pw.demo.range_stream(nb_rows=5, input_rate=100.0)
+    seen = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: seen.append(row)
+    )
+    import threading
+    import time as time_mod
+
+    conns = list(pw.G.connectors)
+
+    def stop():
+        deadline = time_mod.time() + 30
+        while time_mod.time() < deadline and len(seen) < 5:
+            time_mod.sleep(0.02)
+        for c in conns:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stop, daemon=True).start()
+    pw.run()
+    assert len(seen) >= 5
+
+
+def test_csv_read_streaming_picks_up_appended_file(tmp_path):
+    import threading
+    import time as time_mod
+
+    (tmp_path / "a.csv").write_text("word,n\ncat,1\n")
+    t = pw.io.csv.read(
+        str(tmp_path), schema=WN, mode="streaming", refresh_interval=0.05
+    )
+    seen = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: seen.append(row)
+    )
+
+    conns = list(pw.G.connectors)
+
+    def feed():
+        deadline = time_mod.time() + 20
+        while time_mod.time() < deadline and len(seen) < 1:
+            time_mod.sleep(0.02)
+        (tmp_path / "b.csv").write_text("word,n\ndog,2\n")
+        while time_mod.time() < deadline and len(seen) < 2:
+            time_mod.sleep(0.02)
+        for c in conns:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=feed, daemon=True).start()
+    pw.run()
+    assert sorted(r["word"] for r in seen) == ["cat", "dog"]
+
+
+def test_sqlite_read(tmp_path):
+    import sqlite3
+
+    db = tmp_path / "x.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE words (word TEXT, n INTEGER)")
+    conn.execute("INSERT INTO words VALUES ('cat', 1), ('dog', 2)")
+    conn.commit()
+    conn.close()
+
+    t = pw.io.sqlite.read(str(db), "words", schema=WN, mode="static")
+    rows, cols = _capture_rows(t)
+    got = sorted(
+        (r[cols.index("word")], r[cols.index("n")]) for r in rows.values()
+    )
+    assert got == [("cat", 1), ("dog", 2)]
+
+
+def test_fs_empty_dir_yields_empty_table(tmp_path):
+    t = pw.io.jsonlines.read(str(tmp_path), schema=WordSchema, mode="static")
+    rows, _ = _capture_rows(t)
+    assert rows == {}
+
+
+def test_debug_table_to_pandas_roundtrip():
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [1, 2], "b": ["x", "y"]})
+    t = pw.debug.table_from_pandas(df)
+    back = pw.debug.table_to_pandas(t)
+    assert sorted(back["a"].tolist()) == [1, 2]
+    assert sorted(back["b"].tolist()) == ["x", "y"]

@@ -114,11 +114,15 @@ def stub_confluent(monkeypatch):
 
 
 def _stop_when(predicate, timeout=30):
+    # capture the CURRENT graph's connectors: a daemon stopper outliving its
+    # test must not stop the next test's connectors via the global graph
+    conns = list(pw.G.connectors)
+
     def stopper():
         deadline = time.time() + timeout
         while time.time() < deadline and not predicate():
             time.sleep(0.02)
-        for c in pw.G.connectors:
+        for c in conns:
             c._stop.set()
             c.close()
 

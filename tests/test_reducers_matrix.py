@@ -1,0 +1,411 @@
+"""Reducer semantics matrix — every reducer kind under insertion,
+retraction, None handling, and ERROR values (reference ``test_reducers.py``
++ ``src/engine/reduce.rs`` Reducer enum)."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows
+
+
+def _vals(res, col="r"):
+    rows, cols = _capture_rows(res)
+    i = cols.index(col)
+    return sorted(
+        (r[i] if not isinstance(r[i], tuple) else tuple(r[i]))
+        for r in rows.values()
+    )
+
+
+def _single_group(markdown):
+    return T(markdown)
+
+
+def test_sum_int_retraction():
+    t = T(
+        """
+        g | v | __time__ | __diff__
+        a | 5 | 2        | 1
+        a | 3 | 2        | 1
+        a | 5 | 4        | -1
+        """
+    )
+    res = t.groupby(t.g).reduce(r=pw.reducers.sum(t.v))
+    assert _vals(res) == [3]
+
+
+def test_sum_float_accumulates():
+    t = T(
+        """
+        g | v
+        a | 1.5
+        a | 2.25
+        """
+    )
+    res = t.groupby(t.g).reduce(r=pw.reducers.sum(t.v))
+    assert _vals(res) == [3.75]
+
+
+def test_min_max_with_retraction_of_extreme():
+    t = T(
+        """
+        g | v | __time__ | __diff__
+        a | 9 | 2        | 1
+        a | 4 | 2        | 1
+        a | 9 | 4        | -1
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        lo=pw.reducers.min(t.v), hi=pw.reducers.max(t.v)
+    )
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("lo")] == 4 and row[cols.index("hi")] == 4
+
+
+def test_argmin_argmax_return_row_keys():
+    t = T(
+        """
+        g | v
+        a | 3
+        a | 1
+        a | 7
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        am=pw.reducers.argmin(t.v), ax=pw.reducers.argmax(t.v)
+    )
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    trows, tcols = _capture_rows(t)
+    vi = tcols.index("v")
+    am_key = row[cols.index("am")]
+    ax_key = row[cols.index("ax")]
+    am_v = trows[am_key.value if hasattr(am_key, "value") else am_key][vi]
+    ax_v = trows[ax_key.value if hasattr(ax_key, "value") else ax_key][vi]
+    assert am_v == 1 and ax_v == 7
+
+
+def test_avg_is_mean():
+    t = T(
+        """
+        g | v
+        a | 2
+        a | 4
+        """
+    )
+    res = t.groupby(t.g).reduce(r=pw.reducers.avg(t.v))
+    assert _vals(res) == [3.0]
+
+
+def test_unique_single_value_ok():
+    t = T(
+        """
+        g | v
+        a | 7
+        a | 7
+        """
+    )
+    res = t.groupby(t.g).reduce(r=pw.reducers.unique(t.v))
+    assert _vals(res) == [7]
+
+
+def test_unique_conflict_is_error():
+    t = T(
+        """
+        g | v
+        a | 7
+        a | 8
+        """
+    )
+    res = t.groupby(t.g).reduce(r=pw.fill_error(pw.reducers.unique(t.v), -1))
+    assert _vals(res) == [-1]
+
+
+def test_any_picks_some_member():
+    t = T(
+        """
+        g | v
+        a | 7
+        a | 8
+        """
+    )
+    res = t.groupby(t.g).reduce(r=pw.reducers.any(t.v))
+    assert _vals(res)[0] in (7, 8)
+
+
+def test_sorted_tuple_orders_values():
+    t = T(
+        """
+        g | v
+        a | 3
+        a | 1
+        a | 2
+        """
+    )
+    res = t.groupby(t.g).reduce(r=pw.reducers.sorted_tuple(t.v))
+    assert _vals(res) == [(1, 2, 3)]
+
+
+def test_sorted_tuple_skip_nones():
+    t = T(
+        """
+        g | v
+        a | 3
+        a |
+        a | 1
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        r=pw.reducers.sorted_tuple(t.v, skip_nones=True)
+    )
+    assert _vals(res) == [(1, 3)]
+
+
+def test_tuple_preserves_arrival_order_within_epoch():
+    t = T(
+        """
+        g | v | __time__
+        a | 5 | 2
+        a | 7 | 4
+        """
+    )
+    res = t.groupby(t.g).reduce(r=pw.reducers.tuple(t.v))
+    assert _vals(res) == [(5, 7)]
+
+
+def test_count_no_args_counts_rows():
+    t = T(
+        """
+        g
+        a
+        a
+        b
+        """
+    )
+    res = t.groupby(t.g).reduce(t.g, r=pw.reducers.count())
+    rows, cols = _capture_rows(res)
+    got = sorted((r[cols.index("g")], r[cols.index("r")]) for r in rows.values())
+    assert got == [("a", 2), ("b", 1)]
+
+
+def test_ndarray_reducer_collects_numeric():
+    t = T(
+        """
+        g | v
+        a | 1
+        a | 2
+        """
+    )
+    res = t.groupby(t.g).reduce(r=pw.reducers.ndarray(t.v))
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert sorted(row[cols.index("r")].tolist()) == [1, 2]
+
+
+def test_earliest_latest_follow_engine_time():
+    t = T(
+        """
+        g | v | __time__
+        a | 1 | 2
+        a | 2 | 4
+        a | 3 | 6
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        e=pw.reducers.earliest(t.v), l=pw.reducers.latest(t.v)
+    )
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("e")] == 1 and row[cols.index("l")] == 3
+
+
+def test_latest_retraction_falls_back():
+    t = T(
+        """
+        g | v | __time__ | __diff__
+        a | 1 | 2        | 1
+        a | 2 | 4        | 1
+        a | 2 | 6        | -1
+        """
+    )
+    res = t.groupby(t.g).reduce(l=pw.reducers.latest(t.v))
+    assert _vals(res, "l") == [1]
+
+
+def test_stateful_single_reducer():
+    # stateful_single: combine_fn(state, *row_args) once per inserted row
+    def combine(state, v):
+        return (state or 0) + v
+
+    t = T(
+        """
+        g | v
+        a | 4
+        a | 5
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        r=pw.reducers.stateful_single(combine)(t.v)
+    )
+    assert _vals(res) == [9]
+
+
+def test_stateful_many_reducer_sees_diffs():
+    def combine(state, rows):
+        total = state or 0
+        for args, diff in rows:
+            total += args[0] * diff
+        return total
+
+    t = T(
+        """
+        g | v | __time__ | __diff__
+        a | 4 | 2        | 1
+        a | 5 | 2        | 1
+        a | 4 | 4        | -1
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        r=pw.reducers.stateful_many(combine)(t.v)
+    )
+    assert _vals(res) == [5]
+
+
+def test_group_vanishes_when_all_rows_retracted():
+    t = T(
+        """
+        g | v | __time__ | __diff__
+        a | 1 | 2        | 1
+        b | 2 | 2        | 1
+        a | 1 | 4        | -1
+        """
+    )
+    res = t.groupby(t.g).reduce(t.g, r=pw.reducers.count())
+    rows, cols = _capture_rows(res)
+    got = [(r[cols.index("g")], r[cols.index("r")]) for r in rows.values()]
+    assert got == [("b", 1)]
+
+
+def test_multi_column_groupby():
+    t = T(
+        """
+        g | h | v
+        a | x | 1
+        a | y | 2
+        a | x | 3
+        """
+    )
+    res = t.groupby(t.g, t.h).reduce(t.g, t.h, r=pw.reducers.sum(t.v))
+    rows, cols = _capture_rows(res)
+    got = sorted(
+        (r[cols.index("g")], r[cols.index("h")], r[cols.index("r")])
+        for r in rows.values()
+    )
+    assert got == [("a", "x", 4), ("a", "y", 2)]
+
+
+def test_reduce_without_groupby_is_global():
+    t = T(
+        """
+        v
+        1
+        2
+        3
+        """
+    )
+    res = t.reduce(r=pw.reducers.sum(t.v))
+    assert _vals(res) == [6]
+
+
+def test_global_reduce_empty_table():
+    t = T(
+        """
+        v
+        """
+    )
+    res = t.reduce(r=pw.reducers.count())
+    rows, _ = _capture_rows(res)
+    # reference: a global reduce over an empty table still yields one row
+    vals = [r[0] for r in rows.values()]
+    assert vals in ([0], [])
+
+
+def test_expression_over_reducers():
+    t = T(
+        """
+        g | v
+        a | 2
+        a | 4
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        r=pw.reducers.sum(t.v) / pw.reducers.count()
+    )
+    assert _vals(res) == [3.0]
+
+
+def test_reducer_on_expression_argument():
+    t = T(
+        """
+        g | v
+        a | 2
+        a | 3
+        """
+    )
+    res = t.groupby(t.g).reduce(r=pw.reducers.sum(t.v * 10))
+    assert _vals(res) == [50]
+
+
+def test_npsum_array_elements():
+    t = T(
+        """
+        g | a
+        x | 1
+        """
+    )
+    t2 = t.select(
+        t.g,
+        arr=pw.apply_with_type(lambda _: np.ones(3), np.ndarray, pw.this.a),
+    )
+    res = t2.groupby(t2.g).reduce(r=pw.reducers.npsum(t2.arr))
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("r")].tolist() == [1.0, 1.0, 1.0]
+
+
+def test_latest_fifo_eviction_cancels_correct_insertion():
+    # delete the OLDEST duplicate (FIFO window): remaining rows are
+    # v=2@t4 and v=1@t6, so latest=1, earliest=2
+    t = T(
+        """
+          | g | v | __time__ | __diff__
+        1 | a | 1 | 2        | 1
+        2 | a | 2 | 4        | 1
+        3 | a | 1 | 6        | 1
+        1 | a | 1 | 8        | -1
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        l=pw.reducers.latest(t.v), e=pw.reducers.earliest(t.v)
+    )
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("l")] == 1
+    assert row[cols.index("e")] == 2
+
+
+def test_earliest_multiunit_retraction():
+    # both copies of v=1 (same row key, multiplicity 2) retracted at once
+    t = T(
+        """
+          | g | v | __time__ | __diff__
+        1 | a | 1 | 2        | 1
+        1 | a | 1 | 2        | 1
+        2 | a | 5 | 4        | 1
+        1 | a | 1 | 6        | -1
+        1 | a | 1 | 6        | -1
+        """
+    )
+    res = t.groupby(t.g).reduce(e=pw.reducers.earliest(t.v))
+    assert _vals(res, "e") == [5]
