@@ -172,6 +172,29 @@ def _apply_filter(flt, data) -> bool:
     return _eval_jmespath_subset(flt, doc)
 
 
+def _glob_match(pattern: str, value: str) -> bool:
+    """Path-aware glob: '*' and '?' do NOT cross '/', '**' does (real glob
+    semantics — fnmatch would let '*' match into subdirectories)."""
+    import re as _re
+
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "*":
+            if pattern[i : i + 2] == "**":
+                out.append(".*")
+                i += 2
+                continue
+            out.append("[^/]*")
+        elif c == "?":
+            out.append("[^/]")
+        else:
+            out.append(_re.escape(c))
+        i += 1
+    return _re.fullmatch("".join(out), value) is not None
+
+
 def _eval_jmespath_subset(expr: str, doc: Any) -> bool:
     """Tiny JMESPath subset: `a.b == 'v'`, `a == `1``, contains(path, 'v'),
     conjunctions with &&, disjunctions with ||, negation with !."""
@@ -191,6 +214,18 @@ def _eval_jmespath_subset(expr: str, doc: Any) -> bool:
             return target in value
         except TypeError:
             return False
+    if expr.startswith("glob(") and expr.endswith(")"):
+        # the document store's filepath_globpattern compiles to
+        # glob(path, '<pattern>') (reference uses a JMESPath glob fn)
+        inner = expr[len("glob(") : -1]
+        path, _, raw = inner.partition(",")
+        pattern = _parse_literal(raw.strip())
+        value = _lookup(path.strip(), doc)
+        return (
+            isinstance(value, str)
+            and isinstance(pattern, str)
+            and _glob_match(pattern, value)
+        )
     for op in ("==", "!=", ">=", "<=", ">", "<"):
         if op in expr:
             lhs, rhs = expr.split(op, 1)

@@ -68,8 +68,9 @@ class _Tokenizer:
 
 _KEYWORDS = {
     "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "AS", "AND", "OR",
-    "NOT", "JOIN", "ON", "UNION", "INTERSECT", "WITH", "INNER", "LEFT",
-    "RIGHT", "OUTER", "FULL", "NULL", "TRUE", "FALSE", "LIKE", "IN", "ALL",
+    "NOT", "JOIN", "ON", "UNION", "INTERSECT", "EXCEPT", "WITH", "INNER",
+    "LEFT", "RIGHT", "OUTER", "FULL", "NULL", "TRUE", "FALSE", "LIKE", "IN",
+    "ALL",
 }
 
 
@@ -91,20 +92,36 @@ def sql(query: str, **tables) -> Any:
             tk.expect(")")
             if not tk.accept(","):
                 break
-    return _parse_select(tk, tables)
+    result = _parse_select(tk, tables)
+    leftover = tk.peek()
+    if leftover is not None:
+        # silently ignoring a tail (e.g. an unsupported clause) would
+        # return WRONG results that look plausible
+        raise NotImplementedError(
+            f"unsupported SQL from token {leftover!r}"
+        )
+    return result
 
 
 def _parse_select(tk: _Tokenizer, tables: dict):
-    """One SELECT plus a left-associative chain of set operations."""
-    result = _parse_single_select(tk, tables)
-    while True:
-        if tk.accept("UNION"):
-            kind = "union_all" if tk.accept("ALL") else "union"
-            result = _apply_set_op(result, kind, _parse_single_select(tk, tables))
-        elif tk.accept("INTERSECT"):
+    """Set-operation chain with standard precedence: INTERSECT binds
+    tighter than UNION/EXCEPT (which associate left)."""
+
+    def intersect_chain():
+        result = _parse_single_select(tk, tables)
+        while tk.accept("INTERSECT"):
             result = _apply_set_op(
                 result, "intersect", _parse_single_select(tk, tables)
             )
+        return result
+
+    result = intersect_chain()
+    while True:
+        if tk.accept("UNION"):
+            kind = "union_all" if tk.accept("ALL") else "union"
+            result = _apply_set_op(result, kind, intersect_chain())
+        elif tk.accept("EXCEPT"):
+            result = _apply_set_op(result, "except", intersect_chain())
         else:
             break
     return result
@@ -259,6 +276,8 @@ def _apply_set_op(result, kind: str, other):
     right = _distinct_by_content(other)
     if kind == "union":
         return left.update_rows(right)
+    if kind == "except":
+        return left.difference(right)
     return left.intersect(right)
 
 

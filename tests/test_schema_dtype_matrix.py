@@ -1,0 +1,243 @@
+"""Schema and dtype behaviors (reference ``test_schema.py`` /
+``internals/dtype.py``): composition, defaults, primary keys, typehints,
+coercions, Json/Pointer/Duration value types."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import dtype as dt
+from tests.utils import T, _capture_rows
+
+
+def test_schema_union_merges_columns():
+    class A(pw.Schema):
+        a: int
+
+    class B(pw.Schema):
+        b: str
+
+    merged = A | B
+    assert list(merged.column_names()) == ["a", "b"]
+
+
+def test_schema_from_types():
+    from pathway_tpu.internals.schema import schema_from_types
+
+    s = schema_from_types(x=int, y=str)
+    assert list(s.column_names()) == ["x", "y"]
+    assert s.typehints()["x"] is int
+
+
+def test_schema_with_types_overrides():
+    class A(pw.Schema):
+        a: int
+        b: str
+
+    s2 = A.with_types(b=float)
+    assert s2.typehints()["b"] is float
+    assert s2.typehints()["a"] is int
+
+
+def test_schema_without_removes():
+    class A(pw.Schema):
+        a: int
+        b: str
+
+    s2 = A.without("b")
+    assert list(s2.column_names()) == ["a"]
+
+
+def test_primary_key_columns_listed():
+    class A(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    assert A.primary_key_columns() == ["k"]
+
+
+def test_default_values_cached_and_readonly():
+    class A(pw.Schema):
+        a: int = pw.column_definition(default_value=3)
+        b: str
+
+    d = A.default_values()
+    assert d == {"a": 3}
+    with pytest.raises(TypeError):
+        d["a"] = 99  # read-only mapping
+
+
+def test_optional_dtype_strip():
+    opt = dt.Optional(dt.INT)
+    assert opt.strip_optional() is dt.INT
+    assert dt.INT.strip_optional() is dt.INT
+
+
+def test_table_schema_inference_from_markdown():
+    t = T(
+        """
+        a | b   | c
+        1 | 2.5 | x
+        """
+    )
+    hints = t.schema.typehints()
+    assert hints["a"] is int
+    assert hints["b"] is float
+    assert hints["c"] is str
+
+
+def test_select_propagates_dtypes():
+    t = T(
+        """
+        a
+        2
+        """
+    )
+    res = t.select(b=t.a * 1.5)
+    assert res.schema.typehints()["b"] is float
+
+
+def test_concat_requires_same_columns():
+    a = T(
+        """
+        x
+        1
+        """
+    )
+    b = T(
+        """
+        y
+        2
+        """
+    )
+    with pytest.raises(Exception):
+        a.concat_reindex(b)
+
+
+def test_rename_columns():
+    t = T(
+        """
+        a | b
+        1 | x
+        """
+    )
+    r = t.rename_columns(c=t.a)
+    assert "c" in r.column_names() and "a" not in r.column_names()
+
+
+def test_rename_by_dict():
+    t = T(
+        """
+        a | b
+        1 | x
+        """
+    )
+    r = t.rename({"a": "z"})
+    assert "z" in r.column_names()
+
+
+def test_with_columns_overwrites_and_adds():
+    t = T(
+        """
+        a | b
+        1 | x
+        """
+    )
+    r = t.with_columns(a=t.a + 10, c=t.a * 2)
+    rows, cols = _capture_rows(r)
+    (row,) = rows.values()
+    assert row[cols.index("a")] == 11
+    assert row[cols.index("c")] == 2
+
+
+def test_without_columns():
+    t = T(
+        """
+        a | b
+        1 | x
+        """
+    )
+    r = t.without("b")
+    assert list(r.column_names()) == ["a"]
+
+
+def test_json_value_type_roundtrip():
+    j = pw.Json({"a": [1, {"b": 2}]})
+    import json as json_mod
+
+    assert json_mod.loads(str(j)) == {"a": [1, {"b": 2}]}
+
+
+def test_json_equality_by_content():
+    assert pw.Json({"x": 1}) == pw.Json({"x": 1})
+    assert pw.Json({"x": 1}) != pw.Json({"x": 2})
+
+
+def test_pointer_repr_and_equality():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    res = t.select(p=t.pointer_from(t.a))
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    p = row[cols.index("p")]
+    assert repr(p).startswith("^")
+
+
+def test_duration_type_in_table():
+    import pandas as pd
+
+    t = T(
+        """
+        s
+        2024-01-02T00:00:00
+        """
+    )
+    d = t.select(d=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S"))
+    res = d.select(delta=d.d - d.d)
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("delta")] == pd.Timedelta(0)
+
+
+def test_apply_with_type_declared_dtype_respected():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    res = t.select(s=pw.apply_with_type(lambda a: str(a), str, t.a))
+    assert res.schema.typehints()["s"] is str
+
+
+def test_schema_generate_class_like_repr():
+    class A(pw.Schema):
+        a: int
+        b: str = pw.column_definition(default_value="z")
+
+    # repr/typehints must be stable and complete
+    th = A.typehints()
+    assert set(th) == {"a", "b"}
+
+
+def test_column_definition_dtype_override():
+    class A(pw.Schema):
+        a: float = pw.column_definition(dtype=float)
+
+    assert A.typehints()["a"] is float
+
+
+def test_cast_optional_unwrap_chain():
+    t = T(
+        """
+        a
+        3
+        """
+    )
+    res = t.select(v=pw.unwrap(pw.cast(float, t.a)))
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[0] == 3.0

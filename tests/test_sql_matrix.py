@@ -1,0 +1,220 @@
+"""SQL surface matrix (reference ``internals/sql.py`` + sqlglot tests):
+SELECT/WHERE/GROUP BY/HAVING/JOIN/CTE/set ops/expressions."""
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows
+
+
+def _t():
+    return T(
+        """
+        name  | dept | salary
+        alice | eng  | 100
+        bob   | eng  | 80
+        carol | ops  | 90
+        """
+    )
+
+
+def _rows(res):
+    rows, cols = _capture_rows(res)
+    return sorted(tuple(r) for r in rows.values()), cols
+
+
+def test_select_columns():
+    got, cols = _rows(pw.sql("SELECT name, salary FROM t", t=_t()))
+    assert cols == ["name", "salary"]
+    assert got == [("alice", 100), ("bob", 80), ("carol", 90)]
+
+
+def test_select_star():
+    got, cols = _rows(pw.sql("SELECT * FROM t", t=_t()))
+    assert set(cols) == {"name", "dept", "salary"}
+    assert len(got) == 3
+
+
+def test_where_comparison():
+    got, _ = _rows(pw.sql("SELECT name FROM t WHERE salary > 85", t=_t()))
+    assert got == [("alice",), ("carol",)]
+
+
+def test_where_and_or():
+    got, _ = _rows(
+        pw.sql(
+            "SELECT name FROM t WHERE dept = 'eng' AND salary >= 100 "
+            "OR dept = 'ops'",
+            t=_t(),
+        )
+    )
+    assert got == [("alice",), ("carol",)]
+
+
+def test_computed_column_with_alias():
+    got, cols = _rows(
+        pw.sql("SELECT name, salary * 2 AS double_pay FROM t", t=_t())
+    )
+    assert "double_pay" in cols
+    assert (100, ) not in got  # sanity: tuples are (name, pay)
+    assert sorted(g[1] for g in got) == [160, 180, 200]
+
+
+def test_group_by_aggregates():
+    got, cols = _rows(
+        pw.sql(
+            "SELECT dept, SUM(salary) AS total, COUNT(*) AS n "
+            "FROM t GROUP BY dept",
+            t=_t(),
+        )
+    )
+    assert sorted(got) == [("eng", 180, 2), ("ops", 90, 1)]
+
+
+def test_group_by_having():
+    got, _ = _rows(
+        pw.sql(
+            "SELECT dept, SUM(salary) AS total FROM t GROUP BY dept "
+            "HAVING SUM(salary) > 100",
+            t=_t(),
+        )
+    )
+    assert got == [("eng", 180)]
+
+
+def test_global_aggregate():
+    got, _ = _rows(pw.sql("SELECT MAX(salary) AS m FROM t", t=_t()))
+    assert got == [(100,)]
+
+
+def test_join_two_tables():
+    heads = T(
+        """
+        dept | head
+        eng  | dana
+        ops  | evan
+        """
+    )
+    got, _ = _rows(
+        pw.sql(
+            "SELECT t.name, h.head FROM t JOIN h ON t.dept = h.dept",
+            t=_t(),
+            h=heads,
+        )
+    )
+    assert got == [("alice", "dana"), ("bob", "dana"), ("carol", "evan")]
+
+
+def test_union_all_and_union():
+    a = T(
+        """
+        v
+        1
+        2
+        """
+    )
+    b = T(
+        """
+        v
+        2
+        3
+        """
+    )
+    got_all, _ = _rows(pw.sql("SELECT v FROM a UNION ALL SELECT v FROM b", a=a, b=b))
+    assert [g[0] for g in got_all] == [1, 2, 2, 3]
+    got_u, _ = _rows(pw.sql("SELECT v FROM a UNION SELECT v FROM b", a=a, b=b))
+    assert [g[0] for g in got_u] == [1, 2, 3]
+
+
+def test_intersect_except():
+    a = T(
+        """
+        v
+        1
+        2
+        """
+    )
+    b = T(
+        """
+        v
+        2
+        3
+        """
+    )
+    got_i, _ = _rows(pw.sql("SELECT v FROM a INTERSECT SELECT v FROM b", a=a, b=b))
+    assert [g[0] for g in got_i] == [2]
+    got_e, _ = _rows(pw.sql("SELECT v FROM a EXCEPT SELECT v FROM b", a=a, b=b))
+    assert [g[0] for g in got_e] == [1]
+
+
+def test_with_cte():
+    got, _ = _rows(
+        pw.sql(
+            "WITH rich AS (SELECT * FROM t WHERE salary >= 90) "
+            "SELECT name FROM rich",
+            t=_t(),
+        )
+    )
+    assert got == [("alice",), ("carol",)]
+
+
+def test_nested_cte_chain():
+    got, _ = _rows(
+        pw.sql(
+            "WITH a AS (SELECT * FROM t WHERE dept = 'eng'), "
+            "b AS (SELECT * FROM a WHERE salary > 85) "
+            "SELECT name FROM b",
+            t=_t(),
+        )
+    )
+    assert got == [("alice",)]
+
+
+def test_case_insensitive_keywords():
+    got, _ = _rows(pw.sql("select name from t where salary = 80", t=_t()))
+    assert got == [("bob",)]
+
+
+def test_arithmetic_in_where():
+    got, _ = _rows(
+        pw.sql("SELECT name FROM t WHERE salary - 10 = 70", t=_t())
+    )
+    assert got == [("bob",)]
+
+
+def test_not_equal_operators():
+    got, _ = _rows(pw.sql("SELECT name FROM t WHERE dept <> 'eng'", t=_t()))
+    assert got == [("carol",)]
+
+
+def test_intersect_binds_tighter_than_except():
+    a = T("""
+    v
+    1
+    2
+    """)
+    b = T("""
+    v
+    2
+    3
+    """)
+    c = T("""
+    v
+    1
+    """)
+    # a EXCEPT (b INTERSECT c) = {1,2} - {} = {1,2}
+    got, _ = _rows(
+        pw.sql(
+            "SELECT v FROM a EXCEPT SELECT v FROM b INTERSECT SELECT v FROM c",
+            a=a, b=b, c=c,
+        )
+    )
+    assert [g[0] for g in got] == [1, 2]
+
+
+def test_unsupported_clause_raises():
+    import pytest
+
+    with pytest.raises(NotImplementedError):
+        pw.sql("SELECT v FROM a ORDER BY v", a=T("""
+        v
+        1
+        """))

@@ -1,0 +1,265 @@
+"""stdlib behavior matrix — graphs, statistical, ordered, utils.col,
+stateful, sorting (reference stdlib tests)."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows
+
+
+# ------------------------------------------------------------------ graphs
+def test_bellman_ford_shortest_paths():
+    vertices = T(
+        """
+        name | is_source
+        n1   | True
+        n2   | False
+        n3   | False
+        """
+    ).with_id_from(pw.this.name)
+    raw = T(
+        """
+        un | vn | dist
+        n1 | n2 | 5.0
+        n2 | n3 | 2.0
+        n1 | n3 | 10.0
+        """
+    )
+    edges = raw.select(
+        u=vertices.pointer_from(raw.un),
+        v=vertices.pointer_from(raw.vn),
+        dist=raw.dist,
+    )
+    from pathway_tpu.stdlib.graphs import bellman_ford
+
+    res = bellman_ford(vertices, edges)
+    rows, cols = _capture_rows(res)
+    dists = sorted(
+        r[cols.index("dist_from_source")] for r in rows.values()
+    )
+    assert dists == [0.0, 5.0, 7.0]
+
+
+def test_pagerank_symmetric_graph_equal_ranks():
+    edges = T(
+        """
+        u | v
+        a | b
+        b | a
+        """
+    )
+    from pathway_tpu.stdlib.graphs import pagerank
+
+    res = pagerank(edges, steps=20)
+    rows, cols = _capture_rows(res)
+    ranks = [r[cols.index("rank")] for r in rows.values()]
+    assert len(ranks) == 2
+    assert abs(ranks[0] - ranks[1]) <= 1
+
+
+def test_louvain_two_cliques_split():
+    eds = []
+    for grp, names in (("x", ["a", "b", "c"]), ("y", ["p", "q", "r"])):
+        for i, u in enumerate(names):
+            for v in names[i + 1 :]:
+                eds.append((u, v))
+    eds.append(("a", "p"))  # one weak cross edge
+    md = "u | v\n" + "\n".join(f"{u} | {v}" for u, v in eds)
+    edges = T(md)
+    from pathway_tpu.stdlib.graphs import louvain_communities
+
+    res = louvain_communities(edges)
+    rows, cols = _capture_rows(res)
+    com_of = {
+        r[cols.index("v")]: r[cols.index("community")] for r in rows.values()
+    }
+    assert com_of["a"] == com_of["b"] == com_of["c"]
+    assert com_of["p"] == com_of["q"] == com_of["r"]
+    assert com_of["a"] != com_of["p"]
+
+
+# ------------------------------------------------------------- statistical
+def test_interpolate_fills_missing_points():
+    t = T(
+        """
+        t | v
+        0 | 0.0
+        2 |
+        4 | 4.0
+        """
+    )
+    from pathway_tpu.stdlib.statistical import interpolate
+
+    res = interpolate(t, t.t, t.v)
+    rows, cols = _capture_rows(res)
+    by_t = {r[cols.index("t")]: r[cols.index("v")] for r in rows.values()}
+    assert by_t[2] == 2.0
+
+
+# ----------------------------------------------------------------- ordered
+def test_ordered_diff_with_instance():
+    t = T(
+        """
+        t | g | v
+        1 | a | 10
+        2 | a | 13
+        1 | b | 5
+        2 | b | 4
+        """
+    )
+    res = t.diff(pw.this.t, pw.this.v, instance=pw.this.g)
+    rows, cols = _capture_rows(res)
+    di = cols.index("diff_v")
+    gi = cols.index("g")
+    got = sorted(
+        (r[gi], r[di]) for r in rows.values() if r[di] is not None
+    )
+    assert got == [("a", 3), ("b", -1)]
+
+
+# --------------------------------------------------------------- utils.col
+def test_unpack_col_into_columns():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    packed = t.select(tup=pw.make_tuple(t.a, t.a * 2, t.a * 3))
+    from pathway_tpu.stdlib.utils.col import unpack_col
+
+    res = unpack_col(packed.tup, "x", "y", "z")
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert (
+        row[cols.index("x")],
+        row[cols.index("y")],
+        row[cols.index("z")],
+    ) == (1, 2, 3)
+
+
+def test_groupby_reduce_majority():
+    t = T(
+        """
+        c | votes
+        a | 2
+        a | 3
+        b | 4
+        """
+    )
+    from pathway_tpu.stdlib.utils.col import groupby_reduce_majority
+
+    res = groupby_reduce_majority(t.c, t.votes)
+    rows, cols = _capture_rows(res)
+    got = {
+        r[cols.index("c")]: r[cols.index("majority")] for r in rows.values()
+    }
+    assert got["b"] == 4
+    assert got["a"] in (2, 3)  # tie: either vote is a valid majority pick
+
+
+def test_apply_all_rows_whole_column():
+    t = T(
+        """
+        v
+        1
+        2
+        """
+    )
+    from pathway_tpu.stdlib.utils.col import apply_all_rows
+
+    res = apply_all_rows(
+        t.v, fun=lambda vs: [v / sum(vs) for v in vs], result_col_name="share"
+    )
+    rows, cols = _capture_rows(res)
+    shares = sorted(r[cols.index("share")] for r in rows.values())
+    assert shares == [1 / 3, 2 / 3]
+
+
+# ---------------------------------------------------------------- stateful
+def test_deduplicate_keeps_accepted_only():
+    t = T(
+        """
+        v | __time__
+        5 | 2
+        3 | 4
+        9 | 6
+        """
+    )
+    res = pw.stdlib.stateful.deduplicate(
+        t, value=t.v, acceptor=lambda new, old: new > old
+    )
+    rows, cols = _capture_rows(res)
+    assert sorted(r[cols.index("v")] for r in rows.values()) == [9]
+
+
+# ----------------------------------------------------------------- sorting
+def test_sort_prev_next_chain_complete():
+    t = T(
+        """
+        v
+        30
+        10
+        20
+        """
+    )
+    s = t.sort(t.v)
+    merged = t.with_columns(prev=s.prev, next=s.next)
+    rows, cols = _capture_rows(merged)
+    vi, pi, ni = (cols.index(c) for c in ("v", "prev", "next"))
+    by_v = {r[vi]: r for r in rows.values()}
+    assert by_v[10][pi] is None
+    assert by_v[30][ni] is None
+    # middle links both ways
+    assert by_v[20][pi] is not None and by_v[20][ni] is not None
+
+
+def test_sort_with_instance_partitions():
+    t = T(
+        """
+        g | v
+        a | 2
+        a | 1
+        b | 5
+        """
+    )
+    s = t.sort(t.v, instance=t.g)
+    merged = t.with_columns(prev=s.prev, next=s.next)
+    rows, cols = _capture_rows(merged)
+    vi, pi, ni = (cols.index(c) for c in ("v", "prev", "next"))
+    by_v = {r[vi]: r for r in rows.values()}
+    # b's single row has no neighbors despite a's rows existing
+    assert by_v[5][pi] is None and by_v[5][ni] is None
+
+
+# --------------------------------------------------------------------- viz
+def test_table_repr_renders():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    assert "a" in repr(t) or "Table" in repr(t)
+
+
+# ------------------------------------------------------------- ml smoke
+def test_knn_classifier_lsh_smoke():
+    import pandas as pd
+
+    rng = np.random.default_rng(0)
+    X = np.concatenate([rng.normal(0, 0.1, (10, 4)), rng.normal(5, 0.1, (10, 4))])
+    y = [0] * 10 + [1] * 10
+    data = pw.debug.table_from_pandas(
+        pd.DataFrame({"data": [v for v in X], "label": y})
+    )
+    queries = pw.debug.table_from_pandas(
+        pd.DataFrame({"data": [X[0] + 0.01, X[15] + 0.01]})
+    )
+    from pathway_tpu.stdlib.ml.classifiers import knn_lsh_classifier_train, knn_lsh_classify
+
+    model = knn_lsh_classifier_train(data, L=5, d=4, M=5, A=2)
+    res = knn_lsh_classify(model, data.select(data.label), queries, k=3)
+    rows, cols = _capture_rows(res)
+    preds = sorted(r[cols.index("predicted_label")] for r in rows.values())
+    assert preds == [0, 1]

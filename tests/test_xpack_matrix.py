@@ -1,0 +1,265 @@
+"""LLM xpack behavior matrix — splitters, prompts, chats (stub transport),
+parsers, vector store filters, rerank ranking utilities (reference
+``xpacks/llm`` tests)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import _capture_rows
+
+
+# --------------------------------------------------------------- splitters
+def test_token_count_splitter_respects_bounds():
+    from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+
+    sp = TokenCountSplitter(min_tokens=3, max_tokens=6)
+    text = " ".join(f"w{i}" for i in range(20))
+    chunks = sp.__wrapped__(text)
+    assert len(chunks) >= 3
+    for chunk, meta in chunks:
+        assert len(chunk.split()) <= 6
+
+
+def test_recursive_splitter_on_separators():
+    from pathway_tpu.xpacks.llm.splitters import RecursiveSplitter
+
+    sp = RecursiveSplitter(chunk_size=4, chunk_overlap=0)  # words
+    text = "para one here.\n\npara two is a bit longer.\n\npara three."
+    chunks = sp.__wrapped__(text)
+    assert len(chunks) >= 2
+    assert all(isinstance(c, tuple) and isinstance(c[0], str) for c in chunks)
+
+
+def test_null_splitter_passthrough():
+    from pathway_tpu.xpacks.llm.splitters import null_splitter
+
+    out = null_splitter.__wrapped__("hello world")
+    assert out == [("hello world", {})]
+
+
+def test_chunk_texts_word_bound():
+    from pathway_tpu.xpacks.llm.splitters import chunk_texts
+
+    chunks = chunk_texts.__wrapped__(" ".join(["w"] * 450), max_words=200)
+    assert len(chunks) == 3
+
+
+# ----------------------------------------------------------------- prompts
+def test_prompt_qa_includes_query_and_context():
+    from pathway_tpu.xpacks.llm.prompts import prompt_qa
+
+    p = prompt_qa.__wrapped__("what is x", "x is a letter")
+    assert "what is x" in p and "x is a letter" in p
+
+
+def test_prompt_citing_qa_mentions_citation():
+    from pathway_tpu.xpacks.llm.prompts import prompt_citing_qa
+
+    p = prompt_citing_qa.__wrapped__("q", "ctx")
+    assert "cit" in p.lower()
+
+
+def test_prompt_template_formatting():
+    from pathway_tpu.xpacks.llm.prompts import RAGPromptTemplate
+
+    tpl = RAGPromptTemplate(template="Q: {query} C: {context}")
+    out = tpl.as_udf().__wrapped__("myctx", "myq")  # (context, query)
+    assert out == "Q: myq C: myctx"
+
+
+# -------------------------------------------------------------------- llms
+def test_prompt_chat_single_qa_wraps_as_messages():
+    from pathway_tpu.xpacks.llm.llms import prompt_chat_single_qa
+
+    j = prompt_chat_single_qa.__wrapped__("hello")
+    msgs = json.loads(str(j))
+    assert msgs[0]["content"] == "hello"
+    assert msgs[0]["role"] == "user"
+
+
+def test_messages_to_list_accepts_json_and_list():
+    from pathway_tpu.xpacks.llm.llms import _messages_to_list
+
+    msgs = [{"role": "user", "content": "hi"}]
+    assert _messages_to_list(pw.Json(msgs)) == msgs
+    assert _messages_to_list(msgs) == msgs
+
+
+# ----------------------------------------------------------------- parsers
+def test_parse_utf8_decodes():
+    from pathway_tpu.xpacks.llm import parsers
+
+    out = parsers.ParseUtf8().__wrapped__("héllo".encode())
+    assert out[0][0] == "héllo"
+
+
+def test_parse_unstructured_gated_dependency():
+    from pathway_tpu.xpacks.llm import parsers
+
+    try:
+        import unstructured  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="unstructured"):
+            parsers.ParseUnstructured()
+    else:
+        out = parsers.ParseUnstructured().__wrapped__(b"line one")
+        assert out
+
+
+# ------------------------------------------------------------ vector store
+def _fake_embedder(text: str):
+    rng = np.random.default_rng(abs(hash(text)) % (2**32))
+    v = rng.normal(size=8)
+    return v / np.linalg.norm(v)
+
+
+def test_vector_store_retrieve_topk_order():
+    import pandas as pd
+
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+    from pathway_tpu.xpacks.llm.document_store import DocumentStore
+
+    docs = pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {
+                "data": [b"alpha doc", b"beta doc", b"gamma doc"],
+                "_metadata": [
+                    {"path": "a.txt"},
+                    {"path": "b.txt"},
+                    {"path": "c.txt"},
+                ],
+            }
+        )
+    )
+    store = DocumentStore(
+        docs,
+        retriever_factory=BruteForceKnnFactory(
+            dimensions=8, embedder=_fake_embedder
+        ),
+    )
+    queries = pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {
+                "query": ["alpha doc"],
+                "k": [2],
+                "metadata_filter": [None],
+                "filepath_globpattern": [None],
+            }
+        )
+    )
+    res = store.retrieve_query(queries)
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    results = json.loads(str(row[cols.index("result")]))
+    assert len(results) == 2
+    assert results[0]["text"] == "alpha doc"  # exact-match embeds closest
+    assert results[0]["dist"] <= results[1]["dist"]
+
+
+def test_document_store_glob_filter():
+    import pandas as pd
+
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+    from pathway_tpu.xpacks.llm.document_store import DocumentStore
+
+    docs = pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {
+                "data": [b"alpha", b"beta"],
+                "_metadata": [{"path": "k/a.txt"}, {"path": "other/b.md"}],
+            }
+        )
+    )
+    store = DocumentStore(
+        docs,
+        retriever_factory=BruteForceKnnFactory(
+            dimensions=8, embedder=_fake_embedder
+        ),
+    )
+    queries = pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {
+                "query": ["alpha"],
+                "k": [5],
+                "metadata_filter": [None],
+                "filepath_globpattern": ["k/*.txt"],
+            }
+        )
+    )
+    res = store.retrieve_query(queries)
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    results = json.loads(str(row[cols.index("result")]))
+    assert [r["metadata"]["path"] for r in results] == ["k/a.txt"]
+
+
+# ---------------------------------------------------------------- rerankers
+def test_rerank_topk_filter_sorts_and_truncates():
+    from pathway_tpu.xpacks.llm.rerankers import rerank_topk_filter
+
+    docs = [pw.Json({"text": f"d{i}"}) for i in range(5)]
+    scores = [0.1, 0.9, 0.5, 0.7, 0.3]
+    kept_docs, kept_scores = rerank_topk_filter.__wrapped__(docs, scores, k=2)
+    assert list(kept_scores) == [0.9, 0.7]
+
+
+def test_encoder_reranker_cosine():
+    from pathway_tpu.xpacks.llm.rerankers import EncoderReranker
+
+    rr = EncoderReranker()  # default TPU bi-encoder
+    s_same, s_diff = rr.__wrapped__(
+        ["hello there", "hello there"],
+        ["hello there", "entirely unrelated words apple"],
+    )
+    assert s_same > s_diff
+
+
+# -------------------------------------------------------------------- misc
+def test_adaptive_rag_escalates_k():
+    # the adaptive strategy widens k until the answer stops being "no info"
+    from pathway_tpu.xpacks.llm.question_answering import (
+        AdaptiveRAGQuestionAnswerer,
+    )
+
+    assert AdaptiveRAGQuestionAnswerer is not None  # surface exists
+
+
+def test_vector_store_statistics_counts(tmp_path):
+    import pandas as pd
+
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+    from pathway_tpu.xpacks.llm.document_store import DocumentStore
+
+    docs = pw.debug.table_from_pandas(
+        pd.DataFrame(
+            {
+                "data": [b"alpha", b"beta"],
+                "_metadata": [{"path": "a"}, {"path": "b"}],
+            }
+        )
+    )
+    store = DocumentStore(
+        docs,
+        retriever_factory=BruteForceKnnFactory(
+            dimensions=8, embedder=_fake_embedder
+        ),
+    )
+    q = pw.debug.table_from_pandas(pd.DataFrame({"req": [1]}))
+    res = store.statistics_query(q)
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    stats = json.loads(str(row[cols.index("result")]))
+    assert stats["file_count"] == 2
+
+
+def test_glob_filter_does_not_cross_directories():
+    from pathway_tpu.engine.operators.external_index import _glob_match
+
+    assert _glob_match("k/*.txt", "k/a.txt")
+    assert not _glob_match("k/*.txt", "k/sub/a.txt")
+    assert _glob_match("k/**/*.txt", "k/sub/a.txt")
+    assert _glob_match("k/??.txt", "k/ab.txt")
+    assert not _glob_match("k/??.txt", "k/a/b.txt")
