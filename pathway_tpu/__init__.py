@@ -96,6 +96,11 @@ from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer  # no
 from pathway_tpu.internals.sql import sql  # noqa: E402
 from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
 from pathway_tpu.internals.iterate import iterate, iterate_universe  # noqa: E402
+from pathway_tpu.internals.exported import (  # noqa: E402
+    ExportedTable,
+    export_table,
+    import_table,
+)
 from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
 from pathway_tpu import demo  # noqa: E402
 
@@ -169,6 +174,9 @@ __all__ = [
     "indexing",
     "ml",
     "temporal",
+    "ExportedTable",
+    "export_table",
+    "import_table",
     "iterate",
     "sql",
     "AsyncTransformer",

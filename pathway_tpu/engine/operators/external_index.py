@@ -9,6 +9,8 @@ Reference parity: ``src/engine/dataflow/operators/external_index.rs``
 
 from __future__ import annotations
 
+import functools
+import re
 from typing import Any
 
 import numpy as np
@@ -172,27 +174,38 @@ def _apply_filter(flt, data) -> bool:
     return _eval_jmespath_subset(flt, doc)
 
 
-def _glob_match(pattern: str, value: str) -> bool:
-    """Path-aware glob: '*' and '?' do NOT cross '/', '**' does (real glob
-    semantics — fnmatch would let '*' match into subdirectories)."""
-    import re as _re
-
+@functools.lru_cache(maxsize=256)
+def _glob_regex(pattern: str):
+    """Compile a path-aware glob: '*' and '?' do NOT cross '/', '**'
+    matches zero or more whole components ('docs/**/*.md' matches
+    'docs/readme.md'; fnmatch would let '*' cross into subdirectories)."""
     out = []
     i = 0
-    while i < len(pattern):
+    n = len(pattern)
+    while i < n:
         c = pattern[i]
-        if c == "*":
-            if pattern[i : i + 2] == "**":
+        if c == "*" and pattern[i : i + 2] == "**":
+            if pattern[i : i + 3] == "**/":
+                # '**/' absorbs its slash so zero components match
+                out.append("(?:.*/)?")
+                i += 3
+            else:
                 out.append(".*")
                 i += 2
-                continue
+        elif c == "*":
             out.append("[^/]*")
+            i += 1
         elif c == "?":
             out.append("[^/]")
+            i += 1
         else:
-            out.append(_re.escape(c))
-        i += 1
-    return _re.fullmatch("".join(out), value) is not None
+            out.append(re.escape(c))
+            i += 1
+    return re.compile("".join(out))
+
+
+def _glob_match(pattern: str, value: str) -> bool:
+    return _glob_regex(pattern).fullmatch(value) is not None
 
 
 def _eval_jmespath_subset(expr: str, doc: Any) -> bool:

@@ -1,0 +1,201 @@
+"""Export/import between graphs — frontier-tracked table handoff.
+
+Reference: ``api.ExportedTable`` (``src/engine/graph.rs`` ExportedTable with
+``frontier()`` / ``snapshot_at()``; consumed by
+``internals/interactive.py:35-77`` and the export/import datasink/source
+pair). Redesign for this engine:
+
+* ``Table.export()`` (graph A, at build time) attaches a capture sink; while
+  graph A runs, the handle tracks the table's consolidated state, a
+  compacted update history, and the commit-time frontier.
+* ``import_table(exported)`` (graph B) creates an input connector that
+  emits a CONSISTENT snapshot as of the exported frontier, then streams
+  subsequent updates live — graph B can run while graph A is still running
+  (each exported update is queued per importer), and quiesces when graph A
+  finishes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any
+
+from pathway_tpu.engine.operators.core import InputNode
+from pathway_tpu.engine.operators.output import SinkNode
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io._streams import BaseConnector
+
+_FINISHED = object()  # queue sentinel: the exporting run ended
+
+_COMPACT_THRESHOLD = 10_000  # history entries before in-place consolidation
+
+
+def _consolidate(
+    hist: list[tuple[int, int, tuple, int]],
+    frontier: int,
+    on_later=None,
+) -> list[tuple[int, tuple]]:
+    """Net state from every update with ``time <= frontier``; entries past
+    the cut go to ``on_later`` (ordered) when given."""
+    net: dict[tuple[int, tuple], int] = {}
+    order: list[tuple[int, tuple]] = []
+    for time, key, row, diff in hist:
+        if time > frontier:
+            if on_later is not None:
+                on_later((time, key, row, diff))
+            continue
+        ck = (key, row)
+        if ck not in net:
+            net[ck] = 0
+            order.append(ck)
+        net[ck] += diff
+    out: list[tuple[int, tuple]] = []
+    for ck in order:
+        for _ in range(max(0, net[ck])):
+            out.append(ck)
+    return out
+
+
+class ExportedTable:
+    """Frontier-tracked handle to a table's live state."""
+
+    def __init__(self, table: Table):
+        self.column_names = list(table.column_names())
+        self.schema = table.schema
+        self._lock = threading.Lock()
+        self._history: list[tuple[int, int, tuple, int]] = []
+        self._frontier: int = 0
+        self._queues: list[queue.Queue] = []
+        self._finished = False
+
+        def on_batch(time: int, batch) -> None:
+            with self._lock:
+                for key, row, diff in batch.rows():
+                    self._history.append((time, key, row, diff))
+                    for q in self._queues:
+                        q.put((time, key, row, diff))
+                self._frontier = max(self._frontier, time)
+                if len(self._history) > _COMPACT_THRESHOLD:
+                    self._compact_locked()
+
+        def on_finish() -> None:
+            with self._lock:
+                self._finished = True
+                for q in self._queues:
+                    q.put(_FINISHED)
+
+        on_batch.finish = on_finish  # SinkNode end-of-run hook
+        node = SinkNode(
+            G.engine_graph, table._node, on_batch,
+            name=f"export({','.join(self.column_names)})",
+        )
+        G.register_sink(node)
+
+    def _compact_locked(self) -> None:
+        """Collapse history up to the frontier into its net state (bounds
+        memory on streaming sources; snapshots at frontiers earlier than a
+        compaction point are no longer distinguishable, matching the
+        reference's as-of-now export semantics)."""
+        later: list = []
+        rows = _consolidate(self._history, self._frontier, later.append)
+        self._history = [
+            (self._frontier, key, row, 1) for key, row in rows
+        ] + later
+
+    # -- reference ExportedTable surface ----------------------------------
+    def frontier(self) -> int:
+        with self._lock:
+            return self._frontier
+
+    @property
+    def finished(self) -> bool:
+        with self._lock:
+            return self._finished
+
+    def snapshot_at(self, frontier: int) -> list[tuple[int, tuple]]:
+        """Consolidated (key, row) state after every update with
+        ``time <= frontier``."""
+        with self._lock:
+            hist = list(self._history)
+        return _consolidate(hist, frontier)
+
+    def consistent_handoff(self) -> tuple[int, list, "queue.Queue"]:
+        """(frontier, snapshot rows, queue of later updates) atomically; the
+        queue ends with a sentinel once the exporting run finishes."""
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            frontier = self._frontier
+            hist = list(self._history)
+            finished = self._finished
+            if not finished:
+                self._queues.append(q)
+        rows = _consolidate(hist, frontier, q.put)
+        if finished:
+            q.put(_FINISHED)
+        return frontier, rows, q
+
+    def _drop_queue(self, q: queue.Queue) -> None:
+        with self._lock:
+            try:
+                self._queues.remove(q)
+            except ValueError:
+                pass
+
+
+class _ImportConnector(BaseConnector):
+    """Emits the exported snapshot, then streams later updates until the
+    exporting run finishes (or this run stops)."""
+
+    heartbeat_ms = 500
+
+    def __init__(self, node, exported: ExportedTable, follow: bool = True):
+        super().__init__(node)
+        self.exported = exported
+        self.follow = follow
+
+    def run(self) -> None:
+        frontier, rows, updates = self.exported.consistent_handoff()
+        try:
+            self.commit_rows([(key, row, 1) for key, row in rows])
+            if not self.follow:
+                return
+            while not self.should_stop():
+                try:
+                    item = updates.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if item is _FINISHED:
+                    return
+                batch = [(item[1], item[2], item[3])]
+                # drain whatever else is queued into one commit
+                while True:
+                    try:
+                        nxt = updates.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is _FINISHED:
+                        self.commit_rows(batch)
+                        return
+                    batch.append((nxt[1], nxt[2], nxt[3]))
+                self.commit_rows(batch)
+        finally:
+            self.exported._drop_queue(updates)
+
+
+def export_table(table: Table) -> ExportedTable:
+    """Attach an export capture to ``table`` (reference ``Scope.export_table``)."""
+    return ExportedTable(table)
+
+
+def import_table(exported: ExportedTable, *, follow: bool = True) -> Table:
+    """Materialize an :class:`ExportedTable` in the CURRENT graph: snapshot
+    at the exported frontier, then (``follow=True``) live updates until the
+    exporting run finishes."""
+    cols = list(exported.column_names)
+    node = InputNode(G.engine_graph, cols, name=f"import({','.join(cols)})")
+    conn = _ImportConnector(node, exported, follow=follow)
+    G.register_connector(conn)
+    return Table(node, exported.schema, Universe())
