@@ -76,24 +76,28 @@ def _search_kernel(corpus, valid_mask, queries, k: int, metric: str,
 @functools.partial(
     jax.jit, donate_argnums=(0, 1, 2), static_argnames=("normalize",)
 )
-def _append_kernel(corpus, valid, n_dev, v, normalize: bool):
+def _append_kernel(corpus, valid, n_dev, v, m, normalize: bool):
     """One fused dispatch for the whole append: normalise (optional), cast,
     write the corpus rows + valid flags, and advance the device-resident
     write cursor. Donating corpus/valid makes the update in-place in HBM.
     The cursor lives ON DEVICE (``n_dev``): shipping a fresh start offset
     from the host each call would cost one h2d transfer per append — ~12ms
-    on a tunneled dev host, dwarfing the update itself."""
+    on a tunneled dev host, dwarfing the update itself.
+
+    ``v`` is padded to a pow2 row bucket with ``m`` the real count:
+    streaming commits have ragged sizes, and one executable per BUCKET (not
+    per size) keeps XLA from recompiling mid-stream. Pad rows land beyond
+    the cursor with valid=False and are overwritten by the next append."""
     v = v.astype(jnp.float32)
     if normalize:
         v = _normalize(v)
+    vmask = jnp.arange(v.shape[0]) < m  # derived in-kernel: no extra h2d
     start = n_dev
     corpus = jax.lax.dynamic_update_slice(
         corpus, v.astype(corpus.dtype), (start, 0)
     )
-    valid = jax.lax.dynamic_update_slice(
-        valid, jnp.ones((v.shape[0],), dtype=bool), (start,)
-    )
-    return corpus, valid, n_dev + v.shape[0]
+    valid = jax.lax.dynamic_update_slice(valid, vmask, (start,))
+    return corpus, valid, n_dev + m
 
 
 def _use_pallas() -> bool:
@@ -159,13 +163,24 @@ class BruteForceKnnIndex:
 
     def _append(self, keys: list, v, normalize: bool) -> None:
         """Shared append: v is a (m, d) array; normalised on device iff
-        ``normalize`` (host callers pre-normalise in _prep)."""
+        ``normalize`` (host callers pre-normalise in _prep). Rows pad to a
+        pow2 bucket so ragged streaming commits reuse one executable per
+        bucket size."""
         m = len(keys)
+        # growth is driven by REAL rows only — growing for transient pad
+        # rows would double capacity (and recompile every kernel) exactly
+        # when reserved_space was sized to the corpus. If the pad bucket
+        # would overflow remaining capacity, shrink it to fit (only happens
+        # on the final boundary commit).
         self._grow(self.n + m)
         start = self.n
+        bucket = min(next_pow2(m, 16), self.capacity - self.n)
+        v = jnp.asarray(v)
+        if bucket > m:
+            v = jnp.pad(v, ((0, bucket - m), (0, 0)))
         self._corpus, self._valid, self._n_dev = _append_kernel(
-            self._corpus, self._valid, self._n_dev, jnp.asarray(v),
-            normalize=normalize,
+            self._corpus, self._valid, self._n_dev, v,
+            jnp.asarray(m, jnp.int32), normalize=normalize,
         )
         for i, key in enumerate(keys):
             self._slot_of[key] = start + i
