@@ -295,9 +295,10 @@ def config4_streaming_engine() -> dict:
         BruteForceKnn(
             embedded.vec,
             dimensions=MINILM_L6.hidden,
-            # one pad-bucket of slack on top of the corpus: no mid-stream
-            # regrowth AND no clamped-tail append shapes
-            reserved_space=N_DOCS + 512,
+            # MUST match the warm-up index: jit executables key on the
+            # corpus capacity shape. The exact-fit corpus accepts one
+            # clamped-tail append shape on the final commit at most.
+            reserved_space=N_DOCS,
             metric="cos",
         ),
     )

@@ -59,17 +59,17 @@ def _normalize(v):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "metric", "normalize", "bucket")
+    jax.jit, static_argnames=("k", "metric", "normalize")
 )
 def _search_kernel(corpus, valid_mask, queries, k: int, metric: str,
-                   normalize: bool = False, bucket: int = 0):
+                   normalize: bool = False):
     """One fused dispatch for the whole search: cast, normalise (optional),
-    pad the query axis to ``bucket`` rows, gemm + top_k."""
+    gemm + top_k. Queries arrive ALREADY padded to their pow2 bucket —
+    padding outside the jit makes the executable cache key on the BUCKET,
+    not the raw query count (nq=3 and nq=5 share the bucket-16 binary)."""
     q = queries.astype(jnp.float32)
     if normalize:
         q = _normalize(q)
-    if bucket > q.shape[0]:
-        q = jnp.pad(q, ((0, bucket - q.shape[0]), (0, 0)))
     return jax.lax.top_k(knn_scores(corpus, valid_mask, q, metric), k)
 
 
@@ -98,6 +98,19 @@ def _append_kernel(corpus, valid, n_dev, v, m, normalize: bool):
     )
     valid = jax.lax.dynamic_update_slice(valid, vmask, (start,))
     return corpus, valid, n_dev + m
+
+
+_M_SCALARS: dict[int, Any] = {}
+
+
+def _m_scalar(m: int):
+    """Cached device scalar for the append row count — a fresh h2d transfer
+    per append would cost a full round trip on a tunneled host."""
+    s = _M_SCALARS.get(m)
+    if s is None:
+        s = jnp.asarray(m, jnp.int32)
+        _M_SCALARS[m] = s
+    return s
 
 
 def _use_pallas() -> bool:
@@ -175,12 +188,17 @@ class BruteForceKnnIndex:
         self._grow(self.n + m)
         start = self.n
         bucket = min(next_pow2(m, 16), self.capacity - self.n)
-        v = jnp.asarray(v)
-        if bucket > m:
-            v = jnp.pad(v, ((0, bucket - m), (0, 0)))
+        if isinstance(v, np.ndarray) or not isinstance(v, jax.Array):
+            v_host = np.asarray(v, dtype=np.float32)
+            if bucket > m:
+                v_host = np.pad(v_host, ((0, bucket - m), (0, 0)))
+            v = jnp.asarray(v_host)
+        else:
+            if bucket > m:
+                v = jnp.pad(v, ((0, bucket - m), (0, 0)))
         self._corpus, self._valid, self._n_dev = _append_kernel(
             self._corpus, self._valid, self._n_dev, v,
-            jnp.asarray(m, jnp.int32), normalize=normalize,
+            _m_scalar(m), normalize=normalize,
         )
         for i, key in enumerate(keys):
             self._slot_of[key] = start + i
@@ -227,11 +245,29 @@ class BruteForceKnnIndex:
         streaming pipeline can dispatch many searches and drain results with
         one ``jax.device_get`` (device→host fetches dominate end-to-end
         latency when the host is remote from the chip)."""
-        q = jnp.asarray(queries)
-        if q.ndim == 1:
-            q = q[None, :]
-        nq = q.shape[0]
-        bucket = next_pow2(nq, 16)
+        # pad the query axis to its pow2 bucket BEFORE the jit boundary:
+        # host arrays pad for free in numpy; device arrays pay one tiny
+        # cached pad op — either way the big gemm+top_k executable is
+        # shared per bucket instead of per raw query count
+        if isinstance(queries, np.ndarray) or not isinstance(
+            queries, jax.Array
+        ):
+            q_host = np.asarray(queries, dtype=np.float32)
+            if q_host.ndim == 1:
+                q_host = q_host[None, :]
+            nq = q_host.shape[0]
+            bucket = next_pow2(nq, 16)
+            if bucket > nq:
+                q_host = np.pad(q_host, ((0, bucket - nq), (0, 0)))
+            q = jnp.asarray(q_host)
+        else:
+            q = queries
+            if q.ndim == 1:
+                q = q[None, :]
+            nq = q.shape[0]
+            bucket = next_pow2(nq, 16)
+            if bucket > nq:
+                q = jnp.pad(q, ((0, bucket - nq), (0, 0)))
         k_eff = min(k, self.capacity)
         normalize = self.metric == "cos"
         if _use_pallas():
@@ -240,14 +276,11 @@ class BruteForceKnnIndex:
             q = q.astype(jnp.float32)
             if normalize:
                 q = _normalize(q)
-            if bucket > nq:
-                q = jnp.pad(q, ((0, bucket - nq), (0, 0)))
             scores, idx = fused_topk(self._corpus, self._valid, q, k_eff,
                                      self.metric)
         else:
             scores, idx = _search_kernel(self._corpus, self._valid, q, k_eff,
-                                         self.metric, normalize=normalize,
-                                         bucket=bucket)
+                                         self.metric, normalize=normalize)
         return scores, idx
 
     def resolve(self, scores, idx, nq: int, k: int) -> list[list[tuple[Any, float]]]:

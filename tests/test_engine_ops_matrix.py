@@ -1,0 +1,317 @@
+"""Engine operator behavior matrix — buffer/forget/freeze lateness
+operators, flatten, concat variants, ix defaults, asof_now, error
+propagation paths (reference ``time_column.rs`` + operator tests)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows, run_all_and_collect
+
+
+# -------------------------------------------------------- lateness operators
+def test_forget_drops_rows_behind_threshold():
+    t = T(
+        """
+        t  | v | __time__
+        1  | a | 2
+        10 | b | 4
+        2  | c | 6
+        """
+    )
+    # forget when watermark >= t+5, i.e. rows older than 5 ticks
+    out = t._forget(
+        threshold_column=t.t + 5, time_column=t.t
+    )
+    rows, cols = _capture_rows(out)
+    got = sorted(r[cols.index("v")] for r in rows.values())
+    assert "b" in got
+    assert "a" not in got  # forgotten after the watermark passed
+
+
+def test_freeze_ignores_late_rows_without_retraction():
+    t = T(
+        """
+        t  | v | __time__
+        1  | a | 2
+        10 | b | 4
+        2  | c | 6
+        """
+    )
+    out = t._freeze(threshold_column=t.t + 5, time_column=t.t)
+    rows, cols = _capture_rows(out)
+    got = sorted(r[cols.index("v")] for r in rows.values())
+    # a arrived before the watermark passed it: stays frozen in the output;
+    # c arrived already behind the watermark: dropped
+    assert "a" in got and "b" in got and "c" not in got
+
+
+def test_buffer_delays_until_threshold():
+    t = T(
+        """
+        t | v | __time__
+        5 | a | 2
+        9 | b | 4
+        """
+    )
+    # buffer until the watermark (max t seen) passes t+2
+    out = t._buffer(threshold_column=t.t + 2, time_column=t.t)
+    updates = run_all_and_collect(out)
+    rows, cols = _capture_rows(out)
+    got = sorted(r[cols.index("v")] for r in rows.values())
+    # a released when t=9 arrived (9 >= 5+2); b still buffered at end of
+    # a bounded run is flushed on close
+    assert "a" in got
+
+
+# ------------------------------------------------------------------ flatten
+def test_flatten_tuple_column_multiplies_rows():
+    t = T(
+        """
+        k
+        a
+        """
+    )
+    t2 = t.select(t.k, parts=pw.apply_with_type(
+        lambda _: (1, 2, 3), tuple, pw.this.k
+    ))
+    flat = t2.flatten(t2.parts)
+    rows, cols = _capture_rows(flat)
+    assert sorted(r[cols.index("parts")] for r in rows.values()) == [1, 2, 3]
+
+
+def test_flatten_empty_tuple_produces_no_rows():
+    t = T(
+        """
+        k
+        a
+        """
+    )
+    t2 = t.select(t.k, parts=pw.apply_with_type(
+        lambda _: (), tuple, pw.this.k
+    ))
+    flat = t2.flatten(t2.parts)
+    rows, _ = _capture_rows(flat)
+    assert rows == {}
+
+
+def test_flatten_string_column_to_chars():
+    t = T(
+        """
+        s
+        ab
+        """
+    )
+    flat = t.flatten(t.s)
+    rows, cols = _capture_rows(flat)
+    assert sorted(r[cols.index("s")] for r in rows.values()) == ["a", "b"]
+
+
+# ------------------------------------------------------------------- concat
+def test_concat_same_universe_disjoint_keys():
+    t1 = T(
+        """
+          | a
+        1 | 10
+        """
+    )
+    t2 = T(
+        """
+          | a
+        2 | 20
+        """
+    )
+    pw.universes.promise_are_pairwise_disjoint(t1, t2)
+    out = t1.concat(t2)
+    rows, _ = _capture_rows(out)
+    assert sorted(r[0] for r in rows.values()) == [10, 20]
+
+
+def test_concat_reindex_allows_key_overlap():
+    t1 = T(
+        """
+          | a
+        1 | 10
+        """
+    )
+    t2 = T(
+        """
+          | a
+        1 | 20
+        """
+    )
+    out = t1.concat_reindex(t2)
+    rows, _ = _capture_rows(out)
+    assert sorted(r[0] for r in rows.values()) == [10, 20]
+
+
+# ----------------------------------------------------------------------- ix
+def test_ix_missing_key_is_error():
+    base = T(
+        """
+        a | v
+        1 | 10
+        """
+    )
+    keyed = base.with_id_from(base.a)
+    probe = T(
+        """
+        a
+        2
+        """
+    )
+    res = probe.select(
+        v=pw.fill_error(keyed.ix(keyed.pointer_from(probe.a)).v, -1)
+    )
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("v")] == -1
+
+
+def test_ix_optional_returns_none():
+    base = T(
+        """
+        a | v
+        1 | 10
+        """
+    )
+    keyed = base.with_id_from(base.a)
+    probe = T(
+        """
+        a
+        2
+        """
+    )
+    res = probe.select(
+        v=keyed.ix(keyed.pointer_from(probe.a), optional=True).v
+    )
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("v")] is None
+
+
+# -------------------------------------------------------------------- asof
+def test_asof_now_join_answers_against_current_state():
+    data = T(
+        """
+        k | v | __time__
+        x | 1 | 2
+        x | 2 | 6
+        """
+    )
+    queries = T(
+        """
+        k | __time__
+        x | 4
+        """
+    )
+    res = queries.asof_now_join(data, queries.k == data.k).select(
+        queries.k, data.v
+    )
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    # answered at query time (engine time 4): sees v=1, does NOT update to 2
+    assert row[cols.index("v")] == 1
+
+
+# ------------------------------------------------------------------- errors
+def test_error_in_filter_condition_drops_to_error_log():
+    from pathway_tpu.internals.errors import get_global_error_log
+
+    t = T(
+        """
+        a | b
+        1 | 0
+        2 | 1
+        """
+    )
+    res = t.filter(pw.fill_error(t.a // t.b > 0, False))
+    rows, _ = _capture_rows(res)
+    assert len(rows) == 1  # the divide-by-zero row filtered out, run survives
+
+
+def test_error_propagates_through_select_chain():
+    t = T(
+        """
+        a | b
+        1 | 0
+        """
+    )
+    res = t.select(x=t.a // t.b).select(y=pw.this.x + 1).select(
+        z=pw.fill_error(pw.this.y, -9)
+    )
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("z")] == -9
+
+
+def test_terminate_on_error_run_raises(tmp_path):
+    from pathway_tpu.internals.errors import EngineError
+
+    t = T(
+        """
+        a | b
+        1 | 0
+        """
+    )
+    bad = t.select(x=t.a // t.b)
+    out = tmp_path / "x.jsonl"
+    pw.io.jsonlines.write(bad, str(out))
+    with pytest.raises(EngineError):
+        pw.run()
+
+
+def test_global_error_log_collects_messages():
+    from pathway_tpu.internals.errors import get_global_error_log
+
+    t = T(
+        """
+        a | b
+        1 | 0
+        """
+    )
+    res = t.select(x=pw.fill_error(t.a // t.b, -1))
+    _capture_rows(res)
+    assert any(
+        "division" in e["message"].lower() or "zero" in e["message"].lower()
+        for e in get_global_error_log().entries
+    )
+
+
+# ------------------------------------------------------------------ having
+def test_having_restricts_to_present_keys():
+    queries = T(
+        """
+        q
+        1
+        3
+        """
+    )
+    data = T(
+        """
+        k | v
+        1 | 10
+        2 | 20
+        """
+    )
+    keyed = data.with_id_from(data.k)
+    res = queries.having(keyed.ix_ref(queries.q, optional=True))
+    rows, _ = _capture_rows(res)
+    assert len(rows) == 1
+
+
+def test_groupby_then_join_back_enrichment():
+    t = T(
+        """
+        g | v
+        a | 1
+        a | 2
+        b | 5
+        """
+    )
+    stats = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+    enriched = t.join(stats, t.g == stats.g).select(
+        t.g, t.v, share=t.v / stats.s
+    )
+    rows, cols = _capture_rows(enriched)
+    shares = sorted(round(r[cols.index("share")], 2) for r in rows.values())
+    assert shares == [0.33, 0.67, 1.0]

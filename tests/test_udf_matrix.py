@@ -1,0 +1,274 @@
+"""UDF system matrix — sync/async execution, batching, caching, retries,
+timeouts, propagation of None/ERROR (reference ``test_udfs.py``)."""
+
+import asyncio
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows
+
+
+def _one_col(res, col):
+    rows, cols = _capture_rows(res)
+    i = cols.index(col)
+    return sorted(r[i] for r in rows.values())
+
+
+def test_sync_udf_basic():
+    @pw.udf
+    def double(x: int) -> int:
+        return x * 2
+
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    assert _one_col(t.select(b=double(t.a)), "b") == [2, 4]
+
+
+def test_async_udf_executes():
+    @pw.udf
+    async def slow_double(x: int) -> int:
+        await asyncio.sleep(0.01)
+        return x * 2
+
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    assert _one_col(t.select(b=slow_double(t.a)), "b") == [2, 4, 6]
+
+
+def test_async_udf_concurrent_not_serial():
+    calls = []
+
+    @pw.udf
+    async def tracked(x: int) -> int:
+        calls.append(("start", x))
+        await asyncio.sleep(0.05)
+        calls.append(("end", x))
+        return x
+
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        4
+        """
+    )
+    start = time.perf_counter()
+    _one_col(t.select(b=tracked(t.a)), "b")
+    elapsed = time.perf_counter() - start
+    # four 50ms sleeps executed concurrently, not 200ms serially
+    assert elapsed < 1.0
+    starts = [i for i, c in enumerate(calls) if c[0] == "start"]
+    ends = [i for i, c in enumerate(calls) if c[0] == "end"]
+    assert min(ends) > max(starts[:2])  # overlap happened
+
+
+def test_udf_batched_receives_lists():
+    seen_batches = []
+
+    class BatchDouble(pw.UDF):
+        def __init__(self):
+            super().__init__(deterministic=True, batch=True, max_batch_size=10)
+
+        def __wrapped__(self, xs, **kwargs):
+            seen_batches.append(len(xs))
+            return [x * 2 for x in xs]
+
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    bd = BatchDouble()
+    assert _one_col(t.select(b=bd(t.a)), "b") == [2, 4, 6]
+    assert sum(seen_batches) == 3
+    assert max(seen_batches) >= 2  # actually batched
+
+
+def test_udf_in_memory_cache_dedups_calls():
+    calls = []
+
+    @pw.udf(cache_strategy=pw.udfs.InMemoryCache(), deterministic=True)
+    def counted(x: int) -> int:
+        calls.append(x)
+        return x + 1
+
+    t = T(
+        """
+        a
+        5
+        5
+        5
+        """
+    )
+    assert _one_col(t.select(b=counted(t.a)), "b") == [6, 6, 6]
+    assert len(calls) == 1  # one unique argument -> one call
+
+
+def test_udf_disk_cache_shared_by_name(tmp_path, monkeypatch):
+    monkeypatch.setenv("PATHWAY_PERSISTENT_STORAGE", str(tmp_path))
+    calls = []
+
+    @pw.udf(cache_strategy=pw.udfs.DiskCache(name="shared"), deterministic=True)
+    def counted(x: int) -> int:
+        calls.append(x)
+        return x * 3
+
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        9
+        """
+    )
+    assert _one_col(t.select(b=counted(t.a)), "b") == [27]
+    pw.clear_graph()
+
+    # same UDF name: the cache key is (function name, args)
+    @pw.udf(cache_strategy=pw.udfs.DiskCache(name="shared"), deterministic=True)
+    def counted(x: int) -> int:  # noqa: F811
+        calls.append(("second", x))
+        return x * 3
+
+    t2 = pw.debug.table_from_markdown(
+        """
+        a
+        9
+        """
+    )
+    # cache keyed by args and shared by cache name: second run hits
+    assert _one_col(t2.select(b=counted(t2.a)), "b") == [27]
+    assert calls == [9]
+
+
+def test_async_udf_retry_strategy():
+    attempts = []
+
+    @pw.udf(
+        executor=pw.udfs.async_executor(
+            retry_strategy=pw.udfs.FixedDelayRetryStrategy(
+                max_retries=4, delay_ms=5
+            )
+        )
+    )
+    async def flaky(x: int) -> int:
+        attempts.append(x)
+        if len(attempts) < 3:
+            raise RuntimeError("transient")
+        return x
+
+    t = T(
+        """
+        a
+        7
+        """
+    )
+    assert _one_col(t.select(b=flaky(t.a)), "b") == [7]
+    assert len(attempts) == 3
+
+
+def test_udf_exception_becomes_error_value():
+    @pw.udf
+    def boom(x: int) -> int:
+        raise ValueError("nope")
+
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    res = t.select(b=pw.fill_error(boom(t.a), -1))
+    assert _one_col(res, "b") == [-1]
+
+
+def test_udf_none_argument_passed_through():
+    @pw.udf
+    def show(x) -> str:
+        return "none" if x is None else "some"
+
+    t = T(
+        """
+        a | b
+        1 |
+        """
+    )
+    assert _one_col(t.select(c=show(t.b)), "c") == ["none"]
+
+
+def test_udf_capacity_limits_concurrency():
+    active = [0]
+    peak = [0]
+
+    @pw.udf(executor=pw.udfs.async_executor(capacity=2))
+    async def limited(x: int) -> int:
+        active[0] += 1
+        peak[0] = max(peak[0], active[0])
+        await asyncio.sleep(0.02)
+        active[0] -= 1
+        return x
+
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        4
+        5
+        6
+        """
+    )
+    assert len(_one_col(t.select(b=limited(t.a)), "b")) == 6
+    assert peak[0] <= 2
+
+
+def test_async_transformer_multi_output():
+    class Doubler(pw.AsyncTransformer, output_schema=pw.schema_from_types(
+        doubled=int, squared=int
+    )):
+        async def invoke(self, a) -> dict:
+            return {"doubled": a * 2, "squared": a * a}
+
+    t = T(
+        """
+        a
+        3
+        """
+    )
+    res = Doubler(input_table=t).successful
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("doubled")] == 6
+    assert row[cols.index("squared")] == 9
+
+
+def test_udf_expression_composition():
+    @pw.udf
+    def inc(x: int) -> int:
+        return x + 1
+
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    # UDF results compose with expressions and other UDFs
+    assert _one_col(t.select(b=inc(inc(t.a)) * 10), "b") == [30]
