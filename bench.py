@@ -275,7 +275,7 @@ def config4_streaming_engine() -> dict:
     from pathway_tpu.ops.knn import BruteForceKnnIndex as _Knn
 
     warm_idx = _Knn(
-        dimensions=MINILM_L6.hidden, reserved_space=N_DOCS, metric="cos"
+        dimensions=MINILM_L6.hidden, reserved_space=N_DOCS + 512, metric="cos"
     )
     warm_vecs = rng.standard_normal((512, MINILM_L6.hidden)).astype("float32")
     # ragged commits hit every pow2 bucket: warm the full ladder for both
@@ -296,9 +296,11 @@ def config4_streaming_engine() -> dict:
             embedded.vec,
             dimensions=MINILM_L6.hidden,
             # MUST match the warm-up index: jit executables key on the
-            # corpus capacity shape. The exact-fit corpus accepts one
-            # clamped-tail append shape on the final commit at most.
-            reserved_space=N_DOCS,
+            # corpus capacity shape. The pad-bucket of slack means ragged
+            # commits NEVER clamp to odd tail shapes (the cost — capacity
+            # rounds 4608 up to 8192, doubling the per-search gemm — is
+            # noise here: searches are dispatch-RTT-bound at this size).
+            reserved_space=N_DOCS + 512,
             metric="cos",
         ),
     )

@@ -105,9 +105,13 @@ _M_SCALARS: dict[int, Any] = {}
 
 def _m_scalar(m: int):
     """Cached device scalar for the append row count — a fresh h2d transfer
-    per append would cost a full round trip on a tunneled host."""
+    per append would cost a full round trip on a tunneled host. Bounded: a
+    bulk loader with wildly varied commit sizes must not pin device buffers
+    for the process lifetime."""
     s = _M_SCALARS.get(m)
     if s is None:
+        if len(_M_SCALARS) >= 256:
+            _M_SCALARS.clear()
         s = jnp.asarray(m, jnp.int32)
         _M_SCALARS[m] = s
     return s
@@ -188,14 +192,13 @@ class BruteForceKnnIndex:
         self._grow(self.n + m)
         start = self.n
         bucket = min(next_pow2(m, 16), self.capacity - self.n)
-        if isinstance(v, np.ndarray) or not isinstance(v, jax.Array):
+        if not isinstance(v, jax.Array):
             v_host = np.asarray(v, dtype=np.float32)
             if bucket > m:
                 v_host = np.pad(v_host, ((0, bucket - m), (0, 0)))
             v = jnp.asarray(v_host)
-        else:
-            if bucket > m:
-                v = jnp.pad(v, ((0, bucket - m), (0, 0)))
+        elif bucket > m:
+            v = jnp.pad(v, ((0, bucket - m), (0, 0)))
         self._corpus, self._valid, self._n_dev = _append_kernel(
             self._corpus, self._valid, self._n_dev, v,
             _m_scalar(m), normalize=normalize,
@@ -249,25 +252,17 @@ class BruteForceKnnIndex:
         # host arrays pad for free in numpy; device arrays pay one tiny
         # cached pad op — either way the big gemm+top_k executable is
         # shared per bucket instead of per raw query count
-        if isinstance(queries, np.ndarray) or not isinstance(
-            queries, jax.Array
-        ):
-            q_host = np.asarray(queries, dtype=np.float32)
-            if q_host.ndim == 1:
-                q_host = q_host[None, :]
-            nq = q_host.shape[0]
-            bucket = next_pow2(nq, 16)
-            if bucket > nq:
-                q_host = np.pad(q_host, ((0, bucket - nq), (0, 0)))
-            q = jnp.asarray(q_host)
-        else:
-            q = queries
-            if q.ndim == 1:
-                q = q[None, :]
-            nq = q.shape[0]
-            bucket = next_pow2(nq, 16)
-            if bucket > nq:
-                q = jnp.pad(q, ((0, bucket - nq), (0, 0)))
+        is_device = isinstance(queries, jax.Array)
+        q = queries if is_device else np.asarray(queries, dtype=np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        nq = q.shape[0]
+        bucket = next_pow2(nq, 16)
+        if bucket > nq:
+            pad_spec = ((0, bucket - nq), (0, 0))
+            q = jnp.pad(q, pad_spec) if is_device else np.pad(q, pad_spec)
+        if not is_device:
+            q = jnp.asarray(q)
         k_eff = min(k, self.capacity)
         normalize = self.metric == "cos"
         if _use_pallas():

@@ -1,0 +1,200 @@
+"""Streaming-update semantics — how operator outputs EVOLVE across epochs
+(reference ``temporal/test_windows_stream.py`` / ``test_asof_joins_stream`` /
+``test_interval_joins_stream`` style): every test pins the full update
+stream (time-ordered diffs), not just the final state."""
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows, run_all_and_collect
+
+
+def _stream(table):
+    """[(time, row, diff)] sorted by engine time then content."""
+    ups = run_all_and_collect(table)
+    return [(u[0], tuple(u[2]), u[3]) for u in ups]
+
+
+def test_groupby_count_update_stream():
+    t = T(
+        """
+        g | __time__
+        a | 2
+        a | 4
+        """
+    )
+    counts = t.groupby(t.g).reduce(t.g, c=pw.reducers.count())
+    ups = _stream(counts)
+    # time 2: +(a,1); time 4: -(a,1), +(a,2)
+    assert ups == [
+        (2, ("a", 1), 1),
+        (4, ("a", 1), -1),
+        (4, ("a", 2), 1),
+    ]
+
+
+def test_filter_update_stream_passes_diffs():
+    t = T(
+        """
+        v | __time__ | __diff__
+        5 | 2        | 1
+        5 | 4        | -1
+        """
+    )
+    f = t.filter(t.v > 1)
+    assert _stream(f) == [(2, (5,), 1), (4, (5,), -1)]
+
+
+def test_tumbling_window_stream_reopens_on_late_row():
+    t = T(
+        """
+        t | v | __time__
+        1 | 1 | 2
+        7 | 2 | 4
+        2 | 4 | 6
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5)
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    ups = _stream(res)
+    # window [0,5): +1 at t2; window [5,10): +2 at t4;
+    # late row at t6 retracts (1) and emits (5)
+    assert (2, (1,), 1) in ups
+    assert (4, (2,), 1) in ups
+    assert (6, (1,), -1) in ups and (6, (5,), 1) in ups
+
+
+def test_interval_join_stream_matches_appear_incrementally():
+    left = T(
+        """
+        t | a | __time__
+        3 | x | 2
+        """
+    )
+    right = T(
+        """
+        t | b | __time__
+        3 | p | 4
+        4 | q | 6
+        """
+    )
+    res = pw.temporal.interval_join(
+        left, right, left.t, right.t, pw.temporal.interval(0, 1)
+    ).select(pw.left.a, pw.right.b)
+    ups = _stream(res)
+    assert (4, ("x", "p"), 1) in ups
+    assert (6, ("x", "q"), 1) in ups
+    assert not any(d < 0 for _, _, d in ups)  # inner join only adds
+
+
+def test_asof_join_stream_retracts_previous_best():
+    left = T(
+        """
+        t | a | __time__
+        5 | x | 2
+        """
+    )
+    right = T(
+        """
+        t | b | __time__
+        1 | p | 4
+        4 | q | 6
+        """
+    )
+    res = pw.temporal.asof_join(
+        left, right, left.t, right.t
+    ).select(pw.left.a, pw.right.b)
+    ups = _stream(res)
+    # p is the best match at t4; q supersedes it at t6 with a retraction
+    assert (4, ("x", "p"), 1) in ups
+    assert (6, ("x", "p"), -1) in ups
+    assert (6, ("x", "q"), 1) in ups
+
+
+def test_distinct_groupby_idempotent_updates_suppressed():
+    # re-inserting an identical row updates the count but an unchanged
+    # aggregation value must NOT emit retract+insert noise
+    t = T(
+        """
+        g | v | __time__
+        a | 7 | 2
+        a | 7 | 4
+        """
+    )
+    res = t.groupby(t.g).reduce(t.g, m=pw.reducers.max(t.v))
+    ups = _stream(res)
+    assert ups == [(2, ("a", 7), 1)]  # second row changes nothing emitted
+
+
+def test_join_stream_right_insert_after_left():
+    left = T(
+        """
+        k | a | __time__
+        x | 1 | 2
+        """
+    )
+    right = T(
+        """
+        k | b | __time__
+        x | 5 | 6
+        """
+    )
+    res = left.join(right, left.k == right.k).select(left.a, right.b)
+    ups = _stream(res)
+    assert ups == [(6, (1, 5), 1)]
+
+
+def test_union_stream_interleaves_sources():
+    t1 = T(
+        """
+        v | __time__
+        1 | 2
+        """
+    )
+    t2 = T(
+        """
+        v | __time__
+        2 | 4
+        """
+    )
+    u = t1.concat_reindex(t2)
+    ups = _stream(u)
+    assert [(time, row[0]) for time, row, _ in ups] == [(2, 1), (4, 2)]
+
+
+def test_subscribe_on_time_end_fires_per_epoch():
+    t = T(
+        """
+        v | __time__
+        1 | 2
+        2 | 4
+        """
+    )
+    ends = []
+    rows = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: rows.append(time),
+        on_time_end=lambda time: ends.append(time),
+    )
+    pw.run()
+    assert len(ends) >= 2
+    assert set(rows) <= set(ends)
+
+
+def test_window_cutoff_stream_no_updates_after_cutoff():
+    t = T(
+        """
+        t  | v | __time__
+        1  | 1 | 2
+        20 | 2 | 4
+        2  | 9 | 6
+        """
+    )
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.tumbling(duration=5),
+        behavior=pw.temporal.common_behavior(cutoff=1),
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    ups = _stream(res)
+    # nothing at time 6: the late t=2 row fell behind the cutoff
+    assert all(time != 6 for time, _row, _d in ups)
