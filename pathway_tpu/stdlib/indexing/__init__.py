@@ -9,7 +9,11 @@ exact index dominates it on TPU), ``TantivyBM25`` — host-side BM25,
 
 from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25, TantivyBM25Factory
 from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
-from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex, HybridIndexFactory
+from pathway_tpu.stdlib.indexing.hybrid_index import (
+    HybridIndex,
+    HybridIndexDataIndex,
+    HybridIndexFactory,
+)
 from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     BruteForceKnn,
     BruteForceKnnFactory,
@@ -65,6 +69,7 @@ __all__ = [
     "TantivyBM25",
     "TantivyBM25Factory",
     "HybridIndex",
+    "HybridIndexDataIndex",
     "HybridIndexFactory",
     "AbstractRetrieverFactory",
     "default_vector_document_index",

@@ -355,6 +355,25 @@ def _ensure_time_col(tagged, time_expr):
     return tagged.with_columns(__time_value=tagged._pw_window_end)
 
 
+def _merge_sessions(entries, time_of, predicate, max_gap):
+    """THE session-merge rule (shared by windowby sessions and session
+    window joins so the two can never drift): entries are time-sorted;
+    adjacent entries merge when ``predicate(prev_t, next_t)`` (or
+    ``next_t - prev_t <= max_gap``)."""
+    sessions: list[list] = [[entries[0]]]
+    for prev, nxt in zip(entries, entries[1:]):
+        pt, nt = time_of(prev), time_of(nxt)
+        if predicate is not None:
+            merge = predicate(pt, nt)
+        else:
+            merge = (nt - pt) <= max_gap
+        if merge:
+            sessions[-1].append(nxt)
+        else:
+            sessions.append([nxt])
+    return sessions
+
+
 def _session_tag_table(table, time_expr, window: SessionWindow, instance):
     """Tag rows with merged session windows per instance."""
     from pathway_tpu.internals.table import Table, _prepare_env
@@ -379,21 +398,9 @@ def _session_tag_table(table, time_expr, window: SessionWindow, instance):
         out: dict[int, tuple] = {}
         if not entries:
             return out
-        # merge into sessions
-        sessions: list[list[tuple[int, tuple]]] = []
-        cur: list[tuple[int, tuple]] = [entries[0]]
-        for prev, nxt in zip(entries, entries[1:]):
-            pt, nt = prev[1][ti], nxt[1][ti]
-            if predicate is not None:
-                merge = predicate(pt, nt)
-            else:
-                merge = (nt - pt) <= max_gap
-            if merge:
-                cur.append(nxt)
-            else:
-                sessions.append(cur)
-                cur = [nxt]
-        sessions.append(cur)
+        sessions = _merge_sessions(
+            entries, lambda e: e[1][ti], predicate, max_gap
+        )
         for sess in sessions:
             start = sess[0][1][ti]
             end = sess[-1][1][ti]
@@ -910,8 +917,6 @@ def window_join(left_table, right_table, t_left, t_right, window: Window, *on, h
     ``_window_join.py``)."""
     if hasattr(how, "value"):
         how = how.value
-    if isinstance(window, SessionWindow):
-        raise NotImplementedError("session window_join arrives with session joins")
 
     def factory(l_cols, r_cols, out_cols):
         lti = l_cols.index("__t")
@@ -920,6 +925,56 @@ def window_join(left_table, right_table, t_left, t_right, window: Window, *on, h
         rid = r_cols.index("__id")
         l_data = [i for i, c in enumerate(l_cols) if c.startswith("__l_")]
         r_data = [i for i, c in enumerate(r_cols) if c.startswith("__r_")]
+
+        def emit_pairs(out, ls, rs, w):
+            """Shared pairing per window id ``w`` with outer padding."""
+            if ls and rs:
+                for lk, lrow in ls:
+                    for rk, rrow in rs:
+                        out[hash_values(lk, rk, w)] = (
+                            tuple(lrow[i] for i in l_data)
+                            + (lrow[lid], lrow[lti])
+                            + tuple(rrow[i] for i in r_data)
+                            + (rrow[rid], rrow[rti])
+                        )
+            elif ls and how in ("left", "outer"):
+                for lk, lrow in ls:
+                    out[hash_values(lk, 0, w)] = (
+                        tuple(lrow[i] for i in l_data)
+                        + (lrow[lid], lrow[lti])
+                        + tuple(None for _ in r_data)
+                        + (None, None)
+                    )
+            elif rs and how in ("right", "outer"):
+                for rk, rrow in rs:
+                    out[hash_values(0, rk, w)] = (
+                        tuple(None for _ in l_data)
+                        + (None, None)
+                        + tuple(rrow[i] for i in r_data)
+                        + (rrow[rid], rrow[rti])
+                    )
+
+        def compute_session(inst, lrows, rrows):
+            # sessions merge over the UNION of both sides' times (reference
+            # ``_window_join.py`` session mode): a session window id cannot
+            # be assigned per row, so merge here and pair within sessions
+            entries = sorted(
+                [("l", k, row, row[lti]) for k, row in lrows.items()]
+                + [("r", k, row, row[rti]) for k, row in rrows.items()],
+                key=lambda e: (e[3], e[0], e[1]),
+            )
+            out: dict[int, tuple] = {}
+            if not entries:
+                return out
+            sessions = _merge_sessions(
+                entries, lambda e: e[3], window.predicate, window.max_gap
+            )
+            for sess in sessions:
+                w = (sess[0][3], sess[-1][3])
+                ls = [(k, row) for side, k, row, _t in sess if side == "l"]
+                rs = [(k, row) for side, k, row, _t in sess if side == "r"]
+                emit_pairs(out, ls, rs, w)
+            return out
 
         def compute(inst, lrows, rrows):
             from collections import defaultdict as dd
@@ -933,38 +988,11 @@ def window_join(left_table, right_table, t_left, t_right, window: Window, *on, h
             for rk, rrow in rrows.items():
                 for w in window.assign(rrow[rti]):
                     r_by_win[w].append((rk, rrow))
-            wins = set(l_by_win) | set(r_by_win)
-            for w in wins:
-                ls = l_by_win.get(w, [])
-                rs = r_by_win.get(w, [])
-                if ls and rs:
-                    for lk, lrow in ls:
-                        for rk, rrow in rs:
-                            out[hash_values(lk, rk, w)] = (
-                                tuple(lrow[i] for i in l_data)
-                                + (lrow[lid], lrow[lti])
-                                + tuple(rrow[i] for i in r_data)
-                                + (rrow[rid], rrow[rti])
-                            )
-                elif ls and how in ("left", "outer"):
-                    for lk, lrow in ls:
-                        out[hash_values(lk, 0, w)] = (
-                            tuple(lrow[i] for i in l_data)
-                            + (lrow[lid], lrow[lti])
-                            + tuple(None for _ in r_data)
-                            + (None, None)
-                        )
-                elif rs and how in ("right", "outer"):
-                    for rk, rrow in rs:
-                        out[hash_values(0, rk, w)] = (
-                            tuple(None for _ in l_data)
-                            + (None, None)
-                            + tuple(rrow[i] for i in r_data)
-                            + (rrow[rid], rrow[rti])
-                        )
+            for w in set(l_by_win) | set(r_by_win):
+                emit_pairs(out, l_by_win.get(w, []), r_by_win.get(w, []), w)
             return out
 
-        return compute
+        return compute_session if isinstance(window, SessionWindow) else compute
 
     return _binary_temporal(
         left_table, right_table, t_left, t_right, on, how, factory, [], "WindowJoin"

@@ -1,0 +1,194 @@
+"""Deep namespace matrix — ``.dt`` timezone/round/timestamp methods,
+``.str`` transforms, ``.num`` (reference ``test_expressions``/datetime
+tests)."""
+
+import pandas as pd
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows
+
+
+def _one(res, *names):
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    if len(names) == 1:
+        return row[cols.index(names[0])]
+    return tuple(row[cols.index(n)] for n in names)
+
+
+def _dt(s="2024-03-05T06:07:08"):
+    t = T(f"""
+    s
+    {s}
+    """)
+    return t.select(d=pw.this.s.dt.strptime("%Y-%m-%dT%H:%M:%S"))
+
+
+# ------------------------------------------------------------------- .dt
+def test_dt_day_of_week_and_year():
+    d = _dt()
+    res = d.select(dow=d.d.dt.day_of_week(), doy=d.d.dt.day_of_year())
+    dow, doy = _one(res, "dow", "doy")
+    assert dow == 1  # Tuesday
+    assert doy == 31 + 29 + 5  # 2024 is a leap year
+
+
+def test_dt_timestamp_units():
+    d = _dt("1970-01-01T00:01:00")
+    res = d.select(
+        s=d.d.dt.timestamp(unit="s"), ms=d.d.dt.timestamp(unit="ms")
+    )
+    s, ms = _one(res, "s", "ms")
+    assert s == 60 and ms == 60_000
+
+
+def test_dt_from_timestamp_roundtrip():
+    t = T("""
+    ts
+    120
+    """)
+    res = t.select(d=pw.this.ts.dt.from_timestamp(unit="s"))
+    res2 = res.select(back=pw.this.d.dt.timestamp(unit="s"))
+    assert _one(res2, "back") == 120
+
+
+def test_dt_round_and_floor_to_hours():
+    d = _dt("2024-03-05T06:40:00")
+    res = d.select(
+        r=d.d.dt.round(pd.Timedelta(hours=1)),
+        f=d.d.dt.floor(pd.Timedelta(hours=1)),
+    )
+    r, f = _one(res, "r", "f")
+    assert r.hour == 7 and f.hour == 6
+
+
+def test_dt_to_utc_and_back():
+    d = _dt("2024-06-01T12:00:00")
+    res = d.select(u=d.d.dt.to_utc(from_timezone="Europe/Paris"))
+    u = _one(res, "u")
+    assert u.hour == 10  # CEST is UTC+2 in June
+    res2 = res.select(
+        n=pw.this.u.dt.to_naive_in_timezone(timezone="Europe/Paris")
+    )
+    n = _one(res2, "n")
+    assert n.hour == 12
+
+
+def test_dt_add_duration_in_timezone_dst_transition():
+    # reference semantics (date_time.py:840): (to_utc + duration) back to
+    # naive — an ABSOLUTE day added across the Europe/Paris spring-forward
+    # (2024-03-31 02:00) lands one wall-clock hour later
+    d = _dt("2024-03-30T08:00:00")
+    res = d.select(
+        n=d.d.dt.add_duration_in_timezone(
+            pd.Timedelta(days=1), timezone="Europe/Paris"
+        )
+    )
+    n = _one(res, "n")
+    assert n.hour == 9 and n.day == 31
+
+
+def test_duration_unit_extractors():
+    t = T("""
+    a                   | b
+    2024-01-02T03:00:00 | 2024-01-01T00:00:00
+    """)
+    d = t.select(
+        a=pw.this.a.dt.strptime("%Y-%m-%dT%H:%M:%S"),
+        b=pw.this.b.dt.strptime("%Y-%m-%dT%H:%M:%S"),
+    )
+    res = d.select(
+        h=(d.a - d.b).dt.hours(),
+        m=(d.a - d.b).dt.minutes(),
+        s=(d.a - d.b).dt.seconds(),
+    )
+    assert _one(res, "h", "m", "s") == (27, 27 * 60, 27 * 3600)
+
+
+def test_int_to_duration():
+    t = T("""
+    n
+    90
+    """)
+    res = t.select(d=pw.this.n.dt.to_duration(unit="s"))
+    d = _one(res, "d")
+    assert d == pd.Timedelta(seconds=90)
+
+
+# ------------------------------------------------------------------- .str
+def test_str_title_capitalize_swapcase():
+    t = T("""
+    s
+    "hello world"
+    """)
+    res = t.select(
+        t1=t.s.str.title(),
+        c=t.s.str.capitalize(),
+        sw=t.s.str.swap_case(),
+    )
+    t1, c, sw = _one(res, "t1", "c", "sw")
+    assert t1 == "Hello World" and c == "Hello world" and sw == "HELLO WORLD"
+
+
+def test_str_remove_prefix_suffix():
+    t = T("""
+    s
+    prefix-core-suffix
+    """)
+    res = t.select(
+        a=t.s.str.removeprefix("prefix-"), b=t.s.str.removesuffix("-suffix")
+    )
+    a, b = _one(res, "a", "b")
+    assert a == "core-suffix" and b == "prefix-core"
+
+
+def test_str_parse_bool_variants():
+    t = T("""
+    s
+    "yes"
+    """)
+    res = t.select(b=t.s.str.parse_bool())
+    assert _one(res, "b") is True
+
+
+def test_str_to_bytes_and_len():
+    t = T("""
+    s
+    héllo
+    """)
+    res = t.select(b=t.s.str.to_bytes(), n=t.s.str.len())
+    b, n = _one(res, "b", "n")
+    assert b == "héllo".encode() and n == 5
+
+
+def test_str_reversed_and_contains():
+    t = T("""
+    s
+    abc
+    """)
+    res = t.select(r=t.s.str.reversed(), c=t.s.str.contains("b"))
+    r, c = _one(res, "r", "c")
+    assert r == "cba" and c is True
+
+
+# ------------------------------------------------------------------- .num
+def test_num_round_and_abs():
+    t = T("""
+    f
+    -2.567
+    """)
+    res = t.select(
+        r=pw.this.f.num.round(2), a=pw.this.f.num.abs()
+    )
+    r, a = _one(res, "r", "a")
+    assert r == -2.57 and a == 2.567
+
+
+def test_num_fill_na():
+    t = T("""
+    f
+    1.5
+    """)
+    t2 = t.select(f=pw.if_else(t.f > 1, t.f, t.f))
+    res = t.select(x=pw.this.f.num.fill_na(0.0))
+    assert _one(res, "x") == 1.5

@@ -1,0 +1,317 @@
+"""Index-layer matrix — BM25 scoring/updates, hybrid RRF fusion,
+intervals_over windows, window joins (reference ``stdlib/indexing`` +
+temporal tests)."""
+
+import numpy as np
+import pandas as pd
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows
+
+
+# -------------------------------------------------------------------- bm25
+def test_bm25_ranks_term_frequency():
+    from pathway_tpu.stdlib.indexing.bm25 import Bm25Index
+
+    idx = Bm25Index()
+    idx.add(
+        ["d1", "d2", "d3"],
+        [
+            "stream processing engine",
+            "stream stream stream everywhere",
+            "unrelated document about cats",
+        ],
+    )
+    res = idx.search(["stream"], k=2)
+    keys = [k for k, _ in res[0]]
+    assert keys[0] == "d2"  # highest tf
+    assert "d3" not in keys
+
+
+def test_bm25_idf_downweights_common_terms():
+    from pathway_tpu.stdlib.indexing.bm25 import Bm25Index
+
+    idx = Bm25Index()
+    idx.add(
+        ["d1", "d2", "d3"],
+        ["the cat", "the dog", "the bird rare"],
+    )
+    res = idx.search(["rare the"], k=3)
+    keys = [k for k, _ in res[0]]
+    assert keys[0] == "d3"  # 'rare' dominates the ubiquitous 'the'
+
+
+def test_bm25_remove_updates_results():
+    from pathway_tpu.stdlib.indexing.bm25 import Bm25Index
+
+    idx = Bm25Index()
+    idx.add(["d1", "d2"], ["alpha beta", "alpha gamma"])
+    idx.remove(["d1"])
+    res = idx.search(["alpha"], k=5)
+    assert [k for k, _ in res[0]] == ["d2"]
+    assert len(idx) == 1
+
+
+def test_tantivy_bm25_data_index_pipeline():
+    from pathway_tpu.stdlib.indexing import DataIndex, TantivyBM25
+
+    docs = T(
+        """
+        doc
+        apple pie recipe
+        car engine manual
+        """
+    )
+    index = DataIndex(docs, TantivyBM25(docs.doc))
+    queries = T(
+        """
+        q
+        engine
+        """
+    )
+    res = index.query_as_of_now(queries.q, number_of_matches=1)
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("doc")][0] == "car engine manual"
+
+
+# ------------------------------------------------------------------ hybrid
+def test_hybrid_rrf_fuses_vector_and_text():
+    from pathway_tpu.stdlib.indexing import (
+        BruteForceKnn,
+        HybridIndexDataIndex,
+        TantivyBM25,
+        DataIndex,
+    )
+
+    @pw.udf
+    def embed(text: str) -> np.ndarray:
+        rng = np.random.default_rng(abs(hash(text.split()[0])) % (2**32))
+        v = rng.normal(size=8)
+        return v / np.linalg.norm(v)
+
+    docs = pw.debug.table_from_pandas(
+        pd.DataFrame({"doc": ["alpha text", "beta text", "gamma text"]})
+    )
+    # one TEXT query feeds both: the vector side embeds it, BM25 tokenizes
+    vec_idx = DataIndex(
+        docs, BruteForceKnn(docs.doc, dimensions=8, embedder=embed)
+    )
+    txt_idx = DataIndex(docs, TantivyBM25(docs.doc))
+    hybrid = HybridIndexDataIndex([vec_idx, txt_idx])
+    queries = pw.debug.table_from_pandas(pd.DataFrame({"qt": ["beta text"]}))
+    res = hybrid.query_as_of_now(queries.qt, number_of_matches=1)
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("doc")][0] == "beta text"
+
+
+# ----------------------------------------------------------- intervals_over
+def test_intervals_over_aggregates_per_at_point():
+    data = T(
+        """
+        t | v
+        1 | 1
+        2 | 2
+        3 | 4
+        8 | 8
+        """
+    )
+    probes = T(
+        """
+        at
+        2
+        8
+        """
+    )
+    res = data.windowby(
+        data.t,
+        window=pw.temporal.intervals_over(
+            at=probes.at, lower_bound=-1, upper_bound=1
+        ),
+    ).reduce(
+        pw.this._pw_window_location,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    rows, cols = _capture_rows(res)
+    got = sorted(
+        (r[cols.index("_pw_window_location")], r[cols.index("s")])
+        for r in rows.values()
+    )
+    assert got == [(2, 7), (8, 8)]
+
+
+def test_intervals_over_outer_empty_interval_emits_none_row():
+    data = T(
+        """
+        t | v
+        1 | 1
+        """
+    )
+    probes = T(
+        """
+        at
+        10
+        """
+    )
+    res = data.windowby(
+        data.t,
+        window=pw.temporal.intervals_over(
+            at=probes.at, lower_bound=-1, upper_bound=1, is_outer=True
+        ),
+    ).reduce(
+        pw.this._pw_window_location,
+        c=pw.reducers.count(),
+    )
+    rows, cols = _capture_rows(res)
+    got = [(r[cols.index("_pw_window_location")], r[cols.index("c")]) for r in rows.values()]
+    assert got == [(10, 0)] or got == [(10, 1)]  # empty window surfaces
+
+
+# ------------------------------------------------------------ window joins
+def test_window_join_left_pads_unmatched_windows():
+    t1 = T(
+        """
+        t | a
+        1 | x
+        6 | y
+        """
+    )
+    t2 = T(
+        """
+        t | b
+        2 | p
+        """
+    )
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.tumbling(duration=5), how="left"
+    ).select(pw.left.a, pw.right.b)
+    rows, cols = _capture_rows(res)
+    got = sorted(
+        (r[cols.index("a")], r[cols.index("b")]) for r in rows.values()
+    )
+    assert got == [("x", "p"), ("y", None)]
+
+
+def test_window_join_sliding_multiplies_matches():
+    t1 = T(
+        """
+        t | a
+        3 | x
+        """
+    )
+    t2 = T(
+        """
+        t | b
+        3 | p
+        """
+    )
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.sliding(hop=2, duration=4)
+    ).select(pw.left.a, pw.right.b)
+    rows, _ = _capture_rows(res)
+    # t=3 on both sides: windows [0,4) and [2,6) each pair them
+    assert len(rows) == 2
+
+
+def test_window_join_session_groups():
+    t1 = T(
+        """
+        t  | a
+        1  | x
+        20 | y
+        """
+    )
+    t2 = T(
+        """
+        t  | b
+        2  | p
+        21 | q
+        """
+    )
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.session(max_gap=5)
+    ).select(pw.left.a, pw.right.b)
+    rows, cols = _capture_rows(res)
+    got = sorted(
+        (r[cols.index("a")], r[cols.index("b")]) for r in rows.values()
+    )
+    assert got == [("x", "p"), ("y", "q")]
+
+
+# ------------------------------------------------------------- row xformer
+def test_row_transformer_computed_attribute():
+    class Summarizer(pw.ClassArg):
+        arg = pw.input_attribute()
+
+        @pw.output_attribute
+        def doubled(self) -> int:
+            return self.arg * 2
+
+    @pw.transformer
+    class doubler:
+        class table(Summarizer):
+            pass
+
+    t = T(
+        """
+        arg
+        3
+        """
+    )
+    res = doubler(table=t).table
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("doubled")] == 6
+
+
+def test_window_join_session_outer_pads():
+    t1 = T(
+        """
+        t  | a
+        1  | x
+        50 | z
+        """
+    )
+    t2 = T(
+        """
+        t  | b
+        2  | p
+        80 | q
+        """
+    )
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.session(max_gap=5), how="outer"
+    ).select(pw.left.a, pw.right.b)
+    rows, cols = _capture_rows(res)
+    got = sorted(
+        (r[cols.index("a")] or "", r[cols.index("b")] or "")
+        for r in rows.values()
+    )
+    assert got == [("", "q"), ("x", "p"), ("z", "")]
+
+
+def test_window_join_session_predicate():
+    t1 = T(
+        """
+        t  | a
+        1  | x
+        """
+    )
+    t2 = T(
+        """
+        t  | b
+        3  | p
+        30 | q
+        """
+    )
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t,
+        pw.temporal.session(predicate=lambda u, v: abs(u - v) < 5),
+        how="left",
+    ).select(pw.left.a, pw.right.b)
+    rows, cols = _capture_rows(res)
+    got = sorted(
+        (r[cols.index("a")], r[cols.index("b")]) for r in rows.values()
+    )
+    assert got == [("x", "p")]
