@@ -150,8 +150,15 @@ def tumbling(duration=None, origin=None, **kwargs) -> TumblingWindow:
 
 
 def sliding(hop=None, duration=None, origin=None, ratio=None, **kwargs) -> SlidingWindow:
+    # validate eagerly: a hopless/durationless window would otherwise fail
+    # with an opaque TypeError deep inside window assignment (or silently
+    # assign zero windows)
+    if hop is None:
+        raise ValueError("sliding() requires hop (optionally with ratio)")
     if duration is None and ratio is not None:
         duration = hop * ratio
+    if duration is None:
+        raise ValueError("sliding() requires duration or ratio")
     return SlidingWindow(hop, duration, origin)
 
 

@@ -1,0 +1,209 @@
+"""Debug/monitoring/CLI surfaces — table_from_* round trips, update-stream
+printing, probes/stats, StreamGenerator, markdown dialects (reference
+``debug`` + monitoring tests)."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows
+
+
+# -------------------------------------------------------------------- debug
+def test_table_from_rows_with_schema():
+    t = pw.debug.table_from_rows(
+        schema=pw.schema_from_types(a=int, b=str),
+        rows=[(1, "x"), (2, "y")],
+    )
+    rows, cols = _capture_rows(t)
+    assert cols == ["a", "b"]
+    assert sorted(tuple(r) for r in rows.values()) == [(1, "x"), (2, "y")]
+
+
+def test_table_from_markdown_explicit_ids_and_times():
+    t = T(
+        """
+          | a | __time__ | __diff__
+        5 | 1 | 2        | 1
+        5 | 1 | 4        | -1
+        6 | 2 | 2        | 1
+        """
+    )
+    rows, _ = _capture_rows(t)
+    assert len(rows) == 1
+    assert [r[0] for r in rows.values()] == [2]
+
+
+def test_table_from_markdown_empty_cells_are_none():
+    t = T(
+        """
+        a     | b
+        first |
+        plain | 2
+        """
+    )
+    rows, cols = _capture_rows(t)
+    by_a = {r[0]: r[1] for r in rows.values()}
+    assert by_a == {"first": None, "plain": 2}
+
+
+def test_table_to_csv_parquet_roundtrip(tmp_path):
+    t = T(
+        """
+        a | b
+        1 | x
+        2 | y
+        """
+    )
+    pw.debug.table_to_csv(t, str(tmp_path / "t.csv"))
+    df = pd.read_csv(tmp_path / "t.csv")
+    assert sorted(df["a"].tolist()) == [1, 2]
+    pw.clear_graph()
+    t2 = pw.debug.table_from_csv(str(tmp_path / "t.csv"))
+    rows, _ = _capture_rows(t2)
+    assert len(rows) == 2
+
+
+def test_compute_and_print_formats(capsys):
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    pw.debug.compute_and_print(t, include_id=False)
+    out = capsys.readouterr().out
+    assert "a" in out and "1" in out
+
+
+def test_compute_and_print_update_stream(capsys):
+    t = T(
+        """
+        a | __time__ | __diff__
+        1 | 2        | 1
+        1 | 4        | -1
+        """
+    )
+    pw.debug.compute_and_print_update_stream(t, include_id=False)
+    out = capsys.readouterr().out
+    assert "-1" in out and "1" in out
+
+
+def test_stream_generator_table():
+    gen = pw.debug.StreamGenerator()
+    t = gen.table_from_list_of_batches(
+        [[{"a": 1}], [{"a": 2}]],
+        pw.schema_from_types(a=int),
+    )
+    rows, _ = _capture_rows(t)
+    assert sorted(r[0] for r in rows.values()) == [1, 2]
+
+
+def test_table_to_dicts():
+    t = T(
+        """
+        a | b
+        1 | x
+        """
+    )
+    keys, columns = pw.debug.table_to_dicts(t)
+    assert set(columns) == {"a", "b"}
+    (k,) = keys
+    assert columns["a"][k] == 1 and columns["b"][k] == "x"
+
+
+# --------------------------------------------------------------- monitoring
+def test_scheduler_stats_count_operators_and_rows():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = t.select(b=t.a * 2)
+    from pathway_tpu.internals.run import capture_table
+
+    cap = capture_table(res)
+    # probes recorded engine activity
+    assert cap is not None
+
+
+def test_metrics_http_server_serves_prometheus():
+    import threading
+    import urllib.request
+
+    from pathway_tpu.internals.http_server import MetricsServer
+    from pathway_tpu.engine.probes import SchedulerStats
+
+    stats = SchedulerStats()
+    server = MetricsServer(stats, port=0)
+    server.start()
+    try:
+        port = server.port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "pathway" in body or "#" in body
+    finally:
+        server.stop()
+
+
+def test_monitoring_level_resolution():
+    from pathway_tpu.internals.monitoring import MonitoringLevel, _resolve
+
+    assert _resolve(MonitoringLevel.NONE, interactive=True) is MonitoringLevel.NONE
+    auto = _resolve(None, interactive=False)
+    assert isinstance(auto, MonitoringLevel)
+
+
+# --------------------------------------------------------------------- cli
+def test_cli_spawn_runs_program(tmp_path):
+    import subprocess
+    import sys
+
+    prog = tmp_path / "p.py"
+    prog.write_text(
+        "import pathway_tpu as pw\n"
+        "t = pw.debug.table_from_markdown('a\\n1')\n"
+        "pw.debug.compute_and_print(t, include_id=False)\n"
+    )
+    import os
+
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu", "spawn", "--threads", "1",
+         sys.executable, str(prog)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "1" in r.stdout
+
+
+def test_cli_version_flag():
+    import subprocess
+    import sys
+    import os
+
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu", "--version"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert r.returncode == 0
+    assert "0.1" in r.stdout
+
+
+# ----------------------------------------------------------------- graph viz
+def test_table_repr_and_schema_str():
+    t = T(
+        """
+        a | b
+        1 | x
+        """
+    )
+    s = str(t.schema)
+    assert "a" in s and "b" in s

@@ -563,3 +563,158 @@ def test_diff_computes_deltas_in_time_order():
     di = cols.index("diff_v")
     got = sorted(r[di] for r in rows.values() if r[di] is not None)
     assert got == [-2, 3]
+
+
+def test_asof_join_forward_direction():
+    t1 = _times(
+        """
+        t | a
+        5 | x
+        """
+    )
+    t2 = _times(
+        """
+        t | b
+        3 | p
+        7 | q
+        9 | r
+        """
+    )
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, direction="forward"
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | q
+            """
+        ),
+    )
+
+
+def test_asof_join_nearest_direction():
+    t1 = _times(
+        """
+        t | a
+        5 | x
+        """
+    )
+    t2 = _times(
+        """
+        t | b
+        2 | p
+        6 | q
+        """
+    )
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, direction="nearest"
+    ).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            x | q
+            """
+        ),
+    )
+
+
+def test_asof_join_defaults_fill_unmatched():
+    t1 = _times(
+        """
+        t | a
+        1 | x
+        """
+    )
+    t2 = _times(
+        """
+        t | b
+        5 | p
+        """
+    )
+    res = pw.temporal.asof_join_left(
+        t1, t2, t1.t, t2.t, defaults={"b": "none"}
+    ).select(pw.left.a, pw.right.b)
+    rows, cols = _capture_rows(res)
+    (row,) = rows.values()
+    assert row[cols.index("b")] in ("none", None)
+
+
+def test_interval_join_right_mode_pads_right():
+    t1 = _times(
+        """
+        t | a
+        1 | x
+        """
+    )
+    t2 = _times(
+        """
+        t  | b
+        1  | p
+        50 | q
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(0, 0), how="right"
+    ).select(pw.left.a, pw.right.b)
+    rows, cols = _capture_rows(res)
+    got = sorted(
+        (r[cols.index("a")] or "", r[cols.index("b")]) for r in rows.values()
+    )
+    assert got == [("", "q"), ("x", "p")]
+
+
+def test_interval_join_left_mode_pads_left():
+    t1 = _times(
+        """
+        t  | a
+        1  | x
+        50 | y
+        """
+    )
+    t2 = _times(
+        """
+        t | b
+        1 | p
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(0, 0), how="left"
+    ).select(pw.left.a, pw.right.b)
+    rows, cols = _capture_rows(res)
+    got = sorted(
+        (r[cols.index("a")], r[cols.index("b")] or "") for r in rows.values()
+    )
+    assert got == [("x", "p"), ("y", "")]
+
+
+def test_windowby_sliding_with_ratio():
+    t = _times(
+        """
+        t | v
+        1 | 1
+        3 | 2
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=2, ratio=2)  # duration = 4
+    ).reduce(s=pw.reducers.sum(pw.this.v))
+    rows, _ = _capture_rows(res)
+    assert len(rows) >= 2
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        pw.temporal.sliding(duration=4)  # hopless: refuse, don't emit nothing
+
+
+def test_sliding_requires_duration_or_ratio():
+    import pytest
+
+    with pytest.raises(ValueError):
+        pw.temporal.sliding(hop=2)
+    with pytest.raises(ValueError):
+        pw.temporal.sliding(ratio=2)
