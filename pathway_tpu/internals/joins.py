@@ -122,6 +122,10 @@ class JoinResult:
                 if entry is not None:
                     return ColumnReference(self._left, entry[1](x._name))
                 if t is thisclass.left or t is thisclass.this:
+                    if t is thisclass.this and x._name == "id":
+                        # pw.this.id = the join RESULT's key on chains too;
+                        # _rewrite_sel resolves it to the row key
+                        return x
                     resolved = self._resolve_chain_side(x._name)
                     if resolved is not None:
                         return ColumnReference(self._left, resolved)
@@ -288,6 +292,11 @@ class JoinResult:
                 if t is thisclass.right or t is right:
                     return ColumnReference(None, "__r_id" if e._name == "id" else f"__r_{e._name}")
                 if t is thisclass.this:
+                    if e._name == "id":
+                        # the join RESULT's own key (reference
+                        # test_outer_join_id): the evaluator resolves a
+                        # bare 'id' reference to the current row key
+                        return ColumnReference(None, "id")
                     # unqualified this: resolve against left then right
                     if e._name in left.column_names():
                         return ColumnReference(None, f"__l_{e._name}")

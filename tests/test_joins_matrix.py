@@ -778,3 +778,204 @@ def test_chained_join_star_select_demangles():
     assert not any(c.startswith("__j") for c in cols)
     assert {"a", "b", "c", "k"} <= set(cols)
     assert len(rows) == 1
+
+
+# -------------------------------------- reference-derived named scenarios
+def test_left_join_require_nullifies_on_missing_side():
+    # reference test_left_join_01/015: require(expr, ids...) -> None when
+    # any id is missing (unmatched side)
+    t1 = T(
+        """
+          | a  | b
+        1 | 11 | 111
+        2 | 15 | 115
+        """
+    )
+    t2 = T(
+        """
+          | a  | d
+        1 | 11 | 211
+        """
+    )
+    res = t1.join_left(t2, t1.a == t2.a).select(
+        t1.a,
+        s=pw.require(t1.b + t2.d, t1.id, t2.id),
+    )
+    rows, cols = _capture_rows(res)
+    by_a = {r[cols.index("a")]: r[cols.index("s")] for r in rows.values()}
+    assert by_a == {11: 322, 15: None}
+
+
+def test_right_join_wid_substitute_and_desugaring():
+    t1 = T(
+        """
+          | a  | b
+        1 | 11 | 111
+        2 | 15 | 114
+        """
+    )
+    t2 = T(
+        """
+          | c  | d
+        1 | 11 | 211
+        2 | 14 | 214
+        """
+    )
+    res = t1.join_right(t2, t1.a == t2.c, id=t2.id).select(
+        t1.a,
+        t2_c=pw.right.c,
+        s=pw.require(pw.left.b + t2.d, pw.left.id, t2.id),
+    )
+    rows, cols = _capture_rows(res)
+    got = sorted(
+        (r[cols.index("t2_c")], r[cols.index("s")]) for r in rows.values()
+    )
+    assert got == [(11, 322), (14, None)]
+
+
+def test_outer_join_id_select_consistency():
+    # reference test_outer_join_id: pw.this.id selects the RESULT row's own
+    # key — for every row, the selected pointer equals the actual key
+    t1 = T(
+        """
+          | a
+        1 | x
+        2 | y
+        """
+    )
+    t2 = T(
+        """
+          | c
+        1 | p
+        3 | q
+        """
+    )
+    r1 = t1.join_outer(t2, t1.id == t2.id).select(id_col=pw.this.id)
+    rows1, cols1 = _capture_rows(r1)
+    assert len(rows1) == 3  # 1 matched + 1 left-only + 1 right-only
+    for key, row in rows1.items():
+        p = row[cols1.index("id_col")]
+        assert (p.value if hasattr(p, "value") else int(p)) == key
+
+
+def test_chained_join_this_id_is_result_key():
+    t1 = T(
+        """
+        a | k
+        1 | x
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        5 | x
+        """
+    )
+    t3 = T(
+        """
+        c | k
+        9 | x
+        """
+    )
+    res = (
+        t1.join(t2, t1.k == t2.k)
+        .join(t3, t1.k == t3.k)
+        .select(pw.this.a, i=pw.this.id)
+    )
+    rows, cols = _capture_rows(res)
+    (key,) = rows
+    p = list(rows.values())[0][cols.index("i")]
+    assert (p.value if hasattr(p, "value") else int(p)) == key
+
+
+def test_join_on_id_columns():
+    t1 = T(
+        """
+          | a
+        1 | x
+        2 | y
+        """
+    )
+    t2 = T(
+        """
+          | b
+        2 | p
+        3 | q
+        """
+    )
+    res = t1.join(t2, t1.id == t2.id).select(t1.a, t2.b)
+    rows, _ = _capture_rows(res)
+    assert [tuple(r) for r in rows.values()] == [("y", "p")]
+
+
+def test_join_typing_optional_on_padded_side():
+    t1 = T(
+        """
+        a | k
+        1 | x
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        5 | y
+        """
+    )
+    res = t1.join_left(t2, t1.k == t2.k).select(t1.a, t2.b)
+    hints = res.schema.typehints()
+    # the padded right column must be Optional in the result schema
+    import typing
+
+    assert hints["b"] in (typing.Optional[int], int | None)
+
+
+def test_left_join_chain_assign_id_keeps_left_keys():
+    t1 = T(
+        """
+          | a | k
+        7 | 1 | x
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        5 | x
+        """
+    )
+    res = t1.join_left(t2, t1.k == t2.k, id=t1.id).select(t1.a, t2.b)
+    rows, _ = _capture_rows(res)
+    r1, _ = _capture_rows(t1)
+    assert set(rows) == set(r1)
+
+
+def test_outer_join_chaining_no_cond_information_preserved():
+    # chained outer joins: every source row appears at least once
+    t1 = T(
+        """
+        a | k
+        1 | x
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        5 | y
+        """
+    )
+    t3 = T(
+        """
+        c | k
+        9 | z
+        """
+    )
+    res = (
+        t1.join_outer(t2, t1.k == t2.k)
+        .join_outer(t3, pw.left.k == pw.right.k)
+        .select(pw.this.a, pw.this.b, pw.this.c)
+    )
+    rows, cols = _capture_rows(res)
+    present = {
+        n: any(r[cols.index(n)] is not None for r in rows.values())
+        for n in ("a", "b", "c")
+    }
+    assert present == {"a": True, "b": True, "c": True}
