@@ -32,12 +32,16 @@ class ElasticSearchAuth:
 
 
 def write(table, host: str, auth: ElasticSearchAuth | None = None,
-          index_name: str = "", **kwargs) -> None:
-    try:
-        from elasticsearch import Elasticsearch
-    except ImportError as exc:  # pragma: no cover - gated dependency
-        raise ImportError("pw.io.elasticsearch requires the `elasticsearch` package") from exc
-    client = Elasticsearch(host, **(auth.kwargs if auth else {}))
+          index_name: str = "", *, _client=None, **kwargs) -> None:
+    """``_client`` (Elasticsearch-shaped ``.index(index=, document=)``) is
+    injectable for offline tests."""
+    if _client is None:
+        try:
+            from elasticsearch import Elasticsearch
+        except ImportError as exc:  # pragma: no cover - gated dependency
+            raise ImportError("pw.io.elasticsearch requires the `elasticsearch` package") from exc
+        _client = Elasticsearch(host, **(auth.kwargs if auth else {}))
+    client = _client
     cols = list(table.column_names())
 
     def write_batch(time, batch):

@@ -10,13 +10,16 @@ from pathway_tpu.io._utils import format_value_for_output
 
 
 def write(table, connection_string: str, database: str, collection: str,
-          *, max_batch_size: int | None = None, **kwargs) -> None:
-    try:
-        import pymongo
-    except ImportError as exc:  # pragma: no cover - gated dependency
-        raise ImportError("pw.io.mongodb requires the `pymongo` package") from exc
-    client = pymongo.MongoClient(connection_string)
-    coll = client[database][collection]
+          *, max_batch_size: int | None = None, _client=None, **kwargs) -> None:
+    """``_client`` (pymongo-shaped ``client[db][coll].insert_many``) is
+    injectable for offline tests, like the gdrive/sharepoint connectors."""
+    if _client is None:
+        try:
+            import pymongo
+        except ImportError as exc:  # pragma: no cover - gated dependency
+            raise ImportError("pw.io.mongodb requires the `pymongo` package") from exc
+        _client = pymongo.MongoClient(connection_string)
+    coll = _client[database][collection]
     cols = list(table.column_names())
 
     def write_batch(time, batch):
@@ -26,8 +29,11 @@ def write(table, connection_string: str, database: str, collection: str,
             doc["time"] = time
             doc["diff"] = diff
             docs.append(doc)
-        if docs:
-            coll.insert_many(docs)
+        # chunk inserts: one giant insert_many can exceed Mongo's message
+        # size limits and fail the whole batch
+        chunk = max_batch_size or len(docs) or 1
+        for start in range(0, len(docs), chunk):
+            coll.insert_many(docs[start : start + chunk])
 
     node = SinkNode(G.engine_graph, table._node, write_batch, name=f"mongodb({collection})")
     G.register_sink(node)

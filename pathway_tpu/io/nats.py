@@ -29,14 +29,18 @@ def read(uri: str, topic: str, *, schema: Any, format: str = "json", **kwargs):
     )
 
 
-def write(table, uri: str, topic: str, *, format: str = "json", **kwargs) -> None:
-    nats_mod = _require_nats()
+def write(table, uri: str, topic: str, *, format: str = "json",  # noqa: A002
+          _client=None, **kwargs) -> None:
+    """``_client`` (sync ``.publish(subject, payload_bytes)``) is injectable
+    for offline tests; the real path connects an async nats client."""
+    if _client is None:
+        nats_mod = _require_nats()
     import asyncio
 
     cols = list(table.column_names())
     state: dict = {}
 
-    def _client():
+    def _connect():
         if "nc" not in state:
             loop = asyncio.new_event_loop()
             nc = loop.run_until_complete(nats_mod.connect(uri))
@@ -45,11 +49,15 @@ def write(table, uri: str, topic: str, *, format: str = "json", **kwargs) -> Non
         return state["nc"], state["loop"]
 
     def write_batch(time, batch):
-        nc, loop = _client()
         for _key, row, diff in batch.rows():
             payload = {c: format_value_for_output(v) for c, v in zip(cols, row)}
             payload["diff"] = diff
-            loop.run_until_complete(nc.publish(topic, json.dumps(payload).encode()))
+            data = json.dumps(payload).encode()
+            if _client is not None:
+                _client.publish(topic, data)
+            else:
+                nc, loop = _connect()
+                loop.run_until_complete(nc.publish(topic, data))
 
     node = SinkNode(G.engine_graph, table._node, write_batch, name=f"nats({topic})")
     G.register_sink(node)
