@@ -105,6 +105,10 @@ class PathwayWebserver:
             site = web.TCPSite(runner, self.host, self.port)
             await site.start()
             self._runner = runner
+            if self.port == 0 and runner.addresses:
+                # ephemeral port requested: record what the OS picked so
+                # callers (and tests) can reach the server
+                self.port = runner.addresses[0][1]
             self._started.set()
             while True:
                 await asyncio.sleep(3600)
@@ -209,7 +213,7 @@ def rest_connector(
 ) -> tuple[Table, RestServerResponseWriter]:
     """Expose an HTTP endpoint as a (query_table, response_writer) pair."""
     if webserver is None:
-        webserver = PathwayWebserver(host or "0.0.0.0", port or 8080)  # noqa: S104
+        webserver = PathwayWebserver(host or "0.0.0.0", 8080 if port is None else port)  # noqa: S104
     if schema is None:
         schema = schema_mod.schema_from_types(query=str)
     cols = list(schema.column_names())

@@ -1,0 +1,187 @@
+"""HTTP REST connector, slack/pubsub stubs, YAML loader, retries,
+telemetry gating (reference ``io/http`` + aux subsystem tests)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows
+
+
+class QuerySchema(pw.Schema):
+    q: str
+
+
+def test_rest_connector_round_trip():
+    queries, writer = pw.io.http.rest_connector(
+        port=0, schema=QuerySchema, delete_completed_queries=False
+    )
+    res = queries.select(ans=queries.q + "!")
+    writer(res)
+    conns = list(pw.G.connectors)
+    from pathway_tpu.io.http import _RestConnector
+
+    rest = next(c for c in conns if isinstance(c, _RestConnector))
+
+    answers = []
+
+    def client():
+        rest.webserver._started.wait(timeout=20)
+        port = rest.webserver.port
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            data=json.dumps({"q": "hi"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            answers.append(json.loads(urllib.request.urlopen(req, timeout=15).read()))
+        finally:
+            for c in conns:
+                c._stop.set()
+                c.close()
+
+    threading.Thread(target=client, daemon=True).start()
+    pw.run()
+    assert answers and answers[0]["ans"] == "hi!"
+
+
+def test_slack_send_alerts_with_stub_sender():
+    sent = []
+
+    t = T(
+        """
+        alert
+        disk full
+        """
+    )
+    pw.io.slack.send_alerts(
+        t.alert, "CHANNEL", "token",
+        _sender=lambda payload: sent.append((payload["channel"], payload["text"])),
+    )
+    pw.run()
+    assert sent == [("CHANNEL", "disk full")]
+
+
+def test_pubsub_write_with_stub_publisher():
+    published = []
+
+    class _Pub:
+        def topic_path(self, project, topic):
+            return f"{project}/{topic}"
+
+        def publish(self, path, data, **attrs):
+            published.append((path, data))
+
+            class _F:
+                def result(self, timeout=None):
+                    return "id"
+
+            return _F()
+
+    t = T(
+        """
+        word
+        cat
+        """
+    )
+    payloads = t.select(data=pw.apply_with_type(
+        lambda w: json.dumps({"word": w}).encode(), bytes, t.word
+    ))
+    pw.io.pubsub.write(payloads, _Pub(), "proj", "top")
+    pw.run()
+    assert published and published[0][0] == "proj/top"
+    assert json.loads(published[0][1])["word"] == "cat"
+
+
+def test_bigquery_write_with_stub_client():
+    inserted = []
+
+    class _Bq:
+        def insert_rows_json(self, table, rows):
+            inserted.extend(rows)
+            return []
+
+    t = T(
+        """
+        word
+        cat
+        """
+    )
+    pw.io.bigquery.write(
+        t, dataset_name="d", table_name="t", _client=_Bq()
+    )
+    pw.run()
+    assert inserted and inserted[0]["word"] == "cat"
+
+
+def test_yaml_loader_instantiates_pw_objects(tmp_path):
+    yml = """
+splitter: !pw.xpacks.llm.splitters.TokenCountSplitter
+  min_tokens: 2
+  max_tokens: 4
+limit: 7
+"""
+    out = pw.load_yaml(yml)
+    from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+
+    assert isinstance(out["splitter"], TokenCountSplitter)
+    assert out["limit"] == 7
+
+
+def test_yaml_loader_references(tmp_path):
+    yml = """
+shared: !pw.xpacks.llm.splitters.TokenCountSplitter {}
+user: $shared
+"""
+    out = pw.load_yaml(yml)
+    assert out["user"] is out["shared"]
+
+
+def test_retry_strategy_backoff_retries_then_raises():
+    import asyncio
+
+    from pathway_tpu.internals.udfs.retries import (
+        ExponentialBackoffRetryStrategy,
+    )
+
+    s = ExponentialBackoffRetryStrategy(
+        max_retries=3, initial_delay=1, backoff_factor=2, jitter_ms=0
+    )
+    attempts = []
+
+    async def flaky():
+        attempts.append(1)
+        raise RuntimeError("always")
+
+    with pytest.raises(RuntimeError):
+        asyncio.run(s.invoke(flaky))
+    # initial call + 3 retries
+    assert len(attempts) == 4
+
+
+def test_telemetry_noop_without_collector(monkeypatch):
+    monkeypatch.delenv("PATHWAY_MONITORING_SERVER", raising=False)
+    from pathway_tpu.internals import telemetry
+
+    tel = telemetry.maybe_setup() if hasattr(telemetry, "maybe_setup") else None
+    # without a collector configured, telemetry must be inert (no crash)
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    rows, _ = _capture_rows(t.select(b=t.a))
+    assert len(rows) == 1
+
+
+def test_http_retry_policy_defaults():
+    from pathway_tpu.io.http import RetryPolicy
+
+    p = RetryPolicy.default() if hasattr(RetryPolicy, "default") else RetryPolicy()
+    assert p.first_delay_ms > 0
+    assert p.backoff_factor >= 1
