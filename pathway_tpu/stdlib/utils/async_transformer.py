@@ -65,7 +65,16 @@ class AsyncTransformer(ABC):
             ),
             True,
         )
-        return result.filter(cond)
+        failed = result.filter(cond)
+        # the error outputs themselves are unusable values — surface them
+        # as None so the failed table can flow into sinks/joins (matching
+        # the reference's consumable failure diagnostics)
+        return failed.select(
+            **{
+                n: expr_mod.fill_error(failed[n], None)
+                for n in result.column_names()
+            }
+        )
 
     @property
     def finished(self) -> Table:

@@ -1,0 +1,243 @@
+"""Debezium CDC semantics, AsyncTransformer success/failure split, sorted
+index retractions (reference ``io/debezium`` + stdlib utils tests)."""
+
+import json
+import threading
+import time
+
+import pathway_tpu as pw
+from tests.utils import T, _capture_rows
+
+
+class KV(pw.Schema):
+    k: str = pw.column_definition(primary_key=True)
+    v: int
+
+
+def _cdc(op, before=None, after=None):
+    return json.dumps(
+        {"payload": {"op": op, "before": before, "after": after}}
+    ).encode()
+
+
+def _run_cdc(messages, expect_rows):
+    broker = pw.io.kafka.InMemoryKafkaBroker()
+    for m in messages:
+        broker.produce("cdc", m)
+    broker.close()
+    t = pw.io.debezium.read(broker, "cdc", schema=KV)
+    seen = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: seen.append(
+            (row["k"], row["v"], is_addition)
+        ),
+    )
+    conns = list(pw.G.connectors)
+
+    def stop():
+        deadline = time.time() + 20
+        while time.time() < deadline and len(seen) < expect_rows:
+            time.sleep(0.02)
+        for c in conns:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stop, daemon=True).start()
+    pw.run()
+    return t, seen
+
+
+def test_debezium_create_update_delete_sequence():
+    # messages arrive in separate polls so intermediate states are
+    # observable (a single batch correctly consolidates to net zero)
+    broker = pw.io.kafka.InMemoryKafkaBroker()
+    t = pw.io.debezium.read(broker, "cdc", schema=KV)
+    seen = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: seen.append(
+            (row["k"], row["v"], is_addition)
+        ),
+    )
+    conns = list(pw.G.connectors)
+
+    def feed():
+        deadline = time.time() + 20
+
+        def wait_for(n):
+            while time.time() < deadline and len(seen) < n:
+                time.sleep(0.02)
+
+        broker.produce("cdc", _cdc("c", after={"k": "a", "v": 1}))
+        wait_for(1)
+        broker.produce(
+            "cdc",
+            _cdc("u", before={"k": "a", "v": 1}, after={"k": "a", "v": 2}),
+        )
+        wait_for(3)
+        broker.produce("cdc", _cdc("d", before={"k": "a", "v": 2}))
+        wait_for(4)
+        for c in conns:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=feed, daemon=True).start()
+    pw.run()
+    assert seen == [
+        ("a", 1, True),
+        ("a", 1, False),
+        ("a", 2, True),
+        ("a", 2, False),
+    ]
+
+
+def test_debezium_same_batch_ops_consolidate_to_net():
+    _t, seen = _run_cdc(
+        [
+            _cdc("c", after={"k": "a", "v": 1}),
+            _cdc("u", before={"k": "a", "v": 1}, after={"k": "a", "v": 2}),
+            _cdc("d", before={"k": "a", "v": 2}),
+        ],
+        expect_rows=0,
+    )
+    net = {}
+    for k, v, add in seen:
+        net[(k, v)] = net.get((k, v), 0) + (1 if add else -1)
+    assert {kv for kv, n in net.items() if n} == set()
+
+
+def test_debezium_snapshot_read_op():
+    t, seen = _run_cdc(
+        [
+            _cdc("r", after={"k": "x", "v": 7}),  # snapshot row
+            _cdc("c", after={"k": "y", "v": 8}),
+        ],
+        expect_rows=2,
+    )
+    net = {}
+    for k, v, add in seen:
+        net[(k, v)] = net.get((k, v), 0) + (1 if add else -1)
+    assert sorted(kv for kv, n in net.items() if n) == [("x", 7), ("y", 8)]
+
+
+def test_debezium_plain_kafka_envelope_without_schema_field():
+    # payload-less envelope (flattened SMT output) must parse too
+    broker = pw.io.kafka.InMemoryKafkaBroker()
+    broker.produce(
+        "cdc", json.dumps({"op": "c", "after": {"k": "z", "v": 3}}).encode()
+    )
+    broker.close()
+    t = pw.io.debezium.read(broker, "cdc", schema=KV)
+    seen = []
+    pw.io.subscribe(
+        t, on_change=lambda key, row, time, is_addition: seen.append(row)
+    )
+    conns = list(pw.G.connectors)
+
+    def stop():
+        deadline = time.time() + 20
+        while time.time() < deadline and len(seen) < 1:
+            time.sleep(0.02)
+        for c in conns:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stop, daemon=True).start()
+    pw.run()
+    assert seen and seen[0]["k"] == "z"
+
+
+# ---------------------------------------------------------- AsyncTransformer
+def test_async_transformer_failed_table_captures_errors():
+    class Half(pw.AsyncTransformer, output_schema=pw.schema_from_types(half=int)):
+        async def invoke(self, a) -> dict:
+            if a % 2:
+                raise ValueError("odd")
+            return {"half": a // 2}
+
+    t = T(
+        """
+        a
+        2
+        3
+        4
+        """
+    )
+    tf = Half(input_table=t)
+    ok_rows, ok_cols = _capture_rows(tf.successful)
+    assert sorted(r[ok_cols.index("half")] for r in ok_rows.values()) == [1, 2]
+    pw.clear_graph()
+
+    t2 = T(
+        """
+        a
+        2
+        3
+        """
+    )
+    tf2 = Half(input_table=t2)
+    failed_rows, _ = _capture_rows(tf2.failed)
+    assert len(failed_rows) == 1
+
+
+def test_async_transformer_open_close_called():
+    events = []
+
+    class Tr(pw.AsyncTransformer, output_schema=pw.schema_from_types(b=int)):
+        def open(self):
+            events.append("open")
+
+        def close(self):
+            events.append("close")
+
+        async def invoke(self, a) -> dict:
+            return {"b": a}
+
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    rows, _ = _capture_rows(Tr(input_table=t).successful)
+    assert len(rows) == 1
+    assert "open" in events
+
+
+# ------------------------------------------------------------ sorted index
+def test_sort_retraction_relinks_neighbors():
+    t = T(
+        """
+        v | __time__ | __diff__
+        1 | 2        | 1
+        2 | 2        | 1
+        3 | 2        | 1
+        2 | 4        | -1
+        """
+    )
+    s = t.sort(t.v)
+    merged = t.with_columns(prev=s.prev, next=s.next)
+    rows, cols = _capture_rows(merged)
+    vi, pi, ni = (cols.index(c) for c in ("v", "prev", "next"))
+    by_v = {r[vi]: r for r in rows.values()}
+    assert set(by_v) == {1, 3}
+    # 1 and 3 are now adjacent
+    assert by_v[1][ni] is not None and by_v[3][pi] is not None
+
+
+def test_sort_with_key_expression():
+    t = T(
+        """
+        name | score
+        a    | 30
+        b    | 10
+        c    | 20
+        """
+    )
+    s = t.sort(-t.score)  # descending
+    merged = t.with_columns(prev=s.prev)
+    rows, cols = _capture_rows(merged)
+    ni, pi = cols.index("name"), cols.index("prev")
+    first = [r[ni] for r in rows.values() if r[pi] is None]
+    assert first == ["a"]  # highest score sorts first under negation
