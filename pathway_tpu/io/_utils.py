@@ -127,6 +127,20 @@ def format_value_for_output(v) -> Any:
     return v
 
 
+def parse_stream_record(value: bytes, fmt: str, schema, cols, dtypes):
+    """One streamed record (kafka message / http line) -> values dict, or
+    None for undecodable json. THE shared parse for stream connectors so
+    raw/json semantics cannot drift between them: 'raw' keeps bytes
+    untouched."""
+    if fmt == "raw":
+        return {"data": value}
+    try:
+        obj = json.loads(value)
+    except json.JSONDecodeError:
+        return None
+    return parse_record_fields(obj, cols, dtypes, schema)
+
+
 def _iter_lines(data: bytes):
     """'\n'-separated lines, mirroring text-file iteration (the final
     newline does not produce an empty trailing line; '\r' is preserved)."""

@@ -186,14 +186,14 @@ class _KafkaConnector(BaseConnector):
     def _parse(self, msg, cols, dtypes, pk):
         """(key, row) or None for malformed payloads (logged, skipped —
         one bad message must not kill the stream)."""
-        import json
+        from pathway_tpu.io._utils import parse_stream_record
 
         try:
-            if self.fmt == "raw":
-                values = {"data": msg.value()}
-            else:
-                obj = json.loads(msg.value())
-                values = parse_record_fields(obj, cols, dtypes, self.schema)
+            values = parse_stream_record(
+                msg.value(), self.fmt, self.schema, cols, dtypes
+            )
+            if values is None:
+                raise ValueError("undecodable json payload")
             if pk:
                 key = hash_values(*[values[c] for c in pk])
             else:
