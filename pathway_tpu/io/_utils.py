@@ -138,6 +138,10 @@ def parse_stream_record(value: bytes, fmt: str, schema, cols, dtypes):
         obj = json.loads(value)
     except json.JSONDecodeError:
         return None
+    if not isinstance(obj, dict):
+        # valid JSON but not a record (null / number / array): skipping is
+        # the only safe option — crashing would kill the whole stream
+        return None
     return parse_record_fields(obj, cols, dtypes, schema)
 
 

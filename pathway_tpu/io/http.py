@@ -27,7 +27,12 @@ from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._streams import BaseConnector, next_commit_time
-from pathway_tpu.io._utils import format_value_for_output, parse_record_fields, parse_value
+from pathway_tpu.io._utils import (
+    format_value_for_output,
+    parse_record_fields,
+    parse_stream_record,
+    parse_value,
+)
 
 
 class EndpointExamples:
@@ -301,8 +306,6 @@ class _HttpStreamConnector(BaseConnector):
             self._byte_offset, self._counter = int(offset[0]), int(offset[1])
 
     def _row_of(self, line: bytes, cols, dtypes, pk):
-        from pathway_tpu.io._utils import parse_stream_record
-
         payload = line.rstrip(b"\r\n")
         if self.sse:
             if payload.startswith(b"data:"):
@@ -364,7 +367,21 @@ class _HttpStreamConnector(BaseConnector):
                 time_mod.sleep(self.reconnect_delay_s)
                 continue
             try:
-                if self.resume_with_offset and not self._skip_consumed(resp):
+                try:
+                    skipped_ok = (
+                        not self.resume_with_offset
+                        or self._skip_consumed(resp)
+                    )
+                except Exception as exc:  # noqa: BLE001 - blip mid-skip
+                    from pathway_tpu.internals.errors import (
+                        get_global_error_log,
+                    )
+
+                    get_global_error_log().log(
+                        f"http read disconnected while resuming: {exc!r}"
+                    )
+                    skipped_ok = False
+                if not skipped_ok:
                     # log rotated/truncated below the stored offset: nothing
                     # new — back off instead of hammering the server
                     if self.mode == "static":
@@ -426,15 +443,21 @@ def read(
     headers: dict | None = None,
     persistent_id: str | None = None,
     connect_timeout_ms: int | None = None,
-    resume_with_offset: bool = True,
+    resume_with_offset: bool | None = None,
     sse: bool = False,
     _opener=None,
     **kwargs,
 ) -> Table:
     """Stream a line-delimited HTTP response (jsonlines, SSE ``data:``
     lines, plaintext, or raw bytes) into a table; reconnects on EOF in
-    streaming mode, skipping already-consumed bytes. ``_opener(url,
-    headers) -> file-like`` is injectable for offline tests."""
+    streaming mode, skipping already-consumed bytes (default; SSE push
+    endpoints default to NOT resuming by offset since each connection
+    carries only new events). ``connect_timeout_ms`` is a blanket socket
+    timeout — it also bounds idle gaps BETWEEN streamed lines, so leave it
+    unset for quiet live streams. ``_opener(url, headers) -> file-like``
+    is injectable for offline tests."""
+    if resume_with_offset is None:
+        resume_with_offset = not sse
     if format not in ("raw", "plaintext", "json"):
         raise ValueError(
             f"unsupported HTTP read format {format!r}: raw/plaintext/json"

@@ -374,3 +374,29 @@ def test_http_read_partial_line_not_consumed_on_reconnect():
     pw.run()
     # the cut record arrives intact after reconnect, never split
     assert seen == ["a", "b"]
+
+
+def test_http_read_non_object_json_lines_skipped():
+    import io as io_mod
+
+    body = b'null\n42\n[1,2]\n{"word": "ok"}\n'
+    t = pw.io.http.read(
+        "http://stub/mixed", schema=WordHttpSchema, format="json",
+        mode="static", _opener=lambda url, headers: io_mod.BytesIO(body),
+    )
+    rows, cols = _capture_rows(t)
+    assert [r[cols.index("word")] for r in rows.values()] == ["ok"]
+
+
+def test_http_read_sse_defaults_to_no_offset_resume():
+    from pathway_tpu.io.http import _HttpStreamConnector
+    import io as io_mod
+
+    pw.io.http.read(
+        "http://stub/sse", schema=WordHttpSchema, format="json", sse=True,
+        _opener=lambda url, headers: io_mod.BytesIO(b""),
+    )
+    hc = next(
+        c for c in pw.G.connectors if isinstance(c, _HttpStreamConnector)
+    )
+    assert hc.resume_with_offset is False  # SSE sends only NEW events
