@@ -7,6 +7,7 @@ colocation. Lowered to the engine's incremental hash join.
 
 from __future__ import annotations
 
+import types
 from typing import Any
 
 from pathway_tpu.engine.operators import core as core_ops
@@ -51,8 +52,9 @@ class JoinResult:
 
     # class-level default: construction paths that bypass __init__ (e.g.
     # specialized temporal joins building the object piecemeal) still
-    # dealias safely as a no-op
-    _aliases: dict = {}
+    # dealias safely as a no-op; immutable so an in-place mutation can never
+    # leak into every JoinResult in the process
+    _aliases: Any = types.MappingProxyType({})
 
     def __init__(self, left, right, on, id_, how, left_instance, right_instance):
         from pathway_tpu.internals.table import Table

@@ -278,7 +278,7 @@ class _HttpStreamConnector(BaseConnector):
 
     def __init__(self, node, url: str, schema, fmt: str, headers: dict,
                  opener, mode: str, reconnect_delay_s: float = 1.0,
-                 resume_with_offset: bool = True, sse: bool = False):
+                 resume_with_offset: bool | None = None, sse: bool = False):
         super().__init__(node)
         self.url = url
         self.schema = schema
@@ -288,8 +288,10 @@ class _HttpStreamConnector(BaseConnector):
         self.mode = mode
         self.reconnect_delay_s = reconnect_delay_s
         # growing-log/finite bodies re-serve consumed bytes on reconnect:
-        # skip them (no double counting). SSE-style push endpoints send only
-        # NEW events per connection: set resume_with_offset=False there.
+        # skip them (no double counting). Live-tail endpoints (SSE, chunked
+        # push streams) send only NEW data per connection: skipping there
+        # silently swallows fresh records. None = decide per connection from
+        # the response: resume only for bodies with a known finite length.
         self.resume_with_offset = resume_with_offset
         self.sse = sse  # strip SSE 'data:' framing only when asked:
         # unconditional stripping would corrupt payloads that legitimately
@@ -338,6 +340,25 @@ class _HttpStreamConnector(BaseConnector):
             self._counter += 1
         return (key, tuple(values[c] for c in cols), 1)
 
+    def _should_resume(self, resp) -> bool:
+        """Skip already-consumed bytes on this connection? Explicit setting
+        wins; in auto mode resume only when the body is finite/re-served —
+        a Content-Length header, or a plain file-like with no HTTP headers
+        at all (injected readers, file URLs). A header-bearing response
+        WITHOUT Content-Length is a chunked live tail: each connection
+        carries only new data, so skipping would drop records."""
+        if self.resume_with_offset is not None:
+            return self.resume_with_offset
+        if self.sse:
+            return False
+        headers = getattr(resp, "headers", None)
+        if headers is None:
+            getheader = getattr(resp, "getheader", None)
+            if getheader is None:
+                return True  # bare file-like: the body is the whole log
+            return getheader("Content-Length") is not None
+        return headers.get("Content-Length") is not None
+
     def _skip_consumed(self, resp) -> bool:
         """Skip bytes already ingested in a previous connection; False when
         the body is shorter than the recorded offset (nothing new)."""
@@ -369,7 +390,7 @@ class _HttpStreamConnector(BaseConnector):
             try:
                 try:
                     skipped_ok = (
-                        not self.resume_with_offset
+                        not self._should_resume(resp)
                         or self._skip_consumed(resp)
                     )
                 except Exception as exc:  # noqa: BLE001 - blip mid-skip
@@ -450,14 +471,16 @@ def read(
 ) -> Table:
     """Stream a line-delimited HTTP response (jsonlines, SSE ``data:``
     lines, plaintext, or raw bytes) into a table; reconnects on EOF in
-    streaming mode, skipping already-consumed bytes (default; SSE push
-    endpoints default to NOT resuming by offset since each connection
-    carries only new events). ``connect_timeout_ms`` is a blanket socket
+    streaming mode. ``resume_with_offset`` controls whether a reconnect
+    skips already-consumed bytes: leave it ``None`` (default) to decide per
+    connection — finite/re-served bodies (Content-Length) resume, live-tail
+    endpoints (SSE, chunked push streams, which send only NEW data per
+    connection) do not, so fresh records are never swallowed as "already
+    ingested". Pass an explicit bool to override both ways.
+    ``connect_timeout_ms`` is a blanket socket
     timeout — it also bounds idle gaps BETWEEN streamed lines, so leave it
     unset for quiet live streams. ``_opener(url, headers) -> file-like``
     is injectable for offline tests."""
-    if resume_with_offset is None:
-        resume_with_offset = not sse
     if format not in ("raw", "plaintext", "json"):
         raise ValueError(
             f"unsupported HTTP read format {format!r}: raw/plaintext/json"

@@ -158,9 +158,17 @@ class _KafkaConnector(BaseConnector):
             self._positions.update(self._seek_to)
 
     def _make_consumer(self):
+        import uuid
+
         ck = _confluent()
         settings = dict(self.settings)
-        settings.setdefault("group.id", f"pathway-{self.topic}")
+        # unique per run: a shared default group would make two independent
+        # pipelines on the same topic split partitions and each silently see
+        # half the data (reference always takes group.id from
+        # rdkafka_settings; our default must not alias across runs)
+        settings.setdefault(
+            "group.id", f"pathway-{self.topic}-{uuid.uuid4().hex[:12]}"
+        )
         settings.setdefault(
             "auto.offset.reset",
             "latest" if self.start_from_latest else "earliest",
@@ -351,7 +359,6 @@ def read_from_upstash(
         "sasl.mechanism": "SCRAM-SHA-256",
         "sasl.username": username,
         "sasl.password": password,
-        "group.id": f"pathway-upstash-{topic}",
         "auto.offset.reset": "latest" if read_only_new else "earliest",
     }
     return read(
@@ -393,7 +400,6 @@ def simple_read(
         )
     rdkafka_settings = {
         "bootstrap.servers": server,
-        "group.id": f"pathway-simple-{topic}",
         "session.timeout.ms": "60000",
         "auto.offset.reset": "latest" if read_only_new else "earliest",
     }

@@ -107,22 +107,73 @@ class _S3ScanConnector(BaseConnector):
         self.refresh_interval = refresh_interval
         self._seen: dict[str, str] = {}  # object key -> etag
         self._emitted_pk: dict[int, tuple] = {}
+        # object key -> {row key: row its current content provides}, so
+        # ETag changes and deletions retract stale rows (reference
+        # ``scanner/s3.rs`` emits Update/Delete actions, not blind re-adds)
+        self._obj_rows: dict[str, dict[int, tuple]] = {}
+        # pk row key -> object whose value is live (several objects can
+        # carry the same pk; deleting a non-owner must not retract, and
+        # deleting the owner falls back to another source's value)
+        self._row_owner: dict[int, str] = {}
+        self._replayed_rows: dict[int, tuple] = {}
         if mode != "static":
             self.heartbeat_ms = 500
 
-    # persistence offset = the seen map (key -> etag), like fs's mtime map
+    # persistence offset = the seen map (key -> etag) plus enough to rebuild
+    # _obj_rows from replayed row payloads. Non-pk row keys are hash(uri, i)
+    # with i contiguous, so a per-object COUNT suffices — O(objects), not
+    # O(rows). Pk objects store their row-key lists (pk upsert sources are
+    # keyed data, typically far smaller than raw logs).
     def current_offset(self):
-        return dict(self._seen)
+        if self.schema.primary_key_columns():
+            return {
+                "seen": dict(self._seen),
+                "obj_rows": {k: list(v) for k, v in self._obj_rows.items()},
+                "owner": dict(self._row_owner),
+            }
+        return {
+            "seen": dict(self._seen),
+            "counts": {k: len(v) for k, v in self._obj_rows.items()},
+        }
 
     def seek_offset(self, offset) -> None:
-        if isinstance(offset, dict):
+        if not isinstance(offset, dict):
+            return
+        if "seen" not in offset:  # legacy format: plain key -> etag map
             self._seen.update(offset)
+            return
+        self._seen.update(offset["seen"])
+        self._row_owner.update(offset.get("owner", {}))
+        for obj_key, row_keys in offset.get("obj_rows", {}).items():
+            # NB: replay carries the LIVE value per pk; a non-owner source
+            # whose copy differed is restored with the live value until its
+            # ETag next changes — fallback then re-emits a no-op, which is
+            # consistent, just not byte-faithful to the non-owner's content
+            live = self._obj_rows.setdefault(obj_key, {})
+            for rk in row_keys:
+                row = self._replayed_rows.get(rk)
+                if row is not None:
+                    live[rk] = row
+        for obj_key, count in offset.get("counts", {}).items():
+            uri = f"s3://{self.bucket}/{obj_key}"
+            live = self._obj_rows.setdefault(obj_key, {})
+            for i in range(count):
+                rk = hash_values(uri, i)
+                row = self._replayed_rows.get(rk)
+                if row is not None:
+                    live[rk] = row
 
     def on_replay(self, rows) -> None:
-        if self.schema.primary_key_columns():
-            for key, row, diff in rows:
-                if diff > 0:
+        pk = bool(self.schema.primary_key_columns())
+        for key, row, diff in rows:
+            if diff > 0:
+                self._replayed_rows[key] = row
+                if pk:
                     self._emitted_pk[key] = row
+            else:
+                self._replayed_rows.pop(key, None)
+                if pk:
+                    self._emitted_pk.pop(key, None)
 
     def _list_objects(self) -> list[dict]:
         out: list[dict] = []
@@ -137,6 +188,111 @@ class _S3ScanConnector(BaseConnector):
                 return out
             token = resp.get("NextContinuationToken")
 
+    def _parse_object(self, obj: dict, body: bytes, uri: str,
+                      pk, cols, n_proc: int, pid: int) -> dict[int, tuple]:
+        """Parse one downloaded blob into {row key: row} after shard
+        filtering; keys are pk hashes or (uri, index) hashes."""
+        from pathway_tpu.engine.value import shard_of_key
+
+        meta = None
+        if self.with_metadata:
+            meta = Json(
+                {
+                    "path": uri,
+                    "size": int(obj.get("Size", len(body))),
+                    "seen_at": int(time_mod.time()),
+                }
+            )
+        new_rows: dict[int, tuple] = {}
+        for i, values in enumerate(
+            iter_records_from_bytes(body, self.fmt, self.schema, self.csv_settings)
+        ):
+            if self.with_metadata:
+                values = {**values, "_metadata": meta}
+            row = tuple(values[c] for c in cols)
+            if pk:
+                key = hash_values(*[values[c] for c in pk])
+                if n_proc > 1 and shard_of_key(key, n_proc) != pid:
+                    continue
+            else:
+                key = hash_values(uri, i)
+            new_rows[key] = row
+        return new_rows
+
+    def _diff_object(self, key_name: str, new_rows: dict[int, tuple],
+                     pk) -> list[tuple[int, tuple, int]]:
+        """Deltas that move this object's contribution from its previous
+        parse to ``new_rows`` — retracting dropped/changed rows the way the
+        reference scanner emits Update/Delete actions."""
+        deltas: list[tuple[int, tuple, int]] = []
+        old_rows = self._obj_rows.get(key_name, {})
+        live: dict[int, tuple] = {}
+        if pk:
+            for key, row in new_rows.items():
+                old = self._emitted_pk.get(key)
+                if old != row:
+                    # new or changed value: this object's write wins
+                    if old is not None:
+                        deltas.append((key, old, -1))
+                    deltas.append((key, row, 1))
+                    self._emitted_pk[key] = row
+                    self._row_owner[key] = key_name
+                elif key not in self._row_owner:
+                    self._row_owner[key] = key_name
+                # old == row with another owner: an extra source for the
+                # same value — record it in `live`, leave ownership alone
+                live[key] = row
+            self._set_live(key_name, live)
+            for key, old in old_rows.items():
+                if key in new_rows:
+                    continue  # still produced here
+                if self._row_owner.get(key) != key_name:
+                    continue  # live value owned by another object
+                self._drop_or_failover(key, key_name, deltas)
+            return deltas
+        else:
+            for key, row in new_rows.items():
+                old = old_rows.get(key)
+                if old == row:
+                    live[key] = row
+                    continue
+                if old is not None:
+                    deltas.append((key, old, -1))
+                deltas.append((key, row, 1))
+                live[key] = row
+            for key, old in old_rows.items():
+                if key not in new_rows:
+                    deltas.append((key, old, -1))
+        self._set_live(key_name, live)
+        return deltas
+
+    def _set_live(self, key_name: str, live: dict[int, tuple]) -> None:
+        if live:
+            self._obj_rows[key_name] = live
+        else:
+            self._obj_rows.pop(key_name, None)
+
+    def _drop_or_failover(self, key: int, key_name: str,
+                          deltas: list[tuple[int, tuple, int]]) -> None:
+        """The owning object stopped providing pk ``key``: hand the live
+        value over to another object still carrying it, else retract."""
+        cur = self._emitted_pk.get(key)
+        for obj2, rows2 in self._obj_rows.items():
+            if obj2 == key_name:
+                continue
+            val2 = rows2.get(key)
+            if val2 is None:
+                continue
+            if val2 != cur and cur is not None:
+                deltas.append((key, cur, -1))
+                deltas.append((key, val2, 1))
+                self._emitted_pk[key] = val2
+            self._row_owner[key] = obj2
+            return
+        self._row_owner.pop(key, None)
+        if self._emitted_pk.pop(key, None) is not None:
+            deltas.append((key, cur, -1))
+
     def _read_new(self) -> list[tuple[int, tuple, int]]:
         from pathway_tpu.internals import config as config_mod
         from pathway_tpu.engine.value import shard_of_key
@@ -146,6 +302,7 @@ class _S3ScanConnector(BaseConnector):
         cols = list(self.node.column_names)
         pk = self.schema.primary_key_columns()
         rows: list[tuple[int, tuple, int]] = []
+        listed: set[str] = set()
         for obj in self._list_objects():
             key_name = obj["Key"]
             if key_name.endswith("/"):
@@ -157,6 +314,7 @@ class _S3ScanConnector(BaseConnector):
                 and shard_of_key(hash_values(uri), n_proc) != pid
             ):
                 continue
+            listed.add(key_name)
             etag = str(obj.get("ETag", obj.get("LastModified", "")))
             if self._seen.get(key_name) == etag:
                 continue
@@ -173,34 +331,14 @@ class _S3ScanConnector(BaseConnector):
                 get_global_error_log().log(f"s3: fetch {uri} failed: {exc!r}")
                 continue
             self._seen[key_name] = etag
-            meta = None
-            if self.with_metadata:
-                meta = Json(
-                    {
-                        "path": uri,
-                        "size": int(obj.get("Size", len(body))),
-                        "seen_at": int(time_mod.time()),
-                    }
-                )
-            for i, values in enumerate(
-                iter_records_from_bytes(body, self.fmt, self.schema, self.csv_settings)
-            ):
-                if self.with_metadata:
-                    values = {**values, "_metadata": meta}
-                row = tuple(values[c] for c in cols)
-                if pk:
-                    key = hash_values(*[values[c] for c in pk])
-                    if n_proc > 1 and shard_of_key(key, n_proc) != pid:
-                        continue
-                    old = self._emitted_pk.get(key)
-                    if old == row:
-                        continue
-                    if old is not None:
-                        rows.append((key, old, -1))
-                    self._emitted_pk[key] = row
-                else:
-                    key = hash_values(uri, i)
-                rows.append((key, row, 1))
+            new_rows = self._parse_object(obj, body, uri, pk, cols, n_proc, pid)
+            rows.extend(self._diff_object(key_name, new_rows, pk))
+        # objects gone from the bucket: retract everything they contributed
+        for key_name in list(self._seen):
+            if key_name in listed:
+                continue
+            del self._seen[key_name]
+            rows.extend(self._diff_object(key_name, {}, pk))
         return rows
 
     def run(self):

@@ -399,4 +399,11 @@ def test_http_read_sse_defaults_to_no_offset_resume():
     hc = next(
         c for c in pw.G.connectors if isinstance(c, _HttpStreamConnector)
     )
-    assert hc.resume_with_offset is False  # SSE sends only NEW events
+    import io as _io
+
+    # SSE sends only NEW events per connection: never skip by offset, even
+    # for a response that advertises a finite Content-Length
+    class _Resp(_io.BytesIO):
+        headers = {"Content-Length": "0"}
+
+    assert hc._should_resume(_Resp(b"")) is False

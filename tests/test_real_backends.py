@@ -315,13 +315,147 @@ def test_s3_bucket_from_settings_and_offsets():
     assert [row[0] for row in rows.values()] == ["q"]
     # the seen map is the snapshot offset; seeking past it skips re-download
     off = conn.current_offset()
-    assert list(off) == ["pre/a.jsonl"]
+    assert list(off["seen"]) == ["pre/a.jsonl"]
     conn2 = _S3ScanConnector(
         conn.node, client, "frombucket", "pre/", "json", WordSchema,
         "static", False, None,
     )
     conn2.seek_offset(off)
     assert conn2._read_new() == []
+
+
+def test_s3_etag_change_retracts_previous_rows():
+    """An object rewritten in place must retract its old rows, not re-add
+    them under the same keys (reference scanner emits Update actions)."""
+    from pathway_tpu.io.s3 import _S3ScanConnector
+
+    client = _StubS3Client({"d/a.jsonl": _jsonl("old1", "old2")})
+    pw.io.s3.read(
+        "s3://b/d/",
+        aws_s3_settings=pw.io.s3.AwsS3Settings(client=client),
+        format="json", schema=WordSchema, mode="static",
+    )
+    conn = next(c for c in pw.G.connectors if isinstance(c, _S3ScanConnector))
+    first = conn._read_new()
+    assert sorted(r[0][0] for r in [(row, d) for _, row, d in first]) == [
+        "old1", "old2"
+    ]
+    # rewrite: one row changed, one dropped, one added
+    client.objects["d/a.jsonl"] = _jsonl("old1", "new3")
+    deltas = conn._read_new()
+    by_sign = {
+        +1: sorted(row[0] for _, row, d in deltas if d > 0),
+        -1: sorted(row[0] for _, row, d in deltas if d < 0),
+    }
+    assert by_sign == {+1: ["new3"], -1: ["old2"]}  # old1 untouched
+    # net state: old1 + new3 only, each with multiplicity one
+    net: dict = {}
+    for key, row, d in first + deltas:
+        net[key] = net.get(key, 0) + d
+        if net[key] == 0:
+            del net[key]
+    assert len(net) == 2 and all(v == 1 for v in net.values())
+
+
+class _PkWordSchema(pw.Schema):
+    word: str = pw.column_definition(primary_key=True)
+    n: int
+
+
+def _pk_jsonl(*pairs):
+    return "".join(
+        json.dumps({"word": w, "n": n}) + "\n" for w, n in pairs
+    ).encode()
+
+
+def _s3_pk_conn(client):
+    from pathway_tpu.io.s3 import _S3ScanConnector
+
+    pw.io.s3.read(
+        "s3://b/d/",
+        aws_s3_settings=pw.io.s3.AwsS3Settings(client=client),
+        format="json", schema=_PkWordSchema, mode="static",
+    )
+    return next(c for c in pw.G.connectors if isinstance(c, _S3ScanConnector))
+
+
+def test_s3_pk_upsert_and_owner_deletion():
+    client = _StubS3Client({"d/a.jsonl": _pk_jsonl(("k", 1), ("m", 5))})
+    conn = _s3_pk_conn(client)
+    assert len(conn._read_new()) == 2
+    # same pk rewritten with a new value: one retract + one add
+    client.objects["d/a.jsonl"] = _pk_jsonl(("k", 2), ("m", 5))
+    deltas = conn._read_new()
+    assert sorted((row, d) for _, row, d in deltas) == [
+        (("k", 1), -1), (("k", 2), 1)
+    ]
+    # object gone: both pks retracted
+    del client.objects["d/a.jsonl"]
+    deltas = conn._read_new()
+    assert sorted((row, d) for _, row, d in deltas) == [
+        (("k", 2), -1), (("m", 5), -1)
+    ]
+    assert conn._read_new() == []
+
+
+def test_s3_pk_duplicate_source_deletion_keeps_row():
+    """Deleting an object whose pk rows are still carried by ANOTHER object
+    must not retract them (ownership fails over, it does not dangle)."""
+    client = _StubS3Client({"d/a.jsonl": _pk_jsonl(("k", 1))})
+    conn = _s3_pk_conn(client)
+    assert len(conn._read_new()) == 1
+    # a second object with the IDENTICAL row (export/compaction duplicate)
+    client.objects["d/b.jsonl"] = _pk_jsonl(("k", 1))
+    assert conn._read_new() == []  # same value: nothing to emit
+    # delete the duplicate: row still provided by d/a.jsonl -> no deltas
+    del client.objects["d/b.jsonl"]
+    assert conn._read_new() == []
+    # delete the original too: NOW it retracts
+    del client.objects["d/a.jsonl"]
+    deltas = conn._read_new()
+    assert [(row, d) for _, row, d in deltas] == [(("k", 1), -1)]
+
+
+def test_s3_pk_owner_deletion_fails_over_to_other_value():
+    """Owner deleted while another object carries a DIFFERENT value for the
+    same pk: the live value reverts to the surviving source's."""
+    client = _StubS3Client({"d/a.jsonl": _pk_jsonl(("k", 1))})
+    conn = _s3_pk_conn(client)
+    assert len(conn._read_new()) == 1
+    client.objects["d/b.jsonl"] = _pk_jsonl(("k", 2))  # later write wins
+    deltas = conn._read_new()
+    assert sorted((row, d) for _, row, d in deltas) == [
+        (("k", 1), -1), (("k", 2), 1)
+    ]
+    del client.objects["d/b.jsonl"]  # owner gone; a still has ("k", 1)
+    deltas = conn._read_new()
+    assert sorted((row, d) for _, row, d in deltas) == [
+        (("k", 1), 1), (("k", 2), -1)
+    ]
+    del client.objects["d/a.jsonl"]
+    deltas = conn._read_new()
+    assert [(row, d) for _, row, d in deltas] == [(("k", 1), -1)]
+
+
+def test_s3_deleted_object_retracts_rows():
+    from pathway_tpu.io.s3 import _S3ScanConnector
+
+    client = _StubS3Client(
+        {"d/a.jsonl": _jsonl("keep"), "d/b.jsonl": _jsonl("gone1", "gone2")}
+    )
+    pw.io.s3.read(
+        "s3://b/d/",
+        aws_s3_settings=pw.io.s3.AwsS3Settings(client=client),
+        format="json", schema=WordSchema, mode="static",
+    )
+    conn = next(c for c in pw.G.connectors if isinstance(c, _S3ScanConnector))
+    assert len(conn._read_new()) == 3
+    del client.objects["d/b.jsonl"]
+    deltas = conn._read_new()
+    assert sorted(row[0] for _, row, d in deltas if d < 0) == ["gone1", "gone2"]
+    assert not any(d > 0 for _, _, d in deltas)
+    # a subsequent scan is quiescent
+    assert conn._read_new() == []
 
 
 def test_s3_local_path_falls_back_to_fs(tmp_path):
