@@ -139,6 +139,15 @@ def consolidate(batch: Batch | None) -> Batch | None:
     """Sum diffs of identical (key, row) pairs; drop zero-diff rows."""
     if batch is None or len(batch) == 0:
         return None
+    # insert-only (or retract-only) batch with all-distinct keys: identical
+    # (key, row) pairs are impossible, so skip the per-row content hashing —
+    # the common shape of every bulk-ingest commit, where hashing wide
+    # object columns (e.g. embedding vectors) would dominate the epoch
+    diffs = batch.diffs
+    if (diffs.min() > 0 or diffs.max() < 0) and len(
+        np.unique(batch.keys)
+    ) == len(batch):
+        return batch
     rh = row_hashes(batch)
     native = _get_native_consolidate()
     if native is not None:

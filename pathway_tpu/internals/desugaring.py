@@ -65,6 +65,8 @@ def _sub(e: ColumnExpression, m: Mapping[type, Any]) -> ColumnExpression:
             kwargs={k: _sub(v, m) for k, v in e._kwargs.items()},
             max_batch_size=e._max_batch_size,
             batched=e._batched,
+            submit=e._submit_fun,
+            resolve=e._resolve_fun,
         )
         return out
     if isinstance(e, expr_mod.CastExpression):

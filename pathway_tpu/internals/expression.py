@@ -292,6 +292,8 @@ class ApplyExpression(ColumnExpression):
         kwargs: dict | None = None,
         max_batch_size: int | None = None,
         batched: bool = False,
+        submit: Callable | None = None,
+        resolve: Callable | None = None,
     ):
         self._fun = fun
         self._return_type = dt.wrap(return_type) if return_type is not None else dt.ANY
@@ -305,6 +307,13 @@ class ApplyExpression(ColumnExpression):
         # that becomes one padded XLA call for TPU-backed UDFs (the analog of
         # the reference draining a timely batch, operators.rs:269-305)
         self._batched = batched
+        # two-phase batched UDFs: ``submit`` dispatches one microbatch and
+        # returns a handle WITHOUT waiting for the device; ``resolve`` turns
+        # a list of handles into a list of result-lists with ONE device
+        # drain. On a remote/tunneled accelerator this pipelines the chunks
+        # of an epoch instead of paying a round trip per chunk.
+        self._submit_fun = submit
+        self._resolve_fun = resolve
         self._check_for_disallowed_types = False
 
     def __repr__(self):

@@ -157,8 +157,15 @@ class UDF:
         fun = self.__wrapped__
         if inspect.iscoroutinefunction(fun):
             raise TypeError("batch=True UDFs must have a sync __wrapped__")
+        # two-phase protocol: a UDF exposing submit_batch/resolve_batch gets
+        # its epoch chunks DISPATCHED back-to-back and drained with one
+        # device sync, instead of one blocking call per chunk. A cache
+        # strategy needs per-call results, so it keeps the blocking path.
+        submit = getattr(self, "submit_batch", None)
+        resolve = getattr(self, "resolve_batch", None)
         if self.cache_strategy is not None:
             fun = with_batch_cache_strategy(fun, self.cache_strategy)
+            submit = resolve = None
         rt = self._get_return_type()
         # a batched __wrapped__ is hinted list[X]; the per-row type is X
         if self.return_type is None and typing.get_origin(rt) is list:
@@ -172,6 +179,8 @@ class UDF:
             kwargs=kwargs,
             max_batch_size=self.max_batch_size,
             batched=True,
+            submit=submit,
+            resolve=resolve,
         )
 
 

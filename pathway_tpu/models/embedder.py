@@ -62,6 +62,14 @@ def embed_fn(params, input_ids, attention_mask, cfg: TransformerConfig):
     )
 
 
+def _renorm(v: np.ndarray) -> np.ndarray:
+    """Restore exact unit norm after the float16 transport quantization
+    (~5e-4 relative per component; the norm drifts by up to ~1e-4)."""
+    norms = np.linalg.norm(v, axis=-1, keepdims=True)
+    np.clip(norms, 1e-9, None, out=norms)
+    return v / norms
+
+
 class SentenceEmbedderModel:
     """Host-facing embedder: str batch -> np.ndarray (B, H) unit vectors."""
 
@@ -108,10 +116,33 @@ class SentenceEmbedderModel:
     def embed_batch(self, texts: list[str]) -> np.ndarray:
         if not texts:
             return np.zeros((0, self.cfg.hidden), dtype=np.float32)
+        (out, n) = self.embed_submit(texts)
+        return _renorm(np.asarray(out)[:n].astype(np.float32))
+
+    # -- two-phase path: dispatch many batches, drain with ONE round trip --
+    def embed_submit(self, texts: list[str]):
+        """Tokenize + dispatch WITHOUT waiting for the device; the returned
+        handle resolves via :meth:`embed_resolve`. On a tunneled chip each
+        blocking fetch costs a full RTT, so a stream of microbatches must
+        dispatch back-to-back and drain once. The handle is cast to float16
+        on device: embeddings are unit vectors, so the ~5e-4 relative error
+        is far inside the pipeline's parity gate while the device->host
+        transfer (often the slowest hop on a relayed chip) halves."""
         ids, mask = self.tokenizer(texts, max_length=self.max_length)
         ids, mask = pad_to_buckets(ids, mask)
         out = embed_fn(self.params, jnp.asarray(ids), jnp.asarray(mask), self.cfg)
-        return np.asarray(out[: len(texts)])
+        return (out.astype(jnp.float16), len(texts))
+
+    def embed_resolve(self, handles) -> list[np.ndarray]:
+        """One device drain for every submitted handle -> [(n_i, dim) array].
+        ``device_get`` on the whole list drains every transfer together —
+        measured equal to a device-side concat WITHOUT the risk of compiling
+        a fresh concat executable mid-stream when the chunk count changes."""
+        fetched = jax.device_get([h for h, _ in handles])
+        return [
+            _renorm(np.asarray(o)[:n].astype(np.float32))
+            for o, (_, n) in zip(fetched, handles)
+        ]
 
     def __call__(self, texts: list[str]) -> np.ndarray:
         return self.embed_batch(texts)

@@ -28,6 +28,23 @@ UNK_ID = 100
 
 _WORD_RE = re.compile(r"[a-z0-9]+")
 
+_native_tok = False
+
+
+def _native_tokenize():
+    """Lazy-bind the C++ batch tokenizer (None when unavailable)."""
+    global _native_tok
+    if _native_tok is False:
+        try:
+            from pathway_tpu import native as native_mod
+
+            _native_tok = (
+                native_mod.hash_tokenize_native if native_mod.AVAILABLE else None
+            )
+        except Exception:  # noqa: BLE001
+            _native_tok = None
+    return _native_tok
+
 
 def _fnv1a(s: str) -> int:
     h = 0xCBF29CE484222325
@@ -68,7 +85,38 @@ class HashTokenizer:
         pad_to: int | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Batch-encode. Returns (input_ids, attention_mask) int32/int32,
-        padded to ``pad_to`` (or the longest sequence)."""
+        padded to ``pad_to`` (or the longest sequence). The inner loop runs
+        in the C++ extension when available (the reference tokenizes in
+        Rust, ``src/connectors/data_tokenize.rs``); the Python path below is
+        the byte-identical fallback."""
+        native = _native_tokenize()
+        if native is not None:
+            texts = list(texts)
+            got = native(
+                texts, max_length or self.max_length,
+                self._reserved, self._span,
+            )
+            if got is not None:
+                ids, fallback = got
+                if fallback:
+                    # non-ASCII rows re-tokenize in Python (Unicode case
+                    # folding); widen the matrix if any of them runs longer
+                    seqs = {
+                        i: self.tokenize_ids(texts[i], max_length)
+                        for i in fallback
+                    }
+                    need = max(len(s) for s in seqs.values())
+                    if need > ids.shape[1]:
+                        ids = np.pad(ids, ((0, 0), (0, need - ids.shape[1])))
+                    for i, s in seqs.items():
+                        ids[i, : len(s)] = s
+                if pad_to is not None:
+                    if ids.shape[1] < pad_to:
+                        ids = np.pad(ids, ((0, 0), (0, pad_to - ids.shape[1])))
+                    elif ids.shape[1] > pad_to:
+                        ids = ids[:, :pad_to]
+                mask = (ids != PAD_ID).astype(np.int32)
+                return ids, mask
         seqs = [self.tokenize_ids(t, max_length) for t in texts]
         width = pad_to or max((len(s) for s in seqs), default=2)
         width = max(width, 2)

@@ -134,7 +134,23 @@ if AVAILABLE:
         offs = np.frombuffer(lib.split_lines(data), dtype=np.uint64)
         return offs.reshape(-1, 2)
 
+    def hash_tokenize_native(texts, max_length: int, reserved: int,
+                             span: int):
+        """Batch HashTokenizer ids as (writable (n, width) int32 matrix,
+        fallback row indices needing Python re-tokenization — texts with
+        non-ASCII bytes, where Unicode case folding applies), or None for
+        inputs the C++ path rejects outright (non-strings)."""
+        try:
+            buf, width, fallback = lib.hash_tokenize(
+                texts, max_length, reserved, span
+            )
+        except TypeError:
+            return None
+        ids = np.frombuffer(buf, dtype=np.int32).reshape(len(texts), width)
+        return ids, fallback
+
 else:
     hash_object_column_native = None  # type: ignore[assignment]
     consolidate_pairs_native = None  # type: ignore[assignment]
     split_lines_native = None  # type: ignore[assignment]
+    hash_tokenize_native = None  # type: ignore[assignment]

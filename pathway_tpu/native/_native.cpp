@@ -327,6 +327,113 @@ static PyObject* py_split_lines(PyObject*, PyObject* args) {
   return out;
 }
 
+// hash_tokenize(texts, max_length, reserved, span)
+//   -> (ids_bytearray, width, fallback_indices)
+// The HashTokenizer hot loop (models/tokenizer.py): per text emit
+// [CLS] word-ids [SEP] where word-id = reserved + fnv1a(word) % span, words
+// are maximal [a-z0-9]+ runs of the ASCII-lowercased text, truncated so
+// len(ids) <= max_length. Output is an n*width int32 LE row-major matrix,
+// 0-padded (PAD id is 0 and every real id is > 0, so the attention mask is
+// simply ids != 0). Texts containing non-ASCII bytes are listed in
+// fallback_indices with a bare [CLS][SEP] row: Python's str.lower() does
+// Unicode case folding (U+212A KELVIN SIGN -> 'k' etc.) that a byte scan
+// cannot reproduce, so those rows re-tokenize on the Python path to keep
+// native and fallback ids identical for every input.
+static PyObject* py_hash_tokenize(PyObject*, PyObject* args) {
+  PyObject* seq;
+  long max_length, reserved;
+  unsigned long long span;
+  if (!PyArg_ParseTuple(args, "OllK", &seq, &max_length, &reserved, &span))
+    return nullptr;
+  if (span == 0) {
+    PyErr_SetString(PyExc_ValueError, "span must be positive");
+    return nullptr;
+  }
+  PyObject* fast = PySequence_Fast(seq, "expected a sequence of strings");
+  if (fast == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject** items = PySequence_Fast_ITEMS(fast);
+  std::vector<int32_t> flat;
+  flat.reserve((size_t)n * 16);
+  std::vector<uint32_t> lens((size_t)n);
+  size_t width = 2;
+  PyObject* fallback = PyList_New(0);
+  if (fallback == nullptr) {
+    Py_DECREF(fast);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    Py_ssize_t slen;
+    const char* s = PyUnicode_AsUTF8AndSize(items[i], &slen);
+    if (s == nullptr) {
+      Py_DECREF(fast);
+      Py_DECREF(fallback);
+      return nullptr;  // non-string: caller falls back to the Python path
+    }
+    bool ascii = true;
+    for (Py_ssize_t j = 0; j < slen; j++) {
+      if ((unsigned char)s[j] >= 0x80) {
+        ascii = false;
+        break;
+      }
+    }
+    size_t row_start = flat.size();
+    flat.push_back(101);  // [CLS]
+    long count = 1;
+    if (!ascii) {
+      PyObject* idx = PyLong_FromSsize_t(i);
+      if (idx == nullptr || PyList_Append(fallback, idx) < 0) {
+        Py_XDECREF(idx);
+        Py_DECREF(fast);
+        Py_DECREF(fallback);
+        return nullptr;
+      }
+      Py_DECREF(idx);
+    } else {
+      size_t j = 0;
+      while (j < (size_t)slen && count < max_length - 1) {
+        unsigned char c = (unsigned char)s[j];
+        unsigned char lc = (c >= 'A' && c <= 'Z') ? (unsigned char)(c + 32) : c;
+        bool is_word = (lc >= 'a' && lc <= 'z') || (lc >= '0' && lc <= '9');
+        if (!is_word) {
+          j++;
+          continue;
+        }
+        uint64_t h = 0xCBF29CE484222325ULL;
+        while (j < (size_t)slen) {
+          c = (unsigned char)s[j];
+          lc = (c >= 'A' && c <= 'Z') ? (unsigned char)(c + 32) : c;
+          if (!((lc >= 'a' && lc <= 'z') || (lc >= '0' && lc <= '9'))) break;
+          h ^= (uint64_t)lc;
+          h = (h * 0x100000001B3ULL) & 0xFFFFFFFFFFFFFFFFULL;
+          j++;
+        }
+        flat.push_back((int32_t)(reserved + (long)(h % span)));
+        count++;
+      }
+    }
+    flat.push_back(102);  // [SEP]
+    count++;
+    lens[(size_t)i] = (uint32_t)(flat.size() - row_start);
+    if ((size_t)count > width) width = (size_t)count;
+  }
+  Py_DECREF(fast);
+  PyObject* out = PyByteArray_FromStringAndSize(nullptr, (Py_ssize_t)(n * width * 4));
+  if (out == nullptr) {
+    Py_DECREF(fallback);
+    return nullptr;
+  }
+  int32_t* dst = reinterpret_cast<int32_t*>(PyByteArray_AS_STRING(out));
+  std::memset(dst, 0, (size_t)n * width * 4);
+  size_t pos = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    std::memcpy(dst + (size_t)i * width, flat.data() + pos,
+                (size_t)lens[(size_t)i] * 4);
+    pos += lens[(size_t)i];
+  }
+  return Py_BuildValue("(NnN)", out, (Py_ssize_t)width, fallback);
+}
+
 static PyObject* py_set_pointer_type(PyObject*, PyObject* args) {
   PyObject* t;
   if (!PyArg_ParseTuple(args, "O", &t)) return nullptr;
@@ -345,6 +452,8 @@ static PyMethodDef methods[] = {
      "group (key,row_hash) deltas, sum diffs, drop zeros"},
     {"split_lines", py_split_lines, METH_VARARGS,
      "newline tokenizer returning (start,end) offset pairs"},
+    {"hash_tokenize", py_hash_tokenize, METH_VARARGS,
+     "batch HashTokenizer: texts -> padded int32 id matrix + width"},
     {"set_pointer_type", py_set_pointer_type, METH_VARARGS,
      "register the engine Pointer type"},
     {nullptr, nullptr, 0, nullptr}};

@@ -105,6 +105,16 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         vecs = self.model.embed_batch([t if t is not None else "" for t in input])
         return list(vecs)
 
+    # two-phase protocol (picked up by UDF._call_batched): an epoch's chunks
+    # are all dispatched, then drained with one device round trip
+    def submit_batch(self, input: list[str], **kwargs):
+        return self.model.embed_submit(
+            [t if t is not None else "" for t in input]
+        )
+
+    def resolve_batch(self, handles) -> list[list[np.ndarray]]:
+        return [list(vecs) for vecs in self.model.embed_resolve(handles)]
+
     def get_embedding_dimension(self, **kwargs) -> int:
         return self.model.dim
 
