@@ -55,29 +55,84 @@ def flops_per_doc(cfg, seq: int) -> float:
     return cfg.layers * per_layer
 
 
+WORDS_PER_DOC = 100  # ~128 WordPiece tokens, filling the seq-128 budget
+
+
+def build_text_corpus(rng, n_docs: int):
+    """A WordPiece tokenizer over a synthetic ~4.7k-piece vocab plus
+    ``n_docs`` raw-text documents. The A100 anchor
+    (sentence-transformers ``model.encode``) tokenizes raw strings with
+    WordPiece before the GPU sees anything — the honest headline must pay
+    the same cost. Doc words are ~2/3 in-vocab and ~1/3 compounds that
+    greedy-match into word+``##suffix`` pieces, so the tokenizer does
+    realistic multi-piece work rather than trivial lookups."""
+    from pathway_tpu.models.tokenizer import WordPieceTokenizer
+
+    letters = list("abcdefghijklmnopqrstuvwxyz")
+
+    def rand_words(n, lo, hi):
+        lens = rng.integers(lo, hi + 1, size=n)
+        return sorted({"".join(rng.choice(letters, L)) for L in lens})
+
+    words_in = rand_words(2600, 3, 8)
+    suffixes = rand_words(1400, 2, 4)
+    vocab = (
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+        + letters
+        + ["##" + c for c in letters]
+        + [str(d) for d in range(10)]
+        + ["##" + str(d) for d in range(10)]
+        + words_in
+        + ["##" + s for s in suffixes]
+    )
+    wp = WordPieceTokenizer(vocab, max_length=SEQ)
+    compounds = [
+        w + s
+        for w, s in zip(
+            rng.choice(words_in, 1400), rng.choice(suffixes, 1400)
+        )
+    ]
+    pool = np.array(words_in + compounds)
+    word_matrix = rng.choice(pool, size=(n_docs, WORDS_PER_DOC))
+    texts = [" ".join(row) for row in word_matrix]
+    return wp, texts
+
+
 def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float, dict]:
-    """Config 1 (+5 shape): pipelined embed+index ingest with live queries."""
+    """Config 1 (+5 shape): pipelined tokenize+embed+index ingest with live
+    queries, measured FROM RAW TEXT (WordPiece on host, embed+append on
+    device). A kernels-only window (pre-tokenized ids) is reported alongside
+    to expose the tokenization cost explicitly."""
     rng = np.random.default_rng(0)
     # every dispatched batch is DISTINCT — identical dispatches could be
     # deduped by the runtime, inflating the measurement. Layout: [0] warmup,
     # [1] single-RTT probe, [2..9] embed-only pipeline, [10..] windows.
     n_diag = 10
-    n_unique = N_REPS * N_BATCHES + n_diag
-    host_ids = rng.integers(
-        1000, cfg.vocab_size, size=(n_unique, BATCH, SEQ)
-    ).astype(np.int32)
-    mask = jnp.ones((BATCH, SEQ), dtype=jnp.int32)
+    n_kernel_reps = 2  # kernels-only comparison windows (distinct docs too)
+    n_unique = (N_REPS + n_kernel_reps) * N_BATCHES + n_diag
+    wp, texts = build_text_corpus(rng, n_unique * BATCH)
     index = BruteForceKnnIndex(
-        dimensions=cfg.hidden, reserved_space=BATCH * n_unique, metric="cos"
+        dimensions=cfg.hidden,
+        # every batch (text-in windows, kernels-only windows, diagnostics)
+        # appends once — growing mid-window would recompile every kernel
+        reserved_space=BATCH * (n_unique + 4),
+        metric="cos",
     )
 
-    def ingest(b: int, dev_ids):
-        emb = embed_fn(params, dev_ids, mask, cfg)
+    def tokenize(b: int):
+        ids, m = wp(
+            texts[b * BATCH : (b + 1) * BATCH], max_length=SEQ, pad_to=SEQ
+        )
+        return jax.device_put(ids), jax.device_put(m)
+
+    def ingest(b: int, dev):
+        dev_ids, dev_mask = dev
+        emb = embed_fn(params, dev_ids, dev_mask, cfg)
         index.add_device([f"d{b}_{i}" for i in range(BATCH)], emb)
         return emb
 
     # warmup: compile embed, append, search
-    emb = ingest(-1, jax.device_put(host_ids[0]))
+    emb = ingest(0, tokenize(0))
     index.search(np.asarray(emb[:8]), k=TOP_K)
     jax.device_get(emb[:1, :1])
 
@@ -85,17 +140,16 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
     # tunneled chip per-op block_until_ready is unreliable and each fetch
     # costs a full RTT)
     t0 = time.perf_counter()
-    d = jax.device_put(host_ids[1])
-    e = embed_fn(params, d, mask, cfg)
+    e = ingest(1, tokenize(1))
     jax.device_get(e[:1, :1])
     single_rtt = time.perf_counter() - t0
     diag(phase="embed_single_roundtrip_ms", value=round(single_rtt * 1000, 1))
 
     # embed-only pipelined (isolates the device embed rate from index cost)
     n_pipe = 8
-    devs = [jax.device_put(host_ids[i + 2]) for i in range(n_pipe)]
+    devs = [tokenize(i + 2) for i in range(n_pipe)]
     t0 = time.perf_counter()
-    outs = [embed_fn(params, dd, mask, cfg) for dd in devs]
+    outs = [embed_fn(params, di, dm, cfg) for di, dm in devs]
     jax.device_get([o[:1, :1] for o in outs])
     embed_rate = n_pipe * BATCH / (time.perf_counter() - t0)
     diag(
@@ -116,42 +170,56 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
             reduced_to_batches=n_batches,
         )
 
+    def run_window(base: int, prep) -> float:
+        """One sustained ingest window; ``prep(b)`` produces the device
+        inputs for batch b (tokenize-on-the-fly or pre-tokenized)."""
+        start = time.perf_counter()
+        pending = []
+        # double-buffered: prepare batch b+1 (tokenize + h2d enqueue) while
+        # batch b's compute is in flight
+        dev = prep(base)
+        last = None
+        for b in range(n_batches):
+            nxt = prep(base + b + 1) if b + 1 < n_batches else None
+            last = ingest(base + b, dev)
+            if b % QUERY_EVERY == 0:
+                pending.append(index.search_device(last[:8], k=TOP_K))
+            dev = nxt
+        results = jax.device_get((pending, last[:1, :1]))
+        elapsed = time.perf_counter() - start
+        for scores, idx in results[0]:
+            assert scores.shape[1] == TOP_K
+        return BATCH * n_batches / elapsed
+
     # best-of-N full windows: the shared chip has stochastic multi-second
     # contention stalls, so the max over full windows estimates steady state;
-    # each window is still a real sustained BATCH*n_batches-doc ingest with
-    # interleaved live queries, drained with one round trip.
+    # each window is still a real sustained BATCH*n_batches-doc ingest —
+    # text in, vectors indexed — with live queries riding the stream.
     docs_per_sec = 0.0
     window_rates = []
     windows_started = time.perf_counter()
     for rep in range(n_reps):
         if rep >= 1 and time.perf_counter() - windows_started > WINDOW_BUDGET_S:
             break
-        start = time.perf_counter()
-        pending = []
-        last = None
-        base = n_diag + rep * n_batches  # distinct ids per window
-        # double-buffered token upload: enqueue batch b+1's h2d before
-        # dispatching batch b so the transfer overlaps device compute
-        dev_ids = jax.device_put(host_ids[base])
-        for b in range(n_batches):
-            nxt = (
-                jax.device_put(host_ids[base + b + 1])
-                if b + 1 < n_batches
-                else None
-            )
-            last = ingest(base + b, dev_ids)
-            if b % QUERY_EVERY == 0:
-                pending.append(index.search_device(last[:8], k=TOP_K))
-            dev_ids = nxt
-        results = jax.device_get((pending, last[:1, :1]))
-        elapsed = time.perf_counter() - start
-        for scores, idx in results[0]:
-            assert scores.shape[1] == TOP_K
-        rate = BATCH * n_batches / elapsed
+        base = n_diag + rep * n_batches  # distinct docs per window
+        rate = run_window(base, tokenize)
         window_rates.append(round(rate, 1))
         docs_per_sec = max(docs_per_sec, rate)
+
+    # kernels-only comparison windows: same shapes, tokenization hoisted
+    # out. Each rep uses a FRESH doc range (the bench invariant: identical
+    # dispatches could be deduped by the runtime, inflating the number).
+    kernels_only = 0.0
+    for k in range(n_kernel_reps):
+        base = n_diag + (N_REPS + k) * n_batches
+        pre = {b: tokenize(b) for b in range(base, base + n_batches)}
+        kernels_only = max(kernels_only, run_window(base, lambda b: pre.get(b)))
+    diag(
+        phase="ingest_windows_docs_per_sec",
+        windows=window_rates,
+        kernels_only=round(kernels_only, 1),
+    )
     mfu = docs_per_sec * flops_per_doc(cfg, SEQ) / V5E_PEAK_BF16
-    diag(phase="ingest_windows_docs_per_sec", windows=window_rates)
     breakdown = {
         "metric": "ingest_mfu_pct",
         "value": round(mfu * 100, 1),
@@ -160,7 +228,9 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
             "embed_single_roundtrip_ms": round(single_rtt * 1000, 1),
             "embed_only_docs_per_sec": round(embed_rate, 1),
             "window_docs_per_sec": window_rates,
+            "kernels_only_docs_per_sec": round(kernels_only, 1),
             "flops_per_doc_g": round(flops_per_doc(cfg, SEQ) / 1e9, 2),
+            "tokenizer": "wordpiece (native C++, HF-parity)",
         },
     }
     return docs_per_sec, breakdown

@@ -98,25 +98,14 @@ class HashTokenizer:
             )
             if got is not None:
                 ids, fallback = got
-                if fallback:
-                    # non-ASCII rows re-tokenize in Python (Unicode case
-                    # folding); widen the matrix if any of them runs longer
-                    seqs = {
-                        i: self.tokenize_ids(texts[i], max_length)
-                        for i in fallback
-                    }
-                    need = max(len(s) for s in seqs.values())
-                    if need > ids.shape[1]:
-                        ids = np.pad(ids, ((0, 0), (0, need - ids.shape[1])))
-                    for i, s in seqs.items():
-                        ids[i, : len(s)] = s
-                if pad_to is not None:
-                    if ids.shape[1] < pad_to:
-                        ids = np.pad(ids, ((0, 0), (0, pad_to - ids.shape[1])))
-                    elif ids.shape[1] > pad_to:
-                        ids = ids[:, :pad_to]
-                mask = (ids != PAD_ID).astype(np.int32)
-                return ids, mask
+                # non-ASCII rows re-tokenize in Python (Unicode case
+                # folding); every real id is > 0 so the mask derives from
+                # ids != PAD_ID without per-row lengths
+                return _finish_native_batch(
+                    ids, None, fallback,
+                    lambda i: self.tokenize_ids(texts[i], max_length),
+                    PAD_ID, pad_to,
+                )
         seqs = [self.tokenize_ids(t, max_length) for t in texts]
         width = pad_to or max((len(s) for s in seqs), default=2)
         width = max(width, 2)
@@ -168,6 +157,223 @@ class HashTokenizer:
                 continue
             types[r, first_sep + 1 : len(s)] = 1
         return ids, mask, types
+
+
+def _finish_native_batch(ids, lens, fallback, retokenize, pad_id, pad_to):
+    """Shared tail of a native batch-tokenize: patch in Python-retokenized
+    fallback rows (widening if needed), apply ``pad_to``, and build the
+    attention mask — from per-row ``lens`` when provided, else from
+    ``ids != pad_id`` (valid when no real id can equal the pad id)."""
+    if fallback:
+        if lens is not None:
+            lens = lens.copy()
+        seqs = {i: retokenize(i) for i in fallback}
+        need = max(len(s) for s in seqs.values())
+        if need > ids.shape[1]:
+            ids = np.pad(
+                ids, ((0, 0), (0, need - ids.shape[1])),
+                constant_values=pad_id,
+            )
+        for i, s in seqs.items():
+            ids[i, : len(s)] = s
+            if lens is not None:
+                lens[i] = len(s)
+    if pad_to is not None:
+        if ids.shape[1] < pad_to:
+            ids = np.pad(
+                ids, ((0, 0), (0, pad_to - ids.shape[1])),
+                constant_values=pad_id,
+            )
+        elif ids.shape[1] > pad_to:
+            ids = ids[:, :pad_to]
+    if lens is None:
+        mask = (ids != pad_id).astype(np.int32)
+    else:
+        mask = (np.arange(ids.shape[1])[None, :] < lens[:, None]).astype(
+            np.int32
+        )
+    return ids, mask
+
+
+class WordPieceTokenizer:
+    """BERT-style WordPiece tokenizer from a plain vocab (the algorithm the
+    reference runs via HuggingFace's Rust ``tokenizers``;
+    ``/root/reference/python/pathway/xpacks/llm/embedders.py:270-313``
+    delegates to sentence-transformers which does BasicTokenizer +
+    greedy-longest-match WordPiece). Batch encoding runs in the C++
+    extension for ASCII rows; rows with non-ASCII characters take the
+    Python path (Unicode NFD accent stripping + case folding). Parity with
+    ``transformers.BertTokenizer`` over a shared vocab is pinned by test.
+
+    Vocab: a list of token strings (index = id) or a {token: id} dict, or
+    :meth:`from_vocab_file` for a standard one-token-per-line vocab.txt.
+    """
+
+    def __init__(self, vocab, max_length: int = 256, lowercase: bool = True):
+        if isinstance(vocab, dict):
+            self.vocab = dict(vocab)
+            tokens = [None] * (max(vocab.values()) + 1 if vocab else 0)
+            for t, i in vocab.items():
+                tokens[i] = t
+            self._tokens = ["" if t is None else t for t in tokens]
+        else:
+            self._tokens = list(vocab)
+            self.vocab = {t: i for i, t in enumerate(self._tokens)}
+        self.max_length = max_length
+        self.lowercase = lowercase
+        self.vocab_size = len(self._tokens)
+        self.cls_id = self.vocab.get("[CLS]", CLS_ID)
+        self.sep_id = self.vocab.get("[SEP]", SEP_ID)
+        self.unk_id = self.vocab.get("[UNK]", UNK_ID)
+        self.pad_id = self.vocab.get("[PAD]", PAD_ID)
+        self._native_handle = None
+        if self.pad_id in (self.cls_id, self.sep_id):
+            raise ValueError("[PAD] id must differ from [CLS]/[SEP]")
+
+    def __del__(self):
+        if getattr(self, "_native_handle", None) is not None:
+            try:
+                from pathway_tpu import native as native_mod
+
+                native_mod.lib.wordpiece_free(self._native_handle)
+            except Exception:  # noqa: BLE001 - interpreter shutdown
+                pass
+
+    @classmethod
+    def from_vocab_file(cls, path: str, **kw) -> "WordPieceTokenizer":
+        with open(path, encoding="utf-8") as f:
+            tokens = [line.rstrip("\n") for line in f]
+        while tokens and tokens[-1] == "":
+            tokens.pop()
+        return cls(tokens, **kw)
+
+    # -- Python reference path (full Unicode) ------------------------------
+    @staticmethod
+    def _is_punct(ch: str) -> bool:
+        import unicodedata
+
+        cp = ord(ch)
+        if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (
+            123 <= cp <= 126
+        ):
+            return True
+        return unicodedata.category(ch).startswith("P")
+
+    def _basic_tokens(self, text: str) -> list[str]:
+        import unicodedata
+
+        if self.lowercase:
+            text = text.lower()
+            text = unicodedata.normalize("NFD", text)
+            text = "".join(
+                ch for ch in text if unicodedata.category(ch) != "Mn"
+            )
+        out: list[str] = []
+        word: list[str] = []
+        for ch in text:
+            cp = ord(ch)
+            if ch in (" ", "\t", "\n", "\r") or unicodedata.category(ch) == "Zs":
+                if word:
+                    out.append("".join(word))
+                    word = []
+            elif (cp < 0x20 and ch not in "\t\n\r") or cp == 0x7F:
+                continue  # control chars are stripped
+            elif self._is_punct(ch):
+                if word:
+                    out.append("".join(word))
+                    word = []
+                out.append(ch)
+            else:
+                word.append(ch)
+        if word:
+            out.append("".join(word))
+        return out
+
+    def _word_pieces(self, word: str) -> list[int]:
+        if len(word) > 200:  # BERT max_input_chars_per_word
+            return [self.unk_id]
+        pieces: list[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece_id = None
+            while end > start:
+                probe = ("##" if start else "") + word[start:end]
+                piece_id = self.vocab.get(probe)
+                if piece_id is not None:
+                    break
+                end -= 1
+            if piece_id is None:
+                return [self.unk_id]
+            pieces.append(piece_id)
+            start = end
+        return pieces
+
+    def tokenize_ids(self, text: str, max_length: int | None = None) -> list[int]:
+        ml = max_length or self.max_length
+        pieces: list[int] = []
+        for tok in self._basic_tokens(text):
+            pieces.extend(self._word_pieces(tok))
+        return [self.cls_id] + pieces[: max(ml - 2, 0)] + [self.sep_id]
+
+    # -- batch encode (HashTokenizer-compatible contract) ------------------
+    def __call__(
+        self,
+        texts: Sequence[str],
+        max_length: int | None = None,
+        pad_to: int | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        ml = max_length or self.max_length
+        texts = list(texts)
+        # the C++ kernel lowercases unconditionally: cased vocabs must take
+        # the Python path or native/fallback ids would diverge
+        native = _native_wordpiece() if self.lowercase else None
+        if native is not None:
+            load, tokenize = native
+            if self._native_handle is None:
+                self._native_handle = load(self._tokens)
+            got = tokenize(
+                self._native_handle, texts, ml,
+                self.cls_id, self.sep_id, self.unk_id, self.pad_id,
+            )
+            if got is not None:
+                ids, lens, fallback = got
+                return _finish_native_batch(
+                    ids, lens, fallback,
+                    lambda i: self.tokenize_ids(texts[i], ml),
+                    self.pad_id, pad_to,
+                )
+        seqs = [self.tokenize_ids(t, ml) for t in texts]
+        width = pad_to or max((len(s) for s in seqs), default=2)
+        width = max(width, 2)
+        ids = np.full((len(seqs), width), self.pad_id, dtype=np.int32)
+        mask = np.zeros((len(seqs), width), dtype=np.int32)
+        for r, s in enumerate(seqs):
+            s = s[:width]
+            ids[r, : len(s)] = s
+            mask[r, : len(s)] = 1
+        return ids, mask
+
+
+_native_wp = False
+
+
+def _native_wordpiece():
+    """Lazy-bind the C++ WordPiece pair (load, tokenize); None when absent."""
+    global _native_wp
+    if _native_wp is False:
+        try:
+            from pathway_tpu import native as native_mod
+
+            _native_wp = (
+                (native_mod.wordpiece_load_native,
+                 native_mod.wordpiece_tokenize_native)
+                if native_mod.AVAILABLE
+                else None
+            )
+        except Exception:  # noqa: BLE001
+            _native_wp = None
+    return _native_wp
 
 
 from pathway_tpu.ops import next_pow2 as bucket_pow2  # shared padding discipline

@@ -149,8 +149,32 @@ if AVAILABLE:
         ids = np.frombuffer(buf, dtype=np.int32).reshape(len(texts), width)
         return ids, fallback
 
+    def wordpiece_load_native(tokens) -> int:
+        """Register a WordPiece vocab (list of token strings, index = id);
+        returns an opaque handle for wordpiece_tokenize_native."""
+        return lib.wordpiece_load(list(tokens))
+
+    def wordpiece_tokenize_native(handle: int, texts, max_length: int,
+                                  cls_id: int, sep_id: int, unk_id: int,
+                                  pad_id: int):
+        """Batch WordPiece ids as (writable (n, width) int32 matrix,
+        per-row real lengths, fallback row indices — non-ASCII texts
+        needing the Python path), or None for inputs the C++ path rejects
+        (non-strings)."""
+        try:
+            buf, width, lens_buf, fallback = lib.wordpiece_tokenize(
+                handle, texts, max_length, cls_id, sep_id, unk_id, pad_id
+            )
+        except TypeError:
+            return None
+        ids = np.frombuffer(buf, dtype=np.int32).reshape(len(texts), width)
+        lens = np.frombuffer(lens_buf, dtype=np.uint32)
+        return ids, lens, fallback
+
 else:
     hash_object_column_native = None  # type: ignore[assignment]
     consolidate_pairs_native = None  # type: ignore[assignment]
     split_lines_native = None  # type: ignore[assignment]
     hash_tokenize_native = None  # type: ignore[assignment]
+    wordpiece_load_native = None  # type: ignore[assignment]
+    wordpiece_tokenize_native = None  # type: ignore[assignment]

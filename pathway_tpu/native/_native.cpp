@@ -434,6 +434,218 @@ static PyObject* py_hash_tokenize(PyObject*, PyObject* args) {
   return Py_BuildValue("(NnN)", out, (Py_ssize_t)width, fallback);
 }
 
+// ------------------------------------------------------------- WordPiece
+// The reference's embedders tokenize through HuggingFace's Rust
+// `tokenizers` (BERT BasicTokenizer + WordPiece greedy longest-match);
+// this is the same algorithm as a native batch kernel. ASCII rows are
+// handled here; rows with non-ASCII bytes are returned as fallback
+// indices for the Python path (Unicode NFD accent stripping / case
+// folding). Parity with transformers.BertTokenizer is pinned by test.
+
+#include <unordered_map>
+
+struct WordPieceVocab {
+  std::unordered_map<std::string, int32_t> map;
+};
+static std::vector<WordPieceVocab*> g_wp_vocabs;
+
+// wordpiece_load(tokens) -> handle
+static PyObject* py_wordpiece_load(PyObject*, PyObject* args) {
+  PyObject* seq;
+  if (!PyArg_ParseTuple(args, "O", &seq)) return nullptr;
+  PyObject* fast = PySequence_Fast(seq, "expected a sequence of strings");
+  if (fast == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject** items = PySequence_Fast_ITEMS(fast);
+  auto* vocab = new WordPieceVocab();
+  vocab->map.reserve((size_t)n * 2);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    Py_ssize_t slen;
+    const char* s = PyUnicode_AsUTF8AndSize(items[i], &slen);
+    if (s == nullptr) {
+      delete vocab;
+      Py_DECREF(fast);
+      return nullptr;
+    }
+    // assignment (not emplace): duplicate tokens keep the LAST id, matching
+    // dict comprehension / HF vocab-load semantics
+    vocab->map[std::string(s, (size_t)slen)] = (int32_t)i;
+  }
+  Py_DECREF(fast);
+  // reuse a freed slot before growing the registry
+  for (size_t h = 0; h < g_wp_vocabs.size(); h++) {
+    if (g_wp_vocabs[h] == nullptr) {
+      g_wp_vocabs[h] = vocab;
+      return PyLong_FromSsize_t((Py_ssize_t)h);
+    }
+  }
+  g_wp_vocabs.push_back(vocab);
+  return PyLong_FromSsize_t((Py_ssize_t)g_wp_vocabs.size() - 1);
+}
+
+// wordpiece_free(handle): release a vocab registered by wordpiece_load
+static PyObject* py_wordpiece_free(PyObject*, PyObject* args) {
+  Py_ssize_t handle;
+  if (!PyArg_ParseTuple(args, "n", &handle)) return nullptr;
+  if (handle >= 0 && (size_t)handle < g_wp_vocabs.size()) {
+    delete g_wp_vocabs[(size_t)handle];
+    g_wp_vocabs[(size_t)handle] = nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+static inline bool wp_is_punct(unsigned char c) {
+  // BERT _is_punctuation ASCII ranges
+  return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+         (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+}
+
+// greedy longest-match of one lowercased ASCII word into piece ids
+static void wp_word(const WordPieceVocab& v, const std::string& w,
+                    int32_t unk_id, std::vector<int32_t>& out) {
+  if (w.size() > 200) {  // BERT max_input_chars_per_word
+    out.push_back(unk_id);
+    return;
+  }
+  size_t start = 0;
+  std::vector<int32_t> pieces;
+  std::string probe;
+  while (start < w.size()) {
+    size_t end = w.size();
+    int32_t id = -1;
+    while (end > start) {
+      probe.assign(start ? "##" : "");
+      probe.append(w, start, end - start);
+      auto it = v.map.find(probe);
+      if (it != v.map.end()) {
+        id = it->second;
+        break;
+      }
+      end--;
+    }
+    if (id < 0) {  // whole word becomes [UNK]
+      out.push_back(unk_id);
+      return;
+    }
+    pieces.push_back(id);
+    start = end;
+  }
+  out.insert(out.end(), pieces.begin(), pieces.end());
+}
+
+// wordpiece_tokenize(handle, texts, max_length, cls_id, sep_id, unk_id,
+//                    pad_id) -> (ids_bytearray, width, lens_bytearray,
+//                                fallback_indices)
+static PyObject* py_wordpiece_tokenize(PyObject*, PyObject* args) {
+  Py_ssize_t handle;
+  PyObject* seq;
+  long max_length, cls_id, sep_id, unk_id, pad_id;
+  if (!PyArg_ParseTuple(args, "nOlllll", &handle, &seq, &max_length,
+                        &cls_id, &sep_id, &unk_id, &pad_id))
+    return nullptr;
+  if (handle < 0 || (size_t)handle >= g_wp_vocabs.size() ||
+      g_wp_vocabs[(size_t)handle] == nullptr) {
+    PyErr_SetString(PyExc_ValueError, "bad wordpiece vocab handle");
+    return nullptr;
+  }
+  const WordPieceVocab& vocab = *g_wp_vocabs[(size_t)handle];
+  PyObject* fast = PySequence_Fast(seq, "expected a sequence of strings");
+  if (fast == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject** items = PySequence_Fast_ITEMS(fast);
+  std::vector<int32_t> flat;
+  flat.reserve((size_t)n * 32);
+  std::vector<uint32_t> lens((size_t)n);
+  size_t width = 2;
+  PyObject* fallback = PyList_New(0);
+  if (fallback == nullptr) {
+    Py_DECREF(fast);
+    return nullptr;
+  }
+  std::string word;
+  std::vector<int32_t> pieces;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    Py_ssize_t slen;
+    const char* s = PyUnicode_AsUTF8AndSize(items[i], &slen);
+    if (s == nullptr) {
+      Py_DECREF(fast);
+      Py_DECREF(fallback);
+      return nullptr;
+    }
+    bool ascii = true;
+    for (Py_ssize_t j = 0; j < slen; j++) {
+      if ((unsigned char)s[j] >= 0x80) {
+        ascii = false;
+        break;
+      }
+    }
+    size_t row_start = flat.size();
+    flat.push_back((int32_t)cls_id);
+    if (!ascii) {
+      PyObject* idx = PyLong_FromSsize_t(i);
+      if (idx == nullptr || PyList_Append(fallback, idx) < 0) {
+        Py_XDECREF(idx);
+        Py_DECREF(fast);
+        Py_DECREF(fallback);
+        return nullptr;
+      }
+      Py_DECREF(idx);
+    } else {
+      pieces.clear();
+      word.clear();
+      for (Py_ssize_t j = 0; j <= slen; j++) {
+        unsigned char c = j < slen ? (unsigned char)s[j] : (unsigned char)' ';
+        unsigned char lc = (c >= 'A' && c <= 'Z') ? (unsigned char)(c + 32) : c;
+        bool is_space = (c == ' ' || c == '\t' || c == '\n' || c == '\r');
+        bool is_ctrl = (c < 0x20 && !is_space) || c == 0x7f;
+        if (is_space || wp_is_punct(c) || is_ctrl) {
+          if (!word.empty()) {
+            wp_word(vocab, word, (int32_t)unk_id, pieces);
+            word.clear();
+          }
+          if (wp_is_punct(c)) {
+            std::string p(1, (char)c);
+            auto it = vocab.map.find(p);
+            pieces.push_back(it != vocab.map.end() ? it->second
+                                                   : (int32_t)unk_id);
+          }
+        } else {
+          word.push_back((char)lc);
+        }
+      }
+      long budget = max_length > 2 ? max_length - 2 : 0;  // [CLS]/[SEP] room
+      long take = (long)pieces.size() < budget ? (long)pieces.size() : budget;
+      flat.insert(flat.end(), pieces.begin(), pieces.begin() + take);
+    }
+    flat.push_back((int32_t)sep_id);
+    lens[(size_t)i] = (uint32_t)(flat.size() - row_start);
+    if (lens[(size_t)i] > width) width = lens[(size_t)i];
+  }
+  Py_DECREF(fast);
+  PyObject* out = PyByteArray_FromStringAndSize(
+      nullptr, (Py_ssize_t)(n * width * 4));
+  PyObject* lens_out = PyByteArray_FromStringAndSize(
+      nullptr, (Py_ssize_t)(n * 4));
+  if (out == nullptr || lens_out == nullptr) {
+    Py_XDECREF(out);
+    Py_XDECREF(lens_out);
+    Py_DECREF(fallback);
+    return nullptr;
+  }
+  int32_t* dst = reinterpret_cast<int32_t*>(PyByteArray_AS_STRING(out));
+  uint32_t* lp = reinterpret_cast<uint32_t*>(PyByteArray_AS_STRING(lens_out));
+  size_t pos = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    uint32_t len = lens[(size_t)i];
+    std::memcpy(dst + (size_t)i * width, flat.data() + pos, (size_t)len * 4);
+    for (size_t j = len; j < width; j++)
+      dst[(size_t)i * width + j] = (int32_t)pad_id;
+    lp[i] = len;
+    pos += len;
+  }
+  return Py_BuildValue("(NnNN)", out, (Py_ssize_t)width, lens_out, fallback);
+}
+
 static PyObject* py_set_pointer_type(PyObject*, PyObject* args) {
   PyObject* t;
   if (!PyArg_ParseTuple(args, "O", &t)) return nullptr;
@@ -454,6 +666,12 @@ static PyMethodDef methods[] = {
      "newline tokenizer returning (start,end) offset pairs"},
     {"hash_tokenize", py_hash_tokenize, METH_VARARGS,
      "batch HashTokenizer: texts -> padded int32 id matrix + width"},
+    {"wordpiece_load", py_wordpiece_load, METH_VARARGS,
+     "register a WordPiece vocab; returns a handle"},
+    {"wordpiece_free", py_wordpiece_free, METH_VARARGS,
+     "release a WordPiece vocab handle"},
+    {"wordpiece_tokenize", py_wordpiece_tokenize, METH_VARARGS,
+     "batch WordPiece: texts -> padded int32 id matrix + width + fallbacks"},
     {"set_pointer_type", py_set_pointer_type, METH_VARARGS,
      "register the engine Pointer type"},
     {nullptr, nullptr, 0, nullptr}};

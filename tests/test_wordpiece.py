@@ -1,0 +1,129 @@
+"""WordPiece tokenizer parity tests.
+
+The bench's text-in headline and air-gapped HF-checkpoint deployments rely
+on ``WordPieceTokenizer`` producing EXACTLY the ids
+``transformers.BertTokenizer`` would produce over the same vocab (the
+reference tokenizes through sentence-transformers / HF ``tokenizers`` —
+``/root/reference/python/pathway/xpacks/llm/embedders.py:270-313``).
+"""
+
+import numpy as np
+import pytest
+
+from pathway_tpu.models import tokenizer as tok_mod
+from pathway_tpu.models.tokenizer import WordPieceTokenizer
+
+VOCAB = (
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    + ["the", "quick", "brown", "fox", "jump", "##ed", "##ing", "##s",
+       "run", "over", "lazy", "dog", "stream", "tensor", "in", "##dex",
+       "!", ",", ".", "?", "'", "un", "##aff", "##able"]
+    + list("abcdefghijklmnopqrstuvwxyz0123456789")
+    + ["##" + c for c in "abcdefghijklmnopqrstuvwxyz0123456789"]
+)
+
+TEXTS = [
+    "The quick brown fox JUMPED over the lazy dog!",
+    "unaffable streams, indexing?",
+    "zzz unknownword the",
+    "",
+    "a b c 1 2 3 . . .",
+    "x" * 250,  # > 200-char word -> [UNK] (BERT max_input_chars_per_word)
+    "  spaces   and\ttabs\nnewlines  ",
+    "café junÉ the",  # NFD accent strip
+    "naïve fox",
+    "İstanbul run",  # dotted capital I case folding
+]
+
+
+@pytest.fixture()
+def hf_tokenizer(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n")
+    return transformers.BertTokenizer(vocab_file=str(p), do_lower_case=True)
+
+
+def test_matches_transformers_bert_tokenizer(hf_tokenizer):
+    wp = WordPieceTokenizer(VOCAB, max_length=32)
+    ids, mask = wp(TEXTS)
+    for i, t in enumerate(TEXTS):
+        expect = hf_tokenizer(t, truncation=True, max_length=32)["input_ids"]
+        got = [int(x) for x in ids[i][: int(mask[i].sum())]]
+        assert got == expect, t
+
+
+def test_native_and_python_paths_identical():
+    wp = WordPieceTokenizer(VOCAB, max_length=32)
+    ids_n, mask_n = wp(TEXTS)
+    tok_mod._native_wp = None  # force the pure-Python path
+    try:
+        ids_p, mask_p = wp(TEXTS)
+    finally:
+        tok_mod._native_wp = False  # lazily re-bind on next call
+    assert np.array_equal(ids_n, ids_p)
+    assert np.array_equal(mask_n, mask_p)
+
+
+def test_duplicate_vocab_entries_keep_last_id(tmp_path):
+    """HF vocab loading maps duplicate tokens to their LAST index; the
+    native path must agree (a real failure mode caught in review)."""
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "run", "##s", "run"]
+    wp = WordPieceTokenizer(vocab, max_length=8)
+    ids, mask = wp(["run runs"])
+    got = [int(x) for x in ids[0][: int(mask[0].sum())]]
+    assert got == [2, 6, 6, 5, 3]
+
+
+def test_vocab_file_round_trip(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(VOCAB) + "\n")
+    wp = WordPieceTokenizer.from_vocab_file(str(p), max_length=16)
+    assert wp.vocab_size == len(VOCAB)
+    ids, mask = wp(["the fox runs"])
+    assert ids[0][0] == wp.cls_id
+    assert ids[0][int(mask[0].sum()) - 1] == wp.sep_id
+
+
+def test_pad_to_and_mask_contract():
+    wp = WordPieceTokenizer(VOCAB, max_length=16)
+    ids, mask = wp(["the fox", "the"], pad_to=12)
+    assert ids.shape == (2, 12) and mask.shape == (2, 12)
+    assert mask[0].sum() == 4 and mask[1].sum() == 3
+    assert (ids[mask == 0] == wp.pad_id).all()
+
+
+def test_cased_vocab_skips_native_lowercasing():
+    """lowercase=False must not hit the C++ kernel (which lowercases
+    unconditionally): cased tokens keep their ids on every path."""
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "Hello", "hello"]
+    wp = WordPieceTokenizer(vocab, max_length=8, lowercase=False)
+    ids, mask = wp(["Hello hello"])
+    got = [int(x) for x in ids[0][: int(mask[0].sum())]]
+    assert got == [2, 4, 5, 3]
+
+
+def test_tiny_max_length_does_not_crash():
+    wp = WordPieceTokenizer(VOCAB, max_length=16)
+    for ml in (1, 2, 3):
+        ids, mask = wp(["the quick brown fox"], max_length=ml)
+        got = [int(x) for x in ids[0][: int(mask[0].sum())]]
+        assert got[0] == wp.cls_id and got[-1] == wp.sep_id
+        assert len(got) <= max(ml, 2)
+
+
+def test_vocab_handle_freed_and_reused():
+    import gc
+
+    from pathway_tpu import native as native_mod
+
+    if not native_mod.AVAILABLE:
+        pytest.skip("native extension unavailable")
+    wp1 = WordPieceTokenizer(VOCAB, max_length=8)
+    wp1(["the"])  # binds the native handle
+    h1 = wp1._native_handle
+    del wp1
+    gc.collect()
+    wp2 = WordPieceTokenizer(VOCAB, max_length=8)
+    wp2(["the"])
+    assert wp2._native_handle == h1  # freed slot is reused, not leaked
