@@ -145,6 +145,121 @@ def parse_stream_record(value: bytes, fmt: str, schema, cols, dtypes):
     return parse_record_fields(obj, cols, dtypes, schema)
 
 
+def _get_native_rows():
+    """The C++ batch record->row extractor (None if absent)."""
+    from pathway_tpu.native.binding import native_bind
+
+    return native_bind("rows_from_records")
+
+
+def _get_native_jsonl():
+    """The one-pass C++ jsonlines parser (None if absent)."""
+    from pathway_tpu.native.binding import native_bind
+
+    return native_bind("jsonl_rows")
+
+
+def _dtype_code(dtype: dt.DType) -> int:
+    """Column dtype -> C++ fast-coercion code (0 = always take the Python
+    parse_value path for non-null values)."""
+    target = dtype.strip_optional()
+    if target is dt.INT:
+        return 1
+    if target is dt.FLOAT:
+        return 2
+    if target is dt.BOOL:
+        return 3
+    if target is dt.STR:
+        return 4
+    if target is dt.BYTES:
+        return 5
+    if (
+        target is dt.JSON
+        or target is dt.DATE_TIME_NAIVE
+        or target is dt.DATE_TIME_UTC
+        or isinstance(target, (dt.List, dt.Tuple, dt.Array))
+    ):
+        return 0  # needs Json wrapping / datetime / container parsing
+    return 6  # parse_value passes every other target through untouched
+
+
+def fast_rows_eligible(fmt: str) -> bool:
+    """Whether ``rows_from_bytes`` will return rows (vs None) for ``fmt`` —
+    callers check this BEFORE slurping file bytes they might not need."""
+    return fmt in ("json", "jsonlines") and _get_native_rows() is not None
+
+
+def rows_from_bytes(data: bytes, fmt: str, schema,
+                    csv_settings: "CsvParserSettings | None" = None):
+    """Fast batch parse: raw jsonlines bytes -> list of row TUPLES in schema
+    column order (the reference parses records entirely in Rust,
+    ``src/connectors/data_format.rs:500,1439``; this is the C++ analog).
+    Returns None when the fast path does not apply (other formats, no
+    native extension) — callers then fall back to the per-record dict path
+    (``iter_records_from_bytes``). Records needing slow coercions are
+    re-parsed per-record in Python, so results are identical either way;
+    non-dict JSON lines are skipped like undecodable ones."""
+    if fmt not in ("json", "jsonlines"):
+        return None
+    native = _get_native_rows()
+    if native is None:
+        return None
+    cols = [c for c in schema.column_names() if c != "_metadata"]
+    dtypes = {n: c.dtype for n, c in schema.__columns__.items()}
+    codes = [_dtype_code(dtypes[c]) for c in cols]
+    defaults = {
+        c: v for c, v in schema.default_values().items() if c in cols
+    }
+    jsonl_native = _get_native_jsonl()
+    if jsonl_native is not None:
+        # one-pass bytes -> rows; odd lines (escapes, containers, slow
+        # coercions) come back as (row index, line bytes) for Python
+        rows, fallback = jsonl_native(data, cols, codes, defaults)
+        drop = []
+        for i, line in fallback:
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                drop.append(i)
+                continue
+            if not isinstance(obj, dict):
+                drop.append(i)
+                continue
+            values = parse_record_fields(obj, cols, dtypes, schema)
+            rows[i] = tuple(values[c] for c in cols)
+        for i in reversed(drop):
+            del rows[i]
+        return rows
+    lines = [ln for ln in data.split(b"\n") if ln.strip()]
+    out: list[tuple] = []
+    CHUNK = 20_000
+    for start in range(0, len(lines), CHUNK):
+        chunk = lines[start : start + CHUNK]
+        try:
+            objs = json.loads(b"[" + b",".join(chunk) + b"]")
+        except json.JSONDecodeError:
+            objs = []
+            for line in chunk:
+                try:
+                    objs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        rows, fallback = native(objs, cols, codes, defaults)
+        if fallback:
+            drop = []
+            for i in fallback:
+                obj = objs[i]
+                if not isinstance(obj, dict):
+                    drop.append(i)  # scalar/array line: skip, don't crash
+                    continue
+                values = parse_record_fields(obj, cols, dtypes, schema)
+                rows[i] = tuple(values[c] for c in cols)
+            for i in reversed(drop):
+                del rows[i]
+        out.extend(rows)
+    return out
+
+
 def _iter_lines(data: bytes):
     """'\n'-separated lines, mirroring text-file iteration (the final
     newline does not produce an empty trailing line; '\r' is preserved)."""
@@ -194,7 +309,11 @@ def iter_records_from_bytes(data: bytes, fmt: str, schema,
                     except json.JSONDecodeError:
                         continue
             for obj in objs:
-                yield parse_record_fields(obj, cols, dtypes, schema)
+                # valid JSON but not a record (null / number / array):
+                # skip — same containment as parse_stream_record; one bad
+                # line must not kill the connector
+                if isinstance(obj, dict):
+                    yield parse_record_fields(obj, cols, dtypes, schema)
     elif fmt == "plaintext":
         for line in _iter_lines(data):
             yield {"data": line}

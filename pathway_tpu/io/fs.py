@@ -26,8 +26,10 @@ from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._streams import BaseConnector, next_commit_time
 from pathway_tpu.io._utils import (
     CsvParserSettings,
+    fast_rows_eligible,
     format_value_for_output,
     iter_records_from_bytes,
+    rows_from_bytes,
 )
 
 
@@ -148,6 +150,31 @@ class _FsConnector(BaseConnector):
             except OSError:
                 continue
             if fp in seen and seen[fp] >= mtime:
+                continue
+            if not self.with_metadata and fast_rows_eligible(self.fmt):
+                # C++ batch parse: bytes -> row tuples in one pass.
+                # Eligibility is checked BEFORE reading (no double slurp
+                # for csv/plaintext), and `seen` advances only after a
+                # successful read — a transient OSError retries next poll
+                # instead of silently dropping the file forever.
+                try:
+                    with open(fp, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    continue
+                seen[fp] = mtime
+                fast = rows_from_bytes(
+                    data, self.fmt, self.schema, self.csv_settings
+                )
+                if pk:
+                    pk_idx = [cols.index(c) for c in pk]
+                    entries.extend(
+                        (r, tuple(r[j] for j in pk_idx)) for r in fast
+                    )
+                else:
+                    entries.extend(
+                        (r, (fp, i)) for i, r in enumerate(fast)
+                    )
                 continue
             seen[fp] = mtime
             meta = _metadata_for(fp) if self.with_metadata else None

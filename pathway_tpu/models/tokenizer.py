@@ -28,21 +28,16 @@ UNK_ID = 100
 
 _WORD_RE = re.compile(r"[a-z0-9]+")
 
-_native_tok = False
+_native_tok = False  # test hook: set to None to force the Python path
 
 
 def _native_tokenize():
     """Lazy-bind the C++ batch tokenizer (None when unavailable)."""
     global _native_tok
     if _native_tok is False:
-        try:
-            from pathway_tpu import native as native_mod
+        from pathway_tpu.native.binding import native_bind
 
-            _native_tok = (
-                native_mod.hash_tokenize_native if native_mod.AVAILABLE else None
-            )
-        except Exception:  # noqa: BLE001
-            _native_tok = None
+        _native_tok = native_bind("hash_tokenize_native")
     return _native_tok
 
 
@@ -355,24 +350,18 @@ class WordPieceTokenizer:
         return ids, mask
 
 
-_native_wp = False
+_native_wp = False  # test hook: set to None to force the Python path
 
 
 def _native_wordpiece():
     """Lazy-bind the C++ WordPiece pair (load, tokenize); None when absent."""
     global _native_wp
     if _native_wp is False:
-        try:
-            from pathway_tpu import native as native_mod
+        from pathway_tpu.native.binding import native_bind
 
-            _native_wp = (
-                (native_mod.wordpiece_load_native,
-                 native_mod.wordpiece_tokenize_native)
-                if native_mod.AVAILABLE
-                else None
-            )
-        except Exception:  # noqa: BLE001
-            _native_wp = None
+        load = native_bind("wordpiece_load_native")
+        tokenize = native_bind("wordpiece_tokenize_native")
+        _native_wp = (load, tokenize) if load and tokenize else None
     return _native_wp
 
 

@@ -14,7 +14,9 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -598,7 +600,9 @@ static PyObject* py_wordpiece_tokenize(PyObject*, PyObject* args) {
         unsigned char lc = (c >= 'A' && c <= 'Z') ? (unsigned char)(c + 32) : c;
         bool is_space = (c == ' ' || c == '\t' || c == '\n' || c == '\r');
         bool is_ctrl = (c < 0x20 && !is_space) || c == 0x7f;
-        if (is_space || wp_is_punct(c) || is_ctrl) {
+        if (is_ctrl) continue;  // control chars are REMOVED (BERT
+        // clean_text): 'ab\x01cd' stays ONE word, it does not split
+        if (is_space || wp_is_punct(c)) {
           if (!word.empty()) {
             wp_word(vocab, word, (int32_t)unk_id, pieces);
             word.clear();
@@ -646,6 +650,477 @@ static PyObject* py_wordpiece_tokenize(PyObject*, PyObject* args) {
   return Py_BuildValue("(NnNN)", out, (Py_ssize_t)width, lens_out, fallback);
 }
 
+// rows_from_records(records, cols, dtype_codes, defaults)
+//   -> (rows list[tuple], fallback_indices list[int])
+// Batch schema extraction+coercion — the per-record half the reference
+// does in Rust (src/connectors/data_format.rs JsonLinesParser). For each
+// record dict, produce one row tuple in column order with the FAST
+// coercions applied in C: exact-type passthrough, int->float, absent ->
+// schema default / None. A record needing anything slower (string->int
+// parses, datetimes, JSON wrapping, non-dict records) lands in
+// fallback_indices and is re-parsed wholesale by the Python path, so
+// semantics cannot drift. dtype_codes per column: 0=always-fallback,
+// 1=INT, 2=FLOAT, 3=BOOL, 4=STR, 5=BYTES, 6=ANY(passthrough).
+static PyObject* py_rows_from_records(PyObject*, PyObject* args) {
+  PyObject *records, *cols, *codes_obj, *defaults;
+  if (!PyArg_ParseTuple(args, "OOOO", &records, &cols, &codes_obj, &defaults))
+    return nullptr;
+  PyObject* rec_fast = PySequence_Fast(records, "records must be a sequence");
+  if (rec_fast == nullptr) return nullptr;
+  PyObject* col_fast = PySequence_Fast(cols, "cols must be a sequence");
+  if (col_fast == nullptr) {
+    Py_DECREF(rec_fast);
+    return nullptr;
+  }
+  PyObject* code_fast = PySequence_Fast(codes_obj, "codes must be a sequence");
+  if (code_fast == nullptr) {
+    Py_DECREF(rec_fast);
+    Py_DECREF(col_fast);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(rec_fast);
+  Py_ssize_t nc = PySequence_Fast_GET_SIZE(col_fast);
+  if (PySequence_Fast_GET_SIZE(code_fast) != nc || !PyDict_Check(defaults)) {
+    Py_DECREF(rec_fast);
+    Py_DECREF(col_fast);
+    Py_DECREF(code_fast);
+    PyErr_SetString(PyExc_ValueError, "cols/codes length mismatch or bad defaults");
+    return nullptr;
+  }
+  std::vector<long> codes((size_t)nc);
+  for (Py_ssize_t j = 0; j < nc; j++) {
+    codes[(size_t)j] = PyLong_AsLong(PySequence_Fast_GET_ITEM(code_fast, j));
+  }
+  PyObject** recs = PySequence_Fast_ITEMS(rec_fast);
+  PyObject** colnames = PySequence_Fast_ITEMS(col_fast);
+  PyObject* rows = PyList_New(n);
+  PyObject* fallback = PyList_New(0);
+  if (rows == nullptr || fallback == nullptr) goto fail;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* rec = recs[i];
+    bool ok = PyDict_Check(rec);
+    PyObject* row = ok ? PyTuple_New(nc) : nullptr;
+    if (ok && row == nullptr) goto fail;
+    for (Py_ssize_t j = 0; ok && j < nc; j++) {
+      PyObject* v = PyDict_GetItem(rec, colnames[j]);  // borrowed
+      PyObject* outv = nullptr;
+      if (v == nullptr) {  // absent field: schema default, else null
+        outv = PyDict_GetItem(defaults, colnames[j]);
+        if (outv == nullptr) outv = Py_None;
+        Py_INCREF(outv);
+      } else if (v == Py_None) {
+        outv = Py_None;
+        Py_INCREF(outv);
+      } else {
+        switch (codes[(size_t)j]) {
+          case 1:  // INT
+            if (PyLong_Check(v) && !PyBool_Check(v)) {
+              outv = v;
+              Py_INCREF(outv);
+            }
+            break;
+          case 2:  // FLOAT
+            if (PyFloat_Check(v)) {
+              outv = v;
+              Py_INCREF(outv);
+            } else if (PyLong_Check(v) && !PyBool_Check(v)) {
+              double d = PyLong_AsDouble(v);
+              if (d == -1.0 && PyErr_Occurred()) {
+                PyErr_Clear();
+              } else {
+                outv = PyFloat_FromDouble(d);
+              }
+            }
+            break;
+          case 3:  // BOOL
+            if (PyBool_Check(v)) {
+              outv = v;
+              Py_INCREF(outv);
+            }
+            break;
+          case 4:  // STR
+            if (PyUnicode_Check(v)) {
+              outv = v;
+              Py_INCREF(outv);
+            }
+            break;
+          case 5:  // BYTES
+            if (PyBytes_Check(v)) {
+              outv = v;
+              Py_INCREF(outv);
+            }
+            break;
+          case 6:  // ANY: passthrough
+            outv = v;
+            Py_INCREF(outv);
+            break;
+          default:
+            break;  // 0: always fallback
+        }
+      }
+      if (outv == nullptr) {
+        ok = false;  // slow coercion needed: whole record -> Python
+      } else {
+        PyTuple_SET_ITEM(row, j, outv);
+      }
+    }
+    if (ok) {
+      PyList_SET_ITEM(rows, i, row);  // steals
+    } else {
+      Py_XDECREF(row);
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(rows, i, Py_None);
+      PyObject* idx = PyLong_FromSsize_t(i);
+      if (idx == nullptr || PyList_Append(fallback, idx) < 0) {
+        Py_XDECREF(idx);
+        goto fail;
+      }
+      Py_DECREF(idx);
+    }
+  }
+  Py_DECREF(rec_fast);
+  Py_DECREF(col_fast);
+  Py_DECREF(code_fast);
+  return Py_BuildValue("(NN)", rows, fallback);
+fail:
+  Py_DECREF(rec_fast);
+  Py_DECREF(col_fast);
+  Py_DECREF(code_fast);
+  Py_XDECREF(rows);
+  Py_XDECREF(fallback);
+  return nullptr;
+}
+
+// jsonl_rows(data, cols, dtype_codes, defaults)
+//   -> (rows list[tuple|None], fallback list[(index, line_bytes)])
+// One-pass JSON-lines parse + schema extraction + fast coercion straight
+// from bytes — the full Rust-parser analog (data_format.rs JsonLinesParser
+// over data_tokenize.rs lines). Flat objects with string/int/float/bool/
+// null values parse here; any line with escapes, nested containers,
+// overflowing ints, or coercions outside the fast table is returned as a
+// fallback (index, bytes) pair for the Python path. Blank lines produce no
+// row. Rows list holds None at fallback positions (caller patches/drops).
+namespace jsonl {
+
+struct Cursor {
+  const char* p;
+  const char* end;
+};
+
+static inline void skip_ws(Cursor& c) {
+  while (c.p < c.end &&
+         (*c.p == ' ' || *c.p == '\t' || *c.p == '\r')) {
+    c.p++;
+  }
+}
+
+// scan a JSON string (after the opening quote); false => escape/invalid
+static bool scan_string(Cursor& c, const char** s, size_t* len) {
+  *s = c.p;
+  while (c.p < c.end) {
+    unsigned char ch = (unsigned char)*c.p;
+    if (ch == '"') {
+      *len = (size_t)(c.p - *s);
+      c.p++;
+      return true;
+    }
+    if (ch == '\\' || ch < 0x20) return false;  // escapes -> python path
+    c.p++;
+  }
+  return false;
+}
+
+enum ValKind { V_FAIL, V_STR, V_INT, V_FLOAT, V_TRUE, V_FALSE, V_NULL };
+
+struct Val {
+  ValKind kind;
+  const char* s;
+  size_t len;
+  long long i;
+  double d;
+};
+
+static Val parse_value(Cursor& c) {
+  Val v;
+  v.kind = V_FAIL;
+  skip_ws(c);
+  if (c.p >= c.end) return v;
+  char ch = *c.p;
+  if (ch == '"') {
+    c.p++;
+    if (scan_string(c, &v.s, &v.len)) v.kind = V_STR;
+    return v;
+  }
+  if (ch == 't') {
+    if (c.end - c.p >= 4 && std::memcmp(c.p, "true", 4) == 0) {
+      c.p += 4;
+      v.kind = V_TRUE;
+    }
+    return v;
+  }
+  if (ch == 'f') {
+    if (c.end - c.p >= 5 && std::memcmp(c.p, "false", 5) == 0) {
+      c.p += 5;
+      v.kind = V_FALSE;
+    }
+    return v;
+  }
+  if (ch == 'n') {
+    if (c.end - c.p >= 4 && std::memcmp(c.p, "null", 4) == 0) {
+      c.p += 4;
+      v.kind = V_NULL;
+    }
+    return v;
+  }
+  if (ch == '-' || (ch >= '0' && ch <= '9')) {
+    // strict JSON number grammar: -?(0|[1-9]\d*)(\.\d+)?([eE][+-]?\d+)?
+    // — leading-zero ints ('0123') and empty fractions ('1.') must FAIL
+    // here exactly like json.loads rejects them, or the fast path would
+    // emit rows from lines the Python path drops
+    const char* start = c.p;
+    bool is_float = false;
+    if (ch == '-') c.p++;
+    const char* int_start = c.p;
+    while (c.p < c.end && *c.p >= '0' && *c.p <= '9') c.p++;
+    size_t int_digits = (size_t)(c.p - int_start);
+    if (int_digits == 0 ||
+        (int_digits > 1 && *int_start == '0')) {
+      return v;
+    }
+    if (c.p < c.end && *c.p == '.') {
+      is_float = true;
+      c.p++;
+      const char* frac_start = c.p;
+      while (c.p < c.end && *c.p >= '0' && *c.p <= '9') c.p++;
+      if (c.p == frac_start) return v;  // '1.' is not JSON
+    }
+    if (c.p < c.end && (*c.p == 'e' || *c.p == 'E')) {
+      is_float = true;
+      c.p++;
+      if (c.p < c.end && (*c.p == '+' || *c.p == '-')) c.p++;
+      const char* exp_start = c.p;
+      while (c.p < c.end && *c.p >= '0' && *c.p <= '9') c.p++;
+      if (c.p == exp_start) return v;  // '1e' is not JSON
+    }
+    std::string num(start, (size_t)(c.p - start));
+    if (is_float) {
+      char* endp = nullptr;
+      v.d = std::strtod(num.c_str(), &endp);
+      if (endp == num.c_str() + num.size()) v.kind = V_FLOAT;
+    } else {
+      errno = 0;
+      char* endp = nullptr;
+      v.i = std::strtoll(num.c_str(), &endp, 10);
+      if (errno == 0 && endp == num.c_str() + num.size()) v.kind = V_INT;
+    }
+    return v;
+  }
+  return v;  // '{' / '[' / garbage -> fallback
+}
+
+}  // namespace jsonl
+
+static PyObject* py_jsonl_rows(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  PyObject *cols, *codes_obj, *defaults;
+  if (!PyArg_ParseTuple(args, "y*OOO", &buf, &cols, &codes_obj, &defaults))
+    return nullptr;
+  PyObject* col_fast = PySequence_Fast(cols, "cols must be a sequence");
+  PyObject* code_fast =
+      col_fast ? PySequence_Fast(codes_obj, "codes must be a sequence")
+               : nullptr;
+  if (col_fast == nullptr || code_fast == nullptr) {
+    Py_XDECREF(col_fast);
+    PyBuffer_Release(&buf);
+    return nullptr;
+  }
+  Py_ssize_t nc = PySequence_Fast_GET_SIZE(col_fast);
+  std::vector<std::string> names((size_t)nc);
+  std::vector<long> codes((size_t)nc);
+  std::vector<PyObject*> defvals((size_t)nc);  // borrowed (or nullptr)
+  bool arg_err = PySequence_Fast_GET_SIZE(code_fast) != nc ||
+                 !PyDict_Check(defaults);
+  for (Py_ssize_t j = 0; !arg_err && j < nc; j++) {
+    PyObject* nm = PySequence_Fast_GET_ITEM(col_fast, j);
+    Py_ssize_t sl;
+    const char* s = PyUnicode_AsUTF8AndSize(nm, &sl);
+    if (s == nullptr) {
+      arg_err = true;
+      break;
+    }
+    names[(size_t)j].assign(s, (size_t)sl);
+    codes[(size_t)j] = PyLong_AsLong(PySequence_Fast_GET_ITEM(code_fast, j));
+    defvals[(size_t)j] = PyDict_GetItem(defaults, nm);
+  }
+  if (arg_err) {
+    Py_DECREF(col_fast);
+    Py_DECREF(code_fast);
+    PyBuffer_Release(&buf);
+    if (!PyErr_Occurred())
+      PyErr_SetString(PyExc_ValueError, "bad cols/codes/defaults");
+    return nullptr;
+  }
+  PyObject* rows = PyList_New(0);
+  PyObject* fallback = PyList_New(0);
+  const char* data = reinterpret_cast<const char*>(buf.buf);
+  const char* data_end = data + buf.len;
+  std::vector<PyObject*> rowvals((size_t)nc);  // owned per row
+  const char* line = data;
+  bool mem_err = false;
+  while (line < data_end && !mem_err) {
+    const char* nl = (const char*)std::memchr(line, '\n', (size_t)(data_end - line));
+    const char* line_end = nl ? nl : data_end;
+    jsonl::Cursor c{line, line_end};
+    jsonl::skip_ws(c);
+    if (c.p == line_end) {  // blank line: no row
+      line = nl ? nl + 1 : data_end;
+      continue;
+    }
+    bool ok = (*c.p == '{');
+    if (ok) c.p++;
+    for (Py_ssize_t j = 0; j < nc; j++) rowvals[(size_t)j] = nullptr;
+    if (ok) {
+      jsonl::skip_ws(c);
+      if (c.p < line_end && *c.p == '}') {
+        c.p++;  // empty object
+      } else {
+        while (ok) {
+          jsonl::skip_ws(c);
+          if (c.p >= line_end || *c.p != '"') {
+            ok = false;
+            break;
+          }
+          c.p++;
+          const char* ks;
+          size_t klen;
+          if (!jsonl::scan_string(c, &ks, &klen)) {
+            ok = false;
+            break;
+          }
+          jsonl::skip_ws(c);
+          if (c.p >= line_end || *c.p != ':') {
+            ok = false;
+            break;
+          }
+          c.p++;
+          jsonl::Val v = jsonl::parse_value(c);
+          if (v.kind == jsonl::V_FAIL) {
+            ok = false;
+            break;
+          }
+          // which column? (linear scan; schemas are narrow)
+          Py_ssize_t target = -1;
+          for (Py_ssize_t j = 0; j < nc; j++) {
+            if (names[(size_t)j].size() == klen &&
+                std::memcmp(names[(size_t)j].data(), ks, klen) == 0) {
+              target = j;
+              break;
+            }
+          }
+          if (target >= 0) {
+            PyObject* outv = nullptr;
+            long code = codes[(size_t)target];
+            switch (v.kind) {
+              case jsonl::V_NULL:
+                outv = Py_None;
+                Py_INCREF(outv);
+                break;
+              case jsonl::V_STR:
+                if (code == 4 || code == 6)
+                  outv = PyUnicode_FromStringAndSize(v.s, (Py_ssize_t)v.len);
+                break;
+              case jsonl::V_INT:
+                if (code == 1 || code == 6)
+                  outv = PyLong_FromLongLong(v.i);
+                else if (code == 2)
+                  outv = PyFloat_FromDouble((double)v.i);
+                break;
+              case jsonl::V_FLOAT:
+                if (code == 2 || code == 6)
+                  outv = PyFloat_FromDouble(v.d);
+                break;
+              case jsonl::V_TRUE:
+              case jsonl::V_FALSE:
+                if (code == 3 || code == 6) {
+                  outv = v.kind == jsonl::V_TRUE ? Py_True : Py_False;
+                  Py_INCREF(outv);
+                }
+                break;
+              default:
+                break;
+            }
+            if (outv == nullptr) {
+              // slow coercion -> python (clear any allocation/decoding
+              // error PyUnicode_FromStringAndSize may have set)
+              if (PyErr_Occurred()) PyErr_Clear();
+              ok = false;
+              break;
+            }
+            Py_XDECREF(rowvals[(size_t)target]);  // duplicate key: last wins
+            rowvals[(size_t)target] = outv;
+          }
+          jsonl::skip_ws(c);
+          if (c.p < line_end && *c.p == ',') {
+            c.p++;
+            continue;
+          }
+          if (c.p < line_end && *c.p == '}') {
+            c.p++;
+            break;
+          }
+          ok = false;
+        }
+      }
+      if (ok) {  // only trailing whitespace may follow
+        jsonl::skip_ws(c);
+        ok = (c.p == line_end);
+      }
+    }
+    if (ok) {
+      PyObject* row = PyTuple_New(nc);
+      if (row == nullptr) {
+        mem_err = true;
+      } else {
+        for (Py_ssize_t j = 0; j < nc; j++) {
+          PyObject* outv = rowvals[(size_t)j];
+          if (outv == nullptr) {
+            outv = defvals[(size_t)j] ? defvals[(size_t)j] : Py_None;
+            Py_INCREF(outv);
+          }
+          PyTuple_SET_ITEM(row, j, outv);
+          rowvals[(size_t)j] = nullptr;
+        }
+        if (PyList_Append(rows, row) < 0) mem_err = true;
+        Py_DECREF(row);
+      }
+    } else {
+      for (Py_ssize_t j = 0; j < nc; j++) Py_XDECREF(rowvals[(size_t)j]);
+      PyObject* entry = Py_BuildValue(
+          "(ny#)", (Py_ssize_t)PyList_GET_SIZE(rows), line,
+          (Py_ssize_t)(line_end - line));
+      if (entry == nullptr || PyList_Append(fallback, entry) < 0) {
+        Py_XDECREF(entry);
+        mem_err = true;
+      } else {
+        Py_DECREF(entry);
+        Py_INCREF(Py_None);
+        if (PyList_Append(rows, Py_None) < 0) mem_err = true;
+        Py_DECREF(Py_None);
+      }
+    }
+    line = nl ? nl + 1 : data_end;
+  }
+  Py_DECREF(col_fast);
+  Py_DECREF(code_fast);
+  PyBuffer_Release(&buf);
+  if (mem_err) {
+    Py_XDECREF(rows);
+    Py_XDECREF(fallback);
+    return nullptr;
+  }
+  return Py_BuildValue("(NN)", rows, fallback);
+}
+
 static PyObject* py_set_pointer_type(PyObject*, PyObject* args) {
   PyObject* t;
   if (!PyArg_ParseTuple(args, "O", &t)) return nullptr;
@@ -670,6 +1145,10 @@ static PyMethodDef methods[] = {
      "register a WordPiece vocab; returns a handle"},
     {"wordpiece_free", py_wordpiece_free, METH_VARARGS,
      "release a WordPiece vocab handle"},
+    {"rows_from_records", py_rows_from_records, METH_VARARGS,
+     "batch record-dict -> row-tuple extraction with fast coercions"},
+    {"jsonl_rows", py_jsonl_rows, METH_VARARGS,
+     "one-pass jsonlines bytes -> row tuples with schema coercion"},
     {"wordpiece_tokenize", py_wordpiece_tokenize, METH_VARARGS,
      "batch WordPiece: texts -> padded int32 id matrix + width + fallbacks"},
     {"set_pointer_type", py_set_pointer_type, METH_VARARGS,

@@ -1,0 +1,27 @@
+"""Safe lazy resolution of native-backed helpers.
+
+Every Python hot path that prefers a C++ implementation binds it through
+:func:`native_bind` — one place for the import guard and the AVAILABLE
+check, instead of a copy of the try/import/except memoizer per call site.
+Returns the wrapper defined in :mod:`pathway_tpu.native` when one exists
+(e.g. ``hash_tokenize_native``), else the raw extension symbol, else None
+(callers then take their pure-Python path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def native_bind(name: str):
+    try:
+        from pathway_tpu import native as native_mod
+    except Exception:  # noqa: BLE001 - a broken extension degrades, never breaks
+        return None
+    if not native_mod.AVAILABLE:
+        return None
+    fn = getattr(native_mod, name, None)
+    if fn is not None:
+        return fn
+    return getattr(native_mod.lib, name, None)

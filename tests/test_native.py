@@ -124,3 +124,69 @@ def test_hash_tokenize_native_matches_python():
             tok_mod._native_tok = False  # re-bind lazily next call
         assert np.array_equal(ids_n, ids_p), texts
         assert np.array_equal(mask_n, mask_p), texts
+
+
+def test_jsonl_rows_native_matches_dict_path():
+    """The one-pass C++ jsonlines parser must produce exactly the rows the
+    per-record dict path produces — including fallback lines (escapes,
+    string->int coercions, bigints), dropped non-record lines, duplicate
+    keys (last wins), and schema defaults."""
+    from pathway_tpu.internals import schema as sm
+    from pathway_tpu.io import _utils as U
+
+    if U._get_native_jsonl() is None:
+        pytest.skip("native extension unavailable")
+    S2 = sm.schema_from_types(word=str, n=int, f=float, ok=bool)
+    lines = [
+        '{"word": "a", "n": 1, "f": 1.5, "ok": true}',
+        '{"word": "b", "n": 2, "f": 2, "ok": false}',
+        "",
+        '{"word": "c\\u00e9", "n": 3, "f": -1e3, "ok": null}',
+        '{"n": "7", "word": 5, "f": "x", "ok": "yes"}',
+        '{"word": "dup", "word": "dup2", "n": 4, "f": 0.0, "ok": true}',
+        '{"extra": [1,2], "word": "e", "n": 5, "f": 5.5, "ok": false}',
+        "not json at all",
+        "[1, 2, 3]",
+        '{"word": "big", "n": 9223372036854775808, "f": 1.0, "ok": true}',
+        '{"word": "unicodé", "n": 6, "f": 6.0, "ok": true}',
+        '{"missing": 1}',
+        "{}",
+        '  {"word": "ws", "n": 8, "f": 8.0, "ok": false}  ',
+    ]
+    data = "\n".join(lines).encode("utf-8")
+    cols = list(S2.column_names())
+    fast = U.rows_from_bytes(data, "json", S2)
+    slow = [
+        tuple(v[c] for c in cols)
+        for v in U.iter_records_from_bytes(data, "json", S2)
+    ]
+    assert fast == slow
+    for a, b in zip(fast, slow):
+        assert all(type(x) is type(y) for x, y in zip(a, b))
+
+
+def test_jsonl_rows_rejects_non_json_numbers():
+    """Leading-zero ints and empty fractions are not JSON; the fast path
+    must drop those lines exactly like json.loads does (confirmed
+    divergence caught in review)."""
+    from pathway_tpu.internals import schema as sm
+    from pathway_tpu.io import _utils as U
+
+    if U._get_native_jsonl() is None:
+        pytest.skip("native extension unavailable")
+    S2 = sm.schema_from_types(n=int, f=float)
+    lines = [
+        '{"n": 0123, "f": 1.0}',   # leading zero: invalid
+        '{"n": 1, "f": 1.}',        # empty fraction: invalid
+        '{"n": 2, "f": 1e}',        # empty exponent: invalid
+        '{"n": 3, "f": 0.5}',       # valid (bare zero int part is fine)
+        '{"n": -0, "f": 2e3}',      # valid
+    ]
+    data = "\n".join(lines).encode()
+    cols = list(S2.column_names())
+    fast = U.rows_from_bytes(data, "json", S2)
+    slow = [
+        tuple(v[c] for c in cols)
+        for v in U.iter_records_from_bytes(data, "json", S2)
+    ]
+    assert fast == slow == [(3, 0.5), (0, 2000.0)]

@@ -127,3 +127,21 @@ def test_vocab_handle_freed_and_reused():
     wp2 = WordPieceTokenizer(VOCAB, max_length=8)
     wp2(["the"])
     assert wp2._native_handle == h1  # freed slot is reused, not leaked
+
+
+def test_control_chars_removed_not_split(hf_tokenizer):
+    """BERT clean_text REMOVES control chars: 'ab\\x01cd' is one word, not
+    two (a confirmed native/Python divergence caught in review)."""
+    wp = WordPieceTokenizer(VOCAB, max_length=16)
+    texts = ["ab\x01cd the", "run\x0bning", "fox\x7fes"]
+    ids_n, mask_n = wp(texts)
+    tok_mod._native_wp = None
+    try:
+        ids_p, mask_p = wp(texts)
+    finally:
+        tok_mod._native_wp = False
+    assert np.array_equal(ids_n, ids_p)
+    for i, t in enumerate(texts):
+        expect = hf_tokenizer(t, truncation=True, max_length=16)["input_ids"]
+        got = [int(x) for x in ids_n[i][: int(mask_n[i].sum())]]
+        assert got == expect, t
