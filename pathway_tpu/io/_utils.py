@@ -183,14 +183,43 @@ def _dtype_code(dtype: dt.DType) -> int:
     return 6  # parse_value passes every other target through untouched
 
 
+_JSONL_CHUNK = 20_000
+
+
+def _parse_json_line_chunks(lines):
+    """Yield decoded objects for jsonlines content, chunked: one
+    ``json.loads`` per chunk is ~3x per-line calls, and chunking bounds the
+    transient join memory on multi-GB files. A chunk with any invalid line
+    — or where the joined parse yields a different record count than the
+    line count (a line holding SEVERAL comma-separated objects is malformed
+    jsonlines, not two records) — falls back per-line with bad lines
+    skipped, so results never depend on chunk boundaries."""
+    for start in range(0, len(lines), _JSONL_CHUNK):
+        chunk = lines[start : start + _JSONL_CHUNK]
+        objs = None
+        try:
+            joined = json.loads(b"[" + b",".join(chunk) + b"]")
+            if len(joined) == len(chunk):
+                objs = joined
+        except json.JSONDecodeError:
+            pass
+        if objs is None:
+            objs = []
+            for line in chunk:
+                try:
+                    objs.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        yield from objs
+
+
 def fast_rows_eligible(fmt: str) -> bool:
     """Whether ``rows_from_bytes`` will return rows (vs None) for ``fmt`` —
     callers check this BEFORE slurping file bytes they might not need."""
     return fmt in ("json", "jsonlines") and _get_native_rows() is not None
 
 
-def rows_from_bytes(data: bytes, fmt: str, schema,
-                    csv_settings: "CsvParserSettings | None" = None):
+def rows_from_bytes(data: bytes, fmt: str, schema):
     """Fast batch parse: raw jsonlines bytes -> list of row TUPLES in schema
     column order (the reference parses records entirely in Rust,
     ``src/connectors/data_format.rs:500,1439``; this is the C++ analog).
@@ -198,12 +227,11 @@ def rows_from_bytes(data: bytes, fmt: str, schema,
     native extension) — callers then fall back to the per-record dict path
     (``iter_records_from_bytes``). Records needing slow coercions are
     re-parsed per-record in Python, so results are identical either way;
-    non-dict JSON lines are skipped like undecodable ones."""
-    if fmt not in ("json", "jsonlines"):
+    non-record JSON lines (scalars/arrays, multi-object lines) are skipped
+    like undecodable ones."""
+    if not fast_rows_eligible(fmt):
         return None
     native = _get_native_rows()
-    if native is None:
-        return None
     cols = [c for c in schema.column_names() if c != "_metadata"]
     dtypes = {n: c.dtype for n, c in schema.__columns__.items()}
     codes = [_dtype_code(dtypes[c]) for c in cols]
@@ -231,33 +259,20 @@ def rows_from_bytes(data: bytes, fmt: str, schema,
             del rows[i]
         return rows
     lines = [ln for ln in data.split(b"\n") if ln.strip()]
-    out: list[tuple] = []
-    CHUNK = 20_000
-    for start in range(0, len(lines), CHUNK):
-        chunk = lines[start : start + CHUNK]
-        try:
-            objs = json.loads(b"[" + b",".join(chunk) + b"]")
-        except json.JSONDecodeError:
-            objs = []
-            for line in chunk:
-                try:
-                    objs.append(json.loads(line))
-                except json.JSONDecodeError:
-                    continue
-        rows, fallback = native(objs, cols, codes, defaults)
-        if fallback:
-            drop = []
-            for i in fallback:
-                obj = objs[i]
-                if not isinstance(obj, dict):
-                    drop.append(i)  # scalar/array line: skip, don't crash
-                    continue
-                values = parse_record_fields(obj, cols, dtypes, schema)
-                rows[i] = tuple(values[c] for c in cols)
-            for i in reversed(drop):
-                del rows[i]
-        out.extend(rows)
-    return out
+    objs = list(_parse_json_line_chunks(lines))
+    rows, fallback = native(objs, cols, codes, defaults)
+    if fallback:
+        drop = []
+        for i in fallback:
+            obj = objs[i]
+            if not isinstance(obj, dict):
+                drop.append(i)  # scalar/array line: skip, don't crash
+                continue
+            values = parse_record_fields(obj, cols, dtypes, schema)
+            rows[i] = tuple(values[c] for c in cols)
+        for i in reversed(drop):
+            del rows[i]
+    return rows
 
 
 def _iter_lines(data: bytes):
@@ -291,29 +306,13 @@ def iter_records_from_bytes(data: bytes, fmt: str, schema,
         for record in reader:
             yield parse_record_fields(record, cols, dtypes, schema)
     elif fmt in ("json", "jsonlines"):
-        lines = [ln for ln in (l.strip() for l in _iter_lines(data)) if ln]
-        # chunked batch parse: one loads() per CHUNK lines is ~3x faster
-        # than per-line calls, and chunking bounds the transient join/parse
-        # memory on multi-GB files; a chunk with any invalid line falls
-        # back per-line (bad lines skipped, matching per-line behavior)
-        CHUNK = 20_000
-        for start in range(0, len(lines), CHUNK):
-            chunk = lines[start : start + CHUNK]
-            try:
-                objs = json.loads("[" + ",".join(chunk) + "]")
-            except json.JSONDecodeError:
-                objs = []
-                for line in chunk:
-                    try:
-                        objs.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        continue
-            for obj in objs:
-                # valid JSON but not a record (null / number / array):
-                # skip — same containment as parse_stream_record; one bad
-                # line must not kill the connector
-                if isinstance(obj, dict):
-                    yield parse_record_fields(obj, cols, dtypes, schema)
+        lines = [ln for ln in data.split(b"\n") if ln.strip()]
+        for obj in _parse_json_line_chunks(lines):
+            # valid JSON but not a record (null / number / array):
+            # skip — same containment as parse_stream_record; one bad
+            # line must not kill the connector
+            if isinstance(obj, dict):
+                yield parse_record_fields(obj, cols, dtypes, schema)
     elif fmt == "plaintext":
         for line in _iter_lines(data):
             yield {"data": line}

@@ -163,9 +163,7 @@ class _FsConnector(BaseConnector):
                 except OSError:
                     continue
                 seen[fp] = mtime
-                fast = rows_from_bytes(
-                    data, self.fmt, self.schema, self.csv_settings
-                )
+                fast = rows_from_bytes(data, self.fmt, self.schema)
                 if pk:
                     pk_idx = [cols.index(c) for c in pk]
                     entries.extend(

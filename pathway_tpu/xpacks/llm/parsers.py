@@ -245,15 +245,88 @@ class ImageParser(pw.UDF):
 
 class SlideParser(pw.UDF):
     """Parse slide decks page-by-page with a vision LLM (reference
-    ``SlideParser``, parsers.py:569-744 — licensed feature there)."""
+    ``SlideParser``, parsers.py:569-744): render each deck page to an
+    image, describe every page with the vision LLM (``parse_images``, the
+    same fan-out ImageParser uses), and return one ``(text, metadata)``
+    chunk per slide with page numbering.
 
-    def __init__(self, llm: Any = None, parse_prompt: str = "Describe this slide.", **kwargs):
-        super().__init__()
-        self.llm = llm
+    Page rendering uses ``pdf2image`` (gated import); ``page_renderer``
+    injects any ``bytes -> list[PIL.Image]`` callable instead — offline
+    deployments and tests render through it without poppler installed.
+    """
+
+    def __init__(self, llm: Any = None,
+                 parse_prompt: str = "Describe this slide.",
+                 run_mode: str = "parallel",
+                 include_page_screenshot: bool = False,
+                 intermediate_image_format: str = "jpg",
+                 max_image_size: int = 15 * 1024 * 1024,
+                 downsize_horizontal_width: int = 1920,
+                 cache_strategy=None,
+                 page_renderer: Any = None, **kwargs):
+        super().__init__(cache_strategy=cache_strategy)
+        self.llm = llm if llm is not None else DEFAULT_VISION_LLM
         self.parse_prompt = parse_prompt
+        self.run_mode = run_mode
+        self.include_page_screenshot = include_page_screenshot
+        self.intermediate_image_format = intermediate_image_format
+        self.max_image_size = max_image_size
+        self.downsize_horizontal_width = downsize_horizontal_width
+        self.page_renderer = page_renderer
+
+    def _render_pages(self, contents: bytes):
+        if self.page_renderer is not None:
+            return self.page_renderer(contents)
+        try:
+            from pdf2image import convert_from_bytes  # type: ignore
+        except ImportError as exc:  # pragma: no cover - gated dependency
+            raise ImportError(
+                "SlideParser page rendering requires `pdf2image` (plus "
+                "poppler); pass page_renderer=... to supply images another "
+                "way, or use PypdfParser for text-only decks"
+            ) from exc
+        return convert_from_bytes(
+            contents, fmt=self.intermediate_image_format
+        )
 
     def __wrapped__(self, contents: bytes, **kwargs) -> list[tuple[str, dict]]:
-        raise NotImplementedError(
-            "SlideParser requires pdf2image + a vision LLM; install and "
-            "subclass, or use PypdfParser for text-only decks"
+        import asyncio
+
+        from pathway_tpu.internals.udfs import coerce_async
+        from pathway_tpu.xpacks.llm._parser_utils import img_to_b64
+
+        pages = self._render_pages(contents)
+        if not pages:
+            return []
+        llm_fn = (
+            self.llm.__wrapped__ if isinstance(self.llm, pw.UDF) else self.llm
         )
+        fn = coerce_async(llm_fn)
+        # carry the llm's configured model through to parse() (which reads
+        # it via getattr and passes it as a call kwarg that would otherwise
+        # override the user's choice with the default vision model)
+        model = getattr(self.llm, "model", None) or (
+            self.llm.kwargs.get("model")
+            if hasattr(self.llm, "kwargs")
+            else None
+        )
+        if model is not None:
+            fn.model = model
+        parsed, _ = asyncio.run(
+            parse_images(
+                pages,
+                fn,
+                self.parse_prompt,
+                run_mode=self.run_mode,
+                max_image_size=self.max_image_size,
+                downsize_horizontal_width=self.downsize_horizontal_width,
+            )
+        )
+        out = []
+        n = len(pages)
+        for i, text in enumerate(parsed):
+            meta: dict = {"page_number": i + 1, "page_count": n}
+            if self.include_page_screenshot:
+                meta["page_screenshot"] = img_to_b64(pages[i])
+            out.append((str(text), meta))
+        return out

@@ -29,3 +29,46 @@ def fresh_graph():
     pw.clear_graph()
     get_global_error_log().clear()
     yield
+
+
+# ---------------------------------------------------------------- timeouts
+# pytest-timeout is not installed in this image; without this hook the
+# @pytest.mark.timeout guards (crash-recovery kill/restart loops) would be
+# silent no-ops. SIGALRM interrupts the test in the main thread; tests that
+# hang in child processes still get killed because the subprocess waits run
+# there too.
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): fail the test if it runs longer than `seconds` "
+        "(enforced by conftest via SIGALRM when pytest-timeout is absent)",
+    )
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    import signal
+
+    if item.config.pluginmanager.hasplugin("timeout"):
+        return (yield)  # real pytest-timeout installed: defer to it
+    marker = item.get_closest_marker("timeout")
+    if marker is None or not hasattr(signal, "SIGALRM"):
+        return (yield)
+    seconds = float(marker.args[0]) if marker.args else float(
+        marker.kwargs.get("timeout", 300)
+    )
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            f"test exceeded its {seconds:.0f}s timeout mark"
+        )
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        return (yield)
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)

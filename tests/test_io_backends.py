@@ -271,3 +271,160 @@ def test_sharepoint_read():
     (row,) = rows.values()
     assert row[cols.index("data")] == b"pdf"
     assert row[cols.index("_metadata")]["name"] == "a.pdf"
+
+
+# ------------------------------------------------- delta lake streaming read
+class _StubDeltaTable:
+    """DeltaTable-shaped stub: a list of version snapshots (pandas frames),
+    optionally with a change-data-feed per version."""
+
+    def __init__(self, frames, cdf=None):
+        import pandas as pd
+
+        self._frames = [pd.DataFrame(f) for f in frames]
+        self._cdf = cdf  # version -> list of change dicts (with _change_type)
+        self.loaded_version = len(self._frames) - 1
+
+    def version(self):
+        return len(self._frames) - 1
+
+    def load_as_version(self, v):
+        self.loaded_version = v
+
+    def to_pandas(self):
+        return self._frames[self.loaded_version]
+
+    def update_incremental(self):
+        self.loaded_version = len(self._frames) - 1
+
+    def append(self, frame):
+        import pandas as pd
+
+        self._frames.append(
+            pd.concat([self._frames[-1], pd.DataFrame(frame)],
+                      ignore_index=True)
+        )
+        self.loaded_version = len(self._frames) - 1
+
+
+class _CdfStubDeltaTable(_StubDeltaTable):
+    def load_cdf(self, starting_version, ending_version=None):
+        import pandas as pd
+
+        end = ending_version if ending_version is not None else self.version()
+        changes = []
+        for v in range(starting_version + 1, end + 1):
+            changes.extend(self._cdf.get(v, []))
+        return pd.DataFrame(changes)
+
+
+class _DlSchema(pw.Schema):
+    word: str
+    n: int
+
+
+def _drive_delta_stream(table, n_events, feed, schema=_DlSchema):
+    import threading
+    import time as time_mod
+
+    t = pw.io.deltalake.read(
+        "mem://dl", schema, mode="streaming", refresh_interval=0.02,
+        _table=table,
+    )
+    events = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (row["word"], row["n"], 1 if is_addition else -1)
+        ),
+    )
+    conns = list(pw.G.connectors)
+
+    def driver():
+        deadline = time_mod.time() + 30
+        feed(lambda want: [
+            time_mod.sleep(0.02)
+            for _ in iter(lambda: time_mod.time() < deadline and len(events) < want, False)
+        ])
+        while time_mod.time() < deadline and len(events) < n_events:
+            time_mod.sleep(0.02)
+        for c in conns:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=driver, daemon=True).start()
+    pw.run()
+    return events
+
+
+def test_deltalake_streaming_follows_versions():
+    """VERDICT item: mode='streaming' must follow table versions appended
+    MID-RUN, not snapshot once (reference data_storage.rs:1924)."""
+    table = _StubDeltaTable([{"word": ["a"], "n": [1]}])
+
+    def feed(wait_for):
+        wait_for(1)  # initial snapshot ingested
+        table.append({"word": ["b"], "n": [2]})
+        wait_for(2)
+        table.append({"word": ["c"], "n": [3]})
+
+    events = _drive_delta_stream(table, 3, feed)
+    assert sorted(events) == [("a", 1, 1), ("b", 2, 1), ("c", 3, 1)]
+
+
+def test_deltalake_streaming_snapshot_diff_retracts():
+    """A version that rewrites rows (no CDF) retracts via snapshot diff."""
+    import threading
+    import time as time_mod
+
+    table = _StubDeltaTable([{"word": ["a", "b"], "n": [1, 2]}])
+
+    def feed(wait_for):
+        wait_for(2)
+        # version 1 rewrites the table: b removed, c added
+        import pandas as pd
+
+        table._frames.append(pd.DataFrame({"word": ["a", "c"], "n": [1, 3]}))
+        table.loaded_version = 1
+
+    events = _drive_delta_stream(table, 4, feed)
+    assert sorted(events) == [
+        ("a", 1, 1), ("b", 2, -1), ("b", 2, 1), ("c", 3, 1)
+    ]
+
+
+def test_deltalake_streaming_cdf_changes():
+    """Tables with a change feed apply row-level actions, including
+    update pre/post images."""
+    cdf = {
+        1: [
+            {"word": "b", "n": 2, "_change_type": "insert",
+             "_commit_version": 1},
+            {"word": "a", "n": 1, "_change_type": "update_preimage",
+             "_commit_version": 1},
+            {"word": "a", "n": 10, "_change_type": "update_postimage",
+             "_commit_version": 1},
+        ],
+    }
+    table = _CdfStubDeltaTable([{"word": ["a"], "n": [1]}], cdf=cdf)
+
+    def feed(wait_for):
+        wait_for(1)
+        import pandas as pd
+
+        table._frames.append(
+            pd.DataFrame({"word": ["a", "b"], "n": [10, 2]})
+        )
+
+    events = _drive_delta_stream(table, 4, feed)
+    assert sorted(events) == [
+        ("a", 1, -1), ("a", 1, 1), ("a", 10, 1), ("b", 2, 1)
+    ]
+
+
+def test_deltalake_static_reads_current_snapshot():
+    table = _StubDeltaTable([{"word": ["x", "y"], "n": [7, 8]}])
+    t = pw.io.deltalake.read("mem://dl", _DlSchema, mode="static", _table=table)
+    rows, cols = _capture_rows(t)
+    got = sorted((r[cols.index("word")], r[cols.index("n")]) for r in rows.values())
+    assert got == [("x", 7), ("y", 8)]

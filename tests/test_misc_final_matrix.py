@@ -197,3 +197,49 @@ def test_fill_na_on_optional_column():
     res = t.select(c=pw.coalesce(pw.this.a, 0))
     rows, _ = _capture_rows(res)
     assert [r[0] for r in rows.values()] == [1]
+
+
+def test_live_table_streams_updates_without_rerun(tmp_path):
+    """VERDICT item: LiveTable must be fed by a BACKGROUND run and refresh
+    live — not re-run the graph per snapshot (reference
+    internals/interactive.py:37-118)."""
+    import json
+    import time as time_mod
+
+    from pathway_tpu.internals.interactive import LiveTable
+
+    src = tmp_path / "live"
+    src.mkdir()
+    (src / "a.jsonl").write_text(json.dumps({"w": "x", "n": 1}) + "\n")
+
+    class S(pw.Schema):
+        w: str
+        n: int
+
+    t = pw.io.jsonlines.read(
+        str(src), schema=S, mode="streaming", refresh_interval=0.02
+    )
+    agg = t.groupby(t.w).reduce(t.w, total=pw.reducers.sum(t.n))
+    lt = LiveTable(agg)
+    try:
+        deadline = time_mod.time() + 20
+        while time_mod.time() < deadline and len(lt.snapshot()) < 1:
+            time_mod.sleep(0.02)
+        df = lt.snapshot()
+        assert df["total"].tolist() == [1]
+        first_frontier = lt.frontier
+        # the stream grows MID-RUN; the snapshot must follow without any
+        # re-run (the background scheduler is the only thing running)
+        (src / "b.jsonl").write_text(
+            json.dumps({"w": "x", "n": 10}) + "\n"
+            + json.dumps({"w": "y", "n": 5}) + "\n"
+        )
+        while time_mod.time() < deadline and lt.snapshot()["total"].sum() != 16:
+            time_mod.sleep(0.02)
+        df = lt.snapshot()
+        assert sorted(zip(df["w"], df["total"])) == [("x", 11), ("y", 5)]
+        assert lt.frontier > first_frontier
+        assert not lt.failed() and not lt.done()  # still live
+    finally:
+        lt.stop()
+    assert lt.done()

@@ -152,6 +152,7 @@ def test_jsonl_rows_native_matches_dict_path():
         '{"missing": 1}',
         "{}",
         '  {"word": "ws", "n": 8, "f": 8.0, "ok": false}  ',
+        '{"word": "m1", "n": 1, "f": 1.0, "ok": true},{"word": "m2", "n": 2, "f": 2.0, "ok": true}',
     ]
     data = "\n".join(lines).encode("utf-8")
     cols = list(S2.column_names())

@@ -320,3 +320,72 @@ def test_statistics_and_inputs_preserve_query_keys():
     qrows2, _ = _capture_rows(in_q)
     rrows2, _ = _capture_rows(res2)
     assert set(qrows2) == set(rrows2)
+
+
+def test_slide_parser_describes_each_page():
+    """SlideParser renders deck pages and describes each with the vision
+    LLM — tested with an injected renderer + mock LLM (no poppler/network;
+    the pattern the other vision parsers use)."""
+    import numpy as np
+    import PIL.Image
+
+    from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+    pages = [
+        PIL.Image.fromarray(np.full((40, 60, 3), i * 40, dtype=np.uint8))
+        for i in range(3)
+    ]
+    prompts = []
+
+    def mock_vision_llm(messages, model=None):
+        # vision message shape: [text prompt, image_url part]
+        content = messages[0]["content"]
+        prompts.append(content[0]["text"])
+        assert content[1]["image_url"]["url"].startswith("data:image")
+        return f"slide description {len(prompts)}"
+
+    parser = SlideParser(
+        llm=mock_vision_llm,
+        parse_prompt="What is on this slide?",
+        page_renderer=lambda contents: pages,
+    )
+    chunks = parser.__wrapped__(b"%PDF-fake-deck")
+    assert len(chunks) == 3
+    texts = sorted(t for t, _ in chunks)
+    assert texts == [f"slide description {i}" for i in (1, 2, 3)]
+    assert [m["page_number"] for _, m in chunks] == [1, 2, 3]
+    assert all(m["page_count"] == 3 for _, m in chunks)
+    assert prompts[0] == "What is on this slide?"
+
+
+def test_slide_parser_screenshot_metadata():
+    import numpy as np
+    import PIL.Image
+
+    from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+    page = PIL.Image.fromarray(np.zeros((10, 10, 3), dtype=np.uint8))
+    parser = SlideParser(
+        llm=lambda messages, model=None: "desc",
+        page_renderer=lambda contents: [page],
+        include_page_screenshot=True,
+    )
+    ((text, meta),) = parser.__wrapped__(b"deck")
+    assert text == "desc"
+    assert len(meta["page_screenshot"]) > 20  # base64 payload present
+
+
+def test_slide_parser_without_renderer_requires_pdf2image():
+    import pytest as _pytest
+
+    from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+    try:
+        import pdf2image  # noqa: F401
+
+        _pytest.skip("pdf2image present in this environment")
+    except ImportError:
+        pass
+    parser = SlideParser(llm=lambda m, model=None: "x")
+    with _pytest.raises(ImportError, match="pdf2image"):
+        parser.__wrapped__(b"%PDF")
