@@ -253,11 +253,23 @@ class ExchangeContext:
         self.process_id = mesh.process_id
         self.processes = mesh.processes
         self._n_exchanges = 0
+        self._n_iterate_bases = 0
 
     def next_exchange_id(self) -> int:
         ex = self._n_exchanges
         self._n_exchanges += 1
         return ex
+
+    def next_iterate_ctl_base(self) -> int:
+        """A private control-tag namespace for one IterateNode. Disjoint
+        from scheduler rounds (small ints) and the flush rounds (1<<40);
+        tags are drawn one at a time from the 1<<34-wide range (~17e9 —
+        unreachable in any real run), and distinct iterate nodes can never
+        collide. Allocation order is deterministic (same splice walk on
+        every process), so bases line up across the mesh."""
+        base = (1 << 50) + self._n_iterate_bases * (1 << 34)
+        self._n_iterate_bases += 1
+        return base
 
     # ---------------------------------------------------------------- control
     def control_allgather(self, rnd: int, payload, timeout: float = 300.0):
@@ -406,19 +418,40 @@ def splice_exchanges(graph, order: list[Node],
     from pathway_tpu.engine.operators.external_index import ExternalIndexNode
     from pathway_tpu.engine.operators.join import JoinNode
     from pathway_tpu.engine.operators.reduce import GroupbyNode
-    from pathway_tpu.internals.iterate import IterateNode
+    from pathway_tpu.internals.iterate import IterateNode, IterateSiblingNode
 
     spliced: list[tuple[Node, int, Node]] = []
     for node in list(order):
         if isinstance(node, ExchangeNode):
             continue
+        if isinstance(node, IterateSiblingNode):
+            # reads the primary's LOCAL fixpoint results directly; its
+            # input edge exists only for topo ordering — never exchange it
+            continue
         if isinstance(node, IterateNode):
-            raise NotImplementedError(
-                "pw.iterate is not yet supported in multi-process mode: the "
-                "fixpoint subgraph runs per-process without row exchange, "
-                "which would silently shard-split groups. Run iterate "
-                "pipelines with PATHWAY_PROCESSES=1."
-            )
+            # splice the FIXPOINT SUBGRAPH too (reference iterate subscopes
+            # run across workers — dataflow.rs:3737): every process runs
+            # each round over its shard with rows exchanged in front of the
+            # subgraph's stateful operators, and the node coordinates
+            # per-round lockstep + global convergence through its private
+            # control namespace. Idempotent: a sub-scheduler re-walking an
+            # already-spliced subgraph must not re-wire it.
+            if node.exchange_ctx is not ctx:
+                node.exchange_ctx = ctx
+                node.ctl_base = ctx.next_iterate_ctl_base()
+                caps = node.ensure_captures()
+                sub_order = node.subgraph.topo_order(caps)
+                spliced.extend(splice_exchanges(node.subgraph, sub_order, ctx))
+                spliced.append((node, -1, None))  # teardown: clear ctx
+            for i, inp in enumerate(node.inputs):  # route by row key
+                if isinstance(inp, ExchangeNode):
+                    continue
+                ex = ExchangeNode(
+                    graph, inp, ctx, None, name=f"Exchange->{node.name}"
+                )
+                node.inputs[i] = ex
+                spliced.append((node, i, inp))
+            continue
         if isinstance(node, ExternalIndexNode):
             # index additions broadcast so every process's index instance
             # holds the full corpus (reference: one instance per worker fed
@@ -453,6 +486,11 @@ def splice_exchanges(graph, order: list[Node],
 
 
 def unsplice_exchanges(spliced: list[tuple[Node, int, Node]]) -> None:
-    """Undo a splice pass: restore original inputs (teardown of one run)."""
+    """Undo a splice pass: restore original inputs (teardown of one run).
+    ``input_index == -1`` entries clear an IterateNode's exchange binding —
+    the graph is the user's global object and must not keep a dead mesh."""
     for node, i, orig in spliced:
+        if i == -1:
+            node.exchange_ctx = None
+            continue
         node.inputs[i] = orig

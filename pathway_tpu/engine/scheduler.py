@@ -22,9 +22,15 @@ from pathway_tpu.engine.probes import SchedulerStats
 
 class Scheduler:
     def __init__(self, graph: EngineGraph, targets: list[Node] | None = None,
-                 exchange_ctx=None, threads: int | None = None):
+                 exchange_ctx=None, threads: int | None = None,
+                 ctl_tag_alloc: "Callable[[], int] | None" = None):
         self.graph = graph
         self.exchange_ctx = exchange_ctx
+        # control rounds are tagged by ``ctl_tag_alloc`` when provided:
+        # nested schedulers (iterate fixpoint sub-runs) draw from the
+        # owning node's private monotonic namespace so their barriers can
+        # never be confused with the outer loop's or a sibling's
+        self.ctl_tag_alloc = ctl_tag_alloc
         self._spliced = []
         if exchange_ctx is not None:
             from pathway_tpu.engine.exchange import splice_exchanges
@@ -186,8 +192,9 @@ class Scheduler:
                 frontier = min(self._source_frontiers.values(), default=None)
                 live = bool(self._source_frontiers)
                 inflight = self._async_inflight > 0
+            tag = self.ctl_tag_alloc() if self.ctl_tag_alloc is not None else rnd
             states = ctx.control_allgather(
-                rnd, (local_t, frontier, live, inflight)
+                tag, (local_t, frontier, live, inflight)
             )
             if exchange_mod._DEBUG:
                 exchange_mod._dbg(f"round {rnd} states={states}")

@@ -61,10 +61,42 @@ class IterateNode(Node):
         self.iteration_limit = iteration_limit
         self._in_states = [TableState(i.column_names) for i in outer_inputs]
         self._emitted: dict[int, tuple] = {}
+        # multi-process fixpoint coordination (set by splice_exchanges):
+        # rounds run in LOCKSTEP across processes, rows hopping through the
+        # exchanges spliced into the subgraph; barriers are tagged from a
+        # private control namespace so concurrent sibling iterates and the
+        # outer scheduler's rounds can never collide
+        self.exchange_ctx = None
+        self.ctl_base = 0
+        self._ctl_seq = 0
+        # the fixpoint state of EVERY output after the latest epoch's run —
+        # sibling nodes (other outputs of a multi-table iterate) read from
+        # here instead of re-running the shared subgraph (which would both
+        # duplicate the distributed fixpoint per output and race on shared
+        # node state / exchange tags under PATHWAY_THREADS>1)
+        self._epoch_results: list[dict[int, tuple]] = [
+            {} for _ in sub_outputs
+        ]
 
     def reset(self):
         self._in_states = [TableState(i.column_names) for i in self.inputs]
         self._emitted = {}
+        self._epoch_results = [{} for _ in self.sub_outputs]
+
+    def ensure_captures(self) -> list[CaptureNode]:
+        if not hasattr(self, "_captures"):
+            self._captures = [
+                CaptureNode(self.subgraph, o) for o in self.sub_outputs
+            ]
+        return self._captures
+
+    def _next_ctl_tag(self) -> int:
+        """Next tag from this node's private monotonic namespace (~17e9
+        tags at 1<<34 spacing — enough for any run length; allocation is
+        lockstep across processes so tags always line up)."""
+        tag = self.ctl_base + self._ctl_seq
+        self._ctl_seq += 1
+        return tag
 
     def step(self, time, ins):
         changed = False
@@ -72,7 +104,14 @@ class IterateNode(Node):
             if batch is not None and len(batch) > 0:
                 st.apply(batch)
                 changed = True
-        if not changed:
+        ctx = self.exchange_ctx
+        if ctx is not None:
+            # every process must enter the fixpoint together (the rounds
+            # exchange rows): agree whether ANY shard changed this epoch
+            states = ctx.control_allgather(self._next_ctl_tag(), changed)
+            if not any(states.values()):
+                return None
+        elif not changed:
             return None
         # fixpoint: current collections start as the outer inputs
         currents = [dict(st.rows) for st in self._in_states]
@@ -88,10 +127,17 @@ class IterateNode(Node):
 
         for _round in range(limit):
             outs = self._run_body(currents)
-            if tables_equal(outs, currents):
-                currents = outs
-                break
+            converged = tables_equal(outs, currents)
             currents = outs
+            if ctx is not None:
+                # the fixpoint is GLOBAL: loop until every shard is stable
+                states = ctx.control_allgather(
+                    self._next_ctl_tag(), converged
+                )
+                converged = all(states.values())
+            if converged:
+                break
+        self._epoch_results = currents
         result = currents[self.result_node_index]
         from pathway_tpu.engine.operators.core import diff_tables
 
@@ -100,13 +146,18 @@ class IterateNode(Node):
         return out
 
     def _run_body(self, currents: list[dict[int, tuple]]) -> list[dict[int, tuple]]:
-        captures = [
-            CaptureNode(self.subgraph, o) for o in self.sub_outputs
-        ] if not hasattr(self, "_captures") else self._captures
-        self._captures = captures
+        captures = self.ensure_captures()
         # one Scheduler per fixpoint round: run single-threaded (a thread
-        # pool per round would leak workers; the subgraph is small anyway)
-        sched = Scheduler(self.subgraph, captures, threads=1)
+        # pool per round would leak workers; the subgraph is small anyway).
+        # Multi-process: the sub-scheduler runs the SAME lockstep loop as
+        # the outer one (the subgraph is already spliced, so its __init__
+        # splice pass is a no-op) under a private control-tag block; its
+        # exchanges are served even by processes whose shard is empty.
+        ctx = self.exchange_ctx
+        sched = Scheduler(
+            self.subgraph, captures, threads=1, exchange_ctx=ctx,
+            ctl_tag_alloc=self._next_ctl_tag if ctx is not None else None,
+        )
         for n in sched.order:
             n.reset()
         for inp, rows in zip(self.sub_inputs, currents):
@@ -118,8 +169,56 @@ class IterateNode(Node):
                 )
                 sched.inject(inp, 0, batch)
             sched.close_source(inp)
+        # static tables built INSIDE the body (debug tables, constants) are
+        # registered as parse-graph static sources on subgraph nodes: feed
+        # them each round (process 0 only under a mesh — the subgraph's
+        # exchanges route rows to their owners, same as the outer run)
+        order_ids = {n.id for n in sched.order}
+        inject_static = ctx is None or ctx.process_id == 0
+        for node, provider in G.static_sources.values():
+            if node.graph is self.subgraph and node.id in order_ids:
+                sched.register_source(node, 0)
+                if inject_static:
+                    batch = provider()
+                    if batch is not None and len(batch) > 0:
+                        sched.inject(node, 0, batch)
+                sched.close_source(node)
         sched.run()
+        # NB: no teardown_exchanges here — the subgraph splice belongs to
+        # the OUTER scheduler's teardown, and the mesh stays open
+        sched.shutdown()
         return [dict(c.state.rows) for c in captures]
+
+
+class IterateSiblingNode(Node):
+    """A secondary output of a multi-table ``pw.iterate``: reads the
+    primary IterateNode's cached fixpoint results instead of re-running
+    the shared subgraph (one distributed fixpoint per epoch total). Taking
+    the primary as input pins the topo order: the primary's level always
+    completes before siblings step, even under PATHWAY_THREADS>1."""
+
+    def __init__(self, graph, primary: IterateNode, result_node_index: int,
+                 name="IterateOut"):
+        super().__init__(
+            graph,
+            [primary],
+            primary.sub_outputs[result_node_index].column_names,
+            name,
+        )
+        self.primary = primary
+        self.result_node_index = result_node_index
+        self._emitted: dict[int, tuple] = {}
+
+    def reset(self):
+        self._emitted = {}
+
+    def step(self, time, ins):
+        result = self.primary._epoch_results[self.result_node_index]
+        from pathway_tpu.engine.operators.core import diff_tables
+
+        out = diff_tables(self._emitted, result, self.column_names)
+        self._emitted = dict(result)
+        return out
 
 
 def iterate(
@@ -181,17 +280,42 @@ def iterate(
             raise ValueError(f"iterate body must return table {n!r}")
         sub_outputs.append(out_by_name[n]._node)
 
+    # loud error instead of silent emptiness: a body that closes over an
+    # OUTER table would compute every round against zero rows (the sub-run
+    # feeds only the iterated entry tables)
+    stack: list[Node] = list(sub_outputs)
+    seen: set[int] = set()
+    while stack:
+        nd = stack.pop()
+        if nd.id in seen:
+            continue
+        seen.add(nd.id)
+        for i in nd.inputs:
+            if i.graph is subgraph:
+                stack.append(i)
+            else:
+                raise ValueError(
+                    f"pw.iterate body references outer table node "
+                    f"{i.name!r}: pass outer tables as pw.iterate keyword "
+                    "arguments (and return them unchanged) so every "
+                    "iteration round sees their rows"
+                )
+
     results = _IterationResult()
-    for idx, n in enumerate(names):
-        node = IterateNode(
-            G.engine_graph,
-            [t._node for t in outer_tables],
-            subgraph,
-            sub_inputs,
-            sub_outputs,
-            idx,
-            iteration_limit,
-        )
+    # ONE IterateNode runs the fixpoint (emitting output 0); the other
+    # outputs are sibling views over its cached per-output results
+    primary = IterateNode(
+        G.engine_graph,
+        [t._node for t in outer_tables],
+        subgraph,
+        sub_inputs,
+        sub_outputs,
+        0,
+        iteration_limit,
+    )
+    results[names[0]] = Table(primary, out_by_name[names[0]]._schema, Universe())
+    for idx, n in enumerate(names[1:], start=1):
+        node = IterateSiblingNode(G.engine_graph, primary, idx)
         results[n] = Table(node, out_by_name[n]._schema, Universe())
     # mirror the body's return shape (reference behavior): a bare table
     # comes back bare; a dict/namespace keeps attribute access even for one

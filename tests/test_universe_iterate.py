@@ -294,3 +294,27 @@ def test_groupby_after_reindex_consistent():
             """
         ),
     )
+
+
+def test_iterate_outer_table_reference_raises():
+    """A body closing over an outer table would silently iterate against
+    zero rows; it must raise with guidance instead."""
+    t = T(
+        """
+        n
+        1
+        """
+    )
+    outer = T(
+        """
+        m
+        5
+        """
+    )
+
+    def body(t):
+        j = t.join(outer, t.n == outer.m).select(n=t.n)
+        return j
+
+    with pytest.raises(ValueError, match="outer table"):
+        pw.iterate(body, t=t)
