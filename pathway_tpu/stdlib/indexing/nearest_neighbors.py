@@ -78,11 +78,15 @@ class BruteForceKnn(InnerIndex):
 
 
 class USearchKnn(BruteForceKnn):
-    """API parity with the reference's uSearch HNSW index (``USearchKnn:65``).
+    """API parity with the reference's uSearch HNSW index (``USearchKnn:65``)
+    — backed by the EXACT TPU brute-force gemm, NOT a graph-based ANN.
 
-    On TPU the exact brute-force gemm beats host HNSW at reference scales, so
-    this shares the TPU backend; ``connectivity``/``expansion_*`` parameters
-    are accepted for compatibility.
+    On TPU the exact path beats host HNSW at the reference's default scales
+    (the gemm + fused top-k is one MXU dispatch), so
+    ``connectivity``/``expansion_*`` are accepted and ignored. This is an
+    explicit alias, not a silent one: construction warns, because at
+    million-vector scale the intended sublinear behavior matters — use
+    :class:`IvfKnnFactory` (the TPU-native ANN) for big corpora.
     """
 
     def __init__(
@@ -98,6 +102,15 @@ class USearchKnn(BruteForceKnn):
         expansion_search: int = 0,
         embedder: Callable | None = None,
     ):
+        import warnings
+
+        warnings.warn(
+            "USearchKnn on TPU is an EXACT brute-force alias (no HNSW "
+            "graph): fine to ~10^5-10^6 vectors, but for big corpora use "
+            "IvfKnnFactory — the TPU-native approximate index whose probed "
+            "HBM traffic drops ~n_cells/nprobe vs a full scan.",
+            stacklevel=2,
+        )
         super().__init__(
             data_column,
             metadata_column,
@@ -249,6 +262,14 @@ class BruteForceKnnFactory(KnnIndexFactory):
 
 @dataclass
 class IvfKnnFactory(KnnIndexFactory):
+    """THE recommended index factory for big corpora (≳10^6 vectors): the
+    TPU-native approximate index. Searches probe ``nprobe`` of ``n_cells``
+    inverted lists, so per-query HBM traffic (the large-corpus bottleneck)
+    drops ~``n_cells/nprobe`` vs a full scan, with recall governed by
+    ``nprobe``. Rule of thumb: ``n_cells ≈ 2*sqrt(N)``, then raise
+    ``nprobe`` until recall@10 clears your bar (bench config5 measures
+    0.9+ recall at several-x exact-scan throughput on a 1M corpus)."""
+
     n_cells: int = 64
     nprobe: int = 8
     metric: DistanceMetric | str = DistanceMetric.COS

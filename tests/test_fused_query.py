@@ -1,0 +1,76 @@
+"""Fused single-dispatch query pipeline (ops/fused_query.py): results must
+match the staged path (embed -> search -> rerank as separate calls)."""
+
+import numpy as np
+import pytest
+
+from pathway_tpu.models import SentenceEmbedderModel
+from pathway_tpu.models.cross_encoder import CrossEncoderModel
+from pathway_tpu.ops.fused_query import FusedRAGPipeline
+
+WORDS = ["alpha", "beta", "gamma", "delta", "stream", "tensor", "index",
+         "query", "fuse", "chip"]
+
+
+def _mk_docs(n=48, seed=3):
+    rng = np.random.default_rng(seed)
+    return [" ".join(rng.choice(WORDS, 12)) for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    emb = SentenceEmbedderModel(max_length=64)
+    ce = CrossEncoderModel(max_length=160)
+    p = FusedRAGPipeline(emb, ce, reserved_space=64, doc_seq=32, pair_seq=96)
+    docs = _mk_docs()
+    p.add([f"d{i}" for i in range(len(docs))], docs)
+    return p, docs
+
+
+def test_fused_retrieve_matches_staged(pipeline):
+    p, docs = pipeline
+    queries = ["alpha stream tensor", "delta index beta gamma"]
+    fused = p.retrieve(queries, k=5)
+    # staged: embed then exact search as two separate calls
+    qv = p.embedder.embed_batch(queries)
+    staged = p.index.search(qv, k=5)
+    for f_row, s_row in zip(fused, staged):
+        assert [k for k, _ in f_row] == [k for k, _ in s_row]
+        for (_, fs), (_, ss) in zip(f_row, s_row):
+            assert abs(fs - ss) < 1e-2
+
+
+def test_fused_rerank_matches_staged(pipeline):
+    p, docs = pipeline
+    q = "alpha stream tensor chip"
+    fused = p.retrieve_rerank(q, k=8)
+    assert len(fused) == 8
+    # staged: retrieve then cross-encode the SAME (query, doc) pairs
+    qv = p.embedder.embed_batch([q])
+    (hits,) = p.index.search(qv, k=8)
+    pair_texts = [(q, docs[int(key[1:])]) for key, _ in hits]
+    staged_scores = p.reranker.score_batch(pair_texts)
+    staged = sorted(
+        zip((k for k, _ in hits), staged_scores), key=lambda t: -t[1]
+    )
+    assert [k for k, _ in fused] == [k for k, _ in staged]
+    for (_, fs), (_, ss) in zip(fused, staged):
+        assert abs(fs - ss) < 5e-2  # bf16 path noise
+
+def test_fused_rerank_orders_by_rerank_score(pipeline):
+    p, _docs = pipeline
+    out = p.retrieve_rerank("gamma fuse query", k=6)
+    scores = [s for _, s in out]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_capacity_growth_keeps_doc_tokens_aligned():
+    emb = SentenceEmbedderModel(max_length=32)
+    p = FusedRAGPipeline(emb, None, reserved_space=16, doc_seq=16, pair_seq=64)
+    docs = _mk_docs(60, seed=9)  # 60 > 16: forces capacity doubling
+    for s in range(0, 60, 20):
+        p.add([f"d{i}" for i in range(s, s + 20)], docs[s : s + 20])
+    assert p.index.n == 60
+    assert p._doc_tokens.shape[0] == p.index.capacity
+    (row,) = p.retrieve(["alpha beta"], k=3)
+    assert len(row) == 3
