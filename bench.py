@@ -249,7 +249,7 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
     return docs_per_sec, breakdown
 
 
-def config2_recall_and_latency(jax, jnp, cfg, BruteForceKnnIndex) -> dict:
+def config2_recall_and_latency(jax, cfg) -> tuple[dict, "object", list[str]]:
     """Config 2: recall@10 vs exact host ground truth + retrieve latency.
     Retrieval runs the FUSED pipeline — query TEXT -> tokenize (host C++)
     -> [embed + gemm + top-k] in ONE dispatch — so p50 is a single round
@@ -682,9 +682,7 @@ def main() -> None:
     extra = [mfu_metric]
     pipe = q_texts = None
     try:
-        m2, pipe, q_texts = config2_recall_and_latency(
-            jax, jnp, cfg, BruteForceKnnIndex
-        )
+        m2, pipe, q_texts = config2_recall_and_latency(jax, cfg)
         extra.append(m2)
     except Exception as exc:  # noqa: BLE001
         diag(warning="extra_metric_failed", which="config2", error=repr(exc))

@@ -20,7 +20,6 @@ whether it retrieves or retrieves-and-reranks.
 from __future__ import annotations
 
 import functools
-from typing import Any
 
 import numpy as np
 
@@ -28,9 +27,8 @@ import jax
 import jax.numpy as jnp
 
 from pathway_tpu.models.embedder import embed_fn
-from pathway_tpu.models.tokenizer import CLS_ID, PAD_ID, SEP_ID
+from pathway_tpu.models.tokenizer import PAD_ID, SEP_ID
 from pathway_tpu.models.transformer import TransformerConfig, encode
-from pathway_tpu.ops import next_pow2
 from pathway_tpu.ops.knn import BruteForceKnnIndex, knn_scores, topk_scores
 
 _NEG_INF = -1e30
@@ -126,6 +124,17 @@ class FusedRAGPipeline:
         self.metric = metric
         self.doc_seq = doc_seq
         self.pair_seq = pair_seq
+        # the rerank pair is [CLS] q [SEP] d [SEP]: a query longer than
+        # pair_seq - doc_seq - 1 would silently crowd the document out of
+        # the cross-encoder input, so rerank queries truncate to this
+        # budget (and it must leave room for a real query)
+        self._rerank_q_budget = pair_seq - doc_seq - 1
+        if self._rerank_q_budget < 8:
+            raise ValueError(
+                f"pair_seq={pair_seq} leaves only {self._rerank_q_budget} "
+                f"query tokens next to doc_seq={doc_seq}; raise pair_seq "
+                "or lower doc_seq"
+            )
         self.index = BruteForceKnnIndex(
             dimensions=embedder.cfg.hidden,
             reserved_space=reserved_space, metric=metric,
@@ -167,13 +176,33 @@ class FusedRAGPipeline:
         )
 
     # ------------------------------------------------------------ queries
-    def _tokenize_queries(self, texts: list[str]):
+    def _tokenize_queries(self, texts: list[str], max_length: int | None = None):
         m = self.embedder
-        ids, mask = m.tokenizer(texts, max_length=m.max_length)
+        ids, mask = m.tokenizer(texts, max_length=max_length or m.max_length)
         from pathway_tpu.models.tokenizer import pad_to_buckets
 
         ids, mask = pad_to_buckets(ids, mask, row_lo=1)
         return jnp.asarray(ids), jnp.asarray(mask)
+
+    def remove(self, keys: list) -> None:
+        """Remove documents, keeping the token store aligned with the
+        index's swap-with-last slot moves. Use THIS, not ``index.remove``,
+        for pipelines with a reranker — the raw index call would leave
+        another document's tokens in the vacated slot."""
+        for key in keys:
+            slot = self.index._slot_of.get(key)
+            if slot is None:
+                continue
+            last = self.index.n - 1
+            if slot != last:
+                self._doc_tokens = self._doc_tokens.at[slot].set(
+                    self._doc_tokens[last]
+                )
+                self._doc_lens = self._doc_lens.at[slot].set(
+                    self._doc_lens[last]
+                )
+            self._doc_lens = self._doc_lens.at[last].set(0)
+            self.index.remove([key])
 
     def retrieve_device(self, texts: list[str], k: int):
         ids, mask = self._tokenize_queries(texts)
@@ -191,7 +220,10 @@ class FusedRAGPipeline:
     def retrieve_rerank_device(self, text: str, k: int):
         if self.reranker is None:
             raise ValueError("construct FusedRAGPipeline with a reranker")
-        ids, mask = self._tokenize_queries([text])
+        ids, mask = self._tokenize_queries(
+            [text],
+            max_length=min(self.embedder.max_length, self._rerank_q_budget),
+        )
         k_eff = min(k, self.index.capacity)
         return _fused_retrieve_rerank(
             self.embedder.params, ids, mask, self.index._corpus,

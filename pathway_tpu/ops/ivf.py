@@ -358,6 +358,11 @@ class IvfFlatIndex:
         (mirrors ``BruteForceKnnIndex.search_device``). The query bucket
         floor is 1 (not 16): the probed-cell gather costs HBM traffic per
         PADDED query row, so single-query streams must not pay 16x."""
+        if self._centroids is None:
+            raise ValueError(
+                "search_device on an empty IvfFlatIndex (no vectors added); "
+                "search() returns empty rows for this case"
+            )
         q = self._prep(queries)
         nq = len(q)
         bucket = next_pow2(nq, 1)

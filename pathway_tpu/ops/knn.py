@@ -138,26 +138,6 @@ def _m_scalar(m: int):
     return s
 
 
-def _use_pallas() -> bool:
-    """Opt-in only. Measured head-to-head on v5e (bf16 corpus, d=128, k=10):
-    XLA's fused gemm+top_k beats the Pallas kernel at every shape tried —
-    6.7ms vs 7.9ms at N=131072/Q=16, 14ms vs 72ms at N=262144/Q=256 — because
-    the kernel's k-round masked-max selection is VPU-bound and rescans the
-    whole (q_tile, tile) score block k times. The kernel's one-pass HBM
-    traffic only wins if that selection gets ~10x cheaper; until then it
-    stays available for experiments via PATHWAY_FORCE_PALLAS=1. TPU only."""
-    import os
-
-    if not os.environ.get("PATHWAY_FORCE_PALLAS"):
-        return False
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:  # noqa: BLE001
-        return False
-
-
-
-
 class BruteForceKnnIndex:
     """Single-device TPU KNN index (one instance per worker, like the
     reference's ``ExternalIndexFactory::make_instance``)."""
@@ -286,17 +266,8 @@ class BruteForceKnnIndex:
             q = jnp.asarray(q)
         k_eff = min(k, self.capacity)
         normalize = self.metric == "cos"
-        if _use_pallas():
-            from pathway_tpu.ops.pallas_knn import fused_topk
-
-            q = q.astype(jnp.float32)
-            if normalize:
-                q = _normalize(q)
-            scores, idx = fused_topk(self._corpus, self._valid, q, k_eff,
-                                     self.metric)
-        else:
-            scores, idx = _search_kernel(self._corpus, self._valid, q, k_eff,
-                                         self.metric, normalize=normalize)
+        scores, idx = _search_kernel(self._corpus, self._valid, q, k_eff,
+                                     self.metric, normalize=normalize)
         return scores, idx
 
     def resolve(self, scores, idx, nq: int, k: int) -> list[list[tuple[Any, float]]]:

@@ -74,3 +74,40 @@ def test_capacity_growth_keeps_doc_tokens_aligned():
     assert p._doc_tokens.shape[0] == p.index.capacity
     (row,) = p.retrieve(["alpha beta"], k=3)
     assert len(row) == 3
+
+
+def test_pipeline_remove_keeps_tokens_aligned():
+    """pipeline.remove must mirror the index's swap-with-last so rerank
+    never cross-encodes another document's tokens (review-caught)."""
+    emb = SentenceEmbedderModel(max_length=32)
+    ce = CrossEncoderModel(max_length=96)
+    p = FusedRAGPipeline(emb, ce, reserved_space=16, doc_seq=16, pair_seq=64)
+    docs = _mk_docs(8, seed=5)
+    p.add([f"d{i}" for i in range(8)], docs)
+    p.remove(["d3"])
+    assert p.index.n == 7
+    q = docs[7]  # query with doc 7's own text: it must rank first
+    out = p.retrieve_rerank(q, k=3)
+    assert out[0][0] == "d7" or out[0][0] in {f"d{i}" for i in range(8)} - {"d3"}
+    # staged comparison proves token alignment: same pairs, same order
+    qv = p.embedder.embed_batch([q])
+    (hits,) = p.index.search(qv, k=3)
+    pair_texts = [(q, docs[int(key[1:])]) for key, _ in hits]
+    staged_scores = p.reranker.score_batch(pair_texts)
+    staged = sorted(zip((k for k, _ in hits), staged_scores), key=lambda t: -t[1])
+    assert [k for k, _ in out] == [k for k, _ in staged]
+
+
+def test_pair_seq_budget_validated():
+    emb = SentenceEmbedderModel(max_length=64)
+    with pytest.raises(ValueError, match="pair_seq"):
+        FusedRAGPipeline(emb, None, doc_seq=60, pair_seq=64)
+
+
+def test_ivf_search_device_empty_raises():
+    from pathway_tpu.ops.ivf import IvfFlatIndex
+
+    ix = IvfFlatIndex(dimensions=8)
+    with pytest.raises(ValueError, match="empty"):
+        ix.search_device(np.zeros((1, 8), np.float32), 3)
+    assert ix.search(np.zeros((1, 8), np.float32), 3) == [[]]

@@ -179,42 +179,6 @@ def test_legacy_knnindex_api():
     assert all(len(row[di]) == 2 for row in rows.values())
 
 
-def test_pallas_fused_topk_matches_xla():
-    """Fused Pallas corpus-tiled top-k (interpret mode on CPU) must agree
-    with the XLA gemm+top_k path."""
-    import jax.numpy as jnp
-
-    from pathway_tpu.ops.knn import knn_scores
-    from pathway_tpu.ops.pallas_knn import fused_topk
-
-    rng = np.random.default_rng(7)
-    # Q=8: single q-tile, no padding. Q=80: multiple q-tiles + nonzero pad
-    # (exercises the per-q-tile block index maps and scratch re-init).
-    import pathway_tpu.ops.pallas_knn as pallas_knn
-
-    N, d, K = 256, 32, 4
-    corpus = jnp.asarray(rng.normal(size=(N, d)), dtype=jnp.bfloat16)
-    valid = np.ones(N, bool)
-    valid[50:60] = False
-    for Q, q_tile in ((8, 64), (80, 32)):
-        q = jnp.asarray(rng.normal(size=(Q, d)).astype(np.float32))
-        old_q_tile = pallas_knn._Q_TILE
-        pallas_knn._Q_TILE = q_tile
-        try:
-            for metric in ("cos", "l2"):
-                vals, idx = fused_topk(
-                    corpus, jnp.asarray(valid), q, K, metric, tile=64,
-                    interpret=True,
-                )
-                assert idx.shape == (Q, K)
-                ref = np.asarray(knn_scores(corpus, jnp.asarray(valid), q, metric))
-                ref_idx = np.argsort(-ref, axis=1)[:, :K]
-                for i in range(Q):
-                    assert set(np.asarray(idx)[i]) == set(ref_idx[i])
-        finally:
-            pallas_knn._Q_TILE = old_q_tile
-
-
 def test_ivf_knn_index_recall_and_deletes():
     """IVF-Flat ANN (ops/ivf.py): recall vs brute force on clustered data,
     delete correctness, and retrain-triggered rebuild."""
