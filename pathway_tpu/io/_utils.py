@@ -185,24 +185,41 @@ def _dtype_code(dtype: dt.DType) -> int:
 
 _JSONL_CHUNK = 20_000
 
+# joined batch parses separate items with a SENTINEL object, not a bare
+# comma: two compensating malformations (a JSON fragment pair that merges
+# plus a multi-object item that splits) can keep the element COUNT right
+# while misassigning every row in between. With sentinels interleaved,
+# any merge/split either breaks the 2n-1 count or displaces a sentinel
+# off its odd index — both detectable — so the fast path can never
+# fabricate rows the per-item path would reject.
+_JSON_SEP = b',{"__pw_sep__":0},'
+_JSON_SEP_OBJ = {"__pw_sep__": 0}
+
+
+def _joined_parse(items: list[bytes]):
+    """Parse ``items`` as one sentinel-separated JSON array; the decoded
+    objects in order, or None when the batch must re-parse per item."""
+    try:
+        decoded = json.loads(b"[" + _JSON_SEP.join(items) + b"]")
+    except (json.JSONDecodeError, TypeError):
+        return None
+    if len(decoded) != 2 * len(items) - 1:
+        return None
+    if any(decoded[i] != _JSON_SEP_OBJ for i in range(1, len(decoded), 2)):
+        return None
+    return decoded[::2]
+
 
 def _parse_json_line_chunks(lines):
     """Yield decoded objects for jsonlines content, chunked: one
     ``json.loads`` per chunk is ~3x per-line calls, and chunking bounds the
     transient join memory on multi-GB files. A chunk with any invalid line
-    — or where the joined parse yields a different record count than the
-    line count (a line holding SEVERAL comma-separated objects is malformed
-    jsonlines, not two records) — falls back per-line with bad lines
-    skipped, so results never depend on chunk boundaries."""
+    — or any line that is not ONE standalone JSON document (caught by the
+    sentinel check in ``_joined_parse``) — falls back per-line with bad
+    lines skipped, so results never depend on chunk boundaries."""
     for start in range(0, len(lines), _JSONL_CHUNK):
         chunk = lines[start : start + _JSONL_CHUNK]
-        objs = None
-        try:
-            joined = json.loads(b"[" + b",".join(chunk) + b"]")
-            if len(joined) == len(chunk):
-                objs = joined
-        except json.JSONDecodeError:
-            pass
+        objs = _joined_parse(chunk)
         if objs is None:
             objs = []
             for line in chunk:
@@ -273,6 +290,58 @@ def rows_from_bytes(data: bytes, fmt: str, schema):
         for i in reversed(drop):
             del rows[i]
     return rows
+
+
+def stream_parse_plan(schema, cols, dtypes):
+    """Precompute the loop-invariant pieces of
+    ``batch_parse_stream_records`` (dtype codes + defaults) once per
+    connector instead of per poll."""
+    return (
+        [_dtype_code(dtypes[c]) for c in cols],
+        {c: v for c, v in schema.default_values().items() if c in cols},
+    )
+
+
+def batch_parse_stream_records(values: list[bytes], fmt: str, schema,
+                               cols, dtypes,
+                               plan=None) -> list[tuple | None]:
+    """Batch analog of ``parse_stream_record`` for a drained queue poll:
+    one sentinel-guarded ``json.loads`` + the C++ row extractor over the
+    whole batch instead of a Python dict/coercion pass per message. Entry
+    i is the row tuple for ``values[i]`` or None (undecodable /
+    non-record), exactly matching the per-message function. Pass ``plan``
+    from :func:`stream_parse_plan` to hoist the schema-derived constants
+    out of a polling loop."""
+    if fmt == "raw":
+        return [(v,) for v in values]
+    out: list[tuple | None] = [None] * len(values)
+    native = _get_native_rows()
+    objs = _joined_parse(values)
+    if objs is None:
+        objs = [None] * len(values)
+        for i, v in enumerate(values):
+            try:
+                objs[i] = json.loads(v)
+            except (json.JSONDecodeError, TypeError):
+                objs[i] = None
+    if native is not None:
+        codes, defaults = plan if plan is not None else stream_parse_plan(
+            schema, cols, dtypes
+        )
+        rows, fallback = native(objs, cols, codes, defaults)
+        for i in fallback:
+            obj = objs[i]
+            if isinstance(obj, dict):
+                vals = parse_record_fields(obj, cols, dtypes, schema)
+                rows[i] = tuple(vals[c] for c in cols)
+            else:
+                rows[i] = None
+        return rows
+    for i, obj in enumerate(objs):
+        if isinstance(obj, dict):
+            vals = parse_record_fields(obj, cols, dtypes, schema)
+            out[i] = tuple(vals[c] for c in cols)
+    return out
 
 
 def _iter_lines(data: bytes):

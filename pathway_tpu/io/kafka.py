@@ -25,7 +25,6 @@ from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._streams import BaseConnector, next_commit_time
-from pathway_tpu.io._utils import parse_record_fields, parse_value
 
 
 class InMemoryKafkaBroker:
@@ -78,30 +77,47 @@ class _BrokerConnector(BaseConnector):
             self._offset = offset
 
     def run(self):
-        import json
+        from pathway_tpu.io._utils import (
+            batch_parse_stream_records,
+            stream_parse_plan,
+        )
 
         if self.start_from_latest and self._offset == 0:
             self._offset = len(self.broker.poll(self.topic, 0))
         cols = list(self.node.column_names)
         dtypes = {n: c.dtype for n, c in self.schema.__columns__.items()}
-        pk = self.schema.primary_key_columns()
+        plan = stream_parse_plan(self.schema, cols, dtypes)
+        pk = self.schema.primary_key_columns() or ()
+        pk_idx = [cols.index(c) for c in pk]
         while not self.should_stop():
             entries = self.broker.poll(self.topic, self._offset)
             if entries:
                 base = self._offset
+                # whole drained poll parses as ONE batch (chunked
+                # json.loads + C++ row extraction); undecodable or
+                # non-record messages skip instead of killing the stream
+                parsed = batch_parse_stream_records(
+                    [v for _k, v in entries], self.fmt, self.schema,
+                    cols, dtypes, plan=plan,
+                )
                 rows = []
-                for i, (key_bytes, value) in enumerate(entries):
-                    if self.fmt == "raw":
-                        values = {"data": value}
-                    else:
-                        obj = json.loads(value)
-                        values = parse_record_fields(obj, cols, dtypes, self.schema)
+                for i, row in enumerate(parsed):
+                    if row is None:
+                        from pathway_tpu.internals.errors import (
+                            get_global_error_log,
+                        )
+
+                        get_global_error_log().log(
+                            f"kafka broker: skipping malformed message at "
+                            f"offset {base + i}"
+                        )
+                        continue
                     if pk:
-                        key = hash_values(*[values[c] for c in pk])
+                        key = hash_values(*[row[j] for j in pk_idx])
                     else:
                         # log-position keys: stable across restarts
                         key = hash_values(self.topic, base + i)
-                    rows.append((key, tuple(values[c] for c in cols), 1))
+                    rows.append((key, row, 1))
                 self._offset = base + len(entries)
                 self.commit_rows(rows)
             elif self.broker.closed:

@@ -91,6 +91,14 @@ class CrossEncoderModel:
     def score_batch(self, pairs: list[tuple[str, str]]) -> np.ndarray:
         if not pairs:
             return np.zeros((0,), dtype=np.float32)
+        (out, n) = self.score_submit(pairs)
+        return np.asarray(jax.device_get(out))[:n]
+
+    # -- two-phase path: dispatch many pair-batches, drain once ------------
+    def score_submit(self, pairs: list[tuple[str, str]]):
+        """Tokenize + dispatch WITHOUT waiting; resolve the returned handle
+        via :meth:`score_resolve` (same pipelining contract as
+        ``SentenceEmbedderModel.embed_submit``)."""
         ids, mask, types = self.tokenizer.encode_pairs(
             pairs, max_length=self.max_length, return_types=True
         )
@@ -99,7 +107,11 @@ class CrossEncoderModel:
         types2[: types.shape[0], : types.shape[1]] = types
         out = score_fn(self.params, self.head, jnp.asarray(ids),
                        jnp.asarray(mask), self.cfg, jnp.asarray(types2))
-        return np.asarray(out[: len(pairs)])
+        return (out, len(pairs))
+
+    def score_resolve(self, handles) -> list[np.ndarray]:
+        fetched = jax.device_get([h for h, _ in handles])
+        return [np.asarray(o)[:n] for o, (_, n) in zip(fetched, handles)]
 
     def __call__(self, pairs: list[tuple[str, str]]) -> np.ndarray:
         return self.score_batch(pairs)

@@ -78,6 +78,18 @@ class CrossEncoderReranker(pw.UDF):
         scores = self.model.score_batch(pairs)
         return [float(s) for s in scores]
 
+    # two-phase protocol (UDF._call_batched): chunks of an epoch all
+    # dispatch, then ONE device drain — per-chunk syncs cost a relay RTT
+    def submit_batch(self, doc: list[str], query: list[str], **kwargs):
+        pairs = [(q or "", d or "") for q, d in zip(query, doc)]
+        return self.model.score_submit(pairs)
+
+    def resolve_batch(self, handles) -> list[list[float]]:
+        return [
+            [float(s) for s in arr]
+            for arr in self.model.score_resolve(handles)
+        ]
+
     def __call__(self, doc, query, **kwargs):
         return super().__call__(doc, query, **kwargs)
 
@@ -111,6 +123,27 @@ class EncoderReranker(pw.UDF):
         q = model.embed_batch([x or "" for x in query])
         d = model.embed_batch([x or "" for x in doc])
         return [float(s) for s in np.sum(q * d, axis=1)]
+
+    # two-phase protocol: both embed dispatches per chunk go out eagerly;
+    # the single resolve drains every (query, doc) pair of the epoch
+    def submit_batch(self, doc: list[str], query: list[str], **kwargs):
+        model = self.embedder.model
+        hq = model.embed_submit([x or "" for x in query])
+        hd = model.embed_submit([x or "" for x in doc])
+        return (hq, hd)
+
+    def resolve_batch(self, handles) -> list[list[float]]:
+        model = self.embedder.model
+        flat = []
+        for hq, hd in handles:
+            flat.append(hq)
+            flat.append(hd)
+        arrs = model.embed_resolve(flat)
+        out = []
+        for i in range(0, len(arrs), 2):
+            q, d = arrs[i], arrs[i + 1]
+            out.append([float(s) for s in np.sum(q * d, axis=1)])
+        return out
 
 
 class LLMReranker(pw.UDF):

@@ -191,3 +191,32 @@ def test_jsonl_rows_rejects_non_json_numbers():
         for v in U.iter_records_from_bytes(data, "json", S2)
     ]
     assert fast == slow == [(3, 0.5), (0, 2000.0)]
+
+
+def test_batch_stream_parse_compensating_malformations():
+    """A JSON-fragment pair that merges plus a multi-object message that
+    splits can keep the joined-parse element COUNT right; the sentinel
+    separator must still force the per-message path (review repro —
+    without it the batch fabricated rows with wrong offsets)."""
+    from pathway_tpu.internals import schema as sm
+    from pathway_tpu.io import _utils as U
+
+    S2 = sm.schema_from_types(a=int, b=int, x=int)
+    cols = list(S2.column_names())
+    dtypes = {n: c.dtype for n, c in S2.__columns__.items()}
+    values = [b'{"a":1', b'"b":2}', b'{"x":1},{"x":2}']
+    batch = U.batch_parse_stream_records(values, "json", S2, cols, dtypes)
+    per_msg = [
+        U.parse_stream_record(v, "json", S2, cols, dtypes) for v in values
+    ]
+    assert batch == [None, None, None]
+    assert per_msg == [None, None, None]
+    # same guard on the file-path chunk parser
+    lines = [b'{"a":1', b'"b":2}', b'{"x":1},{"x":2}', b'{"a":9,"b":9,"x":9}']
+    objs = list(U._parse_json_line_chunks(lines))
+    assert objs == [{"a": 9, "b": 9, "x": 9}]
+    # a record whose CONTENT equals the sentinel is still a legal record
+    ok = U.batch_parse_stream_records(
+        [b'{"__pw_sep__":0}', b'{"a":1,"b":2,"x":3}'], "json", S2, cols, dtypes
+    )
+    assert ok[1] == (1, 2, 3)

@@ -389,3 +389,35 @@ def test_slide_parser_without_renderer_requires_pdf2image():
     parser = SlideParser(llm=lambda m, model=None: "x")
     with _pytest.raises(ImportError, match="pdf2image"):
         parser.__wrapped__(b"%PDF")
+
+
+def test_rerankers_two_phase_matches_blocking():
+    """CrossEncoder/Encoder rerankers' submit/resolve pipelining must score
+    identically to the blocking __wrapped__ path (the engine uses whichever
+    is wired; results may not depend on it)."""
+    from pathway_tpu.xpacks.llm.rerankers import (
+        CrossEncoderReranker,
+        EncoderReranker,
+    )
+
+    docs = ["alpha beta gamma", "delta stream tensor", "index chip fuse"]
+    queries = ["alpha beta", "alpha beta", "tensor stream"]
+    for rr in (CrossEncoderReranker(max_batch_size=2),
+               EncoderReranker(max_batch_size=2)):
+        blocking = rr.__wrapped__(docs, queries)
+        h1 = rr.submit_batch(docs[:2], queries[:2])
+        h2 = rr.submit_batch(docs[2:], queries[2:])
+        piped = [s for chunk in rr.resolve_batch([h1, h2]) for s in chunk]
+        assert len(piped) == 3
+        for a, b in zip(blocking, piped):
+            assert abs(a - b) < 1e-5
+        # and the engine path (which auto-uses the two-phase protocol)
+        t = pw.debug.table_from_pandas(
+            __import__("pandas").DataFrame({"doc": docs, "q": queries})
+        )
+        scored = t.select(score=rr(t.doc, t.q))
+        from pathway_tpu.debug import table_to_pandas
+
+        got = sorted(table_to_pandas(scored)["score"].tolist())
+        assert all(abs(a - b) < 1e-5 for a, b in zip(got, sorted(blocking)))
+        pw.clear_graph()
