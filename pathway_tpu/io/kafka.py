@@ -142,6 +142,38 @@ def _confluent():
         ) from exc
 
 
+def make_kafka_consumer(settings: dict, topic: str,
+                        seek_to: dict[int, int] | None,
+                        start_from_latest: bool):
+    """A subscribed confluent_kafka Consumer with the framework defaults:
+    unique per-run group.id (a shared default group would make two
+    independent pipelines on the same topic split partitions and each
+    silently see half the data — the reference always takes group.id from
+    rdkafka_settings), manual commits, and per-partition seek applied
+    inside on_assign so partitions NOT in the saved map still flow."""
+    import uuid
+
+    ck = _confluent()
+    settings = dict(settings)
+    settings.setdefault("group.id", f"pathway-{topic}-{uuid.uuid4().hex[:12]}")
+    settings.setdefault(
+        "auto.offset.reset", "latest" if start_from_latest else "earliest"
+    )
+    settings.setdefault("enable.auto.commit", "false")
+    consumer = ck.Consumer(settings)
+    if seek_to:
+        def on_assign(cons, partitions):
+            for p in partitions:
+                if p.partition in seek_to:
+                    p.offset = seek_to[p.partition] + 1
+            cons.assign(partitions)
+
+        consumer.subscribe([topic], on_assign=on_assign)
+    else:
+        consumer.subscribe([topic])
+    return consumer
+
+
 class _KafkaConnector(BaseConnector):
     """Real consumer loop (reference ``KafkaReader::read``,
     ``data_storage.rs:692``): poll -> parse -> commit at a fresh engine time;
@@ -174,38 +206,9 @@ class _KafkaConnector(BaseConnector):
             self._positions.update(self._seek_to)
 
     def _make_consumer(self):
-        import uuid
-
-        ck = _confluent()
-        settings = dict(self.settings)
-        # unique per run: a shared default group would make two independent
-        # pipelines on the same topic split partitions and each silently see
-        # half the data (reference always takes group.id from
-        # rdkafka_settings; our default must not alias across runs)
-        settings.setdefault(
-            "group.id", f"pathway-{self.topic}-{uuid.uuid4().hex[:12]}"
+        return make_kafka_consumer(
+            self.settings, self.topic, self._seek_to, self.start_from_latest
         )
-        settings.setdefault(
-            "auto.offset.reset",
-            "latest" if self.start_from_latest else "earliest",
-        )
-        settings.setdefault("enable.auto.commit", "false")
-        consumer = ck.Consumer(settings)
-
-        if self._seek_to:
-            # seek inside on_assign so partitions NOT in the saved map (no
-            # messages before the crash, or newly added) still flow through
-            # normal subscription instead of being silently dropped
-            def on_assign(cons, partitions):
-                for p in partitions:
-                    if p.partition in self._seek_to:
-                        p.offset = self._seek_to[p.partition] + 1
-                cons.assign(partitions)
-
-            consumer.subscribe([self.topic], on_assign=on_assign)
-        else:
-            consumer.subscribe([self.topic])
-        return consumer
 
     def _parse(self, msg, cols, dtypes, pk):
         """(key, row) or None for malformed payloads (logged, skipped —
