@@ -34,6 +34,7 @@ from pathway_tpu.xpacks.llm.servers import (
     QASummaryRestServer,
     serve_callable,
 )
+from pathway_tpu.ops.fused_query import FusedRAGPipeline
 from pathway_tpu.xpacks.llm.vector_store import (
     SlidesVectorStoreServer,
     VectorStoreClient,
@@ -41,6 +42,7 @@ from pathway_tpu.xpacks.llm.vector_store import (
 )
 
 __all__ = [
+    "FusedRAGPipeline",
     "embedders",
     "llms",
     "parsers",

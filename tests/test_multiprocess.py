@@ -375,11 +375,21 @@ def _write_edges(tmp_path, edges):
 def _net_distances(rows):
     """Fold an update stream of shard outputs into final {n: d} state (two
     processes' static commits may land in different epochs, so the sink
-    legitimately logs intermediate relaxations with retractions)."""
+    legitimately logs intermediate relaxations with retractions). A vertex
+    with MORE than one surviving distance means a lost retraction — fail
+    loudly instead of letting dict insertion order pick a winner."""
     net: dict = {}
     for r in rows:
         net[(r["n"], r["d"])] = net.get((r["n"], r["d"]), 0) + r["diff"]
-    return {n: d for (n, d), c in net.items() if c > 0}
+    out: dict = {}
+    for (n, d), c in net.items():
+        if c > 0:
+            assert n not in out, (
+                f"vertex {n} has several live distances ({out[n]}, {d}): "
+                "a retraction was lost in the update stream"
+            )
+            out[n] = d
+    return out
 
 
 def test_two_process_iterate_shortest_paths(tmp_path):
@@ -439,11 +449,7 @@ def test_two_process_two_thread_iterate(tmp_path, monkeypatch):
         if not os.path.exists(fp):
             continue
         with open(fp) as f:
-            pid_net: dict = {}
-            for line in f:
-                r = json.loads(line)
-                pid_net[(r["n"], r["d"])] = pid_net.get((r["n"], r["d"]), 0) + r["diff"]
-            for (n, _d), c in pid_net.items():
-                if c > 0:
-                    finals.setdefault(n, set()).add(pid)
+            shard_rows = [json.loads(line) for line in f]
+        for n in _net_distances(shard_rows):
+            finals.setdefault(n, set()).add(pid)
     assert all(len(pids) == 1 for pids in finals.values()), finals
