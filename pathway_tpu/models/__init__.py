@@ -24,6 +24,12 @@ from pathway_tpu.models.embedder import (
     mean_pool,
 )
 from pathway_tpu.models.cross_encoder import CrossEncoderModel
+from pathway_tpu.models.decoder import (
+    DecoderConfig,
+    GPT2_SMALL,
+    GPT2_MEDIUM,
+)
+from pathway_tpu.models.bpe import BPETokenizer
 from pathway_tpu.models.tokenizer import HashTokenizer, load_tokenizer
 from pathway_tpu.models.train import (
     contrastive_loss,
@@ -43,6 +49,10 @@ __all__ = [
     "SentenceEmbedderModel",
     "mean_pool",
     "CrossEncoderModel",
+    "DecoderConfig",
+    "GPT2_SMALL",
+    "GPT2_MEDIUM",
+    "BPETokenizer",
     "HashTokenizer",
     "load_tokenizer",
     "contrastive_loss",

@@ -290,3 +290,92 @@ def load_encoder_checkpoint(
     except KeyError:
         pass
     return params, cfg, head
+
+
+# ---------------------------------------------------------------- decoder
+
+
+def decoder_config_from_hf(path_or_cfg: "str | dict"):
+    """GPT-2 family ``config.json`` → DecoderConfig."""
+    from pathway_tpu.models.decoder import DecoderConfig
+
+    if isinstance(path_or_cfg, str):
+        with open(os.path.join(path_or_cfg, "config.json")) as f:
+            c = json.load(f)
+    else:
+        c = dict(path_or_cfg)
+    return DecoderConfig(
+        vocab_size=c.get("vocab_size", 50257),
+        hidden=c.get("n_embd", 768),
+        layers=c.get("n_layer", 12),
+        heads=c.get("n_head", 12),
+        intermediate=c.get("n_inner") or 4 * c.get("n_embd", 768),
+        max_position=c.get("n_positions", 1024),
+        layer_norm_eps=c.get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def params_from_hf_gpt2(state: dict[str, np.ndarray], cfg) -> dict:
+    """Re-lay an HF GPT-2 state dict into the scan-stacked decoder pytree
+    (``models/decoder.py``).
+
+    GPT-2's dense layers are ``Conv1D`` modules storing ``W`` as (in, out)
+    with ``y = x @ W`` — the JAX layout already — so unlike the BERT
+    converter no transposes are needed. ``lm_head.weight`` is tied to
+    ``wte`` and carries no separate tensor."""
+    state = {
+        (k[len("transformer."):] if k.startswith("transformer.") else k): v
+        for k, v in state.items()
+    }
+    pd = np.float32
+
+    def get(name: str) -> np.ndarray:
+        if name not in state:
+            raise KeyError(
+                f"checkpoint is missing {name!r}; not a GPT-2-family decoder?"
+                f" (has {sorted(state)[:5]}...)"
+            )
+        return np.asarray(state[name], dtype=pd)
+
+    wte = get("wte.weight")
+    if wte.shape != (cfg.vocab_size, cfg.hidden):
+        raise ValueError(
+            f"vocab/hidden mismatch: checkpoint {wte.shape} vs config "
+            f"({cfg.vocab_size}, {cfg.hidden})"
+        )
+    stacked: dict[str, list[np.ndarray]] = {
+        k: []
+        for k in (
+            "ln1_scale", "ln1_bias", "qkv_w", "qkv_b", "attn_out_w",
+            "attn_out_b", "ln2_scale", "ln2_bias", "mlp_in_w", "mlp_in_b",
+            "mlp_out_w", "mlp_out_b",
+        )
+    }
+    for i in range(cfg.layers):
+        p = f"h.{i}."
+        stacked["ln1_scale"].append(get(p + "ln_1.weight"))
+        stacked["ln1_bias"].append(get(p + "ln_1.bias"))
+        stacked["qkv_w"].append(get(p + "attn.c_attn.weight"))  # (h, 3h)
+        stacked["qkv_b"].append(get(p + "attn.c_attn.bias"))
+        stacked["attn_out_w"].append(get(p + "attn.c_proj.weight"))
+        stacked["attn_out_b"].append(get(p + "attn.c_proj.bias"))
+        stacked["ln2_scale"].append(get(p + "ln_2.weight"))
+        stacked["ln2_bias"].append(get(p + "ln_2.bias"))
+        stacked["mlp_in_w"].append(get(p + "mlp.c_fc.weight"))
+        stacked["mlp_in_b"].append(get(p + "mlp.c_fc.bias"))
+        stacked["mlp_out_w"].append(get(p + "mlp.c_proj.weight"))
+        stacked["mlp_out_b"].append(get(p + "mlp.c_proj.bias"))
+    return {
+        "wte": wte,
+        "wpe": get("wpe.weight"),
+        "layers": {k: np.stack(v) for k, v in stacked.items()},
+        "ln_f_scale": get("ln_f.weight"),
+        "ln_f_bias": get("ln_f.bias"),
+    }
+
+
+def load_decoder_checkpoint(path: str, cfg=None) -> tuple[dict, "Any"]:
+    """One-call loader for a local GPT-2-family checkpoint directory."""
+    if cfg is None:
+        cfg = decoder_config_from_hf(path)
+    return params_from_hf_gpt2(load_hf_state_dict(path), cfg), cfg

@@ -206,6 +206,155 @@ class CohereChat(BaseChat):
         return ret.text, cited_docs
 
 
+class TPUDecoderChat(BaseChat):
+    """TPU-native local chat: a GPT-2-family causal decoder generating ON
+    DEVICE (``models/decoder.py``).
+
+    Where the reference's local-LLM option (``HFPipelineChat``, reference
+    llms.py:441-542) runs a torch pipeline host-side token by token, this
+    UDF compiles prefill + KV-cached decode + sampling into ONE jitted
+    call, so an engine microbatch of prompts costs a single dispatch.
+
+    Construct either from a local GPT-2-family checkpoint directory
+    (weights + ``vocab.json``/``merges.txt``) or from explicit
+    ``params``/``cfg``/``tokenizer`` (any object with ``encode``/``decode``
+    and an ``eos_id``)."""
+
+    def __init__(
+        self,
+        checkpoint_path: str | None = None,
+        params: dict | None = None,
+        cfg=None,
+        tokenizer=None,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        max_prompt_tokens: int = 512,
+        seed: int = 0,
+        cache_strategy: udfs.CacheStrategy | None = None,
+        max_batch_size: int | None = 64,
+    ):
+        super().__init__(
+            batch=True,
+            max_batch_size=max_batch_size,
+            cache_strategy=cache_strategy,
+        )
+        if checkpoint_path is not None:
+            from pathway_tpu.models.bpe import BPETokenizer
+            from pathway_tpu.models.checkpoint import load_decoder_checkpoint
+
+            params, cfg = load_decoder_checkpoint(checkpoint_path, cfg)
+            if tokenizer is None:
+                tokenizer = BPETokenizer.from_dir(checkpoint_path)
+        if params is None or cfg is None or tokenizer is None:
+            raise ValueError(
+                "TPUDecoderChat needs checkpoint_path or explicit "
+                "params + cfg + tokenizer"
+            )
+        import jax
+
+        self.params = jax.device_put(params)
+        self.cfg = cfg
+        self.tokenizer = tokenizer
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        # clamp the prompt cap so prompt + generation always fits the
+        # model's positions (generate() raises on overflow; the cap makes
+        # the default usable for any max_position)
+        self.max_prompt_tokens = min(
+            int(max_prompt_tokens), cfg.max_position - self.max_new_tokens
+        )
+        if self.max_prompt_tokens <= 0:
+            raise ValueError(
+                f"max_new_tokens ({self.max_new_tokens}) leaves no room "
+                f"for a prompt within max_position ({cfg.max_position})"
+            )
+        self._seed = seed
+        self._calls = 0  # advances the sampling key between calls
+        # (rows, prompt_len, max_new, temperature) -> jitted generate
+        self._jitted: dict[tuple, Any] = {}
+
+    def _format_prompt(self, messages) -> str:
+        if isinstance(messages, str):
+            return messages
+        parts = [
+            f"{m.get('role', 'user')}: {m.get('content', '')}"
+            for m in _messages_to_list(messages)
+        ]
+        return "\n".join(parts) + "\nassistant:"
+
+    def _generate_fn(self, rows: int, s: int, max_new: int, temp: float):
+        fn = self._jitted.get((rows, s, max_new, temp))
+        if fn is None:
+            import jax
+
+            from pathway_tpu.models import decoder as decoder_mod
+
+            cfg = self.cfg
+
+            def run(params, ids, mask, key):
+                return decoder_mod.generate(
+                    params, ids, mask, cfg, max_new,
+                    temperature=temp, key=key,
+                    eos_id=getattr(self.tokenizer, "eos_id", None),
+                )
+
+            fn = jax.jit(run)
+            self._jitted[(rows, s, max_new, temp)] = fn
+        return fn
+
+    def _accepts_call_arg(self, arg_name: str) -> bool:
+        return arg_name in ("max_new_tokens", "temperature")
+
+    def __wrapped__(self, messages: list, **kwargs) -> list[str | None]:
+        import jax
+        import numpy as np
+
+        from pathway_tpu.ops import next_pow2
+
+        max_new = int(kwargs.pop("max_new_tokens", self.max_new_tokens))
+        temp = float(kwargs.pop("temperature", self.temperature))
+        if kwargs:
+            # the sibling chat classes forward call kwargs to their APIs;
+            # a compiled decoder has no such sink — reject, don't ignore
+            raise TypeError(
+                f"TPUDecoderChat got unsupported call kwargs: {sorted(kwargs)}"
+            )
+        prompts = [self._format_prompt(m) for m in messages]
+        encoded = [
+            self.tokenizer.encode(p)[-self.max_prompt_tokens:]
+            for p in prompts
+        ]
+        s = next_pow2(max((len(e) for e in encoded), default=1), 8)
+        s = min(s, self.max_prompt_tokens)
+        rows = next_pow2(len(encoded), 1)
+        ids = np.zeros((rows, s), np.int32)
+        mask = np.zeros((rows, s), np.int32)
+        for r, e in enumerate(encoded):  # LEFT-padded (decoder contract)
+            e = e[-s:]
+            if e:
+                ids[r, s - len(e):] = e
+                mask[r, s - len(e):] = 1
+            else:
+                mask[r, -1] = 1  # empty prompt: one live pad slot
+        # advance the key per call: temperature>0 must SAMPLE across calls,
+        # not replay one fixed draw (greedy decode ignores the key entirely)
+        self._calls += 1
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._calls)
+        toks = np.asarray(
+            self._generate_fn(rows, s, max_new, temp)(
+                self.params, ids, mask, key
+            )
+        )
+        eos = getattr(self.tokenizer, "eos_id", None)
+        out: list[str | None] = []
+        for r in range(len(encoded)):
+            t = toks[r].tolist()
+            if eos is not None and eos in t:
+                t = t[: t.index(eos)]
+            out.append(self.tokenizer.decode(t))
+        return out
+
+
 @pw.udf
 def prompt_chat_single_qa(question: str) -> Json:
     """Wrap a plain question string into a one-message chat (reference
