@@ -66,8 +66,16 @@ class BaseConnector:
         consolidated snapshot rows about to be re-emitted."""
 
     # -- session API used by run() implementations -------------------------
-    def emit(self, time: int, rows: list[tuple[int, tuple, int]]) -> None:
-        if rows:
+    def emit(
+        self, time: int, rows: "list[tuple[int, tuple, int]] | Batch"
+    ) -> None:
+        """Inject rows at ``time``. Accepts either per-row triples or an
+        already-columnar ``Batch`` (bulk readers build batches directly so
+        400k-row commits skip the row-tuple round trip)."""
+        if isinstance(rows, Batch):
+            if len(rows):
+                self._sched.inject(self.node, time, rows)
+        elif rows:
             self._sched.inject(
                 self.node, time, Batch.from_rows(self.node.column_names, rows)
             )
@@ -77,14 +85,17 @@ class BaseConnector:
             return
         self._sched.advance_source(self.node, new_time)
 
-    def commit_rows(self, rows: list[tuple[int, tuple, int]]) -> int:
+    def commit_rows(
+        self, rows: "list[tuple[int, tuple, int]] | Batch"
+    ) -> int:
         """Atomically emit ``rows`` at a fresh commit time and advance the
         frontier past it (safe against the heartbeat)."""
         with self._time_mutex:
             t = next_commit_time()
             self.emit(t, rows)
             if self._snapshot_writer is not None:
-                self._snapshot_writer.write_rows(rows)
+                row_list = list(rows.rows()) if isinstance(rows, Batch) else rows
+                self._snapshot_writer.write_rows(row_list)
                 self._snapshot_writer.advance(t, offset=self.current_offset())
             self.advance(t + 1)
             if self._sched is not None:
