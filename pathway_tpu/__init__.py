@@ -43,8 +43,18 @@ from pathway_tpu.internals.expression import (
     require,
     unwrap,
 )
-from pathway_tpu.internals.groupbys import GroupedTable
-from pathway_tpu.internals.joins import JoinResult
+from pathway_tpu.internals.groupbys import GroupedJoinResult, GroupedTable
+from pathway_tpu.internals.join_mode import JoinMode
+from pathway_tpu.internals.joins import (
+    JoinResult,
+    OuterJoinResult,
+    groupby,
+    join,
+    join_inner,
+    join_left,
+    join_outer,
+    join_right,
+)
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.parse_graph import G, clear_graph
 from pathway_tpu.internals.run import run, run_all
@@ -59,11 +69,14 @@ from pathway_tpu.internals.schema import (
     schema_from_pandas,
     schema_from_types,
 )
-from pathway_tpu.internals.table import Joinable, Table
+from pathway_tpu.internals.table import Joinable, Table, TableLike
+from pathway_tpu.internals.table_slice import TableSlice
 from pathway_tpu.internals.thisclass import left, right, this
 from pathway_tpu.internals import udfs
 from pathway_tpu.internals.udfs import (
     UDF,
+    UDFAsync,
+    UDFSync,
     async_executor,
     auto_executor,
     fully_async_executor,
@@ -81,7 +94,15 @@ from pathway_tpu import debug  # noqa: E402
 from pathway_tpu import io  # noqa: E402
 from pathway_tpu import persistence  # noqa: E402
 from pathway_tpu.stdlib import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils, viz  # noqa: E402
-from pathway_tpu.internals.interactive import LiveTable  # noqa: E402
+from pathway_tpu.internals.interactive import (  # noqa: E402
+    LiveTable,
+    enable_interactive_mode,
+)
+from pathway_tpu.stdlib.temporal import (  # noqa: E402
+    AsofJoinResult,
+    IntervalJoinResult,
+    WindowJoinResult,
+)
 from pathway_tpu.internals.row_transformer import (  # noqa: E402
     ClassArg,
     attribute,
@@ -105,6 +126,11 @@ from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
 from pathway_tpu import demo  # noqa: E402
 
 # typing aliases (reference exposes these as pw.*)
+from pathway_tpu.internals.api import (  # noqa: E402
+    PathwayType as Type,
+    PersistenceMode,
+)
+
 PointerType = Pointer
 DATE_TIME_NAIVE = _dt.DATE_TIME_NAIVE
 DATE_TIME_UTC = _dt.DATE_TIME_UTC
@@ -143,6 +169,27 @@ def table_transformer(fn=None, **kwargs):
 
 __all__ = [
     "Table",
+    "TableLike",
+    "TableSlice",
+    "Joinable",
+    "JoinMode",
+    "JoinResult",
+    "OuterJoinResult",
+    "GroupedJoinResult",
+    "AsofJoinResult",
+    "IntervalJoinResult",
+    "WindowJoinResult",
+    "UDFAsync",
+    "UDFSync",
+    "Type",
+    "PersistenceMode",
+    "join",
+    "join_inner",
+    "join_left",
+    "join_right",
+    "join_outer",
+    "groupby",
+    "enable_interactive_mode",
     "Schema",
     "Json",
     "Pointer",

@@ -160,3 +160,45 @@ class LiveTable:
             return self.snapshot()._repr_html_()
         except Exception:  # noqa: BLE001
             return repr(self)
+
+
+class InteractiveModeController:
+    """Tracks LiveTables started while interactive mode is on so one call
+    can stop every background run (reference ``interactive.py:203`` returns
+    the graph's controller)."""
+
+    def __init__(self):
+        self._live: list[LiveTable] = []
+        self.enabled = True
+
+    def register(self, live: "LiveTable") -> None:
+        self._live.append(live)
+
+    def stop(self) -> None:
+        for lt in self._live:
+            try:
+                lt.stop()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+        self._live.clear()
+        self.enabled = False
+
+
+_controller: InteractiveModeController | None = None
+
+
+def enable_interactive_mode() -> InteractiveModeController:
+    """Switch the process into interactive (notebook) mode: ``Table.live()``
+    tables register with the returned controller, and ``controller.stop()``
+    tears all of them down (reference ``interactive.py:203-220``)."""
+    import warnings
+
+    global _controller
+    warnings.warn("interactive mode is experimental", stacklevel=2)
+    if _controller is None or not _controller.enabled:
+        _controller = InteractiveModeController()
+    return _controller
+
+
+def get_interactive_controller() -> "InteractiveModeController | None":
+    return _controller

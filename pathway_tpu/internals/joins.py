@@ -38,13 +38,30 @@ def join(
     left_instance=None,
     right_instance=None,
 ):
-    from pathway_tpu.internals.table import Table
-
     if hasattr(how, "value"):
         how = how.value
-    return JoinResult(
+    cls = JoinResult if how == "inner" else OuterJoinResult
+    return cls(
         left_table, right_table, list(on), id, how, left_instance, right_instance
     )
+
+
+def join_inner(left_table, right_table, *on, **kw):
+    """Free-function form of ``Joinable.join_inner`` (reference
+    ``internals/joins.py:1163``)."""
+    return join(left_table, right_table, *on, how="inner", **kw)
+
+
+def join_left(left_table, right_table, *on, **kw):
+    return join(left_table, right_table, *on, how="left", **kw)
+
+
+def join_right(left_table, right_table, *on, **kw):
+    return join(left_table, right_table, *on, how="right", **kw)
+
+
+def join_outer(left_table, right_table, *on, **kw):
+    return join(left_table, right_table, *on, how="outer", **kw)
 
 
 class JoinResult:
@@ -221,7 +238,8 @@ class JoinResult:
             if isinstance(right_instance, ColumnExpression)
             else right_instance
         )
-        jr = JoinResult(base, other, on2, id2, how, li2, ri2)
+        cls = JoinResult if how == "inner" else OuterJoinResult
+        jr = cls(base, other, on2, id2, how, li2, ri2)
         jr._aliases = amap
         return jr
 
@@ -483,5 +501,24 @@ class JoinResult:
         return self.select(**left_cols).reduce(*args, **kwargs)
 
     def groupby(self, *args, **kwargs):
+        from pathway_tpu.internals.groupbys import GroupedJoinResult
+
         full = self.select(**self._output_columns())
-        return full.groupby(*args, **kwargs)
+        grouped = full.groupby(*args, **kwargs)
+        # same behavior as grouping the materialized join; the distinct type
+        # mirrors the reference's GroupedJoinResult (groupbys.py:272) for
+        # isinstance-based code
+        grouped.__class__ = GroupedJoinResult
+        return grouped
+
+
+class OuterJoinResult(JoinResult):
+    """Result type of left/right/outer joins (reference ``joins.py``):
+    behaviorally identical to JoinResult — the distinct type exists because
+    outer modes cannot preserve input ids."""
+
+
+def groupby(grouped, *args, **kwargs):
+    """Free-function form of ``Table.groupby`` / ``JoinResult.groupby``
+    (reference ``internals/table.py:2592``)."""
+    return grouped.groupby(*args, **kwargs)

@@ -41,7 +41,39 @@ def _name_seq(prefix: str):
         yield f"{prefix}{next(counter)}"
 
 
-class Joinable:
+class TableLike:
+    """Common interface of universe-bearing objects — Table, GroupedTable,
+    JoinResult (reference ``internals/table_like.py:15``). Universe promises
+    registered here feed the SAT-backed universe solver
+    (``internals/universe.py``)."""
+
+    _universe: Any = None
+
+    def promise_universes_are_disjoint(self, other: "TableLike"):
+        # disjointness is not used by the solver's subset/equality queries;
+        # accepted for API parity (the reference registers it for concat)
+        return self
+
+    def promise_universes_are_equal(self, other: "TableLike"):
+        from pathway_tpu.internals.universe import register_equal
+
+        register_equal(self._universe, other._universe)
+        return self
+
+    def promise_universe_is_equal_to(self, other: "TableLike"):
+        from pathway_tpu.internals.universe import register_equal
+
+        register_equal(self._universe, other._universe)
+        return self
+
+    def promise_universe_is_subset_of(self, other: "TableLike"):
+        from pathway_tpu.internals.universe import register_subset
+
+        register_subset(self._universe, other._universe)
+        return self
+
+
+class Joinable(TableLike):
     """Things you can join on: tables and join results."""
 
     def join(self, other, *on, id=None, how="inner", left_instance=None, right_instance=None):
@@ -416,23 +448,22 @@ class Table(Joinable):
         register_equal(self._universe, other._universe)
         return Table(self._node, self._schema, other._universe)
 
+    @property
+    def slice(self):
+        """A manipulable view of this table's column references (reference
+        ``Table.slice`` / ``internals/table_slice.py``):
+        ``t.select(*t.slice.without("age"))``."""
+        from pathway_tpu.internals.table_slice import TableSlice
+
+        return TableSlice({n: self[n] for n in self.column_names()}, self)
+
     def is_subset_of(self, other: "Table") -> bool:
         from pathway_tpu.internals.universe import GLOBAL_SOLVER
 
         return GLOBAL_SOLVER.query_is_subset(self._universe, other._universe)
 
-    promise_universes_are_disjoint = lambda self, other: self  # noqa: E731
-    def promise_universes_are_equal(self, other: "Table") -> "Table":
-        register_equal(self._universe, other._universe)
-        return self
-
-    def promise_universe_is_subset_of(self, other: "Table") -> "Table":
-        register_subset(self._universe, other._universe)
-        return self
-
-    def promise_universe_is_equal_to(self, other: "Table") -> "Table":
-        register_equal(self._universe, other._universe)
-        return self
+    # universe promises (promise_universes_are_equal & co.) inherit from
+    # TableLike
 
     # ------------------------------------------------------------------ lookup
     def ix(self, expression, *, optional: bool = False, context=None):
@@ -631,9 +662,16 @@ class Table(Joinable):
 
     # LiveTable / interactive hook (reference table.py:2565)
     def live(self):
-        from pathway_tpu.internals.interactive import LiveTable
+        from pathway_tpu.internals.interactive import (
+            LiveTable,
+            get_interactive_controller,
+        )
 
-        return LiveTable(self)
+        lt = LiveTable(self)
+        ctl = get_interactive_controller()
+        if ctl is not None and ctl.enabled:
+            ctl.register(lt)
+        return lt
 
     # engine-level: external index query (stdlib.indexing uses this)
     def _external_index_as_of_now(

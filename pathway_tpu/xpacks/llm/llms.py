@@ -319,13 +319,23 @@ class TPUDecoderChat(BaseChat):
             raise TypeError(
                 f"TPUDecoderChat got unsupported call kwargs: {sorted(kwargs)}"
             )
+        # a per-call max_new_tokens shrinks the prompt budget so the
+        # constructor's fit guarantee (prompt + generation <= max_position)
+        # holds for every call, not just the default
+        prompt_cap = min(
+            self.max_prompt_tokens, self.cfg.max_position - max_new
+        )
+        if prompt_cap <= 0:
+            raise ValueError(
+                f"max_new_tokens ({max_new}) leaves no room for a prompt "
+                f"within max_position ({self.cfg.max_position})"
+            )
         prompts = [self._format_prompt(m) for m in messages]
         encoded = [
-            self.tokenizer.encode(p)[-self.max_prompt_tokens:]
-            for p in prompts
+            self.tokenizer.encode(p)[-prompt_cap:] for p in prompts
         ]
         s = next_pow2(max((len(e) for e in encoded), default=1), 8)
-        s = min(s, self.max_prompt_tokens)
+        s = min(s, prompt_cap)
         rows = next_pow2(len(encoded), 1)
         ids = np.zeros((rows, s), np.int32)
         mask = np.zeros((rows, s), np.int32)

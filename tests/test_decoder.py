@@ -118,6 +118,12 @@ def test_chat_udf_temperature_samples_across_calls(tiny_params):
     assert len(short[0]) == 2
     with pytest.raises(TypeError, match="unsupported call kwargs"):
         chat.__wrapped__(["same prompt"], top_p=0.9)
+    # per-call max_new shrinks the prompt budget so generation still fits
+    # max_position (64 here); an impossible request fails loudly
+    fits = chat.__wrapped__(["x" * 200], max_new_tokens=32)
+    assert len(fits[0]) == 32
+    with pytest.raises(ValueError, match="no room"):
+        chat.__wrapped__(["hi"], max_new_tokens=TINY.max_position)
 
 
 def test_hf_gpt2_logits_parity():
