@@ -231,6 +231,28 @@ class FilterNode(Node):
         return batch.take(mask)
 
 
+class RemoveErrorsNode(Node):
+    """Drop rows with an ERROR value in any column (reference
+    ``Table.remove_errors`` / ``RemoveErrorsContext``, table.py:2491)."""
+
+    def __init__(self, graph, input_node, name="RemoveErrors"):
+        super().__init__(graph, [input_node], input_node.column_names, name)
+
+    def step(self, time, ins):
+        (batch,) = ins
+        if batch is None or len(batch) == 0:
+            return None
+        mask = np.ones(len(batch), dtype=bool)
+        for col in batch.cols.values():
+            if col.dtype == object:
+                mask &= ~error_mask(col)
+        if mask.all():
+            return batch
+        if not mask.any():
+            return None
+        return batch.take(mask)
+
+
 class SelectColumnsNode(Node):
     """Project/rename columns (cheap, array-sharing)."""
 

@@ -29,6 +29,11 @@ from pathway_tpu.internals.type_interpreter import infer_dtype
 from pathway_tpu.internals.universe import Universe
 
 
+def _result_cls(how: str):
+    """The one place the how -> result-class choice lives."""
+    return JoinResult if how == "inner" else OuterJoinResult
+
+
 def join(
     left_table,
     right_table,
@@ -40,28 +45,27 @@ def join(
 ):
     if hasattr(how, "value"):
         how = how.value
-    cls = JoinResult if how == "inner" else OuterJoinResult
-    return cls(
+    return _result_cls(how)(
         left_table, right_table, list(on), id, how, left_instance, right_instance
     )
 
 
 def join_inner(left_table, right_table, *on, **kw):
-    """Free-function form of ``Joinable.join_inner`` (reference
-    ``internals/joins.py:1163``)."""
-    return join(left_table, right_table, *on, how="inner", **kw)
+    """Free-function forms delegate to the ``Joinable`` methods (reference
+    ``internals/joins.py:1163``) so join-mode handling has one home."""
+    return left_table.join_inner(right_table, *on, **kw)
 
 
 def join_left(left_table, right_table, *on, **kw):
-    return join(left_table, right_table, *on, how="left", **kw)
+    return left_table.join_left(right_table, *on, **kw)
 
 
 def join_right(left_table, right_table, *on, **kw):
-    return join(left_table, right_table, *on, how="right", **kw)
+    return left_table.join_right(right_table, *on, **kw)
 
 
 def join_outer(left_table, right_table, *on, **kw):
-    return join(left_table, right_table, *on, how="outer", **kw)
+    return left_table.join_outer(right_table, *on, **kw)
 
 
 class JoinResult:
@@ -238,8 +242,7 @@ class JoinResult:
             if isinstance(right_instance, ColumnExpression)
             else right_instance
         )
-        cls = JoinResult if how == "inner" else OuterJoinResult
-        jr = cls(base, other, on2, id2, how, li2, ri2)
+        jr = _result_cls(how)(base, other, on2, id2, how, li2, ri2)
         jr._aliases = amap
         return jr
 
@@ -500,16 +503,18 @@ class JoinResult:
         }
         return self.select(**left_cols).reduce(*args, **kwargs)
 
+    def keys(self):
+        """Output column names of the join (reference ``JoinResult.keys``,
+        joins.py:605)."""
+        return list(self._output_columns())
+
     def groupby(self, *args, **kwargs):
         from pathway_tpu.internals.groupbys import GroupedJoinResult
 
         full = self.select(**self._output_columns())
-        grouped = full.groupby(*args, **kwargs)
-        # same behavior as grouping the materialized join; the distinct type
-        # mirrors the reference's GroupedJoinResult (groupbys.py:272) for
-        # isinstance-based code
-        grouped.__class__ = GroupedJoinResult
-        return grouped
+        # grouping the materialized join, constructed as the reference's
+        # distinct GroupedJoinResult type (groupbys.py:272)
+        return full.groupby(*args, _result_cls=GroupedJoinResult, **kwargs)
 
 
 class OuterJoinResult(JoinResult):

@@ -52,6 +52,19 @@ def npsum(expr) -> ReducerExpression:
     return ReducerExpression(_NPSUM, expr)
 
 
+def int_sum(expr) -> ReducerExpression:
+    """Deprecated alias of ``sum`` (reference ``reducers.int_sum``,
+    internals/reducers.py:611)."""
+    import warnings
+
+    warnings.warn(
+        "Reducer pathway.reducers.int_sum is deprecated, use "
+        "pathway.reducers.sum instead.",
+        stacklevel=2,
+    )
+    return sum(expr)
+
+
 def min(expr) -> ReducerExpression:  # noqa: A001
     return ReducerExpression(_MIN, expr)
 

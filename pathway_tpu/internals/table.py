@@ -457,6 +457,55 @@ class Table(Joinable):
 
         return TableSlice({n: self[n] for n in self.column_names()}, self)
 
+    def remove_errors(self) -> "Table":
+        """Filter out rows containing ERROR values (reference
+        ``Table.remove_errors``, table.py:2491)."""
+        node = core_ops.RemoveErrorsNode(G.engine_graph, self._node)
+        return Table(node, self._schema, self._universe.subset())
+
+    def to(self, sink) -> None:
+        """Send this table to a sink (reference ``Table.to``, table.py:2353
+        — ``table.to(datasink)``). Accepts anything exposing
+        ``write(table)`` (our ``pw.io.*`` writer objects) or a callable."""
+        if hasattr(sink, "write"):
+            sink.write(self)
+            return
+        if callable(sink):
+            sink(self)
+            return
+        raise TypeError(
+            f"Table.to expects a sink with .write(table) or a callable, "
+            f"got {type(sink).__name__}"
+        )
+
+    def eval_type(self, expression):
+        """Dtype the type interpreter assigns ``expression`` in this
+        table's context (reference ``Table.eval_type``, table.py:2549)."""
+        from pathway_tpu.internals.type_interpreter import infer_dtype
+
+        return infer_dtype(
+            self._desugar(expr_mod.smart_coerce(expression)), self
+        )
+
+    def update_id_type(self, id_type, *, id_append_only: bool | None = None) -> "Table":
+        """Override the dtype of ``self.id`` (reference
+        ``Table.update_id_type``, table.py:2003). The override lives on the
+        result's universe, so tables DERIVED from the result (filter,
+        select, ...) keep the id type; the source table is unchanged."""
+        if id_append_only is not None:
+            import warnings
+
+            warnings.warn(
+                "update_id_type: id_append_only is accepted for reference "
+                "API parity but append-only id tracking is not modeled; "
+                "the flag has no effect",
+                stacklevel=2,
+            )
+        u = self._universe.subset()
+        register_equal(self._universe, u)  # same keys, distinct carrier
+        u.id_dtype = dt.wrap(id_type) if not isinstance(id_type, dt.DType) else id_type
+        return Table(self._node, self._schema, u)
+
     def is_subset_of(self, other: "Table") -> bool:
         from pathway_tpu.internals.universe import GLOBAL_SOLVER
 
@@ -482,6 +531,7 @@ class Table(Joinable):
         sort_by=None,
         _filter_out_results_of_forgetting=False,
         instance=None,
+        _result_cls=None,  # JoinResult.groupby -> GroupedJoinResult
         **kwargs,
     ):
         from pathway_tpu.internals.groupbys import GroupedTable
@@ -493,12 +543,12 @@ class Table(Joinable):
             if sort_by is not None
             else None
         )
+        cls = _result_cls or GroupedTable
         if id is not None:
             id_ref = self._desugar(id)
             grouping = [id_ref]
-            return GroupedTable(self, grouping, inst, by_id=True,
-                                sort_by=sort_expr)
-        return GroupedTable(self, grouping, inst, sort_by=sort_expr)
+            return cls(self, grouping, inst, by_id=True, sort_by=sort_expr)
+        return cls(self, grouping, inst, sort_by=sort_expr)
 
     def reduce(self, *args, **kwargs) -> "Table":
         return self.groupby().reduce(*args, **kwargs)

@@ -51,8 +51,20 @@ class TableSlice:
         return self._mapping[self._name_of(args)]
 
     def __getattr__(self, name: str) -> ColumnReference:
+        from pathway_tpu.internals.table import Table
+
         mapping = object.__getattribute__(self, "_mapping")
         if name in mapping:
+            # discourage method-name columns like the reference does
+            # (table_slice.py:67) — note that names colliding with
+            # TableSlice's OWN methods (keys/without/rename/...) never
+            # reach __getattr__ and must use [] access
+            if hasattr(Table, name) and name != "id":
+                raise ValueError(
+                    f"{name!r} is a method name. It is discouraged to use "
+                    f"it as a column name. If you really want to use it, "
+                    f"use [{name!r}]."
+                )
             return mapping[name]
         raise AttributeError(f"TableSlice has no column {name!r}")
 
@@ -78,6 +90,14 @@ class TableSlice:
                 raise KeyError(f"column {old!r} not in slice")
             mapping.pop(old)
         for old, new in renames.items():
+            # stricter than the reference (which overwrites silently): a
+            # target colliding with a kept column or another rename target
+            # would silently DROP a column from the slice
+            if new in mapping:
+                raise ValueError(
+                    f"rename target {new!r} collides with an existing "
+                    f"column in the slice"
+                )
             mapping[new] = self._mapping[old]  # renamed keys move to the end
         return TableSlice(mapping, self._table)
 

@@ -29,6 +29,11 @@ def _col_dtype(e: expr_mod.ColumnReference, table) -> dt.DType:
     if t is None or not hasattr(t, "_schema"):
         t = table
     if e._name == "id":
+        # Table.update_id_type override rides the universe (and its subsets)
+        u = getattr(t, "_universe", None)
+        override = getattr(u, "id_dtype", None)
+        if override is not None:
+            return override
         return dt.Pointer(getattr(t, "_schema", None))
     try:
         return t._schema.__columns__[e._name].dtype

@@ -36,6 +36,9 @@ from pathway_tpu.internals.udfs.executors import (
     auto_executor,
     fully_async_executor,
     sync_executor,
+    with_capacity,
+    with_retry_strategy,
+    with_timeout,
 )
 from pathway_tpu.internals.udfs.retries import (
     AsyncRetryStrategy,
@@ -63,6 +66,9 @@ __all__ = [
     "FixedDelayRetryStrategy",
     "NoRetryStrategy",
     "coerce_async",
+    "with_capacity",
+    "with_timeout",
+    "with_retry_strategy",
 ]
 
 

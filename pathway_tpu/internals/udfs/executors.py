@@ -113,6 +113,26 @@ def fully_async_executor(
     )
 
 
+def with_capacity(func: Callable, capacity: int) -> Callable:
+    """Limit the number of simultaneous calls of ``func`` (reference
+    ``udfs/executors.py:227``). Sync callables are coerced to async."""
+    return AsyncExecutor(capacity=capacity)._wrap(func)
+
+
+def with_timeout(func: Callable, timeout: float) -> Callable:
+    """Cancel calls of ``func`` that exceed ``timeout`` seconds (reference
+    ``udfs/executors.py:253``). Sync callables are coerced to async."""
+    return AsyncExecutor(timeout=timeout)._wrap(func)
+
+
+def with_retry_strategy(
+    func: Callable, retry_strategy: AsyncRetryStrategy
+) -> Callable:
+    """Retry failing calls of ``func`` per ``retry_strategy`` (reference
+    ``udfs/executors.py``). Sync callables are coerced to async."""
+    return AsyncExecutor(retry_strategy=retry_strategy)._wrap(func)
+
+
 def async_options(
     capacity: int | None = None,
     timeout: float | None = None,

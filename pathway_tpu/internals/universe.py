@@ -20,12 +20,17 @@ class Universe:
 
     def __init__(self):
         self.id = next(Universe._ids)
+        # Table.update_id_type override: the dtype of row ids is a property
+        # of the KEY SPACE, so it rides the universe and flows to every
+        # derived (subset) universe automatically
+        self.id_dtype = None
 
     def __repr__(self):
         return f"Universe({self.id})"
 
     def subset(self) -> "Universe":
         u = Universe()
+        u.id_dtype = self.id_dtype
         register_subset(u, self)
         return u
 

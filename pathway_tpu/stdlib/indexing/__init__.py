@@ -17,6 +17,7 @@ from pathway_tpu.stdlib.indexing.hybrid_index import (
 from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     BruteForceKnn,
     BruteForceKnnFactory,
+    BruteForceKnnMetricKind,
     IvfKnn,
     IvfKnnFactory,
     KnnIndexFactory,
@@ -24,6 +25,7 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     DistanceMetric,
     LshKnn,
     USearchKnn,
+    USearchMetricKind,
     UsearchKnnFactory,
 )
 from pathway_tpu.stdlib.indexing.retrievers import (
@@ -48,6 +50,8 @@ from pathway_tpu.stdlib.indexing.sorting import (
 )
 
 __all__ = [
+    "BruteForceKnnMetricKind",
+    "USearchMetricKind",
     "SortedIndex",
     "build_sorted_index",
     "retrieve_prev_next_values",

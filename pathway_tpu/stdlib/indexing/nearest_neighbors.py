@@ -28,6 +28,30 @@ class DistanceMetric(enum.Enum):
     L2SQ = "l2sq"
 
 
+class BruteForceKnnMetricKind(enum.Enum):
+    """Reference ``engine.pyi:882`` — metric kinds of the brute-force KNN."""
+
+    L2SQ = "l2sq"
+    COS = "cos"
+
+
+class USearchMetricKind(enum.Enum):
+    """Reference ``engine.pyi:871``. On TPU only IP/L2SQ/COS map to the
+    dense kernels; the exotic uSearch metrics normalize to COS with a
+    warning at index construction (USearchKnn already warns that it
+    aliases the exact index)."""
+
+    IP = "ip"
+    L2SQ = "l2sq"
+    COS = "cos"
+    PEARSON = "pearson"
+    HAVERSINE = "haversine"
+    DIVERGENCE = "divergence"
+    HAMMING = "hamming"
+    TANIMOTO = "tanimoto"
+    SORENSEN = "sorensen"
+
+
 class _KnnIndexFactory(ExternalIndexFactory):
     def __init__(self, dimensions, reserved_space, metric: str):
         self.dimensions = dimensions
@@ -60,7 +84,20 @@ class BruteForceKnn(InnerIndex):
         super().__init__(data_column, metadata_column)
         self.dimensions = dimensions
         self.reserved_space = reserved_space
-        self.metric = metric.value if isinstance(metric, DistanceMetric) else str(metric)
+        # accepts DistanceMetric, the reference's metric-kind enums
+        # (BruteForceKnnMetricKind / USearchMetricKind), or a plain string
+        self.metric = (
+            metric.value if isinstance(metric, enum.Enum) else str(metric)
+        )
+        if self.metric not in ("cos", "l2sq", "l2"):
+            import warnings
+
+            warnings.warn(
+                f"metric {self.metric!r} has no native TPU kernel; falling "
+                f"back to cosine over unit-normalized vectors (rankings "
+                f"differ from true {self.metric!r} on unnormalized data)",
+                stacklevel=2,
+            )
         self.embedder = embedder
 
     def index_vector_expr(self) -> ColumnExpression:

@@ -3,6 +3,7 @@ the round-3 additions (TableSlice, JoinMode, free join/groupby functions,
 TableLike hierarchy, interactive mode controller)."""
 
 import ast
+import os
 
 import pytest
 
@@ -36,6 +37,110 @@ def test_every_reference_export_exists():
     assert not missing, f"missing top-level exports: {missing}"
 
 
+def _public_defs(path, classname=None):
+    tree = ast.parse(open(path).read())
+    if classname is not None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == classname:
+                return {
+                    n.name
+                    for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and not n.name.startswith("_")
+                }
+        return set()
+    return {
+        n.name
+        for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and not n.name.startswith("_")
+    }
+
+
+@pytest.mark.parametrize(
+    "ref_path,classname,ours",
+    [
+        ("internals/table.py", "Table", lambda: pw.Table),
+        ("internals/joins.py", "JoinResult", lambda: pw.JoinResult),
+        ("internals/expression.py", "ColumnExpression",
+         lambda: pw.ColumnExpression),
+    ],
+    ids=["Table", "JoinResult", "ColumnExpression"],
+)
+def test_reference_methods_exist(ref_path, classname, ours):
+    try:
+        ref = _public_defs(
+            f"/root/reference/python/pathway/{ref_path}", classname
+        )
+    except OSError:
+        pytest.skip("reference checkout not available")
+    have = set(dir(ours()))
+    missing = sorted(ref - have)
+    assert not missing, f"{classname} missing methods: {missing}"
+
+
+def _ref_module_all(path):
+    for node in ast.walk(ast.parse(open(path).read())):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    return [ast.literal_eval(e) for e in node.value.elts]
+    tree = ast.parse(open(path).read())
+    return [
+        n.name
+        for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.ClassDef))
+        and not n.name.startswith("_")
+    ]
+
+
+@pytest.mark.parametrize(
+    "ref_rel,mod",
+    [
+        ("stdlib/temporal/__init__.py", "pathway_tpu.stdlib.temporal"),
+        ("stdlib/indexing/__init__.py", "pathway_tpu.stdlib.indexing"),
+        ("stdlib/ml/__init__.py", "pathway_tpu.stdlib.ml"),
+        ("stdlib/graphs/__init__.py", "pathway_tpu.stdlib.graphs"),
+        ("stdlib/stateful/__init__.py", "pathway_tpu.stdlib.stateful"),
+        ("xpacks/llm/embedders.py", "pathway_tpu.xpacks.llm.embedders"),
+        ("xpacks/llm/llms.py", "pathway_tpu.xpacks.llm.llms"),
+        ("xpacks/llm/rerankers.py", "pathway_tpu.xpacks.llm.rerankers"),
+        ("xpacks/llm/parsers.py", "pathway_tpu.xpacks.llm.parsers"),
+        ("xpacks/llm/splitters.py", "pathway_tpu.xpacks.llm.splitters"),
+        ("io/__init__.py", "pathway_tpu.io"),
+    ],
+)
+def test_reference_submodule_surface_exists(ref_rel, mod):
+    import importlib
+
+    path = f"/root/reference/python/pathway/{ref_rel}"
+    if not os.path.exists(path):
+        pytest.skip("reference checkout not available")
+    names = _ref_module_all(path)
+    m = importlib.import_module(mod)
+    missing = [n for n in names if not hasattr(m, n)]
+    assert not missing, f"{mod} missing: {missing}"
+
+
+def test_metric_kind_enums_accepted():
+    from pathway_tpu.stdlib import indexing as idx
+
+    t = _pets()
+    knn = idx.BruteForceKnn(
+        t.age, None, dimensions=4,
+        metric=idx.BruteForceKnnMetricKind.L2SQ,
+    )
+    assert knn.metric == "l2sq"
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # USearchKnn exact-alias warning
+        uk = idx.USearchKnn(
+            t.age, None, dimensions=4, metric=idx.USearchMetricKind.COS
+        )
+    assert uk.metric == "cos"
+
+
 def _pets():
     return T(
         """
@@ -65,6 +170,19 @@ def test_table_slice_manipulation():
     res = t.select(*t.slice.without("age"))
     _, cols = _capture_rows(res)
     assert cols == ["owner", "pet"]
+
+
+def test_table_slice_method_name_columns_need_brackets():
+    t = T(
+        """
+        filter | v
+        a      | 1
+        """
+    )
+    sl = t.slice
+    with pytest.raises(ValueError, match="method name"):
+        sl.filter  # noqa: B018 — collides with Table.filter
+    assert sl["filter"]._name == "filter"
 
 
 def test_table_slice_rejects_foreign_refs():
@@ -139,6 +257,107 @@ def test_type_and_persistence_mode_aliases():
     assert pw.Type is PathwayType
     assert pw.PersistenceMode is not None
     assert pw.UDFSync is not None and pw.UDFAsync is not None
+
+
+def test_remove_errors_filters_bad_rows():
+    t = T(
+        """
+        a | b
+        3 | 3
+        4 | 0
+        6 | 2
+        """
+    )
+    t2 = t.with_columns(x=pw.this.a // pw.this.b)
+    rows, cols = _capture_rows(t2.remove_errors())
+    got = sorted(map(tuple, rows.values()))
+    assert got == [(3, 3, 1), (6, 2, 3)], got
+
+
+def test_table_to_and_eval_type(tmp_path):
+    import json
+
+    t = _pets()
+    out = tmp_path / "o.jsonl"
+    # Table.to with a callable sink (our pw.io writers are functions)
+    t.to(lambda table: pw.io.jsonlines.write(table, str(out)))
+    pw.run()
+    assert len(list(open(out))) == 3
+    from pathway_tpu.internals import dtype as dt
+
+    assert t.eval_type(t.age) is dt.INT
+    assert t.eval_type(t.age + 1.5) is dt.FLOAT
+    with pytest.raises(TypeError, match="sink"):
+        t.to(42)
+
+
+def test_update_id_type_and_join_keys():
+    t1, t2 = _pets(), _pets()
+    u = t1.update_id_type(int)
+    from pathway_tpu.internals import dtype as dt
+
+    assert u.eval_type(u.id) == dt.wrap(int)
+    # the override propagates to derived tables (it rides the universe)...
+    f = u.filter(u.age > 8)
+    assert f.eval_type(f.id) == dt.wrap(int)
+    # ...but never back to the source table
+    assert t1.eval_type(t1.id) != dt.wrap(int)
+    jr = t1.join(t2, pw.left.owner == pw.right.owner)
+    assert "owner" in jr.keys() and "age" in jr.keys()
+
+
+def test_reducers_int_sum_deprecated_alias():
+    t = _pets()
+    with pytest.warns(UserWarning, match="deprecated"):
+        red = pw.reducers.int_sum(t.age)
+    rows, _ = _capture_rows(t.reduce(s=red))
+    assert list(rows.values())[0][0] == 27
+
+
+def test_udfs_with_combinators():
+    import asyncio
+
+    calls = {"n": 0, "live": 0, "peak": 0}
+
+    async def work(x):
+        calls["live"] += 1
+        calls["peak"] = max(calls["peak"], calls["live"])
+        await asyncio.sleep(0.01)
+        calls["live"] -= 1
+        return x * 2
+
+    capped = pw.udfs.with_capacity(work, 2)
+    out = asyncio.run(
+        _gather(*[capped(i) for i in range(6)])
+    )
+    assert out == [0, 2, 4, 6, 8, 10] and calls["peak"] <= 2
+
+    async def slow(x):
+        await asyncio.sleep(1.0)
+        return x
+
+    timed = pw.udfs.with_timeout(slow, 0.05)
+    with pytest.raises(asyncio.TimeoutError):
+        asyncio.run(timed(1))
+
+    attempts = {"n": 0}
+
+    async def flaky(x):
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("transient")
+        return x
+
+    retried = pw.udfs.with_retry_strategy(
+        flaky, pw.udfs.FixedDelayRetryStrategy(max_retries=5, delay_ms=1)
+    )
+    assert asyncio.run(retried(7)) == 7 and attempts["n"] == 3
+
+
+async def _gather(*aws):
+    import asyncio
+
+    return list(await asyncio.gather(*aws))
 
 
 def test_enable_interactive_mode_controller():
