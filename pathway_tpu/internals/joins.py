@@ -246,6 +246,9 @@ class JoinResult:
         jr._aliases = amap
         return jr
 
+    def join_inner(self, other, *on, **kw):
+        return self.join(other, *on, how="inner", **kw)
+
     def join_left(self, other, *on, **kw):
         return self.join(other, *on, how="left", **kw)
 

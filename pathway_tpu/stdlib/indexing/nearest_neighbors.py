@@ -36,10 +36,10 @@ class BruteForceKnnMetricKind(enum.Enum):
 
 
 class USearchMetricKind(enum.Enum):
-    """Reference ``engine.pyi:871``. On TPU only IP/L2SQ/COS map to the
-    dense kernels; the exotic uSearch metrics normalize to COS with a
-    warning at index construction (USearchKnn already warns that it
-    aliases the exact index)."""
+    """Reference ``engine.pyi:871``. On TPU only L2SQ and COS map to the
+    dense kernels; every other uSearch metric (including IP) falls back to
+    cosine over unit-normalized vectors, with a warning at index
+    construction (for unit vectors IP and COS rank identically)."""
 
     IP = "ip"
     L2SQ = "l2sq"

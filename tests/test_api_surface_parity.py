@@ -19,7 +19,8 @@ STALE_REFERENCE_EXPORTS = {"window"}
 
 def test_every_reference_export_exists():
     try:
-        src = open(REFERENCE_INIT).read()
+        with open(REFERENCE_INIT) as f:
+            src = f.read()
     except OSError:
         pytest.skip("reference checkout not available")
     ref_all = None
@@ -38,7 +39,8 @@ def test_every_reference_export_exists():
 
 
 def _public_defs(path, classname=None):
-    tree = ast.parse(open(path).read())
+    with open(path) as f:
+        tree = ast.parse(f.read())
     if classname is not None:
         for node in ast.walk(tree):
             if isinstance(node, ast.ClassDef) and node.name == classname:
@@ -80,12 +82,13 @@ def test_reference_methods_exist(ref_path, classname, ours):
 
 
 def _ref_module_all(path):
-    for node in ast.walk(ast.parse(open(path).read())):
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
             for t in node.targets:
                 if getattr(t, "id", None) == "__all__":
                     return [ast.literal_eval(e) for e in node.value.elts]
-    tree = ast.parse(open(path).read())
     return [
         n.name
         for n in tree.body
@@ -213,11 +216,17 @@ def test_join_mode_enum_and_free_functions():
         (pw.join_outer, pw.OuterJoinResult),
     ]:
         assert isinstance(fn(t1, t2, t1.owner == t2.owner), mode)
-    # chained joins carry the typing too
+    # chained joins carry the typing too, and every join_* method exists
+    # on a JoinResult operand (so the free functions can delegate)
     chained = t1.join(t2, t1.owner == t2.owner).join_outer(
         _pets(), pw.left.owner == pw.right.owner
     )
     assert isinstance(chained, pw.OuterJoinResult)
+    inner_chain = pw.join_inner(
+        t1.join(t2, t1.owner == t2.owner), _pets(),
+        pw.left.owner == pw.right.owner,
+    )
+    assert type(inner_chain) is pw.JoinResult
 
 
 def test_free_groupby_and_grouped_join_result():
