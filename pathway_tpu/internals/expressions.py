@@ -57,8 +57,10 @@ class StringNamespace(_Namespace):
     def endswith(self, suffix):
         return self._call("str.endswith", smart_coerce(suffix), return_type=dt.BOOL)
 
-    def swap_case(self):
+    def swapcase(self):
         return self._call("str.swapcase", return_type=dt.STR)
+
+    swap_case = swapcase  # pre-r3 spelling kept for compatibility
 
     def title(self):
         return self._call("str.title", return_type=dt.STR)

@@ -28,6 +28,37 @@ from pathway_tpu.internals.universe import Universe
 
 
 class GroupedTable:
+    @classmethod
+    def create(
+        cls,
+        table,
+        grouping_columns,
+        last_column_is_instance: bool = False,
+        set_id: bool = False,
+        sort_by=None,
+        _filter_out_results_of_forgetting: bool = False,
+        _skip_errors: bool = True,
+        _is_window: bool = False,
+    ) -> "GroupedTable":
+        """Mirror of the reference constructor (``GroupedTable.create``,
+        groupbys.py:119) for code ported from it; our own windowby path
+        builds grouped tables directly. When ``last_column_is_instance``
+        the trailing column is BOTH a grouping column (so ``reduce`` may
+        reference it, as in the reference) and the instance routing
+        column."""
+        if _skip_errors is not True or _is_window or _filter_out_results_of_forgetting:
+            import warnings
+
+            warnings.warn(
+                "GroupedTable.create: _skip_errors/_is_window/"
+                "_filter_out_results_of_forgetting are accepted for "
+                "reference parity but not modeled here",
+                stacklevel=2,
+            )
+        grouping = list(grouping_columns)
+        instance = grouping[-1] if last_column_is_instance else None
+        return cls(table, grouping, instance, by_id=set_id, sort_by=sort_by)
+
     def __init__(self, table, grouping: list, instance=None, by_id: bool = False,
                  sort_by=None):
         from pathway_tpu.internals.table import Table

@@ -66,8 +66,17 @@ def _public_defs(path, classname=None):
         ("internals/joins.py", "JoinResult", lambda: pw.JoinResult),
         ("internals/expression.py", "ColumnExpression",
          lambda: pw.ColumnExpression),
+        ("internals/schema.py", "Schema", lambda: pw.Schema),
+        ("internals/groupbys.py", "GroupedTable", lambda: pw.GroupedTable),
+        ("internals/expressions/date_time.py", "DateTimeNamespace",
+         lambda: T("a\n1").a.dt),
+        ("internals/expressions/string.py", "StringNamespace",
+         lambda: T("a\n1").a.str),
+        ("internals/expressions/numerical.py", "NumericalNamespace",
+         lambda: T("a\n1").a.num),
     ],
-    ids=["Table", "JoinResult", "ColumnExpression"],
+    ids=["Table", "JoinResult", "ColumnExpression", "Schema",
+         "GroupedTable", "dt", "str", "num"],
 )
 def test_reference_methods_exist(ref_path, classname, ours):
     try:

@@ -155,6 +155,44 @@ def test_tumbling_window_equivalence(seed):
 
 
 @pytest.mark.parametrize("seed", SEEDS)
+def test_interval_join_equivalence(seed):
+    """Interval join under random epoch partitioning — late rows on either
+    side must retract/emit exactly the matches a batch run produces."""
+    _check(
+        lambda t1, t2: pw.temporal.interval_join(
+            t1, t2, t1.v, t2.v, pw.temporal.interval(-2, 2)
+        ).select(k1=pw.left.k, k2=pw.right.k, tl=pw.left.v, tr=pw.right.v),
+        seed,
+        n=25,
+        two_tables=True,
+    )
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_asof_join_equivalence(seed):
+    """Asof join: the 'current best match' changes as rows stream in; the
+    final state must still equal the batch answer."""
+    _check(
+        lambda t1, t2: pw.temporal.asof_join(
+            t1, t2, t1.v, t2.v, t1.k == t2.k, direction="backward"
+        ).select(k=pw.left.k, tl=pw.left.v, tr=pw.right.v),
+        seed,
+        n=25,
+        two_tables=True,
+    )
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_session_window_equivalence(seed):
+    _check(
+        lambda t: t.windowby(
+            t.v, window=pw.temporal.session(max_gap=2)
+        ).reduce(n=pw.reducers.count(), s=pw.reducers.sum(pw.this.v)),
+        seed,
+    )
+
+
+@pytest.mark.parametrize("seed", SEEDS)
 def test_filter_groupby_join_chain_equivalence(seed):
     def build(t1, t2):
         agg = t1.groupby(t1.k).reduce(t1.k, s=pw.reducers.sum(t1.v))
