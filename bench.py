@@ -139,14 +139,18 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
         return jax.device_put(ids), jax.device_put(m)
 
     def ingest(b: int, dev):
+        # fused embed+append: ONE dispatch per batch (was two)
         dev_ids, dev_mask = dev
-        emb = embed_fn(params, dev_ids, dev_mask, cfg)
-        index.add_device([f"d{b}_{i}" for i in range(BATCH)], emb)
-        return emb
+        return index.add_embed(
+            [f"d{b}_{i}" for i in range(BATCH)],
+            params, dev_ids, dev_mask, cfg, embed_fn,
+        )
 
-    # warmup: compile embed, append, search
+    # warmup: compile the fused ingest, the STANDALONE embed (the embed-only
+    # diag below uses it; ingest no longer does), append, and search
     emb = ingest(0, tokenize(0))
     index.search(np.asarray(emb[:8]), k=TOP_K)
+    jax.device_get(embed_fn(params, *tokenize(0), cfg)[:1, :1])
     jax.device_get(emb[:1, :1])
 
     # per-phase diagnostics (each timed with ONE device_get sync; on a

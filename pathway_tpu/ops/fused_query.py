@@ -159,10 +159,19 @@ class FusedRAGPipeline:
         if not keys:
             return
         start = self.index.n
-        # full-precision device path: the vectors never leave HBM, so skip
-        # the f16 transport cast embed_submit applies for host fetches
-        (emb, n) = self.embedder.embed_device(list(texts))
-        self.index.add_device(keys, emb[:n])
+        # fused embed+append: one dispatch from token ids to corpus rows
+        # (the vectors never leave HBM; no transport cast, no separate
+        # append enqueue)
+        from pathway_tpu.models.embedder import embed_fn
+        from pathway_tpu.models.tokenizer import pad_to_buckets
+
+        m = self.embedder
+        ids, mask = m.tokenizer(list(texts), max_length=m.max_length)
+        ids, mask = pad_to_buckets(ids, mask)
+        self.index.add_embed(
+            keys, m.params, jnp.asarray(ids), jnp.asarray(mask), m.cfg,
+            embed_fn,
+        )
         if self.index.capacity != self._doc_tokens.shape[0]:
             grow = self.index.capacity - self._doc_tokens.shape[0]
             self._doc_tokens = jnp.pad(self._doc_tokens, ((0, grow), (0, 0)))

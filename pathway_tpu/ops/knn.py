@@ -94,6 +94,19 @@ def _search_kernel(corpus, valid_mask, queries, k: int, metric: str,
     return topk_scores(knn_scores(corpus, valid_mask, q, metric), k)
 
 
+def _write_rows(corpus, valid, n_dev, v, m):
+    """Shared in-kernel append body: write ``v`` (f32, already normalized
+    as required) at the device cursor, mark the first ``m`` rows valid,
+    advance the cursor by ``m``. Both append kernels trace through this so
+    the write/cursor invariant has exactly one home."""
+    vmask = jnp.arange(v.shape[0]) < m
+    corpus = jax.lax.dynamic_update_slice(
+        corpus, v.astype(corpus.dtype), (n_dev, 0)
+    )
+    valid = jax.lax.dynamic_update_slice(valid, vmask, (n_dev,))
+    return corpus, valid, n_dev + m
+
+
 @functools.partial(
     jax.jit, donate_argnums=(0, 1, 2), static_argnames=("normalize",)
 )
@@ -112,13 +125,22 @@ def _append_kernel(corpus, valid, n_dev, v, m, normalize: bool):
     v = v.astype(jnp.float32)
     if normalize:
         v = _normalize(v)
-    vmask = jnp.arange(v.shape[0]) < m  # derived in-kernel: no extra h2d
-    start = n_dev
-    corpus = jax.lax.dynamic_update_slice(
-        corpus, v.astype(corpus.dtype), (start, 0)
-    )
-    valid = jax.lax.dynamic_update_slice(valid, vmask, (start,))
-    return corpus, valid, n_dev + m
+    return _write_rows(corpus, valid, n_dev, v, m)
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0, 1, 2), static_argnames=("embed", "cfg")
+)
+def _embed_append_kernel(corpus, valid, n_dev, params, ids, mask, m, *,
+                         embed, cfg):
+    """Embed + append in ONE dispatch: token ids go in, corpus rows come
+    out, and the (normalized) embeddings are returned for queries riding
+    the stream. On a relayed chip every dispatch enqueue pays tunnel
+    latency, so halving the per-batch dispatch count matters as much as
+    the kernels themselves."""
+    emb = embed(params, ids, mask, cfg)  # (B, d) f32, unit-normalized
+    corpus, valid, n_dev = _write_rows(corpus, valid, n_dev, emb, m)
+    return corpus, valid, n_dev, emb
 
 
 _M_SCALARS: dict[int, Any] = {}
@@ -204,10 +226,7 @@ class BruteForceKnnIndex:
             self._corpus, self._valid, self._n_dev, v,
             _m_scalar(m), normalize=normalize,
         )
-        for i, key in enumerate(keys):
-            self._slot_of[key] = start + i
-            self._keys.append(key)
-        self.n += m
+        self._record_keys(keys, start)
 
     def add(self, keys: list, vectors: np.ndarray) -> None:
         if not keys:
@@ -223,6 +242,54 @@ class BruteForceKnnIndex:
         if v.ndim == 1:
             v = v[None, :]
         self._append(keys, v, normalize=self.metric == "cos")
+
+    def _record_keys(self, keys: list, start: int) -> None:
+        """Host-side half of an append: key -> slot bookkeeping (one home
+        for both the plain and the fused ingest paths)."""
+        for i, key in enumerate(keys):
+            self._slot_of[key] = start + i
+            self._keys.append(key)
+        self.n += len(keys)
+
+    def add_embed(self, keys: list, params, input_ids, attention_mask,
+                  cfg, embed):
+        """Fastest ingest path: embed the tokenized batch AND append the
+        vectors in one fused dispatch (see ``_embed_append_kernel``).
+        ``embed(params, ids, mask, cfg)`` must return unit-normalized
+        (rows, d) float32 — e.g. ``models.embedder.embed_fn``. Returns the
+        embeddings (device array) for downstream queries.
+
+        The write covers ALL ``input_ids.shape[0]`` token rows (pad rows
+        land beyond the cursor, valid=False, and are overwritten by the
+        next append), so capacity must fit ``n + rows``. Size
+        ``reserved_space`` with one token-bucket of headroom: growing here
+        for transient pad rows recompiles every capacity-shaped kernel
+        mid-stream — hence the warning."""
+        m = len(keys)
+        if m == 0:
+            return None
+        rows = input_ids.shape[0]
+        if rows < m:
+            raise ValueError(f"{m} keys but only {rows} token rows")
+        if self.n + rows > self.capacity:
+            import warnings
+
+            warnings.warn(
+                f"add_embed growing capacity ({self.capacity} -> fit "
+                f"{self.n + rows}) for a padded batch; every "
+                f"capacity-shaped kernel recompiles. Size reserved_space "
+                f"with one token-bucket of headroom to avoid this.",
+                stacklevel=2,
+            )
+            self._grow(self.n + rows)
+        start = self.n
+        self._corpus, self._valid, self._n_dev, emb = _embed_append_kernel(
+            self._corpus, self._valid, self._n_dev,
+            params, input_ids, attention_mask, _m_scalar(m),
+            embed=embed, cfg=cfg,
+        )
+        self._record_keys(keys, start)
+        return emb
 
     def remove(self, keys: list) -> None:
         for key in keys:

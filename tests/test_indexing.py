@@ -305,3 +305,56 @@ def test_ivf_pretrain_remove_readd_no_duplicates():
     # and it's the v2 copy: querying v2 scores "k" near 1.0
     score_k = dict(row)["k"]
     assert score_k > 0.9
+
+
+def test_add_embed_fused_matches_two_step():
+    """The one-dispatch embed+append path must be indistinguishable from
+    embed_fn followed by add_device (corpus, validity, returned vectors,
+    and search results)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.embedder import embed_fn
+    from pathway_tpu.models.transformer import TransformerConfig, init_params
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    cfg = TransformerConfig(
+        layers=2, hidden=32, heads=4, intermediate=64, vocab_size=100,
+        dtype=jnp.float32,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.array(rng.integers(0, 100, (16, 24)), jnp.int32)
+    mask = jnp.ones((16, 24), jnp.int32)
+    keys = [f"k{i}" for i in range(16)]
+
+    # f32 corpora: comparing bf16 corpora at tight atol would flake — the
+    # two paths run different executables and the two-step one
+    # re-normalizes (a ~1e-7 perturbation that can flip a bf16 rounding)
+    two = BruteForceKnnIndex(
+        dimensions=32, reserved_space=64, metric="cos", dtype=jnp.float32
+    )
+    emb = embed_fn(params, ids, mask, cfg)
+    two.add_device(keys, emb)
+
+    fused = BruteForceKnnIndex(
+        dimensions=32, reserved_space=64, metric="cos", dtype=jnp.float32
+    )
+    emb2 = fused.add_embed(keys, params, ids, mask, cfg, embed_fn)
+
+    assert np.allclose(
+        np.asarray(two._corpus), np.asarray(fused._corpus), atol=1e-5
+    )
+    assert np.array_equal(np.asarray(two._valid), np.asarray(fused._valid))
+    assert np.allclose(np.asarray(emb), np.asarray(emb2), atol=1e-6)
+    q = np.asarray(emb[:3])
+    for row_a, row_b in zip(two.search(q, k=4), fused.search(q, k=4)):
+        assert [k for k, _ in row_a] == [k for k, _ in row_b]
+        assert np.allclose(
+            [s for _, s in row_a], [s for _, s in row_b], atol=1e-5
+        )
+    # second fused append continues at the cursor
+    ids2 = jnp.array(rng.integers(0, 100, (16, 24)), jnp.int32)
+    fused.add_embed([f"m{i}" for i in range(16)], params, ids2, mask, cfg,
+                    embed_fn)
+    assert fused.n == 32 and int(np.asarray(fused._valid).sum()) == 32
