@@ -44,7 +44,7 @@ def _build_path() -> str:
 def _compile(out_path: str) -> bool:
     include = sysconfig.get_paths()["include"]
     cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++20",
         f"-I{include}", _SRC, "-o", out_path,
     ]
     try:

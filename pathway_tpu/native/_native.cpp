@@ -444,10 +444,31 @@ static PyObject* py_hash_tokenize(PyObject*, PyObject* args) {
 // indices for the Python path (Unicode NFD accent stripping / case
 // folding). Parity with transformers.BertTokenizer is pinned by test.
 
+#include <string_view>
 #include <unordered_map>
 
+// transparent hashing: greedy longest-match probes are substrings of the
+// word buffer, looked up as string_views with ZERO per-probe allocations
+// (the old per-probe "##"+substr std::string construction dominated the
+// single-core tokenizer profile)
+struct SvHash {
+  using is_transparent = void;
+  size_t operator()(std::string_view s) const noexcept {
+    return std::hash<std::string_view>{}(s);
+  }
+  size_t operator()(const std::string& s) const noexcept {
+    return std::hash<std::string_view>{}(s);
+  }
+};
+
+using WpMap =
+    std::unordered_map<std::string, int32_t, SvHash, std::equal_to<>>;
+
 struct WordPieceVocab {
-  std::unordered_map<std::string, int32_t> map;
+  // word_start also answers single-char punctuation lookups (a 1-char
+  // token can never start with "##")
+  WpMap word_start;   // tokens NOT starting with "##"
+  WpMap word_suffix;  // tokens starting with "##", stored WITHOUT the "##"
 };
 static std::vector<WordPieceVocab*> g_wp_vocabs;
 
@@ -460,7 +481,8 @@ static PyObject* py_wordpiece_load(PyObject*, PyObject* args) {
   Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
   PyObject** items = PySequence_Fast_ITEMS(fast);
   auto* vocab = new WordPieceVocab();
-  vocab->map.reserve((size_t)n * 2);
+  vocab->word_start.reserve((size_t)n);
+  vocab->word_suffix.reserve((size_t)n);
   for (Py_ssize_t i = 0; i < n; i++) {
     Py_ssize_t slen;
     const char* s = PyUnicode_AsUTF8AndSize(items[i], &slen);
@@ -471,7 +493,12 @@ static PyObject* py_wordpiece_load(PyObject*, PyObject* args) {
     }
     // assignment (not emplace): duplicate tokens keep the LAST id, matching
     // dict comprehension / HF vocab-load semantics
-    vocab->map[std::string(s, (size_t)slen)] = (int32_t)i;
+    std::string tok(s, (size_t)slen);
+    if (slen >= 2 && s[0] == '#' && s[1] == '#') {
+      vocab->word_suffix[tok.substr(2)] = (int32_t)i;
+    } else {
+      vocab->word_start[tok] = (int32_t)i;
+    }
   }
   Py_DECREF(fast);
   // reuse a freed slot before growing the registry
@@ -502,7 +529,8 @@ static inline bool wp_is_punct(unsigned char c) {
          (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
 }
 
-// greedy longest-match of one lowercased ASCII word into piece ids
+// greedy longest-match of one lowercased ASCII word into piece ids;
+// probes are string_views into the word buffer — no allocations
 static void wp_word(const WordPieceVocab& v, const std::string& w,
                     int32_t unk_id, std::vector<int32_t>& out) {
   if (w.size() > 200) {  // BERT max_input_chars_per_word
@@ -511,15 +539,13 @@ static void wp_word(const WordPieceVocab& v, const std::string& w,
   }
   size_t start = 0;
   std::vector<int32_t> pieces;
-  std::string probe;
   while (start < w.size()) {
+    const WpMap& m = start ? v.word_suffix : v.word_start;
     size_t end = w.size();
     int32_t id = -1;
     while (end > start) {
-      probe.assign(start ? "##" : "");
-      probe.append(w, start, end - start);
-      auto it = v.map.find(probe);
-      if (it != v.map.end()) {
+      auto it = m.find(std::string_view(w.data() + start, end - start));
+      if (it != m.end()) {
         id = it->second;
         break;
       }
@@ -608,10 +634,11 @@ static PyObject* py_wordpiece_tokenize(PyObject*, PyObject* args) {
             word.clear();
           }
           if (wp_is_punct(c)) {
-            std::string p(1, (char)c);
-            auto it = vocab.map.find(p);
-            pieces.push_back(it != vocab.map.end() ? it->second
-                                                   : (int32_t)unk_id);
+            char pc = (char)c;
+            auto it = vocab.word_start.find(std::string_view(&pc, 1));
+            pieces.push_back(it != vocab.word_start.end()
+                                 ? it->second
+                                 : (int32_t)unk_id);
           }
         } else {
           word.push_back((char)lc);

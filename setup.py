@@ -13,7 +13,11 @@ setup(
         Extension(
             "pathway_tpu.native._native",
             sources=["pathway_tpu/native/_native.cpp"],
-            extra_compile_args=["-O3", "-std=c++17"],
+            # c++20 floor (g++ >= 11): the WordPiece probe path uses
+            # transparent unordered_map::find(string_view) (P0919). On
+            # older toolchains the optional extension simply doesn't build
+            # and the Python fallbacks take over.
+            extra_compile_args=["-O3", "-std=c++20"],
             optional=True,
         )
     ],
