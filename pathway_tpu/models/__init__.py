@@ -33,8 +33,11 @@ from pathway_tpu.models.bpe import BPETokenizer
 from pathway_tpu.models.tokenizer import HashTokenizer, load_tokenizer
 from pathway_tpu.models.train import (
     contrastive_loss,
-    make_train_step,
+    init_decoder_train_state,
     init_train_state,
+    lm_loss,
+    make_decoder_train_step,
+    make_train_step,
 )
 
 __all__ = [
@@ -58,6 +61,9 @@ __all__ = [
     "contrastive_loss",
     "make_train_step",
     "init_train_state",
+    "lm_loss",
+    "init_decoder_train_state",
+    "make_decoder_train_step",
     "MoEConfig",
     "init_moe_params",
     "moe_ffn",

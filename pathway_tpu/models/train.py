@@ -56,16 +56,62 @@ def init_train_state(rng, cfg: TransformerConfig,
     return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32)), tx
 
 
-def make_train_step(cfg: TransformerConfig, tx, temperature: float = 0.05):
-    """Returns train_step(state, batch) -> (state, loss). Jit it (optionally
-    with in/out shardings) at the call site."""
+def _make_step(loss_fn, tx):
+    """Shared optimiser step: value_and_grad(loss_fn) -> tx.update ->
+    apply_updates. Both training objectives (contrastive encoder, causal
+    LM) go through here so optimizer-step changes have one home."""
 
     def train_step(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(contrastive_loss)(
-            state.params, batch, cfg, temperature
-        )
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
 
     return train_step
+
+
+def make_train_step(cfg: TransformerConfig, tx, temperature: float = 0.05):
+    """Returns train_step(state, batch) -> (state, loss). Jit it (optionally
+    with in/out shardings) at the call site."""
+    return _make_step(
+        lambda params, batch: contrastive_loss(params, batch, cfg, temperature),
+        tx,
+    )
+
+
+# ------------------------------------------------------------- decoder LM
+
+
+def lm_loss(params, batch, cfg):
+    """Next-token cross-entropy for the causal decoder
+    (``models/decoder.py``). ``batch``: ids (B, S) with mask (B, S); the
+    loss averages over positions whose TARGET is a real token, so padding
+    never contributes. Same masking/position conventions as
+    ``decoder.forward`` (left- or right-padded both work)."""
+    from pathway_tpu.models import decoder as decoder_mod
+
+    ids, mask = batch["ids"], batch["mask"]
+    logits = decoder_mod.forward(params, ids, mask, cfg)  # (B, S, V) f32
+    targets = ids[:, 1:]
+    tmask = (mask[:, 1:] * mask[:, :-1]).astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1, :], targets
+    )
+    return jnp.sum(ce * tmask) / jnp.clip(jnp.sum(tmask), 1.0, None)
+
+
+def init_decoder_train_state(rng, cfg, learning_rate: float = 3e-4):
+    from pathway_tpu.models import decoder as decoder_mod
+
+    params = decoder_mod.init_params(rng, cfg)
+    tx = optax.adamw(learning_rate)
+    return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32)), tx
+
+
+def make_decoder_train_step(cfg, tx):
+    """Returns train_step(state, batch) -> (state, loss) for the causal
+    LM objective; jit with dp/tp shardings at the call site (params under
+    ``decoder.param_partition_specs``, batch sharded on dp)."""
+    return _make_step(
+        lambda params, batch: lm_loss(params, batch, cfg), tx
+    )
