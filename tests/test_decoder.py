@@ -97,18 +97,10 @@ def test_chat_udf_temperature_samples_across_calls(tiny_params):
     (the key folds in a per-call counter), so repeated identical prompts
     are not byte-identical replays."""
     from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
-
-    class ToyTok:
-        eos_id = None
-
-        def encode(self, text):
-            return [ord(c) % 96 + 1 for c in text][:16]
-
-        def decode(self, ids):
-            return "".join(chr((int(i) - 1) % 96 + 32) for i in ids)
+    from tests.utils import ToyCharTokenizer
 
     chat = TPUDecoderChat(
-        params=tiny_params, cfg=TINY, tokenizer=ToyTok(),
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(),
         max_new_tokens=8, temperature=1.5,
     )
     outs = {tuple(chat.__wrapped__(["same prompt"])) for _ in range(4)}
@@ -336,19 +328,10 @@ def test_tpu_decoder_chat_udf_end_to_end(tiny_params):
     """TPUDecoderChat through a real pipeline: prompts table -> batched
     decode UDF -> completions, greedy = reproducible."""
     from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
-
-    class ToyTok:
-        eos_id = None
-        vocab_size = TINY.vocab_size
-
-        def encode(self, text):
-            return [ord(c) % 96 + 1 for c in text][:16]
-
-        def decode(self, ids):
-            return "".join(chr((i - 1) % 96 + 32) for i in ids)
+    from tests.utils import ToyCharTokenizer
 
     chat = TPUDecoderChat(
-        params=tiny_params, cfg=TINY, tokenizer=ToyTok(),
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(),
         max_new_tokens=4,
     )
     pw.clear_graph()

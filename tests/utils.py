@@ -65,3 +65,19 @@ def run_all_and_collect(table) -> list[tuple]:
 
 
 T = pw.debug.table_from_markdown
+
+
+class ToyCharTokenizer:
+    """Minimal invertible char-level tokenizer for decoder tests (ids in
+    [1, 96], 1 char per token)."""
+
+    eos_id = None
+
+    def __init__(self, max_len: int = 16):
+        self.max_len = max_len
+
+    def encode(self, text):
+        return [ord(c) % 96 + 1 for c in text][: self.max_len]
+
+    def decode(self, ids):
+        return "".join(chr((int(i) - 1) % 96 + 32) for i in ids)
