@@ -119,6 +119,17 @@ def _ref_module_all(path):
         ("xpacks/llm/rerankers.py", "pathway_tpu.xpacks.llm.rerankers"),
         ("xpacks/llm/parsers.py", "pathway_tpu.xpacks.llm.parsers"),
         ("xpacks/llm/splitters.py", "pathway_tpu.xpacks.llm.splitters"),
+        ("xpacks/llm/servers.py", "pathway_tpu.xpacks.llm.servers"),
+        ("xpacks/llm/question_answering.py",
+         "pathway_tpu.xpacks.llm.question_answering"),
+        ("xpacks/llm/document_store.py",
+         "pathway_tpu.xpacks.llm.document_store"),
+        ("xpacks/llm/vector_store.py", "pathway_tpu.xpacks.llm.vector_store"),
+        ("persistence/__init__.py", "pathway_tpu.persistence"),
+        ("stdlib/utils/async_transformer.py",
+         "pathway_tpu.stdlib.utils.async_transformer"),
+        ("stdlib/statistical/__init__.py", "pathway_tpu.stdlib.statistical"),
+        ("stdlib/ordered/__init__.py", "pathway_tpu.stdlib.ordered"),
         ("io/__init__.py", "pathway_tpu.io"),
     ],
 )
