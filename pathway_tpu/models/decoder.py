@@ -10,10 +10,11 @@ a relayed chip that is the difference between one RTT per answer and one
 RTT per token.
 
 Design mirrors ``models/transformer.py`` (the encoder): functional param
-pytrees, layers stacked on a leading axis and driven by ``lax.scan``, bf16
-compute with f32 accumulation (``preferred_element_type``), and Megatron-
-style tensor-parallel ``PartitionSpec``s so the same forward runs 1-chip or
-sharded. The layout is HF-GPT-2-compatible (pre-LN blocks, learned
+pytrees, layers stacked on a leading axis and driven by ``lax.scan``,
+compute-dtype matmul outputs/bias/gelu/residuals (attention scores, the
+probs@v accumulation, layernorm statistics, and logits stay f32), and
+Megatron-style tensor-parallel ``PartitionSpec``s so the same forward runs
+1-chip or sharded. The layout is HF-GPT-2-compatible (pre-LN blocks, learned
 positions, tanh-approximate gelu, weight-tied LM head); weights load via
 ``checkpoint.params_from_hf_gpt2`` and logits-parity against transformers
 is pinned by ``tests/test_decoder.py``.
@@ -136,37 +137,43 @@ def _block(x, lp, k, v, mask_bias, cfg: DecoderConfig):
     The caller owns the KV source — the in-sequence keys for prefill, the
     cache for decode — so prefill and decode share one block body and
     cannot diverge numerically."""
+    # matmul outputs / bias / gelu / residuals stay in cfg.dtype (the MXU
+    # accumulates f32 internally; attention SCORES and layernorm statistics
+    # stay f32) — same HBM-traffic optimization as the encoder's _layer,
+    # bit-unchanged for f32 configs
     B, S, H = x.shape
     nh, hd = cfg.heads, cfg.head_dim
     h1 = _ln(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
     qkv = jnp.einsum("bsh,hk->bsk", h1.astype(cfg.dtype),
                      lp["qkv_w"].astype(cfg.dtype),
-                     preferred_element_type=jnp.float32)
-    qkv = qkv + lp["qkv_b"].astype(jnp.float32)
+                     preferred_element_type=cfg.dtype)
+    qkv = qkv + lp["qkv_b"].astype(cfg.dtype)
     q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
-    q = _split_heads(q.astype(cfg.dtype), nh, hd)
+    q = _split_heads(q, nh, hd)
     scores = jnp.einsum("bnqd,bnkd->bnqk", q, k.astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
     scores = scores / math.sqrt(hd) + mask_bias
     probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+    # the weighted-sum over up to cache_len values keeps GUARANTEED f32
+    # accumulation (same as the encoder's explicit-softmax path) — with a
+    # bf16 preference some backends may use bf16 partial sums
     ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, v.astype(cfg.dtype),
                      preferred_element_type=jnp.float32).astype(cfg.dtype)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H)
     attn = jnp.einsum("bsh,hk->bsk", ctx, lp["attn_out_w"].astype(cfg.dtype),
-                      preferred_element_type=jnp.float32)
-    x = x.astype(jnp.float32) + attn + lp["attn_out_b"].astype(jnp.float32)
+                      preferred_element_type=cfg.dtype)
+    x = x + attn + lp["attn_out_b"].astype(cfg.dtype)
     h2 = _ln(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)
     m = jnp.einsum("bsh,hi->bsi", h2.astype(cfg.dtype),
                    lp["mlp_in_w"].astype(cfg.dtype),
-                   preferred_element_type=jnp.float32)
+                   preferred_element_type=cfg.dtype)
     # gelu_new (tanh approximation) — what GPT-2 checkpoints are trained with
-    m = jax.nn.gelu(m + lp["mlp_in_b"].astype(jnp.float32), approximate=True)
-    m = jnp.einsum("bsi,ih->bsh", m.astype(cfg.dtype),
-                   lp["mlp_out_w"].astype(cfg.dtype),
-                   preferred_element_type=jnp.float32)
-    x = x + m + lp["mlp_out_b"].astype(jnp.float32)
-    return x.astype(cfg.dtype), _split_heads(k_new.astype(cfg.dtype), nh, hd), \
-        _split_heads(v_new.astype(cfg.dtype), nh, hd)
+    m = jax.nn.gelu(m + lp["mlp_in_b"].astype(cfg.dtype), approximate=True)
+    m = jnp.einsum("bsi,ih->bsh", m, lp["mlp_out_w"].astype(cfg.dtype),
+                   preferred_element_type=cfg.dtype)
+    x = x + m + lp["mlp_out_b"].astype(cfg.dtype)
+    return x.astype(cfg.dtype), _split_heads(k_new, nh, hd), \
+        _split_heads(v_new, nh, hd)
 
 
 def _logits(params, x, cfg):
@@ -206,8 +213,8 @@ def _prefill_kv(x, lp, cfg):
     h1 = _ln(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
     qkv = jnp.einsum("bsh,hk->bsk", h1.astype(cfg.dtype),
                      lp["qkv_w"].astype(cfg.dtype),
-                     preferred_element_type=jnp.float32)
-    qkv = qkv + lp["qkv_b"].astype(jnp.float32)
+                     preferred_element_type=cfg.dtype)
+    qkv = qkv + lp["qkv_b"].astype(cfg.dtype)
     _, k, v = jnp.split(qkv, 3, axis=-1)
     nh, hd = cfg.heads, cfg.head_dim
     return _split_heads(k.astype(cfg.dtype), nh, hd), \
