@@ -236,6 +236,68 @@ def fast_rows_eligible(fmt: str) -> bool:
     return fmt in ("json", "jsonlines") and _get_native_rows() is not None
 
 
+def _fast_parse_plan(schema):
+    """Loop-invariant parse inputs shared by the row and columnar fast
+    paths: (cols, dtypes, codes, defaults)."""
+    cols = [c for c in schema.column_names() if c != "_metadata"]
+    dtypes = {n: c.dtype for n, c in schema.__columns__.items()}
+    codes = [_dtype_code(dtypes[c]) for c in cols]
+    defaults = {
+        c: v for c, v in schema.default_values().items() if c in cols
+    }
+    return cols, dtypes, codes, defaults
+
+
+def _repair_fallback(fallback, cols, dtypes, schema, write_row):
+    """Shared fallback repair: re-parse each (row index, line bytes) entry
+    in Python and hand ``write_row(i, values)`` the coerced values; returns
+    the indices to DROP (undecodable / non-record lines). One home for the
+    repair semantics of both the row and columnar paths."""
+    drop = []
+    for i, line in fallback:
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            drop.append(i)
+            continue
+        if not isinstance(obj, dict):
+            drop.append(i)
+            continue
+        write_row(i, parse_record_fields(obj, cols, dtypes, schema))
+    return drop
+
+
+def cols_from_bytes(data: bytes, fmt: str, schema):
+    """Columnar twin of :func:`rows_from_bytes`: raw jsonlines bytes ->
+    ``(column_lists, n_rows)`` with one Python list per schema column —
+    no row tuples are ever materialized (the C++ parser emits straight
+    into column lists), so bulk readers skip the transpose entirely.
+    Returns None when the fast path does not apply; fallback rows are
+    repaired per-record exactly like the row path."""
+    if not fast_rows_eligible(fmt):
+        return None
+    jsonl_native = _get_native_jsonl()
+    if jsonl_native is None:
+        rows = rows_from_bytes(data, fmt, schema)
+        if rows is None:
+            return None
+        return [list(col) for col in zip(*rows)], len(rows)
+    cols, dtypes, codes, defaults = _fast_parse_plan(schema)
+    col_lists, n, fallback = jsonl_native(data, cols, codes, defaults, 1)
+    col_lists = list(col_lists)
+
+    def write_row(i, values):
+        for j, c in enumerate(cols):
+            col_lists[j][i] = values[c]
+
+    drop = _repair_fallback(fallback, cols, dtypes, schema, write_row)
+    for i in reversed(drop):
+        for col in col_lists:
+            del col[i]
+        n -= 1
+    return col_lists, n
+
+
 def rows_from_bytes(data: bytes, fmt: str, schema):
     """Fast batch parse: raw jsonlines bytes -> list of row TUPLES in schema
     column order (the reference parses records entirely in Rust,
@@ -249,29 +311,17 @@ def rows_from_bytes(data: bytes, fmt: str, schema):
     if not fast_rows_eligible(fmt):
         return None
     native = _get_native_rows()
-    cols = [c for c in schema.column_names() if c != "_metadata"]
-    dtypes = {n: c.dtype for n, c in schema.__columns__.items()}
-    codes = [_dtype_code(dtypes[c]) for c in cols]
-    defaults = {
-        c: v for c, v in schema.default_values().items() if c in cols
-    }
+    cols, dtypes, codes, defaults = _fast_parse_plan(schema)
     jsonl_native = _get_native_jsonl()
     if jsonl_native is not None:
         # one-pass bytes -> rows; odd lines (escapes, containers, slow
         # coercions) come back as (row index, line bytes) for Python
         rows, fallback = jsonl_native(data, cols, codes, defaults)
-        drop = []
-        for i, line in fallback:
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError:
-                drop.append(i)
-                continue
-            if not isinstance(obj, dict):
-                drop.append(i)
-                continue
-            values = parse_record_fields(obj, cols, dtypes, schema)
+
+        def write_row(i, values):
             rows[i] = tuple(values[c] for c in cols)
+
+        drop = _repair_fallback(fallback, cols, dtypes, schema, write_row)
         for i in reversed(drop):
             del rows[i]
         return rows

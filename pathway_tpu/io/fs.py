@@ -26,6 +26,7 @@ from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._streams import BaseConnector, next_commit_time
 from pathway_tpu.io._utils import (
     CsvParserSettings,
+    cols_from_bytes,
     fast_rows_eligible,
     format_value_for_output,
     iter_records_from_bytes,
@@ -259,19 +260,17 @@ class _FsConnector(BaseConnector):
             except OSError:
                 continue
             seen[fp] = mtime
-            fast = rows_from_bytes(data, self.fmt, self.schema)
-            m = len(fast)
+            col_lists, m = cols_from_bytes(data, self.fmt, self.schema)
             if m == 0:
                 continue
             c_path = np.empty(m, dtype=object)
             c_path[:] = fp
             c_idx = np.arange(m, dtype=object)  # python ints: hash parity
             key_arrs.append(keys_for_value_columns([c_path, c_idx], m))
-            colt = list(zip(*fast))
             arrs = []
             for j in range(len(cols)):
                 a = np.empty(m, dtype=object)
-                a[:] = colt[j]
+                a[:] = col_lists[j]
                 arrs.append(a)
             col_arrs.append(arrs)
         if not key_arrs:

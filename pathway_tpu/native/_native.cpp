@@ -950,7 +950,11 @@ static Val parse_value(Cursor& c) {
 static PyObject* py_jsonl_rows(PyObject*, PyObject* args) {
   Py_buffer buf;
   PyObject *cols, *codes_obj, *defaults;
-  if (!PyArg_ParseTuple(args, "y*OOO", &buf, &cols, &codes_obj, &defaults))
+  int columnar = 0;  // 1: emit per-column LISTS (no row tuples) — the
+                     // bulk fs reader consumes columns, so the row-tuple
+                     // detour and its transpose disappear entirely
+  if (!PyArg_ParseTuple(args, "y*OOO|i", &buf, &cols, &codes_obj, &defaults,
+                        &columnar))
     return nullptr;
   PyObject* col_fast = PySequence_Fast(cols, "cols must be a sequence");
   PyObject* code_fast =
@@ -987,13 +991,22 @@ static PyObject* py_jsonl_rows(PyObject*, PyObject* args) {
       PyErr_SetString(PyExc_ValueError, "bad cols/codes/defaults");
     return nullptr;
   }
-  PyObject* rows = PyList_New(0);
+  PyObject* rows = columnar ? nullptr : PyList_New(0);
   PyObject* fallback = PyList_New(0);
+  std::vector<PyObject*> col_out;  // columnar mode: one list per column
+  bool mem_err = (!columnar && rows == nullptr) || fallback == nullptr;
+  if (columnar) {
+    col_out.resize((size_t)nc, nullptr);
+    for (Py_ssize_t j = 0; !mem_err && j < nc; j++) {
+      col_out[(size_t)j] = PyList_New(0);
+      if (col_out[(size_t)j] == nullptr) mem_err = true;
+    }
+  }
+  Py_ssize_t n_rows_out = 0;  // emitted rows incl. fallback placeholders
   const char* data = reinterpret_cast<const char*>(buf.buf);
   const char* data_end = data + buf.len;
   std::vector<PyObject*> rowvals((size_t)nc);  // owned per row
   const char* line = data;
-  bool mem_err = false;
   while (line < data_end && !mem_err) {
     const char* nl = (const char*)std::memchr(line, '\n', (size_t)(data_end - line));
     const char* line_end = nl ? nl : data_end;
@@ -1104,35 +1117,61 @@ static PyObject* py_jsonl_rows(PyObject*, PyObject* args) {
       }
     }
     if (ok) {
-      PyObject* row = PyTuple_New(nc);
-      if (row == nullptr) {
-        mem_err = true;
-      } else {
-        for (Py_ssize_t j = 0; j < nc; j++) {
+      if (columnar) {
+        for (Py_ssize_t j = 0; j < nc && !mem_err; j++) {
           PyObject* outv = rowvals[(size_t)j];
           if (outv == nullptr) {
             outv = defvals[(size_t)j] ? defvals[(size_t)j] : Py_None;
             Py_INCREF(outv);
           }
-          PyTuple_SET_ITEM(row, j, outv);
+          if (PyList_Append(col_out[(size_t)j], outv) < 0) mem_err = true;
+          Py_DECREF(outv);
           rowvals[(size_t)j] = nullptr;
         }
-        if (PyList_Append(rows, row) < 0) mem_err = true;
-        Py_DECREF(row);
+        for (Py_ssize_t j = 0; j < nc; j++) {  // on error: free leftovers
+          Py_XDECREF(rowvals[(size_t)j]);
+          rowvals[(size_t)j] = nullptr;
+        }
+        n_rows_out++;
+      } else {
+        PyObject* row = PyTuple_New(nc);
+        if (row == nullptr) {
+          mem_err = true;
+        } else {
+          for (Py_ssize_t j = 0; j < nc; j++) {
+            PyObject* outv = rowvals[(size_t)j];
+            if (outv == nullptr) {
+              outv = defvals[(size_t)j] ? defvals[(size_t)j] : Py_None;
+              Py_INCREF(outv);
+            }
+            PyTuple_SET_ITEM(row, j, outv);
+            rowvals[(size_t)j] = nullptr;
+          }
+          if (PyList_Append(rows, row) < 0) mem_err = true;
+          Py_DECREF(row);
+          n_rows_out++;
+        }
       }
     } else {
       for (Py_ssize_t j = 0; j < nc; j++) Py_XDECREF(rowvals[(size_t)j]);
       PyObject* entry = Py_BuildValue(
-          "(ny#)", (Py_ssize_t)PyList_GET_SIZE(rows), line,
-          (Py_ssize_t)(line_end - line));
+          "(ny#)", n_rows_out, line, (Py_ssize_t)(line_end - line));
       if (entry == nullptr || PyList_Append(fallback, entry) < 0) {
         Py_XDECREF(entry);
         mem_err = true;
       } else {
         Py_DECREF(entry);
-        Py_INCREF(Py_None);
-        if (PyList_Append(rows, Py_None) < 0) mem_err = true;
-        Py_DECREF(Py_None);
+        if (columnar) {
+          for (Py_ssize_t j = 0; j < nc && !mem_err; j++) {
+            if (PyList_Append(col_out[(size_t)j], Py_None) < 0)
+              mem_err = true;
+          }
+        } else {
+          Py_INCREF(Py_None);
+          if (PyList_Append(rows, Py_None) < 0) mem_err = true;
+          Py_DECREF(Py_None);
+        }
+        n_rows_out++;
       }
     }
     line = nl ? nl + 1 : data_end;
@@ -1143,7 +1182,20 @@ static PyObject* py_jsonl_rows(PyObject*, PyObject* args) {
   if (mem_err) {
     Py_XDECREF(rows);
     Py_XDECREF(fallback);
+    for (PyObject* cl : col_out) Py_XDECREF(cl);
     return nullptr;
+  }
+  if (columnar) {
+    PyObject* cols_tuple = PyTuple_New(nc);
+    if (cols_tuple == nullptr) {
+      Py_XDECREF(fallback);
+      for (PyObject* cl : col_out) Py_XDECREF(cl);
+      return nullptr;
+    }
+    for (Py_ssize_t j = 0; j < nc; j++) {
+      PyTuple_SET_ITEM(cols_tuple, j, col_out[(size_t)j]);  // steals ref
+    }
+    return Py_BuildValue("(NnN)", cols_tuple, n_rows_out, fallback);
   }
   return Py_BuildValue("(NN)", rows, fallback);
 }
