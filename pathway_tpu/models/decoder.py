@@ -275,17 +275,42 @@ def decode_step(params: dict, token: jax.Array, step_pos: jax.Array,
     return _logits(params, x, cfg)[:, 0, :], {"k": ks, "v": vs}
 
 
+def _filter_logits(logits, top_k: int | None, top_p: float | None):
+    """Standard nucleus/top-k logit filtering, fully on device (static
+    shapes: both filters mask to -inf rather than shrinking the vocab).
+    With both set, top-k applies first, then top-p within the survivors —
+    the HF ``text-generation`` composition."""
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob > top_p; the token
+        # that CROSSES the threshold stays (shift the mask by one)
+        cut = cum - probs > top_p
+        cutoff = jnp.where(  # smallest KEPT logit (excluded -> +inf)
+            cut, jnp.inf, sorted_logits
+        ).min(axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def generate(params: dict, prompt_ids: jax.Array, attention_mask: jax.Array,
              cfg: DecoderConfig, max_new: int, temperature: float = 0.0,
              key: jax.Array | None = None,
-             eos_id: int | None = None) -> jax.Array:
+             eos_id: int | None = None,
+             top_k: int | None = None,
+             top_p: float | None = None) -> jax.Array:
     """Generate ``max_new`` tokens after a LEFT-padded prompt batch, fully
     on device (prefill + all steps + sampling in one traced computation —
     jit this whole function). Returns (B, max_new) int32; positions after a
     row's EOS are filled with ``eos_id`` when given.
 
     ``temperature == 0`` is greedy argmax; otherwise softmax sampling at
-    the given temperature using ``key``."""
+    the given temperature using ``key``, optionally restricted to the
+    ``top_k`` highest logits and/or the ``top_p`` nucleus."""
     B, S = prompt_ids.shape
     cache_len = S + max_new
     if S + max_new > cfg.max_position:
@@ -308,9 +333,11 @@ def generate(params: dict, prompt_ids: jax.Array, attention_mask: jax.Array,
     def sample(logits, k):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits / temperature, axis=-1
-        ).astype(jnp.int32)
+        # temperature FIRST, then the nucleus (HF warper order): the top-p
+        # set must be chosen from the TEMPERED distribution — filtering
+        # untempered logits would nullify high temperatures
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
 
     def body(carry, t):
         logits, cache, slot_mask, done, key = carry

@@ -228,6 +228,8 @@ class TPUDecoderChat(BaseChat):
         tokenizer=None,
         max_new_tokens: int = 64,
         temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
         max_prompt_tokens: int = 512,
         seed: int = 0,
         cache_strategy: udfs.CacheStrategy | None = None,
@@ -257,6 +259,8 @@ class TPUDecoderChat(BaseChat):
         self.tokenizer = tokenizer
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
         # clamp the prompt cap so prompt + generation always fits the
         # model's positions (generate() raises on overflow; the cap makes
         # the default usable for any max_position)
@@ -270,7 +274,8 @@ class TPUDecoderChat(BaseChat):
             )
         self._seed = seed
         self._calls = 0  # advances the sampling key between calls
-        # (rows, prompt_len, max_new, temperature) -> jitted generate
+        # (rows, prompt_len, max_new, temperature, top_k, top_p) -> jitted
+        # generate executable
         self._jitted: dict[tuple, Any] = {}
 
     def _format_prompt(self, messages) -> str:
@@ -282,8 +287,10 @@ class TPUDecoderChat(BaseChat):
         ]
         return "\n".join(parts) + "\nassistant:"
 
-    def _generate_fn(self, rows: int, s: int, max_new: int, temp: float):
-        fn = self._jitted.get((rows, s, max_new, temp))
+    def _generate_fn(self, rows: int, s: int, max_new: int, temp: float,
+                     top_k, top_p):
+        cache_key = (rows, s, max_new, temp, top_k, top_p)
+        fn = self._jitted.get(cache_key)
         if fn is None:
             import jax
 
@@ -296,14 +303,15 @@ class TPUDecoderChat(BaseChat):
                     params, ids, mask, cfg, max_new,
                     temperature=temp, key=key,
                     eos_id=getattr(self.tokenizer, "eos_id", None),
+                    top_k=top_k, top_p=top_p,
                 )
 
             fn = jax.jit(run)
-            self._jitted[(rows, s, max_new, temp)] = fn
+            self._jitted[cache_key] = fn
         return fn
 
     def _accepts_call_arg(self, arg_name: str) -> bool:
-        return arg_name in ("max_new_tokens", "temperature")
+        return arg_name in ("max_new_tokens", "temperature", "top_k", "top_p")
 
     def __wrapped__(self, messages: list, **kwargs) -> list[str | None]:
         import jax
@@ -313,6 +321,10 @@ class TPUDecoderChat(BaseChat):
 
         max_new = int(kwargs.pop("max_new_tokens", self.max_new_tokens))
         temp = float(kwargs.pop("temperature", self.temperature))
+        top_k = kwargs.pop("top_k", self.top_k)
+        top_k = None if top_k is None else max(1, int(top_k))
+        top_p = kwargs.pop("top_p", self.top_p)
+        top_p = None if top_p is None else float(top_p)
         if kwargs:
             # the sibling chat classes forward call kwargs to their APIs;
             # a compiled decoder has no such sink — reject, don't ignore
@@ -351,7 +363,7 @@ class TPUDecoderChat(BaseChat):
         self._calls += 1
         key = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._calls)
         toks = np.asarray(
-            self._generate_fn(rows, s, max_new, temp)(
+            self._generate_fn(rows, s, max_new, temp, top_k, top_p)(
                 self.params, ids, mask, key
             )
         )

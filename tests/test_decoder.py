@@ -71,6 +71,36 @@ def test_generate_sampling_deterministic_under_key(tiny_params):
     assert (np.asarray(a) != np.asarray(c)).any()
 
 
+def test_logit_filtering_top_k_top_p():
+    """_filter_logits masks exactly the HF-convention sets: top-k keeps
+    the k highest; top-p keeps the smallest prefix of the sorted
+    distribution whose cumulative probability crosses p (the crossing
+    token INCLUDED)."""
+    logits = jnp.log(jnp.array([[0.5, 0.25, 0.15, 0.08, 0.02]]))
+    k2 = np.asarray(D._filter_logits(logits, top_k=2, top_p=None))
+    assert np.isfinite(k2[0, :2]).all() and np.isinf(k2[0, 2:]).all()
+    # top_p=0.6: 0.5 alone < 0.6, so 0.25 (the crossing token) stays too
+    p6 = np.asarray(D._filter_logits(logits, top_k=None, top_p=0.6))
+    assert np.isfinite(p6[0, :2]).all() and np.isinf(p6[0, 2:]).all()
+    # top_p=0.95: keeps 0.5+0.25+0.15+0.08 (crosses at the 4th)
+    p95 = np.asarray(D._filter_logits(logits, top_k=None, top_p=0.95))
+    assert np.isfinite(p95[0, :4]).all() and np.isinf(p95[0, 4:]).all()
+    # composition: top_k=3 then top_p=0.6 within survivors
+    both = np.asarray(D._filter_logits(logits, top_k=3, top_p=0.6))
+    assert np.isfinite(both[0, :2]).all() and np.isinf(both[0, 2:]).all()
+
+
+def test_generate_top_k_sampling_stays_in_set(tiny_params):
+    """With top_k=1 sampling at any temperature equals greedy decode."""
+    ids, mask = _left_padded_prompts()
+    greedy = np.asarray(D.generate(tiny_params, ids, mask, TINY, 5))
+    k1 = np.asarray(
+        D.generate(tiny_params, ids, mask, TINY, 5, temperature=2.0,
+                   key=jax.random.PRNGKey(9), top_k=1)
+    )
+    assert (greedy == k1).all()
+
+
 def test_generate_eos_padding(tiny_params):
     """After a row emits EOS every later slot is EOS."""
     ids, mask = _left_padded_prompts()
@@ -109,7 +139,11 @@ def test_chat_udf_temperature_samples_across_calls(tiny_params):
     short = chat.__wrapped__(["same prompt"], max_new_tokens=2)
     assert len(short[0]) == 2
     with pytest.raises(TypeError, match="unsupported call kwargs"):
-        chat.__wrapped__(["same prompt"], top_p=0.9)
+        chat.__wrapped__(["same prompt"], beam_width=4)
+    # top_k / top_p are honored per call (greedy-equivalent at top_k=1)
+    only_top = chat.__wrapped__(["same prompt"], temperature=1.5, top_k=1)
+    greedy = chat.__wrapped__(["same prompt"], temperature=0.0)
+    assert only_top == greedy
     # per-call max_new shrinks the prompt budget so generation still fits
     # max_position (64 here); an impossible request fails loudly
     fits = chat.__wrapped__(["x" * 200], max_new_tokens=32)
