@@ -267,13 +267,48 @@ def _repair_fallback(fallback, cols, dtypes, schema, write_row):
     return drop
 
 
-def cols_from_bytes(data: bytes, fmt: str, schema):
-    """Columnar twin of :func:`rows_from_bytes`: raw jsonlines bytes ->
-    ``(column_lists, n_rows)`` with one Python list per schema column —
-    no row tuples are ever materialized (the C++ parser emits straight
-    into column lists), so bulk readers skip the transpose entirely.
-    Returns None when the fast path does not apply; fallback rows are
-    repaired per-record exactly like the row path."""
+def _get_native_csv():
+    from pathway_tpu.native.binding import native_bind
+
+    return native_bind("csv_cols")
+
+
+def _csv_settings_simple(settings: "CsvParserSettings | None") -> bool:
+    """Whether the C++ CSV state machine covers these settings (1-byte
+    delimiter/quote, RFC4180 double-quote escapes, no comment chars)."""
+    s = settings or CsvParserSettings()
+    return (
+        len(s.delimiter) == 1
+        and ord(s.delimiter) < 128
+        and len(s.quote) == 1
+        and ord(s.quote) < 128
+        and s.escape is None
+        and s.enable_double_quote_escapes
+        and s.enable_quoting
+        and s.comment_character is None
+    )
+
+
+def fast_cols_eligible(fmt: str, csv_settings=None) -> bool:
+    """Whether :func:`cols_from_bytes` has a fast path for ``fmt``."""
+    if fmt in ("json", "jsonlines"):
+        return fast_rows_eligible(fmt)
+    if fmt in ("csv", "dsv"):
+        return _get_native_csv() is not None and _csv_settings_simple(
+            csv_settings
+        )
+    return False
+
+
+def cols_from_bytes(data: bytes, fmt: str, schema, csv_settings=None):
+    """Columnar twin of :func:`rows_from_bytes`: raw jsonlines OR csv
+    bytes -> ``(column_lists, n_rows)`` with one Python list per schema
+    column — no row tuples are ever materialized (the C++ parsers emit
+    straight into column lists), so bulk readers skip the transpose
+    entirely. Returns None when the fast path does not apply; fallback
+    records are repaired per-record exactly like the row paths."""
+    if fmt in ("csv", "dsv"):
+        return _csv_cols_from_bytes(data, schema, csv_settings)
     if not fast_rows_eligible(fmt):
         return None
     jsonl_native = _get_native_jsonl()
@@ -291,6 +326,47 @@ def cols_from_bytes(data: bytes, fmt: str, schema):
             col_lists[j][i] = values[c]
 
     drop = _repair_fallback(fallback, cols, dtypes, schema, write_row)
+    for i in reversed(drop):
+        for col in col_lists:
+            del col[i]
+        n -= 1
+    return col_lists, n
+
+
+def _csv_cols_from_bytes(data: bytes, schema, csv_settings):
+    """C++ CSV fast path; fallback records (exotic coercions) re-parse
+    through the REAL csv module against the header the C++ parser saw, so
+    results match the DictReader path exactly."""
+    import csv as csv_mod
+    import io as io_mod
+
+    native = _get_native_csv()
+    if native is None or not _csv_settings_simple(csv_settings):
+        return None
+    settings = csv_settings or CsvParserSettings()
+    cols, dtypes, codes, defaults = _fast_parse_plan(schema)
+    header, col_lists, n, fallback = native(
+        data, ord(settings.delimiter), ord(settings.quote),
+        cols, codes, defaults,
+    )
+    col_lists = list(col_lists)
+    drop: list[int] = []
+    for i, rec_bytes in fallback:
+        text = rec_bytes.decode("utf-8", errors="replace")
+        parsed = list(csv_mod.reader(
+            io_mod.StringIO(text), delimiter=settings.delimiter,
+            quotechar=settings.quote,
+        ))
+        if not parsed:
+            drop.append(i)
+            continue
+        fields = list(parsed[0])
+        if len(fields) < len(header):  # DictReader restval: None
+            fields += [None] * (len(header) - len(fields))
+        record = dict(zip(header, fields))
+        values = parse_record_fields(record, cols, dtypes, schema)
+        for j, c in enumerate(cols):
+            col_lists[j][i] = values[c]
     for i in reversed(drop):
         for col in col_lists:
             del col[i]

@@ -27,6 +27,7 @@ from pathway_tpu.io._streams import BaseConnector, next_commit_time
 from pathway_tpu.io._utils import (
     CsvParserSettings,
     cols_from_bytes,
+    fast_cols_eligible,
     fast_rows_eligible,
     format_value_for_output,
     iter_records_from_bytes,
@@ -136,7 +137,11 @@ class _FsConnector(BaseConnector):
         pid = config_mod.pathway_config.process_id
         cols = list(self.node.column_names)
         pk = self.schema.primary_key_columns()
-        if not pk and not self.with_metadata and fast_rows_eligible(self.fmt):
+        if (
+            not pk
+            and not self.with_metadata
+            and fast_cols_eligible(self.fmt, self.csv_settings)
+        ):
             return self._read_all_fast_batch(seen, cols, n_proc, pid)
         # collect rows + key sources, then hash keys in ONE columnar native
         # pass — per-row hash_values dominated wordcount-class profiles
@@ -260,7 +265,9 @@ class _FsConnector(BaseConnector):
             except OSError:
                 continue
             seen[fp] = mtime
-            col_lists, m = cols_from_bytes(data, self.fmt, self.schema)
+            col_lists, m = cols_from_bytes(
+                data, self.fmt, self.schema, self.csv_settings
+            )
             if m == 0:
                 continue
             c_path = np.empty(m, dtype=object)
