@@ -142,6 +142,7 @@ class BPETokenizer:
         self.byte_dec = {v: k for k, v in self.byte_enc.items()}
         self.eos_id = self.vocab.get(eos_token)
         self._cache: dict[str, list[str]] = {}
+        self._warned_unknown = False
 
     @classmethod
     def from_dir(cls, path: str, **kw) -> "BPETokenizer":
@@ -198,10 +199,24 @@ class BPETokenizer:
             mapped = "".join(self.byte_enc[b] for b in pre.encode("utf-8"))
             for piece in self._bpe(mapped):
                 pid = self.vocab.get(piece)
-                if pid is None:  # unknown piece: fall back to raw bytes
-                    ids.extend(
-                        self.vocab.get(c, 0) for c in piece
-                    )
+                if pid is None:
+                    # unknown piece: fall back to per-character ids.  A full
+                    # GPT-2 vocab has all 256 byte symbols, so misses only
+                    # happen with truncated/non-standard vocabs — skip those
+                    # characters (never inject an arbitrary id) and warn once
+                    for c in piece:
+                        cid = self.vocab.get(c)
+                        if cid is not None:
+                            ids.append(cid)
+                        elif not self._warned_unknown:
+                            self._warned_unknown = True
+                            import warnings
+
+                            warnings.warn(
+                                "BPETokenizer: vocab lacks byte symbol "
+                                f"{c!r}; dropping it (truncated vocab?)",
+                                stacklevel=2,
+                            )
                 else:
                     ids.append(pid)
         return ids

@@ -1245,14 +1245,25 @@ static bool parse_float(const std::string& f, double* out) {
   return true;
 }
 
+static inline bool is_strip_ws(char c) {
+  // the ASCII subset of what Python str.strip() removes (incl. the
+  // \x1c-\x1f separator control chars, which are .isspace() in Python)
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+         c == '\v' || (c >= '\x1c' && c <= '\x1f');
+}
+
+// Mirrors str(raw).strip().lower() in ("1","true","yes","on").  Returns
+// false (caller falls back to the Python parser) when the field holds
+// non-ASCII bytes, where Python's strip()/lower() could diverge.
 static bool parse_bool(const std::string& f, bool* out) {
   std::string t;
   t.reserve(f.size());
   size_t b = 0, e = f.size();
-  while (b < e && (f[b] == ' ' || f[b] == '\t')) b++;
-  while (e > b && (f[e - 1] == ' ' || f[e - 1] == '\t')) e--;
+  while (b < e && is_strip_ws(f[b])) b++;
+  while (e > b && is_strip_ws(f[e - 1])) e--;
   for (size_t i = b; i < e; i++) {
     char c = f[i];
+    if ((unsigned char)c >= 0x80) return false;
     t.push_back(c >= 'A' && c <= 'Z' ? (char)(c + 32) : c);
   }
   *out = (t == "1" || t == "true" || t == "yes" || t == "on");
@@ -1318,6 +1329,10 @@ static PyObject* py_csv_cols(PyObject*, PyObject* args) {
     std::string cur;
     bool in_quotes = false;
     bool any = false;
+    // csv.reader opens a quoted section only when the quote is the very
+    // first character of a field; any later quote is a literal char
+    // (e.g. '5" disk,x' -> ['5" disk', 'x'], '"a"b"c,d' -> ['ab"c', 'd'])
+    bool field_fresh = true;
     while (c < end) {
       char ch = *c;
       if (in_quotes) {
@@ -1326,12 +1341,15 @@ static PyObject* py_csv_cols(PyObject*, PyObject* args) {
           else { in_quotes = false; c++; }
         } else { cur.push_back(ch); c++; }
       } else if (ch == quote) {
-        in_quotes = true;
+        if (field_fresh) in_quotes = true;
+        else cur.push_back(quote);
+        field_fresh = false;
         any = true;
         c++;
       } else if (ch == delim) {
         fields.push_back(cur);
         cur.clear();
+        field_fresh = true;
         any = true;
         c++;
       } else if (ch == '\n' || ch == '\r') {
@@ -1349,6 +1367,7 @@ static PyObject* py_csv_cols(PyObject*, PyObject* args) {
         return true;
       } else {
         cur.push_back(ch);
+        field_fresh = false;
         any = true;
         c++;
       }
@@ -1421,9 +1440,10 @@ static PyObject* py_csv_cols(PyObject*, PyObject* args) {
         }
         case 3: {
           bool v;
-          csvn::parse_bool(f, &v);
-          outv = v ? Py_True : Py_False;
-          Py_INCREF(outv);
+          if (csvn::parse_bool(f, &v)) {
+            outv = v ? Py_True : Py_False;
+            Py_INCREF(outv);
+          }
           break;
         }
         case 4:

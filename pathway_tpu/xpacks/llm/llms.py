@@ -322,7 +322,13 @@ class TPUDecoderChat(BaseChat):
         max_new = int(kwargs.pop("max_new_tokens", self.max_new_tokens))
         temp = float(kwargs.pop("temperature", self.temperature))
         top_k = kwargs.pop("top_k", self.top_k)
-        top_k = None if top_k is None else max(1, int(top_k))
+        # clamp into [1, vocab_size]: lax.top_k(k > vocab) raises an opaque
+        # trace-time error; HF silently clamps to vocab size, so match that
+        top_k = (
+            None
+            if top_k is None
+            else min(max(1, int(top_k)), self.cfg.vocab_size)
+        )
         top_p = kwargs.pop("top_p", self.top_p)
         top_p = None if top_p is None else float(top_p)
         if kwargs:

@@ -428,3 +428,40 @@ def test_tpu_decoder_chat_udf_end_to_end(tiny_params):
     res2 = t2.select(a=chat(pw.this.q))
     rows2 = pw.debug.table_to_dicts(res2)[1]["a"]
     assert sorted(str(v) for v in rows2.values()) == answers
+
+
+def test_chat_udf_top_k_clamped_to_vocab(tiny_params):
+    """top_k larger than the vocab must clamp (HF behavior), not raise an
+    opaque lax.top_k trace error."""
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+    from tests.utils import ToyCharTokenizer
+
+    chat = TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(),
+        max_new_tokens=4, temperature=1.0,
+    )
+    out = chat.__wrapped__(["hi"], top_k=10**6)
+    assert len(out) == 1 and len(out[0]) == 4
+
+
+def test_bpe_truncated_vocab_drops_unknown_chars(tmp_path):
+    """A vocab missing byte symbols must not inject token id 0 for the
+    missing characters — it skips them and warns once."""
+    import warnings
+
+    d = _toy_bpe_dir(tmp_path)
+    tok = BPETokenizer.from_dir(d)
+    # remove one byte symbol from the vocab to simulate truncation
+    victim = tok.byte_enc[ord("q")]
+    assert victim in tok.vocab
+    bad_vocab = {k: v for k, v in tok.vocab.items() if k != victim}
+    tok2 = BPETokenizer(
+        bad_vocab, [tuple(p) for p in sorted(tok.ranks, key=tok.ranks.get)]
+    )
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        ids = tok2.encode("q")
+        ids_again = tok2.encode("qq")
+    assert ids == [] and ids_again == []
+    assert 0 not in ids
+    assert len(w) == 1 and "vocab lacks byte symbol" in str(w[0].message)

@@ -299,3 +299,33 @@ def test_debug_table_to_pandas_roundtrip():
     back = pw.debug.table_to_pandas(t)
     assert sorted(back["a"].tolist()) == [1, 2]
     assert sorted(back["b"].tolist()) == ["x", "y"]
+
+
+def test_csv_stray_quote_mid_field_is_literal(tmp_path):
+    # csv.reader opens a quoted section only at field start; a quote after
+    # unquoted content is a literal char.  The C++ fast path used to enter
+    # quoted mode mid-field and swallow the rest of the file into one field.
+    t = _static(
+        tmp_path, "a.csv", 'word,n\n5" disk,1\n"a"b"c,2\nplain,3\n',
+        format="csv", schema=WN,
+    )
+    rows, cols = _capture_rows(t)
+    got = sorted(
+        (r[cols.index("word")], r[cols.index("n")]) for r in rows.values()
+    )
+    assert got == [('5" disk', 1), ('ab"c', 2), ("plain", 3)]
+
+
+def test_csv_bool_quoted_with_newline_whitespace(tmp_path):
+    # parse_bool must strip the same whitespace set as str.strip(): a quoted
+    # field can legitimately contain \n or \r around the token.
+    class B(pw.Schema):
+        f: bool
+
+    t = _static(
+        tmp_path, "b.csv", 'f\n"true\n"\n" YES\t"\n"no\r"\n',
+        format="csv", schema=B,
+    )
+    rows, cols = _capture_rows(t)
+    got = sorted(r[cols.index("f")] for r in rows.values())
+    assert got == [False, True, True]
