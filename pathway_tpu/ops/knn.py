@@ -129,18 +129,57 @@ def _append_kernel(corpus, valid, n_dev, v, m, normalize: bool):
 
 
 @functools.partial(
-    jax.jit, donate_argnums=(0, 1, 2), static_argnames=("embed", "cfg")
+    jax.jit,
+    donate_argnums=(0, 1, 2),
+    static_argnames=("embed", "cfg", "pad_id"),
 )
 def _embed_append_kernel(corpus, valid, n_dev, params, ids, mask, m, *,
-                         embed, cfg):
+                         embed, cfg, pad_id=0):
     """Embed + append in ONE dispatch: token ids go in, corpus rows come
     out, and the (normalized) embeddings are returned for queries riding
     the stream. On a relayed chip every dispatch enqueue pays tunnel
     latency, so halving the per-batch dispatch count matters as much as
-    the kernels themselves."""
+    the kernels themselves.
+
+    ``ids`` may be any integer dtype (int16 halves the h2d transfer for
+    vocabularies under 32k — every BERT-family vocab); ``mask=None``
+    derives the attention mask on device as ``ids != pad_id``, removing
+    the mask transfer entirely. On a bandwidth-constrained link the
+    ids-only int16 form cuts per-batch host bytes 4x."""
+    ids = ids.astype(jnp.int32)
+    if mask is None:
+        mask = (ids != pad_id).astype(jnp.int32)
     emb = embed(params, ids, mask, cfg)  # (B, d) f32, unit-normalized
     corpus, valid, n_dev = _write_rows(corpus, valid, n_dev, emb, m)
     return corpus, valid, n_dev, emb
+
+
+@functools.partial(
+    jax.jit,
+    donate_argnums=(0, 1, 2),
+    static_argnames=("embed", "cfg", "pad_id", "query_rows", "k", "metric"),
+)
+def _embed_append_query_kernel(corpus, valid, n_dev, params, ids, mask, m, *,
+                               embed, cfg, pad_id, query_rows, k, metric):
+    """Ingest AND ride-along query in one dispatch: embed the batch, append
+    it, then search the first ``query_rows`` fresh embeddings against the
+    corpus *as updated by this very append* (self-inclusive as-of-now
+    semantics — identical to dispatching a search right after the append).
+    On a relayed chip each extra dispatch costs ~ms-level fixed overhead,
+    more than the whole corpus scan itself, so a streaming pipeline with
+    queries riding the ingest stream should prefer this over
+    ``search_device`` after ``add_embed``."""
+    ids = ids.astype(jnp.int32)
+    if mask is None:
+        mask = (ids != pad_id).astype(jnp.int32)
+    emb = embed(params, ids, mask, cfg)
+    corpus, valid, n_dev = _write_rows(corpus, valid, n_dev, emb, m)
+    # emb is already unit-normalized (embed contract), so cos needs no
+    # renormalise here
+    scores, idx = topk_scores(
+        knn_scores(corpus, valid, emb[:query_rows], metric), k
+    )
+    return corpus, valid, n_dev, emb, scores, idx
 
 
 _M_SCALARS: dict[int, Any] = {}
@@ -245,19 +284,31 @@ class BruteForceKnnIndex:
 
     def _record_keys(self, keys: list, start: int) -> None:
         """Host-side half of an append: key -> slot bookkeeping (one home
-        for both the plain and the fused ingest paths)."""
-        for i, key in enumerate(keys):
-            self._slot_of[key] = start + i
-            self._keys.append(key)
+        for both the plain and the fused ingest paths). zip/update/extend
+        keep the whole batch in C — this sits on the per-batch ingest path."""
+        self._slot_of.update(zip(keys, range(start, start + len(keys))))
+        self._keys.extend(keys)
         self.n += len(keys)
 
     def add_embed(self, keys: list, params, input_ids, attention_mask,
-                  cfg, embed):
+                  cfg, embed, pad_id: int = 0, query_rows: int = 0,
+                  k: int = 0):
         """Fastest ingest path: embed the tokenized batch AND append the
         vectors in one fused dispatch (see ``_embed_append_kernel``).
         ``embed(params, ids, mask, cfg)`` must return unit-normalized
         (rows, d) float32 — e.g. ``models.embedder.embed_fn``. Returns the
         embeddings (device array) for downstream queries.
+
+        ``attention_mask=None`` derives the mask on device from
+        ``input_ids != pad_id`` — pass int16 ids and no mask to cut the
+        per-batch host->device bytes 4x (the win on a remote/tunneled
+        chip, where ingest is link-bound before it is compute-bound).
+
+        ``query_rows=q, k=n`` additionally searches the first ``q`` fresh
+        embeddings against the just-updated corpus INSIDE the same
+        dispatch and returns ``(emb, scores, idx)`` instead of ``emb`` —
+        the streaming ingest-with-live-queries shape with zero extra
+        dispatches (a separate ``search_device`` costs 2 more).
 
         The write covers ALL ``input_ids.shape[0]`` token rows (pad rows
         land beyond the cursor, valid=False, and are overwritten by the
@@ -267,7 +318,9 @@ class BruteForceKnnIndex:
         mid-stream — hence the warning."""
         m = len(keys)
         if m == 0:
-            return None
+            # keep the arity of the documented return shape so callers can
+            # unpack unconditionally
+            return (None, None, None) if query_rows else None
         rows = input_ids.shape[0]
         if rows < m:
             raise ValueError(f"{m} keys but only {rows} token rows")
@@ -283,10 +336,21 @@ class BruteForceKnnIndex:
             )
             self._grow(self.n + rows)
         start = self.n
+        if query_rows:
+            (self._corpus, self._valid, self._n_dev, emb, scores,
+             idx) = _embed_append_query_kernel(
+                self._corpus, self._valid, self._n_dev,
+                params, input_ids, attention_mask, _m_scalar(m),
+                embed=embed, cfg=cfg, pad_id=pad_id,
+                query_rows=query_rows, k=min(k, self.capacity),
+                metric=self.metric,
+            )
+            self._record_keys(keys, start)
+            return emb, scores, idx
         self._corpus, self._valid, self._n_dev, emb = _embed_append_kernel(
             self._corpus, self._valid, self._n_dev,
             params, input_ids, attention_mask, _m_scalar(m),
-            embed=embed, cfg=cfg,
+            embed=embed, cfg=cfg, pad_id=pad_id,
         )
         self._record_keys(keys, start)
         return emb

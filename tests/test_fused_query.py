@@ -111,3 +111,81 @@ def test_ivf_search_device_empty_raises():
     with pytest.raises(ValueError, match="empty"):
         ix.search_device(np.zeros((1, 8), np.float32), 3)
     assert ix.search(np.zeros((1, 8), np.float32), 3) == [[]]
+
+
+def test_add_embed_ids_only_int16_matches_masked():
+    """add_embed with mask=None (device-derived from pad id) and int16 ids
+    must produce the same corpus rows as the explicit-mask int32 path."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models import MINILM_L6, init_params
+    from pathway_tpu.models.embedder import (
+        cast_params_for_inference, embed_fn,
+    )
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    cfg = MINILM_L6
+    params = cast_params_for_inference(
+        init_params(jax.random.PRNGKey(0), cfg), cfg
+    )
+    rng = np.random.default_rng(7)
+    ids = rng.integers(1, 5000, size=(16, 32)).astype(np.int32)
+    ids[:, 20:] = 0  # pad tail
+    mask = (ids != 0).astype(np.int32)
+
+    a = BruteForceKnnIndex(dimensions=cfg.hidden, reserved_space=32)
+    b = BruteForceKnnIndex(dimensions=cfg.hidden, reserved_space=32)
+    ea = a.add_embed(list(range(16)), params, jnp.asarray(ids),
+                     jnp.asarray(mask), cfg, embed_fn)
+    eb = b.add_embed(list(range(16)), params,
+                     jnp.asarray(ids.astype(np.int16)), None, cfg, embed_fn)
+    np.testing.assert_allclose(np.asarray(ea), np.asarray(eb), atol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(a._corpus[:16]).astype(np.float32),
+        np.asarray(b._corpus[:16]).astype(np.float32),
+    )
+
+
+def test_add_embed_ride_along_query_matches_separate_search():
+    """query_rows/k inside add_embed must equal add_embed followed by
+    search_device on the same fresh embeddings (self-inclusive corpus)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models import MINILM_L6, init_params
+    from pathway_tpu.models.embedder import (
+        cast_params_for_inference, embed_fn,
+    )
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    cfg = MINILM_L6
+    params = cast_params_for_inference(
+        init_params(jax.random.PRNGKey(1), cfg), cfg
+    )
+
+    def batch(seed):
+        r = np.random.default_rng(seed)
+        ids = r.integers(1, 5000, size=(16, 32)).astype(np.int16)
+        ids[:, 24:] = 0
+        return jnp.asarray(ids)
+
+    fused = BruteForceKnnIndex(dimensions=cfg.hidden, reserved_space=64)
+    plain = BruteForceKnnIndex(dimensions=cfg.hidden, reserved_space=64)
+    fused.add_embed(list(range(16)), params, batch(0), None, cfg, embed_fn)
+    plain.add_embed(list(range(16)), params, batch(0), None, cfg, embed_fn)
+
+    emb_f, sc_f, ix_f = fused.add_embed(
+        list(range(16, 32)), params, batch(1), None, cfg, embed_fn,
+        query_rows=4, k=5,
+    )
+    emb_p = plain.add_embed(list(range(16, 32)), params, batch(1), None,
+                            cfg, embed_fn)
+    sc_p, ix_p = plain.search_device(emb_p[:4], k=5)
+    np.testing.assert_array_equal(np.asarray(ix_f), np.asarray(ix_p)[:4])
+    np.testing.assert_allclose(
+        np.asarray(sc_f), np.asarray(sc_p)[:4], atol=1e-5
+    )
+    # the query doc itself is in the corpus: top hit is self with cos ~ 1
+    assert np.allclose(np.asarray(sc_f)[:, 0], 1.0, atol=1e-3)
+    assert list(np.asarray(ix_f)[:, 0]) == [16, 17, 18, 19]
