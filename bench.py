@@ -619,6 +619,119 @@ def config5_ivf_recall_latency(cfg) -> dict:
     }
 
 
+def config_join_streaming() -> dict:
+    """Streaming inner join through the FULL engine (kafka -> join ->
+    select -> subscribe): orders x users on user id, 200k orders against
+    20k users, delivered as per-row callbacks. Plus an operator-level
+    hot-key probe: single-row inserts against one 4096-row join key — the
+    workload where per-delta bucket recompute (the r3 implementation) is
+    O(bucket) and the bilinear delta path is O(matches)."""
+    import threading
+
+    import pathway_tpu as pw
+    from pathway_tpu.io.kafka import InMemoryKafkaBroker
+
+    pw.clear_graph()
+    rng = np.random.default_rng(21)
+    n_orders, n_users = 200_000, 20_000
+    broker = InMemoryKafkaBroker()
+    uids = rng.integers(0, n_users, n_orders)
+    for i in range(n_orders):
+        broker.produce(
+            "orders",
+            json.dumps(
+                {"oid": i, "uid": int(uids[i]), "amount": float(i % 97)}
+            ).encode(),
+        )
+    for u in range(n_users):
+        broker.produce(
+            "users", json.dumps({"uid": u, "name": f"user{u}"}).encode()
+        )
+    broker.close()
+
+    class OrderS(pw.Schema):
+        oid: int
+        uid: int
+        amount: float
+
+    class UserS(pw.Schema):
+        uid: int
+        name: str
+
+    orders = pw.io.kafka.read(broker, topic="orders", schema=OrderS)
+    users = pw.io.kafka.read(broker, topic="users", schema=UserS)
+    j = orders.join(users, orders.uid == users.uid).select(
+        orders.oid, users.name, orders.amount
+    )
+    out: list = []
+    pw.io.subscribe(
+        j, on_change=lambda key, row, time, is_addition: out.append(1)
+    )
+
+    def stop():
+        deadline = time.time() + 300
+        while time.time() < deadline and len(out) < n_orders:
+            time.sleep(0.05)
+        for c in pw.G.connectors:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stop, daemon=True).start()
+    t0 = time.perf_counter()
+    pw.run()
+    el = time.perf_counter() - t0
+    e2e_rate = len(out) / el
+
+    # operator-level hot-key probe (no engine around it)
+    from pathway_tpu.engine.batch import Batch
+    from pathway_tpu.engine.graph import EngineGraph, Node
+    from pathway_tpu.engine.operators.join import JoinNode
+
+    g = EngineGraph()
+    left = Node(g, [], ["oid", "uid", "amount"], "L")
+    right = Node(g, [], ["uid", "name"], "R")
+    node = JoinNode(
+        g, left, right, ["uid"], ["uid"], "inner",
+        [("oid", "left", "oid"), ("name", "right", "name"),
+         ("amount", "left", "amount")],
+    )
+    B, n_ins = 4096, 512
+    node.step(0, [None, Batch.from_rows(
+        ["uid", "name"], [(10**6 + i, (7, f"u{i}"), 1) for i in range(B)]
+    )])
+    t0 = time.perf_counter()
+    emitted = 0
+    for t in range(1, n_ins + 1):
+        o = node.step(t, [Batch.from_rows(
+            ["oid", "uid", "amount"], [(t, (t, 7, 1.0), 1)]
+        ), None])
+        emitted += len(o) if o is not None else 0
+    hot_el = time.perf_counter() - t0
+    diag(
+        phase="config_join",
+        e2e_rows_per_sec=round(e2e_rate, 1),
+        hotkey_deltas_per_sec=round(n_ins / hot_el, 1),
+        hotkey_pairs_emitted=emitted,
+    )
+    return {
+        "metric": "streaming_join_rows_per_sec",
+        "value": round(e2e_rate, 1),
+        "unit": "rows/s",
+        "detail": {
+            "orders": n_orders,
+            "users": n_users,
+            "pipeline": "kafka -> inner join -> select -> subscribe",
+            "hotkey_single_insert_deltas_per_sec": round(n_ins / hot_el, 1),
+            "hotkey_bucket_rows": B,
+            "note": (
+                "hot-key probe is operator-level: r3's recompute-per-delta "
+                "ran ~5 deltas/s on this shape (O(bucket) per insert); the "
+                "bilinear delta path is O(matches)"
+            ),
+        },
+    }
+
+
 def config_wordcount_streaming() -> dict:
     """Engine streaming throughput on the reference's claim-to-fame shape
     (wordcount vs Flink/Spark, ``/root/reference/README.md:245-251``):
@@ -773,6 +886,7 @@ def main() -> None:
     for fn, args in (
         (config4_streaming_engine, ()),
         (config5_ivf_recall_latency, (cfg,)),
+        (config_join_streaming, ()),
         (config_wordcount_streaming, ()),
         (config_decoder_generate, ()),
     ):

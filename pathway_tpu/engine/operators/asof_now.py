@@ -14,7 +14,7 @@ from collections import defaultdict
 from pathway_tpu.engine.batch import Batch
 from pathway_tpu.engine.graph import Node
 from pathway_tpu.engine.operators.join import JoinNode
-from pathway_tpu.engine.value import hash_values
+from pathway_tpu.engine.value import ERROR, hash_values
 from pathway_tpu.internals.errors import get_global_error_log
 
 
@@ -33,18 +33,24 @@ class AsofNowJoinNode(JoinNode):
         lb, rb = ins
         # right side: just maintain state (no retriggering)
         if rb is not None:
-            self._apply_side(
-                self._right, rb, self.inputs[1].column_names, self.right_on
-            )
+            self._side_deltas(self._right, rb, self.right_on)
         if lb is None:
             return None
         rows: list[tuple[int, tuple, int]] = []
         lnames = self.inputs[0].column_names
-        lid_idx = None
+        # jk format matches _side_deltas' right buckets: bare value for a
+        # single-column key, tuple otherwise
+        on_idx = [lnames.index(c) for c in self.left_on]
+        single = len(on_idx) == 1
         for key, lrow, diff in lb.rows():
             if diff > 0:
-                jk = self._jk_of(lrow, lnames, self.left_on)
-                if jk is None:
+                if single:
+                    jk = lrow[on_idx[0]]
+                    bad = jk is ERROR
+                else:
+                    jk = tuple(lrow[i] for i in on_idx)
+                    bad = any(v is ERROR for v in jk)
+                if bad:
                     get_global_error_log().log("Error value in join key")
                     continue
                 rbucket = self._right.get(jk, {})

@@ -65,6 +65,16 @@ class _BrokerConnector(BaseConnector):
         self.start_from_latest = start_from_latest
         self._offset = 0
         self._started = False
+        # primary-keyed topics are upsert sessions (see run())
+        self._emitted_pk: dict[int, tuple] = {}
+
+    def on_replay(self, rows) -> None:
+        if self.schema.primary_key_columns():
+            for key, row, diff in rows:
+                if diff > 0:
+                    self._emitted_pk[key] = row
+                else:
+                    self._emitted_pk.pop(key, None)
 
     # persistence: the broker log position IS the reader offset — stored
     # with every snapshot chunk so a restart resumes past replayed data
@@ -100,7 +110,8 @@ class _BrokerConnector(BaseConnector):
                     [v for _k, v in entries], self.fmt, self.schema,
                     cols, dtypes, plan=plan,
                 )
-                rows = []
+                good: list[tuple] = []
+                offs: list[int] = []
                 for i, row in enumerate(parsed):
                     if row is None:
                         from pathway_tpu.internals.errors import (
@@ -112,12 +123,57 @@ class _BrokerConnector(BaseConnector):
                             f"offset {base + i}"
                         )
                         continue
+                    good.append(row)
+                    offs.append(base + i)
+                # key derivation is ONE vectorized Key::for_values pass per
+                # poll (identical values to per-row hash_values) — per-row
+                # hashing dominated ingress at high rates
+                if good:
+                    import numpy as np
+
+                    from pathway_tpu.engine.value import (
+                        keys_for_value_columns,
+                    )
+
+                    n = len(good)
                     if pk:
-                        key = hash_values(*[row[j] for j in pk_idx])
+                        # np.empty + slice-assign keeps list/array-valued pk
+                        # columns as 1-D object arrays (np.array(...) would
+                        # collapse equal-length lists into a 2-D array and
+                        # change row identities vs hash_values)
+                        key_cols = []
+                        for j in pk_idx:
+                            col = np.empty(n, dtype=object)
+                            col[:] = [r[j] for r in good]
+                            key_cols.append(col)
                     else:
                         # log-position keys: stable across restarts
-                        key = hash_values(self.topic, base + i)
-                    rows.append((key, row, 1))
+                        key_cols = [
+                            np.full(n, self.topic, dtype=object),
+                            np.array(offs, dtype=object),
+                        ]
+                    keys = keys_for_value_columns(key_cols, n).tolist()
+                    if pk:
+                        # primary-keyed topics are upsert sessions
+                        # (reference SessionType::Upsert): a re-delivered
+                        # key retracts the previous row instead of
+                        # violating the universe key invariant
+                        rows = []
+                        emitted = self._emitted_pk
+                        for k, row in zip(keys, good):
+                            old = emitted.get(k)
+                            if old == row:
+                                continue
+                            if old is not None:
+                                rows.append((k, old, -1))
+                            emitted[k] = row
+                            rows.append((k, row, 1))
+                    else:
+                        rows = [
+                            (k, row, 1) for k, row in zip(keys, good)
+                        ]
+                else:
+                    rows = []
                 self._offset = base + len(entries)
                 self.commit_rows(rows)
             elif self.broker.closed:

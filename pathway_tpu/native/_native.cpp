@@ -131,8 +131,21 @@ static bool serialize(PyObject* v, std::string& out) {
     if (!overflow) {
       out.push_back('\x02');
       put_u64(out, (uint64_t)ll);
+    } else if (overflow > 0) {
+      // positive ints in [2^63, 2^64) — every uint64 row key lands here.
+      // Python encodes them as TAG_BIGINT + u32 len + to_bytes(little,
+      // signed): bit_length 64 -> 9 bytes, low 8 LE + 0x00 sign byte.
+      unsigned long long ull = PyLong_AsUnsignedLongLong(v);
+      if (PyErr_Occurred()) {
+        PyErr_Clear();
+        return false;  // > 2^64: rare — python fallback
+      }
+      out.push_back('\x0f');
+      put_u32(out, 9);
+      put_u64(out, (uint64_t)ull);
+      out.push_back('\x00');
     } else {
-      return false;  // bigint: rare — python fallback
+      return false;  // < -2^63: rare — python fallback
     }
   } else if (PyFloat_Check(v)) {
     double d = PyFloat_AS_DOUBLE(v);

@@ -291,3 +291,34 @@ def test_fs_list_primary_key_hashes_match_scalar(tmp_path):
     assert sorted(rows) == sorted(
         [hash_values([1, 2]), hash_values([3, 4])]
     )
+
+
+def test_kafka_pk_list_column_keys_match_hash_values(tmp_path):
+    """Vectorized pk key derivation must produce hash_values-identical row
+    identities even for list-valued pk columns whose equal lengths would
+    collapse np.array(...) into a 2-D array."""
+    import json
+
+    import pathway_tpu as pw
+    from pathway_tpu.engine.value import hash_values
+    from pathway_tpu.io.kafka import InMemoryKafkaBroker
+    from tests.utils import _capture_rows
+
+    broker = InMemoryKafkaBroker()
+    for tag, n in (([1, 2], 10), ([3, 4], 20), ([1, 2], 11)):
+        broker.produce(
+            "t", json.dumps({"tag": tag, "n": n}).encode()
+        )
+    broker.close()
+
+    class S(pw.Schema):
+        tag: list = pw.column_definition(primary_key=True)
+        n: int
+
+    t = pw.io.kafka.read(broker, topic="t", schema=S)
+    rows, cols = _capture_rows(t)
+    # upsert semantics: second [1,2] replaces the first
+    assert sorted(r[cols.index("n")] for r in rows.values()) == [11, 20]
+    expect = {hash_values((1, 2)), hash_values((3, 4))}
+    got = {k.value if hasattr(k, "value") else int(k) for k in rows}
+    assert got == expect, (got, expect)
