@@ -1,19 +1,36 @@
 """Airbyte source connector (reference ``python/pathway/io/airbyte``:
-runs an Airbyte connector via `airbyte-serverless` (PyPI venv or docker) and
-streams its record messages as a ``data: Json`` column, incremental state
-kept between polls).
+runs an Airbyte connector and streams its RECORD messages as a
+``data: Json`` column, incremental STATE kept between polls).
 
-This build has no network/docker egress, so the runner is pluggable: pass
-``_source`` (any object with ``extract(streams) -> iterable`` yielding
-Airbyte RECORD message dicts) to use an in-process source; otherwise the
-``airbyte_serverless`` package is required, matching the reference's local
-execution type."""
+Execution modes (reference ``io/airbyte/logic.py`` +
+``third_party/airbyte_serverless/sources.py:89-140``):
+
+* ``execution_type="local"`` — a local connector process. Either the
+  ``airbyte_serverless`` package (PyPI venv runner) or any executable
+  speaking the Airbyte protocol via :class:`ExecutableAirbyteSource`.
+* ``execution_type="docker"`` — the connector's public Docker image,
+  wrapped as ``docker run --rm -i --volume <tmp>:<mnt> <image>``
+  (:class:`DockerAirbyteSource`). Gated on a ``docker`` binary.
+* ``_source=...`` — any object with ``extract(streams) -> iterable`` of
+  Airbyte RECORD message dicts (in-process; used by tests and embedded
+  sources).
+
+The subprocess contract is the standard Airbyte connector CLI: actions
+``spec`` / ``discover --config c.json`` / ``read --config c.json
+--catalog cat.json [--state s.json]``, each emitting JSON-lines messages
+on stdout; RECORD rows stream into the table, the latest STATE message is
+fed back on the next poll so incremental streams resume instead of
+re-reading."""
 
 from __future__ import annotations
 
+import json as json_mod
 import os
+import shlex
+import subprocess
+import tempfile
 import time as time_mod
-from typing import Any, Sequence
+from typing import Any, Iterable, Sequence
 
 from pathway_tpu.engine.operators.core import InputNode
 from pathway_tpu.engine.value import hash_values
@@ -24,6 +41,180 @@ from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.universe import Universe
 from pathway_tpu.io._streams import BaseConnector
+
+
+class AirbyteSourceError(RuntimeError):
+    """A connector emitted a TRACE error message (reference
+    ``executable_runner.py: AirbyteSourceException``)."""
+
+
+class ExecutableAirbyteSource:
+    """Runs any executable speaking the Airbyte connector CLI protocol.
+
+    ``executable`` is the command prefix (string, shell-quoted as needed);
+    config/catalog/state are passed as ``--name <tempdir>/name.json`` file
+    arguments exactly like the reference's runner
+    (``third_party/airbyte_serverless/executable_runner.py:208-246``).
+    Incremental: the newest STATE message from each ``read`` is kept on
+    ``self.state`` and passed back on the next ``extract``."""
+
+    def __init__(self, executable: str, config: dict | None = None,
+                 streams: Sequence[str] | None = None,
+                 env_vars: dict[str, str] | None = None):
+        self.executable = executable
+        self.config = config or {}
+        self.streams = list(streams or [])
+        self.env_vars = env_vars
+        self._temp_dir_obj = tempfile.TemporaryDirectory()
+        self.temp_dir = self._temp_dir_obj.name
+        # where the executable sees the temp dir (differs under docker,
+        # where the host dir is volume-mounted)
+        self.temp_dir_for_executable = self.temp_dir
+        self.state: Any = None
+        self._catalog: dict | None = None
+
+    # -- protocol ----------------------------------------------------------
+    def _run(self, action: str, state=None) -> Iterable[dict]:
+        command = f"{self.executable} {action}"
+
+        def add_argument(name: str, value) -> str:
+            path = os.path.join(self.temp_dir, f"{name}.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json_mod.dump(value, f)
+            return (
+                f" --{name} {self.temp_dir_for_executable}/{name}.json"
+            )
+
+        if action != "spec":
+            command += add_argument("config", self.config)
+        if action == "read":
+            command += add_argument("catalog", self.configured_catalog)
+            if state is not None:
+                command += add_argument("state", state)
+        env = (
+            {**os.environ, **self.env_vars} if self.env_vars else None
+        )  # augment, never replace: the connector still needs PATH etc.
+        proc = subprocess.Popen(
+            command, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            shell=True, env=env,
+        )
+        assert proc.stdout is not None
+        try:
+            for line in iter(proc.stdout.readline, b""):
+                content = line.decode(errors="replace").strip()
+                if not content:
+                    continue
+                try:
+                    message = json_mod.loads(content)
+                except ValueError:
+                    continue  # connectors log non-JSON noise on stdout
+                if message.get("trace", {}).get("error"):
+                    raise AirbyteSourceError(
+                        json_mod.dumps(message["trace"]["error"])
+                    )
+                yield message
+            proc.wait()
+            if proc.returncode != 0:
+                raise AirbyteSourceError(
+                    f"connector exited with status {proc.returncode} "
+                    f"(action {action!r})"
+                )
+        finally:
+            # early generator close (_first_message, TRACE error) must not
+            # leak a running connector process
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    def _first_message(self, action: str) -> dict:
+        for message in self._run(action):
+            if message.get("type") not in ("LOG", "TRACE"):
+                return message
+        raise AirbyteSourceError(f"no message from action {action!r}")
+
+    @property
+    def spec(self) -> dict:
+        return self._first_message("spec")["spec"]
+
+    @property
+    def catalog(self) -> dict:
+        if self._catalog is None:
+            self._catalog = self._first_message("discover")["catalog"]
+        return self._catalog
+
+    @property
+    def configured_catalog(self) -> dict:
+        """Every requested stream, incremental where the connector supports
+        it (reference ``executable_runner.py: get_configured_catalog``)."""
+        configured = []
+        for stream in self.catalog.get("streams", []):
+            if self.streams and stream.get("name") not in self.streams:
+                continue
+            modes = stream.get("supported_sync_modes") or ["full_refresh"]
+            sync_mode = (
+                "incremental" if "incremental" in modes else "full_refresh"
+            )
+            configured.append(
+                {
+                    "stream": stream,
+                    "sync_mode": sync_mode,
+                    "destination_sync_mode": "append",
+                    "cursor_field": stream.get("default_cursor_field", []),
+                }
+            )
+        return {"streams": configured}
+
+    def extract(self, streams: Sequence[str] | None = None) -> list[dict]:
+        """One ``read`` pass: returns RECORD messages, stores the newest
+        STATE for the next call."""
+        if streams:
+            self.streams = list(streams)
+        out = []
+        for message in self._run("read", state=self.state):
+            mtype = message.get("type")
+            if mtype == "RECORD":
+                out.append(message)
+            elif mtype == "STATE":
+                self.state = message.get("state")
+        return out
+
+
+def _docker_command(image: str, temp_dir: str, mount_dir: str,
+                    env_vars: dict[str, str] | None = None) -> str:
+    """The docker envelope the reference builds
+    (``third_party/airbyte_serverless/sources.py:108-111``)."""
+    env = " ".join(
+        f"-e {shlex.quote(k)}={shlex.quote(v)}"
+        for k, v in (env_vars or {}).items()
+    )
+    env = f"{env} " if env else ""
+    return (
+        f"docker run --rm -i --volume {temp_dir}:{mount_dir} "
+        f"{env}{image}"
+    )
+
+
+class DockerAirbyteSource(ExecutableAirbyteSource):
+    """Runs the connector's public Docker image. Gated: constructing
+    without a ``docker`` binary raises (this build's image has none; the
+    envelope itself is covered by tests through ``_docker_command``)."""
+
+    def __init__(self, connector: str, config: dict | None = None,
+                 streams: Sequence[str] | None = None,
+                 env_vars: dict[str, str] | None = None):
+        import shutil
+
+        if shutil.which("docker") is None:
+            raise RuntimeError(
+                "execution_type='docker' needs a docker binary on PATH; "
+                "use execution_type='local' or pass _source=..."
+            )
+        super().__init__("", config, streams)
+        self.docker_image = connector
+        self.temp_dir_for_executable = "/mnt/temp"
+        self.executable = _docker_command(
+            connector, self.temp_dir, self.temp_dir_for_executable, env_vars
+        )
 
 
 def _make_serverless_source(config_file_path, streams, env_vars, enforce_method):
@@ -101,14 +292,27 @@ def read(
     """Stream Airbyte RECORD messages of the selected ``streams`` into a
     ``data: Json`` table (reference ``io/airbyte/__init__.py:107``)."""
     if _source is None:
-        if execution_type != "local":
+        if execution_type == "docker":
+            import yaml
+
+            with open(config_file_path) as f:
+                config = yaml.safe_load(f)
+            source_config = config["source"]
+            _source = DockerAirbyteSource(
+                source_config["docker_image"],
+                source_config.get("config", {}),
+                streams,
+                env_vars,
+            )
+        elif execution_type != "local":
             raise NotImplementedError(
                 "remote (GCP) Airbyte execution requires cloud access; use "
-                "execution_type='local' or pass _source=..."
+                "execution_type='local'/'docker' or pass _source=..."
             )
-        _source = _make_serverless_source(
-            config_file_path, streams, env_vars, enforce_method
-        )
+        else:
+            _source = _make_serverless_source(
+                config_file_path, streams, env_vars, enforce_method
+            )
     schema = schema_mod.schema_from_types(data=dt.JSON)
     cols = list(schema.column_names())
     node = InputNode(G.engine_graph, cols, name=f"airbyte({','.join(streams)})")

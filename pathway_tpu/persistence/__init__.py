@@ -62,6 +62,7 @@ def get_persistent_sources() -> dict[str, Any]:
 
 
 from pathway_tpu.persistence.backends import (  # noqa: E402
+    AzureBlobBackend,
     FilesystemBackend,
     MemoryBackend,
     MockBackend,
@@ -76,6 +77,7 @@ from pathway_tpu.persistence.snapshot import (  # noqa: E402
 from pathway_tpu.persistence.state import MetadataAccessor, StoredMetadata  # noqa: E402
 
 __all__ = [
+    "AzureBlobBackend",
     "Backend",
     "Config",
     "FilesystemBackend",

@@ -185,3 +185,79 @@ class S3Backend(PersistenceBackend):
 
     def remove_key(self, key):
         self.client.delete_object(Bucket=self.bucket, Key=self._key(key))
+
+
+class AzureBlobBackend(PersistenceBackend):
+    """Azure Blob Storage-backed blobs, following the same gated-SDK
+    pattern as :class:`S3Backend`: constructing without azure-storage-blob
+    raises a clear ImportError instead of silently degrading (the earlier
+    build mapped ``Backend.azure`` to a LOCAL path — a correctness trap:
+    users believed they had durable cloud persistence). Pass an explicit
+    ``container_client=`` (anything with upload_blob / download_blob /
+    list_blob_names / delete_blob) to use a custom or stub client."""
+
+    def __init__(
+        self,
+        container: str,
+        prefix: str = "",
+        container_client=None,
+        connection_string: str | None = None,
+        account_url: str | None = None,
+        credential=None,
+        **client_kwargs,
+    ):
+        if container_client is None:
+            try:
+                from azure.storage.blob import (  # type: ignore
+                    BlobServiceClient,
+                )
+            except ImportError as exc:  # pragma: no cover - env-dependent
+                raise ImportError(
+                    "Azure persistence backend requires azure-storage-blob; "
+                    "pass an explicit container_client= or use "
+                    "Backend.filesystem / Backend.s3"
+                ) from exc
+            if connection_string is not None:
+                service = BlobServiceClient.from_connection_string(
+                    connection_string, **client_kwargs
+                )
+            elif account_url is not None:
+                service = BlobServiceClient(
+                    account_url, credential=credential, **client_kwargs
+                )
+            else:
+                raise ValueError(
+                    "Backend.azure needs connection_string=, account_url=, "
+                    "or an explicit container_client="
+                )
+            container_client = service.get_container_client(container)
+        self.container = container
+        self.prefix = prefix.strip("/")
+        self.client = container_client
+
+    def _key(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put_value(self, key, value):
+        self.client.upload_blob(self._key(key), value, overwrite=True)
+
+    def get_value(self, key):
+        return self.client.download_blob(self._key(key)).readall()
+
+    def list_keys(self) -> list[str]:
+        out = []
+        it = (
+            # trailing '/' so a sibling prefix sharing the string prefix
+            # ('persist' vs 'persist-old') is never included
+            self.client.list_blob_names(name_starts_with=self.prefix + "/")
+            if self.prefix
+            else self.client.list_blob_names()
+        )
+        for name in it:
+            if self.prefix:
+                name = name[len(self.prefix) + 1:]
+            out.append(name)
+        return sorted(out)
+
+    def remove_key(self, key):
+        self.client.delete_blob(self._key(key))

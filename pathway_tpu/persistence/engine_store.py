@@ -15,6 +15,7 @@ import pickle
 from typing import Any
 
 from pathway_tpu.persistence.backends import (
+    AzureBlobBackend,
     FilesystemBackend,
     MemoryBackend,
     MockBackend,
@@ -30,9 +31,27 @@ def make_backend(backend_cfg: Any) -> PersistenceBackend:
     if isinstance(backend_cfg, PersistenceBackend):
         return backend_cfg
     kind = getattr(backend_cfg, "kind", None)
-    if kind == "filesystem" or kind == "azure":
-        # azure falls back to a local path in this build (gated: no SDK baked)
+    if kind == "filesystem":
         return FilesystemBackend(backend_cfg.path)
+    if kind == "azure":
+        # gated on azure-storage-blob (or an injected container_client) —
+        # raises instead of silently degrading to a local path
+        opts = dict(backend_cfg.options)
+        account = opts.pop("account", None)
+        if isinstance(account, str):
+            # a plain account name/url means the real SDK path — handing a
+            # string to the client slot would crash deep into the run
+            opts.setdefault("account_url", account)
+        elif account is not None:
+            if not hasattr(account, "upload_blob"):
+                raise TypeError(
+                    "Backend.azure account= must be an account URL string "
+                    "or a container-client-like object with upload_blob/"
+                    f"download_blob, got {type(account).__name__}"
+                )
+            # ``account`` doubles as the injected client (stub/test usage)
+            opts.setdefault("container_client", account)
+        return AzureBlobBackend(container=backend_cfg.path, **opts)
     if kind == "s3":
         opts = backend_cfg.options.get("bucket_settings") or {}
         if isinstance(opts, dict):

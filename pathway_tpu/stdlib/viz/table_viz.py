@@ -33,13 +33,41 @@ def _frame_for_display(df, include_id: bool, short_pointers: bool):
     return df
 
 
-def show(table, *, include_id: bool = True, short_pointers: bool = True):
+def _format_value(x, short_pointers: bool = True):
+    """Type-aware cell formatting (reference ``table_viz.py:60-70``
+    ``_format_types``): Pointers shorten, long Json truncates, the rest
+    passes through."""
+    from pathway_tpu.engine.value import Pointer
+    from pathway_tpu.internals.json import Json
+
+    if isinstance(x, Pointer):
+        s = str(x)
+        if len(s) > 8 and short_pointers:
+            s = s[:8] + "..."
+        return s
+    if isinstance(x, Json):
+        s = str(x)
+        if len(s) > 64:
+            s = s[:64] + " ..."
+        return s
+    return x
+
+
+def show(
+    table,
+    *,
+    include_id: bool = True,
+    short_pointers: bool = True,
+    snapshot: bool = True,
+):
     """Display a table. With panel installed, returns a live-updating panel
     widget fed by ``io.subscribe``; without it, computes the current static
     snapshot and returns an HTML object (works in plain Jupyter).
 
-    Reference parity: ``pw.Table.show`` / cell-magic display
-    (stdlib/viz/table_viz.py:26-140).
+    ``snapshot=False`` shows the CHANGELOG instead of the current state:
+    every update row with its engine ``time`` and ``diff``, newest first,
+    retractions styled red / additions green — the reference's streaming
+    table view (stdlib/viz/table_viz.py:55-100).
     """
     try:
         import panel as pn
@@ -50,6 +78,7 @@ def show(table, *, include_id: bool = True, short_pointers: bool = True):
         df = _frame_for_display(
             _snapshot_dataframe(table), include_id, short_pointers
         )
+        df = df.map(lambda x: _format_value(x, short_pointers))
         html = df.to_html(max_rows=100)
         try:  # inside IPython, return a rich display object
             from IPython.display import HTML
@@ -62,23 +91,46 @@ def show(table, *, include_id: bool = True, short_pointers: bool = True):
 
     import pathway_tpu as pw
 
-    column_names = table.schema.column_names()
-    rows: dict[Any, dict] = {}
+    column_names = list(table.schema.column_names())
+    frame_cols = column_names + (["time", "diff"] if not snapshot else [])
     widget = pn.widgets.Tabulator(
-        pd.DataFrame(columns=column_names), disabled=True
+        pd.DataFrame(columns=frame_cols), disabled=True
     )
+    if not snapshot:
+        # changelog view: color retractions red, additions green
+        def _diff_colors(row):
+            color = "red" if row["diff"] < 0 else "green"
+            return [f"color: {color}" for _ in row]
+
+        style = getattr(widget, "style", None)
+        if style is not None:
+            style.apply(_diff_colors, axis=1)
+
+    rows: dict[Any, dict] = {}
+    changelog: list[dict] = []
 
     def on_change(key, row, time, is_addition):
-        if is_addition:
-            rows[key] = row
+        if snapshot:
+            if is_addition:
+                rows[key] = row
+            else:
+                rows.pop(key, None)
         else:
-            rows.pop(key, None)
+            changelog.append(
+                {**row, "time": time, "diff": 1 if is_addition else -1}
+            )
 
     def on_time_end(time):
-        widget.value = _frame_for_display(
-            pd.DataFrame.from_dict(rows, orient="index"),
-            include_id, short_pointers,
-        )
+        if snapshot:
+            df = _frame_for_display(
+                pd.DataFrame.from_dict(rows, orient="index"),
+                include_id, short_pointers,
+            )
+        else:
+            df = pd.DataFrame(
+                list(reversed(changelog)), columns=frame_cols
+            )
+        widget.value = df.map(lambda x: _format_value(x, short_pointers))
 
     pw.io.subscribe(table, on_change=on_change, on_time_end=on_time_end)
     return pn.Column(widget)

@@ -207,3 +207,151 @@ def test_table_repr_and_schema_str():
     )
     s = str(t.schema)
     assert "a" in s and "b" in s
+
+
+# ------------------------------------------------------------- viz (stubbed)
+class _StubSource:
+    """bokeh.models.ColumnDataSource stand-in recording stream() patches."""
+
+    def __init__(self, data=None):
+        self.data = data or {}
+        self.streamed: list = []
+
+    def stream(self, data, rollover=None):
+        self.streamed.append((data, rollover))
+        self.data = data
+
+
+def _install_viz_stubs(monkeypatch):
+    import sys
+    import types
+
+    bokeh = types.ModuleType("bokeh")
+    models = types.ModuleType("bokeh.models")
+    models.ColumnDataSource = _StubSource
+    bokeh.models = models
+
+    class _Box:
+        def __init__(self, *children, **kw):
+            self.children = list(children)
+
+    class _Tabulator:
+        def __init__(self, value, **kw):
+            self.value = value
+            self.style = None
+
+    panel = types.ModuleType("panel")
+    panel.Column = _Box
+    panel.Row = _Box
+    widgets = types.ModuleType("panel.widgets")
+    widgets.Tabulator = _Tabulator
+    panel.widgets = widgets
+    monkeypatch.setitem(sys.modules, "bokeh", bokeh)
+    monkeypatch.setitem(sys.modules, "bokeh.models", models)
+    monkeypatch.setitem(sys.modules, "panel", panel)
+    monkeypatch.setitem(sys.modules, "panel.widgets", widgets)
+
+
+def test_plot_bounded_renders_immediately(monkeypatch):
+    """A table with only static inputs fills the source at once with a
+    'Static preview' banner (reference bounded-input behavior)."""
+    _install_viz_stubs(monkeypatch)
+    from pathway_tpu.stdlib.viz.plotting import plot
+
+    t = T(
+        """
+        a | b
+        3 | 30
+        1 | 10
+        2 | 20
+        """
+    )
+    captured = {}
+
+    def fig_fn(source):
+        captured["source"] = source
+        return "FIG"
+
+    viz = plot(t, fig_fn, sorting_col="a")
+    assert viz.children[0].children == ["Static preview"]
+    src = captured["source"]
+    assert len(src.streamed) == 1
+    data, rollover = src.streamed[0]
+    assert data["a"] == [1, 2, 3] and data["b"] == [10, 20, 30]
+    assert rollover == 3
+
+
+def test_plot_streaming_updates_on_time_end(monkeypatch):
+    """A connector-fed table gets 'Streaming mode' and stream() patches
+    as epochs close during pw.run()."""
+    import json
+
+    _install_viz_stubs(monkeypatch)
+    from pathway_tpu.io.kafka import InMemoryKafkaBroker
+    from pathway_tpu.stdlib.viz.plotting import plot
+
+    pw.clear_graph()
+    broker = InMemoryKafkaBroker()
+    for i in range(3):
+        broker.produce("t", json.dumps({"a": i}).encode())
+    broker.close()
+
+    class S(pw.Schema):
+        a: int
+
+    t = pw.io.kafka.read(broker, topic="t", schema=S)
+    captured = {}
+
+    def fig_fn(source):
+        captured["source"] = source
+        return "FIG"
+
+    viz = plot(t, fig_fn, sorting_col="a")
+    assert viz.children[0].children == ["Streaming mode"]
+    assert captured["source"].streamed == []  # nothing until pw.run
+    pw.run()
+    src = captured["source"]
+    assert src.streamed, "no stream() patches arrived during the run"
+    data, rollover = src.streamed[-1]
+    assert data["a"] == [0, 1, 2] and rollover == 3
+
+
+def test_show_changelog_mode(monkeypatch):
+    """show(snapshot=False) renders the changelog with time/diff columns
+    (newest first) instead of the squashed state."""
+    import json
+
+    _install_viz_stubs(monkeypatch)
+    from pathway_tpu.stdlib.viz.table_viz import show
+
+    pw.clear_graph()
+
+    class S(pw.Schema):
+        w: str = pw.column_definition(primary_key=True)
+        n: int
+
+    import threading
+    import time as time_mod
+
+    from pathway_tpu.io.kafka import InMemoryKafkaBroker
+
+    broker = InMemoryKafkaBroker()
+    broker.produce("t", json.dumps({"w": "x", "n": 1}).encode())
+
+    def feed_upsert():
+        # second epoch: the upsert must arrive in a LATER poll, or
+        # consolidation correctly collapses it inside one commit
+        time_mod.sleep(0.4)
+        broker.produce("t", json.dumps({"w": "x", "n": 2}).encode())
+        broker.close()
+
+    threading.Thread(target=feed_upsert, daemon=True).start()
+    t = pw.io.kafka.read(broker, topic="t", schema=S)
+    viz = show(t, snapshot=False)
+    pw.run()
+    widget = viz.children[0]
+    df = widget.value
+    assert list(df.columns) == ["w", "n", "time", "diff"]
+    # upsert: +1 (n=1), then -1 (n=1) and +1 (n=2); newest first
+    assert list(df["diff"]) in ([1, -1, 1], [-1, 1, 1])
+    assert set(df["n"]) == {1, 2}

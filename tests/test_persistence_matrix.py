@@ -213,3 +213,77 @@ def test_record_then_replay_modes(tmp_path):
     out2: list = []
     seen2 = _run_counting_pipeline(src, cfg_rep, 0, out2)
     assert [w for w, d in seen2 if d > 0] == ["r"]
+
+
+class _StubAzureContainer:
+    """Duck-typed azure ContainerClient: upload_blob / download_blob /
+    list_blob_names / delete_blob over an in-memory dict."""
+
+    def __init__(self):
+        self.blobs: dict[str, bytes] = {}
+
+    def upload_blob(self, name, data, overwrite=False):
+        if not overwrite and name in self.blobs:
+            raise FileExistsError(name)
+        self.blobs[name] = bytes(data)
+
+    def download_blob(self, name):
+        data = self.blobs[name]
+
+        class _Dl:
+            def readall(self):
+                return data
+
+        return _Dl()
+
+    def list_blob_names(self, name_starts_with=None):
+        return sorted(
+            k for k in self.blobs
+            if name_starts_with is None or k.startswith(name_starts_with)
+        )
+
+    def delete_blob(self, name):
+        self.blobs.pop(name, None)
+
+
+def test_azure_backed_persistence_restart_exactly_once(tmp_path):
+    """Backend.azure must store through the REAL AzureBlobBackend (stub
+    container client) — never silently on the local filesystem — and a
+    restart resumes past snapshotted data exactly-once."""
+    client = _StubAzureContainer()
+    backend = pw.persistence.Backend.azure(
+        "container", account=client, prefix="persist"
+    )
+    cfg = pwp.Config(backend=backend)
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "a.jsonl").write_text('{"word": "cat"}\n{"word": "dog"}\n')
+
+    out1: list = []
+    seen1 = _run_counting_pipeline(src, cfg, 2, out1)
+    assert sorted(w for w, d in seen1 if d > 0) == ["cat", "dog"]
+    # snapshot chunks actually landed in the azure stub, not on disk
+    assert any(k.startswith("persist/streams/words/") for k in client.blobs)
+
+    (src / "b.jsonl").write_text('{"word": "cat"}\n')
+    out2: list = []
+    seen2 = _run_counting_pipeline(src, cfg, 3, out2)
+    net: dict = {}
+    for w, d in seen2:
+        net[w] = net.get(w, 0) + d
+    assert {k: v for k, v in net.items() if v} == {"cat": 2, "dog": 1}
+
+
+def test_azure_backend_roundtrip_and_gating():
+    client = _StubAzureContainer()
+    b = pwp.AzureBlobBackend(container="c", prefix="p", container_client=client)
+    b.put_value("x/one", b"1")
+    b.put_value("x/two", b"2")
+    assert b.list_prefix("x/") == ["x/one", "x/two"]
+    assert b.get_value("x/two") == b"2"
+    b.remove_key("x/one")
+    assert b.list_prefix("x/") == ["x/two"]
+    # no SDK, no client: a clear error — NEVER a local-path fallback
+    with pytest.raises((ImportError, ValueError)):
+        pwp.AzureBlobBackend(container="c")
