@@ -93,8 +93,12 @@ class JoinNode(Node):
         if len(on) == 1:
             jks: list = cols[on[0]].tolist()
             single = True
-        else:
+        elif on:
             jks = list(zip(*[cols[c].tolist() for c in on]))
+            single = False
+        else:
+            # empty join key = cross join: every row shares the () bucket
+            jks = [()] * len(batch)
             single = False
         deltas: dict[Any, list[tuple[int, tuple, int]]] = defaultdict(list)
         dirty: set = set()

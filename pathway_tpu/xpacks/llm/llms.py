@@ -254,7 +254,12 @@ class TPUDecoderChat(BaseChat):
             )
         import jax
 
-        self.params = jax.device_put(params)
+        from pathway_tpu.models.decoder import cast_params_for_inference
+
+        # compute-dtype weights: the decode phase reads the full parameter
+        # set per step, so bf16 storage halves its HBM bill (no-op for
+        # f32 configs)
+        self.params = jax.device_put(cast_params_for_inference(params, cfg))
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.max_new_tokens = int(max_new_tokens)

@@ -259,3 +259,23 @@ def test_join_reinsert_same_key_replaces_pairs():
         )
     )
     assert got == [((1, "u"), -1), ((2, "u"), 1)], got
+
+
+def test_cross_join_empty_key_list():
+    """A join with an EMPTY key list (cross join) buckets every row under
+    (); the columnar key extraction must not drop rows for on=[]."""
+    from pathway_tpu.engine.batch import Batch
+    from pathway_tpu.engine.graph import EngineGraph, Node
+    from pathway_tpu.engine.operators.join import JoinNode
+
+    g = EngineGraph()
+    left = Node(g, [], ["a"], "L")
+    right = Node(g, [], ["b"], "R")
+    node = JoinNode(
+        g, left, right, [], [], "inner",
+        [("a", "left", "a"), ("b", "right", "b")],
+    )
+    node.step(0, [None, Batch.from_rows(["b"], [(100 + i, (i,), 1) for i in range(3)])])
+    out = node.step(1, [Batch.from_rows(["a"], [(1, ("x",), 1), (2, ("y",), 1)]), None])
+    pairs = sorted(zip(*[c.tolist() for c in out.cols.values()]))
+    assert pairs == [("x", 0), ("x", 1), ("x", 2), ("y", 0), ("y", 1), ("y", 2)]
