@@ -16,6 +16,19 @@ import numpy as np
 
 from pathway_tpu.engine import value as value_mod
 
+_rows_split = False  # lazily bound: False = unchecked, None = unavailable
+
+
+def _native_rows_split():
+    """C++ SoA transpose for from_rows (one pass instead of n*ncols
+    Python array writes); None when the native module isn't built."""
+    global _rows_split
+    if _rows_split is False:
+        from pathway_tpu.native.binding import native_bind
+
+        _rows_split = native_bind("batch_rows_split")
+    return _rows_split
+
 
 class Batch:
     """A set of keyed row deltas at a single logical time."""
@@ -97,10 +110,28 @@ class Batch:
         rows: list[tuple[int, tuple, int]],
     ) -> "Batch":
         n = len(rows)
+        names = list(column_names)
+        split = _native_rows_split()
+        if split is not None and n:
+            keys = np.empty(n, dtype=np.uint64)
+            diffs = np.empty(n, dtype=np.int64)
+            try:
+                col_lists = split(
+                    rows if isinstance(rows, list) else list(rows),
+                    len(names), memoryview(keys), memoryview(diffs),
+                )
+            except TypeError:
+                pass  # list rows / odd key types: python path below
+            else:
+                cols = {}
+                for name, cl in zip(names, col_lists):
+                    a = np.empty(n, dtype=object)
+                    a[:] = cl
+                    cols[name] = a
+                return Batch(keys, cols, diffs)
         keys = np.empty(n, dtype=np.uint64)
         diffs = np.empty(n, dtype=np.int64)
-        cols = {name: np.empty(n, dtype=object) for name in column_names}
-        names = list(column_names)
+        cols = {name: np.empty(n, dtype=object) for name in names}
         for i, (k, row, d) in enumerate(rows):
             keys[i] = k
             diffs[i] = d

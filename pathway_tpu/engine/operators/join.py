@@ -30,6 +30,30 @@ from pathway_tpu.engine.state import rows_equal
 from pathway_tpu.engine.value import ERROR, hash_values
 from pathway_tpu.internals.errors import get_global_error_log
 
+_native_lib = False  # lazily bound: False = unchecked, None = unavailable
+
+
+def _native_join():
+    """The C++ join entry points, when the native extension is built."""
+    global _native_lib
+    if _native_lib is False:
+        from types import SimpleNamespace
+
+        from pathway_tpu.native.binding import native_bind
+
+        fns = {
+            n: native_bind(n)
+            for n in (
+                "join_apply_side", "join_ld_cross", "join_record_pairs"
+            )
+        }
+        _native_lib = (
+            None
+            if any(f is None for f in fns.values())
+            else SimpleNamespace(**fns)
+        )
+    return _native_lib
+
 
 class JoinNode(Node):
     """Hash join on precomputed join-key columns.
@@ -66,6 +90,12 @@ class JoinNode(Node):
              (lnames if side == "left" else rnames).index(src))
             for _name, side, src in output_spec
         ]
+        # C++ emitter spec (native join_ld_cross): which side + position
+        # each output column reads from
+        self._sides_bytes = bytes(
+            1 if is_left else 0 for is_left, _ in self._out_idx
+        )
+        self._idx_list = [i for _, i in self._out_idx]
         # jk -> key -> row
         self._left: dict[Any, dict[int, tuple]] = defaultdict(dict)
         self._right: dict[Any, dict[int, tuple]] = defaultdict(dict)
@@ -87,9 +117,20 @@ class JoinNode(Node):
         recompute path (the replaced row's pairs must retract)."""
         cols = batch.cols
         col_lists = [c.tolist() for c in cols.values()]
-        rows = list(zip(*col_lists)) if col_lists else [()] * len(batch)
         keys = batch.keys.tolist()
         diffs = batch.diffs.tolist()
+        native = _native_join()
+        if native is not None and len(on) == 1:
+            # the whole pass (row assembly, bucket updates, per-jk delta
+            # grouping, upsert-dirty detection) in one C loop
+            jk_idx = list(cols).index(on[0])
+            deltas, dirty_list, n_err = native.join_apply_side(
+                state, keys, diffs, tuple(col_lists), jk_idx, ERROR
+            )
+            for _ in range(n_err):
+                get_global_error_log().log("Error value in join key")
+            return deltas, set(dirty_list)
+        rows = list(zip(*col_lists)) if col_lists else [()] * len(batch)
         if len(on) == 1:
             jks: list = cols[on[0]].tolist()
             single = True
@@ -206,6 +247,9 @@ class JoinNode(Node):
         dirty = ldirty | rdirty
         rows: list[tuple[int, tuple, int]] = []
         pairs: list[tuple[Any, int, int, tuple]] = []
+        native = _native_join() if self.mode == "inner" else None
+        works: list[tuple[list, dict]] = []  # (ld, rbucket) per fast jk
+        fast_jks: list[Any] = []
         fast_ok = self.mode == "inner" and self.key_mode == "pair"
         out_idx = self._out_idx
         jks = (
@@ -220,9 +264,9 @@ class JoinNode(Node):
                 pass  # replaced row keys: recompute path below
             elif fast_ok and rd is None:
                 # dominant streaming shape: left-side inserts against a
-                # static-ish right bucket — handled inline (the generic
-                # helper's per-jk set/dict overhead dominated profiles of
-                # many-small-bucket joins)
+                # static-ish right bucket — the whole step's cross
+                # products emit through ONE native call (Python loop kept
+                # as the no-native fallback)
                 if len(ld) == 1:
                     ok = ld[0][2] > 0
                 else:
@@ -232,13 +276,17 @@ class JoinNode(Node):
                 if ok:
                     rbucket = self._right.get(jk)
                     if rbucket:
-                        append = pairs.append
-                        for lk, lrow, _d in ld:
-                            for rk, rrow in rbucket.items():
-                                append((jk, lk, rk, tuple(
-                                    [lrow[i] if il else rrow[i]
-                                     for il, i in out_idx]
-                                )))
+                        if native is not None:
+                            works.append((ld, rbucket))
+                            fast_jks.append(jk)
+                        else:
+                            append = pairs.append
+                            for lk, lrow, _d in ld:
+                                for rk, rrow in rbucket.items():
+                                    append((jk, lk, rk, tuple(
+                                        [lrow[i] if il else rrow[i]
+                                         for il, i in out_idx]
+                                    )))
                     continue
             elif (
                 fast_ok
@@ -263,6 +311,30 @@ class JoinNode(Node):
                 self._emitted[jk] = new_out
             else:
                 self._emitted.pop(jk, None)
+        if works:
+            # the whole step's fast-path cross products in one C pass:
+            # output tuples + (lk, rk) key columns come back ready for the
+            # vectorized Key::for_values hash; per-pair emitted
+            # bookkeeping is a second C pass
+            from pathway_tpu.engine.value import keys_for_value_columns
+
+            out_rows, lks, rks, items = native.join_ld_cross(
+                works, self._sides_bytes, self._idx_list
+            )
+            if out_rows:
+                n = len(out_rows)
+                la = np.empty(n, dtype=object)
+                la[:] = lks
+                ra = np.empty(n, dtype=object)
+                ra[:] = rks
+                oks = keys_for_value_columns([la, ra], n)
+                native.join_record_pairs(
+                    [self._emitted[jk] for jk in fast_jks],
+                    items,
+                    memoryview(np.ascontiguousarray(oks, dtype=np.uint64)),
+                    out_rows,
+                )
+                rows.extend(zip(oks.tolist(), out_rows, (1,) * n))
         if pairs:
             # one vectorized Key::for_values pass over all fast-path pairs
             # (C++ column hash + numpy mixing) instead of a Python

@@ -1545,7 +1545,404 @@ static PyObject* py_set_pointer_type(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+// ---------------------------------------------------------------- join emit
+// join_ld_cross(works, sides, idxs)
+//   works: list of (ld, rbucket) where ld = [(key, row, diff), ...] and
+//          rbucket = {rkey: rrow}; rows are tuples.
+//   sides: bytes, one per output column, 1 = from lrow else rrow.
+//   idxs:  list of ints, source position within that row.
+// One call per engine step covers every fast-path join key: emits the
+// dL x R cross product as (out_rows, lkeys, rkeys, item_of_pair) —
+// the per-pair work the Python inner loop paid ~2us each for.
+static PyObject* py_join_ld_cross(PyObject*, PyObject* args) {
+  PyObject *works, *sides_obj, *idxs_obj;
+  if (!PyArg_ParseTuple(args, "OSO", &works, &sides_obj, &idxs_obj))
+    return nullptr;
+  const char* sides = PyBytes_AS_STRING(sides_obj);
+  Py_ssize_t ncols = PyBytes_GET_SIZE(sides_obj);
+  PyObject* idx_fast = PySequence_Fast(idxs_obj, "idxs must be a sequence");
+  if (idx_fast == nullptr) return nullptr;
+  if (PySequence_Fast_GET_SIZE(idx_fast) != ncols) {
+    Py_DECREF(idx_fast);
+    PyErr_SetString(PyExc_ValueError, "sides/idxs length mismatch");
+    return nullptr;
+  }
+  std::vector<Py_ssize_t> idxs((size_t)ncols);
+  for (Py_ssize_t j = 0; j < ncols; j++) {
+    idxs[(size_t)j] =
+        PyLong_AsSsize_t(PySequence_Fast_GET_ITEM(idx_fast, j));
+    if (idxs[(size_t)j] < 0) {  // conversion error OR a negative index —
+      // both invalid (unchecked GET_ITEM macros below must never see <0)
+      if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_ValueError, "idxs must be non-negative");
+      Py_DECREF(idx_fast);
+      return nullptr;
+    }
+  }
+  PyObject* works_fast = PySequence_Fast(works, "works must be a sequence");
+  if (works_fast == nullptr) {
+    Py_DECREF(idx_fast);
+    return nullptr;
+  }
+  Py_ssize_t nwork = PySequence_Fast_GET_SIZE(works_fast);
+  PyObject* out_rows = PyList_New(0);
+  PyObject* lks = PyList_New(0);
+  PyObject* rks = PyList_New(0);
+  PyObject* items = PyList_New(0);
+  bool fail = out_rows == nullptr || lks == nullptr || rks == nullptr ||
+              items == nullptr;
+  for (Py_ssize_t w = 0; !fail && w < nwork; w++) {
+    PyObject* pair = PySequence_Fast_GET_ITEM(works_fast, w);
+    if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+      PyErr_SetString(PyExc_TypeError, "work item must be (ld, rbucket)");
+      fail = true;
+      break;
+    }
+    PyObject* ld = PyTuple_GET_ITEM(pair, 0);
+    PyObject* rbucket = PyTuple_GET_ITEM(pair, 1);
+    if (!PyDict_Check(rbucket)) {
+      PyErr_SetString(PyExc_TypeError, "rbucket must be a dict");
+      fail = true;
+      break;
+    }
+    PyObject* ld_fast = PySequence_Fast(ld, "ld must be a sequence");
+    if (ld_fast == nullptr) { fail = true; break; }
+    Py_ssize_t nld = PySequence_Fast_GET_SIZE(ld_fast);
+    PyObject* witem = PyLong_FromSsize_t(w);
+    if (witem == nullptr) { Py_DECREF(ld_fast); fail = true; break; }
+    for (Py_ssize_t i = 0; !fail && i < nld; i++) {
+      PyObject* entry = PySequence_Fast_GET_ITEM(ld_fast, i);
+      if (!PyTuple_Check(entry) || PyTuple_GET_SIZE(entry) < 2) {
+        PyErr_SetString(PyExc_TypeError, "ld entry must be (key, row, diff)");
+        fail = true;
+        break;
+      }
+      PyObject* lk = PyTuple_GET_ITEM(entry, 0);
+      PyObject* lrow = PyTuple_GET_ITEM(entry, 1);
+      if (!PyTuple_Check(lrow)) {
+        PyErr_SetString(PyExc_TypeError, "lrow must be a tuple");
+        fail = true;
+        break;
+      }
+      PyObject *rk, *rrow;
+      Py_ssize_t pos = 0;
+      while (!fail && PyDict_Next(rbucket, &pos, &rk, &rrow)) {
+        if (!PyTuple_Check(rrow)) {
+          PyErr_SetString(PyExc_TypeError, "rrow must be a tuple");
+          fail = true;
+          break;
+        }
+        PyObject* out = PyTuple_New(ncols);
+        if (out == nullptr) { fail = true; break; }
+        for (Py_ssize_t j = 0; j < ncols; j++) {
+          PyObject* src = sides[j] ? lrow : rrow;
+          Py_ssize_t k = idxs[(size_t)j];
+          if (k >= PyTuple_GET_SIZE(src)) {
+            Py_DECREF(out);
+            PyErr_SetString(PyExc_IndexError, "row index out of range");
+            fail = true;
+            break;
+          }
+          PyObject* v = PyTuple_GET_ITEM(src, k);
+          Py_INCREF(v);
+          PyTuple_SET_ITEM(out, j, v);
+        }
+        if (fail) break;
+        if (PyList_Append(out_rows, out) < 0 ||
+            PyList_Append(lks, lk) < 0 || PyList_Append(rks, rk) < 0 ||
+            PyList_Append(items, witem) < 0)
+          fail = true;
+        Py_DECREF(out);
+      }
+    }
+    Py_DECREF(witem);
+    Py_DECREF(ld_fast);
+  }
+  Py_DECREF(works_fast);
+  Py_DECREF(idx_fast);
+  if (fail) {
+    Py_XDECREF(out_rows);
+    Py_XDECREF(lks);
+    Py_XDECREF(rks);
+    Py_XDECREF(items);
+    return nullptr;
+  }
+  PyObject* result = PyTuple_Pack(4, out_rows, lks, rks, items);
+  Py_DECREF(out_rows);
+  Py_DECREF(lks);
+  Py_DECREF(rks);
+  Py_DECREF(items);
+  return result;
+}
+
+// record_pairs(subdicts, item_of_pair, oks_u64_buffer, rows)
+//   subdicts: list of per-join-key emitted dicts (one per work item);
+//   item_of_pair: list of ints mapping each pair to its work item;
+//   oks: buffer of n*8 LE uint64 output keys; rows: list of row tuples.
+// Performs emitted[jk][ok] = row for every pair in one C pass.
+static PyObject* py_join_record_pairs(PyObject*, PyObject* args) {
+  PyObject *subdicts, *items, *rows;
+  Py_buffer oks;
+  if (!PyArg_ParseTuple(args, "OOy*O", &subdicts, &items, &oks, &rows))
+    return nullptr;
+  PyObject* sub_fast = PySequence_Fast(subdicts, "subdicts");
+  PyObject* item_fast = PySequence_Fast(items, "items");
+  PyObject* rows_fast = PySequence_Fast(rows, "rows");
+  bool fail = sub_fast == nullptr || item_fast == nullptr ||
+              rows_fast == nullptr;
+  Py_ssize_t n = fail ? 0 : PySequence_Fast_GET_SIZE(rows_fast);
+  if (!fail && ((Py_ssize_t)oks.len < n * 8 ||
+                PySequence_Fast_GET_SIZE(item_fast) != n)) {
+    PyErr_SetString(PyExc_ValueError, "record_pairs length mismatch");
+    fail = true;
+  }
+  const uint64_t* ok = fail ? nullptr
+                            : reinterpret_cast<const uint64_t*>(oks.buf);
+  Py_ssize_t nsub = fail ? 0 : PySequence_Fast_GET_SIZE(sub_fast);
+  for (Py_ssize_t i = 0; !fail && i < n; i++) {
+    Py_ssize_t w =
+        PyLong_AsSsize_t(PySequence_Fast_GET_ITEM(item_fast, i));
+    if (w < 0 || w >= nsub) {  // negative = error or invalid index; both
+      // must never reach the unchecked GET_ITEM below
+      if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_IndexError, "item index out of range");
+      fail = true;
+      break;
+    }
+    PyObject* d = PySequence_Fast_GET_ITEM(sub_fast, w);
+    if (!PyDict_Check(d)) {
+      PyErr_SetString(PyExc_TypeError, "subdict must be a dict");
+      fail = true;
+      break;
+    }
+    PyObject* key = PyLong_FromUnsignedLongLong(ok[i]);
+    if (key == nullptr) { fail = true; break; }
+    if (PyDict_SetItem(d, key,
+                       PySequence_Fast_GET_ITEM(rows_fast, i)) < 0)
+      fail = true;
+    Py_DECREF(key);
+  }
+  Py_XDECREF(sub_fast);
+  Py_XDECREF(item_fast);
+  Py_XDECREF(rows_fast);
+  PyBuffer_Release(&oks);
+  if (fail) return nullptr;
+  Py_RETURN_NONE;
+}
+
+// batch_rows_split(rows, ncols, keys_u64_buf, diffs_i64_buf)
+//   rows: list of (key:int, row:tuple, diff:int). Fills the key/diff
+//   buffers and returns a tuple of ncols value lists — the SoA transpose
+//   behind Batch.from_rows, one C pass instead of n*ncols Python steps.
+static PyObject* py_batch_rows_split(PyObject*, PyObject* args) {
+  PyObject* rows;
+  Py_ssize_t ncols;
+  Py_buffer keys_buf, diffs_buf;
+  if (!PyArg_ParseTuple(args, "Onw*w*", &rows, &ncols, &keys_buf,
+                        &diffs_buf))
+    return nullptr;
+  PyObject* fast = PySequence_Fast(rows, "rows must be a sequence");
+  if (fast == nullptr) {
+    PyBuffer_Release(&keys_buf);
+    PyBuffer_Release(&diffs_buf);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  bool fail = false;
+  if ((Py_ssize_t)keys_buf.len < n * 8 ||
+      (Py_ssize_t)diffs_buf.len < n * 8) {
+    PyErr_SetString(PyExc_ValueError, "key/diff buffer too small");
+    fail = true;
+  }
+  uint64_t* keys = reinterpret_cast<uint64_t*>(keys_buf.buf);
+  int64_t* diffs = reinterpret_cast<int64_t*>(diffs_buf.buf);
+  PyObject* cols = fail ? nullptr : PyTuple_New(ncols);
+  if (cols == nullptr) fail = true;
+  for (Py_ssize_t j = 0; !fail && j < ncols; j++) {
+    PyObject* lst = PyList_New(n);
+    if (lst == nullptr) { fail = true; break; }
+    PyTuple_SET_ITEM(cols, j, lst);
+  }
+  for (Py_ssize_t i = 0; !fail && i < n; i++) {
+    PyObject* triple = PySequence_Fast_GET_ITEM(fast, i);
+    if (!PyTuple_Check(triple) || PyTuple_GET_SIZE(triple) != 3) {
+      PyErr_SetString(PyExc_TypeError, "row entry must be (key, row, diff)");
+      fail = true;
+      break;
+    }
+    PyObject* key = PyTuple_GET_ITEM(triple, 0);
+    PyObject* row = PyTuple_GET_ITEM(triple, 1);
+    PyObject* diff = PyTuple_GET_ITEM(triple, 2);
+    keys[i] = PyLong_AsUnsignedLongLongMask(key);
+    int64_t d = PyLong_AsLongLong(diff);
+    if (PyErr_Occurred()) { fail = true; break; }
+    diffs[i] = d;
+    if (!PyTuple_Check(row) || PyTuple_GET_SIZE(row) != ncols) {
+      PyErr_SetString(PyExc_TypeError, "row tuple arity mismatch");
+      fail = true;
+      break;
+    }
+    for (Py_ssize_t j = 0; j < ncols; j++) {
+      PyObject* v = PyTuple_GET_ITEM(row, j);
+      Py_INCREF(v);
+      PyList_SET_ITEM(PyTuple_GET_ITEM(cols, j), i, v);
+    }
+  }
+  Py_DECREF(fast);
+  PyBuffer_Release(&keys_buf);
+  PyBuffer_Release(&diffs_buf);
+  if (fail) {
+    Py_XDECREF(cols);
+    return nullptr;
+  }
+  return cols;
+}
+
+// join_apply_side(state, keys, diffs, col_lists, jk_idx, error_sentinel)
+//   state: dict jk -> {rowkey: rowtuple}; keys/diffs: lists; col_lists:
+//   tuple of per-column value lists (the SoA batch); jk_idx: which column
+//   is the (single) join key. Builds each row tuple once, applies the
+//   delta to the bucket state, and groups deltas per jk — the whole
+//   Python _side_deltas pass in one C loop. Returns (deltas_dict,
+//   dirty_list, n_errors).
+static PyObject* py_join_apply_side(PyObject*, PyObject* args) {
+  PyObject *state, *keys, *diffs, *col_lists, *sentinel;
+  Py_ssize_t jk_idx;
+  if (!PyArg_ParseTuple(args, "O!OOO!nO", &PyDict_Type, &state, &keys,
+                        &diffs, &PyTuple_Type, &col_lists, &jk_idx,
+                        &sentinel))
+    return nullptr;
+  PyObject* keys_fast = PySequence_Fast(keys, "keys");
+  PyObject* diffs_fast = PySequence_Fast(diffs, "diffs");
+  if (keys_fast == nullptr || diffs_fast == nullptr) {
+    Py_XDECREF(keys_fast);
+    Py_XDECREF(diffs_fast);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(keys_fast);
+  Py_ssize_t ncols = PyTuple_GET_SIZE(col_lists);
+  std::vector<PyObject**> col_items((size_t)ncols);
+  bool fail = PySequence_Fast_GET_SIZE(diffs_fast) != n || jk_idx < 0 ||
+              jk_idx >= ncols;
+  if (fail) PyErr_SetString(PyExc_ValueError, "bad apply_side arguments");
+  for (Py_ssize_t j = 0; !fail && j < ncols; j++) {
+    PyObject* col = PyTuple_GET_ITEM(col_lists, j);
+    if (!PyList_Check(col) || PyList_GET_SIZE(col) != n) {
+      PyErr_SetString(PyExc_TypeError, "columns must be n-length lists");
+      fail = true;
+      break;
+    }
+    col_items[(size_t)j] = ((PyListObject*)col)->ob_item;
+  }
+  PyObject* deltas = fail ? nullptr : PyDict_New();
+  PyObject* dirty = fail ? nullptr : PyList_New(0);
+  Py_ssize_t n_err = 0;
+  if (deltas == nullptr || dirty == nullptr) fail = true;
+  for (Py_ssize_t i = 0; !fail && i < n; i++) {
+    PyObject* jk = col_items[(size_t)jk_idx][i];
+    if (jk == sentinel) { n_err++; continue; }
+    PyObject* key = PySequence_Fast_GET_ITEM(keys_fast, i);
+    long long d = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(diffs_fast, i));
+    if (PyErr_Occurred()) { fail = true; break; }
+    PyObject* row = PyTuple_New(ncols);
+    if (row == nullptr) { fail = true; break; }
+    for (Py_ssize_t j = 0; j < ncols; j++) {
+      PyObject* v = col_items[(size_t)j][i];
+      Py_INCREF(v);
+      PyTuple_SET_ITEM(row, j, v);
+    }
+    PyObject* bucket = PyDict_GetItemWithError(state, jk);  // borrowed
+    if (bucket == nullptr && PyErr_Occurred()) {
+      Py_DECREF(row);
+      fail = true;
+      break;
+    }
+    if (d > 0) {
+      if (bucket == nullptr) {
+        bucket = PyDict_New();
+        if (bucket == nullptr ||
+            PyDict_SetItem(state, jk, bucket) < 0) {
+          Py_XDECREF(bucket);
+          Py_DECREF(row);
+          fail = true;
+          break;
+        }
+        Py_DECREF(bucket);  // state holds it; borrowed ref stays valid
+      } else if (PyDict_Contains(bucket, key) == 1) {
+        // upsert-style re-delivery of a row key: recompute path
+        if (PyList_Append(dirty, jk) < 0) {
+          Py_DECREF(row);
+          fail = true;
+          break;
+        }
+      }
+      if (PyDict_SetItem(bucket, key, row) < 0) {
+        Py_DECREF(row);
+        fail = true;
+        break;
+      }
+    } else if (bucket != nullptr) {
+      if (PyDict_Contains(bucket, key) == 1 &&
+          PyDict_DelItem(bucket, key) < 0) {
+        Py_DECREF(row);
+        fail = true;
+        break;
+      }
+      if (PyDict_GET_SIZE(bucket) == 0 &&
+          PyDict_DelItem(state, jk) < 0) {
+        Py_DECREF(row);
+        fail = true;
+        break;
+      }
+    }
+    // deltas[jk].append((key, row, diff))
+    PyObject* dl = PyDict_GetItemWithError(deltas, jk);  // borrowed
+    if (dl == nullptr) {
+      if (PyErr_Occurred()) { Py_DECREF(row); fail = true; break; }
+      dl = PyList_New(0);
+      if (dl == nullptr || PyDict_SetItem(deltas, jk, dl) < 0) {
+        Py_XDECREF(dl);
+        Py_DECREF(row);
+        fail = true;
+        break;
+      }
+      Py_DECREF(dl);
+    }
+    PyObject* triple = PyTuple_New(3);
+    if (triple == nullptr) { Py_DECREF(row); fail = true; break; }
+    Py_INCREF(key);
+    PyTuple_SET_ITEM(triple, 0, key);
+    PyTuple_SET_ITEM(triple, 1, row);  // steals the row ref
+    PyObject* dobj = PyLong_FromLongLong(d);
+    if (dobj == nullptr) { Py_DECREF(triple); fail = true; break; }
+    PyTuple_SET_ITEM(triple, 2, dobj);
+    if (PyList_Append(dl, triple) < 0) fail = true;
+    Py_DECREF(triple);
+  }
+  Py_DECREF(keys_fast);
+  Py_DECREF(diffs_fast);
+  if (fail) {
+    Py_XDECREF(deltas);
+    Py_XDECREF(dirty);
+    return nullptr;
+  }
+  PyObject* nerr = PyLong_FromSsize_t(n_err);
+  PyObject* out = nerr ? PyTuple_Pack(3, deltas, dirty, nerr) : nullptr;
+  Py_DECREF(deltas);
+  Py_DECREF(dirty);
+  Py_XDECREF(nerr);
+  return out;
+}
+
 static PyMethodDef methods[] = {
+    {"join_apply_side", py_join_apply_side, METH_VARARGS,
+     "apply one side's columnar batch to join bucket state"},
+    {"join_ld_cross", py_join_ld_cross, METH_VARARGS,
+     "emit dL x R cross-product rows for fast-path join keys"},
+    {"join_record_pairs", py_join_record_pairs, METH_VARARGS,
+     "bulk emitted[jk][ok] = row bookkeeping"},
+    {"batch_rows_split", py_batch_rows_split, METH_VARARGS,
+     "SoA transpose of (key, row, diff) triples"},
     {"hash_object_column", py_hash_object_column, METH_VARARGS,
      "hash a sequence of values into an n*8-byte output buffer; returns "
      "indices needing python fallback"},
