@@ -32,12 +32,18 @@ class ObjectStoreConnector(BaseConnector):
     object id, with retractions for changed/removed objects."""
 
     def __init__(self, node, provider, mode: str, with_metadata: bool,
-                 refresh_interval: float):
+                 refresh_interval: float,
+                 max_failed_attempts_in_row: int | None = 8):
         super().__init__(node)
         self.provider = provider
         self.mode = mode
         self.with_metadata = with_metadata
         self.refresh_interval = refresh_interval
+        # transient remote-service failures retry this many consecutive
+        # polls before the error propagates (reference sharepoint
+        # ``max_failed_attempts_in_row``, xpacks/connectors/sharepoint/
+        # __init__.py:185-208); None = retry forever
+        self.max_failed_attempts_in_row = max_failed_attempts_in_row
         # object id -> (version, emitted row tuple)
         self._live: dict[str, tuple[Any, tuple]] = {}
         self._cache = None  # CachedObjectStorage when persistence is on
@@ -114,13 +120,31 @@ class ObjectStoreConnector(BaseConnector):
         return deltas
 
     def run(self) -> None:
-        deltas = self._scan()
+        deltas = self._scan()  # first scan failing fails loudly
         if deltas or self._persistence is None:
             self.commit_rows(deltas)
         if self.mode == "static":
             return
+        failures = 0
         while not self.should_stop():
             time_mod.sleep(self.refresh_interval)
-            deltas = self._scan()
+            try:
+                deltas = self._scan()
+            except Exception:
+                failures += 1
+                if (
+                    self.max_failed_attempts_in_row is not None
+                    and failures >= self.max_failed_attempts_in_row
+                ):
+                    raise
+                import logging
+
+                logging.getLogger("pathway_tpu").error(
+                    "object-store scan failed (%d/%s); retrying in %ss",
+                    failures, self.max_failed_attempts_in_row,
+                    self.refresh_interval,
+                )
+                continue
+            failures = 0
             if deltas:
                 self.commit_rows(deltas)

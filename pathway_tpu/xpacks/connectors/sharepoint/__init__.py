@@ -30,13 +30,23 @@ class _SharePointProvider:
         self.object_size_limit = object_size_limit
 
     def list_objects(self) -> dict[str, tuple[Any, dict]]:
+        import time as time_mod
+
         listing: dict[str, tuple[Any, dict]] = {}
         for meta in self.client.list_files(self.root_path, self.recursive):
             size = int(meta.get("size", 0) or 0)
             if self.object_size_limit is not None and size > self.object_size_limit:
                 continue
             version = (meta.get("modified_at"), size)
-            listing[meta["path"]] = (version, dict(meta))
+            meta = dict(meta)
+            # reference metadata shape (_SharePointEntryMeta.as_dict +
+            # url property, sharepoint/__init__.py:29-76)
+            base = meta.get("base_url")
+            if base and "url" not in meta:
+                meta["url"] = f"{base}{meta['path']}"
+            meta["seen_at"] = int(time_mod.time())
+            meta["status"] = "downloaded"
+            listing[meta["path"]] = (version, meta)
         return listing
 
     def fetch(self, object_id: str) -> bytes:
@@ -96,12 +106,14 @@ def read(
     object_size_limit: int | None = None,
     with_metadata: bool = False,
     refresh_interval: int = 30,
+    max_failed_attempts_in_row: int | None = 8,
     persistent_id: str | None = None,
     _client=None,
 ) -> Table:
-    """Read a SharePoint document library as binary rows. With
-    ``persistent_id``, downloads are cached by URI for deterministic
-    replay."""
+    """Read a SharePoint document library as binary rows. Transient scan
+    failures retry up to ``max_failed_attempts_in_row`` consecutive polls
+    before propagating (reference behavior). With ``persistent_id``,
+    downloads are cached by URI for deterministic replay."""
     client = _client or _office365_client(url, tenant, client_id, cert_path, thumbprint)
     schema = schema_mod.schema_from_types(data=bytes)
     if with_metadata:
@@ -110,7 +122,8 @@ def read(
     node = InputNode(G.engine_graph, cols, name=f"sharepoint({root_path})")
     provider = _SharePointProvider(client, root_path, recursive, object_size_limit)
     conn = ObjectStoreConnector(
-        node, provider, mode, with_metadata, float(refresh_interval)
+        node, provider, mode, with_metadata, float(refresh_interval),
+        max_failed_attempts_in_row=max_failed_attempts_in_row,
     )
     G.register_connector(conn)
     if persistent_id is not None:

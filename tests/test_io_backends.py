@@ -538,3 +538,111 @@ def test_airbyte_docker_envelope(tmp_path):
     if shutil.which("docker") is None:
         with pytest.raises(RuntimeError, match="docker binary"):
             DockerAirbyteSource("airbyte/source-faker:0.1.4")
+
+
+def test_gdrive_workspace_export_and_metadata():
+    """Google-Workspace files route through export (mime mapping) instead
+    of raw download, and listings carry the enriched url/path/seen_at
+    metadata the reference adds."""
+    from pathway_tpu.internals.json import unwrap_json
+    from pathway_tpu.io.gdrive import DEFAULT_MIME_TYPE_MAPPING
+
+    doc_mime = "application/vnd.google-apps.document"
+
+    class ExportingDrive:
+        def __init__(self):
+            self.export_calls = []
+            self.files = {
+                "gdoc1": {"id": "gdoc1", "name": "notes.gdoc",
+                          "mimeType": doc_mime,
+                          "modifiedTime": "2026-01-01T00:00:00Z",
+                          "size": "0"},
+                "raw1": {"id": "raw1", "name": "a.txt",
+                         "mimeType": "text/plain",
+                         "modifiedTime": "2026-01-01T00:00:00Z",
+                         "size": "3"},
+            }
+
+        def list_files(self, object_id):
+            return list(self.files.values())
+
+        def download(self, file_id, mime_type=None):
+            self.export_calls.append((file_id, mime_type))
+            if mime_type in DEFAULT_MIME_TYPE_MAPPING:
+                return b"exported-docx"
+            return b"raw"
+
+    drive = ExportingDrive()
+    t = pw.io.gdrive.read(
+        "folder", mode="static", with_metadata=True, _client=drive
+    )
+    rows, cols = _capture_rows(t)
+    by_name = {}
+    for r in rows.values():
+        meta = unwrap_json(r[cols.index("_metadata")])
+        by_name[meta["name"]] = (r[cols.index("data")], meta)
+    data, meta = by_name["notes.gdoc"]
+    assert data == b"exported-docx"
+    assert meta["url"].startswith("https://drive.google.com/file/d/gdoc1")
+    assert meta["path"] == "notes.gdoc" and meta["status"] == "downloaded"
+    assert "seen_at" in meta
+    assert ("gdoc1", doc_mime) in drive.export_calls
+    assert by_name["a.txt"][0] == b"raw"
+
+
+def test_object_store_scan_failure_tolerance(tmp_path):
+    """Transient list failures retry up to max_failed_attempts_in_row
+    consecutive polls (reference sharepoint behavior); recovery resets the
+    counter and the stream continues."""
+    import threading
+    import time as time_mod
+
+    class FlakyProvider:
+        def __init__(self):
+            self.calls = 0
+            self.objects = {"a": (1, {"path": "a"})}
+
+        def list_objects(self):
+            self.calls += 1
+            if self.calls in (2, 3):  # two transient failures mid-stream
+                raise ConnectionError("remote hiccup")
+            return dict(self.objects)
+
+        def fetch(self, oid):
+            return b"payload"
+
+    from pathway_tpu.engine.operators.core import InputNode
+    from pathway_tpu.internals.parse_graph import G as PG
+    from pathway_tpu.io._object_store import ObjectStoreConnector
+
+    pw.clear_graph()
+    provider = FlakyProvider()
+    node = InputNode(PG.engine_graph, ["data"], name="flaky")
+    conn = ObjectStoreConnector(
+        node, provider, "streaming", False, 0.05,
+        max_failed_attempts_in_row=8,
+    )
+    PG.register_connector(conn)
+    from pathway_tpu.internals.table import Table
+    from pathway_tpu.internals.universe import Universe
+    from pathway_tpu.internals import schema as schema_mod
+
+    t = Table(node, schema_mod.schema_from_types(data=bytes), Universe())
+    got = []
+    pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: got.append(
+        (row["data"], is_addition)))
+
+    def feeder():
+        deadline = time_mod.time() + 30
+        while time_mod.time() < deadline and provider.calls < 5:
+            time_mod.sleep(0.05)
+        provider.objects["b"] = (1, {"path": "b"})  # post-recovery update
+        while time_mod.time() < deadline and len(got) < 2:
+            time_mod.sleep(0.05)
+        conn._stop.set()
+        conn.close()
+
+    threading.Thread(target=feeder, daemon=True).start()
+    pw.run()
+    assert provider.calls >= 5  # survived the two failures and kept polling
+    assert (b"payload", True) in got and len(got) >= 2
