@@ -162,12 +162,14 @@ class USearchKnn(BruteForceKnn):
 
 
 class _IvfIndexFactory(ExternalIndexFactory):
-    def __init__(self, dimensions, n_cells, nprobe, metric, train_after):
+    def __init__(self, dimensions, n_cells, nprobe, metric, train_after,
+                 dtype=None):
         self.dimensions = dimensions
         self.n_cells = n_cells
         self.nprobe = nprobe
         self.metric = metric
         self.train_after = train_after
+        self.dtype = dtype
 
     def make_instance(self):
         from pathway_tpu.ops.ivf import IvfFlatIndex
@@ -178,6 +180,8 @@ class _IvfIndexFactory(ExternalIndexFactory):
             nprobe=self.nprobe,
             metric=self.metric,
             train_after=self.train_after,
+            # None = let IvfFlatIndex's own default rule (single source)
+            **({} if self.dtype is None else {"dtype": self.dtype}),
         )
 
 
@@ -197,6 +201,7 @@ class IvfKnn(BruteForceKnn):
         metric: DistanceMetric | str = DistanceMetric.COS,
         train_after: int | None = None,
         embedder: Callable | None = None,
+        dtype=None,
     ):
         super().__init__(
             data_column,
@@ -208,11 +213,14 @@ class IvfKnn(BruteForceKnn):
         self.n_cells = n_cells
         self.nprobe = nprobe
         self.train_after = train_after
+        # jnp.int8 stores cells quantized (half the HBM per probed row,
+        # int8-MXU scoring); None/bfloat16 is the full-precision default
+        self.dtype = dtype
 
     def make_factory(self):
         return _IvfIndexFactory(
             self.dimensions, self.n_cells, self.nprobe, self.metric,
-            self.train_after,
+            self.train_after, self.dtype,
         )
 
 
@@ -311,6 +319,9 @@ class IvfKnnFactory(KnnIndexFactory):
     nprobe: int = 8
     metric: DistanceMetric | str = DistanceMetric.COS
     train_after: int | None = None
+    # jnp.int8 = quantized cell storage (half the HBM per probed row,
+    # int8-MXU scoring; bench config-5 reports the recall delta per run)
+    dtype: Any = None
 
     def build_inner_index(self, data_column, metadata_column=None) -> InnerIndex:
         return IvfKnn(
@@ -322,6 +333,7 @@ class IvfKnnFactory(KnnIndexFactory):
             metric=self.metric,
             train_after=self.train_after,
             embedder=self.embedder,
+            dtype=self.dtype,
         )
 
 

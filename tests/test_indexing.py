@@ -402,3 +402,26 @@ def test_ivf_int8_cells_match_bf16_recall():
     # relatively largest in tiny dimensions); at embedding dims (384) the
     # measured delta is ~0 (bench config-5 reports it per run)
     assert recalls["int8"] >= recalls["bf16"] - 0.1, recalls
+
+
+def test_ivf_factory_int8_through_data_index():
+    """IvfKnnFactory(dtype=jnp.int8) plumbs the quantized storage through
+    build_inner_index -> IvfKnn -> the engine-facing factory, and the
+    index answers through the full DataIndex surface."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.stdlib.indexing import DataIndex, IvfKnnFactory
+
+    docs, queries, vecs = _vec_tables()
+    fac = IvfKnnFactory(dimensions=8, n_cells=4, nprobe=4, train_after=64,
+                        dtype=jnp.int8)
+    inner = fac.build_inner_index(docs.vec)
+    assert inner.dtype == jnp.int8
+    inst = inner.make_factory().make_instance()
+    assert inst.dtype == jnp.int8 and inst._scales is not None
+    index = DataIndex(docs, inner)
+    res = index.query_as_of_now(queries.qvec, number_of_matches=1)
+    rows, cols = _capture_rows(res)
+    di = cols.index("doc")
+    found = sorted(row[di][0] for row in rows.values())
+    assert found == ["d0", "d1", "d2"]
