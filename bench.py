@@ -1103,17 +1103,60 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 - auxiliary metrics must not sink the headline
             diag(warning="extra_metric_failed", which=fn.__name__, error=repr(exc))
 
-    print(
-        json.dumps(
-            {
-                "metric": "rag_ingest_embed_index_docs_per_sec",
-                "value": round(docs_per_sec, 1),
-                "unit": "docs/s",
-                "vs_baseline": round(docs_per_sec / BASELINE_DOCS_PER_SEC, 3),
-                "extra_metrics": extra,
-            }
-        )
-    )
+    record = {
+        "metric": "rag_ingest_embed_index_docs_per_sec",
+        "value": round(docs_per_sec, 1),
+        "unit": "docs/s",
+        "vs_baseline": round(docs_per_sec / BASELINE_DOCS_PER_SEC, 3),
+        "extra_metrics": extra,
+    }
+    # Full record FIRST (for humans / complete archive) ...
+    print(json.dumps(record), flush=True)
+
+    # ... compact summary LAST: the driver stores only the tail of stdout,
+    # so the final line must alone carry every key number (VERDICT r4 §weak 1).
+    def _m(name: str):
+        return next((m for m in extra if m.get("metric") == name), None) or {}
+
+    ivf = _m("ivf_recall_at_10")
+    big = (ivf.get("detail") or {}).get("sweep_4M") or {}
+    join = _m("streaming_join_rows_per_sec")
+    summary = {
+        "metric": "rag_ingest_embed_index_docs_per_sec",
+        "value": round(docs_per_sec, 1),
+        "unit": "docs/s",
+        "vs_baseline": round(docs_per_sec / BASELINE_DOCS_PER_SEC, 3),
+        "summary": {
+            "ingest_mfu_pct": mfu_metric.get("value"),
+            "config4_engine_docs_per_sec": _m(
+                "streaming_engine_embed_upsert_docs_per_sec"
+            ).get("value"),
+            "join_e2e_rows_per_sec": join.get("value"),
+            "join_hotkey_deltas_per_sec": (join.get("detail") or {}).get(
+                "hotkey_single_insert_deltas_per_sec"
+            ),
+            "wordcount_rows_per_sec": _m(
+                "wordcount_streaming_rows_per_sec"
+            ).get("value"),
+            "decoder_tokens_per_sec": _m(
+                "decoder_generate_tokens_per_sec"
+            ).get("value"),
+            "knn_recall_at_10": _m("knn_recall_at_10").get("value"),
+            "rerank_p50_ms": _m("rerank_stage_p50_ms").get("value"),
+            "ivf_recall_at_10": ivf.get("value"),
+            "ivf_big": {
+                k: big.get(k)
+                for k in (
+                    "corpus",
+                    "recall_at_10_vs_exact",
+                    "speedup_vs_exact_batch64",
+                    "ivf_qps_batch64",
+                )
+                if k in big
+            },
+        },
+    }
+    print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

@@ -23,7 +23,10 @@ class AsofNowJoinNode(JoinNode):
         super().__init__(*args, **kwargs)
         self._left_emitted: dict[int, dict[int, tuple]] = {}
 
-    _state_attrs = ("_left", "_right", "_emitted", "_left_emitted")
+    _state_attrs = (
+        "_left", "_right", "_emitted", "_left_jk", "_right_jk",
+        "_left_emitted",
+    )
 
     def reset(self):
         super().reset()
@@ -33,7 +36,7 @@ class AsofNowJoinNode(JoinNode):
         lb, rb = ins
         # right side: just maintain state (no retriggering)
         if rb is not None:
-            self._side_deltas(self._right, rb, self.right_on)
+            self._side_deltas(self._right, self._right_jk, rb, self.right_on)
         if lb is None:
             return None
         rows: list[tuple[int, tuple, int]] = []

@@ -100,21 +100,31 @@ class JoinNode(Node):
         self._left: dict[Any, dict[int, tuple]] = defaultdict(dict)
         self._right: dict[Any, dict[int, tuple]] = defaultdict(dict)
         self._emitted: dict[Any, dict[int, tuple]] = defaultdict(dict)
+        # row key -> its current jk, per side: a raw re-delivery (insert
+        # of a live row key with NO retraction) that CHANGES the join key
+        # must retract the stale row from its previous bucket
+        self._left_jk: dict[int, Any] = {}
+        self._right_jk: dict[int, Any] = {}
 
-    _state_attrs = ("_left", "_right", "_emitted")
+    _state_attrs = ("_left", "_right", "_emitted", "_left_jk", "_right_jk")
 
     def reset(self):
         self._left = defaultdict(dict)
         self._right = defaultdict(dict)
         self._emitted = defaultdict(dict)
+        self._left_jk = {}
+        self._right_jk = {}
 
     def _side_deltas(
-        self, state: dict, batch: Batch, on: list[str]
+        self, state: dict, key2jk: dict, batch: Batch, on: list[str]
     ) -> tuple[dict[Any, list[tuple[int, tuple, int]]], set]:
         """Apply one side's batch to its bucket state; returns the per-jk
         delta rows (columnar extraction — no per-row name lookups) plus the
-        jks where an insert REPLACED an existing row key — those need the
-        recompute path (the replaced row's pairs must retract)."""
+        jks needing the recompute path: where an insert REPLACED an
+        existing row key (the replaced row's pairs must retract), and —
+        via ``key2jk`` — the PREVIOUS bucket of a re-delivered key whose
+        join key changed (its stale row is evicted here and its pairs
+        retract through the recompute diff)."""
         cols = batch.cols
         col_lists = [c.tolist() for c in cols.values()]
         keys = batch.keys.tolist()
@@ -122,10 +132,11 @@ class JoinNode(Node):
         native = _native_join()
         if native is not None and len(on) == 1:
             # the whole pass (row assembly, bucket updates, per-jk delta
-            # grouping, upsert-dirty detection) in one C loop
+            # grouping, upsert-dirty detection, stale-bucket eviction) in
+            # one C loop
             jk_idx = list(cols).index(on[0])
             deltas, dirty_list, n_err = native.join_apply_side(
-                state, keys, diffs, tuple(col_lists), jk_idx, ERROR
+                state, key2jk, keys, diffs, tuple(col_lists), jk_idx, ERROR
             )
             for _ in range(n_err):
                 get_global_error_log().log("Error value in join key")
@@ -147,16 +158,37 @@ class JoinNode(Node):
             if (jk is ERROR) if single else any(v is ERROR for v in jk):
                 get_global_error_log().log("Error value in join key")
                 continue
-            bucket = state[jk]
             if diff > 0:
+                old = key2jk.get(key)
+                if old is not None and old != jk:
+                    # re-delivery changed the join key: evict the stale
+                    # row and recompute its old bucket
+                    ob = state.get(old)
+                    if ob is not None:
+                        ob.pop(key, None)
+                        if not ob:
+                            del state[old]
+                    dirty.add(old)
+                    deltas.setdefault(old, [])
+                bucket = state[jk]
                 if key in bucket:
                     dirty.add(jk)  # upsert-style re-delivery of a row key
                 bucket[key] = row
+                key2jk[key] = jk
+                deltas[jk].append((key, row, diff))
             else:
-                bucket.pop(key, None)
-            if not bucket:
-                del state[jk]
-            deltas[jk].append((key, row, diff))
+                old = key2jk.pop(key, None)
+                tgt = old if old is not None else jk
+                bucket = state.get(tgt)
+                if bucket is not None:
+                    bucket.pop(key, None)
+                    if not bucket:
+                        del state[tgt]
+                deltas[tgt].append((key, row, diff))
+                if old is not None and old != jk:
+                    # retraction delivered with a stale join key: the row
+                    # actually lived in ``old`` — recompute that bucket
+                    dirty.add(tgt)
         return deltas, dirty
 
     def _out_key(self, lk: int | None, rk: int | None) -> int:
@@ -233,12 +265,12 @@ class JoinNode(Node):
     def step(self, time, ins):
         lb, rb = ins
         ldeltas, ldirty = (
-            self._side_deltas(self._left, lb, self.left_on)
+            self._side_deltas(self._left, self._left_jk, lb, self.left_on)
             if lb is not None
             else ({}, set())
         )
         rdeltas, rdirty = (
-            self._side_deltas(self._right, rb, self.right_on)
+            self._side_deltas(self._right, self._right_jk, rb, self.right_on)
             if rb is not None
             else ({}, set())
         )

@@ -108,7 +108,9 @@ class ExecutableAirbyteSource:
                     message = json_mod.loads(content)
                 except ValueError:
                     continue  # connectors log non-JSON noise on stdout
-                if message.get("trace", {}).get("error"):
+                if not isinstance(message, dict):
+                    continue  # valid-JSON scalar noise (e.g. bare strings)
+                if (message.get("trace") or {}).get("error"):
                     raise AirbyteSourceError(
                         json_mod.dumps(message["trace"]["error"])
                     )

@@ -337,6 +337,20 @@ def read(
     start_from_latest: bool = False,
     **kwargs,
 ) -> Table:
+    """Read a Kafka topic as a streaming table.
+
+    Memory contract: when ``schema`` declares primary-key columns the
+    reader runs an upsert session — it must retract the previous row for
+    a re-delivered key, so it retains the last-emitted row tuple for
+    EVERY live primary key for the life of the connector (host memory
+    ~ keyspace x row width). Unavoidable for upsert retraction
+    semantics; for unbounded-cardinality topics, prefer an append-only
+    schema (no primary key) and deduplicate downstream where state can
+    be compacted by temporal behaviors.
+
+    Reference parity: ``io/kafka/__init__.py`` read() in the reference
+    (session-type selection from the schema's primary key).
+    """
     from pathway_tpu.internals import schema as schema_mod
 
     if format == "raw":

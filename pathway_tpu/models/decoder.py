@@ -410,9 +410,17 @@ def cast_params_for_inference(params: dict, cfg: DecoderConfig) -> dict:
     if cfg.dtype == jnp.float32:
         return params
 
+    _LN_LEAVES = frozenset(
+        f"{ln}_{leaf}"
+        for ln in ("ln1", "ln2", "ln_f")
+        for leaf in ("scale", "bias")
+    )
+
     def cast(path, p):
-        name = "/".join(str(getattr(k, "key", k)) for k in path)
-        if "ln" in name or p.dtype != jnp.float32:
+        # exact leaf names, not an "ln" substring test — a future matmul
+        # weight that happens to contain "ln" in its path must still cast
+        leaf = str(getattr(path[-1], "key", path[-1])) if path else ""
+        if leaf in _LN_LEAVES or p.dtype != jnp.float32:
             return p
         return p.astype(cfg.dtype)
 

@@ -1798,19 +1798,50 @@ static PyObject* py_batch_rows_split(PyObject*, PyObject* args) {
   return cols;
 }
 
-// join_apply_side(state, keys, diffs, col_lists, jk_idx, error_sentinel)
-//   state: dict jk -> {rowkey: rowtuple}; keys/diffs: lists; col_lists:
-//   tuple of per-column value lists (the SoA batch); jk_idx: which column
-//   is the (single) join key. Builds each row tuple once, applies the
-//   delta to the bucket state, and groups deltas per jk — the whole
-//   Python _side_deltas pass in one C loop. Returns (deltas_dict,
-//   dirty_list, n_errors).
+// Ensure deltas[jk] exists and return it (borrowed); nullptr on error.
+static PyObject* join_delta_list(PyObject* deltas, PyObject* jk) {
+  PyObject* dl = PyDict_GetItemWithError(deltas, jk);  // borrowed
+  if (dl == nullptr) {
+    if (PyErr_Occurred()) return nullptr;
+    dl = PyList_New(0);
+    if (dl == nullptr || PyDict_SetItem(deltas, jk, dl) < 0) {
+      Py_XDECREF(dl);
+      return nullptr;
+    }
+    Py_DECREF(dl);  // deltas holds it; borrowed ref stays valid
+  }
+  return dl;
+}
+
+// Remove `key` from state[jk]'s bucket (dropping an emptied bucket).
+// Returns 0 ok, -1 error.
+static int join_evict(PyObject* state, PyObject* jk, PyObject* key) {
+  PyObject* bucket = PyDict_GetItemWithError(state, jk);  // borrowed
+  if (bucket == nullptr) return PyErr_Occurred() ? -1 : 0;
+  int has = PyDict_Contains(bucket, key);
+  if (has < 0) return -1;
+  if (has == 1 && PyDict_DelItem(bucket, key) < 0) return -1;
+  if (PyDict_GET_SIZE(bucket) == 0 && PyDict_DelItem(state, jk) < 0)
+    return -1;
+  return 0;
+}
+
+// join_apply_side(state, key2jk, keys, diffs, col_lists, jk_idx,
+//                 error_sentinel)
+//   state: dict jk -> {rowkey: rowtuple}; key2jk: dict rowkey -> its
+//   current jk (stale-bucket eviction for key-changing raw
+//   re-deliveries); keys/diffs: lists; col_lists: tuple of per-column
+//   value lists (the SoA batch); jk_idx: which column is the (single)
+//   join key. Builds each row tuple once, applies the delta to the
+//   bucket state, and groups deltas per jk — the whole Python
+//   _side_deltas pass in one C loop. Returns (deltas_dict, dirty_list,
+//   n_errors).
 static PyObject* py_join_apply_side(PyObject*, PyObject* args) {
-  PyObject *state, *keys, *diffs, *col_lists, *sentinel;
+  PyObject *state, *key2jk, *keys, *diffs, *col_lists, *sentinel;
   Py_ssize_t jk_idx;
-  if (!PyArg_ParseTuple(args, "O!OOO!nO", &PyDict_Type, &state, &keys,
-                        &diffs, &PyTuple_Type, &col_lists, &jk_idx,
-                        &sentinel))
+  if (!PyArg_ParseTuple(args, "O!O!OOO!nO", &PyDict_Type, &state,
+                        &PyDict_Type, &key2jk, &keys, &diffs,
+                        &PyTuple_Type, &col_lists, &jk_idx, &sentinel))
     return nullptr;
   PyObject* keys_fast = PySequence_Fast(keys, "keys");
   PyObject* diffs_fast = PySequence_Fast(diffs, "diffs");
@@ -1851,18 +1882,49 @@ static PyObject* py_join_apply_side(PyObject*, PyObject* args) {
       Py_INCREF(v);
       PyTuple_SET_ITEM(row, j, v);
     }
-    PyObject* bucket = PyDict_GetItemWithError(state, jk);  // borrowed
-    if (bucket == nullptr && PyErr_Occurred()) {
+    PyObject* old = PyDict_GetItemWithError(key2jk, key);  // borrowed
+    if (old == nullptr && PyErr_Occurred()) {
       Py_DECREF(row);
       fail = true;
       break;
     }
+    int moved = 0;  // row key is live under a DIFFERENT jk
+    if (old != nullptr && old != jk) {
+      moved = PyObject_RichCompareBool(old, jk, Py_EQ);
+      if (moved < 0) { Py_DECREF(row); fail = true; break; }
+      moved = !moved;
+    }
+    // which deltas[...] list this triple lands in: the delivered jk for
+    // inserts, the row's ACTUAL bucket for retractions (a retraction
+    // carrying a stale join key must drain from where the row lives)
+    PyObject* grp;
     if (d > 0) {
+      if (moved) {
+        // key-changing raw re-delivery: evict the stale row and mark
+        // the old bucket for recompute (its pairs must retract)
+        if (join_evict(state, old, key) < 0 ||
+            PyList_Append(dirty, old) < 0 ||
+            join_delta_list(deltas, old) == nullptr) {
+          Py_DECREF(row);
+          fail = true;
+          break;
+        }
+      }
+      grp = jk;
+      Py_INCREF(grp);
+      PyObject* bucket = PyDict_GetItemWithError(state, jk);  // borrowed
+      if (bucket == nullptr && PyErr_Occurred()) {
+        Py_DECREF(grp);
+        Py_DECREF(row);
+        fail = true;
+        break;
+      }
       if (bucket == nullptr) {
         bucket = PyDict_New();
         if (bucket == nullptr ||
             PyDict_SetItem(state, jk, bucket) < 0) {
           Py_XDECREF(bucket);
+          Py_DECREF(grp);
           Py_DECREF(row);
           fail = true;
           break;
@@ -1871,53 +1933,65 @@ static PyObject* py_join_apply_side(PyObject*, PyObject* args) {
       } else if (PyDict_Contains(bucket, key) == 1) {
         // upsert-style re-delivery of a row key: recompute path
         if (PyList_Append(dirty, jk) < 0) {
+          Py_DECREF(grp);
           Py_DECREF(row);
           fail = true;
           break;
         }
       }
-      if (PyDict_SetItem(bucket, key, row) < 0) {
+      if (PyDict_SetItem(bucket, key, row) < 0 ||
+          PyDict_SetItem(key2jk, key, jk) < 0) {
+        Py_DECREF(grp);
         Py_DECREF(row);
         fail = true;
         break;
       }
-    } else if (bucket != nullptr) {
-      if (PyDict_Contains(bucket, key) == 1 &&
-          PyDict_DelItem(bucket, key) < 0) {
+    } else {
+      grp = old != nullptr ? old : jk;
+      Py_INCREF(grp);  // must survive the key2jk delete below
+      if (old != nullptr && PyDict_DelItem(key2jk, key) < 0) {
+        Py_DECREF(grp);
         Py_DECREF(row);
         fail = true;
         break;
       }
-      if (PyDict_GET_SIZE(bucket) == 0 &&
-          PyDict_DelItem(state, jk) < 0) {
+      if (join_evict(state, grp, key) < 0 ||
+          (moved && PyList_Append(dirty, grp) < 0)) {
+        Py_DECREF(grp);
         Py_DECREF(row);
         fail = true;
         break;
       }
     }
-    // deltas[jk].append((key, row, diff))
-    PyObject* dl = PyDict_GetItemWithError(deltas, jk);  // borrowed
+    // deltas[grp].append((key, row, diff))
+    PyObject* dl = join_delta_list(deltas, grp);
     if (dl == nullptr) {
-      if (PyErr_Occurred()) { Py_DECREF(row); fail = true; break; }
-      dl = PyList_New(0);
-      if (dl == nullptr || PyDict_SetItem(deltas, jk, dl) < 0) {
-        Py_XDECREF(dl);
-        Py_DECREF(row);
-        fail = true;
-        break;
-      }
-      Py_DECREF(dl);
+      Py_DECREF(grp);
+      Py_DECREF(row);
+      fail = true;
+      break;
     }
     PyObject* triple = PyTuple_New(3);
-    if (triple == nullptr) { Py_DECREF(row); fail = true; break; }
+    if (triple == nullptr) {
+      Py_DECREF(grp);
+      Py_DECREF(row);
+      fail = true;
+      break;
+    }
     Py_INCREF(key);
     PyTuple_SET_ITEM(triple, 0, key);
     PyTuple_SET_ITEM(triple, 1, row);  // steals the row ref
     PyObject* dobj = PyLong_FromLongLong(d);
-    if (dobj == nullptr) { Py_DECREF(triple); fail = true; break; }
+    if (dobj == nullptr) {
+      Py_DECREF(grp);
+      Py_DECREF(triple);
+      fail = true;
+      break;
+    }
     PyTuple_SET_ITEM(triple, 2, dobj);
     if (PyList_Append(dl, triple) < 0) fail = true;
     Py_DECREF(triple);
+    Py_DECREF(grp);
   }
   Py_DECREF(keys_fast);
   Py_DECREF(diffs_fast);

@@ -324,6 +324,18 @@ class BruteForceKnnIndex:
         rows = input_ids.shape[0]
         if rows < m:
             raise ValueError(f"{m} keys but only {rows} token rows")
+        if query_rows:
+            # degenerate top-k (k=0) and out-of-range query slices would
+            # silently produce empty/garbage results from the fused kernel
+            if k < 1:
+                raise ValueError(
+                    f"query_rows={query_rows} requires k >= 1 (got {k})"
+                )
+            if not 0 <= query_rows <= rows:
+                raise ValueError(
+                    f"query_rows={query_rows} must be within the {rows} "
+                    f"token rows"
+                )
         if self.n + rows > self.capacity:
             import warnings
 

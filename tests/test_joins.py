@@ -261,6 +261,66 @@ def test_join_reinsert_same_key_replaces_pairs():
     assert got == [((1, "u"), -1), ((2, "u"), 1)], got
 
 
+def test_join_redelivery_changes_join_key():
+    """A raw re-delivery (insert of a live row key, NO retraction) that
+    CHANGES the join key must retract the stale row's pairs from its
+    previous bucket — key2jk tracking, both native and fallback paths."""
+    from pathway_tpu.engine.batch import Batch
+    from pathway_tpu.engine.graph import EngineGraph, Node
+    from pathway_tpu.engine.operators import join as join_mod
+
+    # fallback FIRST: a missing native build must not skip past it
+    for native in (False, True):
+        saved = join_mod._native_lib
+        if not native:
+            join_mod._native_lib = None
+        try:
+            if native and join_mod._native_join() is None:
+                continue  # native extension unavailable; fallback covered
+            g = EngineGraph()
+            left = Node(g, [], ["oid", "uid"], "L")
+            right = Node(g, [], ["uid", "name"], "R")
+            node = join_mod.JoinNode(
+                g, left, right, ["uid"], ["uid"], "inner",
+                [("oid", "left", "oid"), ("name", "right", "name")],
+            )
+            node.step(0, [None, Batch.from_rows(
+                ["uid", "name"], [(900, (7, "u"), 1), (901, (8, "v"), 1)]
+            )])
+            o1 = node.step(1, [
+                Batch.from_rows(["oid", "uid"], [(100, (1, 7), 1)]), None
+            ])
+            assert len(o1) == 1 and o1.diffs.tolist() == [1]
+            # same row key 100, join key moves 7 -> 8, no retraction first
+            o2 = node.step(2, [
+                Batch.from_rows(["oid", "uid"], [(100, (2, 8), 1)]), None
+            ])
+            got = sorted(
+                (row, d)
+                for row, d in zip(
+                    zip(*[c.tolist() for c in o2.cols.values()]),
+                    o2.diffs.tolist(),
+                )
+            )
+            assert got == [((1, "u"), -1), ((2, "v"), 1)], (native, got)
+            # the stale row is gone from the old bucket, not just hidden
+            assert 100 not in node._left.get(7, {}), native
+            # and a later retraction of the moved row cleans up fully
+            o3 = node.step(3, [
+                Batch.from_rows(["oid", "uid"], [(100, (2, 8), -1)]), None
+            ])
+            assert [
+                (row, d)
+                for row, d in zip(
+                    zip(*[c.tolist() for c in o3.cols.values()]),
+                    o3.diffs.tolist(),
+                )
+            ] == [((2, "v"), -1)], native
+            assert not node._left_jk, native
+        finally:
+            join_mod._native_lib = saved
+
+
 def test_cross_join_empty_key_list():
     """A join with an EMPTY key list (cross join) buckets every row under
     (); the columnar key extraction must not drop rows for on=[]."""
