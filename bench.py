@@ -405,7 +405,10 @@ def config4_streaming_engine() -> dict:
 
     docs = pw.io.kafka.read(broker, topic="docs", schema=DocSchema)
     embedder = SentenceTransformerEmbedder(
-        model="minilm-l6", max_batch_size=1024
+        # deferred: fully-async two-phase mode — the engine pump overlaps
+        # host dataflow (parse/join/index/subscribe) with the TPU embed,
+        # instead of parking each epoch on the device drain
+        model="minilm-l6", max_batch_size=1024, deferred=True,
     )
     # warm the embed + index executables for the stream's shape buckets so
     # the timed window measures ENGINE throughput, not one-time XLA compiles

@@ -344,18 +344,7 @@ class ExpressionEvaluator:
         parallel lists of argument values. This is the TPU microbatch point —
         one padded XLA dispatch per chunk instead of one host call per row."""
         out = np.empty(n, dtype=object)
-        todo: list[int] = []
-        for i in range(n):
-            a = [x[i] for x in args]
-            kw = {k: v[i] for k, v in kwargs.items()}
-            if any(v is ERROR for v in a) or any(v is ERROR for v in kw.values()):
-                out[i] = ERROR
-            elif e._propagate_none and (
-                any(v is None for v in a) or any(v is None for v in kw.values())
-            ):
-                out[i] = None
-            else:
-                todo.append(i)
+        todo = scan_apply_rows(e, args, kwargs, n, out)
         fun = e._fun
         chunk = e._max_batch_size or len(todo) or 1
         submit = getattr(e, "_submit_fun", None)
@@ -391,51 +380,8 @@ class ExpressionEvaluator:
         remote accelerator this costs one round trip per EPOCH instead of
         one per chunk (the reference analogously drains a whole timely batch
         into FuturesUnordered, operators.rs:269-305)."""
-        resolve = e._resolve_fun
-        handles: list[tuple[list[int], Any]] = []
-        for start in range(0, len(todo), chunk):
-            idx = todo[start : start + chunk]
-            batch_args = [[x[i] for i in idx] for x in args]
-            batch_kwargs = {k: [v[i] for i in idx] for k, v in kwargs.items()}
-            try:
-                handles.append((idx, submit(*batch_args, **batch_kwargs)))
-            except Exception as exc:  # noqa: BLE001
-                _log_error(
-                    f"batched apply submit error: {type(exc).__name__}: {exc}"
-                )
-                for i in idx:
-                    out[i] = ERROR
-        if not handles:
-            return out
-        try:
-            all_results = resolve([h for _, h in handles])
-            if len(all_results) != len(handles):
-                raise ValueError(
-                    f"two-phase UDF resolved {len(all_results)} chunks "
-                    f"for {len(handles)} submitted"
-                )
-        except Exception as exc:  # noqa: BLE001
-            _log_error(f"batched apply resolve error: {type(exc).__name__}: {exc}")
-            for idx, _ in handles:
-                for i in idx:
-                    out[i] = ERROR
-            return out
-        for (idx, _), results in zip(handles, all_results):
-            try:
-                if len(results) != len(idx):
-                    raise ValueError(
-                        f"batched UDF returned {len(results)} results for "
-                        f"a chunk of {len(idx)}"
-                    )
-                for i, r in zip(idx, results):
-                    out[i] = dt.coerce_value(r, e._return_type)
-            except Exception as exc:  # noqa: BLE001 - degrade the chunk only
-                _log_error(
-                    f"batched apply result error: {type(exc).__name__}: {exc}"
-                )
-                for i in idx:
-                    out[i] = ERROR
-        return out
+        handles = submit_apply_chunks(e, args, kwargs, todo, chunk, out)
+        return finish_apply_chunks(e, out, handles)
 
     def _eval_apply_async(self, e, args, kwargs, n) -> np.ndarray:
         """Resolve one epoch's async-UDF calls concurrently (the reference
@@ -592,3 +538,87 @@ def _to_string(v) -> str:
     if v is None:
         return "None"
     return str(v)
+
+
+# -- two-phase batched apply helpers (shared by the in-epoch pipelined path
+# and RowwiseNode's deferred drainer) ------------------------------------
+
+
+def scan_apply_rows(e, args, kwargs, n: int, out: np.ndarray) -> list[int]:
+    """Pre-scan one epoch batch for a batched apply: short-circuit ERROR /
+    propagated-None rows into ``out`` and return the indexes still to run."""
+    todo: list[int] = []
+    propagate_none = e._propagate_none
+    for i in range(n):
+        a = [x[i] for x in args]
+        kw = {k: v[i] for k, v in kwargs.items()}
+        if any(v is ERROR for v in a) or any(v is ERROR for v in kw.values()):
+            out[i] = ERROR
+        elif propagate_none and (
+            any(v is None for v in a) or any(v is None for v in kw.values())
+        ):
+            out[i] = None
+        else:
+            todo.append(i)
+    return todo
+
+
+def submit_apply_chunks(
+    e, args, kwargs, todo: list[int], chunk: int, out: np.ndarray
+) -> list[tuple[list[int], Any]]:
+    """Dispatch every chunk of a two-phase batched apply (no device wait);
+    a chunk whose submit raises degrades its rows to ERROR."""
+    submit = e._submit_fun
+    handles: list[tuple[list[int], Any]] = []
+    for start in range(0, len(todo), chunk):
+        idx = todo[start : start + chunk]
+        batch_args = [[x[i] for i in idx] for x in args]
+        batch_kwargs = {k: [v[i] for i in idx] for k, v in kwargs.items()}
+        try:
+            handles.append((idx, submit(*batch_args, **batch_kwargs)))
+        except Exception as exc:  # noqa: BLE001
+            _log_error(
+                f"batched apply submit error: {type(exc).__name__}: {exc}"
+            )
+            for i in idx:
+                out[i] = ERROR
+    return handles
+
+
+def finish_apply_chunks(
+    e, out: np.ndarray, handles: list[tuple[list[int], Any]]
+) -> np.ndarray:
+    """Drain every submitted chunk with ONE ``resolve`` call and coerce the
+    results into ``out`` (the blocking half of the two-phase protocol —
+    also run off-thread, chunk at a time, by the deferred Rowwise path)."""
+    if not handles:
+        return out
+    try:
+        all_results = e._resolve_fun([h for _, h in handles])
+        if len(all_results) != len(handles):
+            raise ValueError(
+                f"two-phase UDF resolved {len(all_results)} chunks "
+                f"for {len(handles)} submitted"
+            )
+    except Exception as exc:  # noqa: BLE001
+        _log_error(f"batched apply resolve error: {type(exc).__name__}: {exc}")
+        for idx, _ in handles:
+            for i in idx:
+                out[i] = ERROR
+        return out
+    for (idx, _), results in zip(handles, all_results):
+        try:
+            if len(results) != len(idx):
+                raise ValueError(
+                    f"batched UDF returned {len(results)} results for "
+                    f"a chunk of {len(idx)}"
+                )
+            for i, r in zip(idx, results):
+                out[i] = dt.coerce_value(r, e._return_type)
+        except Exception as exc:  # noqa: BLE001 - degrade the chunk only
+            _log_error(
+                f"batched apply result error: {type(exc).__name__}: {exc}"
+            )
+            for i in idx:
+                out[i] = ERROR
+    return out

@@ -102,6 +102,23 @@ class RowwiseNode(Node):
         )
         # key -> [refcount, {out_col: value}]
         self._replay_cache: dict[int, list] = {}
+        # top-level deferred two-phase applies (fully-async executor): the
+        # epoch submits their chunks and returns WITHOUT waiting for the
+        # device — a drainer thread resolves off-epoch and injects the
+        # completed batch at a later engine time, so the scheduler keeps
+        # ingesting/stepping while the accelerator computes (reference
+        # fully-async UDF semantics, src/python_api/mod.rs fully_async;
+        # here fused with the TPU two-phase dispatch protocol)
+        self._deferred_names = {
+            name
+            for name, e in expressions.items()
+            if getattr(e, "_deferred", False)
+            and getattr(e, "_batched", False)
+            and getattr(e, "_submit_fun", None) is not None
+            and getattr(e, "_resolve_fun", None) is not None
+        }
+        self._drain_queue = None
+        self._drain_thread = None
 
     _state_attrs = ("_replay_cache",)
 
@@ -111,11 +128,34 @@ class RowwiseNode(Node):
     def reset(self):
         super().reset()
         self._replay_cache = {}
+        if self._drain_queue is not None:
+            # release the previous run's drainer. A clean run finishes
+            # with the queue empty (async_inflight hits zero first), but
+            # a run killed by an epoch exception can leave items behind —
+            # discard them so the stale thread doesn't keep resolving on
+            # the device alongside the new run's drainer
+            import queue as queue_mod
+
+            try:
+                while True:
+                    self._drain_queue.get_nowait()
+            except queue_mod.Empty:
+                pass
+            self._drain_queue.put(None)
+            self._drain_queue = None
+            self._drain_thread = None
 
     def step(self, time, ins):
         (batch,) = ins
         if batch is None or len(batch) == 0:
             return None
+        if (
+            self._deferred_names
+            and not self._nondet
+            and getattr(self, "scheduler", None) is not None
+            and getattr(self.scheduler, "allow_deferred", False)
+        ):
+            return self._step_deferred(batch)
         if not self._nondet:
             env = EvalEnv(batch.cols, batch.keys, len(batch))
             ev = ExpressionEvaluator(env)
@@ -124,6 +164,119 @@ class RowwiseNode(Node):
                 out_cols[name] = ev.eval(expr)
             return Batch(batch.keys, out_cols, batch.diffs)
         return self._step_consistent(batch)
+
+    # ---- deferred (fully-async) two-phase path ---------------------------
+    def _step_deferred(self, batch):
+        from pathway_tpu.engine.expression_eval import (
+            scan_apply_rows,
+            submit_apply_chunks,
+        )
+
+        n = len(batch)
+        env = EvalEnv(batch.cols, batch.keys, n)
+        ev = ExpressionEvaluator(env)
+        out_cols: dict[str, np.ndarray] = {}
+        pending = []
+        for name, expr in self.expressions.items():
+            if name in self._deferred_names:
+                args = [ev.eval(a) for a in expr._args]
+                kwargs = {k: ev.eval(v) for k, v in expr._kwargs.items()}
+                out = np.empty(n, dtype=object)
+                todo = scan_apply_rows(expr, args, kwargs, n, out)
+                chunk = expr._max_batch_size or len(todo) or 1
+                handles = submit_apply_chunks(
+                    expr, args, kwargs, todo, chunk, out
+                )
+                out_cols[name] = out
+                pending.append((expr, out, handles))
+            else:
+                out_cols[name] = ev.eval(expr)
+        # EVERY batch rides the queue once the node is deferred — emitting
+        # "nothing to resolve" batches inline would let them overtake
+        # earlier in-flight batches (a retraction must never pass its
+        # insert downstream)
+        sched = self.scheduler
+        sched.async_begin()
+        self._ensure_drainer()
+        self._drain_queue.put((sched, batch.keys, batch.diffs, out_cols, pending))
+        return None
+
+    def _ensure_drainer(self):
+        import queue
+        import threading
+
+        if self._drain_thread is None or not self._drain_thread.is_alive():
+            self._drain_queue = queue.Queue()
+            self._drain_thread = threading.Thread(
+                target=self._drain_loop,
+                args=(self._drain_queue,),
+                daemon=True,
+                name=f"pathway:defer:{self.name}",
+            )
+            self._drain_thread.start()
+
+    def _drain_loop(self, q):
+        from pathway_tpu.engine.clock import kick_heartbeats, next_commit_time
+        from pathway_tpu.engine.expression_eval import finish_apply_chunks
+
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            sched, keys, diffs, out_cols, pending = item
+            try:
+                # Split-safety: per-chunk injection reorders rows of one
+                # batch across engine times, which is only sound when no
+                # key can appear twice with conflicting signs — i.e. the
+                # batch is insert-only (a consolidated insert-only batch
+                # has each key at most once). A batch carrying any
+                # retraction resolves chunk-by-chunk for the same device
+                # overlap but injects ONCE, preserving intra-batch order.
+                insert_only = bool((diffs > 0).all())
+                if len(pending) == 1 and insert_only:
+                    # the common streaming case drains CHUNK BY CHUNK,
+                    # injecting each chunk's rows as soon as its device
+                    # result lands: downstream host work (joins, index
+                    # appends, sinks) for chunk i overlaps the chip
+                    # computing chunk i+1 — the whole point of deferral.
+                    # (One resolve per chunk costs a fixed dispatch RTT
+                    # each; measured well under the overlap it buys.)
+                    expr, out, handles = pending[0]
+                    emitted = np.zeros(len(keys), dtype=bool)
+                    for idx, h in handles:
+                        finish_apply_chunks(expr, out, [(idx, h)])
+                        sel = np.asarray(idx, dtype=np.int64)
+                        emitted[sel] = True
+                        self._inject_rows(sched, keys, diffs, out_cols, sel)
+                        kick_heartbeats()
+                    rest = np.nonzero(~emitted)[0]
+                    if len(rest):
+                        # rows with no device work (ERROR / propagated
+                        # None) flush last; inserts never conflict
+                        self._inject_rows(sched, keys, diffs, out_cols, rest)
+                        kick_heartbeats()
+                else:
+                    for expr, out, handles in pending:
+                        # chunk-at-a-time drain: the GIL is released while
+                        # the chip computes, so the scheduler keeps pumping
+                        for idx_h in handles:
+                            finish_apply_chunks(expr, out, [idx_h])
+                    sched.inject(
+                        self, next_commit_time(), Batch(keys, out_cols, diffs)
+                    )
+                    kick_heartbeats()
+            except Exception as exc:  # noqa: BLE001 - drop batch, keep engine
+                get_global_error_log().log(
+                    f"deferred udf drain error: {type(exc).__name__}: {exc}"
+                )
+            finally:
+                sched.async_done()
+
+    def _inject_rows(self, sched, keys, diffs, out_cols, sel) -> None:
+        from pathway_tpu.engine.clock import next_commit_time
+
+        sub = {name: col[sel] for name, col in out_cols.items()}
+        sched.inject(self, next_commit_time(), Batch(keys[sel], sub, diffs[sel]))
 
     def _step_consistent(self, batch):
         from pathway_tpu.engine.value import hash_values

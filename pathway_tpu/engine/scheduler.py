@@ -23,9 +23,14 @@ from pathway_tpu.engine.probes import SchedulerStats
 class Scheduler:
     def __init__(self, graph: EngineGraph, targets: list[Node] | None = None,
                  exchange_ctx=None, threads: int | None = None,
-                 ctl_tag_alloc: "Callable[[], int] | None" = None):
+                 ctl_tag_alloc: "Callable[[], int] | None" = None,
+                 allow_deferred: bool = True):
         self.graph = graph
         self.exchange_ctx = exchange_ctx
+        # deferred (fully-async) UDF emission needs the run's OUTER pump:
+        # nested fixpoint sub-schedulers (iterate rounds) run under their
+        # own time discipline and must keep UDFs on the blocking path
+        self.allow_deferred = allow_deferred
         # control rounds are tagged by ``ctl_tag_alloc`` when provided:
         # nested schedulers (iterate fixpoint sub-runs) draw from the
         # owning node's private monotonic namespace so their barriers can

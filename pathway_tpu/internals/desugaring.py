@@ -67,6 +67,7 @@ def _sub(e: ColumnExpression, m: Mapping[type, Any]) -> ColumnExpression:
             batched=e._batched,
             submit=e._submit_fun,
             resolve=e._resolve_fun,
+            deferred=e._deferred,
         )
         return out
     if isinstance(e, expr_mod.CastExpression):

@@ -294,6 +294,7 @@ class ApplyExpression(ColumnExpression):
         batched: bool = False,
         submit: Callable | None = None,
         resolve: Callable | None = None,
+        deferred: bool = False,
     ):
         self._fun = fun
         self._return_type = dt.wrap(return_type) if return_type is not None else dt.ANY
@@ -314,6 +315,12 @@ class ApplyExpression(ColumnExpression):
         # of an epoch instead of paying a round trip per chunk.
         self._submit_fun = submit
         self._resolve_fun = resolve
+        # deferred=True (fully-async two-phase): the Rowwise operator
+        # dispatches the chunks and returns WITHOUT blocking the epoch —
+        # results are drained off-thread and injected at a later engine
+        # time, so the scheduler keeps pumping while the device computes
+        # (reference fully-async UDF semantics with TPU pipelining)
+        self._deferred = deferred
         self._check_for_disallowed_types = False
 
     def __repr__(self):

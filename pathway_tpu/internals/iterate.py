@@ -157,6 +157,7 @@ class IterateNode(Node):
         sched = Scheduler(
             self.subgraph, captures, threads=1, exchange_ctx=ctx,
             ctl_tag_alloc=self._next_ctl_tag if ctx is not None else None,
+            allow_deferred=False,
         )
         for n in sched.order:
             n.reset()

@@ -176,6 +176,17 @@ class UDF:
         # a batched __wrapped__ is hinted list[X]; the per-row type is X
         if self.return_type is None and typing.get_origin(rt) is list:
             (rt,) = typing.get_args(rt)
+        # FullyAsyncExecutor on a two-phase batched UDF = deferred mode:
+        # the epoch doesn't block on the device; results are injected at a
+        # later engine time (deterministic only — retractions re-derive
+        # the value, so a nondeterministic UDF must keep the replay-cache
+        # blocking path)
+        deferred = (
+            isinstance(self.executor, FullyAsyncExecutor)
+            and submit is not None
+            and resolve is not None
+            and self.deterministic
+        )
         return expr_mod.ApplyExpression(
             fun,
             rt,
@@ -187,6 +198,7 @@ class UDF:
             batched=True,
             submit=submit,
             resolve=resolve,
+            deferred=deferred,
         )
 
 

@@ -72,9 +72,15 @@ class AsyncExecutor(Executor):
 
 
 class FullyAsyncExecutor(AsyncExecutor):
-    """Non-blocking apply: results arrive at later engine times (``Pending``
-    placeholders first). Current engine approximation resolves within the
-    epoch (documented divergence, to be replaced by true pending-emission)."""
+    """Non-blocking apply: results arrive at later engine times.
+
+    On a deterministic two-phase batched UDF (``submit_batch`` /
+    ``resolve_batch``, e.g. the TPU embedders) this selects the DEFERRED
+    engine path: the epoch dispatches the chunks and returns immediately;
+    a drainer thread injects the completed rows at a fresh engine time
+    (``RowwiseNode._step_deferred``). For plain async functions it keeps
+    the within-epoch concurrent resolution (documented divergence from
+    the reference's ``Pending``-placeholder column)."""
 
 
 @dataclass

@@ -163,10 +163,23 @@ class BaseConnector:
             self._hb_thread.start()
 
     def _heartbeat_loop(self) -> None:
+        from pathway_tpu.engine.clock import wait_heartbeat
+
         interval = (self.heartbeat_ms or 500) / 1000.0
-        while not self._stop.wait(interval):
+        gen = 0
+        # bind to THIS run's scheduler: stop() may be followed immediately
+        # by reset_after_run() (clearing _stop) and a fresh start(), so a
+        # parked thread that wakes late must not adopt the next run
+        sched = self._sched
+        while True:
+            # woken early by engine kicks (deferred UDF results landing)
+            # so injected times aren't parked behind this source's idle
+            # frontier for a whole heartbeat interval
+            gen = wait_heartbeat(gen, interval)
+            if self._stop.is_set():
+                return
             with self._time_mutex:
-                if self._closed:
+                if self._closed or self._sched is not sched:
                     return
                 self.advance(next_commit_time() + 1)
 
@@ -184,7 +197,10 @@ class BaseConnector:
         raise NotImplementedError
 
     def stop(self) -> None:
+        from pathway_tpu.engine.clock import kick_heartbeats
+
         self._stop.set()
+        kick_heartbeats()  # wake a parked heartbeat so it sees the stop
         if self._thread is not None:
             self._thread.join(timeout=10)
 
@@ -203,19 +219,9 @@ class BaseConnector:
             self._hb_thread = None
 
 
-_time_lock = threading.Lock()
-_last_time = [0]
-
-
-def next_commit_time() -> int:
-    """Monotonic even commit time shared by all connectors (reference:
-    ``Timestamp::new_from_current_time``, even-valued)."""
-    with _time_lock:
-        t = int(time_mod.time() * 1000) * 2
-        if t <= _last_time[0]:
-            t = _last_time[0] + 2
-        _last_time[0] = t
-        return t
+# the commit clock lives in engine/clock.py (deferred-UDF drains share it);
+# re-exported here under its historical name
+from pathway_tpu.engine.clock import next_commit_time  # noqa: E402,F401
 
 
 class StaticStreamConnector(BaseConnector):

@@ -129,7 +129,16 @@ class SentenceEmbedderModel:
         is far inside the pipeline's parity gate while the device->host
         transfer (often the slowest hop on a relayed chip) halves."""
         (out, n) = self.embed_device(texts)
-        return (out.astype(jnp.float16), n)
+        out = out.astype(jnp.float16)
+        # start the device->host copy NOW: by the time the epoch's last
+        # chunk is dispatched and embed_resolve drains, earlier chunks'
+        # transfers have already overlapped with later chunks' compute
+        # (the drain was ~40% of the engine-streaming epoch otherwise)
+        try:
+            out.copy_to_host_async()
+        except Exception:  # noqa: BLE001 - platform-optional fast path
+            pass
+        return (out, n)
 
     def embed_device(self, texts: list[str]):
         """Dispatch-only embed returning the FULL-PRECISION device array

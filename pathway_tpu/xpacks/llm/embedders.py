@@ -60,13 +60,21 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         *,
         max_batch_size: int | None = 1024,
         cache_strategy: udfs.CacheStrategy | None = None,
+        deferred: bool = False,
         **init_kwargs,
     ):
+        # deferred=True: fully-async streaming mode — the engine epoch
+        # dispatches the embed chunks and moves on; results are injected
+        # at a later engine time, overlapping host dataflow with the TPU
+        # (opt-in because derived tables see the vectors slightly later
+        # than the raw rows, exactly like the reference's fully-async
+        # UDFs)
         super().__init__(
             deterministic=True,
             batch=True,
             max_batch_size=max_batch_size,
             cache_strategy=cache_strategy,
+            executor=udfs.fully_async_executor() if deferred else None,
         )
         from pathway_tpu.models import (
             BGE_SMALL,

@@ -1,0 +1,191 @@
+"""Deferred (fully-async) two-phase batched UDFs.
+
+Reference parity: fully-async UDF semantics (results arrive at later
+engine times) — ``python/pathway/internals/udfs/executors.py``
+``fully_async_executor`` — fused with this engine's TPU two-phase
+dispatch protocol (submit/resolve). The deferred path must produce
+EXACTLY the same final table as the blocking path, only without parking
+the epoch on the device drain.
+"""
+
+import threading
+import time as _t
+
+import pathway_tpu as pw
+from pathway_tpu.engine.operators import core as core_mod
+
+
+class _DoubleUDF(pw.UDF):
+    """Two-phase batched UDF with a simulated device latency."""
+
+    def __init__(self, deferred: bool, latency: float = 0.02):
+        super().__init__(
+            deterministic=True,
+            batch=True,
+            max_batch_size=3,
+            executor=pw.udfs.fully_async_executor() if deferred else None,
+        )
+        self.latency = latency
+
+    def __wrapped__(self, xs):
+        return [x * 2 for x in xs]
+
+    def submit_batch(self, xs):
+        return list(xs)
+
+    def resolve_batch(self, handles):
+        _t.sleep(self.latency)
+        return [[x * 2 for x in h] for h in handles]
+
+
+def _run_pipeline(deferred: bool, with_retract: bool = True):
+    pw.clear_graph()
+    u = _DoubleUDF(deferred)
+
+    class S(pw.Schema):
+        x: int
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(10):
+                self.next(x=i)
+                if i % 4 == 3:
+                    self.commit()
+            self.commit()
+            if with_retract:
+                _t.sleep(0.15)
+                self._buffer.append((7777, {"x": 42}, 1))
+                self.commit()
+                _t.sleep(0.1)
+                self._buffer.append((7777, {"x": 42}, -1))
+                self.commit()
+            _t.sleep(0.2)
+
+    t = pw.io.python.read(Src(), schema=S)
+    sel = t.select(t.x, y=u(t.x))
+    got: dict = {}
+    lock = threading.Lock()
+
+    def on_change(key, row, time, is_addition):
+        with lock:
+            k = (row["x"], row["y"])
+            got[k] = got.get(k, 0) + (1 if is_addition else -1)
+
+    pw.io.subscribe(sel, on_change=on_change)
+
+    def stopper():
+        deadline = _t.time() + 30
+        while _t.time() < deadline:
+            with lock:
+                live = {k: v for k, v in got.items() if v != 0}
+            if len(live) == 10 and (42, 84) not in live:
+                break
+            _t.sleep(0.02)
+        for c in pw.G.connectors:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stopper, daemon=True).start()
+    pw.run()
+    return {k: v for k, v in got.items() if v != 0}
+
+
+def test_deferred_matches_blocking(monkeypatch):
+    """Same final table either way — and the deferred run must actually
+    take the deferred path (the flag survives select desugaring)."""
+    n_deferred = [0]
+    orig = core_mod.RowwiseNode._step_deferred
+
+    def probe(self, batch):
+        n_deferred[0] += 1
+        return orig(self, batch)
+
+    monkeypatch.setattr(core_mod.RowwiseNode, "_step_deferred", probe)
+
+    blocking = _run_pipeline(deferred=False)
+    assert n_deferred[0] == 0, "blocking run must not defer"
+    deferred = _run_pipeline(deferred=True)
+    assert n_deferred[0] > 0, "deferred run never took the deferred path"
+    assert blocking == deferred
+    expected = {(i, i * 2): 1 for i in range(10)}
+    assert deferred == expected
+
+
+def test_deferred_retract_insert_pair_cancels():
+    """An insert+retract pair fed through the deferred pipe cancels out —
+    per-key FIFO holds even though results land at later engine times."""
+    live = _run_pipeline(deferred=True, with_retract=True)
+    assert (42, 84) not in live
+    assert len(live) == 10
+
+
+def test_deferred_mixed_sign_batch_stays_ordered():
+    """A single commit that REPLACES a key (retract old row + insert new
+    row) must not be split across injection times — downstream stateful
+    operators would see the insert while the old row still exists."""
+    pw.clear_graph()
+    u = _DoubleUDF(deferred=True)
+
+    class S(pw.Schema):
+        x: int
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            self._buffer.append((4242, {"x": 5}, 1))
+            self.commit()
+            _t.sleep(0.1)
+            # one commit: retract x=5, insert x=9 under the SAME key
+            self._buffer.append((4242, {"x": 5}, -1))
+            self._buffer.append((4242, {"x": 9}, 1))
+            self.commit()
+            _t.sleep(0.3)
+
+    t = pw.io.python.read(Src(), schema=S)
+    sel = t.select(t.x, y=u(t.x))
+    # a groupby keeps TableState downstream: a mis-ordered split raises
+    # DuplicateKeyError inside the epoch
+    agg = sel.groupby().reduce(total=pw.reducers.sum(sel.y))
+    got = {}
+    lock = threading.Lock()
+
+    def on_change(key, row, time, is_addition):
+        with lock:
+            got[row["total"]] = got.get(row["total"], 0) + (
+                1 if is_addition else -1
+            )
+
+    pw.io.subscribe(agg, on_change=on_change)
+
+    def stopper():
+        deadline = _t.time() + 20
+        while _t.time() < deadline:
+            with lock:
+                if got.get(18, 0) > 0:
+                    break
+            _t.sleep(0.02)
+        for c in pw.G.connectors:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stopper, daemon=True).start()
+    pw.run()
+    live = {k: v for k, v in got.items() if v != 0}
+    assert live == {18: 1}, live
+
+
+def test_deferred_static_table_completes():
+    """Static (debug) tables through a deferred UDF still finish the run
+    and capture every row."""
+    pw.clear_graph()
+    u = _DoubleUDF(deferred=True)
+    t = pw.debug.table_from_markdown(
+        """
+        x
+        1
+        2
+        3
+        """
+    )
+    sel = t.select(y=u(t.x))
+    rows = pw.debug.table_to_dicts(sel)[1]["y"]
+    assert sorted(rows.values()) == [2, 4, 6]
