@@ -130,12 +130,16 @@ class DataIndex:
         )
         data = self.data_table
         data_cols = [c for c in data.column_names()]
-        joined = matched.join(
-            data, matched["__ptr"] == data.id
-        ).select(
-            thisclass.left["__qid"],
-            thisclass.left["__score"],
-            **{c: data[c] for c in data_cols},
+        # pointer GATHER, not a hash join: ``__ptr`` IS the data row key
+        # (the index replies with row pointers), so IxNode looks replies
+        # up against the data table's state directly — a hash join here
+        # would re-shuffle the whole data table (with its vectors) into
+        # join buckets just to serve key-equality lookups
+        target = data.ix(matched["__ptr"])
+        joined = matched.select(
+            matched["__qid"],
+            matched["__score"],
+            **{c: target[c] for c in data_cols},
         )
         if collapse_rows:
             grouped = joined.groupby(joined["__qid"])
