@@ -352,9 +352,12 @@ class ExchangeNode(Node):
     """Route rows to their owner process before a stateful operator.
 
     ``routing`` is None (route by row key), a list of column names whose
-    values hash to the routing key (group/join keys), or the string
-    ``"broadcast"`` — every process receives every row (the reference's
-    per-worker external-index instances see the full add-stream)."""
+    values hash to the routing key (group/join keys), a tuple
+    ``("ptr", col)`` — route to the shard OWNING the row the pointer
+    column references (ix gathers co-locate with their targets) — or the
+    string ``"broadcast"`` — every process receives every row (the
+    reference's per-worker external-index instances see the full
+    add-stream)."""
 
     def __init__(self, graph, input_node, ctx: ExchangeContext,
                  routing, name="Exchange"):
@@ -366,6 +369,16 @@ class ExchangeNode(Node):
     def _routing_keys(self, batch: Batch) -> np.ndarray:
         if self.routing is None:
             return batch.keys
+        if isinstance(self.routing, tuple) and self.routing[0] == "ptr":
+            from pathway_tpu.engine.value import Pointer
+
+            col = batch.cols[self.routing[1]]
+            out = np.empty(len(batch), dtype=np.uint64)
+            for i, p in enumerate(col):
+                # None/ERROR pointers route to shard 0 (the target is
+                # missing everywhere; one shard must own the miss)
+                out[i] = p.value if isinstance(p, Pointer) else 0
+            return out
         return keys_for_value_columns(
             [batch.cols[c] for c in self.routing], len(batch)
         )
@@ -415,6 +428,7 @@ def splice_exchanges(graph, order: list[Node],
     original_input) rewirings so the caller can undo them on teardown — the
     graph is the user's global object and must not keep exchanges bound to
     a dead mesh across runs."""
+    from pathway_tpu.engine.operators.core import IxNode
     from pathway_tpu.engine.operators.external_index import ExternalIndexNode
     from pathway_tpu.engine.operators.join import JoinNode
     from pathway_tpu.engine.operators.reduce import GroupbyNode
@@ -464,6 +478,11 @@ def splice_exchanges(graph, order: list[Node],
             ]
         elif isinstance(node, JoinNode):
             routings = [node.left_on, node.right_on]
+        elif isinstance(node, IxNode):
+            # pointer gathers co-locate with their TARGET row's shard;
+            # the source side keeps row-key routing, so lookup and
+            # target always land on the same process
+            routings = [("ptr", node.ptr_column), None]
         elif node.is_stateful():
             routings = [None] * len(node.inputs)
         else:

@@ -24,8 +24,7 @@ class AsofNowJoinNode(JoinNode):
         self._left_emitted: dict[int, dict[int, tuple]] = {}
 
     _state_attrs = (
-        "_left", "_right", "_emitted", "_left_jk", "_right_jk",
-        "_left_emitted",
+        "_left", "_right", "_left_jk", "_right_jk", "_left_emitted",
     )
 
     def reset(self):

@@ -9,8 +9,10 @@ outer modes and id-preservation. Two execution strategies:
   differential's arranged ``join_core`` — a single-row insert into a B-row
   bucket costs O(matches), not O(B).
 * **Recompute + diff** (outer modes, retractions, id-preserving key
-  modes): per affected join-key recompute diffed against what was emitted
-  — uniform across modes and retraction-correct.
+  modes): per affected join-key recompute diffed against the PRE-batch
+  cross product, rebuilt from a per-step undo log — uniform across modes
+  and retraction-correct, with no materialized emitted-pairs cache
+  (memory O(input rows), not O(emitted pairs)).
 
 Key extraction and row materialization are columnar: join-key columns come
 straight out of the SoA ``Batch`` and all name->position lookups happen
@@ -43,9 +45,7 @@ def _native_join():
 
         fns = {
             n: native_bind(n)
-            for n in (
-                "join_apply_side", "join_ld_cross", "join_record_pairs"
-            )
+            for n in ("join_apply_side", "join_ld_cross")
         }
         _native_lib = (
             None
@@ -96,35 +96,41 @@ class JoinNode(Node):
             1 if is_left else 0 for is_left, _ in self._out_idx
         )
         self._idx_list = [i for _, i in self._out_idx]
-        # jk -> key -> row
+        # jk -> key -> row. NOTE: there is deliberately NO emitted-pairs
+        # cache — after every step, downstream state for a jk equals
+        # ``_cross`` of its current buckets (the fast paths emit exactly
+        # the delta preserving that invariant), so the recompute path
+        # derives "what was emitted" from pre-batch buckets rebuilt via
+        # the per-step undo log. Memory stays O(input rows), not
+        # O(emitted pairs) — the reference pays an arranged output trace
+        # for the same job (dataflow.rs join_core arrangements).
         self._left: dict[Any, dict[int, tuple]] = defaultdict(dict)
         self._right: dict[Any, dict[int, tuple]] = defaultdict(dict)
-        self._emitted: dict[Any, dict[int, tuple]] = defaultdict(dict)
         # row key -> its current jk, per side: a raw re-delivery (insert
         # of a live row key with NO retraction) that CHANGES the join key
         # must retract the stale row from its previous bucket
         self._left_jk: dict[int, Any] = {}
         self._right_jk: dict[int, Any] = {}
 
-    _state_attrs = ("_left", "_right", "_emitted", "_left_jk", "_right_jk")
+    _state_attrs = ("_left", "_right", "_left_jk", "_right_jk")
 
     def reset(self):
         self._left = defaultdict(dict)
         self._right = defaultdict(dict)
-        self._emitted = defaultdict(dict)
         self._left_jk = {}
         self._right_jk = {}
 
     def _side_deltas(
         self, state: dict, key2jk: dict, batch: Batch, on: list[str]
-    ) -> tuple[dict[Any, list[tuple[int, tuple, int]]], set]:
+    ) -> tuple[dict[Any, list[tuple[int, tuple, int]]], set, dict]:
         """Apply one side's batch to its bucket state; returns the per-jk
-        delta rows (columnar extraction — no per-row name lookups) plus the
-        jks needing the recompute path: where an insert REPLACED an
-        existing row key (the replaced row's pairs must retract), and —
+        delta rows (columnar extraction — no per-row name lookups), the
+        jks needing the recompute path — where an insert REPLACED an
+        existing row key (the replaced row's pairs must retract), or —
         via ``key2jk`` — the PREVIOUS bucket of a re-delivered key whose
-        join key changed (its stale row is evicted here and its pairs
-        retract through the recompute diff)."""
+        join key changed — plus an undo log (jk -> [(key, old|None)])
+        recording every bucket mutation so the recompute path can rebuild
+        this side's pre-batch buckets."""
         cols = batch.cols
         col_lists = [c.tolist() for c in cols.values()]
         keys = batch.keys.tolist()
@@ -132,15 +138,15 @@ class JoinNode(Node):
         native = _native_join()
         if native is not None and len(on) == 1:
             # the whole pass (row assembly, bucket updates, per-jk delta
-            # grouping, upsert-dirty detection, stale-bucket eviction) in
-            # one C loop
+            # grouping, upsert-dirty detection, stale-bucket eviction,
+            # undo logging) in one C loop
             jk_idx = list(cols).index(on[0])
-            deltas, dirty_list, n_err = native.join_apply_side(
+            deltas, dirty_list, undo, n_err = native.join_apply_side(
                 state, key2jk, keys, diffs, tuple(col_lists), jk_idx, ERROR
             )
             for _ in range(n_err):
                 get_global_error_log().log("Error value in join key")
-            return deltas, set(dirty_list)
+            return deltas, set(dirty_list), undo
         rows = list(zip(*col_lists)) if col_lists else [()] * len(batch)
         if len(on) == 1:
             jks: list = cols[on[0]].tolist()
@@ -154,6 +160,7 @@ class JoinNode(Node):
             single = False
         deltas: dict[Any, list[tuple[int, tuple, int]]] = defaultdict(list)
         dirty: set = set()
+        undo: dict[Any, list] = defaultdict(list)
         for key, row, diff, jk in zip(keys, rows, diffs, jks):
             if (jk is ERROR) if single else any(v is ERROR for v in jk):
                 get_global_error_log().log("Error value in join key")
@@ -164,15 +171,18 @@ class JoinNode(Node):
                     # re-delivery changed the join key: evict the stale
                     # row and recompute its old bucket
                     ob = state.get(old)
-                    if ob is not None:
-                        ob.pop(key, None)
+                    if ob is not None and key in ob:
+                        undo[old].append((key, ob[key]))
+                        del ob[key]
                         if not ob:
                             del state[old]
                     dirty.add(old)
                     deltas.setdefault(old, [])
                 bucket = state[jk]
-                if key in bucket:
+                prev = bucket.get(key)
+                if prev is not None:
                     dirty.add(jk)  # upsert-style re-delivery of a row key
+                undo[jk].append((key, prev))
                 bucket[key] = row
                 key2jk[key] = jk
                 deltas[jk].append((key, row, diff))
@@ -180,8 +190,9 @@ class JoinNode(Node):
                 old = key2jk.pop(key, None)
                 tgt = old if old is not None else jk
                 bucket = state.get(tgt)
-                if bucket is not None:
-                    bucket.pop(key, None)
+                if bucket is not None and key in bucket:
+                    undo[tgt].append((key, bucket[key]))
+                    del bucket[key]
                     if not bucket:
                         del state[tgt]
                 deltas[tgt].append((key, row, diff))
@@ -189,7 +200,7 @@ class JoinNode(Node):
                     # retraction delivered with a stale join key: the row
                     # actually lived in ``old`` — recompute that bucket
                     dirty.add(tgt)
-        return deltas, dirty
+        return deltas, dirty, undo
 
     def _out_key(self, lk: int | None, rk: int | None) -> int:
         if self.key_mode == "left":
@@ -208,8 +219,22 @@ class JoinNode(Node):
 
     def _join_bucket(self, jk) -> dict[int, tuple]:
         """Full join output for one join key from current state."""
-        lbucket = self._left.get(jk, {})
-        rbucket = self._right.get(jk, {})
+        return self._cross(self._left.get(jk, {}), self._right.get(jk, {}))
+
+    @staticmethod
+    def _pre_bucket(state: dict, jk, undo: dict) -> dict[int, tuple]:
+        """This jk's bucket as it was BEFORE the current batch: replay the
+        side's undo log in reverse over a copy of the current bucket."""
+        cur = state.get(jk)
+        pre = dict(cur) if cur else {}
+        for key, old in reversed(undo.get(jk, ())):
+            if old is None:
+                pre.pop(key, None)
+            else:
+                pre[key] = old
+        return pre
+
+    def _cross(self, lbucket: dict, rbucket: dict) -> dict[int, tuple]:
         out: dict[int, tuple] = {}
         if lbucket and rbucket:
             for lk, lrow in lbucket.items():
@@ -223,65 +248,60 @@ class JoinNode(Node):
                 out[self._out_key(None, rk)] = self._make_row(None, rrow)
         return out
 
-    def _delta_pairs(
-        self,
-        jk,
-        ld: list[tuple[int, tuple, int]],
-        rd: list[tuple[int, tuple, int]],
-        pairs: list[tuple[Any, int, int, tuple]],
-    ) -> bool:
-        """Insert-only inner-join delta for one jk:
-        dL x R + L x dR - dL x dR (state already updated, so R/L here are
-        post-delta buckets). Collects each new (jk, lk, rk, row) pair —
-        output keys are hashed in one vectorized pass afterwards — without
-        touching pre-existing pairs: O(new matches), not O(bucket).
-        Returns False (emitting nothing) when a delta repeats a key —
-        pathological input the recompute path handles with dict
-        last-wins semantics."""
-        new_l = {k for k, _r, _d in ld}
-        new_r = {k for k, _r, _d in rd}
-        if len(new_l) != len(ld) or len(new_r) != len(rd):
-            return False
-        lbucket = self._left.get(jk, {})
-        rbucket = self._right.get(jk, {})
-        out_idx = self._out_idx
-        append = pairs.append
-        for lk, lrow, _diff in ld:
-            for rk, rrow in rbucket.items():
-                append((jk, lk, rk, tuple(
-                    [lrow[i] if is_left else rrow[i]
-                     for is_left, i in out_idx]
-                )))
-        for rk, rrow, _diff in rd:
-            for lk, lrow in lbucket.items():
-                if lk in new_l:
-                    continue  # already paired in the dL x R term
-                append((jk, lk, rk, tuple(
-                    [lrow[i] if is_left else rrow[i]
-                     for is_left, i in out_idx]
-                )))
-        return True
+    @staticmethod
+    def _clean_delta(
+        delta: "list[tuple[int, tuple, int]] | None", undo: "list | None"
+    ) -> "list[tuple[int, tuple, int]] | None":
+        """Normalize one jk's side delta for the weighted bilinear path:
+        every row key at most once, and each retraction rewritten to
+        carry the row ACTUALLY stored in the bucket (from the undo log —
+        the delivered retraction row is what the source claims, the
+        stored row is what downstream pairs were built from). Returns
+        None when the delta needs the recompute path (duplicate keys, or
+        a retraction that removed nothing)."""
+        if not delta:
+            return []
+        if len(delta) == 1 and delta[0][2] > 0:
+            return delta  # dominant streaming shape: one insert
+        seen = set()
+        out = []
+        stored = None
+        for key, row, d in delta:
+            if key in seen:
+                return None
+            seen.add(key)
+            if d > 0:
+                out.append((key, row, d))
+                continue
+            if stored is None:
+                stored = {
+                    k: old for k, old in (undo or ()) if old is not None
+                }
+            srow = stored.get(key)
+            if srow is None:
+                return None  # retraction of an absent key: recompute
+            out.append((key, srow, d))
+        return out
 
     def step(self, time, ins):
         lb, rb = ins
-        ldeltas, ldirty = (
+        ldeltas, ldirty, lundo = (
             self._side_deltas(self._left, self._left_jk, lb, self.left_on)
             if lb is not None
-            else ({}, set())
+            else ({}, set(), {})
         )
-        rdeltas, rdirty = (
+        rdeltas, rdirty, rundo = (
             self._side_deltas(self._right, self._right_jk, rb, self.right_on)
             if rb is not None
-            else ({}, set())
+            else ({}, set(), {})
         )
         if not ldeltas and not rdeltas:
             return None
         dirty = ldirty | rdirty
         rows: list[tuple[int, tuple, int]] = []
-        pairs: list[tuple[Any, int, int, tuple]] = []
+        pairs: list[tuple[int, int, tuple, int]] = []  # (lk, rk, row, diff)
         native = _native_join() if self.mode == "inner" else None
-        works: list[tuple[list, dict]] = []  # (ld, rbucket) per fast jk
-        fast_jks: list[Any] = []
+        works: list = []  # (delta, bucket[, swapped]) per fast jk term
         fast_ok = self.mode == "inner" and self.key_mode == "pair"
         out_idx = self._out_idx
         jks = (
@@ -292,43 +312,51 @@ class JoinNode(Node):
         for jk in jks:
             ld = ldeltas.get(jk) if ldeltas else None
             rd = rdeltas.get(jk) if rdeltas else None
-            if jk in dirty:
-                pass  # replaced row keys: recompute path below
-            elif fast_ok and rd is None:
-                # dominant streaming shape: left-side inserts against a
-                # static-ish right bucket — the whole step's cross
-                # products emit through ONE native call (Python loop kept
-                # as the no-native fallback)
-                if len(ld) == 1:
-                    ok = ld[0][2] > 0
-                else:
-                    ok = all(d > 0 for _k, _r, d in ld) and len(
-                        {k for k, _r, _d in ld}
-                    ) == len(ld)
-                if ok:
-                    rbucket = self._right.get(jk)
-                    if rbucket:
-                        if native is not None:
-                            works.append((ld, rbucket))
-                            fast_jks.append(jk)
-                        else:
-                            append = pairs.append
-                            for lk, lrow, _d in ld:
-                                for rk, rrow in rbucket.items():
-                                    append((jk, lk, rk, tuple(
-                                        [lrow[i] if il else rrow[i]
-                                         for il, i in out_idx]
-                                    )))
+            if fast_ok and jk not in dirty:
+                # weighted bilinear delta: dJ = dL x R_post + L_pre x dR
+                # — exact for ANY mix of inserts and retractions (each
+                # side's keys unique, retractions carry stored rows), so
+                # churn-heavy streams stay O(delta x matches) instead of
+                # falling back to per-jk recompute
+                ld2 = self._clean_delta(ld, lundo.get(jk))
+                rd2 = self._clean_delta(rd, rundo.get(jk))
+                if ld2 is not None and rd2 is not None:
+                    if rd2:
+                        lpre = self._pre_bucket(self._left, jk, lundo)
+                        if lpre:
+                            if native is not None:
+                                works.append((rd2, lpre, True))
+                            else:
+                                append = pairs.append
+                                for rk, rrow, d in rd2:
+                                    for lk, lrow in lpre.items():
+                                        append((lk, rk, tuple(
+                                            [lrow[i] if il else rrow[i]
+                                             for il, i in out_idx]
+                                        ), d))
+                    if ld2:
+                        rbucket = self._right.get(jk)
+                        if rbucket:
+                            if native is not None:
+                                works.append((ld2, rbucket))
+                            else:
+                                append = pairs.append
+                                for lk, lrow, d in ld2:
+                                    for rk, rrow in rbucket.items():
+                                        append((lk, rk, tuple(
+                                            [lrow[i] if il else rrow[i]
+                                             for il, i in out_idx]
+                                        ), d))
                     continue
-            elif (
-                fast_ok
-                and all(d > 0 for _k, _r, d in ld or ())
-                and all(d > 0 for _k, _r, d in rd or ())
-                and self._delta_pairs(jk, ld or (), rd or (), pairs)
-            ):
-                continue
+            # recompute path: diff the cross product of pre-batch buckets
+            # (rebuilt via the undo logs) against the current one — what
+            # was previously emitted IS the pre-batch cross (invariant
+            # kept by every emission path)
             new_out = self._join_bucket(jk)
-            old_out = self._emitted.get(jk, {})
+            old_out = self._cross(
+                self._pre_bucket(self._left, jk, lundo),
+                self._pre_bucket(self._right, jk, rundo),
+            )
             for k, row in old_out.items():
                 nrow = new_out.get(k)
                 if nrow is None:
@@ -339,34 +367,26 @@ class JoinNode(Node):
             for k, row in new_out.items():
                 if k not in old_out:
                     rows.append((k, row, 1))
-            if new_out:
-                self._emitted[jk] = new_out
-            else:
-                self._emitted.pop(jk, None)
+        fast_batch = None
         if works:
             # the whole step's fast-path cross products in one C pass:
-            # output tuples + (lk, rk) key columns come back ready for the
-            # vectorized Key::for_values hash; per-pair emitted
-            # bookkeeping is a second C pass
-            from pathway_tpu.engine.value import keys_for_value_columns
-
-            out_rows, lks, rks, items = native.join_ld_cross(
+            # per-OUTPUT-COLUMN value lists plus the hashed pair keys and
+            # weights come back ready to wrap in a Batch — no row tuples,
+            # no re-split, no second hashing pass
+            col_lists, keys_buf, diffs_buf = native.join_ld_cross(
                 works, self._sides_bytes, self._idx_list
             )
-            if out_rows:
-                n = len(out_rows)
-                la = np.empty(n, dtype=object)
-                la[:] = lks
-                ra = np.empty(n, dtype=object)
-                ra[:] = rks
-                oks = keys_for_value_columns([la, ra], n)
-                native.join_record_pairs(
-                    [self._emitted[jk] for jk in fast_jks],
-                    items,
-                    memoryview(np.ascontiguousarray(oks, dtype=np.uint64)),
-                    out_rows,
+            n = len(keys_buf) >> 3
+            if n:
+                oks = np.frombuffer(keys_buf, dtype=np.uint64)
+                cols = {}
+                for name, lst in zip(self.column_names, col_lists):
+                    arr = np.empty(n, dtype=object)
+                    arr[:] = lst
+                    cols[name] = arr
+                fast_batch = Batch(
+                    oks, cols, np.frombuffer(diffs_buf, dtype=np.int64)
                 )
-                rows.extend(zip(oks.tolist(), out_rows, (1,) * n))
         if pairs:
             # one vectorized Key::for_values pass over all fast-path pairs
             # (C++ column hash + numpy mixing) instead of a Python
@@ -375,15 +395,18 @@ class JoinNode(Node):
 
             oks = keys_for_value_columns(
                 [
+                    np.array([p[0] for p in pairs], dtype=object),
                     np.array([p[1] for p in pairs], dtype=object),
-                    np.array([p[2] for p in pairs], dtype=object),
                 ],
                 len(pairs),
             )
-            emitted = self._emitted
-            for (jk, _lk, _rk, row), ok in zip(pairs, oks.tolist()):
-                rows.append((ok, row, 1))
-                emitted[jk][ok] = row
-        if not rows:
-            return None
-        return Batch.from_rows(self.column_names, rows)
+            for (_lk, _rk, row, d), ok in zip(pairs, oks.tolist()):
+                rows.append((ok, row, d))
+        if rows:
+            row_batch = Batch.from_rows(self.column_names, rows)
+            if fast_batch is None:
+                return row_batch
+            from pathway_tpu.engine.batch import concat_batches
+
+            return concat_batches([fast_batch, row_batch])
+        return fast_batch

@@ -396,6 +396,145 @@ def generate(params: dict, prompt_ids: jax.Array, attention_mask: jax.Array,
     return toks  # (B, max_new)
 
 
+# ---- continuous-batching slot pool ----------------------------------------
+#
+# Serving state for admitting requests into an IN-FLIGHT decode loop
+# (reference bar: HFPipelineChat runs one torch pipeline call per batch —
+# a new request waits for the whole batch; here it waits at most one
+# decode chunk). The host owns slot lifecycle: it admits a request into a
+# free slot (pool_admit), advances every active slot T steps per dispatch
+# (pool_decode_chunk), reads the (T, n_slots) token block, and frees a
+# slot on EOS or when the request's own max_new budget is spent —
+# per-row prompt lengths and budgets need no device bookkeeping. Lanes
+# not in ``active`` still flow through the chunk's compute (static
+# shapes) but their state does not advance.
+
+
+def pool_init(params: dict, cfg: DecoderConfig, n_slots: int,
+              cache_len: int) -> dict:
+    """Empty serving pool: per-slot KV caches, last logits, attention
+    slot masks and cursors. ``cache_len`` must cover the largest
+    admitted prompt + its budget + one chunk of slack (a lane may
+    overrun its budget until the chunk boundary; writes clamp to the
+    last slot)."""
+    L, nh, hd = cfg.layers, cfg.heads, cfg.head_dim
+    del params
+    return {
+        "k": jnp.zeros((L, n_slots, nh, cache_len, hd), cfg.dtype),
+        "v": jnp.zeros((L, n_slots, nh, cache_len, hd), cfg.dtype),
+        "logits": jnp.zeros((n_slots, cfg.vocab_size), jnp.float32),
+        "slot_mask": jnp.zeros((n_slots, cache_len), jnp.int32),
+        "pos": jnp.zeros((n_slots,), jnp.int32),    # next position id
+        "write": jnp.zeros((n_slots,), jnp.int32),  # next cache slot
+    }
+
+
+def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,
+               slot: jax.Array, cfg: DecoderConfig) -> dict:
+    """Prefill ONE left-padded prompt (``ids``/``mask`` shaped (1, S))
+    and install it in ``slot``: KV written, cursors set, first-token
+    logits staged. jit per prompt-length bucket; ``slot`` is traced."""
+    C = pool["k"].shape[3]
+    S = ids.shape[1]
+    last_logits, cache = prefill(params, ids, mask, cfg, cache_len=C)
+    k = jax.lax.dynamic_update_slice(
+        pool["k"], cache["k"].astype(pool["k"].dtype), (0, slot, 0, 0, 0)
+    )
+    v = jax.lax.dynamic_update_slice(
+        pool["v"], cache["v"].astype(pool["v"].dtype), (0, slot, 0, 0, 0)
+    )
+    row_mask = jnp.concatenate(
+        [mask.astype(jnp.int32), jnp.zeros((1, C - S), jnp.int32)], axis=1
+    )
+    slot_mask = jax.lax.dynamic_update_slice(
+        pool["slot_mask"], row_mask, (slot, 0)
+    )
+    logits = jax.lax.dynamic_update_slice(
+        pool["logits"], last_logits, (slot, 0)
+    )
+    n_prompt = jnp.sum(mask, axis=1).astype(jnp.int32)  # (1,)
+    pos = jax.lax.dynamic_update_slice(pool["pos"], n_prompt, (slot,))
+    write = jax.lax.dynamic_update_slice(
+        pool["write"], jnp.full((1,), S, jnp.int32), (slot,)
+    )
+    return {"k": k, "v": v, "logits": logits, "slot_mask": slot_mask,
+            "pos": pos, "write": write}
+
+
+def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
+                      key: jax.Array, cfg: DecoderConfig, n_steps: int,
+                      temperature: float = 0.0,
+                      top_k: int | None = None,
+                      top_p: float | None = None) -> tuple[dict, jax.Array]:
+    """Advance every ``active`` slot ``n_steps`` decode steps in ONE
+    dispatch. Returns ``(pool, tokens (n_steps, n_slots))`` — the host
+    truncates each slot's stream at EOS / its budget (a lane keeps
+    decoding garbage past its own EOS until the chunk ends; discarded).
+    Inactive lanes compute but their state does not advance."""
+    B = pool["logits"].shape[0]
+    C = pool["k"].shape[3]
+    b_idx = jnp.arange(B)
+    act_i = active.astype(jnp.int32)
+    act_b = active[:, None, None]
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
+
+    def body(carry, _):
+        k_c, v_c, logits, slot_mask, pos, write, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)
+        w = jnp.minimum(write, C - 1)
+        # the sampled token's own cache slot attends to itself
+        slot_mask = jnp.where(
+            active[:, None] & (jnp.arange(C)[None, :] == w[:, None]),
+            1, slot_mask,
+        )
+        p = jnp.minimum(pos, cfg.max_position - 1)
+        x = (params["wte"][tok][:, None, :]
+             + params["wpe"][p][:, None, :]).astype(cfg.dtype)
+        mask_bias = jnp.where(
+            slot_mask[:, None, None, :] > 0, 0.0, -1e9
+        ).astype(jnp.float32)
+
+        def layer(x, inp):
+            lp, kl, vl = inp
+            k_new, v_new = _prefill_kv(x, lp, cfg)  # (B, nh, 1, hd)
+            # per-ROW write position (each lane is at its own slot)
+            kl = kl.at[b_idx, :, w, :].set(
+                jnp.where(act_b, k_new[:, :, 0, :], kl[b_idx, :, w, :])
+            )
+            vl = vl.at[b_idx, :, w, :].set(
+                jnp.where(act_b, v_new[:, :, 0, :], vl[b_idx, :, w, :])
+            )
+            x, _, _ = _block(x, lp, kl, vl, mask_bias, cfg)
+            return x, (kl, vl)
+
+        x, (k_c, v_c) = jax.lax.scan(
+            layer, x, (params["layers"], k_c, v_c)
+        )
+        new_logits = _logits(params, x, cfg)[:, 0, :]
+        logits = jnp.where(active[:, None], new_logits, logits)
+        return (k_c, v_c, logits, slot_mask, pos + act_i,
+                write + act_i, key), tok
+
+    (k_c, v_c, logits, slot_mask, pos, write, _), toks = jax.lax.scan(
+        body,
+        (pool["k"], pool["v"], pool["logits"], pool["slot_mask"],
+         pool["pos"], pool["write"], key),
+        None,
+        length=n_steps,
+    )
+    return (
+        {"k": k_c, "v": v_c, "logits": logits, "slot_mask": slot_mask,
+         "pos": pos, "write": write},
+        toks,
+    )
+
+
 def cast_params_for_inference(params: dict, cfg: DecoderConfig) -> dict:
     """Store matmul weights in the compute dtype for generation: every
     decode step reads the whole parameter set from HBM, so f32-stored

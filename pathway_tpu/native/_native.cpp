@@ -1547,13 +1547,41 @@ static PyObject* py_set_pointer_type(PyObject*, PyObject* args) {
 
 // ---------------------------------------------------------------- join emit
 // join_ld_cross(works, sides, idxs)
+// splitmix64 rehash with salt — must match value.py hash_keys_with.
+static inline uint64_t splitmix_salt(uint64_t x, uint64_t salt) {
+  x += salt;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+static const uint64_t kSeqSalt = 0x9E3779B97F4A7C15ULL;  // value.py _SEQ_SALT
+static const uint64_t kColPrime = 0x100000001B3ULL;
+
+// xxh64 of the canonical serialization of one value (the per-element body
+// of hash_object_column). Returns false when the value can't be
+// canonically serialized in C (exotic types) — callers raise.
+static bool hash_value_u64(PyObject* v, std::string& scratch, uint64_t* out) {
+  scratch.clear();
+  if (!serialize(v, scratch)) return false;
+  *out = xxh64(reinterpret_cast<const uint8_t*>(scratch.data()),
+               scratch.size(), 0);
+  return true;
+}
+
 //   works: list of (ld, rbucket) where ld = [(key, row, diff), ...] and
-//          rbucket = {rkey: rrow}; rows are tuples.
+//          rbucket = {rkey: rrow}; rows are tuples. diff (+/-1) is the
+//          emission weight: a retracted left row crossed against the
+//          bucket emits its pairs with diff -1 (the weighted bilinear
+//          delta — mixed insert/retract streams ride the same path).
 //   sides: bytes, one per output column, 1 = from lrow else rrow.
 //   idxs:  list of ints, source position within that row.
 // One call per engine step covers every fast-path join key: emits the
-// dL x R cross product as (out_rows, lkeys, rkeys, item_of_pair) —
-// the per-pair work the Python inner loop paid ~2us each for.
+// dL x R cross product COLUMNAR — (col_lists, out_keys_u64_bytes,
+// diffs_i64_bytes) — with the pair output keys (Key::for_values(lk,
+// rk), matching value.py keys_for_value_columns) hashed inline. The
+// caller wraps the columns + key/diff buffers straight into a Batch:
+// no row tuples, no re-split, no second hashing pass.
 static PyObject* py_join_ld_cross(PyObject*, PyObject* args) {
   PyObject *works, *sides_obj, *idxs_obj;
   if (!PyArg_ParseTuple(args, "OSO", &works, &sides_obj, &idxs_obj))
@@ -1585,149 +1613,149 @@ static PyObject* py_join_ld_cross(PyObject*, PyObject* args) {
     return nullptr;
   }
   Py_ssize_t nwork = PySequence_Fast_GET_SIZE(works_fast);
-  PyObject* out_rows = PyList_New(0);
-  PyObject* lks = PyList_New(0);
-  PyObject* rks = PyList_New(0);
-  PyObject* items = PyList_New(0);
-  bool fail = out_rows == nullptr || lks == nullptr || rks == nullptr ||
-              items == nullptr;
+  // total pair count up front (lens only) so the key buffer and column
+  // lists are allocated exactly once
+  Py_ssize_t total = 0;
+  bool fail = false;
   for (Py_ssize_t w = 0; !fail && w < nwork; w++) {
     PyObject* pair = PySequence_Fast_GET_ITEM(works_fast, w);
-    if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
-      PyErr_SetString(PyExc_TypeError, "work item must be (ld, rbucket)");
+    if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) < 2 ||
+        !PyDict_Check(PyTuple_GET_ITEM(pair, 1))) {
+      PyErr_SetString(PyExc_TypeError,
+                      "work item must be (delta, bucket[, swapped])");
       fail = true;
       break;
     }
+    Py_ssize_t nld = PySequence_Size(PyTuple_GET_ITEM(pair, 0));
+    if (nld < 0) { fail = true; break; }
+    total += nld * PyDict_GET_SIZE(PyTuple_GET_ITEM(pair, 1));
+  }
+  PyObject* keys_buf =
+      fail ? nullptr : PyByteArray_FromStringAndSize(nullptr, total * 8);
+  PyObject* diffs_buf =
+      fail ? nullptr : PyByteArray_FromStringAndSize(nullptr, total * 8);
+  PyObject* cols = fail || keys_buf == nullptr || diffs_buf == nullptr
+                       ? nullptr
+                       : PyTuple_New(ncols);
+  fail = fail || keys_buf == nullptr || diffs_buf == nullptr ||
+         cols == nullptr;
+  for (Py_ssize_t j = 0; !fail && j < ncols; j++) {
+    PyObject* lst = PyList_New(total);
+    if (lst == nullptr) { fail = true; break; }
+    PyTuple_SET_ITEM(cols, j, lst);
+  }
+  uint64_t* keys_out =
+      fail ? nullptr
+           : reinterpret_cast<uint64_t*>(PyByteArray_AS_STRING(keys_buf));
+  int64_t* diffs_out =
+      fail ? nullptr
+           : reinterpret_cast<int64_t*>(PyByteArray_AS_STRING(diffs_buf));
+  std::string scratch;
+  std::vector<uint64_t> rk_hash;  // per-work rbucket hashes (reused rows)
+  Py_ssize_t outpos = 0;
+  for (Py_ssize_t w = 0; !fail && w < nwork; w++) {
+    PyObject* pair = PySequence_Fast_GET_ITEM(works_fast, w);
     PyObject* ld = PyTuple_GET_ITEM(pair, 0);
     PyObject* rbucket = PyTuple_GET_ITEM(pair, 1);
-    if (!PyDict_Check(rbucket)) {
-      PyErr_SetString(PyExc_TypeError, "rbucket must be a dict");
-      fail = true;
-      break;
+    // swapped: the delta is the RIGHT side crossed against a LEFT
+    // bucket (L x dR term) — output-column sourcing and the two key-
+    // hash salts flip, everything else is symmetric
+    int swapped = 0;
+    if (PyTuple_GET_SIZE(pair) >= 3) {
+      swapped = PyObject_IsTrue(PyTuple_GET_ITEM(pair, 2));
+      if (swapped < 0) { fail = true; break; }
     }
     PyObject* ld_fast = PySequence_Fast(ld, "ld must be a sequence");
     if (ld_fast == nullptr) { fail = true; break; }
     Py_ssize_t nld = PySequence_Fast_GET_SIZE(ld_fast);
-    PyObject* witem = PyLong_FromSsize_t(w);
-    if (witem == nullptr) { Py_DECREF(ld_fast); fail = true; break; }
+    Py_ssize_t nrb = PyDict_GET_SIZE(rbucket);
+    // hash each bucket key once per work item (shared across delta rows);
+    // the left-position hash carries the column-combine prime so the pair
+    // key is a plain XOR either way
+    rk_hash.resize((size_t)nrb);
+    {
+      PyObject *rk, *rrow;
+      Py_ssize_t pos = 0, ri = 0;
+      while (PyDict_Next(rbucket, &pos, &rk, &rrow)) {
+        uint64_t h;
+        if (!hash_value_u64(rk, scratch, &h)) {
+          PyErr_SetString(PyExc_TypeError, "unhashable join row key");
+          fail = true;
+          break;
+        }
+        rk_hash[(size_t)ri++] =
+            swapped ? splitmix_salt(h, kSeqSalt) * kColPrime
+                    : splitmix_salt(h, kSeqSalt * 2);
+      }
+    }
     for (Py_ssize_t i = 0; !fail && i < nld; i++) {
       PyObject* entry = PySequence_Fast_GET_ITEM(ld_fast, i);
-      if (!PyTuple_Check(entry) || PyTuple_GET_SIZE(entry) < 2) {
+      if (!PyTuple_Check(entry) || PyTuple_GET_SIZE(entry) < 2 ||
+          !PyTuple_Check(PyTuple_GET_ITEM(entry, 1))) {
         PyErr_SetString(PyExc_TypeError, "ld entry must be (key, row, diff)");
         fail = true;
         break;
       }
       PyObject* lk = PyTuple_GET_ITEM(entry, 0);
       PyObject* lrow = PyTuple_GET_ITEM(entry, 1);
-      if (!PyTuple_Check(lrow)) {
-        PyErr_SetString(PyExc_TypeError, "lrow must be a tuple");
+      long long weight = 1;
+      if (PyTuple_GET_SIZE(entry) >= 3) {
+        weight = PyLong_AsLongLong(PyTuple_GET_ITEM(entry, 2));
+        if (PyErr_Occurred()) { fail = true; break; }
+      }
+      uint64_t lh;
+      if (!hash_value_u64(lk, scratch, &lh)) {
+        PyErr_SetString(PyExc_TypeError, "unhashable join row key");
         fail = true;
         break;
       }
+      lh = swapped ? splitmix_salt(lh, kSeqSalt * 2)
+                   : splitmix_salt(lh, kSeqSalt) * kColPrime;
       PyObject *rk, *rrow;
-      Py_ssize_t pos = 0;
+      Py_ssize_t pos = 0, ri = 0;
       while (!fail && PyDict_Next(rbucket, &pos, &rk, &rrow)) {
         if (!PyTuple_Check(rrow)) {
           PyErr_SetString(PyExc_TypeError, "rrow must be a tuple");
           fail = true;
           break;
         }
-        PyObject* out = PyTuple_New(ncols);
-        if (out == nullptr) { fail = true; break; }
         for (Py_ssize_t j = 0; j < ncols; j++) {
-          PyObject* src = sides[j] ? lrow : rrow;
+          PyObject* src = ((sides[j] != 0) != (swapped != 0)) ? lrow : rrow;
           Py_ssize_t k = idxs[(size_t)j];
           if (k >= PyTuple_GET_SIZE(src)) {
-            Py_DECREF(out);
             PyErr_SetString(PyExc_IndexError, "row index out of range");
             fail = true;
             break;
           }
           PyObject* v = PyTuple_GET_ITEM(src, k);
           Py_INCREF(v);
-          PyTuple_SET_ITEM(out, j, v);
+          PyList_SET_ITEM(PyTuple_GET_ITEM(cols, j), outpos, v);
         }
         if (fail) break;
-        if (PyList_Append(out_rows, out) < 0 ||
-            PyList_Append(lks, lk) < 0 || PyList_Append(rks, rk) < 0 ||
-            PyList_Append(items, witem) < 0)
-          fail = true;
-        Py_DECREF(out);
+        keys_out[outpos] = lh ^ rk_hash[(size_t)ri++];
+        diffs_out[outpos] = (int64_t)weight;
+        outpos++;
       }
     }
-    Py_DECREF(witem);
     Py_DECREF(ld_fast);
   }
   Py_DECREF(works_fast);
   Py_DECREF(idx_fast);
-  if (fail) {
-    Py_XDECREF(out_rows);
-    Py_XDECREF(lks);
-    Py_XDECREF(rks);
-    Py_XDECREF(items);
-    return nullptr;
-  }
-  PyObject* result = PyTuple_Pack(4, out_rows, lks, rks, items);
-  Py_DECREF(out_rows);
-  Py_DECREF(lks);
-  Py_DECREF(rks);
-  Py_DECREF(items);
-  return result;
-}
-
-// record_pairs(subdicts, item_of_pair, oks_u64_buffer, rows)
-//   subdicts: list of per-join-key emitted dicts (one per work item);
-//   item_of_pair: list of ints mapping each pair to its work item;
-//   oks: buffer of n*8 LE uint64 output keys; rows: list of row tuples.
-// Performs emitted[jk][ok] = row for every pair in one C pass.
-static PyObject* py_join_record_pairs(PyObject*, PyObject* args) {
-  PyObject *subdicts, *items, *rows;
-  Py_buffer oks;
-  if (!PyArg_ParseTuple(args, "OOy*O", &subdicts, &items, &oks, &rows))
-    return nullptr;
-  PyObject* sub_fast = PySequence_Fast(subdicts, "subdicts");
-  PyObject* item_fast = PySequence_Fast(items, "items");
-  PyObject* rows_fast = PySequence_Fast(rows, "rows");
-  bool fail = sub_fast == nullptr || item_fast == nullptr ||
-              rows_fast == nullptr;
-  Py_ssize_t n = fail ? 0 : PySequence_Fast_GET_SIZE(rows_fast);
-  if (!fail && ((Py_ssize_t)oks.len < n * 8 ||
-                PySequence_Fast_GET_SIZE(item_fast) != n)) {
-    PyErr_SetString(PyExc_ValueError, "record_pairs length mismatch");
+  if (!fail && outpos != total) {
+    PyErr_SetString(PyExc_RuntimeError, "join cross emitted short");
     fail = true;
   }
-  const uint64_t* ok = fail ? nullptr
-                            : reinterpret_cast<const uint64_t*>(oks.buf);
-  Py_ssize_t nsub = fail ? 0 : PySequence_Fast_GET_SIZE(sub_fast);
-  for (Py_ssize_t i = 0; !fail && i < n; i++) {
-    Py_ssize_t w =
-        PyLong_AsSsize_t(PySequence_Fast_GET_ITEM(item_fast, i));
-    if (w < 0 || w >= nsub) {  // negative = error or invalid index; both
-      // must never reach the unchecked GET_ITEM below
-      if (!PyErr_Occurred())
-        PyErr_SetString(PyExc_IndexError, "item index out of range");
-      fail = true;
-      break;
-    }
-    PyObject* d = PySequence_Fast_GET_ITEM(sub_fast, w);
-    if (!PyDict_Check(d)) {
-      PyErr_SetString(PyExc_TypeError, "subdict must be a dict");
-      fail = true;
-      break;
-    }
-    PyObject* key = PyLong_FromUnsignedLongLong(ok[i]);
-    if (key == nullptr) { fail = true; break; }
-    if (PyDict_SetItem(d, key,
-                       PySequence_Fast_GET_ITEM(rows_fast, i)) < 0)
-      fail = true;
-    Py_DECREF(key);
+  if (fail) {
+    Py_XDECREF(keys_buf);
+    Py_XDECREF(diffs_buf);
+    Py_XDECREF(cols);
+    return nullptr;
   }
-  Py_XDECREF(sub_fast);
-  Py_XDECREF(item_fast);
-  Py_XDECREF(rows_fast);
-  PyBuffer_Release(&oks);
-  if (fail) return nullptr;
-  Py_RETURN_NONE;
+  PyObject* result = PyTuple_Pack(3, cols, keys_buf, diffs_buf);
+  Py_DECREF(cols);
+  Py_DECREF(keys_buf);
+  Py_DECREF(diffs_buf);
+  return result;
 }
 
 // batch_rows_split(rows, ncols, keys_u64_buf, diffs_i64_buf)
@@ -1813,14 +1841,30 @@ static PyObject* join_delta_list(PyObject* deltas, PyObject* jk) {
   return dl;
 }
 
-// Remove `key` from state[jk]'s bucket (dropping an emptied bucket).
-// Returns 0 ok, -1 error.
-static int join_evict(PyObject* state, PyObject* jk, PyObject* key) {
+// undo[jk].append((key, old_row_or_None)) — the per-mutation undo log the
+// recompute path replays in reverse to reconstruct pre-batch buckets
+// (replacing the old always-materialized emitted-pairs cache).
+static int join_log_undo(PyObject* undo, PyObject* jk, PyObject* key,
+                         PyObject* old) {
+  PyObject* lst = join_delta_list(undo, jk);  // borrowed ensure-list
+  if (lst == nullptr) return -1;
+  PyObject* pairt = PyTuple_Pack(2, key, old ? old : Py_None);
+  if (pairt == nullptr) return -1;
+  int rc = PyList_Append(lst, pairt);
+  Py_DECREF(pairt);
+  return rc;
+}
+
+// Remove `key` from state[jk]'s bucket (dropping an emptied bucket),
+// logging the removed row to the undo log. Returns 0 ok, -1 error.
+static int join_evict(PyObject* state, PyObject* jk, PyObject* key,
+                      PyObject* undo) {
   PyObject* bucket = PyDict_GetItemWithError(state, jk);  // borrowed
   if (bucket == nullptr) return PyErr_Occurred() ? -1 : 0;
-  int has = PyDict_Contains(bucket, key);
-  if (has < 0) return -1;
-  if (has == 1 && PyDict_DelItem(bucket, key) < 0) return -1;
+  PyObject* old = PyDict_GetItemWithError(bucket, key);  // borrowed
+  if (old == nullptr) return PyErr_Occurred() ? -1 : 0;
+  if (join_log_undo(undo, jk, key, old) < 0) return -1;
+  if (PyDict_DelItem(bucket, key) < 0) return -1;
   if (PyDict_GET_SIZE(bucket) == 0 && PyDict_DelItem(state, jk) < 0)
     return -1;
   return 0;
@@ -1834,8 +1878,10 @@ static int join_evict(PyObject* state, PyObject* jk, PyObject* key) {
 //   value lists (the SoA batch); jk_idx: which column is the (single)
 //   join key. Builds each row tuple once, applies the delta to the
 //   bucket state, and groups deltas per jk — the whole Python
-//   _side_deltas pass in one C loop. Returns (deltas_dict, dirty_list,
-//   n_errors).
+//   _side_deltas pass in one C loop. Every bucket mutation is logged to
+//   an undo dict (jk -> [(key, old_row|None), ...]) so the recompute
+//   path can rebuild pre-batch buckets. Returns (deltas_dict,
+//   dirty_list, undo_dict, n_errors).
 static PyObject* py_join_apply_side(PyObject*, PyObject* args) {
   PyObject *state, *key2jk, *keys, *diffs, *col_lists, *sentinel;
   Py_ssize_t jk_idx;
@@ -1867,8 +1913,9 @@ static PyObject* py_join_apply_side(PyObject*, PyObject* args) {
   }
   PyObject* deltas = fail ? nullptr : PyDict_New();
   PyObject* dirty = fail ? nullptr : PyList_New(0);
+  PyObject* undo = fail ? nullptr : PyDict_New();
   Py_ssize_t n_err = 0;
-  if (deltas == nullptr || dirty == nullptr) fail = true;
+  if (deltas == nullptr || dirty == nullptr || undo == nullptr) fail = true;
   for (Py_ssize_t i = 0; !fail && i < n; i++) {
     PyObject* jk = col_items[(size_t)jk_idx][i];
     if (jk == sentinel) { n_err++; continue; }
@@ -1902,7 +1949,7 @@ static PyObject* py_join_apply_side(PyObject*, PyObject* args) {
       if (moved) {
         // key-changing raw re-delivery: evict the stale row and mark
         // the old bucket for recompute (its pairs must retract)
-        if (join_evict(state, old, key) < 0 ||
+        if (join_evict(state, old, key, undo) < 0 ||
             PyList_Append(dirty, old) < 0 ||
             join_delta_list(deltas, old) == nullptr) {
           Py_DECREF(row);
@@ -1919,6 +1966,7 @@ static PyObject* py_join_apply_side(PyObject*, PyObject* args) {
         fail = true;
         break;
       }
+      PyObject* prev = nullptr;  // row stored under this key pre-insert
       if (bucket == nullptr) {
         bucket = PyDict_New();
         if (bucket == nullptr ||
@@ -1930,16 +1978,24 @@ static PyObject* py_join_apply_side(PyObject*, PyObject* args) {
           break;
         }
         Py_DECREF(bucket);  // state holds it; borrowed ref stays valid
-      } else if (PyDict_Contains(bucket, key) == 1) {
+      } else {
+        prev = PyDict_GetItemWithError(bucket, key);  // borrowed
+        if (prev == nullptr && PyErr_Occurred()) {
+          Py_DECREF(grp);
+          Py_DECREF(row);
+          fail = true;
+          break;
+        }
         // upsert-style re-delivery of a row key: recompute path
-        if (PyList_Append(dirty, jk) < 0) {
+        if (prev != nullptr && PyList_Append(dirty, jk) < 0) {
           Py_DECREF(grp);
           Py_DECREF(row);
           fail = true;
           break;
         }
       }
-      if (PyDict_SetItem(bucket, key, row) < 0 ||
+      if (join_log_undo(undo, jk, key, prev) < 0 ||
+          PyDict_SetItem(bucket, key, row) < 0 ||
           PyDict_SetItem(key2jk, key, jk) < 0) {
         Py_DECREF(grp);
         Py_DECREF(row);
@@ -1955,7 +2011,7 @@ static PyObject* py_join_apply_side(PyObject*, PyObject* args) {
         fail = true;
         break;
       }
-      if (join_evict(state, grp, key) < 0 ||
+      if (join_evict(state, grp, key, undo) < 0 ||
           (moved && PyList_Append(dirty, grp) < 0)) {
         Py_DECREF(grp);
         Py_DECREF(row);
@@ -1998,12 +2054,15 @@ static PyObject* py_join_apply_side(PyObject*, PyObject* args) {
   if (fail) {
     Py_XDECREF(deltas);
     Py_XDECREF(dirty);
+    Py_XDECREF(undo);
     return nullptr;
   }
   PyObject* nerr = PyLong_FromSsize_t(n_err);
-  PyObject* out = nerr ? PyTuple_Pack(3, deltas, dirty, nerr) : nullptr;
+  PyObject* out =
+      nerr ? PyTuple_Pack(4, deltas, dirty, undo, nerr) : nullptr;
   Py_DECREF(deltas);
   Py_DECREF(dirty);
+  Py_DECREF(undo);
   Py_XDECREF(nerr);
   return out;
 }
@@ -2012,9 +2071,7 @@ static PyMethodDef methods[] = {
     {"join_apply_side", py_join_apply_side, METH_VARARGS,
      "apply one side's columnar batch to join bucket state"},
     {"join_ld_cross", py_join_ld_cross, METH_VARARGS,
-     "emit dL x R cross-product rows for fast-path join keys"},
-    {"join_record_pairs", py_join_record_pairs, METH_VARARGS,
-     "bulk emitted[jk][ok] = row bookkeeping"},
+     "emit dL x R cross products columnar with hashed pair output keys"},
     {"batch_rows_split", py_batch_rows_split, METH_VARARGS,
      "SoA transpose of (key, row, diff) triples"},
     {"hash_object_column", py_hash_object_column, METH_VARARGS,

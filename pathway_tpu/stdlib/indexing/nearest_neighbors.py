@@ -114,17 +114,38 @@ class BruteForceKnn(InnerIndex):
         return _KnnIndexFactory(self.dimensions, self.reserved_space, self.metric)
 
 
-class USearchKnn(BruteForceKnn):
-    """API parity with the reference's uSearch HNSW index (``USearchKnn:65``)
-    — backed by the EXACT TPU brute-force gemm, NOT a graph-based ANN.
+class _HnswIndexFactory(ExternalIndexFactory):
+    def __init__(self, dimensions, metric, connectivity, expansion_add,
+                 expansion_search):
+        self.dimensions = dimensions
+        self.metric = metric
+        self.connectivity = connectivity
+        self.expansion_add = expansion_add
+        self.expansion_search = expansion_search
 
-    On TPU the exact path beats host HNSW at the reference's default scales
-    (the gemm + fused top-k is one MXU dispatch), so
-    ``connectivity``/``expansion_*`` are accepted and ignored. This is an
-    explicit alias, not a silent one: construction warns, because at
-    million-vector scale the intended sublinear behavior matters — use
-    :class:`IvfKnnFactory` (the TPU-native ANN) for big corpora.
-    """
+    def make_instance(self):
+        from pathway_tpu.ops.hnsw import HnswIndex
+
+        return HnswIndex(
+            dimensions=self.dimensions,
+            metric=self.metric,
+            connectivity=self.connectivity or 16,
+            expansion_add=self.expansion_add or 128,
+            expansion_search=self.expansion_search or 64,
+        )
+
+
+class USearchKnn(BruteForceKnn):
+    """Graph-based ANN with the reference's uSearch HNSW API
+    (``USearchKnn:65``): a host-side HNSW (``ops/hnsw.py``) honoring
+    ``connectivity`` / ``expansion_add`` / ``expansion_search``.
+
+    Pick by workload: this index is incremental and training-free with
+    sub-linear HOST-side search (no device round trip); for big corpora
+    where per-query HBM traffic dominates, :class:`IvfKnnFactory` is the
+    TPU-native ANN (gemm-shaped probes on the MXU) and the recommended
+    default — the exact :class:`BruteForceKnn` gemm also beats host HNSW
+    outright up to ~10^5-10^6 vectors."""
 
     def __init__(
         self,
@@ -139,15 +160,6 @@ class USearchKnn(BruteForceKnn):
         expansion_search: int = 0,
         embedder: Callable | None = None,
     ):
-        import warnings
-
-        warnings.warn(
-            "USearchKnn on TPU is an EXACT brute-force alias (no HNSW "
-            "graph): fine to ~10^5-10^6 vectors, but for big corpora use "
-            "IvfKnnFactory — the TPU-native approximate index whose probed "
-            "HBM traffic drops ~n_cells/nprobe vs a full scan.",
-            stacklevel=2,
-        )
         super().__init__(
             data_column,
             metadata_column,
@@ -159,6 +171,12 @@ class USearchKnn(BruteForceKnn):
         self.connectivity = connectivity
         self.expansion_add = expansion_add
         self.expansion_search = expansion_search
+
+    def make_factory(self):
+        return _HnswIndexFactory(
+            self.dimensions, self.metric, self.connectivity,
+            self.expansion_add, self.expansion_search,
+        )
 
 
 class _IvfIndexFactory(ExternalIndexFactory):

@@ -234,11 +234,22 @@ class TPUDecoderChat(BaseChat):
         seed: int = 0,
         cache_strategy: udfs.CacheStrategy | None = None,
         max_batch_size: int | None = 64,
+        continuous: bool = False,
+        n_slots: int = 16,
+        chunk_steps: int = 16,
+        deferred: bool = False,
     ):
+        # continuous=True: requests are served by a persistent slot-pool
+        # loop (_ContinuousServer) — new rows admit into the IN-FLIGHT
+        # decode at chunk boundaries instead of waiting for the previous
+        # batch's full generation. deferred=True additionally runs the
+        # UDF on the engine's fully-async path so the pump never blocks
+        # on the decode (see SentenceTransformerEmbedder(deferred=...)).
         super().__init__(
             batch=True,
             max_batch_size=max_batch_size,
             cache_strategy=cache_strategy,
+            executor=udfs.fully_async_executor() if deferred else None,
         )
         if checkpoint_path is not None:
             from pathway_tpu.models.bpe import BPETokenizer
@@ -282,6 +293,72 @@ class TPUDecoderChat(BaseChat):
         # (rows, prompt_len, max_new, temperature, top_k, top_p) -> jitted
         # generate executable
         self._jitted: dict[tuple, Any] = {}
+        self._server: _ContinuousServer | None = None
+        if continuous:
+            self._server = _ContinuousServer(
+                self.params, cfg, tokenizer,
+                n_slots=n_slots, chunk_steps=chunk_steps,
+                max_prompt_tokens=self.max_prompt_tokens,
+                default_max_new=self.max_new_tokens,
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p, seed=seed,
+            )
+            # the two-phase engine protocol only exists in continuous
+            # mode — exposing these as CLASS methods would activate the
+            # pipelined path for batch-static instances too
+            self.submit_batch = self._submit_batch_continuous
+            self.resolve_batch = self._resolve_batch_continuous
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+
+    # two-phase protocol (continuous mode): submit enqueues every row into
+    # the serving loop WITHOUT waiting; resolve blocks on the completions.
+    # Combined with deferred=True the engine pump overlaps the decode.
+    def _submit_batch_continuous(self, messages: list, **kwargs):
+        if self._server is None:
+            raise TypeError("submit_batch requires continuous=True")
+        max_new = int(kwargs.pop("max_new_tokens", self.max_new_tokens))
+        if kwargs:
+            # sampling params are compiled into the serving loop; per-call
+            # overrides would silently apply to OTHER rows' chunks
+            raise TypeError(
+                f"continuous TPUDecoderChat cannot vary {sorted(kwargs)} "
+                f"per call; set them on the constructor"
+            )
+        if max_new > self.max_new_tokens:
+            # the slot pool's KV cache is sized from the constructor's
+            # max_new_tokens; a longer request would clamp-overwrite the
+            # last cache slot and return corrupted tokens
+            raise ValueError(
+                f"continuous TPUDecoderChat serves at most the "
+                f"constructor's max_new_tokens ({self.max_new_tokens}) "
+                f"per request; got {max_new}"
+            )
+        prompt_cap = min(
+            self.max_prompt_tokens, self.cfg.max_position - max_new
+        )
+        if prompt_cap <= 0:
+            raise ValueError(
+                f"max_new_tokens ({max_new}) leaves no room for a prompt "
+                f"within max_position ({self.cfg.max_position})"
+            )
+        reqs = []
+        for m in messages:
+            ids = self.tokenizer.encode(self._format_prompt(m))[-prompt_cap:]
+            reqs.append(self._server.submit(ids, max_new))
+        return reqs
+
+    def _resolve_batch_continuous(self, handles) -> list:
+        out = []
+        for reqs in handles:
+            texts = []
+            for req in reqs:
+                req.done.wait()
+                texts.append(req.text)
+            out.append(texts)
+        return out
 
     def _format_prompt(self, messages) -> str:
         if isinstance(messages, str):
@@ -323,6 +400,11 @@ class TPUDecoderChat(BaseChat):
         import numpy as np
 
         from pathway_tpu.ops import next_pow2
+
+        if self._server is not None:
+            # continuous mode: route the blocking path through the same
+            # slot pool (submit everything, then wait)
+            return self.resolve_batch([self.submit_batch(messages, **kwargs)])[0]
 
         max_new = int(kwargs.pop("max_new_tokens", self.max_new_tokens))
         temp = float(kwargs.pop("temperature", self.temperature))
@@ -386,6 +468,200 @@ class TPUDecoderChat(BaseChat):
                 t = t[: t.index(eos)]
             out.append(self.tokenizer.decode(t))
         return out
+
+
+class _PendingCompletion:
+    """One in-flight continuous-batching request (host-side slot record)."""
+
+    __slots__ = ("ids", "max_new", "tokens", "done", "text", "finished_at")
+
+    def __init__(self, ids: list, max_new: int):
+        import threading
+
+        self.ids = ids
+        self.max_new = max_new
+        self.tokens: list[int] = []
+        self.done = threading.Event()
+        self.text: str | None = None
+        self.finished_at: float | None = None  # time.perf_counter()
+
+
+class _ContinuousServer:
+    """Slot-pool serving loop for ``TPUDecoderChat(continuous=True)``.
+
+    A background thread owns a ``pool_init`` state of ``n_slots``
+    sequences. Requests enqueue at any time; each loop iteration admits
+    waiting requests into free slots (one prefill dispatch per
+    admission, bucketed by prompt length), advances every busy slot
+    ``chunk_steps`` decode steps in ONE dispatch, and frees slots whose
+    stream hit EOS or the request's own ``max_new`` budget. A new
+    request therefore waits at most one chunk — not a whole batch
+    generation (reference ``HFPipelineChat`` is batch-static,
+    llms.py:441)."""
+
+    def __init__(self, params, cfg, tokenizer, *, n_slots: int,
+                 chunk_steps: int, max_prompt_tokens: int,
+                 default_max_new: int, temperature: float, top_k, top_p,
+                 seed: int):
+        import threading
+        from collections import deque
+
+        import jax
+
+        from pathway_tpu.models import decoder as decoder_mod
+        from pathway_tpu.ops import next_pow2
+
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.n_slots = n_slots
+        self.chunk_steps = chunk_steps
+        self.max_prompt_bucket = next_pow2(max_prompt_tokens, 8)
+        # a lane may overrun its budget until the chunk boundary
+        self.cache_len = (
+            self.max_prompt_bucket + default_max_new + chunk_steps
+        )
+        self.eos_id = getattr(tokenizer, "eos_id", None)
+        self._D = decoder_mod
+        self.pool = decoder_mod.pool_init(
+            params, cfg, n_slots, self.cache_len
+        )
+        self._admit_fns: dict = {}
+        cfgc, steps = cfg, chunk_steps
+
+        def chunk(params_, pool, active, key):
+            return decoder_mod.pool_decode_chunk(
+                params_, pool, active, key, cfgc, steps,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+            )
+
+        # donate the pool: the KV caches are the dominant HBM object and
+        # the loop is pure state-in/state-out — without donation every
+        # chunk would copy the whole pool and double peak memory
+        self._chunk_fn = jax.jit(chunk, donate_argnums=(1,))
+        self._key = jax.random.PRNGKey(seed)
+        self._ticks = 0
+        self.queue: deque = deque()
+        self.slots: list = [None] * n_slots
+        self.free = list(range(n_slots))
+        self.lock = threading.Lock()
+        self.wake = threading.Event()
+        self._stop = False
+        self.failed: BaseException | None = None
+        self.stats = {"chunks": 0, "admitted": 0, "steps": 0}
+        self.thread = threading.Thread(
+            target=self._run_safe, daemon=True, name="pathway:decoder-serve"
+        )
+        self.thread.start()
+
+    def _run_safe(self):
+        try:
+            self._loop()
+        except BaseException as exc:  # noqa: BLE001 - never hang waiters
+            self.failed = exc
+            with self.lock:
+                pending = [r for r in self.slots if r is not None]
+                pending.extend(self.queue)
+                self.queue.clear()
+            for req in pending:
+                req.text = None  # error sentinel (UDF rows become ERROR)
+                req.done.set()
+            from pathway_tpu.internals.errors import get_global_error_log
+
+            get_global_error_log().log(
+                f"decoder serving loop died: {type(exc).__name__}: {exc}"
+            )
+
+    def submit(self, prompt_ids: list, max_new: int) -> _PendingCompletion:
+        req = _PendingCompletion(prompt_ids, max_new)
+        with self.lock:
+            # checked under the lock: _run_safe drains the queue under it,
+            # so a failed server can never strand a late submit
+            if self.failed is not None:
+                raise RuntimeError(
+                    f"decoder serving loop died: {self.failed!r}"
+                )
+            self.queue.append(req)
+        self.wake.set()
+        return req
+
+    def _admit_fn(self, s: int):
+        fn = self._admit_fns.get(s)
+        if fn is None:
+            import jax
+
+            D, cfgc = self._D, self.cfg
+
+            def admit(params_, ids, mask, pool, slot):
+                return D.pool_admit(params_, ids, mask, pool, slot, cfgc)
+
+            fn = jax.jit(admit, donate_argnums=(3,))
+            self._admit_fns[s] = fn
+        return fn
+
+    def _loop(self):
+        import jax
+        import numpy as np
+
+        from pathway_tpu.ops import next_pow2
+
+        active = np.zeros(self.n_slots, dtype=bool)
+        while not self._stop:
+            admissions = []
+            with self.lock:
+                while self.queue and self.free:
+                    admissions.append((self.free.pop(), self.queue.popleft()))
+            for slot, req in admissions:
+                e = req.ids[-self.max_prompt_bucket:]
+                s = max(8, next_pow2(max(len(e), 1), 8))
+                ids = np.zeros((1, s), np.int32)
+                mask = np.zeros((1, s), np.int32)
+                if e:
+                    ids[0, s - len(e):] = e
+                    mask[0, s - len(e):] = 1
+                else:
+                    mask[0, -1] = 1
+                self.pool = self._admit_fn(s)(
+                    self.params, ids, mask, self.pool, np.int32(slot)
+                )
+                self.slots[slot] = req
+                active[slot] = True
+                self.stats["admitted"] += 1
+            if not active.any():
+                self.wake.clear()
+                self.wake.wait(timeout=0.05)
+                continue
+            self._ticks += 1
+            key = jax.random.fold_in(self._key, self._ticks)
+            self.pool, toks = self._chunk_fn(
+                self.params, self.pool, active, key
+            )
+            toks = np.asarray(toks)  # (chunk_steps, n_slots) — the sync
+            self.stats["chunks"] += 1
+            self.stats["steps"] += int(active.sum()) * self.chunk_steps
+            for slot in np.nonzero(active)[0]:
+                req = self.slots[slot]
+                for t in toks[:, slot].tolist():
+                    if self.eos_id is not None and t == self.eos_id:
+                        req.max_new = 0  # stream closed
+                        break
+                    req.tokens.append(int(t))
+                    if len(req.tokens) >= req.max_new:
+                        break
+                if req.max_new == 0 or len(req.tokens) >= req.max_new:
+                    import time as time_mod
+
+                    req.text = self.tokenizer.decode(req.tokens)
+                    req.finished_at = time_mod.perf_counter()
+                    self.slots[slot] = None
+                    active[slot] = False
+                    with self.lock:
+                        self.free.append(int(slot))
+                    req.done.set()
+
+    def shutdown(self):
+        self._stop = True
+        self.wake.set()
 
 
 @pw.udf

@@ -321,6 +321,100 @@ def test_join_redelivery_changes_join_key():
             join_mod._native_lib = saved
 
 
+def test_join_mixed_sign_bilinear_fuzz():
+    """Weighted bilinear delta (dL x R_post + L_pre x dR) vs batch truth:
+    random interleaved inserts/retractions on BOTH sides, native and
+    fallback paths. The final downstream multiset must equal the join of
+    the surviving rows computed in one batch."""
+    import numpy as np
+
+    from pathway_tpu.engine.batch import Batch, consolidate
+    from pathway_tpu.engine.graph import EngineGraph, Node
+    from pathway_tpu.engine.operators import join as join_mod
+
+    def mk():
+        g = EngineGraph()
+        left = Node(g, [], ["oid", "uid"], "L")
+        right = Node(g, [], ["uid", "name"], "R")
+        return join_mod.JoinNode(
+            g, left, right, ["uid"], ["uid"], "inner",
+            [("oid", "left", "oid"), ("name", "right", "name")],
+        )
+
+    def apply(d, batch):
+        if batch is None:
+            return
+        batch = consolidate(batch)
+        if batch is None:
+            return
+        for k, row, diff in batch.rows():
+            d[k] = d.get(k, 0) + diff
+            if d[k] == 0:
+                del d[k]
+
+    for native in (False, True):
+        saved = join_mod._native_lib
+        if not native:
+            join_mod._native_lib = None
+        try:
+            if native and join_mod._native_join() is None:
+                continue
+            rng = np.random.default_rng(7)
+            node = mk()
+            down: dict = {}
+            truth_l: dict = {}
+            truth_r: dict = {}
+            t = 1
+            for _step in range(150):
+                ops_l: list = []
+                ops_r: list = []
+                for _ in range(rng.integers(1, 6)):
+                    side = rng.random() < 0.6
+                    tl = truth_l if side else truth_r
+                    ops = ops_l if side else ops_r
+                    used = {k for k, _r, _d in ops}
+                    if tl and rng.random() < 0.45:
+                        items = [k for k in tl if k not in used]
+                        if not items:
+                            continue
+                        k = items[int(rng.integers(0, len(items)))]
+                        ops.append((k, tl.pop(k), -1))
+                    else:
+                        k = int(rng.integers(0, 1 << 30)) + (
+                            0 if side else 1 << 40
+                        )
+                        if k in tl or k in used:
+                            continue
+                        row = (
+                            (k, int(rng.integers(0, 6)))
+                            if side
+                            else (int(rng.integers(0, 6)), f"n{k}")
+                        )
+                        tl[k] = row
+                        ops.append((k, row, 1))
+                ins = [
+                    Batch.from_rows(["oid", "uid"], ops_l) if ops_l else None,
+                    Batch.from_rows(["uid", "name"], ops_r) if ops_r else None,
+                ]
+                apply(down, node.step(t, ins))
+                t += 1
+            ref_node = mk()
+            ref: dict = {}
+            apply(ref, ref_node.step(0, [
+                Batch.from_rows(
+                    ["oid", "uid"],
+                    [(k, r, 1) for k, r in truth_l.items()],
+                ),
+                Batch.from_rows(
+                    ["uid", "name"],
+                    [(k, r, 1) for k, r in truth_r.items()],
+                ),
+            ]))
+            assert down == ref, (native, len(down), len(ref))
+        finally:
+            join_mod._native_lib = saved
+
+
 def test_cross_join_empty_key_list():
     """A join with an EMPTY key list (cross join) buckets every row under
     (); the columnar key extraction must not drop rows for on=[]."""
