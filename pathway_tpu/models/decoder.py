@@ -414,9 +414,12 @@ def pool_init(params: dict, cfg: DecoderConfig, n_slots: int,
               cache_len: int) -> dict:
     """Empty serving pool: per-slot KV caches, last logits, attention
     slot masks and cursors. ``cache_len`` must cover the largest
-    admitted prompt + its budget + one chunk of slack (a lane may
-    overrun its budget until the chunk boundary; writes clamp to the
-    last slot)."""
+    admitted prompt + its budget + one chunk of overrun slack per
+    pipelined chunk in flight INCLUDING the one being dispatched (a
+    lane may overrun its budget until its tokens are drained —
+    ``_ContinuousServer`` runs ``pipeline_depth`` chunks ahead and
+    sizes prompt + budget + (pipeline_depth + 1) * chunk_steps; writes
+    clamp to the last slot)."""
     L, nh, hd = cfg.layers, cfg.heads, cfg.head_dim
     del params
     return {

@@ -237,6 +237,7 @@ class TPUDecoderChat(BaseChat):
         continuous: bool = False,
         n_slots: int = 16,
         chunk_steps: int = 16,
+        pipeline_depth: int = 4,
         deferred: bool = False,
     ):
         # continuous=True: requests are served by a persistent slot-pool
@@ -302,6 +303,7 @@ class TPUDecoderChat(BaseChat):
                 default_max_new=self.max_new_tokens,
                 temperature=self.temperature, top_k=self.top_k,
                 top_p=self.top_p, seed=seed,
+                pipeline_depth=pipeline_depth,
             )
             # the two-phase engine protocol only exists in continuous
             # mode — exposing these as CLASS methods would activate the
@@ -502,7 +504,7 @@ class _ContinuousServer:
     def __init__(self, params, cfg, tokenizer, *, n_slots: int,
                  chunk_steps: int, max_prompt_tokens: int,
                  default_max_new: int, temperature: float, top_k, top_p,
-                 seed: int):
+                 seed: int, pipeline_depth: int = 4):
         import threading
         from collections import deque
 
@@ -517,9 +519,16 @@ class _ContinuousServer:
         self.n_slots = n_slots
         self.chunk_steps = chunk_steps
         self.max_prompt_bucket = next_pow2(max_prompt_tokens, 8)
-        # a lane may overrun its budget until the chunk boundary
+        # the host loop runs ``pipeline_depth`` chunks AHEAD of the token
+        # drain: each chunk's token block starts its device->host copy at
+        # dispatch and has depth*cycle_time to land before the host reads
+        # it (one read otherwise costs a full relay round trip). A lane
+        # may overrun its budget until its tokens drain, so give one
+        # chunk of cache slack per in-flight chunk plus the current one.
+        self.pipeline_depth = max(0, int(pipeline_depth))
         self.cache_len = (
-            self.max_prompt_bucket + default_max_new + chunk_steps
+            self.max_prompt_bucket + default_max_new
+            + (self.pipeline_depth + 1) * chunk_steps
         )
         self.eos_id = getattr(tokenizer, "eos_id", None)
         self._D = decoder_mod
@@ -559,28 +568,35 @@ class _ContinuousServer:
             self._loop()
         except BaseException as exc:  # noqa: BLE001 - never hang waiters
             self.failed = exc
-            with self.lock:
-                pending = [r for r in self.slots if r is not None]
-                pending.extend(self.queue)
-                self.queue.clear()
-            for req in pending:
-                req.text = None  # error sentinel (UDF rows become ERROR)
-                req.done.set()
             from pathway_tpu.internals.errors import get_global_error_log
 
             get_global_error_log().log(
                 f"decoder serving loop died: {type(exc).__name__}: {exc}"
             )
+        finally:
+            # whether the loop died or shutdown() stopped it mid-flight:
+            # every request still in a slot or queued completes with the
+            # error sentinel — a timeout-less resolve wait must never hang
+            with self.lock:
+                pending = [r for r in self.slots if r is not None]
+                pending.extend(self.queue)
+                self.queue.clear()
+            for req in pending:
+                if not req.done.is_set():
+                    req.text = None  # error sentinel (UDF rows -> ERROR)
+                    req.done.set()
 
     def submit(self, prompt_ids: list, max_new: int) -> _PendingCompletion:
         req = _PendingCompletion(prompt_ids, max_new)
         with self.lock:
             # checked under the lock: _run_safe drains the queue under it,
-            # so a failed server can never strand a late submit
+            # so a dead server can never strand a late submit
             if self.failed is not None:
                 raise RuntimeError(
                     f"decoder serving loop died: {self.failed!r}"
                 )
+            if self._stop:
+                raise RuntimeError("decoder serving loop is shut down")
             self.queue.append(req)
         self.wake.set()
         return req
@@ -605,13 +621,22 @@ class _ContinuousServer:
 
         from pathway_tpu.ops import next_pow2
 
+        from collections import deque
+
         active = np.zeros(self.n_slots, dtype=bool)
+        # in-flight chunk records, oldest first; drained once the ring is
+        # deeper than pipeline_depth (or on idle)
+        inflight: deque = deque()
         while not self._stop:
             admissions = []
             with self.lock:
                 while self.queue and self.free:
                     admissions.append((self.free.pop(), self.queue.popleft()))
             for slot, req in admissions:
+                # the slot record goes in FIRST: if the admit dispatch
+                # raises, the failure sweep still finds (and fails) this
+                # request instead of stranding its waiter
+                self.slots[slot] = req
                 e = req.ids[-self.max_prompt_bucket:]
                 s = max(8, next_pow2(max(len(e), 1), 8))
                 ids = np.zeros((1, s), np.int32)
@@ -624,23 +649,42 @@ class _ContinuousServer:
                 self.pool = self._admit_fn(s)(
                     self.params, ids, mask, self.pool, np.int32(slot)
                 )
-                self.slots[slot] = req
                 active[slot] = True
                 self.stats["admitted"] += 1
-            if not active.any():
+            if active.any():
+                self._ticks += 1
+                key = jax.random.fold_in(self._key, self._ticks)
+                self.pool, toks_dev = self._chunk_fn(
+                    self.params, self.pool, active, key
+                )
+                try:
+                    # start the device->host token copy NOW: the block
+                    # lands while the next pipeline_depth chunks compute,
+                    # so the eventual read is local instead of a relay
+                    # round trip (measured ~100ms -> ~1ms per chunk)
+                    toks_dev.copy_to_host_async()
+                except Exception:  # noqa: BLE001 - platform-optional
+                    pass
+                self.stats["chunks"] += 1
+                self.stats["steps"] += int(active.sum()) * self.chunk_steps
+                # snapshot WHICH request each lane served: by the time
+                # these tokens drain the slot may have been freed and
+                # re-admitted to a different request
+                inflight.append((toks_dev, active.copy(), list(self.slots)))
+                if len(inflight) <= self.pipeline_depth:
+                    continue
+            elif not inflight:
                 self.wake.clear()
                 self.wake.wait(timeout=0.05)
                 continue
-            self._ticks += 1
-            key = jax.random.fold_in(self._key, self._ticks)
-            self.pool, toks = self._chunk_fn(
-                self.params, self.pool, active, key
+            prev = inflight.popleft()
+            toks, was_active, snap_slots = (
+                np.asarray(prev[0]), prev[1], prev[2]
             )
-            toks = np.asarray(toks)  # (chunk_steps, n_slots) — the sync
-            self.stats["chunks"] += 1
-            self.stats["steps"] += int(active.sum()) * self.chunk_steps
-            for slot in np.nonzero(active)[0]:
-                req = self.slots[slot]
+            for slot in np.nonzero(was_active)[0]:
+                req = snap_slots[slot]
+                if req is None or req.done.is_set():
+                    continue  # freed by an earlier chunk's tail
                 for t in toks[:, slot].tolist():
                     if self.eos_id is not None and t == self.eos_id:
                         req.max_new = 0  # stream closed
@@ -662,6 +706,11 @@ class _ContinuousServer:
     def shutdown(self):
         self._stop = True
         self.wake.set()
+        t = self.thread
+        if t is not None and t.is_alive():
+            # join so interpreter teardown never kills the thread mid
+            # device call (jax runtime aborts on threads dying inside it)
+            t.join(timeout=10)
 
 
 @pw.udf
