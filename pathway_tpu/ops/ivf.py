@@ -32,23 +32,45 @@ from pathway_tpu.ops import canonical_metric, next_pow2, prep_host_vectors
 _NEG_INF = -1e30
 
 
-@functools.partial(jax.jit, static_argnames=("n_iters",))
-def kmeans_fit(vectors, centroids0, n_iters: int = 10):
+@functools.partial(jax.jit, static_argnames=("n_iters", "block"))
+def kmeans_fit(vectors, centroids0, n_iters: int = 10, block: int = 8192):
     """Mini-batch-free k-means over ``vectors`` (N, d) f32 starting from
     ``centroids0`` (C, d); returns refined (C, d) f32 centroids. Dead
-    centroids keep their previous position."""
+    centroids keep their previous position. Assignment and accumulation
+    run BLOCKED over rows: the (N, C) score/one-hot temps of the naive
+    form are ~17 GB at N=256k, C=16k (measured OOM) — blocking caps them
+    at (block, C)."""
+    n, dim = vectors.shape
+    c = centroids0.shape[0]
+    pad = (-n) % block
+    if pad:
+        vectors = jnp.pad(vectors, ((0, pad), (0, 0)))
+    weights = (jnp.arange(n + pad) < n).astype(jnp.float32)
+    vb = vectors.reshape(-1, block, dim)
+    wb = weights.reshape(-1, block)
 
     def step(centroids, _):
-        scores = jnp.einsum("nd,cd->nc", vectors, centroids,
-                            preferred_element_type=jnp.float32)
-        n_norm = jnp.sum(vectors * vectors, axis=1, keepdims=True)
         c_norm = jnp.sum(centroids * centroids, axis=1)[None, :]
-        assign = jnp.argmin(n_norm + c_norm - 2.0 * scores, axis=1)  # (N,)
-        one_hot = jax.nn.one_hot(assign, centroids.shape[0],
-                                 dtype=jnp.float32)  # (N, C)
-        sums = jnp.einsum("nc,nd->cd", one_hot, vectors,
-                          preferred_element_type=jnp.float32)
-        counts = jnp.sum(one_hot, axis=0)[:, None]
+
+        def blk(inner, inp):
+            sums, counts = inner
+            v, w = inp
+            scores = jnp.einsum("nd,cd->nc", v, centroids,
+                                preferred_element_type=jnp.float32)
+            n_norm = jnp.sum(v * v, axis=1, keepdims=True)
+            assign = jnp.argmin(n_norm + c_norm - 2.0 * scores, axis=1)
+            oh = jax.nn.one_hot(assign, c, dtype=jnp.float32) * w[:, None]
+            sums = sums + jnp.einsum("nc,nd->cd", oh, v,
+                                     preferred_element_type=jnp.float32)
+            counts = counts + jnp.sum(oh, axis=0)
+            return (sums, counts), None
+
+        (sums, counts), _ = jax.lax.scan(
+            blk,
+            (jnp.zeros((c, dim), jnp.float32), jnp.zeros((c,), jnp.float32)),
+            (vb, wb),
+        )
+        counts = counts[:, None]
         new = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0),
                         centroids)
         return new, None
@@ -57,16 +79,32 @@ def kmeans_fit(vectors, centroids0, n_iters: int = 10):
     return centroids
 
 
-_SPILL_CANDIDATES = 4
+# a row tries up to its 32 nearest cells (capped at nprobe per index —
+# see _insert) before the index resorts to growing EVERY cell's
+# capacity: the grow path doubles the dominant HBM tensor (and its
+# eager update can't donate), so spilling further is vastly cheaper
+# than growing for skewed/clustered data (cluster-core cells saturate
+# at ~5x the mean fill). Spilled rows stay FINDABLE because a row's
+# cell is within its own top-nprobe cells, which a query near it probes.
+_SPILL_CANDIDATES = 32
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _zeros_like_donated(x):
+    """Zero a buffer IN PLACE (donation reuses the argument's HBM)."""
+    return jnp.zeros_like(x)
+
+
+# row-block size for cell assignment: the (block, n_cells) score matrix
+# is the dominant temp — 8k rows x 32k cells x 4B = 1 GB regardless of
+# how big an insert batch the caller hands us (an unblocked 512k-row
+# batch against 16k cells needed a 34 GB score matrix: measured OOM)
+_ASSIGN_BLOCK = 8192
 
 
 @functools.partial(jax.jit, static_argnames=("metric", "top_c"))
-def _assign_cells(v, centroids, metric: str, top_c: int = _SPILL_CANDIDATES):
-    """Top-``top_c`` nearest centroids per insert-batch row, (m, top_c)
-    int32, best first. Inserts SPILL to the next-nearest cell when the best
-    one is full — growing every cell's capacity for one hot cell would
-    multiply HBM use (a dense (cells, cap, d) layout pays capacity
-    globally)."""
+def _assign_cells_block(v, centroids, metric: str,
+                        top_c: int = _SPILL_CANDIDATES):
     scores = v @ centroids.T
     if metric == "l2":
         vn = jnp.sum(v * v, axis=1, keepdims=True)
@@ -74,6 +112,26 @@ def _assign_cells(v, centroids, metric: str, top_c: int = _SPILL_CANDIDATES):
         scores = -(vn + cn - 2.0 * scores)
     _, idx = jax.lax.top_k(scores, min(top_c, centroids.shape[0]))
     return idx.astype(jnp.int32)
+
+
+def _assign_cells(v, centroids, metric: str, top_c: int = _SPILL_CANDIDATES):
+    """Top-``top_c`` nearest centroids per insert-batch row, (m, top_c)
+    int32, best first. Inserts SPILL to the next-nearest cell when the best
+    one is full — growing every cell's capacity for one hot cell would
+    multiply HBM use (a dense (cells, cap, d) layout pays capacity
+    globally). Blocked over rows so arbitrarily large insert batches keep
+    a bounded score-matrix footprint."""
+    m = v.shape[0]
+    if m <= _ASSIGN_BLOCK:
+        return _assign_cells_block(v, centroids, metric, top_c)
+    outs = []
+    for s in range(0, m, _ASSIGN_BLOCK):
+        outs.append(
+            _assign_cells_block(
+                v[s : s + _ASSIGN_BLOCK], centroids, metric, top_c
+            )
+        )
+    return jnp.concatenate(outs, axis=0)
 
 
 @functools.partial(
@@ -182,7 +240,10 @@ class IvfFlatIndex:
         self.metric = canonical_metric(metric)
         self.n_cells = n_cells
         self.nprobe = min(nprobe, n_cells)
-        self.cell_cap = next_pow2(cell_capacity, 16)
+        # round to a sublane multiple, NOT a pow2: pow2 rounding silently
+        # grew cell_capacity=640 to 1024 — +60% on the dominant HBM
+        # tensor, which is exactly what capacity budgets are sized against
+        self.cell_cap = max(16, -(-int(cell_capacity) // 16) * 16)
         self.dtype = dtype
         # retrain once this many vectors have arrived (None: n_cells * 16)
         self.train_after = (
@@ -216,29 +277,52 @@ class IvfFlatIndex:
     def _prep(self, vectors) -> np.ndarray:
         return prep_host_vectors(vectors, self.metric)
 
-    def _seed_centroids(self, v: np.ndarray) -> None:
+    @staticmethod
+    def _on_device(v) -> bool:
+        return isinstance(v, jax.Array)
+
+    def _seed_centroids(self, v) -> None:
         if self._centroids is not None:
             return
         reps = int(np.ceil(self.n_cells / max(len(v), 1)))
-        seed = np.tile(v, (reps, 1))[: self.n_cells]
         jitter = np.random.default_rng(0).normal(
-            scale=1e-3, size=seed.shape
-        )
-        self._centroids = jnp.asarray(seed + jitter, dtype=jnp.float32)
+            scale=1e-3, size=(self.n_cells, self.dim)
+        ).astype(np.float32)
+        if self._on_device(v):
+            seed = jnp.tile(v, (reps, 1))[: self.n_cells]
+            self._centroids = seed.astype(jnp.float32) + jnp.asarray(jitter)
+        else:
+            seed = np.tile(v, (reps, 1))[: self.n_cells]
+            self._centroids = jnp.asarray(
+                seed + jitter, dtype=jnp.float32
+            )
 
     def _maybe_train(self) -> None:
         if self._trained or self.n < self.train_after:
             return
-        sample = np.concatenate(self._pending)[-self.train_after * 4:]
+        if any(self._on_device(p) for p in self._pending):
+            sample = jnp.concatenate(
+                [jnp.asarray(p) for p in self._pending]
+            )[-self.train_after * 4:]
+        else:
+            sample = jnp.asarray(
+                np.concatenate(self._pending)[-self.train_after * 4:],
+                dtype=jnp.float32,
+            )
         self._centroids = kmeans_fit(
-            jnp.asarray(sample, dtype=jnp.float32), self._centroids
+            sample.astype(jnp.float32), self._centroids
         )
+        # drop the training sample BEFORE the rebuild: at big-corpus
+        # scales the cells tensor + rebuild working set need every spare
+        # byte of HBM, and this frame would otherwise pin the sample copy
+        del sample
         self._trained = True
         self._rebuild()
 
     def _rebuild(self) -> None:
         """Re-assign every pre-training vector to the trained centroids —
-        from the host-side pending copies (no device readback)."""
+        from the pending copies (host np for the host ingest path, device
+        chunks for ``add_device`` — no device readback either way)."""
         if not self._pending:
             return
         # LATEST copy per key wins (a key removed and re-added pre-training
@@ -249,6 +333,29 @@ class IvfFlatIndex:
         for ai, ks in enumerate(self._pending_keys):
             for ri, k in enumerate(ks):
                 latest[k] = (ai, ri)
+        if any(self._on_device(p) for p in self._pending):
+            # device path: re-insert chunk by chunk with device gathers
+            # (a per-row host stack would fetch GBs over the link)
+            live = set(self._loc)
+            chunks = self._pending
+            keysets = self._pending_keys
+            self._pending = []
+            self._pending_keys = []
+            self._reset_cells()
+            for ai, (chunk, ks) in enumerate(zip(chunks, keysets)):
+                sel = [
+                    ri
+                    for ri, k in enumerate(ks)
+                    if k in live and latest[k] == (ai, ri)
+                ]
+                if not sel:
+                    continue
+                self._insert(
+                    [ks[ri] for ri in sel],
+                    jnp.asarray(chunk)[jnp.asarray(sel, jnp.int32)],
+                    record_pending=False,
+                )
+            return
         keys = [k for k in latest if k in self._loc]
         vecs = (
             np.stack([self._pending[latest[k][0]][latest[k][1]] for k in keys])
@@ -257,20 +364,42 @@ class IvfFlatIndex:
         )
         self._pending.clear()
         self._pending_keys.clear()
-        self._cells = jnp.zeros_like(self._cells)
-        self._valid = jnp.zeros_like(self._valid)
+        self._reset_cells()
+        if len(keys):
+            self._insert(keys, vecs, record_pending=False)
+
+    def _reset_cells(self) -> None:
+        # donated zeroing: plain zeros_like would allocate the NEW cell
+        # tensor while the old one is still referenced — a transient 2x
+        # of the dominant HBM object (measured OOM at a 8.5 GiB tensor)
+        self._cells = _zeros_like_donated(self._cells)
+        self._valid = _zeros_like_donated(self._valid)
         if self._scales is not None:
-            self._scales = jnp.zeros_like(self._scales)
+            self._scales = _zeros_like_donated(self._scales)
         self._keys.clear()
         self._loc.clear()
         self._fill = [0] * self.n_cells
         self._free = [[] for _ in range(self.n_cells)]
         self.n = 0
-        if len(keys):
-            self._insert(keys, vecs, record_pending=False)
 
     def _grow_cells(self) -> None:
         new_cap = self.cell_cap * 2
+        new_bytes = (
+            self.n_cells * new_cap * self.dim
+            * jnp.zeros((), self.dtype).dtype.itemsize
+        )
+        if new_bytes > 7 << 30:
+            # the grow path temporarily holds old + new cell tensors (the
+            # eager update below cannot donate); past ~7 GiB the doubled
+            # tensor cannot fit HBM anyway — fail with an actionable
+            # message instead of an opaque device OOM
+            raise RuntimeError(
+                f"IVF cell capacity exhausted at {self.n} rows "
+                f"(n_cells={self.n_cells}, cell_capacity={self.cell_cap}, "
+                f"spill={_SPILL_CANDIDATES}): growing would need "
+                f"{new_bytes / (1 << 30):.1f} GiB; raise cell_capacity "
+                f"or n_cells up front"
+            )
         cells = jnp.zeros((self.n_cells, new_cap, self.dim), dtype=self.dtype)
         cells = jax.lax.dynamic_update_slice(cells, self._cells, (0, 0, 0))
         valid = jnp.zeros((self.n_cells, new_cap), dtype=bool)
@@ -300,10 +429,15 @@ class IvfFlatIndex:
         # cell assignment on DEVICE (one small gemm + top-k per batch; the
         # host-side matmul dominated million-row builds), one fetch of the
         # int32 candidate matrix (m, top_c) best-first
+        # spill reach is capped at nprobe: a row in its rank-k cell is
+        # only findable when queries probe >= k cells, so spilling past
+        # nprobe would trade silent recall loss for capacity
+        top_c = max(4, min(_SPILL_CANDIDATES, self.nprobe))
         cand = np.asarray(
             jax.device_get(
                 _assign_cells(
-                    jnp.asarray(v, jnp.float32), self._centroids, self.metric
+                    jnp.asarray(v, jnp.float32), self._centroids,
+                    self.metric, top_c=top_c,
                 )
             )
         )
@@ -388,7 +522,33 @@ class IvfFlatIndex:
     def add(self, keys: list, vectors) -> None:
         if not keys:
             return
-        self._insert(keys, self._prep(vectors))
+        v = self._prep(vectors)
+        if len(keys) != len(v):
+            raise ValueError(
+                f"{len(keys)} keys for {len(v)} vectors"
+            )
+        self._insert(keys, v)
+        self._maybe_train()
+
+    def add_device(self, keys: list, vectors) -> None:
+        """Fast path for vectors already ON DEVICE (e.g. straight out of
+        the embedder, or generated on-chip): normalizes, assigns cells,
+        and writes slots without moving the vectors over the host link;
+        pre-training pending copies stay device-resident too. Only the
+        tiny (m, spill) candidate matrix is fetched per batch."""
+        if not keys:
+            return
+        v = jnp.asarray(vectors, jnp.float32)
+        if v.ndim == 1:
+            v = v[None, :]
+        if len(keys) != v.shape[0]:
+            raise ValueError(
+                f"{len(keys)} keys for {v.shape[0]} vectors"
+            )
+        if self.metric == "cos":
+            nrm = jnp.linalg.norm(v, axis=1, keepdims=True)
+            v = v / jnp.maximum(nrm, 1e-12)
+        self._insert(keys, v)
         self._maybe_train()
 
     def remove(self, keys: list) -> None:

@@ -214,6 +214,58 @@ def test_ivf_knn_index_recall_and_deletes():
     assert all(k != "k0" for k, _ in ivf.search(vecs[:1], K)[0])
 
 
+def test_ivf_add_device_matches_host_add():
+    """``add_device`` (device-resident ingest: on-device normalize,
+    device pending chunks, device-gather rebuild) must rank identically
+    to the host ``add`` path, through the train/rebuild lifecycle."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops.ivf import IvfFlatIndex
+
+    rng = np.random.default_rng(0)
+    n, d = 6144, 16
+    centers = rng.standard_normal((16, d)).astype(np.float32) * 3
+    corpus = centers[rng.integers(0, 16, n)] + rng.standard_normal(
+        (n, d)
+    ).astype(np.float32)
+    corpus = (
+        corpus / np.linalg.norm(corpus, axis=1, keepdims=True)
+    ).astype(np.float32)
+    q = corpus[:16]
+
+    def build(dev):
+        idx = IvfFlatIndex(
+            dimensions=d, n_cells=32, nprobe=8, metric="cos",
+            cell_capacity=512, train_after=2048, dtype=jnp.int8,
+        )
+        bs = 2048  # crosses train_after mid-build: rebuild path covered
+        for s in range(0, n, bs):
+            if dev:
+                idx.add_device(
+                    list(range(s, s + bs)),
+                    jax.device_put(corpus[s:s + bs]),
+                )
+            else:
+                idx.add(list(range(s, s + bs)), corpus[s:s + bs])
+        return idx
+
+    rh = build(False).search(q, k=5)
+    rd = build(True).search(q, k=5)
+    assert sum(a[0][0] == b[0][0] for a, b in zip(rh, rd)) >= 15
+    overlap = np.mean([
+        len({k for k, _ in a} & {k for k, _ in b}) / 5
+        for a, b in zip(rh, rd)
+    ])
+    assert overlap >= 0.9, overlap
+    # keys/vector count mismatches must fail loudly on both paths
+    idx = IvfFlatIndex(dimensions=d, n_cells=8, nprobe=2)
+    with pytest.raises(ValueError, match="keys for"):
+        idx.add(list(range(10)), corpus[:5])
+    with pytest.raises(ValueError, match="keys for"):
+        idx.add_device(list(range(10)), jax.device_put(corpus[:5]))
+
+
 def test_ivf_knn_in_dataflow():
     """IvfKnn through DataIndex.query_as_of_now."""
     from pathway_tpu.stdlib.indexing import DataIndex, IvfKnn
