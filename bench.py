@@ -1344,6 +1344,51 @@ def _decoder_serving_compare(params, cfg) -> dict:
     }
 
 
+def _run_phase_subprocess(name: str, timeout_s: int = 1800) -> dict:
+    """Run one bench phase in a fresh process (clean HBM heap) and return
+    its metric dict; stderr diagnostics are forwarded — including on
+    timeout, so a killed phase still shows how far it got."""
+    import subprocess
+
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--phase", name],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired as exc:
+        if exc.stderr:
+            err = exc.stderr
+            sys.stderr.write(
+                err if isinstance(err, str) else err.decode(errors="replace")
+            )
+            sys.stderr.flush()
+        raise
+    if p.stderr:
+        sys.stderr.write(p.stderr)
+        sys.stderr.flush()
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise RuntimeError(
+        f"phase {name!r} produced no metric (rc={p.returncode})"
+    )
+
+
+def run_single_phase(name: str) -> None:
+    from pathway_tpu.models import MINILM_L6
+
+    fns = {
+        "config5": lambda: config5_ivf_recall_latency(MINILM_L6),
+        "join": config_join_streaming,
+        "wordcount": config_wordcount_streaming,
+        "decoder": config_decoder_generate,
+    }
+    print(json.dumps(fns[name]()), flush=True)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -1372,17 +1417,33 @@ def main() -> None:
             extra.append(config3_rerank_latency(cfg, pipe, q_texts))
         except Exception as exc:  # noqa: BLE001
             diag(warning="extra_metric_failed", which="config3", error=repr(exc))
-    for fn, args in (
-        (config4_streaming_engine, ()),
-        (config5_ivf_recall_latency, (cfg,)),
-        (config_join_streaming, ()),
-        (config_wordcount_streaming, ()),
-        (config_decoder_generate, ()),
+    try:
+        extra.append(config4_streaming_engine())
+    except Exception as exc:  # noqa: BLE001
+        diag(warning="extra_metric_failed", which="config4", error=repr(exc))
+    # the remaining phases run in FRESH subprocesses: the big-tier ANN
+    # sweep and the decoder each want most of HBM, and a long-lived
+    # process accumulates allocator fragmentation (measured: phases that
+    # pass standalone RESOURCE_EXHAUSTED in-process after the 1M sweep).
+    # The persistent .jax_cache keeps per-process recompiles cheap.
+    # Release the parent's device state first — the children share the
+    # chip and the big-tier sweep wants every spare byte of HBM.
+    del params
+    pipe = q_texts = None  # noqa: F841
+    import pathway_tpu as pw
+
+    pw.clear_graph()
+    import gc
+
+    gc.collect()
+    for phase, budget in (
+        ("config5", 2400), ("join", 1200), ("wordcount", 900),
+        ("decoder", 1800),
     ):
         try:
-            extra.append(fn(*args))
-        except Exception as exc:  # noqa: BLE001 - auxiliary metrics must not sink the headline
-            diag(warning="extra_metric_failed", which=fn.__name__, error=repr(exc))
+            extra.append(_run_phase_subprocess(phase, timeout_s=budget))
+        except Exception as exc:  # noqa: BLE001 - must not sink the headline
+            diag(warning="extra_metric_failed", which=phase, error=repr(exc))
 
     record = {
         "metric": "rag_ingest_embed_index_docs_per_sec",
@@ -1444,4 +1505,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
+        run_single_phase(sys.argv[2])
+    else:
+        main()
