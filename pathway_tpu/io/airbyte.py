@@ -11,6 +11,12 @@ Execution modes (reference ``io/airbyte/logic.py`` +
 * ``execution_type="docker"`` — the connector's public Docker image,
   wrapped as ``docker run --rm -i --volume <tmp>:<mnt> <image>``
   (:class:`DockerAirbyteSource`). Gated on a ``docker`` binary.
+* ``execution_type="remote"`` — the connector image runs as a Google
+  Cloud Run JOB (:class:`RemoteAirbyteSource`): a self-contained runner
+  script is delivered via env var, incremental state rides the execution
+  overrides, and results come back through Cloud Logging in the
+  reference-compatible chunked transport. Gated on the google-cloud
+  SDKs; tests inject jobs/logs client doubles.
 * ``_source=...`` — any object with ``extract(streams) -> iterable`` of
   Airbyte RECORD message dicts (in-process; used by tests and embedded
   sources).
@@ -219,6 +225,388 @@ class DockerAirbyteSource(ExecutableAirbyteSource):
         )
 
 
+# ---------------------------------------------------------------------------
+# remote (Google Cloud Run) execution
+
+
+class LogChunkTransport:
+    """The chunked stdout->Cloud-Logging result transport the reference's
+    remote runner speaks (``executable_runner.py:52-160``): the run's
+    messages + zlib/b64 catalog are JSON-serialized, split into
+    log-entry-sized chunks, and printed with an index header so the
+    collector can reassemble them from unordered log entries. Field names
+    match the reference wire format, so either side's runner works with
+    either side's collector."""
+
+    ENTRY_TYPE = "__entry_type"
+    INDEX = "index"
+    PAYLOAD = "payload"
+    MESSAGES = "messages"
+    CATALOG = "catalog"
+    METADATA = "metadata"
+    CHUNK = "chunk"
+    N_CHUNKS = "n_chunks"
+    MAX_LOG_ENTRY_LENGTH = 262144
+    MAX_ENV_LENGTH = 32768
+
+    @classmethod
+    def serialize(cls, messages: list, catalog: Any) -> list[dict]:
+        import base64
+        import zlib
+
+        catalog_b64 = base64.b64encode(
+            zlib.compress(
+                json_mod.dumps(catalog, ensure_ascii=False).encode(),
+                level=zlib.Z_BEST_COMPRESSION,
+            )
+        ).decode()
+        if len(catalog_b64) > cls.MAX_ENV_LENGTH:
+            catalog_b64 = None
+        body = json_mod.dumps(
+            {cls.MESSAGES: list(messages), cls.CATALOG: catalog_b64},
+            ensure_ascii=False,
+        )
+        size = int(cls.MAX_LOG_ENTRY_LENGTH * 0.9 / 4 - 256) // 2
+        chunks = [body[i : i + size] for i in range(0, len(body), size)]
+        out = [{cls.ENTRY_TYPE: cls.METADATA, cls.N_CHUNKS: len(chunks)}]
+        out.extend(
+            {cls.ENTRY_TYPE: cls.CHUNK, cls.INDEX: i, cls.PAYLOAD: c}
+            for i, c in enumerate(chunks)
+        )
+        return out
+
+    def __init__(self):
+        self._expected: int | None = None
+        # keyed by index: Cloud Logging delivers at-least-once, and a
+        # duplicated chunk entry must not wedge the count-based check
+        self._chunks: dict[int, str] = {}
+
+    def append(self, payload: Any) -> None:
+        if not isinstance(payload, dict):
+            return
+        entry = payload.get(self.ENTRY_TYPE)
+        if entry == self.METADATA:
+            self._expected = payload[self.N_CHUNKS]
+        elif entry == self.CHUNK:
+            self._chunks[int(payload[self.INDEX])] = payload[self.PAYLOAD]
+
+    def _restore(self):
+        if self._expected is None or self._expected != len(self._chunks):
+            return None
+        return json_mod.loads(
+            "".join(self._chunks[i] for i in sorted(self._chunks))
+        )
+
+    def messages(self):
+        r = self._restore()
+        return None if r is None else r[self.MESSAGES]
+
+    def catalog_b64(self):
+        r = self._restore()
+        return None if r is None else r[self.CATALOG]
+
+
+# The script delivered (base64, env var) into the connector container on
+# Cloud Run: runs discover + read against the image's own entrypoint and
+# prints the results through the chunked log transport. Self-contained —
+# the container only needs python3 (every Airbyte connector image has it).
+_REMOTE_RUNNER_TEMPLATE = r'''
+import base64, json, os, shlex, subprocess, tempfile, zlib
+
+MAX_LOG = @MAX_LOG@
+MAX_ENV = @MAX_ENV@
+
+def sh(cmd):
+    out = subprocess.run(cmd, shell=True, capture_output=True, text=True)
+    lines = []
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            lines.append(json.loads(line))
+        except ValueError:
+            continue
+    return lines, out.returncode, out.stderr[-2000:]
+
+cfg = json.loads(zlib.decompress(base64.b64decode(os.environ["PW_CONFIG"])))
+entry = os.environ.get("AIRBYTE_ENTRYPOINT", "python /airbyte/integration_code/main.py")
+tmp = tempfile.mkdtemp()
+cpath = os.path.join(tmp, "config.json")
+with open(cpath, "w") as f:
+    json.dump(cfg.get("config", {}), f)
+catalog = None
+cached = os.environ.get("CACHED_CATALOG")
+if cached:
+    catalog = json.loads(zlib.decompress(base64.b64decode(cached)))
+if catalog is None:
+    found, rc, err = sh(f"{entry} discover --config {shlex.quote(cpath)}")
+    for m in found:
+        if m.get("type") == "CATALOG":
+            catalog = m["catalog"]
+    if catalog is None:
+        raise SystemExit(f"no CATALOG from discover (rc={rc}): {err}")
+streams = [s for s in (cfg.get("streams") or []) if s]
+conf = {
+    "streams": [
+        {
+            "stream": st,
+            "sync_mode": (
+                "incremental"
+                if "incremental" in (st.get("supported_sync_modes") or [])
+                else "full_refresh"
+            ),
+            "destination_sync_mode": "append",
+            "cursor_field": st.get("default_cursor_field", []),
+        }
+        for st in catalog["streams"]
+        if not streams or st["name"] in streams
+    ]
+}
+catpath = os.path.join(tmp, "catalog.json")
+with open(catpath, "w") as f:
+    json.dump(conf, f)
+cmd = f"{entry} read --config {shlex.quote(cpath)} --catalog {shlex.quote(catpath)}"
+state = os.environ.get("AIRBYTE_STATE")
+if state and state != "null":
+    spath = os.path.join(tmp, "state.json")
+    with open(spath, "w") as f:
+        f.write(state)
+    cmd += f" --state {shlex.quote(spath)}"
+raw, rc, err = sh(cmd)
+messages = [
+    m for m in raw if m.get("type") in ("RECORD", "STATE", "TRACE")
+]
+if rc != 0 and not messages:
+    # a silently-crashed read must surface as an ERROR, not an empty poll
+    messages = [{
+        "type": "TRACE",
+        "trace": {"error": {"message": f"connector read failed rc={rc}",
+                            "stderr": err}},
+    }]
+catalog_b64 = base64.b64encode(
+    zlib.compress(json.dumps(catalog, ensure_ascii=False).encode(), 9)
+).decode()
+if len(catalog_b64) > MAX_ENV:
+    catalog_b64 = None
+body = json.dumps({"messages": messages, "catalog": catalog_b64},
+                  ensure_ascii=False)
+size = int(MAX_LOG * 0.9 / 4 - 256) // 2
+chunks = [body[i:i + size] for i in range(0, len(body), size)]
+print(json.dumps({"__entry_type": "metadata", "n_chunks": len(chunks)}))
+for i, c in enumerate(chunks):
+    print(json.dumps({"__entry_type": "chunk", "index": i, "payload": c},
+                     ensure_ascii=False))
+'''
+
+# one source of truth for the wire constants: the embedded runner is the
+# template with the transport's limits substituted in
+_REMOTE_RUNNER_SOURCE = (
+    _REMOTE_RUNNER_TEMPLATE
+    .replace("@MAX_LOG@", str(LogChunkTransport.MAX_LOG_ENTRY_LENGTH))
+    .replace("@MAX_ENV@", str(LogChunkTransport.MAX_ENV_LENGTH))
+)
+
+
+class RemoteAirbyteSource:
+    """Runs the connector image as a Google Cloud Run JOB (reference
+    ``RemoteAirbyteSource``, ``third_party/airbyte_serverless/
+    sources.py:173``): the job is created at construction, each
+    ``extract`` triggers one execution with the incremental state (and
+    cached catalog) delivered via env overrides, and results come back
+    through Cloud Logging using the chunked transport above.
+
+    Gated: without the ``google-cloud-run`` / ``google-cloud-logging``
+    SDKs, construction requires injected ``jobs_client`` (create_job /
+    run_job / delete_job) and ``logs_lister(execution_id) ->
+    iterable[payload]`` doubles — the air-gapped test surface."""
+
+    def __init__(self, config: dict, streams: Sequence[str], *,
+                 job_id: str, region: str,
+                 credentials: Any = None,
+                 env_vars: dict[str, str] | None = None,
+                 project: str | None = None,
+                 jobs_client: Any = None,
+                 logs_lister: Any = None,
+                 logs_timeout_s: float = 300.0):
+        import base64
+        import zlib
+
+        self.config = config
+        self.streams = list(streams)
+        self.job_id = job_id
+        self.region = region
+        self.env_vars = dict(env_vars or {})
+        self.state: Any = None
+        self._cached_catalog_b64: str | None = None
+        self.logs_timeout_s = logs_timeout_s
+        self.project = project or getattr(credentials, "project_id", None)
+        if self.project is None:
+            # ambient (ADC) credentials carry no project id; the job
+            # parent path needs one — fail here, not with a 404 later
+            raise ValueError(
+                "remote Airbyte execution needs a GCP project id: pass "
+                "gcp_project=... (or credentials with project_id)"
+            )
+        if jobs_client is None or logs_lister is None:
+            try:
+                import google.cloud.logging as gcp_logging  # type: ignore
+                import google.cloud.run_v2 as run_v2  # type: ignore
+            except ImportError as exc:
+                raise ImportError(
+                    "execution_type='remote' needs the google-cloud-run "
+                    "and google-cloud-logging SDKs (or injected "
+                    "jobs_client/logs_lister doubles)"
+                ) from exc
+            jobs_client = jobs_client or run_v2.JobsClient(
+                credentials=credentials
+            )
+            if logs_lister is None:
+                log_client = gcp_logging.Client(
+                    project=self.project, credentials=credentials
+                )
+
+                def logs_lister(execution_id):  # noqa: F811
+                    return (
+                        e.payload
+                        for e in log_client.list_entries(
+                            filter_=(
+                                'labels."run.googleapis.com/'
+                                f'execution_name" = {execution_id}'
+                            ),
+                            page_size=1000,
+                        )
+                    )
+        self.jobs = jobs_client
+        self.logs_lister = logs_lister
+        payload = {
+            "config": (config.get("source") or {}).get("config", {}),
+            "streams": self.streams,
+        }
+        self._config_env = base64.b64encode(
+            zlib.compress(json_mod.dumps(payload).encode(), 9)
+        ).decode()
+        if len(self._config_env) > LogChunkTransport.MAX_ENV_LENGTH:
+            raise ValueError(
+                "connector config too large for a Cloud Run env var "
+                f"({len(self._config_env)} b64 bytes > "
+                f"{LogChunkTransport.MAX_ENV_LENGTH})"
+            )
+        self._create_job()
+
+    @property
+    def job_name(self) -> str:
+        return (
+            f"projects/{self.project}/locations/{self.region}"
+            f"/jobs/{self.job_id}"
+        )
+
+    def _create_job(self) -> None:
+        import base64
+
+        self.maybe_delete_job()
+        image = (self.config.get("source") or {})["docker_image"]
+        env = [{"name": k, "value": v} for k, v in self.env_vars.items()]
+        env.append({"name": "PW_CONFIG", "value": self._config_env})
+        env.append({
+            "name": "RUNNER_CODE",
+            "value": base64.b64encode(
+                _REMOTE_RUNNER_SOURCE.encode()
+            ).decode(),
+        })
+        container = {
+            # the override at run time targets the container by NAME (a
+            # DNS_LABEL) — the image string is not a valid name
+            "name": "connector",
+            "image": image,
+            "command": ["/bin/sh"],
+            "args": [
+                "-c",
+                " && ".join([
+                    "echo $RUNNER_CODE > runner.txt",
+                    "base64 -d < runner.txt > runner.py",
+                    "python3 runner.py",
+                ]),
+            ],
+            "env": env,
+            "resources": {"limits": {"memory": "512Mi", "cpu": "1"}},
+        }
+        self.jobs.create_job(
+            job={"template": {"template": {
+                "containers": [container],
+                "timeout": {"seconds": 3600},
+                "max_retries": 0,
+            }}},
+            job_id=self.job_id,
+            parent=f"projects/{self.project}/locations/{self.region}",
+        ).result()
+
+    def maybe_delete_job(self) -> None:
+        try:
+            self.jobs.delete_job(name=self.job_name).result()
+        except Exception:  # noqa: BLE001 - absent job / NotFound
+            pass
+
+    def on_stop(self) -> None:
+        self.maybe_delete_job()
+
+    def extract(self, streams: Sequence[str] = ()) -> Iterable[dict]:
+        prepared_state = json_mod.dumps(self.state)
+        if len(prepared_state) > LogChunkTransport.MAX_ENV_LENGTH:
+            raise ValueError(
+                "incremental state too large for a Cloud Run env var; "
+                "use fewer streams per read()"
+            )
+        overrides = []
+        if self.state is not None:
+            overrides.append({"name": "AIRBYTE_STATE",
+                              "value": prepared_state})
+        if self._cached_catalog_b64 is not None:
+            overrides.append({"name": "CACHED_CATALOG",
+                              "value": self._cached_catalog_b64})
+        op = self.jobs.run_job({
+            "name": self.job_name,
+            "overrides": {"container_overrides": [{
+                "name": "connector",
+                "env": overrides,
+            }]},
+        })
+        execution_id = op.metadata.name.split("/")[-1]
+        result = op.result()
+        if getattr(result, "succeeded_count", 1) != 1:
+            raise AirbyteSourceError(
+                f"Cloud Run execution {execution_id} failed"
+            )
+        messages = None
+        deadline = time_mod.monotonic() + self.logs_timeout_s
+        while messages is None:
+            transport = LogChunkTransport()
+            for payload in self.logs_lister(execution_id):
+                transport.append(payload)
+            messages = transport.messages()
+            if messages is None:
+                if time_mod.monotonic() > deadline:
+                    raise AirbyteSourceError(
+                        f"no complete result in Cloud Logging for "
+                        f"execution {execution_id} after "
+                        f"{self.logs_timeout_s}s"
+                    )
+                time_mod.sleep(3.0)
+                continue
+            self._cached_catalog_b64 = transport.catalog_b64()
+        # fail BEFORE committing state: advancing the cursor while
+        # discarding the batch's records would silently skip them forever
+        for message in messages:
+            if (message.get("trace") or {}).get("error"):
+                raise AirbyteSourceError(
+                    json_mod.dumps(message["trace"]["error"])
+                )
+        for message in messages:
+            if message.get("type") == "STATE":
+                self.state = message.get("state")
+        return [m for m in messages if m.get("type") == "RECORD"]
+
+
 def _make_serverless_source(config_file_path, streams, env_vars, enforce_method):
     try:
         import yaml
@@ -286,6 +674,7 @@ def read(
     service_user_credentials_file: str | None = None,
     gcp_region: str = "europe-west1",
     gcp_job_name: str | None = None,
+    gcp_project: str | None = None,
     enforce_method: str | None = None,
     refresh_interval_ms: int = 60000,
     persistent_id: str | None = None,
@@ -306,10 +695,33 @@ def read(
                 streams,
                 env_vars,
             )
+        elif execution_type == "remote":
+            import yaml
+
+            with open(config_file_path) as f:
+                config = yaml.safe_load(f)
+            credentials = None
+            if service_user_credentials_file is not None:
+                from google.oauth2 import service_account  # type: ignore
+
+                credentials = (
+                    service_account.Credentials.from_service_account_file(
+                        service_user_credentials_file
+                    )
+                )
+            job_id = gcp_job_name or (
+                "pathway-airbyte-"
+                + format(hash_values(str(config_file_path)) & 0xFFFFFF, "x")
+            )
+            _source = RemoteAirbyteSource(
+                config, streams, job_id=job_id, region=gcp_region,
+                credentials=credentials, env_vars=env_vars,
+                project=gcp_project,
+            )
         elif execution_type != "local":
-            raise NotImplementedError(
-                "remote (GCP) Airbyte execution requires cloud access; use "
-                "execution_type='local'/'docker' or pass _source=..."
+            raise ValueError(
+                f"unknown execution_type {execution_type!r}; expected "
+                "'local', 'docker' or 'remote'"
             )
         else:
             _source = _make_serverless_source(
