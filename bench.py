@@ -37,9 +37,13 @@ import numpy as np
 # state instead of cold compiles
 import jax as _jax  # noqa: E402
 
+# PATHWAY_TPU_COMPILE_CACHE overrides the bench-local default so engine
+# runs, tests and the bench can share one cache (internals/config.py wires
+# the same env var package-wide)
 _jax.config.update(
     "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    os.environ.get("PATHWAY_TPU_COMPILE_CACHE")
+    or os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
 )
 _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
@@ -263,6 +267,48 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
         kernels_only=round(kernels_only, 1),
     )
     mfu = docs_per_sec * flops_per_doc(cfg, SEQ) / V5E_PEAK_BF16
+
+    # per-phase roofline: accounted bytes + FLOPs -> MFU / HBM utilisation /
+    # bound, so "34% MFU" comes with the ledger that explains it
+    from pathway_tpu.engine.probes import RooflineModel
+
+    param_bytes = sum(
+        int(np.prod(p.shape)) * p.dtype.itemsize
+        for p in jax.tree.leaves(params)
+    )
+
+    def ingest_bytes(n_docs: int, seq: int) -> float:
+        """HBM traffic model for a doc window: one full parameter read per
+        dispatched batch plus bf16 activation traffic (~4 reads/writes per
+        layer per token element — attention+mlp operand streams)."""
+        batches = max(1, n_docs // BATCH)
+        activations = 8.0 * cfg.layers * n_docs * seq * cfg.hidden
+        return batches * param_bytes + activations
+
+    roofline = RooflineModel(peak_flops=V5E_PEAK_BF16)
+    win_docs = BATCH * n_batches
+    roofline.add(
+        "ingest",
+        seconds=win_docs / max(docs_per_sec, 1e-9),
+        flops=win_docs * flops_per_doc(cfg, SEQ),
+        bytes_moved=ingest_bytes(win_docs, SEQ),
+        dispatches=n_batches,
+    )
+    roofline.add(
+        "embed_only",
+        seconds=n_pipe * BATCH / max(embed_rate, 1e-9),
+        flops=n_pipe * BATCH * flops_per_doc(cfg, SEQ),
+        bytes_moved=ingest_bytes(n_pipe * BATCH, SEQ),
+        dispatches=n_pipe,
+    )
+    if kernels_only:
+        roofline.add(
+            "kernels_only",
+            seconds=win_docs / kernels_only,
+            flops=win_docs * flops_per_doc(cfg, SEQ),
+            bytes_moved=ingest_bytes(win_docs, SEQ),
+            dispatches=n_batches,
+        )
     breakdown = {
         "metric": "ingest_mfu_pct",
         "value": round(mfu * 100, 1),
@@ -274,6 +320,7 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
             "kernels_only_docs_per_sec": round(kernels_only, 1),
             "flops_per_doc_g": round(flops_per_doc(cfg, SEQ) / 1e9, 2),
             "tokenizer": "wordpiece (native C++, HF-parity)",
+            "roofline": roofline.summary(),
         },
     }
     return docs_per_sec, breakdown
@@ -373,37 +420,48 @@ def config3_rerank_latency(cfg, pipe, q_texts) -> dict:
     }
 
 
+def _median_and_spread(rates: list[float]) -> tuple[float, float]:
+    """Median of repeat windows + relative spread (max-min)/median — the
+    dev/driver disagreement came from single ~1 s windows; median over
+    stabilized windows is the reported number, spread the error bar."""
+    med = float(np.median(rates))
+    spread = (max(rates) - min(rates)) / med * 100.0 if med > 0 else 0.0
+    return med, spread
+
+
 def config4_streaming_engine() -> dict:
     """Config 4: end-to-end ENGINE path — streaming Kafka -> embed UDF ->
     KNN upsert with live queries riding the stream. This number includes all
     host-side engine overhead (connectors, operators, consolidation), unlike
-    the device-path headline."""
+    the device-path headline.
+
+    Stabilized measurement (VERDICT r5: ~1 s windows explained the 10%
+    dev/driver disagreement): each repeat streams enough docs for a >=5 s
+    window at the observed rate, >=3 repeats, median + spread reported."""
+    import gc
     import threading
 
     import pathway_tpu as pw
+    from pathway_tpu.engine import probes as probes_mod
     from pathway_tpu.io.kafka import InMemoryKafkaBroker
     from pathway_tpu.models import MINILM_L6
     from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
 
-    pw.clear_graph()
-    broker = InMemoryKafkaBroker()
-    N_DOCS = 16384  # a sustained window: fixed startup cost amortizes out
+    # ~98k docs ≈ 5.5 s at the r5 rate (17.7k docs/s); override for smoke
+    # runs via env
+    N_DOCS = int(os.environ.get("PATHWAY_BENCH_CONFIG4_DOCS", str(6 * 16384)))
+    N_REPEATS = int(os.environ.get("PATHWAY_BENCH_REPS", "3"))
+    SEQ_ENGINE = 32  # 24-word docs tokenize into the seq-32 bucket
+
     words = ["alpha", "beta", "gamma", "delta", "stream", "tensor", "index"]
     rng = np.random.default_rng(11)
-    for i in range(N_DOCS):
-        broker.produce(
-            "docs",
-            json.dumps(
-                {"id": i, "text": " ".join(rng.choice(words, 24))}
-            ).encode(),
-        )
-    broker.close()
+    payloads = [
+        json.dumps(
+            {"id": i, "text": " ".join(rng.choice(words, 24))}
+        ).encode()
+        for i in range(N_DOCS)
+    ]
 
-    class DocSchema(pw.Schema):
-        id: int
-        text: str
-
-    docs = pw.io.kafka.read(broker, topic="docs", schema=DocSchema)
     embedder = SentenceTransformerEmbedder(
         # deferred: fully-async two-phase mode — the engine pump overlaps
         # host dataflow (parse/join/index/subscribe) with the TPU embed,
@@ -411,7 +469,8 @@ def config4_streaming_engine() -> dict:
         model="minilm-l6", max_batch_size=1024, deferred=True,
     )
     # warm the embed + index executables for the stream's shape buckets so
-    # the timed window measures ENGINE throughput, not one-time XLA compiles
+    # the timed windows measure ENGINE throughput, not one-time XLA
+    # compiles (once: the in-process executable cache carries across reps)
     warm_text = " ".join(rng.choice(words, 24))
     from pathway_tpu.ops.knn import BruteForceKnnIndex as _Knn
 
@@ -434,65 +493,132 @@ def config4_streaming_engine() -> dict:
     embedder.model.embed_batch(["alpha stream tensor"] * 2)
     warm_idx.add([f"w{i}" for i in range(N_DOCS)], warm_vecs)
     warm_idx.search(warm_vecs[:2], k=TOP_K)  # search bucket 16
-    embedded = docs.select(docs.id, vec=embedder(docs.text))
+    del warm_idx, warm_vecs
+    gc.collect()
 
-    from pathway_tpu.stdlib.indexing import BruteForceKnn, DataIndex
+    class DocSchema(pw.Schema):
+        id: int
+        text: str
 
-    index = DataIndex(
-        embedded,
-        BruteForceKnn(
-            embedded.vec,
-            dimensions=MINILM_L6.hidden,
-            # MUST match the warm-up index: jit executables key on the
-            # corpus capacity shape. The pad-bucket of slack means ragged
-            # commits NEVER clamp to odd tail shapes (the cost — capacity
-            # rounds 16896 up to 32768, ~2x the per-search gemm — is noise
-            # here: searches are dispatch-RTT-bound at this size).
-            reserved_space=N_DOCS + 512,
-            metric="cos",
+    def one_rep() -> dict:
+        pw.clear_graph()
+        broker = InMemoryKafkaBroker()
+        for p in payloads:
+            broker.produce("docs", p)
+        broker.close()
+        docs = pw.io.kafka.read(broker, topic="docs", schema=DocSchema)
+        embedded = docs.select(docs.id, vec=embedder(docs.text))
+
+        from pathway_tpu.stdlib.indexing import BruteForceKnn, DataIndex
+
+        index = DataIndex(
+            embedded,
+            BruteForceKnn(
+                embedded.vec,
+                dimensions=MINILM_L6.hidden,
+                # MUST match the warm-up index: jit executables key on the
+                # corpus capacity shape. The pad-bucket of slack means
+                # ragged commits NEVER clamp to odd tail shapes (the cost —
+                # capacity rounding, ~2x the per-search gemm — is noise
+                # here: searches are dispatch-RTT-bound at this size).
+                reserved_space=N_DOCS + 512,
+                metric="cos",
+            ),
+        )
+        queries = pw.debug.table_from_pandas(
+            __import__("pandas").DataFrame(
+                {"qtext": ["alpha stream tensor", "delta index beta"]}
+            )
+        )
+        q_emb = queries.select(qvec=embedder(queries.qtext))
+        res = index.query_as_of_now(q_emb.qvec, number_of_matches=TOP_K)
+        n_results = []
+        pw.io.subscribe(
+            res,
+            on_change=lambda key, row, time, is_addition: n_results.append(1),
+        )
+
+        counted = []
+        pw.io.subscribe(
+            embedded,
+            on_change=lambda key, row, time, is_addition: counted.append(1),
+        )
+
+        def stop_when_done():
+            deadline = time.time() + 300
+            while time.time() < deadline and len(counted) < N_DOCS:
+                time.sleep(0.05)
+            for c in pw.G.connectors:
+                c._stop.set()
+                c.close()
+
+        threading.Thread(target=stop_when_done, daemon=True).start()
+        disp_before = probes_mod.dispatch_counts()
+        t0 = time.perf_counter()
+        pw.run()
+        elapsed = time.perf_counter() - t0
+        disp_after = probes_mod.dispatch_counts()
+        from pathway_tpu.internals.run import LAST_RUN_STATS
+
+        tax = LAST_RUN_STATS.engine_tax() if LAST_RUN_STATS else {}
+        out = {
+            "rate": len(counted) / elapsed,
+            "elapsed": elapsed,
+            "docs": len(counted),
+            "query_results": len(n_results),
+            "engine": tax,
+            "dispatches": {
+                k: disp_after.get(k, 0) - disp_before.get(k, 0)
+                for k in disp_after
+                if disp_after.get(k, 0) != disp_before.get(k, 0)
+            },
+        }
+        gc.collect()  # free the rep's 150MB device corpus before the next
+        return out
+
+    reps = [one_rep() for _ in range(max(1, N_REPEATS))]
+    rates = [r["rate"] for r in reps]
+    med, spread = _median_and_spread(rates)
+
+    # engine-side ingest roofline: same accounting as the headline's, at
+    # the stream's seq bucket — the MFU the ENGINE path sustains
+    from pathway_tpu.engine.probes import RooflineModel
+    from pathway_tpu.models.transformer import MINILM_L6 as _cfg
+
+    roofline = RooflineModel(peak_flops=V5E_PEAK_BF16)
+    total_docs = sum(r["docs"] for r in reps)
+    roofline.add(
+        "engine_ingest",
+        seconds=sum(r["elapsed"] for r in reps),
+        flops=total_docs * flops_per_doc(_cfg, SEQ_ENGINE),
+        bytes_moved=total_docs * 8.0 * _cfg.layers * SEQ_ENGINE * _cfg.hidden,
+        dispatches=sum(
+            sum(r["dispatches"].values()) for r in reps
         ),
     )
-    queries = pw.debug.table_from_pandas(
-        __import__("pandas").DataFrame(
-            {"qtext": ["alpha stream tensor", "delta index beta"]}
-        )
-    )
-    q_emb = queries.select(qvec=embedder(queries.qtext))
-    res = index.query_as_of_now(q_emb.qvec, number_of_matches=TOP_K)
-    n_results = []
-    pw.io.subscribe(
-        res, on_change=lambda key, row, time, is_addition: n_results.append(1)
-    )
-
-    counted = []
-    pw.io.subscribe(
-        embedded, on_change=lambda key, row, time, is_addition: counted.append(1)
-    )
-
-    def stop_when_done():
-        deadline = time.time() + 300
-        while time.time() < deadline and len(counted) < N_DOCS:
-            time.sleep(0.05)
-        for c in pw.G.connectors:
-            c._stop.set()
-            c.close()
-
-    threading.Thread(target=stop_when_done, daemon=True).start()
-    t0 = time.perf_counter()
-    pw.run()
-    elapsed = time.perf_counter() - t0
-    rate = len(counted) / elapsed
     diag(
         phase="config4",
-        streaming_docs_per_sec=round(rate, 1),
-        docs=len(counted),
-        query_results=len(n_results),
+        streaming_docs_per_sec=round(med, 1),
+        windows=[round(r, 1) for r in rates],
+        spread_pct=round(spread, 1),
+        window_seconds=[round(r["elapsed"], 2) for r in reps],
+        engine=reps[-1]["engine"],
+        dispatches=reps[-1]["dispatches"],
     )
     return {
         "metric": "streaming_engine_embed_upsert_docs_per_sec",
-        "value": round(rate, 1),
+        "value": round(med, 1),
         "unit": "docs/s",
-        "detail": {"docs": len(counted), "live_query_results": len(n_results)},
+        "detail": {
+            "docs_per_window": N_DOCS,
+            "windows_docs_per_sec": [round(r, 1) for r in rates],
+            "window_seconds": [round(r["elapsed"], 2) for r in reps],
+            "spread_pct": round(spread, 1),
+            "live_query_results": reps[-1]["query_results"],
+            "engine": reps[-1]["engine"],
+            "device_dispatches": reps[-1]["dispatches"],
+            "roofline": roofline.summary(),
+        },
     }
 
 
@@ -985,41 +1111,25 @@ def config_join_streaming() -> dict:
 def config_wordcount_streaming() -> dict:
     """Engine streaming throughput on the reference's claim-to-fame shape
     (wordcount vs Flink/Spark, ``/root/reference/README.md:245-251``):
-    jsonlines files arriving over time -> groupby/count -> subscriber."""
+    jsonlines files arriving over time -> groupby/count -> subscriber.
+
+    Stabilized: each repeat streams enough rows for a >=2 s window, >=3
+    repeats, median + spread reported (the old single ~0.5 s window was
+    inside connector-poll jitter)."""
     import os
     import shutil
     import threading
 
     import pathway_tpu as pw
 
-    pw.clear_graph()
-    src = "/tmp/pathway_bench_wc"
-    shutil.rmtree(src, ignore_errors=True)
-    os.makedirs(src)
+    n_rows = int(os.environ.get("PATHWAY_BENCH_WC_ROWS", "1600000"))
+    n_files = 16
+    n_repeats = int(os.environ.get("PATHWAY_BENCH_REPS", "3"))
 
     class S(pw.Schema):
         word: str
 
-    t = pw.io.jsonlines.read(src, schema=S, mode="streaming", refresh_interval=0.02)
-    counts = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
-    n_rows, n_files = 400_000, 10
-    # subscribe to the AGGREGATE (the wordcount benchmark's observable —
-    # Flink/Spark comparisons sink the counts, not a raw passthrough);
-    # completion = the live totals sum to every ingested row
-    totals: dict = {}
-    running = [0]  # O(1) completion check: track the sum via count deltas
-    done = threading.Event()
-
-    def on_counts(key, row, time, is_addition):
-        if is_addition:
-            w = row["word"]
-            running[0] += row["c"] - totals.get(w, 0)
-            totals[w] = row["c"]
-            if running[0] >= n_rows:
-                done.set()
-
-    pw.io.subscribe(counts, on_change=on_counts)
-    # pre-render the input bytes OUTSIDE the timed window: the bench
+    # pre-render the input bytes OUTSIDE the timed windows: the bench
     # measures the pipeline, not the feeder's string formatting
     per = n_rows // n_files
     blobs = [
@@ -1028,31 +1138,80 @@ def config_wordcount_streaming() -> dict:
         )
         for fi in range(n_files)
     ]
+    n_rows = per * n_files  # what the blobs actually contain
 
-    def feeder():
-        for fi, blob in enumerate(blobs):
-            tmp = f"{src}/f{fi}.jsonl.tmp"
-            with open(tmp, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, f"{src}/f{fi}.jsonl")
-        done.wait(timeout=240)
-        for c in pw.G.connectors:
-            c._stop.set()
-            c.close()
+    def one_rep() -> dict:
+        pw.clear_graph()
+        src = "/tmp/pathway_bench_wc"
+        shutil.rmtree(src, ignore_errors=True)
+        os.makedirs(src)
+        t = pw.io.jsonlines.read(
+            src, schema=S, mode="streaming", refresh_interval=0.02
+        )
+        counts = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+        # subscribe to the AGGREGATE (the wordcount benchmark's observable —
+        # Flink/Spark comparisons sink the counts, not a raw passthrough);
+        # completion = the live totals sum to every ingested row
+        totals: dict = {}
+        running = [0]  # O(1) completion check: track the sum via deltas
+        done = threading.Event()
 
-    threading.Thread(target=feeder, daemon=True).start()
-    t0 = time.perf_counter()
-    pw.run()
-    elapsed = time.perf_counter() - t0
-    ingested = sum(totals.values())
-    rate = ingested / elapsed
-    shutil.rmtree(src, ignore_errors=True)
-    diag(phase="wordcount", streaming_rows_per_sec=round(rate, 1))
+        def on_counts(key, row, time, is_addition):
+            if is_addition:
+                w = row["word"]
+                running[0] += row["c"] - totals.get(w, 0)
+                totals[w] = row["c"]
+                if running[0] >= n_rows:
+                    done.set()
+
+        pw.io.subscribe(counts, on_change=on_counts)
+
+        def feeder():
+            for fi, blob in enumerate(blobs):
+                tmp = f"{src}/f{fi}.jsonl.tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, f"{src}/f{fi}.jsonl")
+            done.wait(timeout=240)
+            for c in pw.G.connectors:
+                c._stop.set()
+                c.close()
+
+        threading.Thread(target=feeder, daemon=True).start()
+        t0 = time.perf_counter()
+        pw.run()
+        elapsed = time.perf_counter() - t0
+        ingested = sum(totals.values())
+        shutil.rmtree(src, ignore_errors=True)
+        return {
+            "rate": ingested / elapsed,
+            "elapsed": elapsed,
+            "rows": ingested,
+            "distinct_words": len(totals),
+        }
+
+    reps = [one_rep() for _ in range(max(1, n_repeats))]
+    rates = [r["rate"] for r in reps]
+    med, spread = _median_and_spread(rates)
+    diag(
+        phase="wordcount",
+        streaming_rows_per_sec=round(med, 1),
+        windows=[round(r, 1) for r in rates],
+        spread_pct=round(spread, 1),
+        window_seconds=[round(r["elapsed"], 2) for r in reps],
+    )
     return {
         "metric": "wordcount_streaming_rows_per_sec",
-        "value": round(rate, 1),
+        "value": round(med, 1),
         "unit": "rows/s",
-        "detail": {"rows": ingested, "files": n_files, "distinct_words": len(totals)},
+        "detail": {
+            "rows": reps[-1]["rows"],
+            "files": n_files,
+            "distinct_words": reps[-1]["distinct_words"],
+            "windows_rows_per_sec": [round(r, 1) for r in rates],
+            "window_seconds": [round(r["elapsed"], 2) for r in reps],
+            "spread_pct": round(spread, 1),
+        },
     }
 
 
@@ -1381,6 +1540,7 @@ def run_single_phase(name: str) -> None:
     from pathway_tpu.models import MINILM_L6
 
     fns = {
+        "config4": config4_streaming_engine,
         "config5": lambda: config5_ivf_recall_latency(MINILM_L6),
         "join": config_join_streaming,
         "wordcount": config_wordcount_streaming,
@@ -1463,6 +1623,16 @@ def main() -> None:
     ivf = _m("ivf_recall_at_10")
     big = (ivf.get("detail") or {}).get("sweep_big") or {}
     join = _m("streaming_join_rows_per_sec")
+    config4 = _m("streaming_engine_embed_upsert_docs_per_sec")
+    c4_val = config4.get("value")
+    # engine tax ratio: ENGINE-path docs/s over the device-path headline —
+    # the PR's contract number (>=0.85 target, was 0.761 at r5)
+    tax_ratio = (
+        round(c4_val / docs_per_sec, 3)
+        if isinstance(c4_val, (int, float)) and docs_per_sec
+        else None
+    )
+    headline_detail = (mfu_metric.get("detail") or {})
     summary = {
         "metric": "rag_ingest_embed_index_docs_per_sec",
         "value": round(docs_per_sec, 1),
@@ -1470,9 +1640,13 @@ def main() -> None:
         "vs_baseline": round(docs_per_sec / BASELINE_DOCS_PER_SEC, 3),
         "summary": {
             "ingest_mfu_pct": mfu_metric.get("value"),
-            "config4_engine_docs_per_sec": _m(
-                "streaming_engine_embed_upsert_docs_per_sec"
-            ).get("value"),
+            "ingest_roofline": headline_detail.get("roofline"),
+            "config4_engine_docs_per_sec": c4_val,
+            "config4_spread_pct": (config4.get("detail") or {}).get(
+                "spread_pct"
+            ),
+            "engine_tax_ratio": tax_ratio,
+            "engine_stats": (config4.get("detail") or {}).get("engine"),
             "join_e2e_rows_per_sec": join.get("value"),
             "join_hotkey_deltas_per_sec": (join.get("detail") or {}).get(
                 "hotkey_single_insert_deltas_per_sec"

@@ -88,6 +88,10 @@ from pathway_tpu.internals.universe import Universe
 from pathway_tpu.internals import config as _config
 from pathway_tpu.internals.config import set_license_key, set_monitoring_config
 
+# persistent XLA compilation cache for the whole package (engine runs,
+# tests, bench) — opt-in via PATHWAY_TPU_COMPILE_CACHE=<dir>, no-op otherwise
+_config.maybe_enable_compile_cache()
+
 # submodule namespaces (populated lazily to avoid import cycles)
 from pathway_tpu import asynchronous  # noqa: E402
 from pathway_tpu import debug  # noqa: E402

@@ -71,6 +71,13 @@ class Batch:
 
     def take(self, mask_or_idx: np.ndarray) -> "Batch":
         if mask_or_idx.dtype == bool:
+            # all-true mask: skip the nonzero scan AND the per-column gather
+            # copies (the hot shape — filters on streaming ingest mostly
+            # pass everything). Safe to alias: batches are treated as
+            # immutable by operators (consolidate only mutates fresh
+            # int-indexed copies).
+            if mask_or_idx.all():
+                return self
             idx = np.nonzero(mask_or_idx)[0]
         else:
             idx = mask_or_idx

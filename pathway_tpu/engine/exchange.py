@@ -359,6 +359,11 @@ class ExchangeNode(Node):
     reference's per-worker external-index instances see the full
     add-stream)."""
 
+    # must step EVERY epoch even with no local deltas: the exchange is a
+    # collective — peers with data block until this side joins (so the
+    # scheduler's sparse-stepping skip does not apply)
+    always_step = True
+
     def __init__(self, graph, input_node, ctx: ExchangeContext,
                  routing, name="Exchange"):
         super().__init__(graph, [input_node], input_node.column_names, name)

@@ -530,6 +530,24 @@ class ExpressionEvaluator:
         return method_impl.dispatch(e._method, args, e._kwargs, self.env.n)
 
 
+def eval_exprs(
+    cols: dict[str, np.ndarray],
+    keys: np.ndarray,
+    n: int,
+    exprs: dict[str, Any],
+) -> dict[str, np.ndarray]:
+    """Evaluate a named expression program over raw batch arrays.
+
+    The shared evaluation core of ``RowwiseNode.step`` and the fused-chain
+    rowwise stage (``operators/core.py:fusable_stage``): one ``EvalEnv`` /
+    ``ExpressionEvaluator`` pair per batch, every output column evaluated
+    against the SAME input environment (self-referential programs see input
+    columns, not freshly computed ones — reference select semantics)."""
+    env = EvalEnv(cols, keys, n)
+    ev = ExpressionEvaluator(env)
+    return {name: ev.eval(e) for name, e in exprs.items()}
+
+
 def _to_string(v) -> str:
     if isinstance(v, bool):
         return "True" if v else "False"

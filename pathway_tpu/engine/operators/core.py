@@ -13,7 +13,12 @@ from typing import Any, Callable
 import numpy as np
 
 from pathway_tpu.engine.batch import Batch, concat_batches, consolidate
-from pathway_tpu.engine.expression_eval import EvalEnv, ExpressionEvaluator, error_mask
+from pathway_tpu.engine.expression_eval import (
+    EvalEnv,
+    ExpressionEvaluator,
+    error_mask,
+    eval_exprs,
+)
 from pathway_tpu.engine.graph import EngineGraph, Node
 from pathway_tpu.engine.state import (
     DuplicateKeyError,
@@ -157,11 +162,9 @@ class RowwiseNode(Node):
         ):
             return self._step_deferred(batch)
         if not self._nondet:
-            env = EvalEnv(batch.cols, batch.keys, len(batch))
-            ev = ExpressionEvaluator(env)
-            out_cols = {}
-            for name, expr in self.expressions.items():
-                out_cols[name] = ev.eval(expr)
+            out_cols = eval_exprs(
+                batch.cols, batch.keys, len(batch), self.expressions
+            )
             return Batch(batch.keys, out_cols, batch.diffs)
         return self._step_consistent(batch)
 
@@ -423,6 +426,104 @@ class SelectColumnsNode(Node):
             {out: batch.cols[src] for out, src in self.mapping.items()},
             batch.diffs,
         )
+
+
+# ------------------------------------------------------------------------- #
+# chain fusion stages (engine/graph.py:fuse_chains)
+#
+# A "stage" is the fused form of one stateless per-row operator: a closure
+# (keys, cols, diffs) -> (keys, cols, diffs) | None operating on the raw
+# batch arrays. Stages run back-to-back inside FusedChainNode.step with no
+# intermediate Batch objects and no per-member consolidate — but in chain
+# order with masks applied immediately, so values, dropped rows and error
+# logging are byte-identical to the unfused graph.
+
+
+def _rowwise_stage(node: "RowwiseNode"):
+    exprs = node.expressions
+
+    def stage(keys, cols, diffs):
+        return keys, eval_exprs(cols, keys, len(keys), exprs), diffs
+
+    return stage
+
+
+def _filter_stage(node: "FilterNode"):
+    predicate = node.predicate
+
+    def stage(keys, cols, diffs):
+        n = len(keys)
+        env = EvalEnv(cols, keys, n)
+        cond = ExpressionEvaluator(env).eval(predicate)
+        mask = np.zeros(n, dtype=bool)
+        for i, v in enumerate(cond):
+            if v is True:
+                mask[i] = True
+            elif v is ERROR:
+                get_global_error_log().log("Error value in filter condition")
+        if not mask.any():
+            return None
+        if mask.all():
+            return keys, cols, diffs
+        idx = np.nonzero(mask)[0]
+        return keys[idx], {n_: c[idx] for n_, c in cols.items()}, diffs[idx]
+
+    return stage
+
+
+def _remove_errors_stage(node: "RemoveErrorsNode"):
+    def stage(keys, cols, diffs):
+        mask = np.ones(len(keys), dtype=bool)
+        for col in cols.values():
+            if col.dtype == object:
+                mask &= ~error_mask(col)
+        if mask.all():
+            return keys, cols, diffs
+        if not mask.any():
+            return None
+        idx = np.nonzero(mask)[0]
+        return keys[idx], {n_: c[idx] for n_, c in cols.items()}, diffs[idx]
+
+    return stage
+
+
+def _select_columns_stage(node: "SelectColumnsNode"):
+    mapping = node.mapping
+
+    def stage(keys, cols, diffs):
+        return keys, {out: cols[src] for out, src in mapping.items()}, diffs
+
+    return stage
+
+
+def fusable_stage(node: Node):
+    """Return the fused stage closure for ``node`` if it is a stateless
+    per-row operator eligible for chain fusion, else None.
+
+    Eligibility is strict: exactly one input, the base-class ``on_time_end``
+    (members are skipped in the scheduler's end-of-epoch sweep), no flush
+    hook (run.py's flush loop only sees scheduled nodes), and no per-row
+    state — which excludes RowwiseNode with non-deterministic UDFs (replay
+    cache) or deferred two-phase applies (drainer injects under the node's
+    own id, which a fused intermediate no longer has)."""
+    if len(node.inputs) != 1:
+        return None
+    if type(node).on_time_end is not Node.on_time_end:
+        return None
+    if getattr(node, "flush", None) is not None:
+        return None
+    # exact types only: a subclass may override step() with new semantics
+    if type(node) is RowwiseNode:
+        if node._nondet or node._deferred_names:
+            return None
+        return _rowwise_stage(node)
+    if type(node) is FilterNode:
+        return _filter_stage(node)
+    if type(node) is RemoveErrorsNode:
+        return _remove_errors_stage(node)
+    if type(node) is SelectColumnsNode:
+        return _select_columns_stage(node)
+    return None
 
 
 class FusedNode(Node):

@@ -16,15 +16,16 @@ from collections import defaultdict
 from typing import Any, Callable
 
 from pathway_tpu.engine.batch import Batch, concat_batches, consolidate
-from pathway_tpu.engine.graph import EngineGraph, Node
-from pathway_tpu.engine.probes import SchedulerStats
+from pathway_tpu.engine.graph import EngineGraph, Node, fuse_chains
+from pathway_tpu.engine.probes import SchedulerStats, _current_op
 
 
 class Scheduler:
     def __init__(self, graph: EngineGraph, targets: list[Node] | None = None,
                  exchange_ctx=None, threads: int | None = None,
                  ctl_tag_alloc: "Callable[[], int] | None" = None,
-                 allow_deferred: bool = True):
+                 allow_deferred: bool = True,
+                 fuse: bool | None = None):
         self.graph = graph
         self.exchange_ctx = exchange_ctx
         # deferred (fully-async) UDF emission needs the run's OUTER pump:
@@ -44,14 +45,23 @@ class Scheduler:
                 graph, graph.topo_order(targets), exchange_ctx
             )
         self.order = graph.topo_order(targets)
+        # chain fusion: collapse linear runs of stateless per-row operators
+        # into single plan nodes (engine/graph.py:fuse_chains) — one step,
+        # one consolidate per chain per epoch instead of one per member.
+        # Plan-level only: the user graph is global and stays untouched.
+        from pathway_tpu.internals import config as config_mod
+
+        if fuse is None:
+            fuse = config_mod.pathway_config.fusion
+        self.fused_chains: list[list[Node]] = []
+        if fuse:
+            self.order, self.fused_chains = fuse_chains(self.order, targets)
         self._order_ids = {n.id for n in self.order}
         # PATHWAY_THREADS > 1: step independent operators (same topo level)
         # concurrently — the in-process analog of the reference's worker
         # threads. numpy/jax kernels release the GIL, so dense operators
         # genuinely overlap; results are deterministic because a level only
         # starts after every producer level finished.
-        from pathway_tpu.internals import config as config_mod
-
         if threads is None:
             threads = config_mod.pathway_config.threads
         self._n_threads = max(1, threads)
@@ -87,6 +97,8 @@ class Scheduler:
         self._stopped = False
         self.current_time: int = -1
         self.stats = SchedulerStats()
+        self.stats.fused_chains = len(self.fused_chains)
+        self.stats.fused_nodes = sum(len(c) for c in self.fused_chains)
 
     # ------------------------------------------------------------------ inputs
     def register_source(self, node: Node, initial_time: int = 0) -> None:
@@ -126,6 +138,20 @@ class Scheduler:
             self._lock.notify_all()
 
     # ------------------------------------------------------------------ loop
+    def _next_ready_time(self) -> "int | None":
+        """Smallest time safe to process (below every live source frontier),
+        or None. A min over pending keys, not a sort: a fast producer can
+        queue hundreds of commit times, and the pump takes them one epoch
+        at a time — sorting the whole set per epoch was O(E^2 log E) across
+        a backlog drain."""
+        if not self._pending:
+            return None
+        t = min(self._pending.keys())
+        frontier = min(self._source_frontiers.values(), default=None)
+        if frontier is not None and t >= frontier:
+            return None
+        return t
+
     def _ready_times(self) -> list[int]:
         """Times safe to process: below every live source frontier."""
         if not self._pending:
@@ -145,8 +171,8 @@ class Scheduler:
                 while True:
                     if self._stopped:
                         return
-                    ready = self._ready_times()
-                    if ready:
+                    t = self._next_ready_time()
+                    if t is not None:
                         break
                     if (
                         not self._source_frontiers
@@ -155,7 +181,6 @@ class Scheduler:
                     ):
                         return
                     self._lock.wait(timeout=0.5)
-                t = ready[0]
                 injected = self._pending.pop(t)
             self._run_epoch(t, injected)
 
@@ -192,8 +217,7 @@ class Scheduler:
             with self._lock:
                 if self._stopped:
                     return
-                ready = self._ready_times()
-                local_t = ready[0] if ready else None
+                local_t = self._next_ready_time()
                 frontier = min(self._source_frontiers.values(), default=None)
                 live = bool(self._source_frontiers)
                 inflight = self._async_inflight > 0
@@ -235,10 +259,9 @@ class Scheduler:
         ran = False
         while True:
             with self._lock:
-                ready = self._ready_times()
-                if not ready:
+                t = self._next_ready_time()
+                if t is None:
                     return ran
-                t = ready[0]
                 injected = self._pending.pop(t)
             self._run_epoch(t, injected)
             ran = True
@@ -250,14 +273,30 @@ class Scheduler:
             outputs.get(i.id) if i.id in self._order_ids else None
             for i in node.inputs
         ]
+        extra = injected.get(node.id)
+        # sparse stepping: every shipped operator no-ops when all input
+        # deltas are None and nothing was injected, so skip the dispatch
+        # entirely (the end-of-epoch on_time_end sweep still runs for all
+        # nodes). With deferred-UDF streams most epochs touch only the
+        # embed->index spine, not the whole graph.
+        if (
+            extra is None
+            and not node.always_step
+            and all(b is None for b in ins)
+        ):
+            self.stats.record_skip()
+            return
         started = time.perf_counter()
+        op_stats = self.stats.operator(node.id, node.name)
+        _current_op.stats = op_stats  # device dispatches attribute here
         try:
             out = node.step(t, ins)
         except Exception as exc:
             from pathway_tpu.internals.trace import add_error_trace
 
             raise add_error_trace(exc, node.trace)
-        extra = injected.get(node.id)
+        finally:
+            _current_op.stats = None
         if extra:
             out = concat_batches([out] + extra) if out is not None else concat_batches(extra)
         result = consolidate(out) if out is not None else None

@@ -3,7 +3,10 @@
 Env vars: PATHWAY_THREADS / PATHWAY_PROCESSES / PATHWAY_PROCESS_ID /
 PATHWAY_FIRST_PORT (worker topology), PATHWAY_IGNORE_ASSERTS,
 PATHWAY_RUNTIME_TYPECHECKING, PATHWAY_PERSISTENT_STORAGE,
-PATHWAY_LICENSE_KEY (accepted, unused — no license gating in this build).
+PATHWAY_LICENSE_KEY (accepted, unused — no license gating in this build),
+PATHWAY_FUSION (default on — stateless operator-chain fusion,
+engine/graph.py:fuse_chains), PATHWAY_TPU_COMPILE_CACHE=<dir> (persistent
+XLA compilation cache for the whole package, not just bench.py).
 """
 
 from __future__ import annotations
@@ -54,6 +57,12 @@ class PathwayConfig:
     )
 
     @property
+    def fusion(self) -> bool:
+        """Stateless operator-chain fusion (scheduler plan rewrite).
+        Read per scheduler construction so tests can flip it per-run."""
+        return _env_bool("PATHWAY_FUSION", True)
+
+    @property
     def threads(self) -> int:
         return int(os.environ.get("PATHWAY_THREADS", "1"))
 
@@ -67,6 +76,37 @@ class PathwayConfig:
 
 
 pathway_config = PathwayConfig()
+
+_compile_cache_dir: str | None = None
+
+
+def maybe_enable_compile_cache() -> str | None:
+    """Point JAX's persistent compilation cache at
+    ``$PATHWAY_TPU_COMPILE_CACHE`` (package-wide: engine runs, tests and
+    the bench all reuse cached executables across processes). No-op when
+    the env var is unset or jax is unavailable; idempotent otherwise.
+    Returns the cache dir in effect, or None."""
+    global _compile_cache_dir
+    cache_dir = os.environ.get("PATHWAY_TPU_COMPILE_CACHE")
+    if not cache_dir:
+        return None
+    if _compile_cache_dir == cache_dir:
+        return _compile_cache_dir
+    try:
+        import jax
+
+        cache_dir = os.path.abspath(cache_dir)
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even fast compiles: streaming graphs compile many small
+        # bucket-shaped kernels whose individual compile times sit under
+        # the default threshold but add up across runs
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 - optional: cache must never break runs
+        return None
+    _compile_cache_dir = cache_dir
+    return _compile_cache_dir
 
 _persistence_config: Any = None
 

@@ -78,6 +78,14 @@ class IterateNode(Node):
             {} for _ in sub_outputs
         ]
 
+    @property
+    def always_step(self) -> bool:
+        # under a peer mesh every epoch opens with a control_allgather — a
+        # collective; a process skipping the step (no local deltas) while a
+        # peer enters it would wedge the round. Single-process fixpoints
+        # no-op on all-None input, so the sparse-stepping skip stays valid.
+        return self.exchange_ctx is not None
+
     def reset(self):
         self._in_states = [TableState(i.column_names) for i in self.inputs]
         self._emitted = {}
@@ -197,6 +205,11 @@ class IterateSiblingNode(Node):
     the shared subgraph (one distributed fixpoint per epoch total). Taking
     the primary as input pins the topo order: the primary's level always
     completes before siblings step, even under PATHWAY_THREADS>1."""
+
+    # reads the primary's ``_epoch_results`` side channel, which can change
+    # even when the primary's OWN output delta (this node's input) is None —
+    # must not be skipped by the scheduler's sparse stepping
+    always_step = True
 
     def __init__(self, graph, primary: IterateNode, result_node_index: int,
                  name="IterateOut"):

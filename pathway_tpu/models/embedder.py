@@ -17,6 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pathway_tpu.engine.probes import record_device_dispatch
 from pathway_tpu.models.tokenizer import (
     HashTokenizer,
     load_tokenizer,
@@ -149,6 +150,7 @@ class SentenceEmbedderModel:
         ids, mask = self.tokenizer(texts, max_length=self.max_length)
         ids, mask = pad_to_buckets(ids, mask)
         out = embed_fn(self.params, jnp.asarray(ids), jnp.asarray(mask), self.cfg)
+        record_device_dispatch("embed_dispatch")
         return (out, len(texts))
 
     def embed_resolve(self, handles) -> list[np.ndarray]:
@@ -157,6 +159,7 @@ class SentenceEmbedderModel:
         measured equal to a device-side concat WITHOUT the risk of compiling
         a fresh concat executable mid-stream when the chunk count changes."""
         fetched = jax.device_get([h for h, _ in handles])
+        record_device_dispatch("embed_drain")
         return [
             _renorm(np.asarray(o)[:n].astype(np.float32))
             for o, (_, n) in zip(fetched, handles)

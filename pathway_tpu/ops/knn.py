@@ -20,6 +20,7 @@ from __future__ import annotations
 import functools
 import math
 
+from pathway_tpu.engine.probes import record_device_dispatch
 from pathway_tpu.ops import canonical_metric, next_pow2, prep_host_vectors
 from typing import Any
 
@@ -65,11 +66,24 @@ def topk_scores(scores, k: int):
     """top-k over (Q, N) scores; for large N a two-stage blocked reduction
     — ``lax.top_k`` cost grows superlinearly in row length (sorting
     networks), so per-block top-k followed by top-k over the block winners
-    is MUCH faster at 10^6-row corpora (measured seconds -> milliseconds)."""
+    is MUCH faster at 10^6-row corpora (measured seconds -> milliseconds).
+
+    A ragged tail (``N % _TOPK_BLOCK != 0``) pads the last block with
+    ``_NEG_INF`` instead of falling back to the superlinear full-row
+    ``lax.top_k``: shapes here are trace-time constants, so the pad is a
+    static concat compiled into the executable. Pad slots can never win a
+    top-k spot against any real score, and downstream resolvers already
+    treat ``score <= _NEG_INF / 2`` as an empty slot."""
     Q, N = scores.shape
-    if N <= 2 * _TOPK_BLOCK or N % _TOPK_BLOCK != 0:
+    if N <= 2 * _TOPK_BLOCK:
         return jax.lax.top_k(scores, k)
-    nb = N // _TOPK_BLOCK
+    pad = (-N) % _TOPK_BLOCK
+    if pad:
+        scores = jnp.concatenate(
+            [scores, jnp.full((Q, pad), _NEG_INF, dtype=scores.dtype)],
+            axis=1,
+        )
+    nb = (N + pad) // _TOPK_BLOCK
     kb = min(k, _TOPK_BLOCK)
     bs, bi = jax.lax.top_k(scores.reshape(Q, nb, _TOPK_BLOCK), kb)
     flat_s = bs.reshape(Q, nb * kb)
@@ -265,6 +279,7 @@ class BruteForceKnnIndex:
             self._corpus, self._valid, self._n_dev, v,
             _m_scalar(m), normalize=normalize,
         )
+        record_device_dispatch("knn_append")
         self._record_keys(keys, start)
 
     def add(self, keys: list, vectors: np.ndarray) -> None:
@@ -357,6 +372,7 @@ class BruteForceKnnIndex:
                 query_rows=query_rows, k=min(k, self.capacity),
                 metric=self.metric,
             )
+            record_device_dispatch("knn_embed_append_query")
             self._record_keys(keys, start)
             return emb, scores, idx
         self._corpus, self._valid, self._n_dev, emb = _embed_append_kernel(
@@ -364,6 +380,7 @@ class BruteForceKnnIndex:
             params, input_ids, attention_mask, _m_scalar(m),
             embed=embed, cfg=cfg, pad_id=pad_id,
         )
+        record_device_dispatch("knn_embed_append")
         self._record_keys(keys, start)
         return emb
 
@@ -411,6 +428,7 @@ class BruteForceKnnIndex:
         normalize = self.metric == "cos"
         scores, idx = _search_kernel(self._corpus, self._valid, q, k_eff,
                                      self.metric, normalize=normalize)
+        record_device_dispatch("knn_search")
         return scores, idx
 
     def resolve(self, scores, idx, nq: int, k: int) -> list[list[tuple[Any, float]]]:
@@ -439,6 +457,7 @@ class BruteForceKnnIndex:
             return [[] for _ in range(nq)]
         # one round trip for both result arrays
         scores, idx = jax.device_get(self.search_device(queries, k))
+        record_device_dispatch("knn_drain")
         return self.resolve(scores, idx, nq, k)
 
     def __len__(self) -> int:

@@ -45,6 +45,11 @@ def pytest_configure(config):
         "timeout(seconds): fail the test if it runs longer than `seconds` "
         "(enforced by conftest via SIGALRM when pytest-timeout is absent)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running perf guards, excluded from the tier-1 sweep "
+        "(-m 'not slow')",
+    )
 
 
 @pytest.hookimpl(wrapper=True)

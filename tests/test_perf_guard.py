@@ -1,0 +1,83 @@
+"""Perf guard: the ENGINE path over a synthetic stream must sustain at
+least 0.8x the throughput of a direct Python loop over the same kernel —
+the host-side engine tax (operator dispatch, batch plumbing, consolidate)
+may cost at most ~25% on top of the actual compute.
+
+This is the CPU analog of the bench's config4-vs-headline contract
+(``bench.py``); it runs with a numpy kernel so it guards the engine's
+overhead on any machine, independent of the accelerator. Marked slow: it
+needs multi-second measurement windows to be stable, and tier-1 excludes
+it (-m 'not slow').
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import run as run_mod
+from tests.utils import _capture_rows
+
+# a kernel heavy enough (~100 us/row) that a well-behaved engine's per-row
+# overhead (~tens of us with fusion + sparse stepping) fits in the 25%
+# budget, but light enough that the guard finishes in a few seconds
+_D_BATCH, _D_IN, _D_OUT = 24, 384, 512
+_W = np.random.default_rng(0).standard_normal((_D_IN, _D_OUT)).astype(
+    np.float32
+)
+
+
+def _kernel(seed: int) -> float:
+    x = np.full((_D_BATCH, _D_IN), (seed % 97) * 0.01, dtype=np.float32)
+    return float((x @ _W).sum())
+
+
+def _build(rows):
+    pw.clear_graph()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), rows, is_stream=True
+    )
+    s = t.select(t.v, y=pw.apply_with_type(_kernel, float, t.v))
+    f = s.filter(s.v >= 0)
+    return f.select(f.v, z=f.y + 0.0)
+
+
+def _stream_rows(n_rows, n_epochs):
+    per = n_rows // n_epochs
+    return [(i, 2 + 2 * (i // per), 1) for i in range(n_rows)]
+
+
+@pytest.mark.slow
+def test_engine_stream_vs_direct_kernel_loop():
+    n_rows, n_epochs = 4000, 20
+
+    # warm-up pass OUTSIDE both timed windows: absorbs one-per-process
+    # costs shared by neither side fairly (the native-extension build
+    # attempt on first Batch.from_rows, numpy thread-pool spin-up,
+    # expression-compile caches)
+    _capture_rows(_build(_stream_rows(200, 4)))
+    for i in range(50):
+        _kernel(i)
+
+    # direct loop: the same kernel called row-by-row, no engine around it
+    t0 = time.perf_counter()
+    direct_out = [_kernel(i) for i in range(n_rows)]
+    direct_s = time.perf_counter() - t0
+    assert len(direct_out) == n_rows
+
+    # engine: the same rows streamed over n_epochs commits through a
+    # fusable select/filter chain with the kernel as a rowwise UDF
+    out = _build(_stream_rows(n_rows, n_epochs))
+    t0 = time.perf_counter()
+    state, _ = _capture_rows(out)
+    engine_s = time.perf_counter() - t0
+    assert len(state) == n_rows
+
+    stats = run_mod.LAST_RUN_STATS
+    ratio = direct_s / engine_s
+    detail = (
+        f"direct={direct_s:.3f}s engine={engine_s:.3f}s ratio={ratio:.3f} "
+        f"stats={stats.engine_tax() if stats else None}"
+    )
+    assert ratio >= 0.8, f"engine tax exceeded 25% of kernel cost: {detail}"

@@ -1,0 +1,121 @@
+"""Roofline model + device-dispatch counters (``engine/probes.py``) and the
+ragged-tail blocked top-k (``ops/knn.py``)."""
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine import probes
+
+
+# ------------------------------------------------------------- roofline
+
+
+def test_phase_roofline_compute_bound():
+    # 1s at half of peak FLOPs, tiny byte traffic -> compute bound
+    ph = probes.PhaseRoofline(
+        name="x", seconds=1.0, flops=probes.V5E_PEAK_BF16_FLOPS * 0.5,
+        bytes_moved=1e9, dispatches=4,
+    )
+    s = ph.summary(probes.V5E_PEAK_BF16_FLOPS, probes.V5E_PEAK_HBM_BYTES)
+    assert s["mfu_pct"] == pytest.approx(50.0, abs=0.1)
+    assert s["bound"] == "compute"
+    assert s["dispatches"] == 4
+
+
+def test_phase_roofline_memory_bound():
+    # saturate HBM, negligible FLOPs -> memory bound
+    ph = probes.PhaseRoofline(
+        name="x", seconds=1.0, flops=1e12,
+        bytes_moved=probes.V5E_PEAK_HBM_BYTES * 0.8, dispatches=1,
+    )
+    s = ph.summary(probes.V5E_PEAK_BF16_FLOPS, probes.V5E_PEAK_HBM_BYTES)
+    assert s["bound"] == "memory"
+    assert s["hbm_util_pct"] == pytest.approx(80.0, abs=0.5)
+
+
+def test_phase_roofline_overhead_bound():
+    # neither resource above 5% utilisation -> dispatch/host overhead
+    ph = probes.PhaseRoofline(
+        name="x", seconds=1.0, flops=1e12, bytes_moved=1e9, dispatches=999,
+    )
+    s = ph.summary(probes.V5E_PEAK_BF16_FLOPS, probes.V5E_PEAK_HBM_BYTES)
+    assert s["bound"] == "overhead"
+
+
+def test_roofline_model_ledger():
+    m = probes.RooflineModel()
+    m.add("ingest", seconds=2.0, flops=4e12, bytes_moved=8e9, dispatches=10)
+    m.add("drain", seconds=0.5, flops=0.0, bytes_moved=1e9, dispatches=1)
+    out = m.summary()
+    assert set(out) == {"ingest", "drain"}
+    assert out["ingest"]["gflops"] == pytest.approx(4000.0, rel=1e-3)
+    assert out["ingest"]["arith_intensity"] == pytest.approx(500.0, rel=1e-3)
+    for row in out.values():
+        assert {"mfu_pct", "hbm_util_pct", "bound", "seconds"} <= set(row)
+
+
+# ----------------------------------------------------- dispatch counters
+
+
+def test_dispatch_counters_global_and_per_op():
+    probes.reset_dispatch_counts()
+    probes.record_device_dispatch("embed_dispatch")
+    probes.record_device_dispatch("embed_dispatch", 2)
+    probes.record_device_dispatch("knn_search")
+    counts = probes.dispatch_counts()
+    assert counts["embed_dispatch"] == 3
+    assert counts["knn_search"] == 1
+
+    # per-operator attribution rides a thread-local set by the scheduler
+    op = probes.OperatorStats(name="embed")
+    probes._current_op.stats = op
+    try:
+        probes.record_device_dispatch("embed_dispatch")
+    finally:
+        probes._current_op.stats = None
+    assert op.dispatches == 1
+    assert probes.dispatch_counts()["embed_dispatch"] == 4
+    probes.reset_dispatch_counts()
+    assert probes.dispatch_counts() == {}
+
+
+def test_scheduler_stats_engine_tax_keys():
+    st = probes.SchedulerStats()
+    st.record_skip()
+    st.record_skip()
+    tax = st.engine_tax()
+    assert tax["steps_skipped"] == 2
+    assert {"wall_s", "steps", "operator_dispatches", "fused_chains",
+            "fused_nodes"} <= set(tax)
+
+
+# ------------------------------------------------- blocked top-k ragged
+
+
+def test_blocked_topk_ragged_tail_matches_flat():
+    """N not a multiple of the block AND N > 2*block: the tail must be
+    padded with -inf INSIDE the blocked path (no full-row top_k fallback)
+    and stay exact vs the flat reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops import knn as knn_mod
+
+    rng = np.random.default_rng(3)
+    old = knn_mod._TOPK_BLOCK
+    knn_mod._TOPK_BLOCK = 64
+    try:
+        for n in (300, 64 * 5 + 1, 64 * 4 - 1):
+            scores = jnp.asarray(
+                rng.standard_normal((5, n)).astype(np.float32)
+            )
+            fs, fi = jax.device_get(knn_mod.topk_scores(scores, 10))
+            es, ei = jax.device_get(jax.lax.top_k(scores, 10))
+            assert np.allclose(fs, es), f"scores diverged at N={n}"
+            s_np = np.asarray(scores)
+            for q in range(5):
+                assert np.allclose(s_np[q][fi[q]], es[q]), f"idx at N={n}"
+            # no pad index may leak out: all indices inside the real corpus
+            assert int(fi.max()) < n
+    finally:
+        knn_mod._TOPK_BLOCK = old
