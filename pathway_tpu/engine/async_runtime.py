@@ -1,17 +1,25 @@
-"""Dedicated event loop for async UDF execution.
+"""Dedicated event loop for async UDF execution, plus the bounded
+stage-worker primitive behind the host/device overlap layer.
 
-The analog of the reference's current-thread tokio runtime
-(``src/async_runtime.rs``): one long-lived background loop thread serves all
-async-UDF microbatches, so blocking resolution works regardless of whether
-the calling thread has its own running loop (scripts, notebooks, connector
-threads alike).
+The event loop is the analog of the reference's current-thread tokio
+runtime (``src/async_runtime.rs``): one long-lived background loop thread
+serves all async-UDF microbatches, so blocking resolution works regardless
+of whether the calling thread has its own running loop (scripts, notebooks,
+connector threads alike).
+
+:class:`StageWorker` is the second runtime primitive: a daemon thread
+draining a BOUNDED work queue. The ingest pipeline
+(``models/embedder.py``) chains two of them (tokenize -> dispatch) so host
+stages overlap device compute while the queue bounds cap dispatch-ahead
+depth and provide backpressure.
 """
 
 from __future__ import annotations
 
 import asyncio
+import queue
 import threading
-from typing import Any, Coroutine
+from typing import Any, Callable, Coroutine
 
 _loop: asyncio.AbstractEventLoop | None = None
 _loop_lock = threading.Lock()
@@ -34,3 +42,49 @@ def run_coroutine_blocking(coro: Coroutine) -> Any:
     """Run a coroutine on the shared background loop; block until done."""
     future = asyncio.run_coroutine_threadsafe(coro, get_event_loop())
     return future.result()
+
+
+_STOP = object()
+
+
+class StageWorker:
+    """One pipeline stage: a daemon thread draining a bounded work queue.
+
+    ``submit`` blocks once ``maxsize`` items are in flight — that bound IS
+    the stage's backpressure/dispatch-ahead knob, not an error condition.
+    ``fn`` must be total (route failures into the work item, e.g. onto a
+    pending-result handle): a raising ``fn`` would silently drop the item,
+    so exceptions are swallowed here only as a last-ditch guard to keep
+    the stage alive for subsequent items.
+    """
+
+    def __init__(self, fn: Callable[[Any], None], maxsize: int, name: str):
+        self._fn = fn
+        self._queue: queue.Queue = queue.Queue(maxsize=max(1, int(maxsize)))
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def submit(self, item: Any) -> None:
+        """Enqueue ``item``; blocks while the stage queue is full."""
+        if self._closed:
+            raise RuntimeError(f"StageWorker {self._thread.name} is closed")
+        self._queue.put(item)
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is _STOP:
+                return
+            try:
+                self._fn(item)
+            except BaseException:  # noqa: BLE001 - see class docstring
+                pass
+
+    def close(self, join: bool = True) -> None:
+        """Drain queued items, stop the thread. Idempotent."""
+        if not self._closed:
+            self._closed = True
+            self._queue.put(_STOP)
+        if join and self._thread.is_alive():
+            self._thread.join(timeout=30)

@@ -7,7 +7,7 @@ the scheduler times every operator step and counts rows; snapshots feed the
 console dashboard (``internals/monitoring.py``), the Prometheus endpoint
 (``internals/http_server.py``) and ``pw.run``'s final summary.
 
-Two additions beyond the reference:
+Three additions beyond the reference:
 
 * **device-dispatch counters** — kernels (``models/embedder.py``,
   ``ops/knn.py``) call :func:`record_device_dispatch` on every accelerator
@@ -18,6 +18,11 @@ Two additions beyond the reference:
   bytes moved) per pipeline phase and reports MFU, memory-bandwidth
   utilisation and the arithmetic-intensity-implied bound, so the bench's
   "ingest MFU" line is derived from accounting, not vibes.
+* **pipeline-stage ledger** — :func:`record_stage` accumulates host busy
+  seconds per ingest stage (tokenize / h2d / dispatch / drain) and
+  :func:`bubble_attribution` splits a window's wall time across them with
+  device compute as the residual, so the non-MFU fraction is attributed
+  instead of unexplained.
 """
 
 from __future__ import annotations
@@ -59,6 +64,69 @@ def dispatch_counts() -> dict[str, int]:
 def reset_dispatch_counts() -> None:
     with _dispatch_lock:
         _dispatch_counts.clear()
+
+
+# --------------------------------------------------------------------- #
+# pipeline-stage ledger (bubble attribution)
+#
+# The roofline says HOW FAR the device is from peak; this ledger says
+# WHERE the missing time went. Host-measurable pipeline stages (tokenize,
+# h2d staging, dispatch enqueue, drain) record their busy seconds here;
+# :func:`bubble_attribution` turns a window's ledger into a percentage
+# breakdown of wall time, with device compute as the residual (under
+# JAX's async dispatch the host never observes compute directly).
+
+_stage_lock = threading.Lock()
+_stage_seconds: dict[str, float] = {}
+_stage_items: dict[str, int] = {}
+
+
+def record_stage(stage: str, seconds: float, items: int = 1) -> None:
+    """Accumulate ``seconds`` of host busy time for pipeline ``stage``
+    (e.g. ``tokenize``, ``h2d``, ``dispatch``, ``drain``). Thread-safe;
+    called by stage workers, so overlapped stages can legitimately sum to
+    more than wall time — that excess IS the overlap evidence."""
+    with _stage_lock:
+        _stage_seconds[stage] = _stage_seconds.get(stage, 0.0) + seconds
+        _stage_items[stage] = _stage_items.get(stage, 0) + items
+
+
+def stage_seconds() -> dict[str, float]:
+    with _stage_lock:
+        return dict(_stage_seconds)
+
+
+def reset_stage_seconds() -> None:
+    with _stage_lock:
+        _stage_seconds.clear()
+        _stage_items.clear()
+
+
+def bubble_attribution(wall_s: float, stages: dict[str, float] | None = None) -> dict:
+    """Split a window's wall time across pipeline stages.
+
+    ``stages`` defaults to the global ledger. Host stages are reported as
+    measured; ``compute`` is the residual ``wall - sum(host stages)``
+    clipped at zero — the time the host spent neither tokenizing, staging
+    nor draining, i.e. waiting on (or overlapped with) device compute.
+    ``pct`` values therefore sum to ~100 of wall when stages run serially
+    on one thread; ``sum_host_pct`` above 100 means background workers
+    overlapped host stages with each other or with compute."""
+    stages = dict(stages if stages is not None else stage_seconds())
+    wall = max(wall_s, 1e-12)
+    host_total = sum(stages.values())
+    compute = max(0.0, wall_s - host_total)
+    out: dict = {
+        "wall_s": round(wall_s, 6),
+        "stages_s": {k: round(v, 6) for k, v in sorted(stages.items())},
+        "compute_residual_s": round(compute, 6),
+        "pct": {
+            k: round(100.0 * v / wall, 2) for k, v in sorted(stages.items())
+        },
+        "sum_host_pct": round(100.0 * host_total / wall, 2),
+    }
+    out["pct"]["compute"] = round(100.0 * compute / wall, 2)
+    return out
 
 
 # --------------------------------------------------------------------- #

@@ -7,6 +7,28 @@ PATHWAY_LICENSE_KEY (accepted, unused — no license gating in this build),
 PATHWAY_FUSION (default on — stateless operator-chain fusion,
 engine/graph.py:fuse_chains), PATHWAY_TPU_COMPILE_CACHE=<dir> (persistent
 XLA compilation cache for the whole package, not just bench.py).
+
+Host/device overlap knobs (read per use, like PATHWAY_FUSION, so tests can
+flip them per-run):
+
+* PATHWAY_TPU_PIPELINE (default on) — pipelined ingest in
+  ``models/embedder.py`` (background tokenizer worker + staged h2d +
+  donated dispatch); ``0`` restores the serial submit path.
+* PATHWAY_TPU_PIPELINE_DEPTH (default 2) — dispatch-ahead depth: how many
+  tokenized batches may be staged/dispatched ahead of the oldest
+  unresolved one.
+* PATHWAY_TPU_PIPELINE_QUEUE (default 8) — bound of the raw-text queue
+  feeding the tokenizer worker; ``embed_submit`` blocks (backpressure)
+  once this many batches wait.
+* PATHWAY_TPU_CHUNKED_PREFILL (default on) — continuous serving admits
+  long prompts piece-wise, interleaved with decode chunks
+  (``xpacks/llm/llms.py``); ``0`` restores one-shot admission prefill.
+* PATHWAY_TPU_PREFILL_CHUNK (default 64) — prefill piece length (tokens).
+* PATHWAY_TPU_EAGER_REFILL (default on) — free a decode slot the moment
+  its dispatched steps cover the request budget instead of waiting for
+  the token drain ``pipeline_depth`` chunks later.
+* PATHWAY_TPU_KNN_F32_SCORES (default off) — score KNN with f32 operands
+  instead of the bf16 MXU fast path (``ops/knn.py``).
 """
 
 from __future__ import annotations
@@ -61,6 +83,49 @@ class PathwayConfig:
         """Stateless operator-chain fusion (scheduler plan rewrite).
         Read per scheduler construction so tests can flip it per-run."""
         return _env_bool("PATHWAY_FUSION", True)
+
+    @property
+    def tpu_pipeline(self) -> bool:
+        """Pipelined ingest in ``SentenceEmbedderModel`` (background
+        tokenizer worker, staged h2d, donated dispatch). The kill switch:
+        ``PATHWAY_TPU_PIPELINE=0`` restores the serial submit path."""
+        return _env_bool("PATHWAY_TPU_PIPELINE", True)
+
+    @property
+    def tpu_pipeline_depth(self) -> int:
+        """Dispatch-ahead depth of the ingest pipeline: batches staged or
+        dispatched ahead of the oldest unresolved one (>=2 for overlap)."""
+        return max(1, int(os.environ.get("PATHWAY_TPU_PIPELINE_DEPTH", "2")))
+
+    @property
+    def tpu_pipeline_queue(self) -> int:
+        """Bound of the raw-text queue feeding the tokenizer worker;
+        ``embed_submit`` blocks (backpressure) once this many wait."""
+        return max(1, int(os.environ.get("PATHWAY_TPU_PIPELINE_QUEUE", "8")))
+
+    @property
+    def chunked_prefill(self) -> bool:
+        """Continuous serving admits long prompts piece-wise, interleaved
+        with decode chunks, instead of one full-prompt prefill."""
+        return _env_bool("PATHWAY_TPU_CHUNKED_PREFILL", True)
+
+    @property
+    def prefill_chunk(self) -> int:
+        """Prefill piece length (tokens) for chunked admission."""
+        return max(8, int(os.environ.get("PATHWAY_TPU_PREFILL_CHUNK", "64")))
+
+    @property
+    def eager_refill(self) -> bool:
+        """Free a decode slot at DISPATCH time once its dispatched steps
+        cover the request budget, instead of at token-drain time
+        ``pipeline_depth`` chunks later."""
+        return _env_bool("PATHWAY_TPU_EAGER_REFILL", True)
+
+    @property
+    def knn_f32_scores(self) -> bool:
+        """Score KNN with f32 operands (recall-first) instead of the bf16
+        MXU fast path (throughput-first, default)."""
+        return _env_bool("PATHWAY_TPU_KNN_F32_SCORES", False)
 
     @property
     def threads(self) -> int:

@@ -464,6 +464,84 @@ def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,
             "pos": pos, "write": write}
 
 
+def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
+                       pos: jax.Array, pool: dict, slot: jax.Array,
+                       start: jax.Array, n_prompt: jax.Array,
+                       cfg: DecoderConfig, *, first: bool,
+                       last: bool) -> dict:
+    """CHUNKED prefill: write ONE piece of a left-padded prompt
+    (``ids``/``mask``/``pos`` shaped (1, T)) into ``slot``'s cache at
+    offsets ``[start, start + T)``, sharing ``_block`` with decode and
+    full prefill so the chunked path cannot diverge numerically.
+
+    The host splits a bucket-padded prompt into fixed-size pieces and
+    dispatches one per server-loop tick, interleaved with decode chunks
+    (``_ContinuousServer``) — a long prompt no longer stalls every active
+    lane for a whole-prompt prefill. ``pos`` carries the host-computed
+    position ids (``cumsum(mask) - 1`` clipped, the same convention as
+    :func:`prefill`); ``first`` clears the slot's stale mask row (a
+    re-admitted slot would otherwise attend the PREVIOUS occupant's cache
+    tail beyond this prompt); ``last`` installs the next-token logits and
+    the pos/write cursors (``n_prompt`` (1,) is the real token count).
+    Because attention is causal, piece i's queries only see cache entries
+    written by pieces <= i, so the union of pieces is elementwise
+    identical to :func:`pool_admit`'s one-shot prefill. jit per (piece
+    length, first, last); ``slot``/``start``/``n_prompt`` are traced."""
+    C = pool["k"].shape[3]
+    T = ids.shape[1]
+    nh, hd = cfg.heads, cfg.head_dim
+    p = jnp.clip(pos, 0, cfg.max_position - 1)
+    x = (params["wte"][ids] + params["wpe"][p]).astype(cfg.dtype)
+    if first:
+        row_mask = jnp.zeros((1, C), jnp.int32)
+    else:
+        row_mask = jax.lax.dynamic_slice(pool["slot_mask"], (slot, 0), (1, C))
+    row_mask = jax.lax.dynamic_update_slice(
+        row_mask, mask.astype(jnp.int32), (0, start)
+    )
+    slot_mask = jax.lax.dynamic_update_slice(
+        pool["slot_mask"], row_mask, (slot, 0)
+    )
+    # a piece query at cache index start+j attends every LIVE index of
+    # this row <= start+j (earlier pieces + its own causal prefix) —
+    # elementwise the same predicate as prefill()'s causal & pad mask
+    idxs = jnp.arange(C)[None, None, None, :]
+    qpos = (start + jnp.arange(T))[None, None, :, None]
+    allowed = (row_mask[:, None, None, :] > 0) & (idxs <= qpos)
+    mask_bias = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
+
+    def layer(x, inp):
+        lp, kl, vl = inp
+        k_new, v_new = _prefill_kv(x, lp, cfg)  # (1, nh, T, hd)
+        kl = jax.lax.dynamic_update_slice(
+            kl, k_new.astype(kl.dtype), (slot, 0, start, 0)
+        )
+        vl = jax.lax.dynamic_update_slice(
+            vl, v_new.astype(vl.dtype), (slot, 0, start, 0)
+        )
+        k_row = jax.lax.dynamic_slice(kl, (slot, 0, 0, 0), (1, nh, C, hd))
+        v_row = jax.lax.dynamic_slice(vl, (slot, 0, 0, 0), (1, nh, C, hd))
+        x, _, _ = _block(x, lp, k_row, v_row, mask_bias, cfg)
+        return x, (kl, vl)
+
+    x, (k, v) = jax.lax.scan(layer, x, (params["layers"], pool["k"], pool["v"]))
+    out = {"k": k, "v": v, "logits": pool["logits"], "slot_mask": slot_mask,
+           "pos": pool["pos"], "write": pool["write"]}
+    if last:
+        last_logits = _logits(params, x[:, -1:, :], cfg)[:, 0, :]
+        out["logits"] = jax.lax.dynamic_update_slice(
+            pool["logits"], last_logits, (slot, 0)
+        )
+        out["pos"] = jax.lax.dynamic_update_slice(
+            pool["pos"], n_prompt.astype(jnp.int32), (slot,)
+        )
+        write_end = start + jnp.full((1,), T, jnp.int32)
+        out["write"] = jax.lax.dynamic_update_slice(
+            pool["write"], write_end, (slot,)
+        )
+    return out
+
+
 def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
                       key: jax.Array, cfg: DecoderConfig, n_steps: int,
                       temperature: float = 0.0,

@@ -6,18 +6,30 @@ This is the TPU-native stand-in for sentence-transformers' MiniLM pipeline
 ``model.encode`` on CPU/GPU). Here the whole embed step — encode, pool,
 normalise — is one jitted function; batches arrive padded to pow2 buckets so
 each (batch, seq) bucket compiles once and is reused for the stream's life.
+
+``embed_submit`` is PIPELINED by default (PATHWAY_TPU_PIPELINE=0 restores
+the serial path): a background tokenizer worker feeds a bounded queue, a
+dispatch worker stages the next batch onto the device (``jax.device_put``)
+while the current one computes and launches a donated executable, so input
+buffers ping-pong instead of accumulating one per batch in flight. Stage
+busy-seconds land in the probes stage ledger (tokenize / h2d / dispatch /
+drain) for bubble attribution.
 """
 
 from __future__ import annotations
 
 import functools
+import threading
+import time
+import warnings
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from pathway_tpu.engine.probes import record_device_dispatch
+from pathway_tpu.engine.async_runtime import StageWorker
+from pathway_tpu.engine.probes import record_device_dispatch, record_stage
 from pathway_tpu.models.tokenizer import (
     HashTokenizer,
     load_tokenizer,
@@ -63,6 +75,114 @@ def embed_fn(params, input_ids, attention_mask, cfg: TransformerConfig):
     )
 
 
+# backends without input aliasing (CPU tests) ignore the donation and warn
+# per bucket shape; the pipeline is still correct, just without the
+# ping-pong buffer reuse, so the warning is pure noise there
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1, 2))
+def _embed_fn_donated(params, input_ids, attention_mask, cfg: TransformerConfig):
+    """``embed_fn`` with the token buffers donated back to XLA. The
+    pipeline's staged inputs alternate between "being written by the h2d
+    stage" and "owned by the in-flight dispatch", so donation caps live
+    input buffers at the dispatch-ahead depth (ping-pong) instead of one
+    pair per batch in flight."""
+    return embed_fn(params, input_ids, attention_mask, cfg)
+
+
+class _PendingEmbed:
+    """Handle returned by the pipelined ``embed_submit``: tokenize and
+    dispatch run on background stage workers; :meth:`wait` blocks until
+    the batch is dispatched and yields the serial-path handle (f16 device
+    array, row count). Stage failures surface here, at resolve time."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._value = None
+        self._error: BaseException | None = None
+
+    def wait(self):
+        self._event.wait()
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _IngestPipeline:
+    """tokenize -> h2d -> dispatch behind ``embed_submit``.
+
+    Two chained :class:`StageWorker` threads: the TOKENIZER worker turns
+    raw-text batches (queue bound: PATHWAY_TPU_PIPELINE_QUEUE) into
+    bucket-padded id/mask arrays; the DISPATCH worker stages them onto the
+    device and launches the donated embed executable. Because dispatch
+    only ENQUEUES device work, batch b+1's h2d copy and tokenization
+    overlap batch b's compute; the dispatch queue bound
+    (PATHWAY_TPU_PIPELINE_DEPTH) caps how far the host runs ahead.
+    Single-threaded stages keep dispatch in submit order, so bucket
+    executables are reused exactly as on the serial path."""
+
+    def __init__(self, model: "SentenceEmbedderModel", depth: int, queue_bound: int):
+        self._model = model
+        self._dispatch = StageWorker(
+            self._dispatch_one, maxsize=depth, name="pathway-tpu:embed-dispatch"
+        )
+        self._tokenize = StageWorker(
+            self._tokenize_one, maxsize=queue_bound, name="pathway-tpu:embed-tokenize"
+        )
+
+    def submit(self, texts: list[str]) -> _PendingEmbed:
+        handle = _PendingEmbed()
+        self._tokenize.submit((texts, handle))
+        return handle
+
+    def _tokenize_one(self, item) -> None:
+        texts, handle = item
+        try:
+            model = self._model
+            t0 = time.perf_counter()
+            ids, mask = model.tokenizer(texts, max_length=model.max_length)
+            ids, mask = pad_to_buckets(ids, mask)
+            record_stage("tokenize", time.perf_counter() - t0)
+        except BaseException as exc:  # noqa: BLE001 - surfaces at resolve
+            handle._error = exc
+            handle._event.set()
+            return
+        # blocks while `depth` batches are staged/dispatched ahead — the
+        # backpressure that keeps input buffers ping-ponging
+        self._dispatch.submit((ids, mask, len(texts), handle))
+
+    def _dispatch_one(self, item) -> None:
+        ids, mask, n, handle = item
+        try:
+            model = self._model
+            t0 = time.perf_counter()
+            dev_ids = jax.device_put(ids)
+            dev_mask = jax.device_put(mask)
+            t1 = time.perf_counter()
+            record_stage("h2d", t1 - t0)
+            out = _embed_fn_donated(model.params, dev_ids, dev_mask, model.cfg)
+            record_device_dispatch("embed_dispatch")
+            out = out.astype(jnp.float16)
+            try:
+                out.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - platform-optional fast path
+                pass
+            record_stage("dispatch", time.perf_counter() - t1)
+            handle._value = (out, n)
+        except BaseException as exc:  # noqa: BLE001 - surfaces at resolve
+            handle._error = exc
+        handle._event.set()
+
+    def close(self) -> None:
+        self._tokenize.close()
+        self._dispatch.close()
+
+
 def _renorm(v: np.ndarray) -> np.ndarray:
     """Restore exact unit norm after the float16 transport quantization
     (~5e-4 relative per component; the norm drifts by up to ~1e-4)."""
@@ -88,6 +208,36 @@ class SentenceEmbedderModel:
         if params is None:
             params = init_params(jax.random.PRNGKey(seed), cfg)
         self.params = cast_params_for_inference(params, cfg)
+        self._pipeline: _IngestPipeline | None = None
+        self._pipeline_lock = threading.Lock()
+
+    def _maybe_pipeline(self) -> _IngestPipeline | None:
+        """The shared ingest pipeline, lazily built — or None when
+        PATHWAY_TPU_PIPELINE=0 (the serial-path kill switch). The flag is
+        read per call, so flipping the env var mid-process routes new
+        submits immediately (an existing pipeline keeps draining)."""
+        from pathway_tpu.internals.config import pathway_config
+
+        if not pathway_config.tpu_pipeline:
+            return None
+        pipe = self._pipeline
+        if pipe is None:
+            with self._pipeline_lock:
+                pipe = self._pipeline
+                if pipe is None:
+                    pipe = self._pipeline = _IngestPipeline(
+                        self,
+                        depth=pathway_config.tpu_pipeline_depth,
+                        queue_bound=pathway_config.tpu_pipeline_queue,
+                    )
+        return pipe
+
+    def close(self) -> None:
+        """Stop the pipeline workers (drains queued batches first)."""
+        with self._pipeline_lock:
+            pipe, self._pipeline = self._pipeline, None
+        if pipe is not None:
+            pipe.close()
 
     @classmethod
     def from_local(cls, path: str, cfg: TransformerConfig = MINILM_L6, **kw):
@@ -117,8 +267,7 @@ class SentenceEmbedderModel:
     def embed_batch(self, texts: list[str]) -> np.ndarray:
         if not texts:
             return np.zeros((0, self.cfg.hidden), dtype=np.float32)
-        (out, n) = self.embed_submit(texts)
-        return _renorm(np.asarray(out)[:n].astype(np.float32))
+        return self.embed_resolve([self.embed_submit(texts)])[0]
 
     # -- two-phase path: dispatch many batches, drain with ONE round trip --
     def embed_submit(self, texts: list[str]):
@@ -128,7 +277,16 @@ class SentenceEmbedderModel:
         dispatch back-to-back and drain once. The handle is cast to float16
         on device: embeddings are unit vectors, so the ~5e-4 relative error
         is far inside the pipeline's parity gate while the device->host
-        transfer (often the slowest hop on a relayed chip) halves."""
+        transfer (often the slowest hop on a relayed chip) halves.
+
+        Pipelined by default: tokenization and h2d staging happen on
+        background stage workers, so this returns as soon as the batch is
+        queued (backpressure: blocks once PATHWAY_TPU_PIPELINE_QUEUE
+        batches wait). With PATHWAY_TPU_PIPELINE=0 the whole stage chain
+        runs inline here, exactly as before."""
+        pipe = self._maybe_pipeline()
+        if pipe is not None:
+            return pipe.submit(texts)
         (out, n) = self.embed_device(texts)
         out = out.astype(jnp.float16)
         # start the device->host copy NOW: by the time the epoch's last
@@ -157,12 +315,19 @@ class SentenceEmbedderModel:
         """One device drain for every submitted handle -> [(n_i, dim) array].
         ``device_get`` on the whole list drains every transfer together —
         measured equal to a device-side concat WITHOUT the risk of compiling
-        a fresh concat executable mid-stream when the chunk count changes."""
-        fetched = jax.device_get([h for h, _ in handles])
+        a fresh concat executable mid-stream when the chunk count changes.
+        Accepts pipelined (:class:`_PendingEmbed`) and serial ``(out, n)``
+        handles interchangeably, in any order relative to submission."""
+        resolved = [
+            h.wait() if isinstance(h, _PendingEmbed) else h for h in handles
+        ]
+        t0 = time.perf_counter()
+        fetched = jax.device_get([out for out, _ in resolved])
         record_device_dispatch("embed_drain")
+        record_stage("drain", time.perf_counter() - t0)
         return [
             _renorm(np.asarray(o)[:n].astype(np.float32))
-            for o, (_, n) in zip(fetched, handles)
+            for o, (_, n) in zip(fetched, resolved)
         ]
 
     def __call__(self, texts: list[str]) -> np.ndarray:

@@ -33,12 +33,24 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-def knn_scores(corpus, valid_mask, queries, metric: str):
+def knn_scores(corpus, valid_mask, queries, metric: str,
+               f32_scores: bool = False):
     """Masked similarity scores, higher is better; one MXU gemm.
     corpus (N,d) bf16, queries (Q,d) f32 -> (Q,N) f32. Shared by the
-    single-chip kernel below and parallel/sharded_knn's per-shard kernel."""
-    q = queries.astype(jnp.bfloat16)
-    c = corpus
+    single-chip kernel below and parallel/sharded_knn's per-shard kernel.
+
+    Accumulation is f32 either way (``preferred_element_type``); the
+    default casts OPERANDS to bf16 for the MXU fast path, which is where
+    the ~4% recall@10 vs f32 host truth actually goes. ``f32_scores=True``
+    (PATHWAY_TPU_KNN_F32_SCORES, or ``BruteForceKnnIndex(f32_scores=...)``)
+    keeps queries f32 and upcasts the corpus for the dot — recall-first at
+    roughly half the gemm throughput."""
+    if f32_scores:
+        q = queries.astype(jnp.float32)
+        c = corpus.astype(jnp.float32)
+    else:
+        q = queries.astype(jnp.bfloat16)
+        c = corpus
     dots = jax.lax.dot_general(
         q,
         c,
@@ -94,10 +106,10 @@ def topk_scores(scores, k: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "metric", "normalize")
+    jax.jit, static_argnames=("k", "metric", "normalize", "f32_scores")
 )
 def _search_kernel(corpus, valid_mask, queries, k: int, metric: str,
-                   normalize: bool = False):
+                   normalize: bool = False, f32_scores: bool = False):
     """One fused dispatch for the whole search: cast, normalise (optional),
     gemm + top_k. Queries arrive ALREADY padded to their pow2 bucket —
     padding outside the jit makes the executable cache key on the BUCKET,
@@ -105,7 +117,9 @@ def _search_kernel(corpus, valid_mask, queries, k: int, metric: str,
     q = queries.astype(jnp.float32)
     if normalize:
         q = _normalize(q)
-    return topk_scores(knn_scores(corpus, valid_mask, q, metric), k)
+    return topk_scores(
+        knn_scores(corpus, valid_mask, q, metric, f32_scores=f32_scores), k
+    )
 
 
 def _write_rows(corpus, valid, n_dev, v, m):
@@ -171,10 +185,13 @@ def _embed_append_kernel(corpus, valid, n_dev, params, ids, mask, m, *,
 @functools.partial(
     jax.jit,
     donate_argnums=(0, 1, 2),
-    static_argnames=("embed", "cfg", "pad_id", "query_rows", "k", "metric"),
+    static_argnames=(
+        "embed", "cfg", "pad_id", "query_rows", "k", "metric", "f32_scores"
+    ),
 )
 def _embed_append_query_kernel(corpus, valid, n_dev, params, ids, mask, m, *,
-                               embed, cfg, pad_id, query_rows, k, metric):
+                               embed, cfg, pad_id, query_rows, k, metric,
+                               f32_scores=False):
     """Ingest AND ride-along query in one dispatch: embed the batch, append
     it, then search the first ``query_rows`` fresh embeddings against the
     corpus *as updated by this very append* (self-inclusive as-of-now
@@ -191,7 +208,10 @@ def _embed_append_query_kernel(corpus, valid, n_dev, params, ids, mask, m, *,
     # emb is already unit-normalized (embed contract), so cos needs no
     # renormalise here
     scores, idx = topk_scores(
-        knn_scores(corpus, valid, emb[:query_rows], metric), k
+        knn_scores(
+            corpus, valid, emb[:query_rows], metric, f32_scores=f32_scores
+        ),
+        k,
     )
     return corpus, valid, n_dev, emb, scores, idx
 
@@ -224,9 +244,18 @@ class BruteForceKnnIndex:
         metric: str = "cos",
         auxiliary_space: int = 0,
         dtype=jnp.bfloat16,
+        f32_scores: bool | None = None,
     ):
+        from pathway_tpu.internals.config import pathway_config
+
         self.dim = dimensions
         self.metric = canonical_metric(metric)
+        # None defers to PATHWAY_TPU_KNN_F32_SCORES (recall-first scoring
+        # with f32 operands vs the default bf16 MXU fast path)
+        self.f32_scores = (
+            pathway_config.knn_f32_scores
+            if f32_scores is None else bool(f32_scores)
+        )
         self.capacity = next_pow2(reserved_space, 16)
         self.dtype = dtype
         self._corpus = jnp.zeros((self.capacity, self.dim), dtype=dtype)
@@ -370,7 +399,7 @@ class BruteForceKnnIndex:
                 params, input_ids, attention_mask, _m_scalar(m),
                 embed=embed, cfg=cfg, pad_id=pad_id,
                 query_rows=query_rows, k=min(k, self.capacity),
-                metric=self.metric,
+                metric=self.metric, f32_scores=self.f32_scores,
             )
             record_device_dispatch("knn_embed_append_query")
             self._record_keys(keys, start)
@@ -427,7 +456,8 @@ class BruteForceKnnIndex:
         k_eff = min(k, self.capacity)
         normalize = self.metric == "cos"
         scores, idx = _search_kernel(self._corpus, self._valid, q, k_eff,
-                                     self.metric, normalize=normalize)
+                                     self.metric, normalize=normalize,
+                                     f32_scores=self.f32_scores)
         record_device_dispatch("knn_search")
         return scores, idx
 

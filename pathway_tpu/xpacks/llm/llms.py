@@ -239,6 +239,9 @@ class TPUDecoderChat(BaseChat):
         chunk_steps: int = 16,
         pipeline_depth: int = 4,
         deferred: bool = False,
+        chunked_prefill: bool | None = None,
+        prefill_chunk: int | None = None,
+        eager_refill: bool | None = None,
     ):
         # continuous=True: requests are served by a persistent slot-pool
         # loop (_ContinuousServer) — new rows admit into the IN-FLIGHT
@@ -304,6 +307,9 @@ class TPUDecoderChat(BaseChat):
                 temperature=self.temperature, top_k=self.top_k,
                 top_p=self.top_p, seed=seed,
                 pipeline_depth=pipeline_depth,
+                chunked_prefill=chunked_prefill,
+                prefill_chunk=prefill_chunk,
+                eager_refill=eager_refill,
             )
             # the two-phase engine protocol only exists in continuous
             # mode — exposing these as CLASS methods would activate the
@@ -499,17 +505,37 @@ class _ContinuousServer:
     stream hit EOS or the request's own ``max_new`` budget. A new
     request therefore waits at most one chunk — not a whole batch
     generation (reference ``HFPipelineChat`` is batch-static,
-    llms.py:441)."""
+    llms.py:441).
+
+    Occupancy (``stats["steps"] / stats["slot_steps_total"]``, exported
+    via :meth:`occupancy`) is kept high two ways, both default-on via
+    ``internals/config.py`` env flags:
+
+    * **chunked prefill** (PATHWAY_TPU_CHUNKED_PREFILL) — prompts longer
+      than ``prefill_chunk`` admit piece-wise via
+      ``pool_prefill_chunk``, one piece per loop tick interleaved with
+      decode chunks, so a long prompt never stalls every active lane
+      for a whole-prompt prefill dispatch.
+    * **eager refill** (PATHWAY_TPU_EAGER_REFILL) — a lane whose
+      DISPATCHED steps already cover its budget frees its slot
+      immediately (its remaining tokens drain from the in-flight
+      snapshots) instead of ``pipeline_depth`` chunks later at
+      drain time — the occupancy gap that kept slots idle a whole
+      pipeline's depth per request."""
 
     def __init__(self, params, cfg, tokenizer, *, n_slots: int,
                  chunk_steps: int, max_prompt_tokens: int,
                  default_max_new: int, temperature: float, top_k, top_p,
-                 seed: int, pipeline_depth: int = 4):
+                 seed: int, pipeline_depth: int = 4,
+                 chunked_prefill: bool | None = None,
+                 prefill_chunk: int | None = None,
+                 eager_refill: bool | None = None):
         import threading
         from collections import deque
 
         import jax
 
+        from pathway_tpu.internals.config import pathway_config
         from pathway_tpu.models import decoder as decoder_mod
         from pathway_tpu.ops import next_pow2
 
@@ -531,11 +557,29 @@ class _ContinuousServer:
             + (self.pipeline_depth + 1) * chunk_steps
         )
         self.eos_id = getattr(tokenizer, "eos_id", None)
+        self.chunked_prefill = (
+            pathway_config.chunked_prefill
+            if chunked_prefill is None else bool(chunked_prefill)
+        )
+        self.prefill_chunk = max(8, next_pow2(
+            pathway_config.prefill_chunk
+            if prefill_chunk is None else int(prefill_chunk), 8,
+        ))
+        self.eager_refill = (
+            pathway_config.eager_refill
+            if eager_refill is None else bool(eager_refill)
+        )
         self._D = decoder_mod
         self.pool = decoder_mod.pool_init(
             params, cfg, n_slots, self.cache_len
         )
         self._admit_fns: dict = {}
+        self._prefill_fns: dict = {}
+        # slot -> (remaining prefill pieces, n_prompt); drained one piece
+        # per loop tick so prefill interleaves with decode chunks
+        self._pending_prefill: dict[int, tuple] = {}
+        # per-slot DISPATCHED decode steps since admission (eager refill)
+        self._sent = [0] * n_slots
         cfgc, steps = cfg, chunk_steps
 
         def chunk(params_, pool, active, key):
@@ -557,7 +601,14 @@ class _ContinuousServer:
         self.wake = threading.Event()
         self._stop = False
         self.failed: BaseException | None = None
-        self.stats = {"chunks": 0, "admitted": 0, "steps": 0}
+        self.stats = {
+            "chunks": 0, "admitted": 0, "steps": 0,
+            "slot_steps_total": 0, "prefill_chunks": 0,
+        }
+        # in-flight chunk records, oldest first; an attribute (not a loop
+        # local) so the failure sweep can fail eagerly-freed requests
+        # whose tokens never drained
+        self._inflight: deque = deque()
         self.thread = threading.Thread(
             target=self._run_safe, daemon=True, name="pathway:decoder-serve"
         )
@@ -581,6 +632,10 @@ class _ContinuousServer:
                 pending = [r for r in self.slots if r is not None]
                 pending.extend(self.queue)
                 self.queue.clear()
+            # eagerly-freed requests live only in the in-flight snapshots
+            # until their tokens drain — sweep those too
+            for rec in list(self._inflight):
+                pending.extend(r for r in rec[2] if r is not None)
             for req in pending:
                 if not req.done.is_set():
                     req.text = None  # error sentinel (UDF rows -> ERROR)
@@ -601,6 +656,12 @@ class _ContinuousServer:
         self.wake.set()
         return req
 
+    def occupancy(self) -> float:
+        """Active-slot-steps / total-slot-steps across every decode chunk
+        dispatched so far: the fraction of the pool's decode compute that
+        served live lanes (1.0 = every lane of every chunk was busy)."""
+        return self.stats["steps"] / max(self.stats["slot_steps_total"], 1)
+
     def _admit_fn(self, s: int):
         fn = self._admit_fns.get(s)
         if fn is None:
@@ -615,6 +676,24 @@ class _ContinuousServer:
             self._admit_fns[s] = fn
         return fn
 
+    def _prefill_fn(self, t: int, first: bool, last: bool):
+        key = (t, first, last)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            import jax
+
+            D, cfgc = self._D, self.cfg
+
+            def piece(params_, ids, mask, pos, pool, slot, start, n_prompt):
+                return D.pool_prefill_chunk(
+                    params_, ids, mask, pos, pool, slot, start, n_prompt,
+                    cfgc, first=first, last=last,
+                )
+
+            fn = jax.jit(piece, donate_argnums=(4,))
+            self._prefill_fns[key] = fn
+        return fn
+
     def _loop(self):
         import jax
         import numpy as np
@@ -624,9 +703,7 @@ class _ContinuousServer:
         from collections import deque
 
         active = np.zeros(self.n_slots, dtype=bool)
-        # in-flight chunk records, oldest first; drained once the ring is
-        # deeper than pipeline_depth (or on idle)
-        inflight: deque = deque()
+        inflight = self._inflight
         while not self._stop:
             admissions = []
             with self.lock:
@@ -637,6 +714,7 @@ class _ContinuousServer:
                 # raises, the failure sweep still finds (and fails) this
                 # request instead of stranding its waiter
                 self.slots[slot] = req
+                self._sent[slot] = 0
                 e = req.ids[-self.max_prompt_bucket:]
                 s = max(8, next_pow2(max(len(e), 1), 8))
                 ids = np.zeros((1, s), np.int32)
@@ -646,11 +724,39 @@ class _ContinuousServer:
                     mask[0, s - len(e):] = 1
                 else:
                     mask[0, -1] = 1
-                self.pool = self._admit_fn(s)(
-                    self.params, ids, mask, self.pool, np.int32(slot)
-                )
-                active[slot] = True
+                if self.chunked_prefill and s > self.prefill_chunk:
+                    # split into fixed-size pieces, dispatched ONE per
+                    # loop tick below — the active lanes keep decoding
+                    # between pieces instead of stalling for the whole
+                    # prompt's prefill
+                    pos = np.clip(
+                        np.cumsum(mask[0]) - 1, 0, None
+                    )[None, :].astype(np.int32)
+                    n_prompt = np.asarray([int(mask.sum())], np.int32)
+                    P = self.prefill_chunk
+                    pieces = [
+                        (ids[:, o:o + P], mask[:, o:o + P], pos[:, o:o + P], o)
+                        for o in range(0, s, P)
+                    ]
+                    self._pending_prefill[slot] = (pieces, n_prompt)
+                else:
+                    self.pool = self._admit_fn(s)(
+                        self.params, ids, mask, self.pool, np.int32(slot)
+                    )
+                    active[slot] = True
                 self.stats["admitted"] += 1
+            for slot in list(self._pending_prefill):
+                pieces, n_prompt = self._pending_prefill[slot]
+                p_ids, p_mask, p_pos, off = pieces.pop(0)
+                first, last = off == 0, not pieces
+                self.pool = self._prefill_fn(p_ids.shape[1], first, last)(
+                    self.params, p_ids, p_mask, p_pos, self.pool,
+                    np.int32(slot), np.int32(off), n_prompt,
+                )
+                self.stats["prefill_chunks"] += 1
+                if last:
+                    del self._pending_prefill[slot]
+                    active[slot] = True
             if active.any():
                 self._ticks += 1
                 key = jax.random.fold_in(self._key, self._ticks)
@@ -666,14 +772,43 @@ class _ContinuousServer:
                 except Exception:  # noqa: BLE001 - platform-optional
                     pass
                 self.stats["chunks"] += 1
-                self.stats["steps"] += int(active.sum()) * self.chunk_steps
+                self.stats["slot_steps_total"] += (
+                    self.n_slots * self.chunk_steps
+                )
                 # snapshot WHICH request each lane served: by the time
                 # these tokens drain the slot may have been freed and
                 # re-admitted to a different request
                 inflight.append((toks_dev, active.copy(), list(self.slots)))
+                for slot in np.nonzero(active)[0]:
+                    req = self.slots[slot]
+                    if req is None:
+                        continue
+                    # occupancy numerator counts USEFUL slot-steps only:
+                    # a lane decoding past its budget while its tokens
+                    # drain is busy but wasted, exactly the idle-by-
+                    # another-name this metric exists to expose
+                    self.stats["steps"] += min(
+                        self.chunk_steps,
+                        max(0, req.max_new - self._sent[slot]),
+                    )
+                    self._sent[slot] += self.chunk_steps
+                    if self.eager_refill and self._sent[slot] >= req.max_new:
+                        # budget exhaustion is host-knowable at DISPATCH
+                        # time: no further chunk can add to this lane's
+                        # answer, so free the slot NOW — its tokens drain
+                        # from the snapshots — instead of pipeline_depth
+                        # chunks later. Device stream ordering makes the
+                        # next occupant's prefill overwrite safe: it is
+                        # enqueued after this chunk.
+                        self.slots[slot] = None
+                        active[slot] = False
+                        with self.lock:
+                            self.free.append(int(slot))
                 if len(inflight) <= self.pipeline_depth:
                     continue
             elif not inflight:
+                if self._pending_prefill:
+                    continue
                 self.wake.clear()
                 self.wake.wait(timeout=0.05)
                 continue
@@ -697,10 +832,14 @@ class _ContinuousServer:
 
                     req.text = self.tokenizer.decode(req.tokens)
                     req.finished_at = time_mod.perf_counter()
-                    self.slots[slot] = None
-                    active[slot] = False
-                    with self.lock:
-                        self.free.append(int(slot))
+                    # eager refill may have freed (and even re-admitted)
+                    # this slot chunks ago — only release it if it still
+                    # belongs to the request we just completed
+                    if self.slots[slot] is req:
+                        self.slots[slot] = None
+                        active[slot] = False
+                        with self.lock:
+                            self.free.append(int(slot))
                     req.done.set()
 
     def shutdown(self):

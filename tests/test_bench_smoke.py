@@ -1,0 +1,56 @@
+"""``python bench.py --smoke``: the seconds-scale schema run must exit 0
+and emit a summary whose every key is populated, so bench regressions
+(schema drift, broken phases) surface in tier-1 instead of wasting a
+full driver run. No throughput bar is asserted here."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_schema():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # bench measures on ONE device, not the
+    # conftest's virtual 8-CPU mesh
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=420, cwd=REPO, env=env,
+    )
+    assert p.returncode == 0, p.stderr[-4000:]
+    last = None
+    for line in p.stdout.strip().splitlines():
+        try:
+            last = json.loads(line)
+        except ValueError:
+            continue
+    assert last is not None, p.stdout[-2000:]
+    assert last["metric"] == "rag_ingest_embed_index_docs_per_sec"
+    s = last["summary"]
+    # bench.py's own smoke gate already rejects empty keys; re-assert the
+    # load-bearing ones here so the contract lives in the test suite too
+    for key in (
+        "ingest_mfu_pct", "ingest_roofline", "config4_engine_docs_per_sec",
+        "engine_tax_ratio", "engine_stats", "join_e2e_rows_per_sec",
+        "wordcount_rows_per_sec", "decoder_tokens_per_sec",
+        "knn_recall_at_10", "rerank_p50_ms", "ivf_recall_at_10",
+        "ingest_bubbles", "serving",
+    ):
+        assert s.get(key) is not None, key
+    bub = s["ingest_bubbles"]
+    assert set(bub["pct"]) >= {"tokenize", "h2d", "dispatch", "compute"}
+    # stage percentages + device-compute residual account for the wall
+    # (> 100 is legal — it means host stages overlapped device compute)
+    assert sum(bub["pct"].values()) == pytest.approx(100.0, abs=2.0) or \
+        bub["sum_host_pct"] > 100.0
+    srv = s["serving"]
+    for key in (
+        "throughput_x", "p50_x", "occupancy", "static_tok_s",
+        "continuous_tok_s",
+    ):
+        assert srv.get(key) is not None, key
+    assert 0.0 < srv["occupancy"] <= 1.0

@@ -1,0 +1,148 @@
+"""Pipelined ingest path (PR: overlapped tokenize -> h2d -> embed).
+
+The pipeline must be an invisible optimisation: identical bytes out,
+any submit/resolve interleaving, bounded queues that backpressure
+instead of deadlocking, and a PATHWAY_TPU_PIPELINE=0 kill switch that
+restores the serial path."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from pathway_tpu.models import MINILM_L6, SentenceEmbedderModel
+from pathway_tpu.models.embedder import _PendingEmbed
+from pathway_tpu.models.tokenizer import HashTokenizer
+
+# pytest re-arms default filters, so the module-level filter in
+# embedder.py doesn't stick here; CPU ignores donation by design
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:Some donated buffers were not usable"
+)
+
+TINY = dataclasses.replace(
+    MINILM_L6, layers=2, hidden=32, heads=4, intermediate=64,
+    vocab_size=500, max_position=64,
+)
+
+TEXTS = [
+    ["the quick brown fox", "jumps over the lazy dog"],
+    ["streaming rag ingest", "tokenize h2d embed", "bounded queues"],
+    ["a single row batch"],
+    ["pipeline depth two", "ping pong buffers", "donated inputs", "drain"],
+]
+
+
+def _model():
+    tok = HashTokenizer(vocab_size=TINY.vocab_size, max_length=16)
+    return SentenceEmbedderModel(cfg=TINY, tokenizer=tok, max_length=16)
+
+
+def test_pipeline_matches_serial_bytes(monkeypatch):
+    m = _model()
+    try:
+        monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "0")
+        serial = [m.embed_batch(t) for t in TEXTS]
+        assert m._pipeline is None  # kill switch: no workers were built
+        monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "1")
+        piped = [m.embed_batch(t) for t in TEXTS]
+        assert m._pipeline is not None
+        for a, b in zip(serial, piped):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        m.close()
+
+
+def test_interleaved_submit_resolve(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "1")
+    m = _model()
+    try:
+        expected = [m.embed_batch(t) for t in TEXTS]
+        h0 = m.embed_submit(TEXTS[0])
+        (r0,) = m.embed_resolve([h0])
+        h1 = m.embed_submit(TEXTS[1])
+        h2 = m.embed_submit(TEXTS[2])
+        r1, r2 = m.embed_resolve([h1, h2])
+        for got, want in zip((r0, r1, r2), expected):
+            np.testing.assert_array_equal(got, want)
+    finally:
+        m.close()
+
+
+def test_out_of_order_resolve(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "1")
+    m = _model()
+    try:
+        expected = [m.embed_batch(t) for t in TEXTS]
+        handles = [m.embed_submit(t) for t in TEXTS]
+        assert all(isinstance(h, _PendingEmbed) for h in handles)
+        got = m.embed_resolve(list(reversed(handles)))
+        for g, want in zip(got, reversed(expected)):
+            np.testing.assert_array_equal(g, want)
+    finally:
+        m.close()
+
+
+def test_mixed_serial_and_pipelined_handles(monkeypatch):
+    """embed_resolve accepts handles from both paths in one drain."""
+    m = _model()
+    try:
+        monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "0")
+        expected = [m.embed_batch(t) for t in TEXTS[:2]]
+        h_serial = m.embed_submit(TEXTS[0])
+        monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "1")
+        h_piped = m.embed_submit(TEXTS[1])
+        got = m.embed_resolve([h_serial, h_piped])
+        for g, want in zip(got, expected):
+            np.testing.assert_array_equal(g, want)
+    finally:
+        m.close()
+
+
+def test_backpressure_tiny_queues_no_deadlock(monkeypatch):
+    """Queue bound 1 / depth 1: submits block instead of growing the
+    queue, and 16 in-flight batches still resolve to the serial bytes."""
+    monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "0")
+    m_serial = _model()
+    batches = [[f"doc {i} alpha", f"doc {i} beta"] for i in range(16)]
+    expected = [m_serial.embed_batch(t) for t in batches]
+    m_serial.close()
+
+    monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "1")
+    monkeypatch.setenv("PATHWAY_TPU_PIPELINE_DEPTH", "1")
+    monkeypatch.setenv("PATHWAY_TPU_PIPELINE_QUEUE", "1")
+    m = _model()
+    try:
+        handles = [m.embed_submit(t) for t in batches]
+        assert m._pipeline._dispatch._queue.maxsize == 1
+        assert m._pipeline._tokenize._queue.maxsize == 1
+        got = m.embed_resolve(handles)
+        for g, want in zip(got, expected):
+            np.testing.assert_array_equal(g, want)
+    finally:
+        m.close()
+
+
+def test_empty_batch_short_circuits(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "1")
+    m = _model()
+    try:
+        out = m.embed_batch([])
+        assert out.shape == (0, TINY.hidden)
+    finally:
+        m.close()
+
+
+def test_tokenizer_error_surfaces_at_resolve(monkeypatch):
+    """Stage failures must not kill the worker; they re-raise at wait()."""
+    monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "1")
+    m = _model()
+    try:
+        bad = m.embed_submit([object()])  # not a str: tokenizer raises
+        good = m.embed_submit(TEXTS[0])
+        with pytest.raises(BaseException):
+            m.embed_resolve([bad])
+        (r,) = m.embed_resolve([good])  # pipeline still alive after error
+        assert r.shape == (len(TEXTS[0]), TINY.hidden)
+    finally:
+        m.close()

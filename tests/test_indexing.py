@@ -477,3 +477,65 @@ def test_ivf_factory_int8_through_data_index():
     di = cols.index("doc")
     found = sorted(row[di][0] for row in rows.values())
     assert found == ["d0", "d1", "d2"]
+
+
+def test_knn_f32_scores_recall_and_exactness():
+    """PATHWAY_TPU_KNN_F32_SCORES / BruteForceKnnIndex(f32_scores=True):
+    scoring with f32 OPERANDS (not just f32 accumulation) must match the
+    f32 host truth exactly at small scale and never lose recall to the
+    default bf16 operand path — the bf16 operand rounding is where the
+    brute-force recall loss comes from."""
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    rng = np.random.default_rng(7)
+    D, N, Q, K = 256, 2000, 32, 10
+    vecs = rng.standard_normal((N, D)).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    queries = (
+        vecs[rng.integers(0, N, Q)]
+        + 0.02 * rng.standard_normal((Q, D)).astype(np.float32)
+    )
+    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+    sims = queries.astype(np.float64) @ vecs.astype(np.float64).T
+    truth = [set(np.argpartition(-s, K)[:K].tolist()) for s in sims]
+    keys = list(range(N))
+
+    recalls = {}
+    for name, flag in (("bf16", False), ("f32", True)):
+        idx = BruteForceKnnIndex(
+            dimensions=D, reserved_space=N, metric="cos", f32_scores=flag
+        )
+        idx.add(keys, vecs)
+        res = idx.search(queries, k=K)
+        recalls[name] = np.mean(
+            [
+                len({k for k, _ in row} & truth[qi]) / K
+                for qi, row in enumerate(res)
+            ]
+        )
+    assert recalls["f32"] >= recalls["bf16"], recalls
+    assert recalls["f32"] >= 0.99, recalls
+
+    # exact top-k parity at small scale, where no near-ties exist
+    small = BruteForceKnnIndex(
+        dimensions=D, reserved_space=64, metric="cos", f32_scores=True
+    )
+    small.add(keys[:64], vecs[:64])
+    sims_s = queries.astype(np.float64) @ vecs[:64].astype(np.float64).T
+    for qi, row in enumerate(small.search(queries, k=5)):
+        want = set(np.argsort(-sims_s[qi])[:5].tolist())
+        assert {k for k, _ in row} == want
+
+
+def test_knn_f32_scores_env_flag(monkeypatch):
+    """f32_scores=None defers to PATHWAY_TPU_KNN_F32_SCORES (read at
+    construction); an explicit argument always wins."""
+    from pathway_tpu.ops.knn import BruteForceKnnIndex
+
+    monkeypatch.setenv("PATHWAY_TPU_KNN_F32_SCORES", "1")
+    assert BruteForceKnnIndex(dimensions=8, reserved_space=4).f32_scores
+    monkeypatch.setenv("PATHWAY_TPU_KNN_F32_SCORES", "0")
+    assert not BruteForceKnnIndex(dimensions=8, reserved_space=4).f32_scores
+    assert BruteForceKnnIndex(
+        dimensions=8, reserved_space=4, f32_scores=True
+    ).f32_scores

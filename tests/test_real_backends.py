@@ -146,9 +146,21 @@ def test_kafka_real_consumer_reads_messages(stub_confluent):
     pw.io.subscribe(
         t, on_change=lambda key, row, time, is_addition: seen.append(row)
     )
+    # capture counts through a sink pumped by the SAME run as the
+    # stopper's subscribe (capture_table would run only its own subgraph,
+    # leaving `seen` forever empty and the stopper to its full timeout)
+    rows: dict = {}
+
+    def on_counts(key, row, time, is_addition):
+        if is_addition:
+            rows[key] = row
+        else:
+            rows.pop(key, None)
+
+    pw.io.subscribe(counts, on_change=on_counts)
     _stop_when(lambda: len(seen) >= 3)
-    rows, cols = _capture_rows(counts)
-    got = {row[0]: row[1] for row in rows.values()}
+    pw.run()
+    got = {row["word"]: row["c"] for row in rows.values()}
     assert got == {"cat": 2, "dog": 1}
 
 
@@ -717,12 +729,19 @@ def test_debezium_real_kafka_cdc(stub_confluent):
         t, on_change=lambda key, row, time, is_addition: events.append(
             (row["id"], row["word"], 1 if is_addition else -1))
     )
-    _stop_when(lambda: len(events) >= 5)  # 2 inserts + (-1,+1) update + delete
+    def _net():
+        net: dict = {}
+        for i, w, d in list(events):
+            net[(i, w)] = net.get((i, w), 0) + d
+        return {k: v for k, v in net.items() if v}
+
+    # stop when the NET state reaches the expected end state: the engine
+    # consolidates all four envelopes of the single drained commit, so a
+    # raw event count (2 inserts + update pair + delete = 5) may never be
+    # observed and would leave the stopper waiting out its full timeout
+    _stop_when(lambda: _net() == {(1, "a2"): 1})
     pw.run()
-    net: dict = {}
-    for i, w, d in events:
-        net[(i, w)] = net.get((i, w), 0) + d
-    final = {k: v for k, v in net.items() if v}
+    final = _net()
     assert final == {(1, "a2"): 1}, (events, final)
 
 
