@@ -447,23 +447,78 @@ def config2_recall_and_latency(jax, cfg) -> tuple[dict, "object", list[str]]:
     }, pipe, q_texts
 
 
+_CASCADE_ENV = (
+    "PATHWAY_TPU_RERANK_CASCADE",
+    "PATHWAY_TPU_RERANK_CASCADE_DEPTH",
+    "PATHWAY_TPU_RERANK_CASCADE_SURVIVORS",
+)
+
+
+def _bench_cascade_point(cfg) -> dict[str, str]:
+    """Cascade operating point for the bench model: near-full cheap depth
+    + half the candidates surviving. The bench reranker is random-init, so
+    its score margins are noise-level and top-8 fidelity needs a deep
+    cheap pass; pretrained checkpoints (real margins) tolerate the
+    ``layers//2`` auto default. Explicit env overrides win."""
+    return {
+        "PATHWAY_TPU_RERANK_CASCADE": "1",
+        "PATHWAY_TPU_RERANK_CASCADE_DEPTH": os.environ.get(
+            "PATHWAY_TPU_RERANK_CASCADE_DEPTH", str(max(1, cfg.layers - 1))
+        ),
+        "PATHWAY_TPU_RERANK_CASCADE_SURVIVORS": os.environ.get(
+            "PATHWAY_TPU_RERANK_CASCADE_SURVIVORS", "16"
+        ),
+    }
+
+
 def config3_rerank_latency(cfg, pipe, q_texts) -> dict:
     """Config 3: retrieve + CrossEncoder rerank of 32 candidates in ONE
     dispatch (embed -> top-k -> gather HBM-resident doc tokens -> cross-
-    encode), vs the staged rerank-only call for comparison."""
+    encode). Measured twice: the default full-depth path (now length-
+    bucketed pair packing — short docs stop paying pair_seq-wide
+    attention) and the cascaded early-exit path, plus the top-8 agreement
+    between the two orderings and the cascade's survivor rate."""
+    from pathway_tpu.engine import probes as probes_mod
     from pathway_tpu.models.cross_encoder import CrossEncoderModel
 
     model = CrossEncoderModel(cfg=cfg, tokenizer=pipe.embedder.tokenizer)
     pipe.reranker = model
-    pipe.retrieve_rerank(q_texts[0], k=32)  # compile
-    lat = []
-    for i in range(12):
-        t0 = time.perf_counter()
-        out = pipe.retrieve_rerank(q_texts[(i + 1) % len(q_texts)], k=32)
-        lat.append(time.perf_counter() - t0)
-    assert len(out) == 32
-    p50 = statistics.median(lat) * 1000
-    diag(phase="config3", retrieve_rerank32_p50_ms=round(p50, 1))
+    n_rep = 12
+
+    def timed():
+        pipe.retrieve_rerank(q_texts[0], k=32)  # compile
+        lat, top8 = [], []
+        for i in range(n_rep):
+            q = q_texts[(i + 1) % len(q_texts)]
+            t0 = time.perf_counter()
+            out = pipe.retrieve_rerank(q, k=32)
+            lat.append(time.perf_counter() - t0)
+            assert len(out) == 32
+            top8.append([key for key, _ in out[:8]])
+        return statistics.median(lat) * 1000, top8
+
+    saved = {v: os.environ.get(v) for v in _CASCADE_ENV}
+    try:
+        os.environ["PATHWAY_TPU_RERANK_CASCADE"] = "0"
+        p50, full8 = timed()
+        os.environ.update(_bench_cascade_point(cfg))
+        probes_mod.reset_cascade_stats()
+        c_p50, casc8 = timed()
+        cascade = probes_mod.cascade_stats()
+    finally:
+        for var, val in saved.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
+    overlap = sum(
+        len(set(a) & set(b)) / 8.0 for a, b in zip(full8, casc8)
+    ) / n_rep
+    diag(
+        phase="config3", retrieve_rerank32_p50_ms=round(p50, 1),
+        cascade_p50_ms=round(c_p50, 1), top8_overlap=round(overlap, 3),
+        survivor_rate=cascade["survivor_rate"],
+    )
     return {
         "metric": "rerank_stage_p50_ms",
         "value": round(p50, 1),
@@ -471,6 +526,95 @@ def config3_rerank_latency(cfg, pipe, q_texts) -> dict:
         "detail": {
             "candidates": 32,
             "pipeline": "fused text->retrieve->rerank (1 dispatch)",
+            "cascade_p50_ms": round(c_p50, 1),
+            "cascade_top8_overlap": round(overlap, 3),
+            "cascade_survivor_rate": cascade["survivor_rate"],
+            "cascade_gflops": cascade["gflops"],
+        },
+    }
+
+
+def config_query_server(cfg, pipe, q_texts) -> dict:
+    """Query serving under Poisson load: concurrent retrieve and
+    retrieve-rerank requests hit a micro-batching ``QueryServer`` that
+    coalesces each tick's arrivals into one batched fused dispatch per
+    request class. Reports achieved QPS, request p50/p95, the tick
+    batch-size histogram and the cascade survivor rate."""
+    from pathway_tpu.engine import probes as probes_mod
+    from pathway_tpu.ops.query_server import QueryServer
+
+    if pipe.reranker is None:
+        raise RuntimeError("config3 must run first (sets the reranker)")
+    n_req = 24 if _smoke() else 96
+    max_batch = 8
+    k_rer = 16
+    rng = np.random.default_rng(23)
+    saved = {v: os.environ.get(v) for v in _CASCADE_ENV}
+    try:
+        os.environ.update(_bench_cascade_point(cfg))
+        probes_mod.reset_cascade_stats()
+        with QueryServer(pipe, max_batch=max_batch) as srv:
+            # pre-compile every pow2 row bucket the server can form, both
+            # request classes, so the Poisson window times serving alone
+            for qb in (1, 2, 4, 8):
+                pipe.retrieve_rerank_batch(q_texts[:qb], k=k_rer)
+                pipe.retrieve(q_texts[:qb], k=TOP_K)
+            t0 = time.perf_counter()
+            srv.query(q_texts[0], k_rer, rerank=True)
+            single_s = time.perf_counter() - t0
+            # offered load ~3x a single stream: enough pressure that ticks
+            # coalesce, not so much the queue only ever grows
+            rate = 3.0 / max(single_s, 1e-4)
+            gaps = rng.exponential(1.0 / rate, size=n_req)
+            reqs = []
+            t_start = time.perf_counter()
+            due = t_start
+            for i, gap in enumerate(gaps):
+                due += gap
+                now = time.perf_counter()
+                if due > now:
+                    time.sleep(due - now)
+                rerank = (i % 3) != 2  # 2/3 rerank, 1/3 retrieve
+                reqs.append(
+                    srv.submit(
+                        q_texts[i % len(q_texts)],
+                        k_rer if rerank else TOP_K, rerank=rerank,
+                    )
+                )
+            for r in reqs:
+                r.wait(timeout=600.0)
+            wall = time.perf_counter() - t_start
+            stats = srv.stats()
+        lats = sorted(r.latency_s for r in reqs)
+        lat_ms = float(np.median(lats)) * 1e3
+        p95 = float(np.percentile(lats, 95)) * 1e3
+        qps = n_req / wall
+        cascade = probes_mod.cascade_stats()
+    finally:
+        for var, val in saved.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
+    diag(
+        phase="query_server", qps=round(qps, 1), p50_ms=round(lat_ms, 1),
+        p95_ms=round(p95, 1), mean_batch=stats["mean_batch"],
+        batch_hist=stats["batch_hist"],
+    )
+    return {
+        "metric": "query_server_qps",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "detail": {
+            "requests": n_req,
+            "offered_qps": round(rate, 1),
+            "p50_ms": round(lat_ms, 1),
+            "p95_ms": round(p95, 1),
+            "mean_batch": stats["mean_batch"],
+            "batch_hist": {str(n): c for n, c in stats["batch_hist"].items()},
+            "ticks": stats["ticks"],
+            "dispatches": stats["dispatches"],
+            "survivor_rate": cascade["survivor_rate"],
         },
     }
 
@@ -1709,6 +1853,13 @@ def main() -> None:
             extra.append(config3_rerank_latency(cfg, pipe, q_texts))
         except Exception as exc:  # noqa: BLE001
             diag(warning="extra_metric_failed", which="config3", error=repr(exc))
+        try:
+            extra.append(config_query_server(cfg, pipe, q_texts))
+        except Exception as exc:  # noqa: BLE001
+            diag(
+                warning="extra_metric_failed", which="query_server",
+                error=repr(exc),
+            )
     try:
         extra.append(config4_streaming_engine())
     except Exception as exc:  # noqa: BLE001
@@ -1835,6 +1986,25 @@ def main() -> None:
             "serving": serving_summary,
             "knn_recall_at_10": _m("knn_recall_at_10").get("value"),
             "rerank_p50_ms": _m("rerank_stage_p50_ms").get("value"),
+            "rerank_cascade_p50_ms": (
+                _m("rerank_stage_p50_ms").get("detail") or {}
+            ).get("cascade_p50_ms"),
+            "cascade_top8_overlap": (
+                _m("rerank_stage_p50_ms").get("detail") or {}
+            ).get("cascade_top8_overlap"),
+            "cascade_survivor_rate": (
+                _m("rerank_stage_p50_ms").get("detail") or {}
+            ).get("cascade_survivor_rate"),
+            "query_qps": _m("query_server_qps").get("value"),
+            "query_p50_ms": (
+                _m("query_server_qps").get("detail") or {}
+            ).get("p50_ms"),
+            "query_p95_ms": (
+                _m("query_server_qps").get("detail") or {}
+            ).get("p95_ms"),
+            "query_batch_hist": (
+                _m("query_server_qps").get("detail") or {}
+            ).get("batch_hist"),
             "ivf_recall_at_10": ivf.get("value"),
             "ivf_big": {
                 k: big.get(k)

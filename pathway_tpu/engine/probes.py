@@ -67,6 +67,53 @@ def reset_dispatch_counts() -> None:
 
 
 # --------------------------------------------------------------------- #
+# cascade-rerank ledger
+#
+# The cascade's whole point is skipped compute, so the ledger counts what
+# each stage actually paid: pairs scored and model FLOPs per stage
+# (``cheap`` = truncated-depth pass over all k candidates, ``full`` =
+# full-depth pass over survivors only). ``cascade_stats()['survivor_rate']``
+# is the fraction of candidates that reached the full pass — the knob the
+# quality/latency trade hangs on.
+
+_cascade_lock = threading.Lock()
+_cascade_pairs: dict[str, int] = {}
+_cascade_flops: dict[str, float] = {}
+
+
+def record_cascade(stage: str, pairs: int, flops: float = 0.0) -> None:
+    """Account ``pairs`` scored (and model ``flops`` paid) by cascade
+    ``stage`` (``cheap`` / ``full``). Thread-safe; called per dispatch by
+    the fused query path."""
+    with _cascade_lock:
+        _cascade_pairs[stage] = _cascade_pairs.get(stage, 0) + pairs
+        _cascade_flops[stage] = _cascade_flops.get(stage, 0.0) + flops
+
+
+def cascade_stats() -> dict:
+    """Snapshot: per-stage pairs + FLOPs, and the survivor rate (full-pass
+    pairs / cheap-pass pairs; 1.0 when the cascade never ran — every
+    candidate 'survived' into the only pass there was)."""
+    with _cascade_lock:
+        pairs = dict(_cascade_pairs)
+        flops = dict(_cascade_flops)
+    cheap = pairs.get("cheap", 0)
+    full = pairs.get("full", 0)
+    rate = (full / cheap) if cheap else 1.0
+    return {
+        "pairs": pairs,
+        "gflops": {k: round(v / 1e9, 3) for k, v in flops.items()},
+        "survivor_rate": round(rate, 4),
+    }
+
+
+def reset_cascade_stats() -> None:
+    with _cascade_lock:
+        _cascade_pairs.clear()
+        _cascade_flops.clear()
+
+
+# --------------------------------------------------------------------- #
 # pipeline-stage ledger (bubble attribution)
 #
 # The roofline says HOW FAR the device is from peak; this ledger says

@@ -29,6 +29,30 @@ flip them per-run):
   the token drain ``pipeline_depth`` chunks later.
 * PATHWAY_TPU_KNN_F32_SCORES (default off) — score KNN with f32 operands
   instead of the bf16 MXU fast path (``ops/knn.py``).
+
+Query-path knobs (``ops/fused_query.py`` / ``ops/query_server.py``):
+
+* PATHWAY_TPU_RERANK_CASCADE (default off) — cascaded early-exit rerank:
+  a truncated-depth cheap pass scores all k candidates, only the top
+  survivors pay the full cross-encoder. ``0`` keeps the single full-depth
+  pass (bitwise-identical to the pre-cascade path).
+* PATHWAY_TPU_RERANK_CASCADE_DEPTH (default 0 = auto, layers//2) — how
+  many encoder layers the cheap pass runs.
+* PATHWAY_TPU_RERANK_CASCADE_SURVIVORS (default 0 = auto,
+  max(8, k//2)) — candidates that survive into the full-depth pass.
+* PATHWAY_TPU_RERANK_SEED_WEIGHT (default 0.25) — weight of the
+  retrieval score mixed into the cheap-pass score (seeds the cascade
+  with the signal retrieval already paid for).
+* PATHWAY_TPU_PAIR_BUCKETS (default on) — length-bucketed pair packing:
+  rerank pairs pad to the pow2 bucket of the true max ``q_len + d_len``
+  instead of always the full ``pair_seq``; ``0`` restores full-width
+  padding.
+* PATHWAY_TPU_QUERY_TICK_MS (default 2.0) — micro-batching query-server
+  coalescing window (milliseconds per tick).
+* PATHWAY_TPU_QUERY_MAX_BATCH (default 64) — max queries coalesced into
+  one device dispatch per tick.
+* PATHWAY_TPU_QUERY_QUEUE (default 256) — admission bound; ``submit``
+  blocks (backpressure) once this many requests wait.
 """
 
 from __future__ import annotations
@@ -120,6 +144,61 @@ class PathwayConfig:
         cover the request budget, instead of at token-drain time
         ``pipeline_depth`` chunks later."""
         return _env_bool("PATHWAY_TPU_EAGER_REFILL", True)
+
+    @property
+    def rerank_cascade(self) -> bool:
+        """Cascaded early-exit rerank: truncated-depth cheap pass over all
+        k candidates, full cross-encoder only on the survivors. Off by
+        default — ``PATHWAY_TPU_RERANK_CASCADE=0`` (or unset) keeps the
+        single full-depth pass bitwise-identical to the pre-cascade path."""
+        return _env_bool("PATHWAY_TPU_RERANK_CASCADE", False)
+
+    @property
+    def rerank_cascade_depth(self) -> int:
+        """Encoder layers the cheap cascade pass runs (0 = auto:
+        ``layers // 2``, minimum 1)."""
+        return max(0, int(os.environ.get("PATHWAY_TPU_RERANK_CASCADE_DEPTH", "0")))
+
+    @property
+    def rerank_cascade_survivors(self) -> int:
+        """Candidates surviving into the full-depth pass (0 = auto:
+        ``max(8, k // 4)`` clamped to k)."""
+        return max(
+            0, int(os.environ.get("PATHWAY_TPU_RERANK_CASCADE_SURVIVORS", "0"))
+        )
+
+    @property
+    def rerank_seed_weight(self) -> float:
+        """Weight of the retrieval score added to the cheap-pass score —
+        the cascade starts from the ranking signal retrieval already paid
+        for instead of from scratch."""
+        return float(os.environ.get("PATHWAY_TPU_RERANK_SEED_WEIGHT", "0.25"))
+
+    @property
+    def pair_buckets(self) -> bool:
+        """Length-bucketed pair packing: rerank pairs pad to the pow2
+        bucket of the true max ``q_len + d_len`` instead of the full
+        ``pair_seq`` window. ``PATHWAY_TPU_PAIR_BUCKETS=0`` restores
+        full-width padding."""
+        return _env_bool("PATHWAY_TPU_PAIR_BUCKETS", True)
+
+    @property
+    def query_tick_ms(self) -> float:
+        """Micro-batching query-server coalescing window (ms per tick)."""
+        return max(
+            0.0, float(os.environ.get("PATHWAY_TPU_QUERY_TICK_MS", "2.0"))
+        )
+
+    @property
+    def query_max_batch(self) -> int:
+        """Max queries coalesced into one device dispatch per tick."""
+        return max(1, int(os.environ.get("PATHWAY_TPU_QUERY_MAX_BATCH", "64")))
+
+    @property
+    def query_queue(self) -> int:
+        """Query-server admission bound; ``submit`` blocks once this many
+        requests wait (backpressure, mirrors the ingest pipeline queue)."""
+        return max(1, int(os.environ.get("PATHWAY_TPU_QUERY_QUEUE", "256")))
 
     @property
     def knn_f32_scores(self) -> bool:

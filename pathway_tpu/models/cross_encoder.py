@@ -102,11 +102,9 @@ class CrossEncoderModel:
         ids, mask, types = self.tokenizer.encode_pairs(
             pairs, max_length=self.max_length, return_types=True
         )
-        ids, mask = pad_to_buckets(ids, mask)
-        types2 = np.zeros_like(ids)
-        types2[: types.shape[0], : types.shape[1]] = types
+        ids, mask, types = pad_to_buckets(ids, mask, types)
         out = score_fn(self.params, self.head, jnp.asarray(ids),
-                       jnp.asarray(mask), self.cfg, jnp.asarray(types2))
+                       jnp.asarray(mask), self.cfg, jnp.asarray(types))
         return (out, len(pairs))
 
     def score_resolve(self, handles) -> list[np.ndarray]:

@@ -369,14 +369,24 @@ from pathway_tpu.ops import next_pow2 as bucket_pow2  # shared padding disciplin
 
 
 def pad_to_buckets(ids: np.ndarray, mask: np.ndarray,
-                   row_lo: int = 8, seq_lo: int = 16
-                   ) -> tuple[np.ndarray, np.ndarray]:
-    """Pad a tokenized batch up to pow2 (rows, seq) buckets."""
+                   types: np.ndarray | None = None,
+                   row_lo: int = 8, seq_lo: int = 16):
+    """Pad a tokenized batch up to pow2 (rows, seq) buckets.
+
+    Optionally pads a ``token_type_ids`` array in the same call (padded
+    tail rows/cols carry mask 0 and type 0 — segment 0, exactly what the
+    type-embedding lookup expects for padding). Returns ``(ids, mask)``
+    or ``(ids, mask, types)`` matching the inputs."""
     rows = bucket_pow2(ids.shape[0], row_lo)
     seq = bucket_pow2(ids.shape[1], seq_lo)
     ids = np.pad(ids, ((0, rows - ids.shape[0]), (0, seq - ids.shape[1])))
     mask = np.pad(mask, ((0, rows - mask.shape[0]), (0, seq - mask.shape[1])))
-    return ids, mask
+    if types is None:
+        return ids, mask
+    types = np.pad(
+        types, ((0, rows - types.shape[0]), (0, seq - types.shape[1]))
+    )
+    return ids, mask, types
 
 
 class _HFTokenizerAdapter:

@@ -263,11 +263,18 @@ def embed_inputs(params: dict, input_ids: jax.Array,
 
 def encode(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
            cfg: TransformerConfig,
-           token_type_ids: jax.Array | None = None) -> jax.Array:
+           token_type_ids: jax.Array | None = None,
+           *, n_layers: int | None = None) -> jax.Array:
     """Full encoder forward. Returns final hidden states (B, S, H) float32.
 
     Static shapes only; the S dimension is the caller's padded bucket size
     (the UDF microbatcher pads to pow2 buckets so executables are reused).
+
+    ``n_layers`` truncates the depth: the scan runs over only the first
+    ``n_layers`` stacked layer slices (a static Python int — each depth is
+    its own executable). Used by the cascade rerank's cheap first pass;
+    ``None`` (default) runs the full stack and is byte-identical to the
+    pre-truncation path.
     """
     x, mask_bias = embed_inputs(params, input_ids, attention_mask, cfg,
                                 token_type_ids)
@@ -275,7 +282,10 @@ def encode(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
     def body(carry, lp):
         return _layer(carry, lp, mask_bias, cfg), None
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    layers = params["layers"]
+    if n_layers is not None and n_layers < cfg.layers:
+        layers = jax.tree.map(lambda a: a[:n_layers], layers)
+    x, _ = jax.lax.scan(body, x, layers)
     return x.astype(jnp.float32)
 
 

@@ -26,12 +26,25 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pathway_tpu.engine.probes import record_cascade, record_device_dispatch
+from pathway_tpu.internals.config import pathway_config
 from pathway_tpu.models.embedder import embed_fn
 from pathway_tpu.models.tokenizer import PAD_ID, SEP_ID
 from pathway_tpu.models.transformer import TransformerConfig, encode
+from pathway_tpu.ops import next_pow2
 from pathway_tpu.ops.knn import BruteForceKnnIndex, knn_scores, topk_scores
 
 _NEG_INF = -1e30
+
+
+def _encoder_flops(cfg: TransformerConfig, seq: int, n_layers: int,
+                   pairs: int) -> float:
+    """Model FLOPs of ``pairs`` sequences of length ``seq`` through
+    ``n_layers`` encoder layers (same accounting as bench.py's
+    ``flops_per_doc``: qkv+attn-out+mlp gemms + 2 S^2 attention gemms)."""
+    h, i = cfg.hidden, cfg.intermediate
+    per_layer = 2 * seq * h * (3 * h + h + 2 * i) + 4 * seq * seq * h
+    return float(pairs) * n_layers * per_layer
 
 
 @functools.partial(
@@ -108,6 +121,127 @@ def _fused_retrieve_rerank(e_params, q_ids, q_mask, corpus, valid,
     return scores[0], idx0, r_scores, order
 
 
+def _pair_scores(r_params, r_head, pair, mask, ttype,
+                 r_cfg: TransformerConfig, n_layers: int | None = None):
+    """Cross-encoder scores for a flat (B, P) pair batch: encode (optionally
+    truncated to ``n_layers``) -> tanh pooler on [CLS] -> scalar head."""
+    hidden = encode(r_params, pair, mask, r_cfg, ttype, n_layers=n_layers)
+    cls = hidden[:, 0, :]
+    pooled = jnp.tanh(
+        cls @ r_params["pooler"]["w"].astype(jnp.float32)
+        + r_params["pooler"]["b"].astype(jnp.float32)
+    )
+    return (pooled @ r_head["w"] + r_head["b"])[:, 0]
+
+
+def _retrieve_and_assemble(e_params, q_ids, q_mask, corpus, valid,
+                           doc_tokens, doc_lens,
+                           e_cfg: TransformerConfig, k: int, metric: str,
+                           pair_seq: int):
+    """Shared front half of the batched rerank kernels: embed queries,
+    top-k the corpus, gather hit docs, assemble (Qb, k, P) pair inputs."""
+    emb = embed_fn(e_params, q_ids, q_mask, e_cfg)            # (Qb, H)
+    scores, idx = topk_scores(
+        knn_scores(corpus, valid, emb, metric), k
+    )                                                         # (Qb, k)
+    d_tok = jnp.take(doc_tokens, idx, axis=0)                 # (Qb, k, dseq)
+    d_len = jnp.take(doc_lens, idx)                           # (Qb, k)
+    q_len = jnp.sum(q_mask, axis=1).astype(jnp.int32)         # (Qb,)
+    pair, mask, ttype = jax.vmap(
+        functools.partial(_assemble_pairs, pair_seq=pair_seq)
+    )(q_ids, q_len, d_tok, d_len)                             # (Qb, k, P)
+    return scores, idx, pair, mask, ttype
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("e_cfg", "r_cfg", "k", "metric", "pair_seq"),
+)
+def _fused_retrieve_rerank_batch(e_params, q_ids, q_mask, corpus, valid,
+                                 doc_tokens, doc_lens, r_params, r_head,
+                                 e_cfg: TransformerConfig,
+                                 r_cfg: TransformerConfig,
+                                 k: int, metric: str, pair_seq: int):
+    """Multi-query generalisation of :func:`_fused_retrieve_rerank` — the
+    whole (Qb, k) candidate matrix cross-encodes as ONE flat batch, so a
+    micro-batching tick of Qb queries still costs one dispatch. Returns
+    (knn_scores (Qb, k), idx (Qb, k), rerank_scores (Qb, k), order (Qb, k))."""
+    scores, idx, pair, mask, ttype = _retrieve_and_assemble(
+        e_params, q_ids, q_mask, corpus, valid, doc_tokens, doc_lens,
+        e_cfg, k, metric, pair_seq,
+    )
+    qb = q_ids.shape[0]
+    flat = lambda a: a.reshape(qb * k, pair_seq)  # noqa: E731
+    r_scores = _pair_scores(
+        r_params, r_head, flat(pair), flat(mask), flat(ttype), r_cfg
+    ).reshape(qb, k)
+    # hits beyond the live corpus (padded capacity) must sort last
+    r_scores = jnp.where(scores <= _NEG_INF / 2, _NEG_INF, r_scores)
+    order = jnp.argsort(-r_scores, axis=1)
+    return scores, idx, r_scores, order
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "e_cfg", "r_cfg", "k", "metric", "pair_seq",
+        "depth", "keep", "seed_weight",
+    ),
+)
+def _fused_retrieve_rerank_cascade(e_params, q_ids, q_mask, corpus, valid,
+                                   doc_tokens, doc_lens, r_params, r_head,
+                                   e_cfg: TransformerConfig,
+                                   r_cfg: TransformerConfig,
+                                   k: int, metric: str, pair_seq: int,
+                                   depth: int, keep: int,
+                                   seed_weight: float):
+    """Cascaded early-exit rerank, still ONE dispatch: a truncated-depth
+    cheap pass (first ``depth`` layers + the score head, seeded with the
+    retrieval score) ranks all k candidates; only the top ``keep``
+    survivors pay the full cross-encoder. Survivor selection happens on
+    device (``lax.top_k`` + gather), so the cheap and full stages share a
+    single executable and a single round trip.
+
+    Returns (knn_scores (Qb, k), idx (Qb, k), rerank_scores (Qb, k),
+    order (Qb, k)). ``order`` lists survivors first (by full-depth score)
+    then the rest (by cheap score); ``rerank_scores`` holds full-depth
+    scores at survivor positions and cheap scores elsewhere — the two
+    ranges are internally ordered but not mutually calibrated."""
+    scores, idx, pair, mask, ttype = _retrieve_and_assemble(
+        e_params, q_ids, q_mask, corpus, valid, doc_tokens, doc_lens,
+        e_cfg, k, metric, pair_seq,
+    )
+    qb = q_ids.shape[0]
+    flat = lambda a, n: a.reshape(qb * n, pair_seq)  # noqa: E731
+    cheap = _pair_scores(
+        r_params, r_head, flat(pair, k), flat(mask, k), flat(ttype, k),
+        r_cfg, n_layers=depth,
+    ).reshape(qb, k)
+    # seed with the ranking signal retrieval already paid for
+    cheap = cheap + jnp.float32(seed_weight) * scores.astype(jnp.float32)
+    cheap = jnp.where(scores <= _NEG_INF / 2, _NEG_INF, cheap)
+    _, surv = jax.lax.top_k(cheap, keep)                      # (Qb, keep)
+    gather = lambda a: jnp.take_along_axis(  # noqa: E731
+        a, surv[:, :, None], axis=1
+    )
+    full = _pair_scores(
+        r_params, r_head,
+        flat(gather(pair), keep), flat(gather(mask), keep),
+        flat(gather(ttype), keep), r_cfg,
+    ).reshape(qb, keep)
+    surv_knn = jnp.take_along_axis(scores, surv, axis=1)
+    full = jnp.where(surv_knn <= _NEG_INF / 2, _NEG_INF, full)
+    rows = jnp.arange(qb)[:, None]
+    r_scores = cheap.at[rows, surv].set(full)
+    # survivors first, ranked by full-depth score; the cascaded-out rest
+    # follow in cheap-score order
+    surv_sorted = jnp.take_along_axis(surv, jnp.argsort(-full, axis=1), axis=1)
+    rest = cheap.at[rows, surv].set(_NEG_INF)
+    rest_order = jnp.argsort(-rest, axis=1)                   # survivors last
+    order = jnp.concatenate([surv_sorted, rest_order[:, : k - keep]], axis=1)
+    return scores, idx, r_scores, order
+
+
 class FusedRAGPipeline:
     """HBM-resident retrieval (+ optional rerank) with one-dispatch queries.
 
@@ -142,6 +276,10 @@ class FusedRAGPipeline:
         cap = self.index.capacity
         self._doc_tokens = jnp.zeros((cap, doc_seq), dtype=jnp.int32)
         self._doc_lens = jnp.zeros((cap,), dtype=jnp.int32)
+        # longest stored doc-token row, tracked on host so the pair-packing
+        # bucket is computable without a device round trip; monotone (not
+        # lowered on remove) so it stays a safe upper bound
+        self._max_doc_len = 0
 
     # ------------------------------------------------------------- ingest
     def _doc_token_rows(self, texts: list[str]):
@@ -177,6 +315,8 @@ class FusedRAGPipeline:
             self._doc_tokens = jnp.pad(self._doc_tokens, ((0, grow), (0, 0)))
             self._doc_lens = jnp.pad(self._doc_lens, (0, grow))
         ids, lens = self._doc_token_rows(list(texts))
+        if lens.size:
+            self._max_doc_len = max(self._max_doc_len, int(lens.max()))
         self._doc_tokens = jax.lax.dynamic_update_slice(
             self._doc_tokens, jnp.asarray(ids), (start, 0)
         )
@@ -186,12 +326,50 @@ class FusedRAGPipeline:
 
     # ------------------------------------------------------------ queries
     def _tokenize_queries(self, texts: list[str], max_length: int | None = None):
+        """Tokenize + bucket-pad queries. Returns device arrays plus the
+        true max query length (a host int, read from the numpy mask BEFORE
+        transfer so pair-bucket selection costs no device round trip)."""
         m = self.embedder
         ids, mask = m.tokenizer(texts, max_length=max_length or m.max_length)
         from pathway_tpu.models.tokenizer import pad_to_buckets
 
+        q_max = int(mask.sum(axis=1).max()) if mask.size else 2
         ids, mask = pad_to_buckets(ids, mask, row_lo=1)
-        return jnp.asarray(ids), jnp.asarray(mask)
+        return jnp.asarray(ids), jnp.asarray(mask), q_max
+
+    def _pair_bucket(self, q_max: int) -> int:
+        """Static pair width for this query batch: the pow2 bucket of the
+        true worst-case pair length ``q_len + max_doc_len + 1`` (capped at
+        the configured ``pair_seq``, which also stays the kill-switch
+        width when ``PATHWAY_TPU_PAIR_BUCKETS=0``). Executables cache per
+        bucket, so short corpora stop paying ``pair_seq``-wide attention."""
+        if not pathway_config.pair_buckets:
+            return self.pair_seq
+        need = q_max + min(self._max_doc_len, self.doc_seq) + 1
+        return min(self.pair_seq, next_pow2(need, 16))
+
+    def _cascade_plan(self, k: int):
+        """(depth, survivors, seed_weight) for a cascade over k candidates,
+        env-overridable with auto defaults: half the encoder depth for the
+        cheap pass, half the candidates surviving (floor 8)."""
+        c = pathway_config
+        layers = self.reranker.cfg.layers
+        depth = c.rerank_cascade_depth or max(1, layers // 2)
+        depth = max(1, min(depth, layers))
+        keep = c.rerank_cascade_survivors or max(8, k // 2)
+        keep = max(1, min(keep, k))
+        return depth, keep, c.rerank_seed_weight
+
+    def _record_cascade(self, qb: int, k: int, keep: int, depth: int,
+                        pair_seq: int) -> None:
+        r_cfg = self.reranker.cfg
+        record_cascade(
+            "cheap", qb * k, _encoder_flops(r_cfg, pair_seq, depth, qb * k)
+        )
+        record_cascade(
+            "full", qb * keep,
+            _encoder_flops(r_cfg, pair_seq, r_cfg.layers, qb * keep),
+        )
 
     def remove(self, keys: list) -> None:
         """Remove documents, keeping the token store aligned with the
@@ -214,8 +392,9 @@ class FusedRAGPipeline:
             self.index.remove([key])
 
     def retrieve_device(self, texts: list[str], k: int):
-        ids, mask = self._tokenize_queries(texts)
+        ids, mask, _ = self._tokenize_queries(texts)
         k_eff = min(k, self.index.capacity)
+        record_device_dispatch("fused_retrieve")
         return _fused_retrieve(
             self.embedder.params, ids, mask, self.index._corpus,
             self.index._valid, self.embedder.cfg, k_eff, self.metric,
@@ -226,28 +405,50 @@ class FusedRAGPipeline:
         scores, idx = jax.device_get(self.retrieve_device(texts, k))
         return self.index.resolve(scores, idx, len(texts), k)
 
-    def retrieve_rerank_device(self, text: str, k: int):
+    def _rerank_args(self, texts: list[str], k: int):
+        """Tokenize rerank queries and bundle the (device args, statics)
+        shared by the single/batch/cascade rerank kernels."""
         if self.reranker is None:
             raise ValueError("construct FusedRAGPipeline with a reranker")
-        ids, mask = self._tokenize_queries(
-            [text],
+        ids, mask, q_max = self._tokenize_queries(
+            texts,
             max_length=min(self.embedder.max_length, self._rerank_q_budget),
         )
         k_eff = min(k, self.index.capacity)
-        return _fused_retrieve_rerank(
+        pair_seq = self._pair_bucket(q_max)
+        arrays = (
             self.embedder.params, ids, mask, self.index._corpus,
             self.index._valid, self._doc_tokens, self._doc_lens,
             self.reranker.params, self.reranker.head,
-            self.embedder.cfg, self.reranker.cfg,
-            k_eff, self.metric, self.pair_seq,
+        )
+        return arrays, k_eff, pair_seq
+
+    def retrieve_rerank_device(self, text: str, k: int):
+        arrays, k_eff, pair_seq = self._rerank_args([text], k)
+        if pathway_config.rerank_cascade:
+            depth, keep, seed_w = self._cascade_plan(k_eff)
+            record_device_dispatch("fused_rerank_cascade")
+            self._record_cascade(1, k_eff, keep, depth, pair_seq)
+            scores, idx, r_scores, order = _fused_retrieve_rerank_cascade(
+                *arrays, self.embedder.cfg, self.reranker.cfg,
+                k_eff, self.metric, pair_seq, depth, keep, seed_w,
+            )
+            return scores[0], idx[0], r_scores[0], order[0]
+        record_device_dispatch("fused_retrieve_rerank")
+        return _fused_retrieve_rerank(
+            *arrays, self.embedder.cfg, self.reranker.cfg,
+            k_eff, self.metric, pair_seq,
         )
 
     def retrieve_rerank(self, text: str, k: int):
         """[(key, rerank_score)] best-first — ONE dispatch round trip for
-        embed + search + gather + cross-encode."""
+        embed + search + gather + cross-encode (cascaded or not)."""
         scores, idx, r_scores, order = jax.device_get(
             self.retrieve_rerank_device(text, k)
         )
+        return self._resolve_rerank_row(scores, idx, r_scores, order)
+
+    def _resolve_rerank_row(self, scores, idx, r_scores, order):
         out = []
         for j in order:
             if scores[j] <= _NEG_INF / 2:
@@ -256,3 +457,34 @@ class FusedRAGPipeline:
             if slot < len(self.index._keys):
                 out.append((self.index._keys[slot], float(r_scores[j])))
         return out
+
+    def retrieve_rerank_batch_device(self, texts: list[str], k: int):
+        """Batched fused retrieve+rerank: the whole query batch costs ONE
+        dispatch (the micro-batching server's tick primitive). Returns
+        (knn_scores, idx, rerank_scores, order), each (Qb', k) with Qb'
+        the pow2 row bucket — callers slice ``[:len(texts)]``."""
+        arrays, k_eff, pair_seq = self._rerank_args(texts, k)
+        if pathway_config.rerank_cascade:
+            depth, keep, seed_w = self._cascade_plan(k_eff)
+            record_device_dispatch("fused_rerank_cascade")
+            self._record_cascade(len(texts), k_eff, keep, depth, pair_seq)
+            return _fused_retrieve_rerank_cascade(
+                *arrays, self.embedder.cfg, self.reranker.cfg,
+                k_eff, self.metric, pair_seq, depth, keep, seed_w,
+            )
+        record_device_dispatch("fused_retrieve_rerank")
+        return _fused_retrieve_rerank_batch(
+            *arrays, self.embedder.cfg, self.reranker.cfg,
+            k_eff, self.metric, pair_seq,
+        )
+
+    def retrieve_rerank_batch(self, texts: list[str], k: int):
+        """Per-query [(key, rerank_score)] best-first lists for a batch of
+        queries — still one dispatch round trip for the whole batch."""
+        scores, idx, r_scores, order = jax.device_get(
+            self.retrieve_rerank_batch_device(texts, k)
+        )
+        return [
+            self._resolve_rerank_row(scores[i], idx[i], r_scores[i], order[i])
+            for i in range(len(texts))
+        ]

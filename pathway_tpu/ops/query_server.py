@@ -1,0 +1,228 @@
+"""Micro-batched query serving over a :class:`FusedRAGPipeline`.
+
+Per-query dispatch wastes the device when queries arrive concurrently:
+``_fused_retrieve`` / ``_fused_retrieve_rerank_batch`` already take
+``(Qb, S)`` query batches, so N requests landing in the same short window
+can share ONE dispatch instead of paying N round trips. The
+:class:`QueryServer` mirrors the continuous decode server in
+``xpacks/llm/llms.py`` (lock + deque + wake event + daemon loop with a
+failure sweep) and the ingest ``StageWorker`` contract in
+``engine/async_runtime.py`` (bounded admission, blocking backpressure):
+
+* ``submit`` enqueues a retrieve or retrieve-rerank request and returns a
+  handle; ``queue_bound`` admission blocks when the server is saturated.
+* the loop coalesces everything that arrived within one ``tick_ms``
+  window (or up to ``max_batch``, whichever first) and issues one batched
+  device dispatch per ``(kind, k)`` group — homogeneous load is exactly
+  one dispatch per tick.
+* results resolve back per request; ``stats()`` reports ticks, the
+  batch-size histogram and coalescing rate the bench's Poisson phase
+  plots.
+
+The server is opt-in: code that never constructs one keeps today's
+per-call query path byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from pathway_tpu.internals.config import pathway_config
+
+
+class QueryRequest:
+    """One in-flight query. ``done`` fires once ``result`` / ``error`` is
+    set; timestamps are ``time.monotonic()`` for latency accounting."""
+
+    __slots__ = (
+        "kind", "text", "k", "done", "result", "error",
+        "submitted_at", "finished_at",
+    )
+
+    def __init__(self, kind: str, text: str, k: int):
+        self.kind = kind                # "retrieve" | "rerank"
+        self.text = text
+        self.k = k
+        self.done = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+        self.submitted_at = time.monotonic()
+        self.finished_at = 0.0
+
+    def wait(self, timeout: float | None = None):
+        if not self.done.wait(timeout):
+            raise TimeoutError("query did not complete in time")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    @property
+    def latency_s(self) -> float:
+        return max(0.0, self.finished_at - self.submitted_at)
+
+
+class QueryServer:
+    """Coalesces concurrent retrieve / retrieve-rerank requests into
+    batched fused dispatches (one per ``(kind, k)`` group per tick)."""
+
+    def __init__(self, pipeline, *, tick_ms: float | None = None,
+                 max_batch: int | None = None,
+                 queue_bound: int | None = None):
+        cfg = pathway_config
+        self._pipe = pipeline
+        self.tick_s = (cfg.query_tick_ms if tick_ms is None else tick_ms) / 1e3
+        self.max_batch = max_batch or cfg.query_max_batch
+        self.queue_bound = queue_bound or cfg.query_queue
+        self._cond = threading.Condition()
+        self._queue: deque[QueryRequest] = deque()
+        self._stop = False
+        self.failed: BaseException | None = None
+        self._stats_lock = threading.Lock()
+        self._ticks = 0
+        self._dispatches = 0
+        self._requests = 0
+        self._batch_hist: dict[int, int] = {}
+        self._thread = threading.Thread(
+            target=self._loop, name="query-server", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ submit
+    def submit(self, text: str, k: int, *, rerank: bool = False) -> QueryRequest:
+        """Enqueue a query; blocks (backpressure) while ``queue_bound``
+        requests already wait. Returns a handle to ``wait()`` on."""
+        kind = "rerank" if rerank else "retrieve"
+        if rerank and self._pipe.reranker is None:
+            raise ValueError("pipeline has no reranker")
+        req = QueryRequest(kind, text, k)
+        with self._cond:
+            while (
+                len(self._queue) >= self.queue_bound
+                and not self._stop and self.failed is None
+            ):
+                self._cond.wait(timeout=0.1)
+            if self.failed is not None:
+                raise RuntimeError("query server failed") from self.failed
+            if self._stop:
+                raise RuntimeError("query server is shut down")
+            self._queue.append(req)
+            self._cond.notify_all()
+        return req
+
+    def query(self, text: str, k: int, *, rerank: bool = False,
+              timeout: float | None = 60.0):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(text, k, rerank=rerank).wait(timeout)
+
+    # -------------------------------------------------------------- loop
+    def _drain_tick(self) -> list[QueryRequest]:
+        """Block until work exists, then hold the tick window open so
+        concurrent arrivals coalesce; returns up to ``max_batch``."""
+        with self._cond:
+            while not self._queue and not self._stop:
+                self._cond.wait()
+            if self._stop and not self._queue:
+                return []
+            deadline = self._queue[0].submitted_at + self.tick_s
+            while (
+                len(self._queue) < self.max_batch and not self._stop
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+            batch = [
+                self._queue.popleft()
+                for _ in range(min(len(self._queue), self.max_batch))
+            ]
+            self._cond.notify_all()  # unblock backpressured submitters
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._drain_tick()
+            if not batch:
+                if self._stop:
+                    return
+                continue
+            try:
+                self._serve(batch)
+            except BaseException as exc:  # noqa: BLE001 - sweep to callers
+                now = time.monotonic()
+                for req in batch:
+                    req.error = exc
+                    req.finished_at = now
+                    req.done.set()
+                with self._cond:
+                    self.failed = exc
+                    self._stop = True
+                    pending = list(self._queue)
+                    self._queue.clear()
+                    self._cond.notify_all()
+                for req in pending:
+                    req.error = exc
+                    req.finished_at = now
+                    req.done.set()
+                return
+
+    def _serve(self, batch: list[QueryRequest]) -> None:
+        # one batched dispatch per (kind, k) group — requests for the same
+        # k share candidates semantics with the per-call path, so batching
+        # never changes a request's result
+        groups: dict[tuple[str, int], list[QueryRequest]] = {}
+        for req in batch:
+            groups.setdefault((req.kind, req.k), []).append(req)
+        for (kind, k), reqs in groups.items():
+            texts = [r.text for r in reqs]
+            if kind == "rerank":
+                results = self._pipe.retrieve_rerank_batch(texts, k)
+            else:
+                results = self._pipe.retrieve(texts, k)
+            now = time.monotonic()
+            for req, res in zip(reqs, results):
+                req.result = res
+                req.finished_at = now
+                req.done.set()
+        with self._stats_lock:
+            self._ticks += 1
+            self._dispatches += len(groups)
+            self._requests += len(batch)
+            n = len(batch)
+            self._batch_hist[n] = self._batch_hist.get(n, 0) + 1
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        with self._stats_lock:
+            ticks = self._ticks
+            reqs = self._requests
+            return {
+                "ticks": ticks,
+                "requests": reqs,
+                "dispatches": self._dispatches,
+                "batch_hist": dict(sorted(self._batch_hist.items())),
+                "mean_batch": round(reqs / ticks, 3) if ticks else 0.0,
+                "failed": self.failed is not None,
+            }
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        with self._cond:
+            pending = list(self._queue)
+            self._queue.clear()
+        for req in pending:
+            if not req.done.is_set():
+                req.error = RuntimeError("query server shut down")
+                req.finished_at = time.monotonic()
+                req.done.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

@@ -28,7 +28,12 @@ def rerank_topk_filter(
     ``rerank_topk_filter``, rerankers.py:15)."""
     if not docs:
         return [], []
-    order = np.argsort(scores)[::-1][:k]
+    # stable sort with original-index tie-break: the UDF declares
+    # deterministic=True, so tied scores must always resolve the same way
+    # (plain argsort reversed would also flip the order WITHIN ties)
+    order = np.argsort(
+        -np.asarray(scores, dtype=np.float64), kind="stable"
+    )[:k]
     docs_sorted = [docs[i] for i in order]
     scores_sorted = [float(scores[i]) for i in order]
     return docs_sorted, scores_sorted

@@ -38,9 +38,16 @@ def test_bench_smoke_schema():
         "engine_tax_ratio", "engine_stats", "join_e2e_rows_per_sec",
         "wordcount_rows_per_sec", "decoder_tokens_per_sec",
         "knn_recall_at_10", "rerank_p50_ms", "ivf_recall_at_10",
-        "ingest_bubbles", "serving",
+        "ingest_bubbles", "serving", "rerank_cascade_p50_ms",
+        "cascade_top8_overlap", "cascade_survivor_rate", "query_qps",
+        "query_p50_ms", "query_p95_ms", "query_batch_hist",
     ):
         assert s.get(key) is not None, key
+    # the query-serving phase ran under load: a survivor rate strictly
+    # inside (0, 1] and a non-empty tick batch histogram
+    assert 0.0 < s["cascade_survivor_rate"] <= 1.0
+    assert s["query_batch_hist"]
+    assert s["query_qps"] > 0
     bub = s["ingest_bubbles"]
     assert set(bub["pct"]) >= {"tokenize", "h2d", "dispatch", "compute"}
     # stage percentages + device-compute residual account for the wall

@@ -79,6 +79,91 @@ def test_dispatch_counters_global_and_per_op():
     assert probes.dispatch_counts() == {}
 
 
+def test_cascade_ledger_survivor_rate():
+    probes.reset_cascade_stats()
+    assert probes.cascade_stats()["survivor_rate"] == 1.0  # no cascade ran
+    probes.record_cascade("cheap", 32, flops=2e9)
+    probes.record_cascade("full", 8, flops=3e9)
+    probes.record_cascade("cheap", 32, flops=2e9)
+    probes.record_cascade("full", 8, flops=3e9)
+    s = probes.cascade_stats()
+    assert s["pairs"] == {"cheap": 64, "full": 16}
+    assert s["survivor_rate"] == pytest.approx(0.25)
+    assert s["gflops"]["cheap"] == pytest.approx(4.0)
+    probes.reset_cascade_stats()
+    assert probes.cascade_stats()["pairs"] == {}
+
+
+def test_fused_rerank_one_dispatch_per_cascade_tick():
+    """The fused retrieve-rerank path must stay ONE device dispatch per
+    call/tick — the cascade's cheap and full stages share that single
+    executable (survivor selection never returns to the host), so the
+    per-operator dispatch counters may move by exactly one kind, once,
+    per tick. Guards against silent dispatch regressions in the fused
+    path."""
+    import os
+
+    from pathway_tpu.models.cross_encoder import CrossEncoderModel
+    from pathway_tpu.models.embedder import SentenceEmbedderModel
+    from pathway_tpu.models.transformer import TransformerConfig
+    from pathway_tpu.ops.fused_query import FusedRAGPipeline
+    from pathway_tpu.ops.query_server import QueryServer
+
+    cfg = TransformerConfig(
+        vocab_size=2048, hidden=32, layers=2, heads=2, intermediate=64
+    )
+    emb = SentenceEmbedderModel(cfg=cfg, max_length=16)
+    rr = CrossEncoderModel(cfg=cfg, tokenizer=emb.tokenizer, max_length=64)
+    pipe = FusedRAGPipeline(emb, rr, reserved_space=32, doc_seq=16,
+                            pair_seq=48)
+    pipe.add([f"k{i}" for i in range(24)],
+             [f"doc {i} alpha beta gamma" for i in range(24)])
+    saved = {
+        v: os.environ.get(v)
+        for v in ("PATHWAY_TPU_RERANK_CASCADE",
+                  "PATHWAY_TPU_RERANK_CASCADE_DEPTH",
+                  "PATHWAY_TPU_RERANK_CASCADE_SURVIVORS")
+    }
+    try:
+        os.environ["PATHWAY_TPU_RERANK_CASCADE"] = "1"
+        os.environ["PATHWAY_TPU_RERANK_CASCADE_DEPTH"] = "1"
+        os.environ["PATHWAY_TPU_RERANK_CASCADE_SURVIVORS"] = "4"
+        pipe.retrieve_rerank("alpha beta", k=8)  # compile outside the count
+        probes.reset_dispatch_counts()
+        for i in range(3):
+            pipe.retrieve_rerank(f"alpha {i}", k=8)
+        counts = probes.dispatch_counts()
+        assert counts == {"fused_rerank_cascade": 3}
+
+        # a micro-batching tick dispatches once for the whole batch too
+        probes.reset_dispatch_counts()
+        with QueryServer(pipe, tick_ms=30.0, max_batch=8) as srv:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(4) as ex:
+                list(ex.map(
+                    lambda t: srv.query(t, 8, rerank=True),
+                    [f"beta {i}" for i in range(4)],
+                ))
+            stats = srv.stats()
+        counts = probes.dispatch_counts()
+        assert counts == {"fused_rerank_cascade": stats["dispatches"]}
+        assert stats["dispatches"] < stats["requests"]
+
+        # kill switch: still exactly one dispatch, on the full-depth kind
+        os.environ["PATHWAY_TPU_RERANK_CASCADE"] = "0"
+        pipe.retrieve_rerank("alpha beta", k=8)  # compile outside the count
+        probes.reset_dispatch_counts()
+        pipe.retrieve_rerank("gamma", k=8)
+        assert probes.dispatch_counts() == {"fused_retrieve_rerank": 1}
+    finally:
+        for var, val in saved.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
+
+
 def test_scheduler_stats_engine_tax_keys():
     st = probes.SchedulerStats()
     st.record_skip()

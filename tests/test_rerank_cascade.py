@@ -1,0 +1,219 @@
+"""Cascaded early-exit rerank + length-bucketed pair packing + batched
+fused rerank (``ops/fused_query.py``).
+
+Contracts under test:
+
+* kill switch: ``PATHWAY_TPU_RERANK_CASCADE=0`` (+ ``PAIR_BUCKETS=0``)
+  reproduces the pre-cascade fused kernel bitwise;
+* quality: cascade-on preserves >=0.9 mean top-8 overlap vs the full
+  rerank ordering on a seeded corpus;
+* batching: multi-query fused retrieve+rerank equals the per-query loop;
+* ``pad_to_buckets`` pads an optional types array whose padded tail rows
+  and cols carry mask 0 and type 0.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pathway_tpu.models.cross_encoder import CrossEncoderModel
+from pathway_tpu.models.embedder import SentenceEmbedderModel
+from pathway_tpu.models.transformer import TransformerConfig, encode
+from pathway_tpu.ops.fused_query import (
+    FusedRAGPipeline,
+    _fused_retrieve_rerank,
+)
+
+CFG = TransformerConfig(
+    vocab_size=4096, hidden=128, layers=4, heads=4, intermediate=256
+)
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    emb = SentenceEmbedderModel(cfg=CFG, max_length=32)
+    rr = CrossEncoderModel(cfg=CFG, tokenizer=emb.tokenizer, max_length=128)
+    p = FusedRAGPipeline(emb, rr, reserved_space=256, doc_seq=24, pair_seq=64)
+    rng = np.random.default_rng(3)
+    words = np.array([
+        "alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta",
+        "theta", "iota", "kappa", "mu", "nu", "stream", "index", "query",
+        "tensor",
+    ])
+    # varied doc lengths so length-bucketed packing is actually exercised
+    docs = [
+        " ".join(rng.choice(words, int(rng.integers(4, 21))))
+        for _ in range(256)
+    ]
+    p.add([f"k{i}" for i in range(256)], docs)
+    p.queries = [" ".join(rng.choice(words, 5)) for _ in range(10)]
+    return p
+
+
+def _cascade_env(monkeypatch, on: bool, depth=None, keep=None, seed_w=None):
+    monkeypatch.setenv("PATHWAY_TPU_RERANK_CASCADE", "1" if on else "0")
+    for var, v in (
+        ("PATHWAY_TPU_RERANK_CASCADE_DEPTH", depth),
+        ("PATHWAY_TPU_RERANK_CASCADE_SURVIVORS", keep),
+        ("PATHWAY_TPU_RERANK_SEED_WEIGHT", seed_w),
+    ):
+        if v is None:
+            monkeypatch.delenv(var, raising=False)
+        else:
+            monkeypatch.setenv(var, str(v))
+
+
+def test_cascade_off_bitwise_identical(pipe, monkeypatch):
+    """Both kill switches thrown -> the pipeline calls the UNTOUCHED
+    seed-era kernel with the full pair window: outputs must be bitwise
+    equal to invoking that kernel directly."""
+    _cascade_env(monkeypatch, on=False)
+    monkeypatch.setenv("PATHWAY_TPU_PAIR_BUCKETS", "0")
+    text, k = pipe.queries[0], 16
+    got = jax.device_get(pipe.retrieve_rerank_device(text, k))
+
+    ids, mask, _ = pipe._tokenize_queries(
+        [text],
+        max_length=min(pipe.embedder.max_length, pipe._rerank_q_budget),
+    )
+    want = jax.device_get(_fused_retrieve_rerank(
+        pipe.embedder.params, ids, mask, pipe.index._corpus,
+        pipe.index._valid, pipe._doc_tokens, pipe._doc_lens,
+        pipe.reranker.params, pipe.reranker.head,
+        pipe.embedder.cfg, pipe.reranker.cfg,
+        k, pipe.metric, pipe.pair_seq,
+    ))
+    for g, w in zip(got, want):
+        assert np.array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_encode_truncation_noop_at_full_depth():
+    """``n_layers=cfg.layers`` (and None) must not change the executable's
+    output — the truncated path only diverges when it actually truncates."""
+    rng = np.random.default_rng(0)
+    from pathway_tpu.models.transformer import init_params
+
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    ids = rng.integers(1, CFG.vocab_size, size=(2, 16)).astype(np.int32)
+    mask = np.ones((2, 16), dtype=np.int32)
+    full = np.asarray(encode(params, ids, mask, CFG))
+    again = np.asarray(encode(params, ids, mask, CFG, n_layers=CFG.layers))
+    assert np.array_equal(full, again)
+    trunc = np.asarray(encode(params, ids, mask, CFG, n_layers=1))
+    assert not np.array_equal(full, trunc)
+
+
+def test_pair_buckets_match_full_width(pipe, monkeypatch):
+    """Length-bucketed pair packing pads attention positions that carry
+    exactly-zero weight, so the ordering matches the full-width window."""
+    _cascade_env(monkeypatch, on=False)
+    monkeypatch.setenv("PATHWAY_TPU_PAIR_BUCKETS", "0")
+    wide = [pipe.retrieve_rerank(q, k=16) for q in pipe.queries[:4]]
+    monkeypatch.setenv("PATHWAY_TPU_PAIR_BUCKETS", "1")
+    bucketed = [pipe.retrieve_rerank(q, k=16) for q in pipe.queries[:4]]
+    for w, b in zip(wide, bucketed):
+        assert [key for key, _ in w] == [key for key, _ in b]
+        np.testing.assert_allclose(
+            [s for _, s in w], [s for _, s in b], rtol=0, atol=1e-4
+        )
+
+
+def test_cascade_overlap_top8(pipe, monkeypatch):
+    """Cascade-on preserves >=0.9 mean top-8 overlap vs full rerank. The
+    operating point (depth 3/4, 28/32 survivors) suits this random-init
+    model's noise-level score margins; pretrained checkpoints run much
+    shallower/harder cascades at the same fidelity."""
+    _cascade_env(monkeypatch, on=False)
+    full = [
+        [key for key, _ in pipe.retrieve_rerank(q, k=32)[:8]]
+        for q in pipe.queries
+    ]
+    _cascade_env(monkeypatch, on=True, depth=3, keep=28, seed_w=0.25)
+    overlaps = []
+    for q, want in zip(pipe.queries, full):
+        got = [key for key, _ in pipe.retrieve_rerank(q, k=32)[:8]]
+        overlaps.append(len(set(got) & set(want)) / 8.0)
+    assert sum(overlaps) / len(overlaps) >= 0.9, overlaps
+
+
+def test_cascade_result_shape_and_survivor_ranking(pipe, monkeypatch):
+    """Cascade output still returns all k candidates, with the survivor
+    prefix ordered by (full-depth) score."""
+    _cascade_env(monkeypatch, on=True, depth=2, keep=8)
+    out = pipe.retrieve_rerank(pipe.queries[1], k=16)
+    assert len(out) == 16
+    assert len({key for key, _ in out}) == 16
+    surv_scores = [s for _, s in out[:8]]
+    assert surv_scores == sorted(surv_scores, reverse=True)
+
+
+@pytest.mark.parametrize("cascade", [False, True])
+def test_batched_equals_per_query_loop(pipe, monkeypatch, cascade):
+    """One batched multi-query dispatch returns what the per-query loop
+    returns, cascaded or not."""
+    _cascade_env(monkeypatch, on=cascade, depth=2, keep=8)
+    texts = pipe.queries[:3]
+    batched = pipe.retrieve_rerank_batch(texts, k=16)
+    looped = [pipe.retrieve_rerank(t, k=16) for t in texts]
+    assert len(batched) == len(looped) == 3
+    for b, l in zip(batched, looped):
+        assert [key for key, _ in b] == [key for key, _ in l]
+        np.testing.assert_allclose(
+            [s for _, s in b], [s for _, s in l], rtol=0, atol=1e-4
+        )
+
+
+def test_pad_to_buckets_pads_types():
+    """Padded tail rows AND cols must carry mask 0 and type 0 so segment
+    embeddings stay inert on padding."""
+    from pathway_tpu.models.tokenizer import pad_to_buckets
+
+    ids = np.ones((5, 13), dtype=np.int32)
+    mask = np.ones((5, 13), dtype=np.int32)
+    types = np.ones((5, 13), dtype=np.int32)
+    pids, pmask, ptypes = pad_to_buckets(ids, mask, types)
+    assert pids.shape == pmask.shape == ptypes.shape == (8, 16)
+    assert pmask[5:].sum() == 0 and pmask[:, 13:].sum() == 0
+    assert ptypes[5:].sum() == 0 and ptypes[:, 13:].sum() == 0
+    assert pids[5:].sum() == 0 and pids[:, 13:].sum() == 0
+    # original block preserved
+    assert ptypes[:5, :13].all() and pmask[:5, :13].all()
+    # two-array form still returns two
+    assert len(pad_to_buckets(ids, mask)) == 2
+
+
+def test_query_server_coalesces_and_matches_direct(pipe, monkeypatch):
+    """Concurrent submissions coalesce into shared ticks and every request
+    gets exactly the per-call path's answer."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pathway_tpu.ops.query_server import QueryServer
+
+    _cascade_env(monkeypatch, on=False)
+    texts = pipe.queries[:6]
+    direct = {t: pipe.retrieve_rerank(t, k=8) for t in texts}
+    with QueryServer(pipe, tick_ms=20.0, max_batch=8) as srv:
+        srv.query(texts[0], 8, rerank=True)  # warm the 1-row bucket
+        with ThreadPoolExecutor(6) as ex:
+            served = list(
+                ex.map(lambda t: srv.query(t, 8, rerank=True), texts)
+            )
+        stats = srv.stats()
+    for t, got in zip(texts, served):
+        assert [key for key, _ in got] == [key for key, _ in direct[t]]
+    assert stats["requests"] == 7
+    # the 6-wide burst shared ticks: fewer dispatches than requests
+    assert stats["dispatches"] < stats["requests"]
+    assert max(stats["batch_hist"]) > 1
+
+
+def test_query_server_backpressure_and_shutdown(pipe, monkeypatch):
+    from pathway_tpu.ops.query_server import QueryServer
+
+    _cascade_env(monkeypatch, on=False)
+    srv = QueryServer(pipe, tick_ms=1.0, max_batch=4, queue_bound=2)
+    assert srv.query(pipe.queries[0], 4, rerank=True)
+    srv.shutdown()
+    with pytest.raises(RuntimeError):
+        srv.submit(pipe.queries[0], 4)

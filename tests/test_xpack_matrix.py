@@ -206,6 +206,22 @@ def test_rerank_topk_filter_sorts_and_truncates():
     assert list(kept_scores) == [0.9, 0.7]
 
 
+def test_rerank_topk_filter_stable_on_ties():
+    """deterministic=True contract: tied scores must break by ORIGINAL
+    index, every call — plain reversed argsort flips order within ties."""
+    from pathway_tpu.xpacks.llm.rerankers import rerank_topk_filter
+
+    docs = [pw.Json({"text": f"d{i}"}) for i in range(6)]
+    scores = [0.5, 0.9, 0.5, 0.9, 0.5, 0.1]
+    kept_docs, kept_scores = rerank_topk_filter.__wrapped__(docs, scores, k=5)
+    assert list(kept_scores) == [0.9, 0.9, 0.5, 0.5, 0.5]
+    # ties resolve in ascending original order: d1 before d3, d0<d2<d4
+    names = [d["text"].value for d in kept_docs]
+    assert names == ["d1", "d3", "d0", "d2", "d4"]
+    again_docs, _ = rerank_topk_filter.__wrapped__(list(docs), list(scores), k=5)
+    assert [d["text"].value for d in again_docs] == names
+
+
 def test_encoder_reranker_cosine():
     from pathway_tpu.xpacks.llm.rerankers import EncoderReranker
 
