@@ -162,7 +162,7 @@ class PathwayConfig:
     @property
     def rerank_cascade_survivors(self) -> int:
         """Candidates surviving into the full-depth pass (0 = auto:
-        ``max(8, k // 4)`` clamped to k)."""
+        ``max(8, k // 2)`` clamped to k)."""
         return max(
             0, int(os.environ.get("PATHWAY_TPU_RERANK_CASCADE_SURVIVORS", "0"))
         )

@@ -236,7 +236,10 @@ def _fused_retrieve_rerank_cascade(e_params, q_ids, q_mask, corpus, valid,
     # survivors first, ranked by full-depth score; the cascaded-out rest
     # follow in cheap-score order
     surv_sorted = jnp.take_along_axis(surv, jnp.argsort(-full, axis=1), axis=1)
-    rest = cheap.at[rows, surv].set(_NEG_INF)
+    # survivor slots drop to -inf, STRICTLY below the _NEG_INF of padded
+    # candidates — otherwise (live docs < keep) they tie and the argsort
+    # re-includes survivor indices, so ``order`` stops being a permutation
+    rest = cheap.at[rows, surv].set(-jnp.inf)
     rest_order = jnp.argsort(-rest, axis=1)                   # survivors last
     order = jnp.concatenate([surv_sorted, rest_order[:, : k - keep]], axis=1)
     return scores, idx, r_scores, order

@@ -148,6 +148,30 @@ def test_cascade_result_shape_and_survivor_ranking(pipe, monkeypatch):
     assert surv_scores == sorted(surv_scores, reverse=True)
 
 
+def test_cascade_small_corpus_no_duplicates(monkeypatch):
+    """Live docs < keep: padded candidates enter the survivor set, so the
+    rest-order argsort must rank survivor placeholders strictly below the
+    ``_NEG_INF`` padding — otherwise ``order`` stops being a permutation
+    and every document is emitted twice."""
+    emb = SentenceEmbedderModel(cfg=CFG, max_length=32)
+    rr = CrossEncoderModel(cfg=CFG, tokenizer=emb.tokenizer, max_length=128)
+    p = FusedRAGPipeline(emb, rr, reserved_space=32, doc_seq=24, pair_seq=64)
+    docs = [
+        "alpha beta", "gamma delta", "epsilon zeta", "eta theta",
+        "iota kappa",
+    ]
+    p.add([f"k{i}" for i in range(5)], docs)
+    _cascade_env(monkeypatch, on=True)  # auto keep = max(8, k//2) > 5 live
+    out = p.retrieve_rerank("alpha query", k=32)
+    keys = [key for key, _ in out]
+    assert len(keys) == len(set(keys)), keys
+    assert set(keys) == {f"k{i}" for i in range(5)}
+    # the batched kernel shares the same order construction — keep it honest
+    for row in p.retrieve_rerank_batch(["alpha query", "gamma query"], k=32):
+        rk = [key for key, _ in row]
+        assert len(rk) == len(set(rk)) == 5, rk
+
+
 @pytest.mark.parametrize("cascade", [False, True])
 def test_batched_equals_per_query_loop(pipe, monkeypatch, cascade):
     """One batched multi-query dispatch returns what the per-query loop
