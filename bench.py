@@ -53,11 +53,13 @@ BASELINE_DOCS_PER_SEC = A100_MINILM_DOCS_PER_SEC * NORTH_STAR_MULTIPLIER
 
 BATCH = 256
 SEQ = 128
-# 128-batch windows: the final drain pays one full tunnel round trip
-# (~110ms measured) regardless of window length, so short windows
-# under-report the sustained rate — at 24 batches the fixed tail alone
-# cost ~25% of the measurement. 32k docs/window amortizes it below 2%.
-N_BATCHES = 128
+# 288-batch windows (~74k docs): the final drain pays one full tunnel
+# round trip (~110ms measured) regardless of window length, so short
+# windows under-report the sustained rate — at 24 batches the fixed tail
+# alone cost ~25% of the measurement. Beyond amortizing it (<1%), the
+# window must also run >= 3 s of wall at the ~23k docs/s headline rate so
+# the number is a *sustained* figure, not a burst over a sub-second burst.
+N_BATCHES = 288
 N_REPS = 4
 QUERY_EVERY = 4
 TOP_K = 10
@@ -300,6 +302,8 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
         window_rates.append(round(rate, 1))
         if rate > docs_per_sec:
             docs_per_sec, bubbles = rate, attr
+    win_docs = BATCH * n_batches
+    window_elapsed_s = win_docs / max(docs_per_sec, 1e-9)
 
     # kernels-only comparison windows: same shapes, tokenization hoisted
     # out. Each rep uses a FRESH doc range (the bench invariant: identical
@@ -339,7 +343,6 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
         return batches * param_bytes + activations
 
     roofline = RooflineModel(peak_flops=V5E_PEAK_BF16)
-    win_docs = BATCH * n_batches
     roofline.add(
         "ingest",
         seconds=win_docs / max(docs_per_sec, 1e-9),
@@ -362,11 +365,26 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
             bytes_moved=ingest_bytes(win_docs, SEQ),
             dispatches=n_batches,
         )
+    # bf16-MXU roofline ceiling for this exact workload shape: the best
+    # wall the chip PHYSICALLY allows given the accounted FLOPs + HBM
+    # bytes, the bound that binds first, and how much of the measured wall
+    # sits ABOVE that bound (the closable bubble). "MFU >= 40% or the
+    # ceiling math in the record" — this is the ceiling math.
+    from pathway_tpu.engine.probes import roofline_ceiling
+
+    ceiling = roofline_ceiling(
+        flops=win_docs * flops_per_doc(cfg, SEQ),
+        bytes_moved=ingest_bytes(win_docs, SEQ),
+        wall_s=window_elapsed_s,
+    )
+    diag(phase="ingest_roofline_ceiling", **ceiling)
     breakdown = {
         "metric": "ingest_mfu_pct",
         "value": round(mfu * 100, 1),
         "unit": "%",
         "detail": {
+            "docs": win_docs,
+            "elapsed_s": round(window_elapsed_s, 3),
             "embed_single_roundtrip_ms": round(single_rtt * 1000, 1),
             "embed_only_docs_per_sec": round(embed_rate, 1),
             "window_docs_per_sec": window_rates,
@@ -374,6 +392,7 @@ def headline(jax, jnp, cfg, params, embed_fn, BruteForceKnnIndex) -> tuple[float
             "flops_per_doc_g": round(flops_per_doc(cfg, SEQ) / 1e9, 2),
             "tokenizer": "wordpiece (native C++, HF-parity)",
             "roofline": roofline.summary(),
+            "ceiling": ceiling,
             "bubble_attribution": bubbles,
             "kernels_only_bubble_attribution": kernel_bubbles,
         },
@@ -420,12 +439,27 @@ def config2_recall_and_latency(jax, cfg) -> tuple[dict, "object", list[str]]:
     q_v = embed_f32(q_texts)
     truth = np.argsort(-(q_v @ corpus_v.T), axis=1)[:, :TOP_K]
 
-    res = pipe.retrieve(q_texts, k=TOP_K)  # compiles the 64-query bucket
-    hits = 0
-    for qi, row in enumerate(res):
-        got = {int(key[1:]) for key, _ in row}
-        hits += len(got & set(truth[qi].tolist()))
-    recall = hits / (nq * TOP_K)
+    def measure_recall():
+        res = pipe.retrieve(q_texts, k=TOP_K)  # compiles the 64-q bucket
+        hits = 0
+        for qi, row in enumerate(res):
+            got = {int(key[1:]) for key, _ in row}
+            hits += len(got & set(truth[qi].tolist()))
+        return hits / (nq * TOP_K)
+
+    recall = measure_recall()
+
+    # second arm: PATHWAY_TPU_KNN_F32_SCORES scoring (f32 operands for the
+    # corpus gemm instead of the bf16 MXU fast path). The knob is read by
+    # BruteForceKnnIndex at construction; flipping the instance attribute
+    # re-measures on the SAME corpus (the bf16-stored vectors upcast in
+    # kernel), which is exactly what the env var changes at init time.
+    saved_f32 = pipe.index.f32_scores
+    try:
+        pipe.index.f32_scores = True
+        recall_f32 = measure_recall()
+    finally:
+        pipe.index.f32_scores = saved_f32
 
     pipe.retrieve([q_texts[0]], k=TOP_K)  # compiles the 1-query bucket
     lat = []
@@ -434,13 +468,20 @@ def config2_recall_and_latency(jax, cfg) -> tuple[dict, "object", list[str]]:
         pipe.retrieve([q_texts[(qi + 1) % nq]], k=TOP_K)
         lat.append(time.perf_counter() - t0)
     p50 = statistics.median(lat) * 1000
-    diag(phase="config2", recall_at_10=recall, retrieve_p50_ms=round(p50, 1))
+    diag(
+        phase="config2",
+        recall_at_10=recall,
+        recall_at_10_f32_scores=recall_f32,
+        retrieve_p50_ms=round(p50, 1),
+    )
     return {
         "metric": "knn_recall_at_10",
         "value": round(recall, 4),
         "unit": "recall",
         "detail": {
             "corpus": n,
+            "recall_at_10_f32_scores": round(recall_f32, 4),
+            "f32_scores_env": "PATHWAY_TPU_KNN_F32_SCORES",
             "retrieve_p50_ms": round(p50, 1),
             "pipeline": "fused text->embed->topk (1 dispatch)",
         },
@@ -718,14 +759,14 @@ def config4_streaming_engine() -> dict:
         id: int
         text: str
 
-    def one_rep() -> dict:
+    def one_rep(embed_udf) -> dict:
         pw.clear_graph()
         broker = InMemoryKafkaBroker()
         for p in payloads:
             broker.produce("docs", p)
         broker.close()
         docs = pw.io.kafka.read(broker, topic="docs", schema=DocSchema)
-        embedded = docs.select(docs.id, vec=embedder(docs.text))
+        embedded = docs.select(docs.id, vec=embed_udf(docs.text))
 
         from pathway_tpu.stdlib.indexing import BruteForceKnn, DataIndex
 
@@ -748,7 +789,7 @@ def config4_streaming_engine() -> dict:
                 {"qtext": ["alpha stream tensor", "delta index beta"]}
             )
         )
-        q_emb = queries.select(qvec=embedder(queries.qtext))
+        q_emb = queries.select(qvec=embed_udf(queries.qtext))
         res = index.query_as_of_now(q_emb.qvec, number_of_matches=TOP_K)
         n_results = []
         pw.io.subscribe(
@@ -801,9 +842,25 @@ def config4_streaming_engine() -> dict:
         gc.collect()  # free the rep's 150MB device corpus before the next
         return out
 
-    reps = [one_rep() for _ in range(max(1, N_REPEATS))]
+    reps = [one_rep(embedder) for _ in range(max(1, N_REPEATS))]
     rates = [r["rate"] for r in reps]
     med, spread = _median_and_spread(rates)
+
+    # default-mode comparison: the SAME engine pipeline with the stock
+    # synchronous UDF executor (deferred=False), so the record carries the
+    # out-of-the-box number alongside the deferred-mode headline. The
+    # model instance (and its jitted executables) is shared; the first
+    # rep absorbs any executor-path compile, the second is the measurement.
+    embedder_default = SentenceTransformerEmbedder(
+        model=embedder.model,
+        max_batch_size=256 if _smoke() else 1024,
+        deferred=False,
+    )
+    default_reps = [
+        one_rep(embedder_default) for _ in range(1 if _smoke() else 2)
+    ]
+    default_rate = max(r["rate"] for r in default_reps)
+    default_elapsed = min(r["elapsed"] for r in default_reps)
 
     # engine-side ingest roofline: same accounting as the headline's, at
     # the stream's seq bucket — the MFU the ENGINE path sustains
@@ -824,6 +881,7 @@ def config4_streaming_engine() -> dict:
     diag(
         phase="config4",
         streaming_docs_per_sec=round(med, 1),
+        default_mode_docs_per_sec=round(default_rate, 1),
         windows=[round(r, 1) for r in rates],
         spread_pct=round(spread, 1),
         window_seconds=[round(r["elapsed"], 2) for r in reps],
@@ -835,10 +893,16 @@ def config4_streaming_engine() -> dict:
         "value": round(med, 1),
         "unit": "docs/s",
         "detail": {
+            "docs": N_DOCS,
+            "elapsed_s": round(
+                statistics.median([r["elapsed"] for r in reps]), 3
+            ),
             "docs_per_window": N_DOCS,
             "windows_docs_per_sec": [round(r, 1) for r in rates],
             "window_seconds": [round(r["elapsed"], 2) for r in reps],
             "spread_pct": round(spread, 1),
+            "default_mode_docs_per_sec": round(default_rate, 1),
+            "default_mode_elapsed_s": round(default_elapsed, 3),
             "live_query_results": reps[-1]["query_results"],
             "engine": reps[-1]["engine"],
             "pipeline_stages": reps[-1]["pipeline_stages"],
@@ -1192,6 +1256,165 @@ def config5_ivf_recall_latency(cfg) -> dict:
     }
 
 
+def config5_sharded() -> dict:
+    """Pod-sharded IVF at >=1M rows/shard x 8 shards (ISSUE 4 satellite
+    3): ``ShardedIvfIndex.add_bulk`` over the dp mesh — water-filled
+    per-shard quotas, one chunked centroid gemm per shard, build-time
+    k-means, and the all-gather top-k merge on search. On the driver this
+    phase runs in a fresh subprocess pinned to the virtual 8-device CPU
+    mesh (JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count=8):
+    the relayed single chip cannot host 8 independent shards, and the
+    satellite's claim is the sharded build/search PATH at pod row counts,
+    not chip speed. If host memory binds before the 1M-rows/shard design
+    point the ladder steps down 1M -> 512k -> 256k and ``bound_by``
+    records which limit bound first."""
+    import gc
+
+    import jax
+
+    from pathway_tpu.parallel import ShardedIvfIndex, make_mesh
+
+    t_phase = time.perf_counter()
+    mesh = make_mesh(tp=1)
+    dp = int(mesh.shape["dp"])
+    d = 384
+    rng = np.random.default_rng(7)
+    n_centers = 512
+    centers = rng.standard_normal((n_centers, d)).astype(np.float32) * 0.5
+
+    design_rows = 1 << 20
+    if _smoke():
+        ladder = [2048]
+        N_CELLS, NPROBE, CAP, TRAIN = 16, 4, 256, 512
+        gen_chunk, nq = 4096, 8
+    else:
+        target = int(
+            os.environ.get("PATHWAY_BENCH_SHARD_ROWS", str(design_rows))
+        )
+        ladder = [target, target // 2, target // 4]
+        N_CELLS, NPROBE, CAP, TRAIN = 1024, 32, 2048, 8192
+        gen_chunk, nq = 1 << 19, 64
+
+    queries = (
+        centers[rng.integers(0, n_centers, nq)]
+        + rng.standard_normal((nq, d)).astype(np.float32)
+    )
+    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+
+    detail: dict = {}
+    for rows_per_shard in ladder:
+        n = rows_per_shard * dp
+        idx = None
+        try:
+            t_build = time.perf_counter()
+            idx = ShardedIvfIndex(
+                mesh, dimensions=d, n_cells=N_CELLS, nprobe=NPROBE,
+                cell_capacity=CAP, metric="cos", train_after=TRAIN,
+            )
+            # streaming build: generate a chunk, bulk-insert it, fold it
+            # into the running exact top-k truth, free it — the full
+            # corpus (8M x 384 f32 = 12.3 GB) never materializes at once
+            best_sc = np.full((nq, TOP_K), -np.inf, np.float32)
+            best_id = np.full((nq, TOP_K), -1, np.int64)
+            crng = np.random.default_rng(11)
+            for s in range(0, n, gen_chunk):
+                m = min(gen_chunk, n - s)
+                chunk = (
+                    centers[crng.integers(0, n_centers, m)]
+                    + crng.standard_normal((m, d)).astype(np.float32)
+                )
+                chunk /= np.linalg.norm(chunk, axis=1, keepdims=True)
+                idx.add_bulk(list(range(s, s + m)), chunk)
+                sims = queries @ chunk.T
+                part = np.argpartition(
+                    -sims, TOP_K - 1, axis=1
+                )[:, :TOP_K]
+                cat_sc = np.concatenate(
+                    [best_sc, np.take_along_axis(sims, part, axis=1)],
+                    axis=1,
+                )
+                cat_id = np.concatenate([best_id, part + s], axis=1)
+                keep = np.argpartition(
+                    -cat_sc, TOP_K - 1, axis=1
+                )[:, :TOP_K]
+                best_sc = np.take_along_axis(cat_sc, keep, axis=1)
+                best_id = np.take_along_axis(cat_id, keep, axis=1)
+                del chunk, sims
+                if (s // gen_chunk) % 4 == 0:
+                    diag(
+                        phase="config5_sharded_build", rows_done=s + m,
+                        rows_total=n,
+                        s=round(time.perf_counter() - t_build, 1),
+                    )
+            build_s = time.perf_counter() - t_build
+            truth_sets = [set(row.tolist()) for row in best_id]
+
+            res = idx.search(queries, k=TOP_K)
+            hits = sum(
+                len({key for key, _ in row} & truth_sets[qi])
+                for qi, row in enumerate(res)
+            )
+            recall = hits / (nq * TOP_K)
+            lat = []
+            for qi in range(5):
+                t0 = time.perf_counter()
+                idx.search(queries[qi % nq][None, :], k=TOP_K)
+                lat.append(time.perf_counter() - t0)
+            reps = 1 if _smoke() else 4
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                idx.search(queries, k=TOP_K)
+            qps_b = reps * nq / (time.perf_counter() - t0)
+            detail = {
+                "shards": dp,
+                "rows_per_shard": rows_per_shard,
+                "rows_total": n,
+                "n_cells_per_shard": N_CELLS,
+                "nprobe": NPROBE,
+                "build_s": round(build_s, 1),
+                "build_rows_per_sec": round(n / max(build_s, 1e-9), 1),
+                "recall_at_10": round(recall, 4),
+                "p50_ms": round(statistics.median(lat) * 1000, 1),
+                "qps_batch": round(qps_b, 1),
+                "backend": jax.default_backend(),
+                "bound_by": (
+                    "none: >=1M rows/shard design point met"
+                    if rows_per_shard >= design_rows
+                    else (
+                        "smoke shapes"
+                        if _smoke()
+                        else "host CPU memory: ladder stepped down from "
+                        f"{ladder[0]} rows/shard"
+                    )
+                ),
+                "elapsed_s": round(time.perf_counter() - t_phase, 1),
+            }
+            diag(phase="config5_sharded", **detail)
+            break
+        except Exception as exc:  # noqa: BLE001 - try the next scale down
+            diag(
+                warning="config5_sharded_failed", rows_per_shard=rows_per_shard,
+                error=repr(exc),
+            )
+            detail = {
+                "error": repr(exc),
+                "rows_per_shard": rows_per_shard,
+                "elapsed_s": round(time.perf_counter() - t_phase, 1),
+            }
+            idx = None  # noqa: F841 - release the failed attempt's state
+            exc = None
+            gc.collect()
+        finally:
+            idx = None
+            gc.collect()
+    return {
+        "metric": "sharded_ivf_build_rows",
+        "value": detail.get("rows_total", 0),
+        "unit": "rows",
+        "detail": detail,
+    }
+
+
 def config_join_streaming() -> dict:
     """Streaming inner join through the FULL engine (kafka -> join ->
     select -> subscribe): orders x users on user id, 200k orders against
@@ -1206,7 +1429,9 @@ def config_join_streaming() -> dict:
 
     pw.clear_graph()
     rng = np.random.default_rng(21)
-    n_orders, n_users = (2_000, 200) if _smoke() else (200_000, 20_000)
+    # 400k orders: >= 3 s of engine wall at the observed e2e join rate
+    # (sustained-window policy — no headline number off a sub-second run)
+    n_orders, n_users = (2_000, 200) if _smoke() else (400_000, 20_000)
     broker = InMemoryKafkaBroker()
     uids = rng.integers(0, n_users, n_orders)
     for i in range(n_orders):
@@ -1327,6 +1552,8 @@ def config_join_streaming() -> dict:
         "detail": {
             "orders": n_orders,
             "users": n_users,
+            "rows": len(out),
+            "elapsed_s": round(el, 3),
             "pipeline": "kafka -> inner join -> select -> subscribe",
             "hotkey_single_insert_deltas_per_sec": round(n_ins / hot_el, 1),
             "hotkey_bucket_rows": B,
@@ -1356,9 +1583,11 @@ def config_wordcount_streaming() -> dict:
 
     import pathway_tpu as pw
 
+    # 4M rows: >= 3 s of wall at the observed ~1.3M rows/s, so the figure
+    # is sustained, not a sub-second burst
     n_rows = int(
         os.environ.get(
-            "PATHWAY_BENCH_WC_ROWS", "20000" if _smoke() else "1600000"
+            "PATHWAY_BENCH_WC_ROWS", "20000" if _smoke() else "4000000"
         )
     )
     n_files = 16
@@ -1446,6 +1675,9 @@ def config_wordcount_streaming() -> dict:
         "unit": "rows/s",
         "detail": {
             "rows": reps[-1]["rows"],
+            "elapsed_s": round(
+                statistics.median([r["elapsed"] for r in reps]), 3
+            ),
             "files": n_files,
             "distinct_words": reps[-1]["distinct_words"],
             "windows_rows_per_sec": [round(r, 1) for r in rates],
@@ -1629,12 +1861,130 @@ def config_decoder_generate() -> dict:
     }
 
 
+def _serving_rest_arm(chat, NREQ, prompts, arrivals) -> dict:
+    """Play a Poisson request trace through the PRODUCT path: each request
+    is an HTTP POST to ``/v1/pw_ai_answer`` on a ``QARestServer`` wrapping
+    ``BaseRAGQuestionAnswerer.answer_query``, so the measured wall includes
+    the REST connector, the engine dataflow, retrieval, prompt build and
+    the chat UDF — not a bare model loop. ``chat`` decides the serving
+    regime: a plain (sync-executor) instance is batch-static — arrivals
+    during an in-flight generation wait for the epoch to finish; a
+    ``continuous=True, deferred=True`` instance admits into the in-flight
+    decode at chunk boundaries while the engine pump keeps draining new
+    arrivals."""
+    import threading
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals.json import Json
+    from pathway_tpu.xpacks.llm.question_answering import (
+        BaseRAGQuestionAnswerer,
+        send_post_request,
+    )
+    from pathway_tpu.xpacks.llm.servers import QARestServer
+
+    class _StaticDocsIndexer:
+        """Minimal DocumentStore stand-in: a fixed context per query. The
+        serving bench measures LLM admission dynamics; retrieval is a
+        constant-cost context source so both arms pay it identically."""
+
+        def retrieve_query(self, queries):
+            @pw.udf
+            def _docs(query: str, k: int) -> Json:
+                return Json(
+                    [{"text": f"context {i}: {query[:24]}"} for i in range(k)]
+                )
+
+            return queries.select(result=_docs(pw.this.query, pw.this.k))
+
+        def statistics_query(self, queries):
+            @pw.udf
+            def _stats() -> Json:
+                return Json({"file_count": 1})
+
+            return queries.select(result=_stats())
+
+        def inputs_query(self, queries):
+            @pw.udf
+            def _inputs(metadata_filter, filepath_globpattern) -> Json:
+                return Json([])
+
+            return queries.select(
+                result=_inputs(
+                    pw.this.metadata_filter, pw.this.filepath_globpattern
+                )
+            )
+
+    pw.clear_graph()
+    qa = BaseRAGQuestionAnswerer(
+        llm=chat, indexer=_StaticDocsIndexer(), search_topk=2
+    )
+    server = QARestServer("127.0.0.1", 0, qa)
+    server.run(threaded=True)
+    server.webserver._started.wait(timeout=60)
+    url = f"http://127.0.0.1:{server.webserver.port}/v1/pw_ai_answer"
+    try:
+        # warm round trip: compiles the REST-path prompt bucket (the RAG
+        # template pushes every prompt to the max_prompt_tokens cap) end
+        # to end before the timed trace
+        send_post_request(url, {"prompt": "w" * 200}, timeout=900)
+        done = [0.0] * NREQ
+        chars = [0] * NREQ
+        errs: list = []
+
+        def fire(k: int) -> None:
+            try:
+                r = send_post_request(
+                    url, {"prompt": prompts[k]}, timeout=900
+                )
+                chars[k] = len(str((r or {}).get("response") or ""))
+            except Exception as exc:  # noqa: BLE001 - recorded, not fatal
+                errs.append(repr(exc))
+            done[k] = time.perf_counter() - t0
+
+        threads = []
+        t0 = time.perf_counter()
+        for k in range(NREQ):
+            now = time.perf_counter() - t0
+            if arrivals[k] > now:
+                time.sleep(arrivals[k] - now)
+            th = threading.Thread(target=fire, args=(k,), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=900)
+        wall = max(max(done), 1e-9)
+        lat_ms = [
+            max(done[k] - arrivals[k], 0.0) * 1000.0 for k in range(NREQ)
+        ]
+        out = {
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
+            "p95_ms": round(float(np.percentile(lat_ms, 95)), 1),
+            # 1-char/token bench tokenizer: answer length IS the generated
+            # token count, so this is useful tokens through the full path
+            "useful_tokens": int(sum(chars)),
+            "useful_tokens_per_sec": round(sum(chars) / wall, 1),
+            "wall_s": round(wall, 2),
+            "n_requests": NREQ,
+            "n_errors": len(errs),
+        }
+        if errs:
+            out["first_error"] = errs[0]
+        return out
+    finally:
+        for c in pw.G.connectors:
+            c._stop.set()
+            c.close()
+        if server._thread is not None:
+            server._thread.join(timeout=60)
+
+
 def _decoder_serving_compare(params, cfg) -> dict:
-    """Poisson-arrival serving comparison through ``TPUDecoderChat``:
-    the same trace is played against a batch-static instance (arrivals
-    during an in-flight generation wait for it, then run as one batch)
-    and a continuous one (slot-pool admission at chunk boundaries).
-    Reports per-request p50/p95 latency and sustained tokens/s."""
+    """Poisson-arrival serving comparison through ``TPUDecoderChat``,
+    measured on the PRODUCT path: both arms play the same trace through
+    ``BaseRAGQuestionAnswerer.answer_query`` behind a live REST server
+    (``_serving_rest_arm``), batch-static vs continuous chunk-boundary
+    admission. The bare direct-API comparison (per-request budgets, no
+    engine around it) is retained under ``direct_api``."""
     from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
 
     class _Tok:
@@ -1720,9 +2070,12 @@ def _decoder_serving_compare(params, cfg) -> dict:
     static = stats(lat, time.perf_counter() - t0)
 
     # ---- continuous: submit on arrival with per-request budgets; slots
-    # free at each lane's own budget and admit mid-flight
+    # free at each lane's own budget and admit mid-flight. deferred=True
+    # also puts the UDF on the engine's fully-async executor, so the SAME
+    # instance serves the REST arm below with the pump overlapping decode.
     chat_c = TPUDecoderChat(**common, continuous=True, n_slots=N_SLOTS,
-                            chunk_steps=CHUNK, pipeline_depth=DEPTH)
+                            chunk_steps=CHUNK, pipeline_depth=DEPTH,
+                            deferred=True)
     try:
         # warm the trace's (single) prompt bucket plus the chunk
         # executable, with enough rows to exercise full-pool cycling
@@ -1757,35 +2110,115 @@ def _decoder_serving_compare(params, cfg) -> dict:
             srv.stats["slot_steps_total"] - warm_stats["slot_steps_total"]
         )
         cont["occupancy"] = round(d_steps / max(d_total, 1), 4)
+
+        # ---- REST product-path arms: the same Poisson discipline, but
+        # every request is an HTTP POST through answer_query. Budgets are
+        # uniform (the product API carries no per-request max_new), so the
+        # arms differ ONLY in admission dynamics — which is the claim
+        # under test. Longer trace: the wall must be a sustained multi-
+        # second window, not a burst.
+        if _smoke():
+            NREQ_REST, LAM_REST = 6, 20.0
+        else:
+            NREQ_REST, LAM_REST = 256, 100.0
+        rng_rest = np.random.default_rng(43)
+        arrivals_rest = np.cumsum(
+            rng_rest.exponential(1.0 / LAM_REST, NREQ_REST)
+        )
+        prompts_rest = [
+            "req " + "x" * int(rng_rest.integers(13, 28))
+            for _ in range(NREQ_REST)
+        ]
+
+        # static REST instance: its own executable cache, so warm the
+        # REST-path shapes (prompt cap bucket x pow2 row buckets at the
+        # constructor depth) before the timed trace. max_batch_size caps
+        # the per-epoch batch exactly like the direct arm's BATCH_CAP.
+        chat_s_rest = TPUDecoderChat(**common, max_batch_size=BATCH_CAP)
+        for b in warm_batches:
+            chat_s_rest.__wrapped__(["w" * 200] * b)
+        rest_static = _serving_rest_arm(
+            chat_s_rest, NREQ_REST, prompts_rest, arrivals_rest
+        )
+
+        # continuous REST arm reuses chat_c (server already warm); only
+        # the REST-path prompt bucket needs one warm pass
+        chat_c.resolve_batch([chat_c.submit_batch(["w" * 200] * WARM_ROWS)])
+        rest_warm_stats = dict(srv.stats)
+        rest_cont = _serving_rest_arm(
+            chat_c, NREQ_REST, prompts_rest, arrivals_rest
+        )
+        rest_cont["chunks"] = srv.stats["chunks"] - rest_warm_stats["chunks"]
+        rest_cont["admitted"] = (
+            srv.stats["admitted"] - rest_warm_stats["admitted"]
+        )
+        r_steps = srv.stats["steps"] - rest_warm_stats["steps"]
+        r_total = (
+            srv.stats["slot_steps_total"]
+            - rest_warm_stats["slot_steps_total"]
+        )
+        rest_cont["occupancy"] = round(r_steps / max(r_total, 1), 4)
     finally:
         chat_c.close()
     return {
-        "poisson_lambda_req_per_s": LAM,
-        "n_requests": NREQ,
-        "budgets": (
-            f"uniform {MINNEW}..{MAXNEW} new tokens per request"
+        # headline figures come from the REST product path
+        "poisson_lambda_req_per_s": LAM_REST,
+        "n_requests": NREQ_REST,
+        "budgets": f"uniform {MAXNEW} new tokens per request (REST arms)",
+        "measured_path": (
+            "HTTP POST /v1/pw_ai_answer -> QARestServer -> "
+            "BaseRAGQuestionAnswerer.answer_query -> retrieve -> prompt "
+            "-> TPUDecoderChat UDF"
         ),
-        "batch_static": static,
-        "continuous": cont,
+        "batch_static": rest_static,
+        "continuous": rest_cont,
         "throughput_x": round(
-            cont["useful_tokens_per_sec"]
-            / max(static["useful_tokens_per_sec"], 1e-9), 2
+            rest_cont["useful_tokens_per_sec"]
+            / max(rest_static["useful_tokens_per_sec"], 1e-9), 2
         ),
-        "p50_x": round(static["p50_ms"] / max(cont["p50_ms"], 1e-9), 2),
+        "p50_x": round(
+            rest_static["p50_ms"] / max(rest_cont["p50_ms"], 1e-9), 2
+        ),
+        # bare-model comparison (per-request budgets, no engine): kept for
+        # continuity with the r4/r5 records
+        "direct_api": {
+            "poisson_lambda_req_per_s": LAM,
+            "n_requests": NREQ,
+            "budgets": (
+                f"uniform {MINNEW}..{MAXNEW} new tokens per request"
+            ),
+            "batch_static": static,
+            "continuous": cont,
+            "throughput_x": round(
+                cont["useful_tokens_per_sec"]
+                / max(static["useful_tokens_per_sec"], 1e-9), 2
+            ),
+            "p50_x": round(
+                static["p50_ms"] / max(cont["p50_ms"], 1e-9), 2
+            ),
+        },
     }
 
 
-def _run_phase_subprocess(name: str, timeout_s: int = 1800) -> dict:
+def _run_phase_subprocess(name: str, timeout_s: int = 1800,
+                          env: dict | None = None) -> dict:
     """Run one bench phase in a fresh process (clean HBM heap) and return
     its metric dict; stderr diagnostics are forwarded — including on
-    timeout, so a killed phase still shows how far it got."""
+    timeout, so a killed phase still shows how far it got. ``env``
+    entries overlay the inherited environment (used to pin the sharded
+    phase onto the virtual 8-device CPU mesh)."""
     import subprocess
 
+    run_env = None
+    if env:
+        run_env = dict(os.environ)
+        run_env.update(env)
     try:
         p = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--phase", name],
             capture_output=True, text=True, timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=run_env,
         )
     except subprocess.TimeoutExpired as exc:
         if exc.stderr:
@@ -1814,6 +2247,7 @@ def run_single_phase(name: str) -> None:
     fns = {
         "config4": config4_streaming_engine,
         "config5": lambda: config5_ivf_recall_latency(MINILM_L6),
+        "config5_sharded": config5_sharded,
         "join": config_join_streaming,
         "wordcount": config_wordcount_streaming,
         "decoder": config_decoder_generate,
@@ -1885,6 +2319,7 @@ def main() -> None:
         # startup would dominate the run
         phase_fns = (
             ("config5", lambda: config5_ivf_recall_latency(cfg)),
+            ("config5_sharded", config5_sharded),
             ("join", config_join_streaming),
             ("wordcount", config_wordcount_streaming),
             ("decoder", config_decoder_generate),
@@ -1898,12 +2333,25 @@ def main() -> None:
                     error=repr(exc),
                 )
     else:
-        for phase, budget in (
-            ("config5", 2400), ("join", 1200), ("wordcount", 900),
-            ("decoder", 1800),
+        # the sharded-IVF phase wants 8 devices; the relayed chip has
+        # one, so its subprocess is pinned to the virtual CPU mesh (the
+        # same topology the tier-1 suite runs on)
+        cpu8_env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip(),
+        }
+        for phase, budget, env in (
+            ("config5", 2400, None), ("join", 1200, None),
+            ("wordcount", 900, None), ("decoder", 1800, None),
+            ("config5_sharded", 2400, cpu8_env),
         ):
             try:
-                extra.append(_run_phase_subprocess(phase, timeout_s=budget))
+                extra.append(
+                    _run_phase_subprocess(phase, timeout_s=budget, env=env)
+                )
             except Exception as exc:  # noqa: BLE001 - must not sink headline
                 diag(
                     warning="extra_metric_failed", which=phase,
@@ -1953,10 +2401,21 @@ def main() -> None:
             "continuous_tok_s": (serving_det.get("continuous") or {}).get(
                 "useful_tokens_per_sec"
             ),
+            "measured_path": serving_det.get("measured_path"),
+            "direct_api_throughput_x": (
+                serving_det.get("direct_api") or {}
+            ).get("throughput_x"),
+            "direct_api_p50_x": (
+                serving_det.get("direct_api") or {}
+            ).get("p50_x"),
         }
         if serving_det and "error" not in serving_det
         else serving_det or None
     )
+    c4_detail = config4.get("detail") or {}
+    shiv = _m("sharded_ivf_build_rows")
+    ceiling = headline_detail.get("ceiling") or {}
+    wc = _m("wordcount_streaming_rows_per_sec")
     summary = {
         "metric": "rag_ingest_embed_index_docs_per_sec",
         "value": round(docs_per_sec, 1),
@@ -1965,26 +2424,47 @@ def main() -> None:
         "summary": {
             "ingest_mfu_pct": mfu_metric.get("value"),
             "ingest_roofline": headline_detail.get("roofline"),
+            "ingest_docs": headline_detail.get("docs"),
+            "ingest_elapsed_s": headline_detail.get("elapsed_s"),
+            "ingest_ceiling": {
+                k: ceiling.get(k)
+                for k in (
+                    "bound", "arith_intensity", "ridge_intensity",
+                    "ceiling_mfu_pct", "attained_of_ceiling_pct",
+                    "overhead_above_bound_s",
+                )
+                if k in ceiling
+            },
             "config4_engine_docs_per_sec": c4_val,
-            "config4_spread_pct": (config4.get("detail") or {}).get(
-                "spread_pct"
+            "config4_default_docs_per_sec": c4_detail.get(
+                "default_mode_docs_per_sec"
             ),
+            "config4_docs": c4_detail.get("docs"),
+            "config4_elapsed_s": c4_detail.get("elapsed_s"),
+            "config4_spread_pct": c4_detail.get("spread_pct"),
             "engine_tax_ratio": tax_ratio,
-            "engine_stats": (config4.get("detail") or {}).get("engine"),
+            "engine_stats": c4_detail.get("engine"),
             "join_e2e_rows_per_sec": join.get("value"),
+            "join_rows": (join.get("detail") or {}).get("rows"),
+            "join_elapsed_s": (join.get("detail") or {}).get("elapsed_s"),
             "join_hotkey_deltas_per_sec": (join.get("detail") or {}).get(
                 "hotkey_single_insert_deltas_per_sec"
             ),
             "join_mixed_retraction_rows_per_sec": (
                 join.get("detail") or {}
             ).get("mixed_retraction_rows_per_sec"),
-            "wordcount_rows_per_sec": _m(
-                "wordcount_streaming_rows_per_sec"
-            ).get("value"),
+            "wordcount_rows_per_sec": wc.get("value"),
+            "wordcount_rows": (wc.get("detail") or {}).get("rows"),
+            "wordcount_elapsed_s": (wc.get("detail") or {}).get(
+                "elapsed_s"
+            ),
             "decoder_tokens_per_sec": dec.get("value"),
             "ingest_bubbles": headline_detail.get("bubble_attribution"),
             "serving": serving_summary,
             "knn_recall_at_10": _m("knn_recall_at_10").get("value"),
+            "knn_recall_at_10_f32": (
+                _m("knn_recall_at_10").get("detail") or {}
+            ).get("recall_at_10_f32_scores"),
             "rerank_p50_ms": _m("rerank_stage_p50_ms").get("value"),
             "rerank_cascade_p50_ms": (
                 _m("rerank_stage_p50_ms").get("detail") or {}
@@ -2016,6 +2496,27 @@ def main() -> None:
                 )
                 if k in big
             },
+            "ivf_xl_16M": (
+                {
+                    k: (big.get("xl_16M") or {}).get(k)
+                    for k in (
+                        "corpus", "recall_at_10_vs_exact",
+                        "ivf_qps_batch64", "error",
+                    )
+                    if k in (big.get("xl_16M") or {})
+                }
+                if not _smoke()
+                else {"skipped": "smoke: big tiers not run"}
+            ),
+            "sharded_ivf": {
+                k: (shiv.get("detail") or {}).get(k)
+                for k in (
+                    "shards", "rows_per_shard", "rows_total", "build_s",
+                    "build_rows_per_sec", "recall_at_10", "p50_ms",
+                    "qps_batch", "bound_by", "elapsed_s", "error",
+                )
+                if k in (shiv.get("detail") or {})
+            },
         },
     }
     print(json.dumps(summary), flush=True)
@@ -2035,12 +2536,21 @@ def main() -> None:
         srv = s.get("serving") or {}
         for k in (
             "throughput_x", "p50_x", "occupancy", "static_tok_s",
-            "continuous_tok_s",
+            "continuous_tok_s", "measured_path",
+            "direct_api_throughput_x", "direct_api_p50_x",
         ):
             _chk(f"summary.serving.{k}", srv.get(k))
         bub = s.get("ingest_bubbles") or {}
         for k in ("wall_s", "stages_s", "pct"):
             _chk(f"summary.ingest_bubbles.{k}", bub.get(k))
+        ceil = s.get("ingest_ceiling") or {}
+        for k in ("bound", "ceiling_mfu_pct", "attained_of_ceiling_pct"):
+            _chk(f"summary.ingest_ceiling.{k}", ceil.get(k))
+        sh = s.get("sharded_ivf") or {}
+        for k in (
+            "shards", "rows_total", "build_s", "recall_at_10", "elapsed_s",
+        ):
+            _chk(f"summary.sharded_ivf.{k}", sh.get(k))
         if missing:
             raise SystemExit(
                 "smoke schema check FAILED; missing/empty: "

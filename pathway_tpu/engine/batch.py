@@ -33,7 +33,7 @@ def _native_rows_split():
 class Batch:
     """A set of keyed row deltas at a single logical time."""
 
-    __slots__ = ("keys", "cols", "diffs")
+    __slots__ = ("keys", "cols", "diffs", "_consolidated")
 
     def __init__(
         self,
@@ -47,6 +47,13 @@ class Batch:
         if diffs is None:
             diffs = np.ones(len(keys), dtype=np.int64)
         self.diffs = np.asarray(diffs, dtype=np.int64)
+        # True once a consolidate() proved this batch single-sign with
+        # all-distinct keys. That invariant survives row subsetting and any
+        # column transform (keys/diffs untouched), so downstream operators
+        # inherit it through take/with_cols/... and their consolidate pass
+        # is O(1) instead of a per-epoch np.unique sort over the spine
+        # (gated by PATHWAY_TPU_EPOCH_CLOSEOUT at the consumer).
+        self._consolidated = False
 
     def __len__(self) -> int:
         return len(self.keys)
@@ -81,27 +88,37 @@ class Batch:
             idx = np.nonzero(mask_or_idx)[0]
         else:
             idx = mask_or_idx
-        return Batch(
+        out = Batch(
             self.keys[idx],
             {n: c[idx] for n, c in self.cols.items()},
             self.diffs[idx],
         )
+        out._consolidated = self._consolidated  # subset of distinct keys
+        return out
 
     def with_cols(self, cols: dict[str, np.ndarray]) -> "Batch":
-        return Batch(self.keys, cols, self.diffs)
+        out = Batch(self.keys, cols, self.diffs)
+        out._consolidated = self._consolidated  # keys/diffs untouched
+        return out
 
     def rename(self, mapping: Mapping[str, str]) -> "Batch":
-        return Batch(
+        out = Batch(
             self.keys,
             {mapping.get(n, n): c for n, c in self.cols.items()},
             self.diffs,
         )
+        out._consolidated = self._consolidated
+        return out
 
     def select_cols(self, names: list[str]) -> "Batch":
-        return Batch(self.keys, {n: self.cols[n] for n in names}, self.diffs)
+        out = Batch(self.keys, {n: self.cols[n] for n in names}, self.diffs)
+        out._consolidated = self._consolidated
+        return out
 
     def negate(self) -> "Batch":
-        return Batch(self.keys, self.cols, -self.diffs)
+        out = Batch(self.keys, self.cols, -self.diffs)
+        out._consolidated = self._consolidated  # sign flip stays single-sign
+        return out
 
     @staticmethod
     def empty(column_names: Iterable[str]) -> "Batch":
@@ -177,6 +194,15 @@ def consolidate(batch: Batch | None) -> Batch | None:
     """Sum diffs of identical (key, row) pairs; drop zero-diff rows."""
     if batch is None or len(batch) == 0:
         return None
+    # a producer already proved this batch single-sign with distinct keys
+    # (the invariant column transforms preserve) — skip even the sort-based
+    # uniqueness re-check, which otherwise repeats at EVERY node of the
+    # operator spine per epoch
+    if batch._consolidated:
+        from pathway_tpu.internals import config as config_mod
+
+        if config_mod.pathway_config.epoch_closeout:
+            return batch
     # insert-only (or retract-only) batch with all-distinct keys: identical
     # (key, row) pairs are impossible, so skip the per-row content hashing —
     # the common shape of every bulk-ingest commit, where hashing wide
@@ -185,6 +211,7 @@ def consolidate(batch: Batch | None) -> Batch | None:
     if (diffs.min() > 0 or diffs.max() < 0) and len(
         np.unique(batch.keys)
     ) == len(batch):
+        batch._consolidated = True
         return batch
     rh = row_hashes(batch)
     native = _get_native_consolidate()

@@ -244,13 +244,51 @@ class RowwiseNode(Node):
                     # computing chunk i+1 — the whole point of deferral.
                     # (One resolve per chunk costs a fixed dispatch RTT
                     # each; measured well under the overlap it buys.)
+                    #
+                    # Coalescing (PATHWAY_TPU_DRAIN_COALESCE, default on):
+                    # when the scheduler already has injected epochs
+                    # WAITING, per-chunk injection only multiplies epochs —
+                    # each one pays the full downstream spine + close-out
+                    # sweep — without buying any extra overlap. So resolved
+                    # chunks accumulate into ONE columnar batch (one engine
+                    # epoch) until the engine runs dry or the group cap is
+                    # hit; a hungry engine still gets every chunk
+                    # immediately, so the kill switch only matters when the
+                    # engine, not the device, is the bottleneck.
+                    from pathway_tpu.internals import config as config_mod
+
+                    group_max = (
+                        config_mod.pathway_config.drain_coalesce_max
+                        if config_mod.pathway_config.drain_coalesce
+                        else 1
+                    )
                     expr, out, handles = pending[0]
                     emitted = np.zeros(len(keys), dtype=bool)
+                    group: list[np.ndarray] = []
                     for idx, h in handles:
                         finish_apply_chunks(expr, out, [(idx, h)])
                         sel = np.asarray(idx, dtype=np.int64)
                         emitted[sel] = True
-                        self._inject_rows(sched, keys, diffs, out_cols, sel)
+                        group.append(sel)
+                        if (
+                            len(group) >= group_max
+                            or sched.pending_backlog() == 0
+                        ):
+                            merged = (
+                                group[0] if len(group) == 1
+                                else np.concatenate(group)
+                            )
+                            self._inject_rows(
+                                sched, keys, diffs, out_cols, merged
+                            )
+                            kick_heartbeats()
+                            group = []
+                    if group:
+                        merged = (
+                            group[0] if len(group) == 1
+                            else np.concatenate(group)
+                        )
+                        self._inject_rows(sched, keys, diffs, out_cols, merged)
                         kick_heartbeats()
                     rest = np.nonzero(~emitted)[0]
                     if len(rest):

@@ -51,24 +51,46 @@ def maybe_terminate_on_error(batch) -> None:
 
     if not config_mod.pathway_config.terminate_on_error:
         return
-    for _key, row, _diff in batch.rows():
-        if any(v is ERROR for v in row):
-            from pathway_tpu.internals.errors import (
-                EngineError,
-                get_global_error_log,
-            )
+    # column-major scan: dense numeric columns can never hold the ERROR
+    # sentinel (an object) and are skipped whole — the per-row tuple walk
+    # paid a Python-level pass over every cell of every output batch
+    found = False
+    for col in batch.cols.values():
+        if col.dtype != object:
+            continue
+        if any(v is ERROR for v in col.tolist()):
+            found = True
+            break
+    if found:
+        from pathway_tpu.internals.errors import (
+            EngineError,
+            get_global_error_log,
+        )
 
-            entries = get_global_error_log().entries
-            detail = entries[-1]["message"] if entries else "ERROR value"
-            raise EngineError(
-                f"error value reached output table ({detail}); set "
-                "terminate_on_error=False or use pw.fill_error(...) to "
-                "tolerate it"
-            )
+        entries = get_global_error_log().entries
+        detail = entries[-1]["message"] if entries else "ERROR value"
+        raise EngineError(
+            f"error value reached output table ({detail}); set "
+            "terminate_on_error=False or use pw.fill_error(...) to "
+            "tolerate it"
+        )
 
 
 class SubscribeNode(Node):
-    """Calls back per delta row, per time flush and at end-of-stream."""
+    """Calls back per delta row, per time flush and at end-of-stream.
+
+    With ``PATHWAY_TPU_COLUMNAR_SUBSCRIBE`` (default on) the per-row
+    formatting — Pointer wrapping, dict packaging, the skip-errors scan —
+    runs on a per-node background formatter thread fed one columnar
+    ``(time, batch)`` block per epoch (the reference's per-batch output
+    formatter threads, dataflow.rs:3579-3617). The scheduler thread's cost
+    per epoch drops to one queue put; per-row callback ORDER is unchanged
+    because one thread drains blocks in epoch order. ``on_time_end`` /
+    ``on_end`` callbacks ride the same queue, so their ordering relative
+    to row callbacks is also preserved; :meth:`finish` (called by the
+    runner before ``pw.run`` returns) flushes the queue, so every callback
+    lands before the run completes. A callback exception is re-raised on
+    the engine thread at the next step or at finish."""
 
     _persist_exempt = True
 
@@ -88,32 +110,112 @@ class SubscribeNode(Node):
         self.on_end_cb = on_end
         self.skip_errors = skip_errors
         self._saw_data_at: int | None = None
+        from pathway_tpu.internals import config as config_mod
+
+        # read once at build time: flipping mid-run would interleave
+        # inline and queued callbacks out of order
+        self._columnar = (
+            config_mod.pathway_config.columnar_subscribe
+            and on_change is not None
+        )
+        self._fmt_queue = None
+        self._fmt_thread = None
+        self._fmt_error: BaseException | None = None
+
+    def _format_rows(self, time, batch) -> None:
+        from pathway_tpu.engine.value import ERROR, Pointer
+
+        names = self.column_names
+        on_change = self.on_change
+        skip = self.skip_errors
+        for key, row, diff in batch.rows():
+            if skip and any(v is ERROR for v in row):
+                continue
+            on_change(Pointer(key), dict(zip(names, row)), time, diff > 0)
+
+    # ---- background formatter ------------------------------------------
+    def _ensure_formatter(self):
+        import queue
+        import threading
+
+        if self._fmt_thread is None or not self._fmt_thread.is_alive():
+            self._fmt_queue = queue.Queue()
+            self._fmt_thread = threading.Thread(
+                target=self._fmt_loop,
+                args=(self._fmt_queue,),
+                daemon=True,
+                name=f"pathway:subscribe:{self.name}",
+            )
+            self._fmt_thread.start()
+        return self._fmt_queue
+
+    def _fmt_loop(self, q):
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            kind, time, batch = item
+            try:
+                if kind == 0:
+                    self._format_rows(time, batch)
+                else:
+                    self.on_time_end_cb(time)
+            except BaseException as exc:  # noqa: BLE001 - re-raised on engine
+                self._fmt_error = exc
+                return
+
+    def _raise_if_failed(self):
+        if self._fmt_error is not None:
+            exc, self._fmt_error = self._fmt_error, None
+            self._fmt_thread = None
+            self._fmt_queue = None
+            raise exc
+
+    def _flush_formatter(self):
+        """Join the formatter so every queued callback has run."""
+        t = self._fmt_thread
+        if t is not None and t.is_alive():
+            self._fmt_queue.put(None)
+            t.join()
+        self._fmt_thread = None
+        self._fmt_queue = None
+        self._raise_if_failed()
 
     def step(self, time, ins):
         (batch,) = ins
         self._saw_data_at = time
         if batch is not None and len(batch) > 0 and self.on_change is not None:
-            from pathway_tpu.engine.value import ERROR, Pointer
-
-            for key, row, diff in batch.rows():
-                if self.skip_errors and any(v is ERROR for v in row):
-                    continue
-                self.on_change(
-                    Pointer(key),
-                    dict(zip(self.column_names, row)),
-                    time,
-                    diff > 0,
-                )
+            if self._columnar:
+                self._raise_if_failed()
+                self._ensure_formatter().put((0, time, batch))
+            else:
+                self._format_rows(time, batch)
         return batch
 
     def on_time_end(self, time):
         if self.on_time_end_cb is not None:
-            self.on_time_end_cb(time)
+            if self._columnar and self._fmt_thread is not None:
+                self._raise_if_failed()
+                self._fmt_queue.put((1, time, None))
+            else:
+                self.on_time_end_cb(time)
         return []
 
     def finish(self):
+        self._flush_formatter()
         if self.on_end_cb is not None:
             self.on_end_cb()
+
+    def reset(self):
+        # drop the previous run's formatter (and any error it died on):
+        # engine graphs are re-runnable and a stale thread must not leak
+        t = self._fmt_thread
+        if t is not None and t.is_alive():
+            self._fmt_queue.put(None)
+            t.join(timeout=5)
+        self._fmt_thread = None
+        self._fmt_queue = None
+        self._fmt_error = None
 
 
 class SinkNode(Node):

@@ -180,6 +180,46 @@ def bubble_attribution(wall_s: float, stages: dict[str, float] | None = None) ->
 # roofline model
 
 
+def roofline_ceiling(
+    flops: float,
+    bytes_moved: float,
+    *,
+    wall_s: float | None = None,
+    peak_flops: float = V5E_PEAK_BF16_FLOPS,
+    peak_bytes: float = V5E_PEAK_HBM_BYTES,
+) -> dict:
+    """The roofline-implied CEILING for a workload, not just its score.
+
+    ``max(flops/peak_flops, bytes/peak_bw)`` is the hard lower bound on
+    device time; ``ceiling_mfu_pct`` is the best MFU the workload can post
+    even at 100% hardware efficiency — below 100 exactly when the shape is
+    bandwidth-bound (arithmetic intensity under the ridge point). Pass the
+    observed ``wall_s`` to also get the attainment split: how much of the
+    wall is the unavoidable bound vs overhead above it. This turns "MFU is
+    34%" into either "the ceiling itself is 41% — we are at 83% of
+    attainable" or "the ceiling is 95% — the other 60% is ours to close".
+    """
+    t_compute = flops / peak_flops
+    t_memory = bytes_moved / peak_bytes
+    t_lb = max(t_compute, t_memory, 1e-12)
+    out: dict = {
+        "flops_time_s": round(t_compute, 6),
+        "memory_time_s": round(t_memory, 6),
+        "bound_time_s": round(t_lb, 6),
+        "bound": "compute" if t_compute >= t_memory else "memory",
+        "arith_intensity": round(flops / max(bytes_moved, 1.0), 2),
+        "ridge_intensity": round(peak_flops / peak_bytes, 2),
+        "ceiling_mfu_pct": round(100.0 * t_compute / t_lb, 2),
+        "ceiling_hbm_pct": round(100.0 * t_memory / t_lb, 2),
+    }
+    if wall_s is not None:
+        wall = max(wall_s, 1e-12)
+        out["wall_s"] = round(wall_s, 6)
+        out["attained_of_ceiling_pct"] = round(100.0 * t_lb / wall, 2)
+        out["overhead_above_bound_s"] = round(max(0.0, wall_s - t_lb), 6)
+    return out
+
+
 @dataclasses.dataclass
 class PhaseRoofline:
     """Accumulated work of one pipeline phase (e.g. ``ingest``, ``query``)."""

@@ -57,6 +57,19 @@ class Scheduler:
         if fuse:
             self.order, self.fused_chains = fuse_chains(self.order, targets)
         self._order_ids = {n.id for n in self.order}
+        # close-out cut: the end-of-epoch on_time_end sweep only has work
+        # at nodes that OVERRIDE the hook (buffers, subscribes); for
+        # everything else the base impl returns [] — broadcasting the
+        # frontier to the whole order was pure per-epoch overhead on
+        # streaming graphs that pump one small commit per epoch.
+        # PATHWAY_TPU_EPOCH_CLOSEOUT=0 restores the full sweep.
+        if config_mod.pathway_config.epoch_closeout:
+            self._sweep_nodes = [
+                n for n in self.order
+                if type(n).on_time_end is not Node.on_time_end
+            ]
+        else:
+            self._sweep_nodes = list(self.order)
         # PATHWAY_THREADS > 1: step independent operators (same topo level)
         # concurrently — the in-process analog of the reference's worker
         # threads. numpy/jax kernels release the GIL, so dense operators
@@ -122,6 +135,14 @@ class Scheduler:
         with self._lock:
             self._pending[time][node.id].append(batch)
             self._lock.notify_all()
+
+    def pending_backlog(self) -> int:
+        """How many injected epoch times wait to be pumped. A cheap peek
+        for asynchronous producers (the deferred-UDF drainer) deciding
+        whether the engine is hungry (0 -> inject now) or behind
+        (>0 -> keep coalescing); approximate by design."""
+        with self._lock:
+            return len(self._pending)
 
     def async_begin(self) -> None:
         with self._lock:
@@ -344,7 +365,7 @@ class Scheduler:
             for node in self.order:
                 self._step_node(node, t, outputs, injected)
         # epoch complete: notify operators; collect late emissions
-        for node in self.order:
+        for node in self._sweep_nodes:
             for future_t, batch in node.on_time_end(t):
                 assert future_t > t, f"{node} emitted at non-future time {future_t}"
                 self.inject(node, future_t, batch)

@@ -29,6 +29,43 @@ flip them per-run):
   the token drain ``pipeline_depth`` chunks later.
 * PATHWAY_TPU_KNN_F32_SCORES (default off) — score KNN with f32 operands
   instead of the bf16 MXU fast path (``ops/knn.py``).
+* PATHWAY_TPU_FUSED_H2D (default on) — the ingest pipeline ships ids+mask
+  to the device as ONE stacked transfer instead of two
+  (``models/embedder.py``); ``0`` restores split transfers.
+
+Engine close-out knobs (``engine/scheduler.py`` / ``engine/operators``):
+
+* PATHWAY_TPU_COLUMNAR_SUBSCRIBE (default on) — subscribe sinks format
+  per-row callbacks on a background formatter thread, one columnar block
+  per epoch, instead of row-by-row on the scheduler thread
+  (``engine/operators/output.py``); ``0`` restores inline formatting.
+* PATHWAY_TPU_DRAIN_COALESCE (default on) — the deferred-UDF drainer
+  merges consecutively-resolved chunks into ONE injected batch whenever
+  the scheduler still has a backlog, so a drain costs one engine epoch
+  per coalesced group instead of one per chunk
+  (``engine/operators/core.py``); ``0`` restores per-chunk injection.
+* PATHWAY_TPU_DRAIN_COALESCE_MAX (default 8) — most chunks merged into
+  one injection (bounds added latency when the engine stays busy).
+* PATHWAY_TPU_EPOCH_CLOSEOUT (default on) — epoch close-out cuts: the
+  end-of-epoch ``on_time_end`` sweep only visits nodes that override the
+  hook, and batches a producer already proved consolidated skip the
+  re-consolidate scan downstream; ``0`` restores the full sweep + scans.
+
+Serving-admission knobs (``xpacks/llm/llms.py`` / ``models/decoder.py``):
+
+* PATHWAY_TPU_BATCH_ADMIT (default on) — same-bucket queued requests
+  admit into free slots in ONE grouped prefill dispatch
+  (``pool_admit_batch``) instead of one dispatch per request; ``0``
+  restores per-request admission.
+* PATHWAY_TPU_PREFILL_OVERLAP (default on) — the serving loop dispatches
+  the in-flight decode chunk FIRST, then admits/prefills newcomers while
+  the device decodes (they join the next chunk); ``0`` restores
+  admit-then-decode ordering.
+* PATHWAY_TPU_CHUNK_AUTOTUNE (default on) — the serving loop shrinks the
+  decode-chunk step count (halving, floor 4) while requests queue, so
+  chunk boundaries (= admission opportunities and drain points) come
+  sooner under load, and restores the full chunk when the queue is
+  empty; ``0`` pins the constructor's ``chunk_steps``.
 
 Query-path knobs (``ops/fused_query.py`` / ``ops/query_server.py``):
 
@@ -199,6 +236,60 @@ class PathwayConfig:
         """Query-server admission bound; ``submit`` blocks once this many
         requests wait (backpressure, mirrors the ingest pipeline queue)."""
         return max(1, int(os.environ.get("PATHWAY_TPU_QUERY_QUEUE", "256")))
+
+    @property
+    def fused_h2d(self) -> bool:
+        """Ship ids+mask to the device as one stacked transfer instead of
+        two per-array transfers (halves per-batch h2d latency overhead)."""
+        return _env_bool("PATHWAY_TPU_FUSED_H2D", True)
+
+    @property
+    def columnar_subscribe(self) -> bool:
+        """Subscribe sinks format per-row callbacks on a background
+        formatter thread, one columnar block per epoch, so the scheduler
+        thread never pays the per-row dict/Pointer packaging. The kill
+        switch ``PATHWAY_TPU_COLUMNAR_SUBSCRIBE=0`` restores inline
+        row-by-row formatting on the scheduler thread."""
+        return _env_bool("PATHWAY_TPU_COLUMNAR_SUBSCRIBE", True)
+
+    @property
+    def drain_coalesce(self) -> bool:
+        """Deferred-UDF drain coalescing: merge consecutively-resolved
+        chunks into one injected batch while the scheduler has a backlog
+        (one engine epoch per group, not per chunk)."""
+        return _env_bool("PATHWAY_TPU_DRAIN_COALESCE", True)
+
+    @property
+    def drain_coalesce_max(self) -> int:
+        """Most resolved chunks merged into one drain injection."""
+        return max(
+            1, int(os.environ.get("PATHWAY_TPU_DRAIN_COALESCE_MAX", "8"))
+        )
+
+    @property
+    def epoch_closeout(self) -> bool:
+        """Epoch close-out cuts: sweep ``on_time_end`` only over nodes
+        that override it, and skip re-consolidating batches a producer
+        already proved consolidated."""
+        return _env_bool("PATHWAY_TPU_EPOCH_CLOSEOUT", True)
+
+    @property
+    def batch_admit(self) -> bool:
+        """Group same-bucket queued requests into one ``pool_admit_batch``
+        prefill dispatch at admission time."""
+        return _env_bool("PATHWAY_TPU_BATCH_ADMIT", True)
+
+    @property
+    def prefill_overlap(self) -> bool:
+        """Dispatch the decode chunk before admission prefills each serving
+        tick, so newcomer prefill work overlaps the in-flight decode."""
+        return _env_bool("PATHWAY_TPU_PREFILL_OVERLAP", True)
+
+    @property
+    def chunk_autotune(self) -> bool:
+        """Auto-shrink decode-chunk steps while requests queue (halving,
+        floor 4) so admission/drain boundaries come sooner under load."""
+        return _env_bool("PATHWAY_TPU_CHUNK_AUTOTUNE", True)
 
     @property
     def knn_f32_scores(self) -> bool:

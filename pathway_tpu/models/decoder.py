@@ -464,6 +464,36 @@ def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,
             "pos": pos, "write": write}
 
 
+def pool_admit_batch(params: dict, ids: jax.Array, mask: jax.Array,
+                     pool: dict, slots: jax.Array,
+                     cfg: DecoderConfig) -> dict:
+    """Prefill M left-padded prompts (``ids``/``mask`` shaped (M, S)) and
+    install them in ``slots`` (M distinct slot indices) in ONE dispatch.
+
+    Row-wise identical to M calls of :func:`pool_admit` — prompts are
+    independent through the causal forward, and the per-row cache/mask/
+    cursor scatters touch disjoint slots — but the M prefill matmuls batch
+    into one kernel and the M dispatches collapse into one, so a burst of
+    same-bucket arrivals costs one admission RTT instead of M
+    (``PATHWAY_TPU_BATCH_ADMIT``). jit per (M, prompt-bucket);
+    ``slots`` is traced."""
+    C = pool["k"].shape[3]
+    M, S = ids.shape
+    last_logits, cache = prefill(params, ids, mask, cfg, cache_len=C)
+    k = pool["k"].at[:, slots].set(cache["k"].astype(pool["k"].dtype))
+    v = pool["v"].at[:, slots].set(cache["v"].astype(pool["v"].dtype))
+    row_mask = jnp.concatenate(
+        [mask.astype(jnp.int32), jnp.zeros((M, C - S), jnp.int32)], axis=1
+    )
+    slot_mask = pool["slot_mask"].at[slots].set(row_mask)
+    logits = pool["logits"].at[slots].set(last_logits)
+    n_prompt = jnp.sum(mask, axis=1).astype(jnp.int32)  # (M,)
+    pos = pool["pos"].at[slots].set(n_prompt)
+    write = pool["write"].at[slots].set(jnp.full((M,), S, jnp.int32))
+    return {"k": k, "v": v, "logits": logits, "slot_mask": slot_mask,
+            "pos": pos, "write": write}
+
+
 def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
                        pos: jax.Array, pool: dict, slot: jax.Array,
                        start: jax.Array, n_prompt: jax.Array,

@@ -93,6 +93,17 @@ def _embed_fn_donated(params, input_ids, attention_mask, cfg: TransformerConfig)
     return embed_fn(params, input_ids, attention_mask, cfg)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _embed_fn_packed(params, packed, cfg: TransformerConfig):
+    """Fused-transfer variant: ``packed`` is ``stack([ids, mask])`` moved as
+    ONE contiguous ``device_put``. Two small transfers per batch each pay a
+    fixed runtime/transport overhead (on a relayed v5e the per-transfer
+    setup dominates at seq-32 batch sizes); halving the transfer count
+    takes the h2d stage off the per-batch critical path. The split back
+    into ids/mask happens inside the executable, where it is free."""
+    return embed_fn(params, packed[0], packed[1], cfg)
+
+
 class _PendingEmbed:
     """Handle returned by the pipelined ``embed_submit``: tokenize and
     dispatch run on background stage workers; :meth:`wait` blocks until
@@ -157,15 +168,26 @@ class _IngestPipeline:
         self._dispatch.submit((ids, mask, len(texts), handle))
 
     def _dispatch_one(self, item) -> None:
+        from pathway_tpu.internals.config import pathway_config
+
         ids, mask, n, handle = item
         try:
             model = self._model
+            fused = pathway_config.fused_h2d
             t0 = time.perf_counter()
-            dev_ids = jax.device_put(ids)
-            dev_mask = jax.device_put(mask)
+            if fused:
+                # one contiguous transfer instead of two (ids and mask are
+                # both int32, so the stack is a cheap host-side copy)
+                dev_packed = jax.device_put(np.stack((ids, mask)))
+            else:
+                dev_ids = jax.device_put(ids)
+                dev_mask = jax.device_put(mask)
             t1 = time.perf_counter()
             record_stage("h2d", t1 - t0)
-            out = _embed_fn_donated(model.params, dev_ids, dev_mask, model.cfg)
+            if fused:
+                out = _embed_fn_packed(model.params, dev_packed, model.cfg)
+            else:
+                out = _embed_fn_donated(model.params, dev_ids, dev_mask, model.cfg)
             record_device_dispatch("embed_dispatch")
             out = out.astype(jnp.float16)
             try:

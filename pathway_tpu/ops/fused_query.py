@@ -48,14 +48,17 @@ def _encoder_flops(cfg: TransformerConfig, seq: int, n_layers: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "k", "metric")
+    jax.jit, static_argnames=("cfg", "k", "metric", "f32_scores")
 )
 def _fused_retrieve(params, q_ids, q_mask, corpus, valid,
-                    cfg: TransformerConfig, k: int, metric: str):
+                    cfg: TransformerConfig, k: int, metric: str,
+                    f32_scores: bool = False):
     """Query encode + pool + normalise + corpus gemm + top-k, one dispatch.
     q_ids/q_mask: (Qb, S). Returns (scores (Qb, k), idx (Qb, k))."""
     emb = embed_fn(params, q_ids, q_mask, cfg)  # (Qb, H) unit vectors
-    return topk_scores(knn_scores(corpus, valid, emb, metric), k)
+    return topk_scores(
+        knn_scores(corpus, valid, emb, metric, f32_scores=f32_scores), k
+    )
 
 
 def _assemble_pairs(q_ids_row, q_len, doc_tokens, doc_lens, pair_seq: int):
@@ -401,6 +404,7 @@ class FusedRAGPipeline:
         return _fused_retrieve(
             self.embedder.params, ids, mask, self.index._corpus,
             self.index._valid, self.embedder.cfg, k_eff, self.metric,
+            f32_scores=self.index.f32_scores,
         )
 
     def retrieve(self, texts: list[str], k: int):

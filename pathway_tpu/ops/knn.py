@@ -20,7 +20,7 @@ from __future__ import annotations
 import functools
 import math
 
-from pathway_tpu.engine.probes import record_device_dispatch
+from pathway_tpu.engine.probes import record_device_dispatch, record_stage
 from pathway_tpu.ops import canonical_metric, next_pow2, prep_host_vectors
 from typing import Any
 
@@ -330,9 +330,15 @@ class BruteForceKnnIndex:
         """Host-side half of an append: key -> slot bookkeeping (one home
         for both the plain and the fused ingest paths). zip/update/extend
         keep the whole batch in C — this sits on the per-batch ingest path."""
+        import time
+
+        t0 = time.perf_counter()
         self._slot_of.update(zip(keys, range(start, start + len(keys))))
         self._keys.extend(keys)
         self.n += len(keys)
+        # "append" = the host-side index bookkeeping share of the ingest
+        # wall; the vector write itself rides the fused device dispatch
+        record_stage("append", time.perf_counter() - t0)
 
     def add_embed(self, keys: list, params, input_ids, attention_mask,
                   cfg, embed, pad_id: int = 0, query_rows: int = 0,

@@ -24,11 +24,9 @@ import math
 
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map  # jax >= 0.8
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
+
+from pathway_tpu.parallel.mesh import compat_shard_map as shard_map
 
 _MASK_BIAS = -1e9
 

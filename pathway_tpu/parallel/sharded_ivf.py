@@ -25,13 +25,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-try:
-    from jax import shard_map  # jax >= 0.8
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pathway_tpu.parallel.mesh import DATA_AXIS, MeshRef as _MeshRef
+from pathway_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MeshRef as _MeshRef,
+    compat_shard_map as shard_map,
+)
 
 _NEG_INF = -1e30
 
@@ -234,6 +234,121 @@ class ShardedIvfIndex:
             self._pending.append(v)
             self._maybe_train()
         self._dev = None  # host state changed; re-upload on next search
+
+    def _train_from(self, v: np.ndarray) -> None:
+        """Train centroids directly from an incoming sample (classic IVF
+        build order: train, then add) instead of waiting for the
+        ``train_after`` watermark — the bulk path would otherwise pay a
+        per-vector ``_rebuild`` over millions of rows after training."""
+        from pathway_tpu.ops.ivf import kmeans_fit
+
+        per = self.train_after * 4
+        for shard in range(self.dp):
+            c0 = shard * self.n_cells
+            rows = v[shard :: self.dp][:per]
+            if len(rows) == 0:
+                continue
+            self._h_centroids[c0 : c0 + self.n_cells] = np.asarray(
+                kmeans_fit(
+                    jnp.asarray(rows, jnp.float32),
+                    jnp.asarray(self._h_centroids[c0 : c0 + self.n_cells]),
+                )
+            )
+        self._trained = True
+        self._pending.clear()
+        if self._loc:
+            # rows placed before training sit in seed-centroid cells;
+            # re-place them under the trained centroids
+            self._rebuild()
+
+    def _balanced_quotas(self, n: int) -> np.ndarray:
+        """Rows-per-shard so the FINAL loads are as level as possible
+        (water filling): find the lowest level L whose fill capacity
+        covers ``n``, fill every shard to L-1, then hand the leftover to
+        the shards still below L. Equivalent to n iterations of
+        argmin(counts) without the per-row Python loop."""
+        counts = np.asarray(self._shard_count, np.int64)
+        lo, hi = int(counts.min()), int(counts.max()) + n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if int(np.maximum(0, mid - counts).sum()) >= n:
+                hi = mid
+            else:
+                lo = mid + 1
+        quota = np.maximum(0, (lo - 1) - counts)
+        leftover = n - int(quota.sum())
+        elig = np.nonzero(counts + quota < lo)[0]
+        quota[elig[:leftover]] += 1
+        return quota
+
+    def add_bulk(self, keys: list, vectors, chunk: int = 65536) -> None:
+        """Bulk build for multi-million-row loads: everything per-row in
+        :meth:`add` becomes per-cell or per-chunk.
+
+        * shard choice: closed-form water filling (``_balanced_quotas``)
+          instead of an argmin per vector;
+        * cell choice: chunked ``block @ centroids.T`` argmax, bounding the
+          score temp at ``chunk x n_cells`` floats;
+        * slot packing: rows grouped by destination cell (one stable sort),
+          then each touched cell takes a contiguous run of its free slots —
+          at most ``n_cells`` Python iterations per shard, not one per row.
+
+        Untrained indexes train from the incoming sample first (build-time
+        k-means), so no post-hoc rebuild is needed. Falls back to
+        :meth:`add` for upserts/duplicates, where per-key handling is the
+        point."""
+        if not keys:
+            return
+        if len(set(keys)) != len(keys) or any(k in self._loc for k in keys):
+            self.add(keys, vectors)
+            return
+        v = self._prep(vectors)
+        self._seed(v)
+        if not self._trained:
+            self._train_from(v)
+        quota = self._balanced_quotas(len(keys))
+        start = 0
+        for s in range(self.dp):
+            m = int(quota[s])
+            if m == 0:
+                continue
+            block = v[start : start + m]
+            bkeys = keys[start : start + m]
+            start += m
+            c0 = s * self.n_cells
+            cents = self._h_centroids[c0 : c0 + self.n_cells]
+            cells = np.empty(m, np.int64)
+            for o in range(0, m, chunk):
+                blk = block[o : o + chunk]
+                if self.metric == "l2":
+                    d2 = (
+                        np.sum(blk * blk, axis=1, keepdims=True)
+                        + np.sum(cents * cents, axis=1)[None, :]
+                        - 2.0 * blk @ cents.T
+                    )
+                    cells[o : o + len(blk)] = np.argmin(d2, axis=1)
+                else:
+                    cells[o : o + len(blk)] = np.argmax(blk @ cents.T, axis=1)
+            order = np.argsort(cells, kind="stable")
+            sorted_cells = cells[order]
+            uniq, first = np.unique(sorted_cells, return_index=True)
+            bounds = np.append(first, m)
+            for ui in range(len(uniq)):
+                rows = order[bounds[ui] : bounds[ui + 1]]
+                gcell = c0 + int(uniq[ui])
+                free = np.nonzero(~self._h_valid[gcell])[0]
+                while len(free) < len(rows):
+                    self._grow_cells()
+                    free = np.nonzero(~self._h_valid[gcell])[0]
+                slots = free[: len(rows)]
+                self._h_cells[gcell, slots] = block[rows]
+                self._h_valid[gcell, slots] = True
+                g = (gcell * self.cell_cap + slots).tolist()
+                kk = [bkeys[r] for r in rows.tolist()]
+                self._key_of.update(zip(g, kk))
+                self._loc.update(zip(kk, g))
+            self._shard_count[s] += m
+        self._dev = None
 
     def _grow_cells(self) -> None:
         new_cap = self.cell_cap * 2

@@ -26,14 +26,14 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map  # jax >= 0.8
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pathway_tpu.ops.knn import knn_scores
-from pathway_tpu.parallel.mesh import DATA_AXIS, MeshRef as _MeshRef
+from pathway_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MeshRef as _MeshRef,
+    compat_shard_map as shard_map,
+)
 
 _NEG_INF = -1e30
 

@@ -249,8 +249,15 @@ class TPUDecoderChat(BaseChat):
         # batch's full generation. deferred=True additionally runs the
         # UDF on the engine's fully-async path so the pump never blocks
         # on the decode (see SentenceTransformerEmbedder(deferred=...)).
+        # Greedy decoding (temperature 0, no top-k/top-p) is deterministic
+        # — declaring it lets the engine take the deferred two-phase path
+        # (which re-derives values on retraction) instead of the blocking
+        # replay-cache path.
         super().__init__(
             batch=True,
+            deterministic=(
+                float(temperature) == 0.0 and top_k is None and top_p is None
+            ),
             max_batch_size=max_batch_size,
             cache_strategy=cache_strategy,
             executor=udfs.fully_async_executor() if deferred else None,
@@ -521,7 +528,25 @@ class _ContinuousServer:
       immediately (its remaining tokens drain from the in-flight
       snapshots) instead of ``pipeline_depth`` chunks later at
       drain time — the occupancy gap that kept slots idle a whole
-      pipeline's depth per request."""
+      pipeline's depth per request.
+    * **prefill/decode overlap** (PATHWAY_TPU_PREFILL_OVERLAP) — each
+      tick dispatches the in-flight lanes' decode chunk FIRST, then
+      runs admission host work and prefill dispatches while it
+      computes; newcomers join the next chunk boundary, which they
+      would have waited for anyway (xLLM-style chunk-boundary
+      admission, arXiv:2510.14686).
+    * **batched admission** (PATHWAY_TPU_BATCH_ADMIT) — same-bucket
+      requests that arrive together admit via one ``pool_admit_batch``
+      dispatch (pow2 group sizes to bound jit variants) instead of one
+      dispatch per request, so an arrival burst costs O(log n)
+      dispatches.
+    * **chunk-steps autotune** (PATHWAY_TPU_CHUNK_AUTOTUNE) —
+      ``chunk_steps`` adapts to observed arrival rate: queue pressure
+      shrinks the chunk (earlier boundaries admit sooner and recycle
+      slots sooner); an idle queue grows it back toward the
+      constructor value (fewer dispatches per token). Candidates are
+      halvings of the constructor value, so the KV-cache slack sizing
+      stays valid."""
 
     def __init__(self, params, cfg, tokenizer, *, n_slots: int,
                  chunk_steps: int, max_prompt_tokens: int,
@@ -569,29 +594,50 @@ class _ContinuousServer:
             pathway_config.eager_refill
             if eager_refill is None else bool(eager_refill)
         )
+        # chunk-admission serving knobs (internals/config.py):
+        # * batch_admit — same-bucket arrivals prefill in ONE grouped
+        #   pool_admit_batch dispatch instead of one dispatch each;
+        # * prefill_overlap — the decode chunk dispatches BEFORE admission
+        #   work each tick, so newcomer prefill overlaps in-flight decode;
+        # * chunk_autotune — decode-chunk steps shrink (halving, floor 4)
+        #   against the observed arrival rate / queue pressure so chunk
+        #   boundaries (admission + drain points) come sooner under load.
+        self.batch_admit = pathway_config.batch_admit
+        self.prefill_overlap = pathway_config.prefill_overlap
+        self.chunk_autotune = pathway_config.chunk_autotune
+        # autotune candidates: halvings of the constructor's chunk_steps
+        # down to 4 — all <= chunk_steps, so the cache-slack sizing above
+        # stays valid for every candidate
+        cands, c = [], chunk_steps
+        while c >= 4:
+            cands.append(c)
+            c //= 2
+        self._step_cands = cands or [chunk_steps]
+        self._arrival_ema: float | None = None
+        self._last_submit_t: float | None = None
+        self._step_wall_ema: float | None = None
+        self._last_dispatch_t: float | None = None
+        self._last_dispatch_steps = 0
         self._D = decoder_mod
         self.pool = decoder_mod.pool_init(
             params, cfg, n_slots, self.cache_len
         )
         self._admit_fns: dict = {}
+        self._admit_batch_fns: dict = {}
         self._prefill_fns: dict = {}
         # slot -> (remaining prefill pieces, n_prompt); drained one piece
         # per loop tick so prefill interleaves with decode chunks
         self._pending_prefill: dict[int, tuple] = {}
         # per-slot DISPATCHED decode steps since admission (eager refill)
         self._sent = [0] * n_slots
-        cfgc, steps = cfg, chunk_steps
-
-        def chunk(params_, pool, active, key):
-            return decoder_mod.pool_decode_chunk(
-                params_, pool, active, key, cfgc, steps,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-            )
-
-        # donate the pool: the KV caches are the dominant HBM object and
-        # the loop is pure state-in/state-out — without donation every
-        # chunk would copy the whole pool and double peak memory
-        self._chunk_fn = jax.jit(chunk, donate_argnums=(1,))
+        self._temperature = temperature
+        self._top_k = top_k
+        self._top_p = top_p
+        # n_steps -> jitted decode-chunk executable. The pool is donated:
+        # the KV caches are the dominant HBM object and the loop is pure
+        # state-in/state-out — without donation every chunk would copy the
+        # whole pool and double peak memory.
+        self._chunk_fns: dict[int, Any] = {}
         self._key = jax.random.PRNGKey(seed)
         self._ticks = 0
         self.queue: deque = deque()
@@ -604,6 +650,7 @@ class _ContinuousServer:
         self.stats = {
             "chunks": 0, "admitted": 0, "steps": 0,
             "slot_steps_total": 0, "prefill_chunks": 0,
+            "admit_dispatches": 0,
         }
         # in-flight chunk records, oldest first; an attribute (not a loop
         # local) so the failure sweep can fail eagerly-freed requests
@@ -642,7 +689,10 @@ class _ContinuousServer:
                     req.done.set()
 
     def submit(self, prompt_ids: list, max_new: int) -> _PendingCompletion:
+        import time as time_mod
+
         req = _PendingCompletion(prompt_ids, max_new)
+        now = time_mod.perf_counter()
         with self.lock:
             # checked under the lock: _run_safe drains the queue under it,
             # so a dead server can never strand a late submit
@@ -653,6 +703,14 @@ class _ContinuousServer:
             if self._stop:
                 raise RuntimeError("decoder serving loop is shut down")
             self.queue.append(req)
+            # observed arrival rate feeds the chunk-steps autotuner
+            if self._last_submit_t is not None:
+                gap = now - self._last_submit_t
+                self._arrival_ema = (
+                    gap if self._arrival_ema is None
+                    else 0.8 * self._arrival_ema + 0.2 * gap
+                )
+            self._last_submit_t = now
         self.wake.set()
         return req
 
@@ -676,6 +734,60 @@ class _ContinuousServer:
             self._admit_fns[s] = fn
         return fn
 
+    def _admit_batch_fn(self, m: int, s: int):
+        fn = self._admit_batch_fns.get((m, s))
+        if fn is None:
+            import jax
+
+            D, cfgc = self._D, self.cfg
+
+            def admit(params_, ids, mask, pool, slots):
+                return D.pool_admit_batch(params_, ids, mask, pool, slots,
+                                          cfgc)
+
+            fn = jax.jit(admit, donate_argnums=(3,))
+            self._admit_batch_fns[(m, s)] = fn
+        return fn
+
+    def _chunk_fn_for(self, steps: int):
+        fn = self._chunk_fns.get(steps)
+        if fn is None:
+            import jax
+
+            D, cfgc = self._D, self.cfg
+            temp, tk, tp = self._temperature, self._top_k, self._top_p
+
+            def chunk(params_, pool, active, key):
+                return D.pool_decode_chunk(
+                    params_, pool, active, key, cfgc, steps,
+                    temperature=temp, top_k=tk, top_p=tp,
+                )
+
+            fn = jax.jit(chunk, donate_argnums=(1,))
+            self._chunk_fns[steps] = fn
+        return fn
+
+    def _pick_steps(self, queue_len: int) -> int:
+        """Decode-chunk step count for this tick. Under queue pressure the
+        SMALLEST candidate wins: the next chunk boundary is both the next
+        admission opportunity and (pipeline_depth chunks on) the next
+        drain/slot-release point, so shorter chunks recycle slots into a
+        waiting queue sooner. With no queue, pick the largest candidate
+        whose wall time still fits inside ~one observed inter-arrival gap
+        (a newcomer waits about one gap at most); an idle trace with no
+        arrival estimate keeps the full constructor chunk."""
+        if not self.chunk_autotune or len(self._step_cands) == 1:
+            return self.chunk_steps
+        if queue_len > 0:
+            return self._step_cands[-1]
+        ia, sw = self._arrival_ema, self._step_wall_ema
+        if ia is None or sw is None or sw <= 0.0:
+            return self._step_cands[0]
+        for c in self._step_cands:
+            if c * sw <= ia:
+                return c
+        return self._step_cands[-1]
+
     def _prefill_fn(self, t: int, first: bool, last: bool):
         key = (t, first, last)
         fn = self._prefill_fns.get(key)
@@ -695,20 +807,132 @@ class _ContinuousServer:
         return fn
 
     def _loop(self):
+        import time as time_mod
+
         import jax
         import numpy as np
 
         from pathway_tpu.ops import next_pow2
 
-        from collections import deque
-
         active = np.zeros(self.n_slots, dtype=bool)
         inflight = self._inflight
+
+        def dispatch_decode() -> bool:
+            """One decode chunk over the active lanes; False if none."""
+            if not active.any():
+                return False
+            with self.lock:
+                qlen = len(self.queue)
+            steps = self._pick_steps(qlen)
+            # tick-to-tick wall per dispatched step: in steady state the
+            # host loop is paced by the device finishing chunks, so this
+            # approximates chunk wall time for the autotuner
+            now = time_mod.perf_counter()
+            if self._last_dispatch_t is not None and self._last_dispatch_steps:
+                per = (now - self._last_dispatch_t) / self._last_dispatch_steps
+                self._step_wall_ema = (
+                    per if self._step_wall_ema is None
+                    else 0.7 * self._step_wall_ema + 0.3 * per
+                )
+            self._last_dispatch_t = now
+            self._last_dispatch_steps = steps
+            self._ticks += 1
+            key = jax.random.fold_in(self._key, self._ticks)
+            self.pool, toks_dev = self._chunk_fn_for(steps)(
+                self.params, self.pool, active, key
+            )
+            try:
+                # start the device->host token copy NOW: the block
+                # lands while the next pipeline_depth chunks compute,
+                # so the eventual read is local instead of a relay
+                # round trip (measured ~100ms -> ~1ms per chunk)
+                toks_dev.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - platform-optional
+                pass
+            self.stats["chunks"] += 1
+            self.stats["slot_steps_total"] += self.n_slots * steps
+            # snapshot WHICH request each lane served: by the time
+            # these tokens drain the slot may have been freed and
+            # re-admitted to a different request
+            inflight.append((toks_dev, active.copy(), list(self.slots)))
+            for slot in np.nonzero(active)[0]:
+                req = self.slots[slot]
+                if req is None:
+                    continue
+                # occupancy numerator counts USEFUL slot-steps only:
+                # a lane decoding past its budget while its tokens
+                # drain is busy but wasted, exactly the idle-by-
+                # another-name this metric exists to expose
+                self.stats["steps"] += min(
+                    steps, max(0, req.max_new - self._sent[slot])
+                )
+                self._sent[slot] += steps
+                if self.eager_refill and self._sent[slot] >= req.max_new:
+                    # budget exhaustion is host-knowable at DISPATCH
+                    # time: no further chunk can add to this lane's
+                    # answer, so free the slot NOW — its tokens drain
+                    # from the snapshots — instead of pipeline_depth
+                    # chunks later. Device stream ordering makes the
+                    # next occupant's prefill overwrite safe: it is
+                    # enqueued after this chunk.
+                    self.slots[slot] = None
+                    active[slot] = False
+                    with self.lock:
+                        self.free.append(int(slot))
+            return True
+
+        def admit_direct(direct) -> None:
+            """One-shot (non-chunked) admissions. With batch admission,
+            same-bucket arrivals group into pow2-sized
+            ``pool_admit_batch`` dispatches (slots are distinct by
+            construction); otherwise one ``pool_admit`` each."""
+            if self.batch_admit and len(direct) > 1:
+                by_s: dict[int, list] = {}
+                for slot, ids, mask, s in direct:
+                    by_s.setdefault(s, []).append((slot, ids, mask))
+                for s, grp in by_s.items():
+                    o = 0
+                    while o < len(grp):
+                        m = 1 << ((len(grp) - o).bit_length() - 1)
+                        part = grp[o:o + m]
+                        o += m
+                        if m == 1:
+                            slot, ids, mask = part[0]
+                            self.pool = self._admit_fn(s)(
+                                self.params, ids, mask, self.pool,
+                                np.int32(slot),
+                            )
+                        else:
+                            ids = np.concatenate([p[1] for p in part], axis=0)
+                            mask = np.concatenate([p[2] for p in part], axis=0)
+                            slots = np.asarray([p[0] for p in part], np.int32)
+                            self.pool = self._admit_batch_fn(m, s)(
+                                self.params, ids, mask, self.pool, slots
+                            )
+                        self.stats["admit_dispatches"] += 1
+                        for p in part:
+                            active[p[0]] = True
+            else:
+                for slot, ids, mask, s in direct:
+                    self.pool = self._admit_fn(s)(
+                        self.params, ids, mask, self.pool, np.int32(slot)
+                    )
+                    self.stats["admit_dispatches"] += 1
+                    active[slot] = True
+
         while not self._stop:
+            # decode FIRST (PATHWAY_TPU_PREFILL_OVERLAP, default on): the
+            # active lanes' next chunk is on the device before any
+            # admission work runs, so newcomer tokenized-prompt prep and
+            # prefill dispatches OVERLAP the in-flight decode instead of
+            # delaying it. Newcomers join the next chunk — they waited one
+            # chunk boundary either way; the chunk just starts earlier.
+            dispatched = self.prefill_overlap and dispatch_decode()
             admissions = []
             with self.lock:
                 while self.queue and self.free:
                     admissions.append((self.free.pop(), self.queue.popleft()))
+            direct = []
             for slot, req in admissions:
                 # the slot record goes in FIRST: if the admit dispatch
                 # raises, the failure sweep still finds (and fails) this
@@ -740,11 +964,9 @@ class _ContinuousServer:
                     ]
                     self._pending_prefill[slot] = (pieces, n_prompt)
                 else:
-                    self.pool = self._admit_fn(s)(
-                        self.params, ids, mask, self.pool, np.int32(slot)
-                    )
-                    active[slot] = True
+                    direct.append((slot, ids, mask, s))
                 self.stats["admitted"] += 1
+            admit_direct(direct)
             for slot in list(self._pending_prefill):
                 pieces, n_prompt = self._pending_prefill[slot]
                 p_ids, p_mask, p_pos, off = pieces.pop(0)
@@ -757,53 +979,12 @@ class _ContinuousServer:
                 if last:
                     del self._pending_prefill[slot]
                     active[slot] = True
-            if active.any():
-                self._ticks += 1
-                key = jax.random.fold_in(self._key, self._ticks)
-                self.pool, toks_dev = self._chunk_fn(
-                    self.params, self.pool, active, key
-                )
-                try:
-                    # start the device->host token copy NOW: the block
-                    # lands while the next pipeline_depth chunks compute,
-                    # so the eventual read is local instead of a relay
-                    # round trip (measured ~100ms -> ~1ms per chunk)
-                    toks_dev.copy_to_host_async()
-                except Exception:  # noqa: BLE001 - platform-optional
-                    pass
-                self.stats["chunks"] += 1
-                self.stats["slot_steps_total"] += (
-                    self.n_slots * self.chunk_steps
-                )
-                # snapshot WHICH request each lane served: by the time
-                # these tokens drain the slot may have been freed and
-                # re-admitted to a different request
-                inflight.append((toks_dev, active.copy(), list(self.slots)))
-                for slot in np.nonzero(active)[0]:
-                    req = self.slots[slot]
-                    if req is None:
-                        continue
-                    # occupancy numerator counts USEFUL slot-steps only:
-                    # a lane decoding past its budget while its tokens
-                    # drain is busy but wasted, exactly the idle-by-
-                    # another-name this metric exists to expose
-                    self.stats["steps"] += min(
-                        self.chunk_steps,
-                        max(0, req.max_new - self._sent[slot]),
-                    )
-                    self._sent[slot] += self.chunk_steps
-                    if self.eager_refill and self._sent[slot] >= req.max_new:
-                        # budget exhaustion is host-knowable at DISPATCH
-                        # time: no further chunk can add to this lane's
-                        # answer, so free the slot NOW — its tokens drain
-                        # from the snapshots — instead of pipeline_depth
-                        # chunks later. Device stream ordering makes the
-                        # next occupant's prefill overwrite safe: it is
-                        # enqueued after this chunk.
-                        self.slots[slot] = None
-                        active[slot] = False
-                        with self.lock:
-                            self.free.append(int(slot))
+            if not dispatched:
+                # legacy ordering (kill switch off) — or the pool was
+                # empty at the top of the tick and admissions just
+                # activated lanes: decode them without an idle hop
+                dispatched = dispatch_decode()
+            if dispatched:
                 if len(inflight) <= self.pipeline_depth:
                     continue
             elif not inflight:

@@ -41,8 +41,24 @@ def test_bench_smoke_schema():
         "ingest_bubbles", "serving", "rerank_cascade_p50_ms",
         "cascade_top8_overlap", "cascade_survivor_rate", "query_qps",
         "query_p50_ms", "query_p95_ms", "query_batch_hist",
+        # sustained-window accounting + dual recall + sharded build
+        # (ISSUE 4): every phase carries volume and elapsed_s
+        "ingest_docs", "ingest_elapsed_s", "ingest_ceiling",
+        "config4_default_docs_per_sec", "config4_docs",
+        "config4_elapsed_s", "join_rows", "join_elapsed_s",
+        "wordcount_rows", "wordcount_elapsed_s", "knn_recall_at_10_f32",
+        "sharded_ivf",
     ):
         assert s.get(key) is not None, key
+    assert s["ingest_elapsed_s"] > 0 and s["ingest_docs"] > 0
+    ceil = s["ingest_ceiling"]
+    assert ceil["bound"] in ("compute", "memory")
+    assert ceil["ceiling_mfu_pct"] > 0
+    sh = s["sharded_ivf"]
+    assert sh.get("error") is None, sh
+    assert sh["rows_total"] == sh["shards"] * sh["rows_per_shard"] > 0
+    assert 0.0 < sh["recall_at_10"] <= 1.0
+    assert 0.0 <= s["knn_recall_at_10_f32"] <= 1.0
     # the query-serving phase ran under load: a survivor rate strictly
     # inside (0, 1] and a non-empty tick batch histogram
     assert 0.0 < s["cascade_survivor_rate"] <= 1.0
@@ -57,7 +73,11 @@ def test_bench_smoke_schema():
     srv = s["serving"]
     for key in (
         "throughput_x", "p50_x", "occupancy", "static_tok_s",
-        "continuous_tok_s",
+        "continuous_tok_s", "measured_path", "direct_api_throughput_x",
+        "direct_api_p50_x",
     ):
         assert srv.get(key) is not None, key
     assert 0.0 < srv["occupancy"] <= 1.0
+    # the serving headline must come off the product path, not the bare
+    # model API
+    assert "pw_ai_answer" in srv["measured_path"]

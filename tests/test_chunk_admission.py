@@ -1,0 +1,184 @@
+"""Chunk-boundary admission serving (PR: batched admission + prefill/
+decode overlap + chunk autotune).
+
+``pool_admit_batch`` must write the same pool state as M sequential
+``pool_admit`` calls, and every serving kill switch
+(PATHWAY_TPU_BATCH_ADMIT / PATHWAY_TPU_PREFILL_OVERLAP /
+PATHWAY_TPU_CHUNK_AUTOTUNE) must change scheduling only — the emitted
+tokens are byte-identical with the switch on or off."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models import decoder as D
+from tests.utils import ToyCharTokenizer
+
+TINY = D.DecoderConfig(
+    vocab_size=128, hidden=32, layers=2, heads=4, intermediate=64,
+    max_position=128, dtype=jnp.float32,
+)
+
+# burst trace: all requests arrive together, so same-bucket admissions
+# group (n_slots=4 forces slot recycling across the burst too)
+PROMPTS = [
+    "hello world",
+    "continuous batching",
+    "abc",
+    "qrs tuv",
+    "slot pool",
+    "zzz",
+]
+NEW = 10
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return D.init_params(jax.random.PRNGKey(0), TINY)
+
+
+def test_pool_admit_batch_matches_sequential(tiny_params):
+    """Grouped prefill into distinct slots == M per-request admissions:
+    integer pool state (cursors, masks) byte-equal, float state equal to
+    kernel-batching tolerance."""
+    S, n_slots, cache_len = 16, 8, 64
+    rng = np.random.default_rng(0)
+    lens = [5, 9, 3]
+    ids = np.zeros((3, S), np.int32)
+    mask = np.zeros((3, S), np.int32)
+    for r, n in enumerate(lens):  # left-padded prompts
+        ids[r, S - n:] = rng.integers(1, 97, n)
+        mask[r, S - n:] = 1
+    ids, mask = jnp.asarray(ids), jnp.asarray(mask)
+    slots = [5, 2, 7]
+
+    seq = D.pool_init(tiny_params, TINY, n_slots, cache_len)
+    for r, slot in enumerate(slots):
+        seq = D.pool_admit(
+            tiny_params, ids[r : r + 1], mask[r : r + 1], seq,
+            jnp.int32(slot), TINY,
+        )
+    bat = D.pool_admit_batch(
+        tiny_params, ids, mask,
+        D.pool_init(tiny_params, TINY, n_slots, cache_len),
+        jnp.asarray(slots, jnp.int32), TINY,
+    )
+    for name in ("slot_mask", "pos", "write"):
+        np.testing.assert_array_equal(
+            np.asarray(seq[name]), np.asarray(bat[name]), err_msg=name
+        )
+    for name in ("k", "v", "logits"):
+        np.testing.assert_allclose(
+            np.asarray(seq[name], np.float32),
+            np.asarray(bat[name], np.float32),
+            rtol=1e-5, atol=1e-6, err_msg=name,
+        )
+
+
+class _MutedWake:
+    """Swallows ``set`` so a multi-request burst enqueues atomically
+    before the serving loop scans its queue (otherwise the first
+    submit's wake-up could admit it alone and the grouped path would
+    depend on thread timing)."""
+
+    def __init__(self, ev):
+        self._ev = ev
+
+    def set(self):
+        pass
+
+    def clear(self):
+        self._ev.clear()
+
+    def wait(self, timeout=None):
+        return self._ev.wait(timeout)
+
+
+def _serve_burst(tiny_params):
+    """All prompts submitted in one burst through the continuous server;
+    returns their texts (flags are read from the environment at
+    construction time)."""
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    chat = TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(),
+        max_new_tokens=NEW, temperature=0.0, max_prompt_tokens=32,
+        continuous=True, n_slots=4, chunk_steps=8, pipeline_depth=2,
+    )
+    try:
+        srv = chat._server
+        real_wake = srv.wake
+        srv.wake = _MutedWake(real_wake)
+        try:
+            reqs = chat.submit_batch(PROMPTS, max_new_tokens=NEW)
+        finally:
+            srv.wake = real_wake
+            real_wake.set()
+        for r in reqs:
+            assert r.done.wait(timeout=120)
+        return [r.text for r in reqs]
+    finally:
+        chat.close()
+
+
+@pytest.fixture(scope="module")
+def static_truth(tiny_params):
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    static = TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(),
+        max_new_tokens=NEW, temperature=0.0, max_prompt_tokens=32,
+    )
+    return static.__wrapped__(PROMPTS, max_new_tokens=NEW)
+
+
+def test_batch_admit_kill_switch_byte_equality(
+    tiny_params, static_truth, monkeypatch
+):
+    """PATHWAY_TPU_BATCH_ADMIT on vs off: identical tokens; the on-arm
+    must actually take the grouped ``pool_admit_batch`` path."""
+    calls = [0]
+    orig = D.pool_admit_batch
+
+    def probe(*a, **k):
+        calls[0] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(D, "pool_admit_batch", probe)
+
+    monkeypatch.setenv("PATHWAY_TPU_BATCH_ADMIT", "1")
+    got_on = _serve_burst(tiny_params)
+    assert calls[0] > 0, "burst never reached the grouped admission path"
+
+    grouped_traces = calls[0]
+    monkeypatch.setenv("PATHWAY_TPU_BATCH_ADMIT", "0")
+    got_off = _serve_burst(tiny_params)
+    assert calls[0] == grouped_traces, "kill switch still grouped"
+
+    assert got_on == got_off == static_truth
+
+
+def test_prefill_overlap_kill_switch_equivalence(
+    tiny_params, static_truth, monkeypatch
+):
+    """Dispatch-decode-first ordering is pure overlap: tokens identical
+    with PATHWAY_TPU_PREFILL_OVERLAP off."""
+    monkeypatch.setenv("PATHWAY_TPU_PREFILL_OVERLAP", "1")
+    got_on = _serve_burst(tiny_params)
+    monkeypatch.setenv("PATHWAY_TPU_PREFILL_OVERLAP", "0")
+    got_off = _serve_burst(tiny_params)
+    assert got_on == got_off == static_truth
+
+
+def test_chunk_autotune_kill_switch_equivalence(
+    tiny_params, static_truth, monkeypatch
+):
+    """Chunk-steps autotune moves chunk BOUNDARIES only, never the
+    per-slot token streams."""
+    monkeypatch.setenv("PATHWAY_TPU_CHUNK_AUTOTUNE", "1")
+    got_on = _serve_burst(tiny_params)
+    monkeypatch.setenv("PATHWAY_TPU_CHUNK_AUTOTUNE", "0")
+    got_off = _serve_burst(tiny_params)
+    assert got_on == got_off == static_truth

@@ -1,0 +1,248 @@
+"""Engine close-out optimisations (PR: columnar subscribe formatting,
+deferred-drain coalescing, epoch close-out cuts).
+
+Every switch must be a pure scheduling/overhead change: callback
+sequences and final tables are identical with the kill switch off."""
+
+import threading
+import time as _t
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.batch import Batch, consolidate
+
+
+def _run_subscribe_trace(n_rows: int = 12):
+    """One commit of ``n_rows`` rows through ``pw.io.subscribe``; returns
+    the ordered callback trace (rows record the thread that ran them)."""
+    pw.clear_graph()
+
+    class S(pw.Schema):
+        x: int
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(n_rows):
+                self.next(x=i)
+            self.commit()
+            _t.sleep(0.2)
+
+    t = pw.io.python.read(Src(), schema=S)
+    sel = t.select(t.x, y=t.x * 2)
+    events: list = []
+    lock = threading.Lock()
+
+    def on_change(key, row, time, is_addition):
+        with lock:
+            events.append(
+                ("row", row["x"], row["y"], is_addition,
+                 threading.current_thread().name)
+            )
+
+    def on_time_end(time):
+        with lock:
+            events.append(("flush",))
+
+    def on_end():
+        with lock:
+            events.append(("end",))
+
+    pw.io.subscribe(
+        sel, on_change=on_change, on_time_end=on_time_end, on_end=on_end
+    )
+
+    def stopper():
+        deadline = _t.time() + 20
+        while _t.time() < deadline:
+            with lock:
+                n = sum(1 for e in events if e[0] == "row")
+            if n >= n_rows:
+                break
+            _t.sleep(0.02)
+        for c in pw.G.connectors:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stopper, daemon=True).start()
+    pw.run()
+    return events
+
+
+def test_columnar_subscribe_order_parity(monkeypatch):
+    """Background formatting must preserve the exact row callback order,
+    keep flushes/end after the rows they close, and actually run on the
+    formatter thread (inline mode must not)."""
+    monkeypatch.setenv("PATHWAY_TPU_COLUMNAR_SUBSCRIBE", "1")
+    ev_col = _run_subscribe_trace()
+    monkeypatch.setenv("PATHWAY_TPU_COLUMNAR_SUBSCRIBE", "0")
+    ev_inline = _run_subscribe_trace()
+
+    rows_col = [e[:4] for e in ev_col if e[0] == "row"]
+    rows_inline = [e[:4] for e in ev_inline if e[0] == "row"]
+    assert rows_col == rows_inline
+    assert rows_col == [("row", i, 2 * i, True) for i in range(12)]
+
+    for ev in (ev_col, ev_inline):
+        assert ev[-1] == ("end",)
+        last_row = max(i for i, e in enumerate(ev) if e[0] == "row")
+        assert any(
+            e == ("flush",) for e in ev[last_row + 1 :]
+        ), "no flush after the commit's rows"
+
+    col_threads = {e[4] for e in ev_col if e[0] == "row"}
+    assert all(t.startswith("pathway:subscribe:") for t in col_threads), (
+        col_threads
+    )
+    inline_threads = {e[4] for e in ev_inline if e[0] == "row"}
+    assert not any(
+        t.startswith("pathway:subscribe:") for t in inline_threads
+    )
+
+
+def test_columnar_subscribe_callback_error_propagates(monkeypatch):
+    """An exception raised inside a queued on_change must surface from
+    ``pw.run`` (re-raised on the engine thread), not vanish with the
+    formatter thread."""
+    monkeypatch.setenv("PATHWAY_TPU_COLUMNAR_SUBSCRIBE", "1")
+    pw.clear_graph()
+
+    class S(pw.Schema):
+        x: int
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(x=1)
+            self.commit()
+            _t.sleep(0.3)
+
+    t = pw.io.python.read(Src(), schema=S)
+
+    def boom(key, row, time, is_addition):
+        raise RuntimeError("subscriber exploded")
+
+    pw.io.subscribe(t, on_change=boom)
+
+    def stopper():
+        _t.sleep(1.0)
+        for c in pw.G.connectors:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stopper, daemon=True).start()
+    with pytest.raises(RuntimeError, match="subscriber exploded"):
+        pw.run()
+
+
+class _DoubleUDF(pw.UDF):
+    """Deferred two-phase batched UDF with a simulated device latency
+    (small batches force MANY resolved chunks — the coalescing shape)."""
+
+    def __init__(self, latency: float = 0.01):
+        super().__init__(
+            deterministic=True, batch=True, max_batch_size=2,
+            executor=pw.udfs.fully_async_executor(),
+        )
+        self.latency = latency
+
+    def __wrapped__(self, xs):
+        return [x * 2 for x in xs]
+
+    def submit_batch(self, xs):
+        return list(xs)
+
+    def resolve_batch(self, handles):
+        _t.sleep(self.latency)
+        return [[x * 2 for x in h] for h in handles]
+
+
+def _run_deferred_pipeline(n: int = 12):
+    pw.clear_graph()
+    u = _DoubleUDF()
+
+    class S(pw.Schema):
+        x: int
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(n):
+                self.next(x=i)
+            self.commit()
+            _t.sleep(0.2)
+
+    t = pw.io.python.read(Src(), schema=S)
+    sel = t.select(t.x, y=u(t.x))
+    got: dict = {}
+    lock = threading.Lock()
+
+    def on_change(key, row, time, is_addition):
+        with lock:
+            k = (row["x"], row["y"])
+            got[k] = got.get(k, 0) + (1 if is_addition else -1)
+
+    pw.io.subscribe(sel, on_change=on_change)
+
+    def stopper():
+        deadline = _t.time() + 30
+        while _t.time() < deadline:
+            with lock:
+                live = {k: v for k, v in got.items() if v != 0}
+            if len(live) == n:
+                break
+            _t.sleep(0.02)
+        for c in pw.G.connectors:
+            c._stop.set()
+            c.close()
+
+    threading.Thread(target=stopper, daemon=True).start()
+    pw.run()
+    return {k: v for k, v in got.items() if v != 0}
+
+
+@pytest.mark.parametrize(
+    "env_key",
+    ["PATHWAY_TPU_DRAIN_COALESCE", "PATHWAY_TPU_EPOCH_CLOSEOUT"],
+)
+def test_closeout_kill_switches_preserve_results(monkeypatch, env_key):
+    """Drain coalescing and the epoch close-out cuts must not change the
+    final table of a deferred pipeline (12 rows through max_batch_size=2
+    -> 6 resolved chunks to drain/coalesce)."""
+    monkeypatch.setenv(env_key, "1")
+    on = _run_deferred_pipeline()
+    monkeypatch.setenv(env_key, "0")
+    off = _run_deferred_pipeline()
+    expected = {(i, 2 * i): 1 for i in range(12)}
+    assert on == off == expected
+
+
+def test_consolidate_proof_survives_transforms(monkeypatch):
+    """A batch consolidate() proved single-sign/distinct keeps the proof
+    through column transforms, and the short-circuit returns the same
+    content as a full re-consolidation."""
+    keys = np.arange(100, 103, dtype=np.int64)
+    b = Batch(keys, {"x": np.arange(3, dtype=np.int64)})
+    assert not b._consolidated
+    c = consolidate(b)
+    assert c is b and b._consolidated
+
+    b2 = b.with_cols({"x": np.arange(3, dtype=np.int64) * 7})
+    assert b2._consolidated
+
+    monkeypatch.setenv("PATHWAY_TPU_EPOCH_CLOSEOUT", "1")
+    fast = consolidate(b2)
+    assert fast is b2  # short-circuit: no re-scan
+
+    monkeypatch.setenv("PATHWAY_TPU_EPOCH_CLOSEOUT", "0")
+    full = consolidate(b2)
+    np.testing.assert_array_equal(full.keys, fast.keys)
+    np.testing.assert_array_equal(full.diffs, fast.diffs)
+    np.testing.assert_array_equal(full.cols["x"], fast.cols["x"])
+
+    # a mixed-sign batch must never earn the proof
+    mixed = Batch(
+        keys, {"x": np.arange(3, dtype=np.int64)},
+        diffs=np.array([1, -1, 1], dtype=np.int64),
+    )
+    consolidate(mixed)
+    assert not mixed._consolidated
