@@ -760,6 +760,10 @@ def config4_streaming_engine() -> dict:
         text: str
 
     def one_rep(embed_udf) -> dict:
+        # every rep measures COLD embed throughput: drop the dedup LRU so
+        # repeat windows over the same payloads don't degrade into a
+        # host-side cache-hit benchmark
+        getattr(embed_udf, "_dedup", {}).clear()
         pw.clear_graph()
         broker = InMemoryKafkaBroker()
         for p in payloads:
@@ -862,6 +866,24 @@ def config4_streaming_engine() -> dict:
     default_rate = max(r["rate"] for r in default_reps)
     default_elapsed = min(r["elapsed"] for r in default_reps)
 
+    # re-ingest dedup (PATHWAY_TPU_EMBED_DEDUP): byte-identical chunks
+    # reuse their embedding instead of re-dispatching — embed a small
+    # corpus twice through the UDF path and report the hit ledger plus the
+    # re-embed speedup (the second pass never touches the device)
+    dedup_texts = [" ".join(rng.choice(words, 24)) for _ in range(256)]
+    embedder._dedup.clear()
+    embedder.dedup_stats["hits"] = embedder.dedup_stats["misses"] = 0
+    t0 = time.perf_counter()
+    embedder.__wrapped__(dedup_texts)
+    dedup_cold_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    embedder.__wrapped__(dedup_texts)
+    dedup_warm_s = time.perf_counter() - t0
+    dedup_detail = {
+        **embedder.dedup_stats,
+        "reembed_speedup_x": round(dedup_cold_s / max(dedup_warm_s, 1e-9), 1),
+    }
+
     # engine-side ingest roofline: same accounting as the headline's, at
     # the stream's seq bucket — the MFU the ENGINE path sustains
     from pathway_tpu.engine.probes import RooflineModel
@@ -907,6 +929,7 @@ def config4_streaming_engine() -> dict:
             "engine": reps[-1]["engine"],
             "pipeline_stages": reps[-1]["pipeline_stages"],
             "device_dispatches": reps[-1]["dispatches"],
+            "embed_dedup": dedup_detail,
             "roofline": roofline.summary(),
         },
     }
@@ -1978,6 +2001,94 @@ def _serving_rest_arm(chat, NREQ, prompts, arrivals) -> dict:
             server._thread.join(timeout=60)
 
 
+def _serving_prefix_trace(params, cfg, tok) -> dict:
+    """Shared-prefix Poisson trace (PATHWAY_TPU_PREFIX_CACHE): RAG serving
+    replays the same system-prompt + retrieved-context head on every
+    request, so the radix KV cache should admit that head from the arena
+    instead of re-prefilling it. Identical trace through two continuous
+    servers — cache ON vs OFF — reporting hit rate, prefill tokens saved,
+    and TTFT (arrival -> first token drained). Greedy decoding: the two
+    arms must emit token-identical generations."""
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    if _smoke():
+        NREQ, LAM, MAXNEW = 8, 20.0, 8
+        N_SLOTS, CHUNK = 4, 4
+    else:
+        NREQ, LAM, MAXNEW = 48, 60.0, 32
+        N_SLOTS, CHUNK = 16, 8
+    rng = np.random.default_rng(7)
+    arrivals = np.cumsum(rng.exponential(1.0 / LAM, NREQ))
+    # 48 shared head chars + fixed 8-char tails (the 1-token/char _Tok):
+    # every prompt is 56 tokens in the 64 bucket, the first 48 block-align
+    head = "c" * 40 + "ontext: "
+    prompts = [head + f"q{k:02d}tail"[:8].ljust(8, "x") for k in range(NREQ)]
+
+    def run_arm(on: bool):
+        chat = TPUDecoderChat(
+            params=params, cfg=cfg, tokenizer=tok,
+            max_new_tokens=MAXNEW, temperature=0.0, max_prompt_tokens=64,
+            continuous=True, n_slots=N_SLOTS, chunk_steps=CHUNK,
+            prefill_chunk=8, prefix_cache=on, prefix_cache_mb=8,
+        )
+        try:
+            srv = chat._server
+            # warm with the SAME head so every hit-path executable
+            # (extract, cached admit, right-padded suffix pieces)
+            # compiles outside the timed window — sequentially, so the
+            # second warm request actually HITS the first one's insert;
+            # then drop the cache so the trace measures a clean
+            # first-miss-then-hits window
+            for wtail in ("warmAAxx", "warmBBxx"):
+                for r in chat.submit_batch([head + wtail]):
+                    r.done.wait(timeout=120)
+            srv.prefix_reset()
+            t0 = time.perf_counter()
+            reqs = []
+            for k in range(NREQ):
+                now = time.perf_counter() - t0
+                if arrivals[k] > now:
+                    time.sleep(arrivals[k] - now)
+                reqs.append(chat.submit_batch([prompts[k]])[0])
+            ttft = []
+            for k, r in enumerate(reqs):
+                r.done.wait(timeout=120)
+                ttft.append(r.first_token_at - t0 - arrivals[k])
+            hit = srv.stats["prefix_hit_tokens"]
+            miss = srv.stats["prefix_miss_tokens"]
+            arm = {
+                "ttft_p50_ms": round(
+                    float(np.percentile(np.asarray(ttft) * 1e3, 50)), 1
+                ),
+                "hit_rate": round(hit / max(hit + miss, 1), 4),
+                "prefill_tokens_saved": int(hit),
+                "hit_requests": srv.stats["prefix_hit_requests"],
+                "requests": srv.stats["prefix_requests"],
+            }
+            return arm, [list(r.tokens) for r in reqs]
+        finally:
+            chat.close()
+
+    on, toks_on = run_arm(True)
+    off, toks_off = run_arm(False)
+    return {
+        "trace": (
+            f"{NREQ} Poisson arrivals at {LAM}/s, {len(head)}-token shared "
+            f"head + {len(prompts[0]) - len(head)}-token distinct tail, "
+            f"{MAXNEW} new tokens each"
+        ),
+        "cache_on": on,
+        "cache_off": off,
+        "prefix_hit_rate": on["hit_rate"],
+        "prefill_tokens_saved": on["prefill_tokens_saved"],
+        "ttft_p50_ms": on["ttft_p50_ms"],
+        "ttft_speedup_x": round(
+            off["ttft_p50_ms"] / max(on["ttft_p50_ms"], 1e-9), 2
+        ),
+        "tokens_match": toks_on == toks_off,
+    }
+
+
 def _decoder_serving_compare(params, cfg) -> dict:
     """Poisson-arrival serving comparison through ``TPUDecoderChat``,
     measured on the PRODUCT path: both arms play the same trace through
@@ -2160,6 +2271,7 @@ def _decoder_serving_compare(params, cfg) -> dict:
         rest_cont["occupancy"] = round(r_steps / max(r_total, 1), 4)
     finally:
         chat_c.close()
+    prefix = _serving_prefix_trace(params, cfg, _Tok())
     return {
         # headline figures come from the REST product path
         "poisson_lambda_req_per_s": LAM_REST,
@@ -2179,6 +2291,8 @@ def _decoder_serving_compare(params, cfg) -> dict:
         "p50_x": round(
             rest_static["p50_ms"] / max(rest_cont["p50_ms"], 1e-9), 2
         ),
+        # shared-prefix trace: the KV prefix cache's serving claim
+        "prefix": prefix,
         # bare-model comparison (per-request budgets, no engine): kept for
         # continuity with the r4/r5 records
         "direct_api": {
@@ -2408,6 +2522,15 @@ def main() -> None:
             "direct_api_p50_x": (
                 serving_det.get("direct_api") or {}
             ).get("p50_x"),
+            "prefix_hit_rate": (serving_det.get("prefix") or {}).get(
+                "prefix_hit_rate"
+            ),
+            "prefill_tokens_saved": (serving_det.get("prefix") or {}).get(
+                "prefill_tokens_saved"
+            ),
+            "ttft_p50_ms": (serving_det.get("prefix") or {}).get(
+                "ttft_p50_ms"
+            ),
         }
         if serving_det and "error" not in serving_det
         else serving_det or None
@@ -2538,6 +2661,7 @@ def main() -> None:
             "throughput_x", "p50_x", "occupancy", "static_tok_s",
             "continuous_tok_s", "measured_path",
             "direct_api_throughput_x", "direct_api_p50_x",
+            "prefix_hit_rate", "prefill_tokens_saved", "ttft_p50_ms",
         ):
             _chk(f"summary.serving.{k}", srv.get(k))
         bub = s.get("ingest_bubbles") or {}

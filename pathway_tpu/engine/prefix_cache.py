@@ -1,0 +1,221 @@
+"""Host-side radix tree over token blocks: which prompt prefixes have KV
+resident in the device arena, and where.
+
+The serving hot path re-prefills the same RAG system prompt / answer
+template for every request (``xpacks/llm/prompts.py`` heads every prompt
+with them). The fix is classic serving-engine prefix caching: KV for
+block-aligned prompt prefixes persists in an arena allocated next to the
+slot pool (``models/decoder.pool_init``), and admission seeds a slot by
+COPYING arena blocks (``pool_admit_cached``) instead of recomputing
+them — prefill then runs only over the uncached suffix.
+
+This module is the host-side half: a radix tree keyed on token BLOCKS
+(one tree edge holds a run of blocks, split on divergence at block
+boundaries), mapping each cached block to its arena id. Everything here
+is plain Python — no jax — so tier-1 exercises it CPU-only:
+
+- ``match``    longest cached block-aligned prefix of a prompt; splits
+               mid-edge so the returned node's root-path exactly covers
+               the matched blocks (the handle the caller ref-counts).
+- ``insert``   extend the tree with a prompt's not-yet-cached full
+               blocks, allocating arena ids (evicting if needed); the
+               caller owns copying the slot's freshly-prefilled KV into
+               them (``kv_extract``).
+- ``acquire``/``release``  ref-count a node's whole root-path while a
+               slot is live on it — referenced blocks never evict, so a
+               seed copy can never race an eviction's arena reuse.
+- eviction     LRU over unreferenced leaf edges when the arena free
+               list runs dry; the arena's block count IS the HBM byte
+               budget (``PATHWAY_TPU_PREFIX_CACHE_MB``).
+
+Insert/evict keep the ``record_prefix`` ledger in ``engine/probes.py``
+current (``inserted_blocks`` / ``evicted_blocks`` / ``cached_bytes``);
+the serving loop accounts hit/miss tokens at admission time.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from pathway_tpu.engine.probes import record_prefix
+
+
+class _Node:
+    """One radix edge: a run of blocks ``keys`` (token tuples) with their
+    arena ids ``blocks``, compressed into a single node. ``refs`` counts
+    live slots whose acquired path passes through here (cumulative: an
+    ancestor's refs >= the sum over its subtree's holders)."""
+
+    __slots__ = ("keys", "blocks", "children", "parent", "refs", "stamp")
+
+    def __init__(self, parent: "_Node | None",
+                 keys: list[tuple[int, ...]], blocks: list[int]):
+        self.parent = parent
+        self.keys = keys
+        self.blocks = blocks
+        self.children: dict[tuple[int, ...], _Node] = {}
+        self.refs = 0
+        self.stamp = 0  # LRU clock at last touch
+
+
+class PrefixCache:
+    """Radix prefix cache over ``n_blocks`` arena slots of ``block``
+    tokens each. ``block_bytes`` is the device footprint of ONE block's
+    K+V across all layers — only used for the bytes ledger; capacity is
+    enforced in blocks (the arena is preallocated, so the byte budget is
+    exact by construction)."""
+
+    def __init__(self, *, n_blocks: int, block: int, block_bytes: int):
+        self.block = int(block)
+        self.block_bytes = int(block_bytes)
+        self.capacity_blocks = int(n_blocks)
+        self._root = _Node(None, [], [])
+        # pop() takes from the tail: reversed so low ids allocate first
+        # (deterministic layouts make the tests' arena assertions exact)
+        self._free = list(range(int(n_blocks)))[::-1]
+        self._clock = 0
+
+    # -- tree internals ------------------------------------------------
+
+    def _tick(self, node: _Node) -> None:
+        self._clock += 1
+        node.stamp = self._clock
+
+    def _block_keys(self, tokens: Sequence[int],
+                    n_blocks: int) -> list[tuple[int, ...]]:
+        B = self.block
+        return [tuple(tokens[i * B:(i + 1) * B]) for i in range(n_blocks)]
+
+    def _split(self, node: _Node, i: int) -> _Node:
+        """Split ``node``'s edge before block ``i`` (0 < i < len(keys)):
+        the TOP half is a NEW node spliced between parent and ``node``;
+        ``node`` keeps its identity (and children, and holders — whose
+        acquired paths all pass through the new top, so it inherits the
+        cumulative ref count). Returns the top half."""
+        top = _Node(node.parent, node.keys[:i], node.blocks[:i])
+        top.refs = node.refs
+        top.stamp = node.stamp
+        node.parent.children[top.keys[0]] = top
+        top.children[node.keys[i]] = node
+        node.parent = top
+        node.keys = node.keys[i:]
+        node.blocks = node.blocks[i:]
+        return top
+
+    # -- public API ----------------------------------------------------
+
+    def match(self, tokens: Sequence[int]) -> tuple[int, list[int], _Node]:
+        """Longest cached block-aligned prefix of ``tokens``. Returns
+        ``(n_blocks, arena_ids, node)`` where ``node``'s root-path covers
+        exactly the matched blocks (mid-edge matches split the edge so
+        the handle is exact). Touches LRU stamps along the path."""
+        want = self._block_keys(tokens, len(tokens) // self.block)
+        node, ids, j = self._root, [], 0
+        while j < len(want):
+            child = node.children.get(want[j])
+            if child is None:
+                break
+            i = 0
+            while (i < len(child.keys) and j + i < len(want)
+                   and child.keys[i] == want[j + i]):
+                i += 1
+            if i == 0:  # defensive: children are keyed by their first block
+                break
+            if i < len(child.keys):
+                child = self._split(child, i)
+            ids.extend(child.blocks)
+            j += i
+            node = child
+            self._tick(node)
+        return j, ids, node
+
+    def acquire(self, node: _Node) -> None:
+        """Pin ``node``'s whole root-path against eviction (a slot is
+        live on this prefix)."""
+        n = node
+        while n is not None:
+            n.refs += 1
+            n = n.parent
+
+    def release(self, node: _Node) -> None:
+        n = node
+        while n is not None:
+            n.refs -= 1
+            n = n.parent
+
+    def insert(self, tokens: Sequence[int],
+               n_blocks: int | None = None) -> tuple[_Node, int, list[int]]:
+        """Ensure the first ``n_blocks`` full blocks of ``tokens`` are in
+        the tree. Returns ``(node, first_new, new_ids)``: the deepest
+        node now covering the prompt's cached prefix, the block index
+        where the newly-allocated run starts, and its arena ids — the
+        caller must copy the slot's KV spans into them (``kv_extract``).
+        Allocation evicts LRU unreferenced leaves when the free list is
+        dry; if the arena is exhausted the tail is simply not cached
+        (``new_ids`` comes back short, or empty)."""
+        if n_blocks is None:
+            n_blocks = len(tokens) // self.block
+        j, _, node = self.match(tokens[: n_blocks * self.block])
+        if j >= n_blocks:
+            return node, j, []
+        want = self._block_keys(tokens, n_blocks)[j:]
+        new_ids: list[int] = []
+        for _ in want:
+            a = self._alloc(protect=node)
+            if a is None:
+                break
+            new_ids.append(a)
+        if not new_ids:
+            return node, j, []
+        child = _Node(node, want[: len(new_ids)], new_ids)
+        node.children[want[0]] = child
+        self._tick(child)
+        record_prefix("inserted_blocks", len(new_ids))
+        record_prefix("cached_bytes", len(new_ids) * self.block_bytes)
+        return child, j, new_ids
+
+    def _alloc(self, protect: _Node) -> int | None:
+        if not self._free and not self._evict_one(protect):
+            return None
+        return self._free.pop()
+
+    def _evict_one(self, protect: _Node) -> bool:
+        """Drop the LRU unreferenced leaf EDGE (whole node — a long cold
+        tail frees in one step). Never touches the root, referenced
+        nodes, interior nodes, or ``protect``'s own root-path (the
+        in-progress insertion point)."""
+        protected = set()
+        n = protect
+        while n is not None:
+            protected.add(id(n))
+            n = n.parent
+        best, stack = None, [self._root]
+        while stack:
+            nd = stack.pop()
+            stack.extend(nd.children.values())
+            if (nd is self._root or nd.children or nd.refs > 0
+                    or id(nd) in protected):
+                continue
+            if best is None or nd.stamp < best.stamp:
+                best = nd
+        if best is None:
+            return False
+        del best.parent.children[best.keys[0]]
+        self._free.extend(best.blocks)
+        record_prefix("evicted_blocks", len(best.blocks))
+        record_prefix("cached_bytes", -len(best.blocks) * self.block_bytes)
+        return True
+
+    # -- observability ---------------------------------------------------
+
+    @property
+    def used_blocks(self) -> int:
+        return self.capacity_blocks - len(self._free)
+
+    def stats(self) -> dict:
+        return {
+            "capacity_blocks": self.capacity_blocks,
+            "used_blocks": self.used_blocks,
+            "cached_bytes": self.used_blocks * self.block_bytes,
+            "block": self.block,
+        }

@@ -114,6 +114,54 @@ def reset_cascade_stats() -> None:
 
 
 # --------------------------------------------------------------------- #
+# prefix-KV-cache ledger
+#
+# Like the cascade ledger, the prefix cache's whole point is SKIPPED
+# compute: ``hit_tokens`` counts prompt tokens whose KV was reused from
+# the arena instead of re-prefilled (== prefill tokens saved),
+# ``miss_tokens`` the tokens that still paid prefill. ``cached_bytes``
+# tracks the arena's resident KV bytes (insert adds, evict subtracts),
+# so the HBM budget is observable, not just enforced.
+
+_prefix_lock = threading.Lock()
+_prefix_counts: dict[str, float] = {}
+
+
+def record_prefix(kind: str, n: float = 1) -> None:
+    """Account ``n`` of ``kind`` (``hit_tokens`` / ``miss_tokens`` /
+    ``requests`` / ``hit_requests`` / ``inserted_blocks`` /
+    ``evicted_blocks`` / ``cached_bytes`` — the last is a running delta,
+    negative on eviction). Thread-safe; called by the serving loop and
+    :class:`pathway_tpu.engine.prefix_cache.PrefixCache`."""
+    with _prefix_lock:
+        _prefix_counts[kind] = _prefix_counts.get(kind, 0) + n
+
+
+def prefix_stats() -> dict:
+    """Snapshot: raw counters plus the token-level ``hit_rate``
+    (hit_tokens / (hit_tokens + miss_tokens); 0.0 when the cache never
+    saw a prompt) and ``prefill_tokens_saved`` (== hit_tokens)."""
+    with _prefix_lock:
+        c = dict(_prefix_counts)
+    hit = c.get("hit_tokens", 0)
+    miss = c.get("miss_tokens", 0)
+    total = hit + miss
+    return {
+        "counts": {k: (int(v) if float(v).is_integer() else v)
+                   for k, v in c.items()},
+        "hit_rate": round(hit / total, 4) if total else 0.0,
+        "prefill_tokens_saved": int(hit),
+        "evicted_blocks": int(c.get("evicted_blocks", 0)),
+        "cached_bytes": int(c.get("cached_bytes", 0)),
+    }
+
+
+def reset_prefix_stats() -> None:
+    with _prefix_lock:
+        _prefix_counts.clear()
+
+
+# --------------------------------------------------------------------- #
 # pipeline-stage ledger (bubble attribution)
 #
 # The roofline says HOW FAR the device is from peak; this ledger says

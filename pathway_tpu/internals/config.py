@@ -66,6 +66,25 @@ Serving-admission knobs (``xpacks/llm/llms.py`` / ``models/decoder.py``):
   chunk boundaries (= admission opportunities and drain points) come
   sooner under load, and restores the full chunk when the queue is
   empty; ``0`` pins the constructor's ``chunk_steps``.
+* PATHWAY_TPU_PREFIX_CACHE (default on) — radix-tree KV prefix cache:
+  admission matches the prompt's longest block-aligned cached prefix
+  and seeds the slot's KV from the device arena instead of
+  re-prefilling it (``engine/prefix_cache.py`` + ``pool_admit_cached``);
+  requires chunked prefill. ``0`` restores the PR-4 admission path
+  byte-identically.
+* PATHWAY_TPU_PREFIX_CACHE_MB (default 64) — HBM budget (MB) of the
+  prefix-cache KV arena; sets the arena block count at pool init, with
+  LRU eviction of unreferenced prefixes once full.
+* PATHWAY_TPU_PREFIX_BLOCK (default 0 = prefill chunk) — prefix-cache
+  granularity in tokens; rounded up to a power of two >= the prefill
+  chunk so cached prefixes stay piece-aligned.
+* PATHWAY_TPU_TOKENIZE_CACHE (default on) — content-keyed LRU memo over
+  tokenizer encodes (``models/tokenizer.py`` / ``models/bpe.py``):
+  repeated doc chunks and the shared prompt template skip re-encoding;
+  ``0`` re-encodes every call.
+* PATHWAY_TPU_EMBED_DEDUP (default on) — byte-identical texts reuse
+  their embedding from a content-keyed LRU instead of re-dispatching
+  (``xpacks/llm/embedders.py``); ``0`` re-embeds every occurrence.
 
 Query-path knobs (``ops/fused_query.py`` / ``ops/query_server.py``):
 
@@ -290,6 +309,43 @@ class PathwayConfig:
         """Auto-shrink decode-chunk steps while requests queue (halving,
         floor 4) so admission/drain boundaries come sooner under load."""
         return _env_bool("PATHWAY_TPU_CHUNK_AUTOTUNE", True)
+
+    @property
+    def prefix_cache(self) -> bool:
+        """Radix-tree KV prefix cache over the serving slot pool: cache
+        hits seed a slot's KV from the device arena and prefill only the
+        uncached suffix. ``PATHWAY_TPU_PREFIX_CACHE=0`` restores the
+        match-free admission path byte-identically."""
+        return _env_bool("PATHWAY_TPU_PREFIX_CACHE", True)
+
+    @property
+    def prefix_cache_mb(self) -> float:
+        """HBM budget (MB) of the prefix-cache KV arena (k+v, all
+        layers); fixes the arena block count at pool init."""
+        return max(
+            0.0, float(os.environ.get("PATHWAY_TPU_PREFIX_CACHE_MB", "64"))
+        )
+
+    @property
+    def prefix_block(self) -> int:
+        """Prefix-cache block granularity in tokens (0 = auto: the
+        prefill chunk). The server rounds up to a power of two >= the
+        prefill chunk so cached prefixes stay prefill-piece-aligned."""
+        return max(0, int(os.environ.get("PATHWAY_TPU_PREFIX_BLOCK", "0")))
+
+    @property
+    def tokenize_cache(self) -> bool:
+        """Content-keyed LRU memo over tokenizer encodes: repeated texts
+        (doc chunks on re-ingest, the shared prompt template on serving)
+        skip BPE/WordPiece re-encoding."""
+        return _env_bool("PATHWAY_TPU_TOKENIZE_CACHE", True)
+
+    @property
+    def embed_dedup(self) -> bool:
+        """Embedding dedup: byte-identical texts reuse their embedding
+        from a content-keyed LRU instead of re-dispatching to the
+        device — the incremental-engine analogue of KV prefix reuse."""
+        return _env_bool("PATHWAY_TPU_EMBED_DEDUP", True)
 
     @property
     def knn_f32_scores(self) -> bool:

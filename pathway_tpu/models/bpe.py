@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import os
 import unicodedata
+from collections import OrderedDict
 from functools import lru_cache
 
 
@@ -142,6 +143,11 @@ class BPETokenizer:
         self.byte_dec = {v: k for k, v in self.byte_enc.items()}
         self.eos_id = self.vocab.get(eos_token)
         self._cache: dict[str, list[str]] = {}
+        # whole-text encode memo (PATHWAY_TPU_TOKENIZE_CACHE): the serving
+        # path re-encodes the shared prompt head + template per request;
+        # the per-pretoken _cache saves the merge loops but still walks
+        # pretokenize() over the full text every time
+        self._encode_memo: OrderedDict[str, list[int]] = OrderedDict()
         self._warned_unknown = False
 
     @classmethod
@@ -194,6 +200,14 @@ class BPETokenizer:
         return parts
 
     def encode(self, text: str) -> list[int]:
+        from pathway_tpu.models.tokenizer import _MEMO_MAX, _tokenize_cache_on
+
+        memo = self._encode_memo if _tokenize_cache_on() else None
+        if memo is not None:
+            got = memo.get(text)
+            if got is not None:
+                memo.move_to_end(text)
+                return list(got)
         ids: list[int] = []
         for pre in pretokenize(text):
             mapped = "".join(self.byte_enc[b] for b in pre.encode("utf-8"))
@@ -219,6 +233,10 @@ class BPETokenizer:
                             )
                 else:
                     ids.append(pid)
+        if memo is not None:
+            memo[text] = list(ids)
+            if len(memo) > _MEMO_MAX:
+                memo.popitem(last=False)
         return ids
 
     def decode(self, ids) -> str:

@@ -411,7 +411,8 @@ def generate(params: dict, prompt_ids: jax.Array, attention_mask: jax.Array,
 
 
 def pool_init(params: dict, cfg: DecoderConfig, n_slots: int,
-              cache_len: int) -> dict:
+              cache_len: int, arena_blocks: int = 0,
+              arena_block: int = 0) -> dict:
     """Empty serving pool: per-slot KV caches, last logits, attention
     slot masks and cursors. ``cache_len`` must cover the largest
     admitted prompt + its budget + one chunk of overrun slack per
@@ -419,10 +420,20 @@ def pool_init(params: dict, cfg: DecoderConfig, n_slots: int,
     lane may overrun its budget until its tokens are drained —
     ``_ContinuousServer`` runs ``pipeline_depth`` chunks ahead and
     sizes prompt + budget + (pipeline_depth + 1) * chunk_steps; writes
-    clamp to the last slot)."""
+    clamp to the last slot).
+
+    With ``arena_blocks > 0`` the pool also carries a prefix-cache KV
+    arena: ``arena_blocks`` blocks of ``arena_block`` tokens each,
+    shaped ``(A, L, nh, block, hd)`` (block-major so :func:`kv_extract`
+    / :func:`kv_insert` gather and scatter whole blocks with one
+    indexed op). Which arena block holds which token prefix is host
+    state (``engine/prefix_cache.PrefixCache``); the pool functions
+    below pass unknown keys through untouched, so the arena rides
+    every donated dispatch and device-side data dependencies order
+    extract/insert against prefill and decode for free."""
     L, nh, hd = cfg.layers, cfg.heads, cfg.head_dim
     del params
-    return {
+    pool = {
         "k": jnp.zeros((L, n_slots, nh, cache_len, hd), cfg.dtype),
         "v": jnp.zeros((L, n_slots, nh, cache_len, hd), cfg.dtype),
         "logits": jnp.zeros((n_slots, cfg.vocab_size), jnp.float32),
@@ -430,6 +441,11 @@ def pool_init(params: dict, cfg: DecoderConfig, n_slots: int,
         "pos": jnp.zeros((n_slots,), jnp.int32),    # next position id
         "write": jnp.zeros((n_slots,), jnp.int32),  # next cache slot
     }
+    if arena_blocks > 0:
+        shape = (arena_blocks, L, nh, arena_block, hd)
+        pool["arena_k"] = jnp.zeros(shape, cfg.dtype)
+        pool["arena_v"] = jnp.zeros(shape, cfg.dtype)
+    return pool
 
 
 def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,
@@ -460,8 +476,8 @@ def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,
     write = jax.lax.dynamic_update_slice(
         pool["write"], jnp.full((1,), S, jnp.int32), (slot,)
     )
-    return {"k": k, "v": v, "logits": logits, "slot_mask": slot_mask,
-            "pos": pos, "write": write}
+    return {**pool, "k": k, "v": v, "logits": logits,
+            "slot_mask": slot_mask, "pos": pos, "write": write}
 
 
 def pool_admit_batch(params: dict, ids: jax.Array, mask: jax.Array,
@@ -490,15 +506,16 @@ def pool_admit_batch(params: dict, ids: jax.Array, mask: jax.Array,
     n_prompt = jnp.sum(mask, axis=1).astype(jnp.int32)  # (M,)
     pos = pool["pos"].at[slots].set(n_prompt)
     write = pool["write"].at[slots].set(jnp.full((M,), S, jnp.int32))
-    return {"k": k, "v": v, "logits": logits, "slot_mask": slot_mask,
-            "pos": pos, "write": write}
+    return {**pool, "k": k, "v": v, "logits": logits,
+            "slot_mask": slot_mask, "pos": pos, "write": write}
 
 
 def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
                        pos: jax.Array, pool: dict, slot: jax.Array,
                        start: jax.Array, n_prompt: jax.Array,
                        cfg: DecoderConfig, *, first: bool,
-                       last: bool) -> dict:
+                       last: bool,
+                       last_col: jax.Array | None = None) -> dict:
     """CHUNKED prefill: write ONE piece of a left-padded prompt
     (``ids``/``mask``/``pos`` shaped (1, T)) into ``slot``'s cache at
     offsets ``[start, start + T)``, sharing ``_block`` with decode and
@@ -516,7 +533,16 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
     Because attention is causal, piece i's queries only see cache entries
     written by pieces <= i, so the union of pieces is elementwise
     identical to :func:`pool_admit`'s one-shot prefill. jit per (piece
-    length, first, last); ``slot``/``start``/``n_prompt`` are traced."""
+    length, first, last); ``slot``/``start``/``n_prompt`` are traced.
+
+    ``last_col`` (traced scalar, only meaningful with ``last``) names
+    the piece column holding the prompt's REAL last token. The default
+    ``None`` keeps the historical static read of the piece's final
+    column — correct for left-padded prompts, whose last piece always
+    ends on the last real token. The prefix-cache path admits prompts
+    RIGHT-padded (token i must sit at cache column i for arena blocks
+    to be layout-exact), so its final piece may end on pad columns and
+    the next-token logits live mid-piece."""
     C = pool["k"].shape[3]
     T = ids.shape[1]
     nh, hd = cfg.heads, cfg.head_dim
@@ -555,10 +581,14 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
         return x, (kl, vl)
 
     x, (k, v) = jax.lax.scan(layer, x, (params["layers"], pool["k"], pool["v"]))
-    out = {"k": k, "v": v, "logits": pool["logits"], "slot_mask": slot_mask,
-           "pos": pool["pos"], "write": pool["write"]}
+    out = {**pool, "k": k, "v": v, "slot_mask": slot_mask}
     if last:
-        last_logits = _logits(params, x[:, -1:, :], cfg)[:, 0, :]
+        if last_col is None:
+            x_last = x[:, -1:, :]
+        else:
+            H = x.shape[2]
+            x_last = jax.lax.dynamic_slice(x, (0, last_col, 0), (1, 1, H))
+        last_logits = _logits(params, x_last, cfg)[:, 0, :]
         out["logits"] = jax.lax.dynamic_update_slice(
             pool["logits"], last_logits, (slot, 0)
         )
@@ -569,6 +599,75 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
         out["write"] = jax.lax.dynamic_update_slice(
             pool["write"], write_end, (slot,)
         )
+    return out
+
+
+def kv_extract(pool: dict, slot: jax.Array, start: jax.Array,
+               idxs: jax.Array, cfg: DecoderConfig) -> dict:
+    """Copy the block-aligned KV span ``[start, start + n*block)`` of
+    ``slot``'s cache into arena blocks ``idxs`` ((n,) int32). Called
+    after a prompt's prefill lands, to publish its freshly-computed
+    blocks into the prefix-cache arena. Pure data movement — no
+    compute — so the cached bytes are bit-identical to what the slot
+    holds. jit per n; ``slot``/``start``/``idxs`` are traced."""
+    del cfg
+    L, _, nh, _, hd = pool["k"].shape
+    Bk = pool["arena_k"].shape[3]
+    n = idxs.shape[0]
+    out = dict(pool)
+    for c, a in (("k", "arena_k"), ("v", "arena_v")):
+        span = jax.lax.dynamic_slice(
+            pool[c], (0, slot, 0, start, 0), (L, 1, nh, n * Bk, hd)
+        )
+        span = span[:, 0].reshape(L, nh, n, Bk, hd).transpose(2, 0, 1, 3, 4)
+        out[a] = pool[a].at[idxs].set(span)
+    return out
+
+
+def kv_insert(pool: dict, slot: jax.Array, start: jax.Array,
+              idxs: jax.Array, cfg: DecoderConfig) -> dict:
+    """Scatter arena blocks ``idxs`` into ``slot``'s cache at
+    ``[start, start + n*block)`` — the inverse of :func:`kv_extract`.
+    The arena stores KV for token i of a prefix at block-local column
+    i % block, so the copy is layout-exact only when the receiving
+    prompt ALSO places token i at cache column i (right-padded
+    admission, ``start = 0``). jit per n; traced like extract."""
+    del cfg
+    L, _, nh, _, hd = pool["k"].shape
+    Bk = pool["arena_k"].shape[3]
+    n = idxs.shape[0]
+    out = dict(pool)
+    for c, a in (("k", "arena_k"), ("v", "arena_v")):
+        span = pool[a][idxs]  # (n, L, nh, Bk, hd)
+        span = span.transpose(1, 2, 0, 3, 4).reshape(L, nh, n * Bk, hd)
+        out[c] = jax.lax.dynamic_update_slice(
+            pool[c], span[:, None], (0, slot, 0, start, 0)
+        )
+    return out
+
+
+def pool_admit_cached(pool: dict, slot: jax.Array, idxs: jax.Array,
+                      cfg: DecoderConfig) -> dict:
+    """Seed ``slot`` with a cached prompt prefix: arena blocks ``idxs``
+    ((n,) int32) land at cache columns ``[0, n*block)`` and the slot's
+    mask row becomes 1 there, 0 beyond — exactly the state
+    :func:`pool_prefill_chunk` would have left after prefilling those
+    tokens right-padded (its ``first`` piece clears the stale row the
+    same way). The host then drives the UNCACHED suffix through the
+    ordinary chunked-prefill pieces (``first=False``, ``pos`` starting
+    at ``n*block``), so a cache hit skips compute without forking the
+    numerics: the suffix attends to seeded KV that is bit-identical to
+    what it would have computed itself. No logits/cursor writes — the
+    suffix's ``last`` piece owns those. jit per n; ``slot``/``idxs``
+    are traced."""
+    out = kv_insert(pool, slot, jnp.int32(0), idxs, cfg)
+    C = pool["k"].shape[3]
+    Bk = pool["arena_k"].shape[3]
+    n_cached = idxs.shape[0] * Bk
+    row_mask = (jnp.arange(C)[None, :] < n_cached).astype(jnp.int32)
+    out["slot_mask"] = jax.lax.dynamic_update_slice(
+        pool["slot_mask"], row_mask, (slot, 0)
+    )
     return out
 
 
@@ -640,8 +739,8 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
         length=n_steps,
     )
     return (
-        {"k": k_c, "v": v_c, "logits": logits, "slot_mask": slot_mask,
-         "pos": pos, "write": write},
+        {**pool, "k": k_c, "v": v_c, "logits": logits,
+         "slot_mask": slot_mask, "pos": pos, "write": write},
         toks,
     )
 

@@ -17,6 +17,7 @@ Two paths:
 from __future__ import annotations
 
 import re
+from collections import OrderedDict
 from typing import Sequence
 
 import numpy as np
@@ -27,6 +28,56 @@ SEP_ID = 102
 UNK_ID = 100
 
 _WORD_RE = re.compile(r"[a-z0-9]+")
+
+# entries per tokenizer instance in the encode memo (matches the bound of
+# bpe.py's per-pretoken cache)
+_MEMO_MAX = 65536
+
+
+def _tokenize_cache_on() -> bool:
+    from pathway_tpu.internals.config import pathway_config
+
+    return pathway_config.tokenize_cache
+
+
+def _memoized_batch(memo: OrderedDict, texts: list, ml: int,
+                    pad_to: int | None, pad_id: int, encode_batch):
+    """Serve per-row token sequences from ``memo`` (a (text, max_length)-
+    keyed LRU, PATHWAY_TPU_TOKENIZE_CACHE); rows not present encode via
+    ``encode_batch`` over the MISS SUBSET only — tokenization is per-row,
+    so a subset batch (native or Python) produces the same sequences as
+    the full batch — and enter the memo. Re-ingested doc chunks and the
+    serving path's shared prompt template hit every time after the first.
+    Padding/mask assembly reproduces the unmemoized contract exactly
+    (width = ``pad_to`` or the longest sequence IN THIS BATCH, floor 2)."""
+    seqs: list = []
+    miss: list[int] = []
+    for i, t in enumerate(texts):
+        key = (t, ml)
+        s = memo.get(key)
+        if s is not None:
+            memo.move_to_end(key)
+        else:
+            miss.append(i)
+        seqs.append(s)
+    if miss:
+        m_ids, m_mask = encode_batch([texts[i] for i in miss])
+        lens = m_mask.sum(axis=1)
+        for j, i in enumerate(miss):
+            s = m_ids[j, : int(lens[j])].tolist()
+            seqs[i] = s
+            memo[(texts[i], ml)] = s
+            if len(memo) > _MEMO_MAX:
+                memo.popitem(last=False)
+    width = pad_to or max((len(s) for s in seqs), default=2)
+    width = max(width, 2)
+    ids = np.full((len(seqs), width), pad_id, dtype=np.int32)
+    mask = np.zeros((len(seqs), width), dtype=np.int32)
+    for r, s in enumerate(seqs):
+        s = s[:width]
+        ids[r, : len(s)] = s
+        mask[r, : len(s)] = 1
+    return ids, mask
 
 _native_tok = False  # test hook: set to None to force the Python path
 
@@ -59,6 +110,7 @@ class HashTokenizer:
         # compact layout for small (test) vocabs
         self._reserved = 999 if vocab_size >= 2000 else SEP_ID + 1
         self._span = max(1, vocab_size - self._reserved)
+        self._memo: OrderedDict = OrderedDict()
 
     def _word_id(self, w: str) -> int:
         return self._reserved + (_fnv1a(w) % self._span)
@@ -83,7 +135,23 @@ class HashTokenizer:
         padded to ``pad_to`` (or the longest sequence). The inner loop runs
         in the C++ extension when available (the reference tokenizes in
         Rust, ``src/connectors/data_tokenize.rs``); the Python path below is
-        the byte-identical fallback."""
+        the byte-identical fallback. Repeated texts serve from the
+        per-instance encode memo (PATHWAY_TPU_TOKENIZE_CACHE)."""
+        texts = list(texts)
+        ml = max_length or self.max_length
+        if _tokenize_cache_on():
+            return _memoized_batch(
+                self._memo, texts, ml, pad_to, PAD_ID,
+                lambda sub: self._encode_batch(sub, ml, None),
+            )
+        return self._encode_batch(texts, ml, pad_to)
+
+    def _encode_batch(
+        self,
+        texts: list,
+        max_length: int | None,
+        pad_to: int | None,
+    ) -> tuple[np.ndarray, np.ndarray]:
         native = _native_tokenize()
         if native is not None:
             texts = list(texts)
@@ -221,6 +289,7 @@ class WordPieceTokenizer:
         self.sep_id = self.vocab.get("[SEP]", SEP_ID)
         self.unk_id = self.vocab.get("[UNK]", UNK_ID)
         self.pad_id = self.vocab.get("[PAD]", PAD_ID)
+        self._memo: OrderedDict = OrderedDict()
         self._native_handle = None
         if self.pad_id in (self.cls_id, self.sep_id):
             raise ValueError("[PAD] id must differ from [CLS]/[SEP]")
@@ -320,6 +389,20 @@ class WordPieceTokenizer:
     ) -> tuple[np.ndarray, np.ndarray]:
         ml = max_length or self.max_length
         texts = list(texts)
+        if _tokenize_cache_on():
+            return _memoized_batch(
+                self._memo, texts, ml, pad_to, self.pad_id,
+                lambda sub: self._encode_batch(sub, ml, None),
+            )
+        return self._encode_batch(texts, ml, pad_to)
+
+    def _encode_batch(
+        self,
+        texts: list,
+        max_length: int | None,
+        pad_to: int | None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        ml = max_length or self.max_length
         # the C++ kernel lowercases unconditionally: cased vocabs must take
         # the Python path or native/fallback ids would diverge
         native = _native_wordpiece() if self.lowercase else None

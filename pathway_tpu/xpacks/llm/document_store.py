@@ -7,6 +7,14 @@ post-processors → splitter, and maintains a retriever index (TPU brute-force
 KNN / BM25 / hybrid) over the chunks. Query tables are answered live:
 ``retrieve_query`` / ``statistics_query`` / ``inputs_query`` mirror the
 reference's REST surface.
+
+Re-ingest cost: when a source file is edited and re-read, the pipeline
+re-derives every chunk of that file, but most chunks are byte-identical to
+their previous versions. The embedding stage
+(``SentenceTransformerEmbedder``) keeps a content-keyed LRU of recent
+chunk embeddings (``PATHWAY_TPU_EMBED_DEDUP``), so unchanged chunks reuse
+their vector instead of re-dispatching to the device — the ingest-side
+analogue of the serving-side KV prefix cache.
 """
 
 from __future__ import annotations

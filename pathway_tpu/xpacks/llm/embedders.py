@@ -19,6 +19,17 @@ from pathway_tpu.internals import udfs
 from pathway_tpu.internals.expression import ColumnExpression
 
 
+# entries kept in the per-embedder dedup LRU (text -> embedding); at
+# MiniLM dims that is ~12 MB of host memory at the bound
+_DEDUP_MAX = 8192
+
+
+def _dedup_on() -> bool:
+    from pathway_tpu.internals.config import pathway_config
+
+    return pathway_config.embed_dedup
+
+
 class BaseEmbedder(pw.UDF):
     """Base embedder UDF (reference ``BaseEmbedder``, embedders.py:64).
 
@@ -108,20 +119,86 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             raise TypeError(f"unsupported model spec: {model!r}")
         self.device = device
         self.kwargs = dict(call_kwargs)
+        # content-keyed dedup (PATHWAY_TPU_EMBED_DEDUP): re-ingesting a file
+        # re-embeds mostly-unchanged chunks; byte-identical texts reuse their
+        # vector instead of re-dispatching — the ingest analogue of the
+        # serving-side prefix cache
+        from collections import OrderedDict
+
+        self._dedup: OrderedDict[str, np.ndarray] = OrderedDict()
+        self.dedup_stats = {"hits": 0, "misses": 0}
+
+    def _dedup_plan(self, texts: list[str]):
+        """Split a batch into cached rows and unique misses.
+
+        Returns ``(plan, miss_texts)`` where each plan entry is
+        ``("h", vec)`` for an LRU hit or ``("m", i)`` indexing into
+        ``miss_texts``; duplicate texts within the batch share one miss.
+        """
+        plan: list[tuple[str, Any]] = []
+        miss_texts: list[str] = []
+        pos: dict[str, int] = {}
+        for t in texts:
+            v = self._dedup.get(t)
+            if v is not None:
+                self._dedup.move_to_end(t)
+                self.dedup_stats["hits"] += 1
+                plan.append(("h", v))
+                continue
+            p = pos.get(t)
+            if p is None:
+                p = pos[t] = len(miss_texts)
+                miss_texts.append(t)
+                self.dedup_stats["misses"] += 1
+            else:
+                self.dedup_stats["hits"] += 1
+            plan.append(("m", p))
+        return plan, miss_texts
+
+    def _dedup_fill(self, plan, miss_texts, miss_vecs) -> list[np.ndarray]:
+        for t, v in zip(miss_texts, miss_vecs):
+            self._dedup[t] = np.asarray(v)
+            if len(self._dedup) > _DEDUP_MAX:
+                self._dedup.popitem(last=False)
+        out: list[np.ndarray] = []
+        for kind, x in plan:
+            v = x if kind == "h" else np.asarray(miss_vecs[x])
+            out.append(np.array(v, copy=True))
+        return out
 
     def __wrapped__(self, input: list[str], **kwargs) -> list[np.ndarray]:
-        vecs = self.model.embed_batch([t if t is not None else "" for t in input])
-        return list(vecs)
+        texts = [t if t is not None else "" for t in input]
+        if not _dedup_on():
+            return list(self.model.embed_batch(texts))
+        plan, miss_texts = self._dedup_plan(texts)
+        miss_vecs = self.model.embed_batch(miss_texts) if miss_texts else []
+        return self._dedup_fill(plan, miss_texts, miss_vecs)
 
     # two-phase protocol (picked up by UDF._call_batched): an epoch's chunks
     # are all dispatched, then drained with one device round trip
     def submit_batch(self, input: list[str], **kwargs):
-        return self.model.embed_submit(
-            [t if t is not None else "" for t in input]
-        )
+        texts = [t if t is not None else "" for t in input]
+        if not _dedup_on():
+            return ("raw", self.model.embed_submit(texts))
+        plan, miss_texts = self._dedup_plan(texts)
+        # an all-hit batch never touches the device
+        h = self.model.embed_submit(miss_texts) if miss_texts else None
+        return ("dedup", h, plan, miss_texts)
 
     def resolve_batch(self, handles) -> list[list[np.ndarray]]:
-        return [list(vecs) for vecs in self.model.embed_resolve(handles)]
+        model_handles = [h[1] for h in handles if h[1] is not None]
+        resolved = iter(
+            self.model.embed_resolve(model_handles) if model_handles else []
+        )
+        out: list[list[np.ndarray]] = []
+        for h in handles:
+            if h[0] == "raw":
+                out.append(list(next(resolved)))
+                continue
+            _, mh, plan, miss_texts = h
+            miss_vecs = list(next(resolved)) if mh is not None else []
+            out.append(self._dedup_fill(plan, miss_texts, miss_vecs))
+        return out
 
     def get_embedding_dimension(self, **kwargs) -> int:
         return self.model.dim

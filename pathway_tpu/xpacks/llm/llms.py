@@ -242,6 +242,9 @@ class TPUDecoderChat(BaseChat):
         chunked_prefill: bool | None = None,
         prefill_chunk: int | None = None,
         eager_refill: bool | None = None,
+        prefix_cache: bool | None = None,
+        prefix_cache_mb: float | None = None,
+        prefix_block: int | None = None,
     ):
         # continuous=True: requests are served by a persistent slot-pool
         # loop (_ContinuousServer) — new rows admit into the IN-FLIGHT
@@ -317,6 +320,9 @@ class TPUDecoderChat(BaseChat):
                 chunked_prefill=chunked_prefill,
                 prefill_chunk=prefill_chunk,
                 eager_refill=eager_refill,
+                prefix_cache=prefix_cache,
+                prefix_cache_mb=prefix_cache_mb,
+                prefix_block=prefix_block,
             )
             # the two-phase engine protocol only exists in continuous
             # mode — exposing these as CLASS methods would activate the
@@ -488,7 +494,8 @@ class TPUDecoderChat(BaseChat):
 class _PendingCompletion:
     """One in-flight continuous-batching request (host-side slot record)."""
 
-    __slots__ = ("ids", "max_new", "tokens", "done", "text", "finished_at")
+    __slots__ = ("ids", "max_new", "tokens", "done", "text", "finished_at",
+                 "first_token_at")
 
     def __init__(self, ids: list, max_new: int):
         import threading
@@ -499,6 +506,7 @@ class _PendingCompletion:
         self.done = threading.Event()
         self.text: str | None = None
         self.finished_at: float | None = None  # time.perf_counter()
+        self.first_token_at: float | None = None  # first token DRAINED
 
 
 class _ContinuousServer:
@@ -554,7 +562,10 @@ class _ContinuousServer:
                  seed: int, pipeline_depth: int = 4,
                  chunked_prefill: bool | None = None,
                  prefill_chunk: int | None = None,
-                 eager_refill: bool | None = None):
+                 eager_refill: bool | None = None,
+                 prefix_cache: bool | None = None,
+                 prefix_cache_mb: float | None = None,
+                 prefix_block: int | None = None):
         import threading
         from collections import deque
 
@@ -605,6 +616,50 @@ class _ContinuousServer:
         self.batch_admit = pathway_config.batch_admit
         self.prefill_overlap = pathway_config.prefill_overlap
         self.chunk_autotune = pathway_config.chunk_autotune
+        # prefix KV cache (PATHWAY_TPU_PREFIX_CACHE): admission matches a
+        # prompt's longest block-aligned cached prefix in a host radix
+        # tree and SEEDS the slot's KV from a device arena instead of
+        # re-prefilling it; only the uncached suffix pays prefill. The
+        # cached path rides the chunked-prefill piece machinery (a hit
+        # admits right-padded so token i sits at cache column i — the
+        # arena layout), so it requires chunked prefill; with the flag
+        # off the admission path is byte-identical to before.
+        import numpy as _np_mod
+
+        self.prefix = None
+        self.prefix_block = 0
+        want_prefix = (
+            pathway_config.prefix_cache
+            if prefix_cache is None else bool(prefix_cache)
+        )
+        if want_prefix and self.chunked_prefill:
+            from pathway_tpu.engine.prefix_cache import PrefixCache
+
+            mb = (
+                pathway_config.prefix_cache_mb
+                if prefix_cache_mb is None else float(prefix_cache_mb)
+            )
+            blk = (
+                pathway_config.prefix_block
+                if prefix_block is None else int(prefix_block)
+            )
+            # block must be a pow2 multiple of the prefill chunk: cached
+            # prefixes then end on piece boundaries, so the right-padded
+            # suffix never writes past the prompt's pow2 bucket
+            blk = next_pow2(max(blk, self.prefill_chunk), self.prefill_chunk)
+            itemsize = _np_mod.dtype(cfg.dtype).itemsize
+            block_bytes = (
+                2 * cfg.layers * cfg.heads * blk * cfg.head_dim * itemsize
+            )
+            n_blocks = int(mb * (1 << 20) // block_bytes)
+            if n_blocks >= 1:
+                self.prefix_block = blk
+                self.prefix = PrefixCache(
+                    n_blocks=n_blocks, block=blk, block_bytes=block_bytes
+                )
+        # request -> radix node whose root-path the request has pinned
+        # (released when the request completes)
+        self._prefix_nodes: dict = {}
         # autotune candidates: halvings of the constructor's chunk_steps
         # down to 4 — all <= chunk_steps, so the cache-slack sizing above
         # stays valid for every candidate
@@ -620,11 +675,15 @@ class _ContinuousServer:
         self._last_dispatch_steps = 0
         self._D = decoder_mod
         self.pool = decoder_mod.pool_init(
-            params, cfg, n_slots, self.cache_len
+            params, cfg, n_slots, self.cache_len,
+            arena_blocks=(self.prefix.capacity_blocks if self.prefix else 0),
+            arena_block=self.prefix_block,
         )
         self._admit_fns: dict = {}
         self._admit_batch_fns: dict = {}
         self._prefill_fns: dict = {}
+        self._admit_cached_fns: dict = {}
+        self._extract_fns: dict = {}
         # slot -> (remaining prefill pieces, n_prompt); drained one piece
         # per loop tick so prefill interleaves with decode chunks
         self._pending_prefill: dict[int, tuple] = {}
@@ -650,7 +709,9 @@ class _ContinuousServer:
         self.stats = {
             "chunks": 0, "admitted": 0, "steps": 0,
             "slot_steps_total": 0, "prefill_chunks": 0,
-            "admit_dispatches": 0,
+            "admit_dispatches": 0, "prefix_hit_tokens": 0,
+            "prefix_miss_tokens": 0, "prefix_hit_requests": 0,
+            "prefix_requests": 0,
         }
         # in-flight chunk records, oldest first; an attribute (not a loop
         # local) so the failure sweep can fail eagerly-freed requests
@@ -788,23 +849,111 @@ class _ContinuousServer:
                 return c
         return self._step_cands[-1]
 
-    def _prefill_fn(self, t: int, first: bool, last: bool):
-        key = (t, first, last)
+    def _prefill_fn(self, t: int, first: bool, last: bool,
+                    with_col: bool = False):
+        key = (t, first, last, with_col)
         fn = self._prefill_fns.get(key)
         if fn is None:
             import jax
 
             D, cfgc = self._D, self.cfg
 
-            def piece(params_, ids, mask, pos, pool, slot, start, n_prompt):
-                return D.pool_prefill_chunk(
-                    params_, ids, mask, pos, pool, slot, start, n_prompt,
-                    cfgc, first=first, last=last,
-                )
+            if with_col:
+                # cached-path final piece: the prompt's last real token
+                # may sit mid-piece (right-padded layout), so its column
+                # arrives traced
+                def piece(params_, ids, mask, pos, pool, slot, start,
+                          n_prompt, last_col):
+                    return D.pool_prefill_chunk(
+                        params_, ids, mask, pos, pool, slot, start,
+                        n_prompt, cfgc, first=first, last=last,
+                        last_col=last_col,
+                    )
+            else:
+                def piece(params_, ids, mask, pos, pool, slot, start,
+                          n_prompt):
+                    return D.pool_prefill_chunk(
+                        params_, ids, mask, pos, pool, slot, start,
+                        n_prompt, cfgc, first=first, last=last,
+                    )
 
             fn = jax.jit(piece, donate_argnums=(4,))
             self._prefill_fns[key] = fn
         return fn
+
+    def _admit_cached_fn(self, m: int):
+        fn = self._admit_cached_fns.get(m)
+        if fn is None:
+            import jax
+
+            D, cfgc = self._D, self.cfg
+
+            def seed(pool, slot, idxs):
+                return D.pool_admit_cached(pool, slot, idxs, cfgc)
+
+            fn = jax.jit(seed, donate_argnums=(0,))
+            self._admit_cached_fns[m] = fn
+        return fn
+
+    def _extract_fn(self, n: int):
+        fn = self._extract_fns.get(n)
+        if fn is None:
+            import jax
+
+            D, cfgc = self._D, self.cfg
+
+            def extract(pool, slot, start, idxs):
+                return D.kv_extract(pool, slot, start, idxs, cfgc)
+
+            fn = jax.jit(extract, donate_argnums=(0,))
+            self._extract_fns[n] = fn
+        return fn
+
+    def _prefix_insert(self, slot: int, req, e: list, base: int) -> None:
+        """Publish ``slot``'s freshly-prefilled full blocks of prompt
+        ``e`` into the radix tree + arena. ``base`` is the cache column
+        of token 0 (``s - n`` for a left-padded miss admission, 0 for
+        the right-padded cached path). Moves the request's ref to the
+        deepest node so the whole prefix stays pinned while it decodes."""
+        import numpy as np
+
+        from pathway_tpu.engine import probes
+
+        node, first_new, new_ids = self.prefix.insert(e)
+        if new_ids:
+            self.pool = self._extract_fn(len(new_ids))(
+                self.pool, np.int32(slot),
+                np.int32(base + first_new * self.prefix_block),
+                np.asarray(new_ids, np.int32),
+            )
+            probes.record_device_dispatch("prefix_extract")
+        old = self._prefix_nodes.get(req)
+        self.prefix.acquire(node)
+        if old is not None:
+            self.prefix.release(old)
+        self._prefix_nodes[req] = node
+
+    def _prefix_release(self, req) -> None:
+        node = self._prefix_nodes.pop(req, None)
+        if node is not None and self.prefix is not None:
+            self.prefix.release(node)
+
+    def prefix_reset(self) -> None:
+        """Drop every cached prefix and zero the per-server prefix
+        counters (bench: warm up the executables, then measure a clean
+        trace). Only call while no requests are in flight."""
+        if self.prefix is None:
+            return
+        from pathway_tpu.engine.prefix_cache import PrefixCache
+
+        self._prefix_nodes.clear()
+        self.prefix = PrefixCache(
+            n_blocks=self.prefix.capacity_blocks, block=self.prefix.block,
+            block_bytes=self.prefix.block_bytes,
+        )
+        for k in ("prefix_hit_tokens", "prefix_miss_tokens",
+                  "prefix_hit_requests", "prefix_requests"):
+            self.stats[k] = 0
 
     def _loop(self):
         import time as time_mod
@@ -812,6 +961,7 @@ class _ContinuousServer:
         import jax
         import numpy as np
 
+        from pathway_tpu.engine.probes import record_prefix
         from pathway_tpu.ops import next_pow2
 
         active = np.zeros(self.n_slots, dtype=bool)
@@ -933,6 +1083,7 @@ class _ContinuousServer:
                 while self.queue and self.free:
                     admissions.append((self.free.pop(), self.queue.popleft()))
             direct = []
+            direct_inserts = []
             for slot, req in admissions:
                 # the slot record goes in FIRST: if the admit dispatch
                 # raises, the failure sweep still finds (and fails) this
@@ -940,6 +1091,69 @@ class _ContinuousServer:
                 self.slots[slot] = req
                 self._sent[slot] = 0
                 e = req.ids[-self.max_prompt_bucket:]
+                n = len(e)
+                B = self.prefix_block
+                # prefix-cache accounting + match. A hit never reuses the
+                # prompt's FINAL (partial or last-full) block: at least
+                # one suffix token must run through pool_prefill_chunk to
+                # produce the first-token logits.
+                m_hit, arena_ids, node = 0, [], None
+                if self.prefix is not None and n > B:
+                    m, arena_ids, node = self.prefix.match(e)
+                    m_hit = min(m, (n - 1) // B)
+                    hit_t = m_hit * B
+                    record_prefix("requests", 1)
+                    record_prefix("hit_tokens", hit_t)
+                    record_prefix("miss_tokens", n - hit_t)
+                    if m_hit:
+                        record_prefix("hit_requests", 1)
+                        self.stats["prefix_hit_requests"] += 1
+                    self.stats["prefix_requests"] += 1
+                    self.stats["prefix_hit_tokens"] += hit_t
+                    self.stats["prefix_miss_tokens"] += n - hit_t
+                if m_hit >= 1:
+                    # cache hit: pin the matched path, seed the slot's
+                    # cache columns [0, m_hit*B) straight from the arena
+                    # (one copy dispatch, no compute), then prefill only
+                    # the suffix — RIGHT-padded, so token i sits at cache
+                    # column i exactly like the arena blocks expect.
+                    self.prefix.acquire(node)
+                    self._prefix_nodes[req] = node
+                    self.pool = self._admit_cached_fn(m_hit)(
+                        self.pool, np.int32(slot),
+                        np.asarray(arena_ids[:m_hit], np.int32),
+                    )
+                    n_cached = m_hit * B
+                    P = self.prefill_chunk
+                    W = n_cached + -((n_cached - n) // P) * P
+                    r_ids = np.zeros((1, W), np.int32)
+                    r_mask = np.zeros((1, W), np.int32)
+                    r_ids[0, :n] = e
+                    r_mask[0, :n] = 1
+                    pos = np.minimum(
+                        np.arange(W), n - 1
+                    )[None, :].astype(np.int32)
+                    n_prompt = np.asarray([n], np.int32)
+                    pieces = [
+                        (r_ids[:, o:o + P], r_mask[:, o:o + P],
+                         pos[:, o:o + P], o)
+                        for o in range(n_cached, W, P)
+                    ]
+                    # the final piece may end on pad columns: the real
+                    # last token's in-piece column rides along traced
+                    # (None when it IS the final column — static path)
+                    lc = (n - 1) - (W - P)
+                    meta = {
+                        "last_col": None if lc == P - 1 else lc,
+                        "insert": (req, e, 0),
+                    }
+                    self._pending_prefill[slot] = (pieces, n_prompt, meta)
+                    self.stats["admitted"] += 1
+                    continue
+                ins = (
+                    (req, e, 0) if self.prefix is not None and n >= B
+                    else None
+                )
                 s = max(8, next_pow2(max(len(e), 1), 8))
                 ids = np.zeros((1, s), np.int32)
                 mask = np.zeros((1, s), np.int32)
@@ -948,6 +1162,9 @@ class _ContinuousServer:
                     mask[0, s - len(e):] = 1
                 else:
                     mask[0, -1] = 1
+                if ins is not None:
+                    # left-padded admission: token 0 sits at column s-n
+                    ins = (req, e, s - n)
                 if self.chunked_prefill and s > self.prefill_chunk:
                     # split into fixed-size pieces, dispatched ONE per
                     # loop tick below — the active lanes keep decoding
@@ -962,23 +1179,43 @@ class _ContinuousServer:
                         (ids[:, o:o + P], mask[:, o:o + P], pos[:, o:o + P], o)
                         for o in range(0, s, P)
                     ]
-                    self._pending_prefill[slot] = (pieces, n_prompt)
+                    meta = {"insert": ins} if ins is not None else None
+                    self._pending_prefill[slot] = (pieces, n_prompt, meta)
                 else:
                     direct.append((slot, ids, mask, s))
+                    if ins is not None:
+                        direct_inserts.append((slot, ins))
                 self.stats["admitted"] += 1
             admit_direct(direct)
+            for slot, (req_i, e_i, base_i) in direct_inserts:
+                # after the admit dispatch: the slot's KV now holds the
+                # prompt's blocks — publish the new ones into the arena
+                self._prefix_insert(slot, req_i, e_i, base_i)
             for slot in list(self._pending_prefill):
-                pieces, n_prompt = self._pending_prefill[slot]
+                pieces, n_prompt, meta = self._pending_prefill[slot]
                 p_ids, p_mask, p_pos, off = pieces.pop(0)
                 first, last = off == 0, not pieces
-                self.pool = self._prefill_fn(p_ids.shape[1], first, last)(
-                    self.params, p_ids, p_mask, p_pos, self.pool,
-                    np.int32(slot), np.int32(off), n_prompt,
-                )
+                lc = meta.get("last_col") if (meta and last) else None
+                if lc is None:
+                    self.pool = self._prefill_fn(p_ids.shape[1], first, last)(
+                        self.params, p_ids, p_mask, p_pos, self.pool,
+                        np.int32(slot), np.int32(off), n_prompt,
+                    )
+                else:
+                    self.pool = self._prefill_fn(
+                        p_ids.shape[1], first, last, True
+                    )(
+                        self.params, p_ids, p_mask, p_pos, self.pool,
+                        np.int32(slot), np.int32(off), n_prompt,
+                        np.int32(lc),
+                    )
                 self.stats["prefill_chunks"] += 1
                 if last:
                     del self._pending_prefill[slot]
                     active[slot] = True
+                    if meta and meta.get("insert") is not None:
+                        req_i, e_i, base_i = meta["insert"]
+                        self._prefix_insert(slot, req_i, e_i, base_i)
             if not dispatched:
                 # legacy ordering (kill switch off) — or the pool was
                 # empty at the top of the tick and admissions just
@@ -1005,6 +1242,8 @@ class _ContinuousServer:
                     if self.eos_id is not None and t == self.eos_id:
                         req.max_new = 0  # stream closed
                         break
+                    if not req.tokens:
+                        req.first_token_at = time_mod.perf_counter()
                     req.tokens.append(int(t))
                     if len(req.tokens) >= req.max_new:
                         break
@@ -1021,6 +1260,7 @@ class _ContinuousServer:
                         active[slot] = False
                         with self.lock:
                             self.free.append(int(slot))
+                    self._prefix_release(req)
                     req.done.set()
 
     def shutdown(self):

@@ -74,10 +74,15 @@ def test_bench_smoke_schema():
     for key in (
         "throughput_x", "p50_x", "occupancy", "static_tok_s",
         "continuous_tok_s", "measured_path", "direct_api_throughput_x",
-        "direct_api_p50_x",
+        "direct_api_p50_x", "prefix_hit_rate", "prefill_tokens_saved",
+        "ttft_p50_ms",
     ):
         assert srv.get(key) is not None, key
     assert 0.0 < srv["occupancy"] <= 1.0
     # the serving headline must come off the product path, not the bare
     # model API
     assert "pw_ai_answer" in srv["measured_path"]
+    # the shared-prefix trace actually exercised the KV prefix cache
+    assert 0.0 < srv["prefix_hit_rate"] <= 1.0
+    assert srv["prefill_tokens_saved"] > 0
+    assert srv["ttft_p50_ms"] > 0

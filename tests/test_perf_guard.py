@@ -81,3 +81,59 @@ def test_engine_stream_vs_direct_kernel_loop():
         f"stats={stats.engine_tax() if stats else None}"
     )
     assert ratio >= 0.8, f"engine tax exceeded 25% of kernel cost: {detail}"
+
+
+@pytest.mark.slow
+def test_prefix_cache_ttft_not_worse_than_cold():
+    """Shared-prefix trace: warm-cache TTFT must not exceed cold-cache
+    TTFT (PATHWAY_TPU_PREFIX_CACHE). The cached admission replaces a
+    multi-piece prefill of the shared head with one arena copy, so the
+    first token of a hit request can only come earlier. Median over a
+    sequential request train, warm-up outside both timed windows; 15%
+    slack absorbs scheduler jitter on a loaded CI host."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models import decoder as D
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+    from tests.utils import ToyCharTokenizer
+
+    cfg = D.DecoderConfig(
+        vocab_size=128, hidden=64, layers=4, heads=4, intermediate=128,
+        max_position=256, dtype=jnp.float32,
+    )
+    params = D.init_params(jax.random.PRNGKey(0), cfg)
+    head = "x" * 56  # 7 blocks cached, 8..16-token suffix per request
+    prompts = [head + f"q{k:02d}xxxx" for k in range(12)]
+
+    def ttft_p50(prefix_on: bool) -> float:
+        chat = TPUDecoderChat(
+            params=params, cfg=cfg, tokenizer=ToyCharTokenizer(128),
+            max_new_tokens=8, temperature=0.0, max_prompt_tokens=64,
+            continuous=True, n_slots=4, chunk_steps=4, pipeline_depth=2,
+            prefill_chunk=8, prefix_cache=prefix_on, prefix_cache_mb=4,
+        )
+        try:
+            # warm-up: compiles every executable on the measured path
+            # (including, on the ON arm, the insert -> hit pair)
+            for wtail in ("warmAAxx", "warmBBxx"):
+                r = chat.submit_batch([head + wtail])[0]
+                assert r.done.wait(timeout=120)
+            lats = []
+            for p in prompts:
+                t0 = time.perf_counter()
+                r = chat.submit_batch([p])[0]
+                assert r.done.wait(timeout=120)
+                lats.append(r.first_token_at - t0)
+            if prefix_on:
+                assert chat._server.stats["prefix_hit_requests"] > 0
+            return float(np.percentile(np.asarray(lats), 50))
+        finally:
+            chat.close()
+
+    warm = ttft_p50(True)
+    cold = ttft_p50(False)
+    assert warm <= cold * 1.15, (
+        f"warm-cache TTFT {warm * 1e3:.1f}ms exceeds cold-cache "
+        f"{cold * 1e3:.1f}ms"
+    )
