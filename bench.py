@@ -2089,6 +2089,85 @@ def _serving_prefix_trace(params, cfg, tok) -> dict:
     }
 
 
+def _serving_spec_trace(params, cfg, tok) -> dict:
+    """Self-speculative decode + int8 KV on the continuous server
+    (PATHWAY_TPU_SPEC_DECODE / PATHWAY_TPU_KV_QUANT): the same shared-head
+    greedy burst through three servers — spec ON, spec OFF, and spec ON
+    with int8 KV. Greedy accept makes spec-on token streams byte-identical
+    to spec-off (``tokens_match``); the decode throughput pair plus
+    acceptance rate and tokens-per-dispatch quantify what the draft/verify
+    cycles buy on this checkpoint."""
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    if _smoke():
+        NREQ, MAXNEW, N_SLOTS, CHUNK = 8, 12, 4, 8
+    else:
+        NREQ, MAXNEW, N_SLOTS, CHUNK = 48, 48, 16, 8
+    head = "c" * 40 + "ontext: "
+    prompts = [head + f"q{k:02d}tail"[:8].ljust(8, "x") for k in range(NREQ)]
+
+    def run_arm(spec_on: bool, kv_quant: str = ""):
+        chat = TPUDecoderChat(
+            params=params, cfg=cfg, tokenizer=tok,
+            max_new_tokens=MAXNEW, temperature=0.0, max_prompt_tokens=64,
+            continuous=True, n_slots=N_SLOTS, chunk_steps=CHUNK,
+            prefill_chunk=8, prefix_cache=False, spec_decode=spec_on,
+            kv_quant=kv_quant,
+        )
+        try:
+            srv = chat._server
+            # warm-up compiles admission + decode (or spec) executables
+            # outside the timed window
+            for r in chat.submit_batch([head + "warmAAxx"] * 2):
+                r.done.wait(timeout=120)
+            t0 = time.perf_counter()
+            reqs = chat.submit_batch(prompts)
+            toks = []
+            for r in reqs:
+                r.done.wait(timeout=120)
+                toks.append(list(r.tokens))
+            wall = max(r.finished_at for r in reqs) - t0
+            gen = sum(len(t) for t in toks)
+            arm = {
+                "tok_s": round(gen / max(wall, 1e-9), 1),
+                "generated": gen,
+                "wall_s": round(wall, 3),
+                "spec_dispatches": srv.stats["spec_dispatches"],
+                "acceptance_rate": round(srv.spec_acceptance(), 4),
+                "tokens_per_dispatch": round(srv.tokens_per_dispatch(), 4),
+                "kv_bytes_saved": srv.kv_bytes_saved,
+            }
+            return arm, toks
+        finally:
+            chat.close()
+
+    spec_arm, toks_spec = run_arm(True)
+    plain_arm, toks_plain = run_arm(False)
+    quant_arm, toks_quant = run_arm(True, "int8")
+    return {
+        "trace": (
+            f"{NREQ} shared-head greedy requests, {MAXNEW} new tokens "
+            f"each, {N_SLOTS} slots"
+        ),
+        "spec_on": spec_arm,
+        "spec_off": plain_arm,
+        "kv_quant": quant_arm,
+        "acceptance_rate": spec_arm["acceptance_rate"],
+        "tokens_per_dispatch": spec_arm["tokens_per_dispatch"],
+        "spec_on_tok_s": spec_arm["tok_s"],
+        "spec_off_tok_s": plain_arm["tok_s"],
+        "spec_speedup_x": round(
+            spec_arm["tok_s"] / max(plain_arm["tok_s"], 1e-9), 2
+        ),
+        "tokens_match": toks_spec == toks_plain,
+        # int8 streams may legitimately diverge from bf16 (quantization
+        # noise); the quality BOUND (top-1 agreement >= 0.99) is pinned by
+        # tests/test_kv_quant.py — this records whether they did here
+        "kv_quant_tokens_match": toks_quant == toks_spec,
+        "kv_bytes_saved": quant_arm["kv_bytes_saved"],
+    }
+
+
 def _decoder_serving_compare(params, cfg) -> dict:
     """Poisson-arrival serving comparison through ``TPUDecoderChat``,
     measured on the PRODUCT path: both arms play the same trace through
@@ -2272,6 +2351,7 @@ def _decoder_serving_compare(params, cfg) -> dict:
     finally:
         chat_c.close()
     prefix = _serving_prefix_trace(params, cfg, _Tok())
+    spec = _serving_spec_trace(params, cfg, _Tok())
     return {
         # headline figures come from the REST product path
         "poisson_lambda_req_per_s": LAM_REST,
@@ -2293,6 +2373,8 @@ def _decoder_serving_compare(params, cfg) -> dict:
         ),
         # shared-prefix trace: the KV prefix cache's serving claim
         "prefix": prefix,
+        # self-speculative decode + int8 KV arms on the same checkpoint
+        "spec": spec,
         # bare-model comparison (per-request budgets, no engine): kept for
         # continuity with the r4/r5 records
         "direct_api": {
@@ -2531,6 +2613,27 @@ def main() -> None:
             "ttft_p50_ms": (serving_det.get("prefix") or {}).get(
                 "ttft_p50_ms"
             ),
+            "spec_acceptance_rate": (serving_det.get("spec") or {}).get(
+                "acceptance_rate"
+            ),
+            "tokens_per_dispatch": (serving_det.get("spec") or {}).get(
+                "tokens_per_dispatch"
+            ),
+            "spec_tok_s": (serving_det.get("spec") or {}).get(
+                "spec_on_tok_s"
+            ),
+            "plain_tok_s": (serving_det.get("spec") or {}).get(
+                "spec_off_tok_s"
+            ),
+            "spec_speedup_x": (serving_det.get("spec") or {}).get(
+                "spec_speedup_x"
+            ),
+            "kv_quant_tok_s": (
+                (serving_det.get("spec") or {}).get("kv_quant") or {}
+            ).get("tok_s"),
+            "kv_bytes_saved": (serving_det.get("spec") or {}).get(
+                "kv_bytes_saved"
+            ),
         }
         if serving_det and "error" not in serving_det
         else serving_det or None
@@ -2662,8 +2765,16 @@ def main() -> None:
             "continuous_tok_s", "measured_path",
             "direct_api_throughput_x", "direct_api_p50_x",
             "prefix_hit_rate", "prefill_tokens_saved", "ttft_p50_ms",
+            "spec_acceptance_rate", "tokens_per_dispatch",
+            "spec_tok_s", "plain_tok_s", "kv_quant_tok_s",
+            "kv_bytes_saved",
         ):
             _chk(f"summary.serving.{k}", srv.get(k))
+        # acceptance floor on the shared-head trace: the draft stack
+        # should agree with the full model well above chance
+        acc = srv.get("spec_acceptance_rate")
+        if not (isinstance(acc, (int, float)) and acc > 0.3):
+            missing.append("summary.serving.spec_acceptance_rate>0.3")
         bub = s.get("ingest_bubbles") or {}
         for k in ("wall_s", "stages_s", "pct"):
             _chk(f"summary.ingest_bubbles.{k}", bub.get(k))

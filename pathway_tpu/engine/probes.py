@@ -162,6 +162,57 @@ def reset_prefix_stats() -> None:
 
 
 # --------------------------------------------------------------------- #
+# speculative-decode ledger
+#
+# Spec decode trades cheap shallow draft steps for multi-token
+# full-model verifies; whether that wins depends entirely on the
+# acceptance rate, so the ledger's job is to make it observable.
+# ``drafted`` counts draft tokens proposed, ``accepted`` the ones the
+# verify pass kept, ``emitted`` the total tokens produced (accepted +
+# one certain token per lane-cycle), ``verify_steps`` the full-model
+# lane-cycles paid (the unit a plain decode step would also cost) and
+# ``draft_steps`` the shallow lane-steps paid. ``kv_bytes_saved`` is the
+# HBM the int8 pool did NOT allocate vs bf16 (recorded once at pool
+# init). tokens_per_dispatch = emitted / verify_steps is the headline:
+# 1.0 is plain decode, anything above is amortized weight streaming.
+
+_spec_lock = threading.Lock()
+_spec_counts: dict[str, float] = {}
+
+
+def record_spec(kind: str, n: float = 1) -> None:
+    """Account ``n`` of ``kind`` (``drafted`` / ``accepted`` /
+    ``emitted`` / ``verify_steps`` / ``draft_steps`` / ``dispatches`` /
+    ``kv_bytes_saved``). Thread-safe; called by the continuous server's
+    drain (token accounting) and pool init (KV bytes)."""
+    with _spec_lock:
+        _spec_counts[kind] = _spec_counts.get(kind, 0) + n
+
+
+def spec_stats() -> dict:
+    """Snapshot: raw counters plus ``acceptance_rate`` (accepted /
+    drafted; 0.0 before any draft ran) and ``tokens_per_dispatch``
+    (emitted / verify_steps; 1.0 is the plain-decode baseline)."""
+    with _spec_lock:
+        c = dict(_spec_counts)
+    drafted = c.get("drafted", 0)
+    accepted = c.get("accepted", 0)
+    emitted = c.get("emitted", 0)
+    verify = c.get("verify_steps", 0)
+    return {
+        "counts": {k: int(v) for k, v in c.items()},
+        "acceptance_rate": round(accepted / drafted, 4) if drafted else 0.0,
+        "tokens_per_dispatch": round(emitted / verify, 4) if verify else 0.0,
+        "kv_bytes_saved": int(c.get("kv_bytes_saved", 0)),
+    }
+
+
+def reset_spec_stats() -> None:
+    with _spec_lock:
+        _spec_counts.clear()
+
+
+# --------------------------------------------------------------------- #
 # pipeline-stage ledger (bubble attribution)
 #
 # The roofline says HOW FAR the device is from peak; this ledger says

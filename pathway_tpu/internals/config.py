@@ -1,114 +1,23 @@
 """Runtime configuration (reference ``internals/config.py``).
 
-Env vars: PATHWAY_THREADS / PATHWAY_PROCESSES / PATHWAY_PROCESS_ID /
-PATHWAY_FIRST_PORT (worker topology), PATHWAY_IGNORE_ASSERTS,
-PATHWAY_RUNTIME_TYPECHECKING, PATHWAY_PERSISTENT_STORAGE,
-PATHWAY_LICENSE_KEY (accepted, unused — no license gating in this build),
-PATHWAY_FUSION (default on — stateless operator-chain fusion,
-engine/graph.py:fuse_chains), PATHWAY_TPU_COMPILE_CACHE=<dir> (persistent
-XLA compilation cache for the whole package, not just bench.py).
+Worker-topology / persistence env vars: PATHWAY_THREADS /
+PATHWAY_PROCESSES / PATHWAY_PROCESS_ID / PATHWAY_FIRST_PORT,
+PATHWAY_IGNORE_ASSERTS, PATHWAY_RUNTIME_TYPECHECKING,
+PATHWAY_PERSISTENT_STORAGE, PATHWAY_LICENSE_KEY (accepted, unused — no
+license gating in this build), PATHWAY_TPU_COMPILE_CACHE=<dir>
+(persistent XLA compilation cache for the whole package, not just
+bench.py).
 
-Host/device overlap knobs (read per use, like PATHWAY_FUSION, so tests can
-flip them per-run):
-
-* PATHWAY_TPU_PIPELINE (default on) — pipelined ingest in
-  ``models/embedder.py`` (background tokenizer worker + staged h2d +
-  donated dispatch); ``0`` restores the serial submit path.
-* PATHWAY_TPU_PIPELINE_DEPTH (default 2) — dispatch-ahead depth: how many
-  tokenized batches may be staged/dispatched ahead of the oldest
-  unresolved one.
-* PATHWAY_TPU_PIPELINE_QUEUE (default 8) — bound of the raw-text queue
-  feeding the tokenizer worker; ``embed_submit`` blocks (backpressure)
-  once this many batches wait.
-* PATHWAY_TPU_CHUNKED_PREFILL (default on) — continuous serving admits
-  long prompts piece-wise, interleaved with decode chunks
-  (``xpacks/llm/llms.py``); ``0`` restores one-shot admission prefill.
-* PATHWAY_TPU_PREFILL_CHUNK (default 64) — prefill piece length (tokens).
-* PATHWAY_TPU_EAGER_REFILL (default on) — free a decode slot the moment
-  its dispatched steps cover the request budget instead of waiting for
-  the token drain ``pipeline_depth`` chunks later.
-* PATHWAY_TPU_KNN_F32_SCORES (default off) — score KNN with f32 operands
-  instead of the bf16 MXU fast path (``ops/knn.py``).
-* PATHWAY_TPU_FUSED_H2D (default on) — the ingest pipeline ships ids+mask
-  to the device as ONE stacked transfer instead of two
-  (``models/embedder.py``); ``0`` restores split transfers.
-
-Engine close-out knobs (``engine/scheduler.py`` / ``engine/operators``):
-
-* PATHWAY_TPU_COLUMNAR_SUBSCRIBE (default on) — subscribe sinks format
-  per-row callbacks on a background formatter thread, one columnar block
-  per epoch, instead of row-by-row on the scheduler thread
-  (``engine/operators/output.py``); ``0`` restores inline formatting.
-* PATHWAY_TPU_DRAIN_COALESCE (default on) — the deferred-UDF drainer
-  merges consecutively-resolved chunks into ONE injected batch whenever
-  the scheduler still has a backlog, so a drain costs one engine epoch
-  per coalesced group instead of one per chunk
-  (``engine/operators/core.py``); ``0`` restores per-chunk injection.
-* PATHWAY_TPU_DRAIN_COALESCE_MAX (default 8) — most chunks merged into
-  one injection (bounds added latency when the engine stays busy).
-* PATHWAY_TPU_EPOCH_CLOSEOUT (default on) — epoch close-out cuts: the
-  end-of-epoch ``on_time_end`` sweep only visits nodes that override the
-  hook, and batches a producer already proved consolidated skip the
-  re-consolidate scan downstream; ``0`` restores the full sweep + scans.
-
-Serving-admission knobs (``xpacks/llm/llms.py`` / ``models/decoder.py``):
-
-* PATHWAY_TPU_BATCH_ADMIT (default on) — same-bucket queued requests
-  admit into free slots in ONE grouped prefill dispatch
-  (``pool_admit_batch``) instead of one dispatch per request; ``0``
-  restores per-request admission.
-* PATHWAY_TPU_PREFILL_OVERLAP (default on) — the serving loop dispatches
-  the in-flight decode chunk FIRST, then admits/prefills newcomers while
-  the device decodes (they join the next chunk); ``0`` restores
-  admit-then-decode ordering.
-* PATHWAY_TPU_CHUNK_AUTOTUNE (default on) — the serving loop shrinks the
-  decode-chunk step count (halving, floor 4) while requests queue, so
-  chunk boundaries (= admission opportunities and drain points) come
-  sooner under load, and restores the full chunk when the queue is
-  empty; ``0`` pins the constructor's ``chunk_steps``.
-* PATHWAY_TPU_PREFIX_CACHE (default on) — radix-tree KV prefix cache:
-  admission matches the prompt's longest block-aligned cached prefix
-  and seeds the slot's KV from the device arena instead of
-  re-prefilling it (``engine/prefix_cache.py`` + ``pool_admit_cached``);
-  requires chunked prefill. ``0`` restores the PR-4 admission path
-  byte-identically.
-* PATHWAY_TPU_PREFIX_CACHE_MB (default 64) — HBM budget (MB) of the
-  prefix-cache KV arena; sets the arena block count at pool init, with
-  LRU eviction of unreferenced prefixes once full.
-* PATHWAY_TPU_PREFIX_BLOCK (default 0 = prefill chunk) — prefix-cache
-  granularity in tokens; rounded up to a power of two >= the prefill
-  chunk so cached prefixes stay piece-aligned.
-* PATHWAY_TPU_TOKENIZE_CACHE (default on) — content-keyed LRU memo over
-  tokenizer encodes (``models/tokenizer.py`` / ``models/bpe.py``):
-  repeated doc chunks and the shared prompt template skip re-encoding;
-  ``0`` re-encodes every call.
-* PATHWAY_TPU_EMBED_DEDUP (default on) — byte-identical texts reuse
-  their embedding from a content-keyed LRU instead of re-dispatching
-  (``xpacks/llm/embedders.py``); ``0`` re-embeds every occurrence.
-
-Query-path knobs (``ops/fused_query.py`` / ``ops/query_server.py``):
-
-* PATHWAY_TPU_RERANK_CASCADE (default off) — cascaded early-exit rerank:
-  a truncated-depth cheap pass scores all k candidates, only the top
-  survivors pay the full cross-encoder. ``0`` keeps the single full-depth
-  pass (bitwise-identical to the pre-cascade path).
-* PATHWAY_TPU_RERANK_CASCADE_DEPTH (default 0 = auto, layers//2) — how
-  many encoder layers the cheap pass runs.
-* PATHWAY_TPU_RERANK_CASCADE_SURVIVORS (default 0 = auto,
-  max(8, k//2)) — candidates that survive into the full-depth pass.
-* PATHWAY_TPU_RERANK_SEED_WEIGHT (default 0.25) — weight of the
-  retrieval score mixed into the cheap-pass score (seeds the cascade
-  with the signal retrieval already paid for).
-* PATHWAY_TPU_PAIR_BUCKETS (default on) — length-bucketed pair packing:
-  rerank pairs pad to the pow2 bucket of the true max ``q_len + d_len``
-  instead of always the full ``pair_seq``; ``0`` restores full-width
-  padding.
-* PATHWAY_TPU_QUERY_TICK_MS (default 2.0) — micro-batching query-server
-  coalescing window (milliseconds per tick).
-* PATHWAY_TPU_QUERY_MAX_BATCH (default 64) — max queries coalesced into
-  one device dispatch per tick.
-* PATHWAY_TPU_QUERY_QUEUE (default 256) — admission bound; ``submit``
-  blocks (backpressure) once this many requests wait.
+Every performance knob — the ``PATHWAY_TPU_*`` family plus
+``PATHWAY_FUSION`` — is declared exactly once in :data:`FLAG_REGISTRY`
+below: env name, type, default, clamp, and the documentation line.
+``PathwayConfig``'s accessor properties and the README's two flag
+tables are both generated from it (``python -m
+pathway_tpu.internals.config`` prints the tables;
+``tests/test_flag_registry.py`` pins README == registry), so the docs
+cannot drift from the code again. All flags are read per USE, not
+cached at import, so tests can flip them per-run with
+``monkeypatch.setenv``.
 """
 
 from __future__ import annotations
@@ -123,6 +32,351 @@ def _env_bool(name: str, default: bool = False) -> bool:
     if v is None:
         return default
     return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _parse_kv_quant(raw: str) -> str:
+    """``int8`` (or any truthy spelling) enables int8 KV storage; every
+    other value — including the kill switch ``0`` — is full precision."""
+    return "int8" if raw.strip().lower() in (
+        "1", "true", "yes", "on", "int8"
+    ) else ""
+
+
+@dataclass(frozen=True)
+class Flag:
+    """One runtime knob: its env var, how to read it, and its one-line
+    doc. ``attr`` is the ``PathwayConfig`` property name (None for knobs
+    read elsewhere, e.g. by bench.py, that are registered only so the
+    README table includes them); ``group`` places the flag in a README
+    table (``pipeline`` / ``query``); ``minimum`` clamps explicit
+    values (defaults are trusted as-is, matching the historical
+    accessors); ``parse`` overrides the ``kind`` parser."""
+
+    env: str
+    kind: str  # "bool" | "int" | "float" | "str"
+    default: Any
+    doc: str
+    attr: str | None = None
+    group: str | None = None
+    minimum: float | None = None
+    parse: Any = None
+
+    def read(self) -> Any:
+        if self.kind == "bool":
+            return _env_bool(self.env, self.default)
+        raw = os.environ.get(self.env)
+        if raw is None:
+            return self.default
+        if self.parse is not None:
+            return self.parse(raw)
+        val = {"int": int, "float": float, "str": str}[self.kind](raw)
+        if self.minimum is not None:
+            val = max(type(val)(self.minimum), val)
+        return val
+
+    def render_default(self) -> str:
+        if self.kind == "bool":
+            return "1" if self.default else "0"
+        if self.kind == "str":
+            return str(self.default) if self.default else "0"
+        return str(self.default)
+
+
+FLAG_REGISTRY: list[Flag] = [
+    # ---- ungrouped (documented in prose, not a README table) ----------
+    Flag(
+        env="PATHWAY_FUSION", kind="bool", default=True, attr="fusion",
+        doc="Stateless operator-chain fusion (scheduler plan rewrite, "
+            "`engine/graph.py:fuse_chains`); read per scheduler "
+            "construction.",
+    ),
+    # ---- ingest / engine / serving knobs (README 'pipeline' table) ----
+    Flag(
+        env="PATHWAY_TPU_PIPELINE", kind="bool", default=True,
+        attr="tpu_pipeline", group="pipeline",
+        doc="Pipelined `embed_submit`: a background tokenizer worker "
+            "feeds a bounded queue and a dispatch worker stages the next "
+            "batch (`jax.device_put`) while the current one computes, "
+            "launching a donated ping-pong executable. `0` restores the "
+            "fully serial tokenize→h2d→dispatch path (byte-identical "
+            "output either way — `tests/test_embedder_pipeline.py` pins "
+            "it).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PIPELINE_DEPTH", kind="int", default=2,
+        attr="tpu_pipeline_depth", group="pipeline", minimum=1,
+        doc="Dispatch-ahead depth: how many batches may be staged/in "
+            "flight beyond the one computing. Bounds live input buffers "
+            "(donation ping-pongs them) and host run-ahead.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PIPELINE_QUEUE", kind="int", default=8,
+        attr="tpu_pipeline_queue", group="pipeline", minimum=1,
+        doc="Tokenizer→dispatch queue bound; `embed_submit` blocks "
+            "(backpressure) once this many tokenized batches wait.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_CHUNKED_PREFILL", kind="bool", default=True,
+        attr="chunked_prefill", group="pipeline",
+        doc="Continuous serving: admit a long prompt in "
+            "`PATHWAY_TPU_PREFILL_CHUNK`-token pieces interleaved with "
+            "decode chunks, instead of stalling every active lane for "
+            "one monolithic prefill dispatch.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PREFILL_CHUNK", kind="int", default=64,
+        attr="prefill_chunk", group="pipeline", minimum=8,
+        doc="Piece size for chunked prefill (pow2-rounded, min 8). "
+            "Prompt buckets at or below it prefill one-shot.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_EAGER_REFILL", kind="bool", default=True,
+        attr="eager_refill", group="pipeline",
+        doc="Free a serving slot the moment its request's token budget "
+            "is covered by dispatched chunks (tokens drain later from "
+            "in-flight snapshots), instead of waiting for the drain "
+            "thread — the next queued request admits at the same chunk "
+            "boundary.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_KNN_F32_SCORES", kind="bool", default=False,
+        attr="knn_f32_scores", group="pipeline",
+        doc="Brute-force KNN scoring with f32 *operands* (not just f32 "
+            "accumulation). Recovers the bf16-operand recall loss at "
+            "~2× the gemm cost; flip it when recall@k matters more than "
+            "ingest throughput. The bench config-2 phase now reports "
+            "recall BOTH ways (`knn_recall_at_10` bf16, "
+            "`knn_recall_at_10_f32` with this flag) so the trade is in "
+            "the record.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_FUSED_H2D", kind="bool", default=True,
+        attr="fused_h2d", group="pipeline",
+        doc="Ingest host→device transfer as one fused int16 ids+mask "
+            "staging copy instead of per-array puts.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_COLUMNAR_SUBSCRIBE", kind="bool", default=True,
+        attr="columnar_subscribe", group="pipeline",
+        doc="`pw.io.subscribe` formats row callbacks COLUMNARLY on a "
+            "named background thread (`pathway:subscribe:<node>`) per "
+            "epoch, instead of row-by-row on the engine thread. "
+            "Callback order, flush/end placement, and exception "
+            "propagation are pinned by `tests/test_engine_closeout.py`.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_DRAIN_COALESCE", kind="bool", default=True,
+        attr="drain_coalesce", group="pipeline",
+        doc="Deferred-UDF drainer merges consecutive resolved chunks "
+            "into one injected engine batch when the scheduler has no "
+            "other pending work (or the group hits "
+            "`PATHWAY_TPU_DRAIN_COALESCE_MAX`), cutting per-chunk epoch "
+            "overhead on the config-4 path.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_DRAIN_COALESCE_MAX", kind="int", default=8,
+        attr="drain_coalesce_max", group="pipeline", minimum=1,
+        doc="Most resolved chunks merged into one drain injection "
+            "(bounds the latency a coalesced group can add while the "
+            "engine stays busy).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_EPOCH_CLOSEOUT", kind="bool", default=True,
+        attr="epoch_closeout", group="pipeline",
+        doc="Epoch close-out cuts: batches that are provably "
+            "single-sign/distinct carry a consolidation proof through "
+            "column transforms, so `consolidate()` short-circuits "
+            "instead of re-scanning; the end-of-time sweep visits only "
+            "nodes that define `on_time_end`.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_BATCH_ADMIT", kind="bool", default=True,
+        attr="batch_admit", group="pipeline",
+        doc="Continuous serving: requests waiting at the same chunk "
+            "boundary with the same prompt bucket admit through ONE "
+            "grouped `pool_admit_batch` prefill (pow2 group sizes) "
+            "instead of one dispatch per request. Byte-equal tokens "
+            "either way (`tests/test_chunk_admission.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PREFILL_OVERLAP", kind="bool", default=True,
+        attr="prefill_overlap", group="pipeline",
+        doc="Serving loop dispatches the next decode chunk BEFORE "
+            "scanning for admissions, so admission prefills overlap "
+            "in-flight decode instead of serializing ahead of it.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_CHUNK_AUTOTUNE", kind="bool", default=True,
+        attr="chunk_autotune", group="pipeline",
+        doc="Serving loop adapts `chunk_steps` to queue pressure (small "
+            "chunks while requests wait → lower admission latency; "
+            "EMA-sized chunks when idle → fewer dispatches). Moves "
+            "chunk boundaries only, never per-slot token streams.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PREFIX_CACHE", kind="bool", default=True,
+        attr="prefix_cache", group="pipeline",
+        doc="Radix-tree KV prefix cache for continuous serving: "
+            "block-aligned prompt prefixes keep their KV in a device "
+            "arena, and a request whose prompt head is cached admits by "
+            "COPYING arena blocks instead of re-prefilling them (see "
+            "\"Prefix KV cache\" below). `0` removes the arena and the "
+            "tree entirely — serving output is byte-identical to the "
+            "plain chunked-admission path (`tests/test_prefix_cache.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PREFIX_CACHE_MB", kind="float", default=64,
+        attr="prefix_cache_mb", group="pipeline", minimum=0,
+        doc="HBM byte budget for the prefix arena; the block count is "
+            "derived from the model's per-block KV footprint, and LRU "
+            "eviction keeps residency inside it. `0` (or a budget below "
+            "one block) disables the cache.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PREFIX_BLOCK", kind="int", default=0,
+        attr="prefix_block", group="pipeline", minimum=0,
+        doc="Cache block size in tokens; `0` = auto (the prefill "
+            "chunk). Always pow2-rounded up to a multiple of "
+            "`PATHWAY_TPU_PREFILL_CHUNK` so cached prefixes end on "
+            "prefill-piece boundaries.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SPEC_DECODE", kind="bool", default=True,
+        attr="spec_decode", group="pipeline",
+        doc="Self-speculative decoding for greedy continuous serving: "
+            "the first `PATHWAY_TPU_SPEC_DECODE_DRAFT_LAYERS` layers "
+            "draft `PATHWAY_TPU_SPEC_DECODE_K` tokens per cycle and ONE "
+            "full-model dispatch verifies them all, advancing "
+            "1+accepted tokens per weight stream. Token streams are "
+            "byte-identical to plain greedy decode "
+            "(`tests/test_spec_decode.py`); the server latches spec off "
+            "when the measured acceptance rate stays under 0.25, and "
+            "sampling requests (temperature > 0) always take the plain "
+            "path.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SPEC_DECODE_DRAFT_LAYERS", kind="int",
+        default=0, attr="spec_draft_layers", group="pipeline", minimum=0,
+        doc="Draft-stack depth for self-speculative decode; `0` = auto "
+            "(`max(1, layers // 4)`), always clamped to `layers - 1`. "
+            "Deeper drafts agree with the full model more often but "
+            "cost more per drafted token.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SPEC_DECODE_K", kind="int", default=3,
+        attr="spec_k", group="pipeline", minimum=1,
+        doc="Draft tokens proposed per speculative cycle (the verify "
+            "pass scores k+1 positions in one dispatch). Larger k "
+            "amortizes more weight streaming at high acceptance and "
+            "wastes more draft compute at low acceptance.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_KV_QUANT", kind="str", default="",
+        attr="kv_quant", group="pipeline", parse=_parse_kv_quant,
+        doc="`int8` stores the KV slot pool AND the prefix-cache arena "
+            "as symmetric per-(layer, slot, head, token) int8 with f32 "
+            "scales, dequantized on read inside attention — ~1.9× KV "
+            "capacity per HBM byte at head_dim 64, so the same budget "
+            "holds ~2× the slots + cached prefix blocks. `0` (default) "
+            "keeps full-precision KV byte-identically "
+            "(`tests/test_kv_quant.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_TOKENIZE_CACHE", kind="bool", default=True,
+        attr="tokenize_cache", group="pipeline",
+        doc="Content-keyed encode memo in the tokenizers "
+            "(HashTokenizer / WordPiece batch paths and whole-text "
+            "BPE): repeated texts — re-ingested chunks, the serving "
+            "path's shared prompt template — skip re-encoding. "
+            "Size-bounded LRU, per-row parity with the uncached path "
+            "pinned by test.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_EMBED_DEDUP", kind="bool", default=True,
+        attr="embed_dedup", group="pipeline",
+        doc="Content-keyed embedding reuse in "
+            "`SentenceTransformerEmbedder`: byte-identical texts "
+            "(re-ingested unchanged chunks) serve from a bounded LRU "
+            "instead of re-dispatching; an all-hit microbatch never "
+            "touches the device. The ingest bench reports the hit "
+            "ledger under `detail.embed_dedup`.",
+    ),
+    Flag(
+        env="PATHWAY_BENCH_SHARD_ROWS", kind="int", default=1048576,
+        group="pipeline", minimum=1,
+        doc="Rows PER SHARD for the bench config-5 sharded-IVF phase (8 "
+            "virtual-mesh shards); the phase walks a ladder down from "
+            "this target and records `bound_by` when host CPU memory, "
+            "not the design point, set the ceiling.",
+    ),
+    # ---- query-path knobs (README 'query' table) ----------------------
+    Flag(
+        env="PATHWAY_TPU_PAIR_BUCKETS", kind="bool", default=True,
+        attr="pair_buckets", group="query",
+        doc="Pow2 length-bucketed pair packing in the fused rerank. `0` "
+            "pads every pair to the full `pair_seq` window (seed "
+            "behavior).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_RERANK_CASCADE", kind="bool", default=False,
+        attr="rerank_cascade", group="query",
+        doc="Two-stage early-exit rerank inside the single fused "
+            "dispatch. `0` scores every candidate at full depth (seed "
+            "behavior, bitwise with buckets off).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_RERANK_CASCADE_DEPTH", kind="int", default=0,
+        attr="rerank_cascade_depth", group="query", minimum=0,
+        doc="Encoder layers in the cheap pass; `0` = auto "
+            "(`layers//2`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_RERANK_CASCADE_SURVIVORS", kind="int",
+        default=0, attr="rerank_cascade_survivors", group="query",
+        minimum=0,
+        doc="Candidates promoted to the full-depth pass; `0` = auto "
+            "(`max(8, k//2)`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_RERANK_SEED_WEIGHT", kind="float", default=0.25,
+        attr="rerank_seed_weight", group="query",
+        doc="Weight of the (normalized) retrieval score blended into "
+            "the cheap-stage score.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_QUERY_TICK_MS", kind="float", default=2.0,
+        attr="query_tick_ms", group="query", minimum=0,
+        doc="Micro-batch window: how long the first queued query waits "
+            "for companions before the tick dispatches.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_QUERY_MAX_BATCH", kind="int", default=64,
+        attr="query_max_batch", group="query", minimum=1,
+        doc="Max queries coalesced into one tick (rows pad to pow2 "
+            "buckets).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_QUERY_QUEUE", kind="int", default=256,
+        attr="query_queue", group="query", minimum=1,
+        doc="Pending-request bound; `submit` blocks (backpressure) "
+            "beyond it.",
+    ),
+]
+
+
+def render_flag_table(group: str) -> str:
+    """The README flag table for ``group``, generated from the registry
+    (``tests/test_flag_registry.py`` pins the README copy to this)."""
+    lines = [
+        "| Env var | Default | What it controls |",
+        "|---|---|---|",
+    ]
+    for f in FLAG_REGISTRY:
+        if f.group == group:
+            lines.append(
+                f"| `{f.env}` | `{f.render_default()}` | {f.doc} |"
+            )
+    return "\n".join(lines)
 
 
 @dataclass
@@ -159,201 +413,6 @@ class PathwayConfig:
     )
 
     @property
-    def fusion(self) -> bool:
-        """Stateless operator-chain fusion (scheduler plan rewrite).
-        Read per scheduler construction so tests can flip it per-run."""
-        return _env_bool("PATHWAY_FUSION", True)
-
-    @property
-    def tpu_pipeline(self) -> bool:
-        """Pipelined ingest in ``SentenceEmbedderModel`` (background
-        tokenizer worker, staged h2d, donated dispatch). The kill switch:
-        ``PATHWAY_TPU_PIPELINE=0`` restores the serial submit path."""
-        return _env_bool("PATHWAY_TPU_PIPELINE", True)
-
-    @property
-    def tpu_pipeline_depth(self) -> int:
-        """Dispatch-ahead depth of the ingest pipeline: batches staged or
-        dispatched ahead of the oldest unresolved one (>=2 for overlap)."""
-        return max(1, int(os.environ.get("PATHWAY_TPU_PIPELINE_DEPTH", "2")))
-
-    @property
-    def tpu_pipeline_queue(self) -> int:
-        """Bound of the raw-text queue feeding the tokenizer worker;
-        ``embed_submit`` blocks (backpressure) once this many wait."""
-        return max(1, int(os.environ.get("PATHWAY_TPU_PIPELINE_QUEUE", "8")))
-
-    @property
-    def chunked_prefill(self) -> bool:
-        """Continuous serving admits long prompts piece-wise, interleaved
-        with decode chunks, instead of one full-prompt prefill."""
-        return _env_bool("PATHWAY_TPU_CHUNKED_PREFILL", True)
-
-    @property
-    def prefill_chunk(self) -> int:
-        """Prefill piece length (tokens) for chunked admission."""
-        return max(8, int(os.environ.get("PATHWAY_TPU_PREFILL_CHUNK", "64")))
-
-    @property
-    def eager_refill(self) -> bool:
-        """Free a decode slot at DISPATCH time once its dispatched steps
-        cover the request budget, instead of at token-drain time
-        ``pipeline_depth`` chunks later."""
-        return _env_bool("PATHWAY_TPU_EAGER_REFILL", True)
-
-    @property
-    def rerank_cascade(self) -> bool:
-        """Cascaded early-exit rerank: truncated-depth cheap pass over all
-        k candidates, full cross-encoder only on the survivors. Off by
-        default — ``PATHWAY_TPU_RERANK_CASCADE=0`` (or unset) keeps the
-        single full-depth pass bitwise-identical to the pre-cascade path."""
-        return _env_bool("PATHWAY_TPU_RERANK_CASCADE", False)
-
-    @property
-    def rerank_cascade_depth(self) -> int:
-        """Encoder layers the cheap cascade pass runs (0 = auto:
-        ``layers // 2``, minimum 1)."""
-        return max(0, int(os.environ.get("PATHWAY_TPU_RERANK_CASCADE_DEPTH", "0")))
-
-    @property
-    def rerank_cascade_survivors(self) -> int:
-        """Candidates surviving into the full-depth pass (0 = auto:
-        ``max(8, k // 2)`` clamped to k)."""
-        return max(
-            0, int(os.environ.get("PATHWAY_TPU_RERANK_CASCADE_SURVIVORS", "0"))
-        )
-
-    @property
-    def rerank_seed_weight(self) -> float:
-        """Weight of the retrieval score added to the cheap-pass score —
-        the cascade starts from the ranking signal retrieval already paid
-        for instead of from scratch."""
-        return float(os.environ.get("PATHWAY_TPU_RERANK_SEED_WEIGHT", "0.25"))
-
-    @property
-    def pair_buckets(self) -> bool:
-        """Length-bucketed pair packing: rerank pairs pad to the pow2
-        bucket of the true max ``q_len + d_len`` instead of the full
-        ``pair_seq`` window. ``PATHWAY_TPU_PAIR_BUCKETS=0`` restores
-        full-width padding."""
-        return _env_bool("PATHWAY_TPU_PAIR_BUCKETS", True)
-
-    @property
-    def query_tick_ms(self) -> float:
-        """Micro-batching query-server coalescing window (ms per tick)."""
-        return max(
-            0.0, float(os.environ.get("PATHWAY_TPU_QUERY_TICK_MS", "2.0"))
-        )
-
-    @property
-    def query_max_batch(self) -> int:
-        """Max queries coalesced into one device dispatch per tick."""
-        return max(1, int(os.environ.get("PATHWAY_TPU_QUERY_MAX_BATCH", "64")))
-
-    @property
-    def query_queue(self) -> int:
-        """Query-server admission bound; ``submit`` blocks once this many
-        requests wait (backpressure, mirrors the ingest pipeline queue)."""
-        return max(1, int(os.environ.get("PATHWAY_TPU_QUERY_QUEUE", "256")))
-
-    @property
-    def fused_h2d(self) -> bool:
-        """Ship ids+mask to the device as one stacked transfer instead of
-        two per-array transfers (halves per-batch h2d latency overhead)."""
-        return _env_bool("PATHWAY_TPU_FUSED_H2D", True)
-
-    @property
-    def columnar_subscribe(self) -> bool:
-        """Subscribe sinks format per-row callbacks on a background
-        formatter thread, one columnar block per epoch, so the scheduler
-        thread never pays the per-row dict/Pointer packaging. The kill
-        switch ``PATHWAY_TPU_COLUMNAR_SUBSCRIBE=0`` restores inline
-        row-by-row formatting on the scheduler thread."""
-        return _env_bool("PATHWAY_TPU_COLUMNAR_SUBSCRIBE", True)
-
-    @property
-    def drain_coalesce(self) -> bool:
-        """Deferred-UDF drain coalescing: merge consecutively-resolved
-        chunks into one injected batch while the scheduler has a backlog
-        (one engine epoch per group, not per chunk)."""
-        return _env_bool("PATHWAY_TPU_DRAIN_COALESCE", True)
-
-    @property
-    def drain_coalesce_max(self) -> int:
-        """Most resolved chunks merged into one drain injection."""
-        return max(
-            1, int(os.environ.get("PATHWAY_TPU_DRAIN_COALESCE_MAX", "8"))
-        )
-
-    @property
-    def epoch_closeout(self) -> bool:
-        """Epoch close-out cuts: sweep ``on_time_end`` only over nodes
-        that override it, and skip re-consolidating batches a producer
-        already proved consolidated."""
-        return _env_bool("PATHWAY_TPU_EPOCH_CLOSEOUT", True)
-
-    @property
-    def batch_admit(self) -> bool:
-        """Group same-bucket queued requests into one ``pool_admit_batch``
-        prefill dispatch at admission time."""
-        return _env_bool("PATHWAY_TPU_BATCH_ADMIT", True)
-
-    @property
-    def prefill_overlap(self) -> bool:
-        """Dispatch the decode chunk before admission prefills each serving
-        tick, so newcomer prefill work overlaps the in-flight decode."""
-        return _env_bool("PATHWAY_TPU_PREFILL_OVERLAP", True)
-
-    @property
-    def chunk_autotune(self) -> bool:
-        """Auto-shrink decode-chunk steps while requests queue (halving,
-        floor 4) so admission/drain boundaries come sooner under load."""
-        return _env_bool("PATHWAY_TPU_CHUNK_AUTOTUNE", True)
-
-    @property
-    def prefix_cache(self) -> bool:
-        """Radix-tree KV prefix cache over the serving slot pool: cache
-        hits seed a slot's KV from the device arena and prefill only the
-        uncached suffix. ``PATHWAY_TPU_PREFIX_CACHE=0`` restores the
-        match-free admission path byte-identically."""
-        return _env_bool("PATHWAY_TPU_PREFIX_CACHE", True)
-
-    @property
-    def prefix_cache_mb(self) -> float:
-        """HBM budget (MB) of the prefix-cache KV arena (k+v, all
-        layers); fixes the arena block count at pool init."""
-        return max(
-            0.0, float(os.environ.get("PATHWAY_TPU_PREFIX_CACHE_MB", "64"))
-        )
-
-    @property
-    def prefix_block(self) -> int:
-        """Prefix-cache block granularity in tokens (0 = auto: the
-        prefill chunk). The server rounds up to a power of two >= the
-        prefill chunk so cached prefixes stay prefill-piece-aligned."""
-        return max(0, int(os.environ.get("PATHWAY_TPU_PREFIX_BLOCK", "0")))
-
-    @property
-    def tokenize_cache(self) -> bool:
-        """Content-keyed LRU memo over tokenizer encodes: repeated texts
-        (doc chunks on re-ingest, the shared prompt template on serving)
-        skip BPE/WordPiece re-encoding."""
-        return _env_bool("PATHWAY_TPU_TOKENIZE_CACHE", True)
-
-    @property
-    def embed_dedup(self) -> bool:
-        """Embedding dedup: byte-identical texts reuse their embedding
-        from a content-keyed LRU instead of re-dispatching to the
-        device — the incremental-engine analogue of KV prefix reuse."""
-        return _env_bool("PATHWAY_TPU_EMBED_DEDUP", True)
-
-    @property
-    def knn_f32_scores(self) -> bool:
-        """Score KNN with f32 operands (recall-first) instead of the bf16
-        MXU fast path (throughput-first, default)."""
-        return _env_bool("PATHWAY_TPU_KNN_F32_SCORES", False)
-
-    @property
     def threads(self) -> int:
         return int(os.environ.get("PATHWAY_THREADS", "1"))
 
@@ -365,6 +424,24 @@ class PathwayConfig:
     def first_port(self) -> int:
         return int(os.environ.get("PATHWAY_FIRST_PORT", "10000"))
 
+
+def _install_flag_properties() -> None:
+    """Attach one read-per-use property per registry flag. Declared once
+    in :data:`FLAG_REGISTRY`; the property is just ``Flag.read``."""
+    for f in FLAG_REGISTRY:
+        if f.attr is None:
+            continue
+        if hasattr(PathwayConfig, f.attr):  # never shadow a manual attr
+            raise RuntimeError(f"duplicate config attr: {f.attr}")
+
+        def _getter(self, _f=f):
+            return _f.read()
+
+        _getter.__name__ = f.attr
+        setattr(PathwayConfig, f.attr, property(_getter, doc=f.doc))
+
+
+_install_flag_properties()
 
 pathway_config = PathwayConfig()
 
@@ -438,3 +515,13 @@ def set_license_key(key: str | None) -> None:
 
 def set_monitoring_config(*, server_endpoint: str | None) -> None:
     pathway_config.monitoring_server = server_endpoint
+
+
+if __name__ == "__main__":
+    # regenerate the README flag tables (paste between the
+    # <!-- flags:<group> --> markers)
+    for _group in ("pipeline", "query"):
+        print(f"<!-- flags:{_group} -->")
+        print(render_flag_table(_group))
+        print(f"<!-- /flags:{_group} -->")
+        print()

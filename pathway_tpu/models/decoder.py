@@ -131,12 +131,50 @@ def _split_heads(x, nh, hd):
     return x.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)  # (B, nh, S, hd)
 
 
-def _block(x, lp, k, v, mask_bias, cfg: DecoderConfig):
+# ---- int8 KV quantization (PATHWAY_TPU_KV_QUANT=int8) ---------------------
+#
+# Decode streams the whole KV cache from HBM every step, so halving its
+# bytes is a direct decode-throughput lever (the phase runs at ~63.5% HBM
+# util, BENCH_r05). Storage is symmetric per-(layer, slot, head, token)
+# int8: one f32 scale per head-token (max|x| / 127 over the head dim)
+# rides next to the payload, so a head-token costs hd + 4 bytes instead
+# of 2*hd bf16 bytes — 1.88x the slots per HBM byte at hd=64. Writes
+# quantize (`_kv_quant`), reads dequantize inside `_block` just before
+# the attention matmuls; presence of a ``k_scale`` key in the pool dict
+# is the static format marker every pool function branches on.
+
+_KV_QMAX = 127.0
+_KV_SCALE_FLOOR = 1e-8  # all-zero rows (padding) quantize to exact zeros
+
+
+def _kv_quant(x):
+    """Symmetric int8 quantization over the last (head) dim: returns
+    ``(payload int8, scale f32 (..., 1))`` with ``x ~= payload * scale``.
+    By construction ``|x| / scale <= 127`` so the round never clips."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / _KV_QMAX, _KV_SCALE_FLOOR)
+    return jnp.round(xf / scale).astype(jnp.int8), scale
+
+
+def pool_quantized(pool: dict) -> bool:
+    """True when the pool stores int8 KV (``pool_init(kv_quant=True)``)."""
+    return "k_scale" in pool
+
+
+def _block(x, lp, k, v, mask_bias, cfg: DecoderConfig, k_scale=None,
+           v_scale=None):
     """One pre-LN GPT-2 block over ALREADY-PROJECTED k/v (B, nh, Skv, hd).
 
     The caller owns the KV source — the in-sequence keys for prefill, the
     cache for decode — so prefill and decode share one block body and
-    cannot diverge numerically."""
+    cannot diverge numerically. With ``k_scale``/``v_scale`` given
+    ((B, nh, Skv, 1) f32), k/v arrive as int8 payloads and dequantize
+    here, on read — the one place every decode/prefill variant funnels
+    through, so quantized serving cannot fork the numerics either."""
+    if k_scale is not None:
+        k = (k.astype(jnp.float32) * k_scale).astype(cfg.dtype)
+        v = (v.astype(jnp.float32) * v_scale).astype(cfg.dtype)
     # matmul outputs / bias / gelu / residuals stay in cfg.dtype (the MXU
     # accumulates f32 internally; attention SCORES and layernorm statistics
     # stay f32) — same HBM-traffic optimization as the encoder's _layer,
@@ -250,16 +288,26 @@ def prefill(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
 
 def decode_step(params: dict, token: jax.Array, step_pos: jax.Array,
                 slot: jax.Array, slot_mask: jax.Array, cache: dict,
-                cfg: DecoderConfig):
+                cfg: DecoderConfig, n_layers: int | None = None):
     """One decode step. ``token`` (B,), ``step_pos`` (B,) position ids,
     ``slot`` scalar cache slot to write, ``slot_mask`` (B, cache_len) 1 for
     live cache slots INCLUDING the one being written. Returns
-    ``(logits (B, V), cache)``."""
+    ``(logits (B, V), cache)``.
+
+    ``n_layers`` runs only the first N blocks (plus the final LN + tied
+    head) — the cascade-rerank trick (``transformer.encode(n_layers=)``)
+    applied to decode: the shallow stack is the self-speculative DRAFT
+    model, its KV a depth-prefix of the same cache (layers >= N pass
+    through untouched), no second parameter set anywhere."""
     B = token.shape[0]
     x = (params["wte"][token][:, None, :]
          + params["wpe"][step_pos][:, None, :]).astype(cfg.dtype)
     mask_bias = jnp.where(slot_mask[:, None, None, :] > 0, 0.0, -1e9
                           ).astype(jnp.float32)
+    layers, ck, cv = params["layers"], cache["k"], cache["v"]
+    if n_layers is not None:
+        layers = jax.tree.map(lambda a: a[:n_layers], layers)
+        ck, cv = ck[:n_layers], cv[:n_layers]
 
     def body(x, inp):
         lp, kl, vl = inp
@@ -269,9 +317,10 @@ def decode_step(params: dict, token: jax.Array, step_pos: jax.Array,
         x, _, _ = _block(x, lp, kl, vl, mask_bias, cfg)
         return x, (kl, vl)
 
-    x, (ks, vs) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
-    )
+    x, (ks, vs) = jax.lax.scan(body, x, (layers, ck, cv))
+    if n_layers is not None:
+        ks = cache["k"].at[:n_layers].set(ks)
+        vs = cache["v"].at[:n_layers].set(vs)
     return _logits(params, x, cfg)[:, 0, :], {"k": ks, "v": vs}
 
 
@@ -412,7 +461,7 @@ def generate(params: dict, prompt_ids: jax.Array, attention_mask: jax.Array,
 
 def pool_init(params: dict, cfg: DecoderConfig, n_slots: int,
               cache_len: int, arena_blocks: int = 0,
-              arena_block: int = 0) -> dict:
+              arena_block: int = 0, kv_quant: bool = False) -> dict:
     """Empty serving pool: per-slot KV caches, last logits, attention
     slot masks and cursors. ``cache_len`` must cover the largest
     admitted prompt + its budget + one chunk of overrun slack per
@@ -430,22 +479,46 @@ def pool_init(params: dict, cfg: DecoderConfig, n_slots: int,
     state (``engine/prefix_cache.PrefixCache``); the pool functions
     below pass unknown keys through untouched, so the arena rides
     every donated dispatch and device-side data dependencies order
-    extract/insert against prefill and decode for free."""
+    extract/insert against prefill and decode for free.
+
+    ``kv_quant=True`` stores the caches (and the arena) as symmetric
+    per-head-token int8 with f32 scales (``k_scale``/``v_scale``,
+    trailing dim 1) — ~1.88x the tokens per HBM byte at hd=64. Every
+    pool function quantizes on write and ``_block`` dequantizes on
+    read; the ``k_scale`` key doubles as the format marker."""
     L, nh, hd = cfg.layers, cfg.heads, cfg.head_dim
     del params
+    kv_dtype = jnp.int8 if kv_quant else cfg.dtype
     pool = {
-        "k": jnp.zeros((L, n_slots, nh, cache_len, hd), cfg.dtype),
-        "v": jnp.zeros((L, n_slots, nh, cache_len, hd), cfg.dtype),
+        "k": jnp.zeros((L, n_slots, nh, cache_len, hd), kv_dtype),
+        "v": jnp.zeros((L, n_slots, nh, cache_len, hd), kv_dtype),
         "logits": jnp.zeros((n_slots, cfg.vocab_size), jnp.float32),
         "slot_mask": jnp.zeros((n_slots, cache_len), jnp.int32),
         "pos": jnp.zeros((n_slots,), jnp.int32),    # next position id
         "write": jnp.zeros((n_slots,), jnp.int32),  # next cache slot
     }
+    if kv_quant:
+        sshape = (L, n_slots, nh, cache_len, 1)
+        pool["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        pool["v_scale"] = jnp.zeros(sshape, jnp.float32)
     if arena_blocks > 0:
         shape = (arena_blocks, L, nh, arena_block, hd)
-        pool["arena_k"] = jnp.zeros(shape, cfg.dtype)
-        pool["arena_v"] = jnp.zeros(shape, cfg.dtype)
+        pool["arena_k"] = jnp.zeros(shape, kv_dtype)
+        pool["arena_v"] = jnp.zeros(shape, kv_dtype)
+        if kv_quant:
+            ashape = (arena_blocks, L, nh, arena_block, 1)
+            pool["arena_k_scale"] = jnp.zeros(ashape, jnp.float32)
+            pool["arena_v_scale"] = jnp.zeros(ashape, jnp.float32)
     return pool
+
+
+def pool_bytes(pool: dict) -> int:
+    """HBM bytes of the pool's KV storage (caches + arena + scales) —
+    the denominator of the kv_quant capacity claim."""
+    keys = ("k", "v", "k_scale", "v_scale", "arena_k", "arena_v",
+            "arena_k_scale", "arena_v_scale")
+    return sum(int(pool[c].size) * pool[c].dtype.itemsize
+               for c in keys if c in pool)
 
 
 def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,
@@ -456,11 +529,23 @@ def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,
     C = pool["k"].shape[3]
     S = ids.shape[1]
     last_logits, cache = prefill(params, ids, mask, cfg, cache_len=C)
+    upd = {}
+    if pool_quantized(pool):
+        ck, sk = _kv_quant(cache["k"])
+        cv, sv = _kv_quant(cache["v"])
+        upd["k_scale"] = jax.lax.dynamic_update_slice(
+            pool["k_scale"], sk, (0, slot, 0, 0, 0)
+        )
+        upd["v_scale"] = jax.lax.dynamic_update_slice(
+            pool["v_scale"], sv, (0, slot, 0, 0, 0)
+        )
+    else:
+        ck, cv = cache["k"], cache["v"]
     k = jax.lax.dynamic_update_slice(
-        pool["k"], cache["k"].astype(pool["k"].dtype), (0, slot, 0, 0, 0)
+        pool["k"], ck.astype(pool["k"].dtype), (0, slot, 0, 0, 0)
     )
     v = jax.lax.dynamic_update_slice(
-        pool["v"], cache["v"].astype(pool["v"].dtype), (0, slot, 0, 0, 0)
+        pool["v"], cv.astype(pool["v"].dtype), (0, slot, 0, 0, 0)
     )
     row_mask = jnp.concatenate(
         [mask.astype(jnp.int32), jnp.zeros((1, C - S), jnp.int32)], axis=1
@@ -476,7 +561,7 @@ def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,
     write = jax.lax.dynamic_update_slice(
         pool["write"], jnp.full((1,), S, jnp.int32), (slot,)
     )
-    return {**pool, "k": k, "v": v, "logits": logits,
+    return {**pool, **upd, "k": k, "v": v, "logits": logits,
             "slot_mask": slot_mask, "pos": pos, "write": write}
 
 
@@ -496,8 +581,16 @@ def pool_admit_batch(params: dict, ids: jax.Array, mask: jax.Array,
     C = pool["k"].shape[3]
     M, S = ids.shape
     last_logits, cache = prefill(params, ids, mask, cfg, cache_len=C)
-    k = pool["k"].at[:, slots].set(cache["k"].astype(pool["k"].dtype))
-    v = pool["v"].at[:, slots].set(cache["v"].astype(pool["v"].dtype))
+    upd = {}
+    if pool_quantized(pool):
+        ck, sk = _kv_quant(cache["k"])
+        cv, sv = _kv_quant(cache["v"])
+        upd["k_scale"] = pool["k_scale"].at[:, slots].set(sk)
+        upd["v_scale"] = pool["v_scale"].at[:, slots].set(sv)
+    else:
+        ck, cv = cache["k"], cache["v"]
+    k = pool["k"].at[:, slots].set(ck.astype(pool["k"].dtype))
+    v = pool["v"].at[:, slots].set(cv.astype(pool["v"].dtype))
     row_mask = jnp.concatenate(
         [mask.astype(jnp.int32), jnp.zeros((M, C - S), jnp.int32)], axis=1
     )
@@ -506,7 +599,7 @@ def pool_admit_batch(params: dict, ids: jax.Array, mask: jax.Array,
     n_prompt = jnp.sum(mask, axis=1).astype(jnp.int32)  # (M,)
     pos = pool["pos"].at[slots].set(n_prompt)
     write = pool["write"].at[slots].set(jnp.full((M,), S, jnp.int32))
-    return {**pool, "k": k, "v": v, "logits": logits,
+    return {**pool, **upd, "k": k, "v": v, "logits": logits,
             "slot_mask": slot_mask, "pos": pos, "write": write}
 
 
@@ -566,9 +659,23 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
     allowed = (row_mask[:, None, None, :] > 0) & (idxs <= qpos)
     mask_bias = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
 
+    quant = pool_quantized(pool)
+
     def layer(x, inp):
-        lp, kl, vl = inp
+        lp, kl, vl, ksl, vsl = inp
         k_new, v_new = _prefill_kv(x, lp, cfg)  # (1, nh, T, hd)
+        ks_row = vs_row = None
+        if quant:
+            k_new, sk = _kv_quant(k_new)
+            v_new, sv = _kv_quant(v_new)
+            ksl = jax.lax.dynamic_update_slice(ksl, sk, (slot, 0, start, 0))
+            vsl = jax.lax.dynamic_update_slice(vsl, sv, (slot, 0, start, 0))
+            ks_row = jax.lax.dynamic_slice(
+                ksl, (slot, 0, 0, 0), (1, nh, C, 1)
+            )
+            vs_row = jax.lax.dynamic_slice(
+                vsl, (slot, 0, 0, 0), (1, nh, C, 1)
+            )
         kl = jax.lax.dynamic_update_slice(
             kl, k_new.astype(kl.dtype), (slot, 0, start, 0)
         )
@@ -577,11 +684,18 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
         )
         k_row = jax.lax.dynamic_slice(kl, (slot, 0, 0, 0), (1, nh, C, hd))
         v_row = jax.lax.dynamic_slice(vl, (slot, 0, 0, 0), (1, nh, C, hd))
-        x, _, _ = _block(x, lp, k_row, v_row, mask_bias, cfg)
-        return x, (kl, vl)
+        x, _, _ = _block(x, lp, k_row, v_row, mask_bias, cfg,
+                         k_scale=ks_row, v_scale=vs_row)
+        return x, (kl, vl, ksl, vsl)
 
-    x, (k, v) = jax.lax.scan(layer, x, (params["layers"], pool["k"], pool["v"]))
+    x, (k, v, ks, vs) = jax.lax.scan(
+        layer, x,
+        (params["layers"], pool["k"], pool["v"],
+         pool.get("k_scale"), pool.get("v_scale")),
+    )
     out = {**pool, "k": k, "v": v, "slot_mask": slot_mask}
+    if quant:
+        out["k_scale"], out["v_scale"] = ks, vs
     if last:
         if last_col is None:
             x_last = x[:, -1:, :]
@@ -602,6 +716,16 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
     return out
 
 
+def _kv_channels(pool: dict) -> list[tuple[str, str]]:
+    """(cache key, arena key) pairs the block copies move — the int8
+    scale planes ride along whenever the pool is quantized, so extract/
+    insert/admit_cached stay format-agnostic."""
+    ch = [("k", "arena_k"), ("v", "arena_v")]
+    if pool_quantized(pool):
+        ch += [("k_scale", "arena_k_scale"), ("v_scale", "arena_v_scale")]
+    return ch
+
+
 def kv_extract(pool: dict, slot: jax.Array, start: jax.Array,
                idxs: jax.Array, cfg: DecoderConfig) -> dict:
     """Copy the block-aligned KV span ``[start, start + n*block)`` of
@@ -611,15 +735,16 @@ def kv_extract(pool: dict, slot: jax.Array, start: jax.Array,
     compute — so the cached bytes are bit-identical to what the slot
     holds. jit per n; ``slot``/``start``/``idxs`` are traced."""
     del cfg
-    L, _, nh, _, hd = pool["k"].shape
+    L, _, nh, _, _ = pool["k"].shape
     Bk = pool["arena_k"].shape[3]
     n = idxs.shape[0]
     out = dict(pool)
-    for c, a in (("k", "arena_k"), ("v", "arena_v")):
+    for c, a in _kv_channels(pool):
+        d = pool[c].shape[-1]  # hd for payloads, 1 for scale planes
         span = jax.lax.dynamic_slice(
-            pool[c], (0, slot, 0, start, 0), (L, 1, nh, n * Bk, hd)
+            pool[c], (0, slot, 0, start, 0), (L, 1, nh, n * Bk, d)
         )
-        span = span[:, 0].reshape(L, nh, n, Bk, hd).transpose(2, 0, 1, 3, 4)
+        span = span[:, 0].reshape(L, nh, n, Bk, d).transpose(2, 0, 1, 3, 4)
         out[a] = pool[a].at[idxs].set(span)
     return out
 
@@ -633,13 +758,14 @@ def kv_insert(pool: dict, slot: jax.Array, start: jax.Array,
     prompt ALSO places token i at cache column i (right-padded
     admission, ``start = 0``). jit per n; traced like extract."""
     del cfg
-    L, _, nh, _, hd = pool["k"].shape
+    L, _, nh, _, _ = pool["k"].shape
     Bk = pool["arena_k"].shape[3]
     n = idxs.shape[0]
     out = dict(pool)
-    for c, a in (("k", "arena_k"), ("v", "arena_v")):
-        span = pool[a][idxs]  # (n, L, nh, Bk, hd)
-        span = span.transpose(1, 2, 0, 3, 4).reshape(L, nh, n * Bk, hd)
+    for c, a in _kv_channels(pool):
+        d = pool[c].shape[-1]
+        span = pool[a][idxs]  # (n, L, nh, Bk, d)
+        span = span.transpose(1, 2, 0, 3, 4).reshape(L, nh, n * Bk, d)
         out[c] = jax.lax.dynamic_update_slice(
             pool[c], span[:, None], (0, slot, 0, start, 0)
         )
@@ -686,6 +812,7 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
     b_idx = jnp.arange(B)
     act_i = active.astype(jnp.int32)
     act_b = active[:, None, None]
+    quant = pool_quantized(pool)
 
     def sample(logits, k):
         if temperature == 0.0:
@@ -694,7 +821,7 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
         return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
 
     def body(carry, _):
-        k_c, v_c, logits, slot_mask, pos, write, key = carry
+        k_c, v_c, ks_c, vs_c, logits, slot_mask, pos, write, key = carry
         key, sub = jax.random.split(key)
         tok = sample(logits, sub)
         w = jnp.minimum(write, C - 1)
@@ -711,8 +838,17 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
         ).astype(jnp.float32)
 
         def layer(x, inp):
-            lp, kl, vl = inp
+            lp, kl, vl, ksl, vsl = inp
             k_new, v_new = _prefill_kv(x, lp, cfg)  # (B, nh, 1, hd)
+            if quant:
+                k_new, sk = _kv_quant(k_new)
+                v_new, sv = _kv_quant(v_new)
+                ksl = ksl.at[b_idx, :, w, :].set(
+                    jnp.where(act_b, sk[:, :, 0, :], ksl[b_idx, :, w, :])
+                )
+                vsl = vsl.at[b_idx, :, w, :].set(
+                    jnp.where(act_b, sv[:, :, 0, :], vsl[b_idx, :, w, :])
+                )
             # per-ROW write position (each lane is at its own slot)
             kl = kl.at[b_idx, :, w, :].set(
                 jnp.where(act_b, k_new[:, :, 0, :], kl[b_idx, :, w, :])
@@ -720,29 +856,274 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
             vl = vl.at[b_idx, :, w, :].set(
                 jnp.where(act_b, v_new[:, :, 0, :], vl[b_idx, :, w, :])
             )
-            x, _, _ = _block(x, lp, kl, vl, mask_bias, cfg)
-            return x, (kl, vl)
+            x, _, _ = _block(x, lp, kl, vl, mask_bias, cfg,
+                             k_scale=ksl, v_scale=vsl)
+            return x, (kl, vl, ksl, vsl)
 
-        x, (k_c, v_c) = jax.lax.scan(
-            layer, x, (params["layers"], k_c, v_c)
+        x, (k_c, v_c, ks_c, vs_c) = jax.lax.scan(
+            layer, x, (params["layers"], k_c, v_c, ks_c, vs_c)
         )
         new_logits = _logits(params, x, cfg)[:, 0, :]
         logits = jnp.where(active[:, None], new_logits, logits)
-        return (k_c, v_c, logits, slot_mask, pos + act_i,
+        return (k_c, v_c, ks_c, vs_c, logits, slot_mask, pos + act_i,
                 write + act_i, key), tok
 
-    (k_c, v_c, logits, slot_mask, pos, write, _), toks = jax.lax.scan(
-        body,
-        (pool["k"], pool["v"], pool["logits"], pool["slot_mask"],
-         pool["pos"], pool["write"], key),
-        None,
-        length=n_steps,
+    (k_c, v_c, ks_c, vs_c, logits, slot_mask, pos, write, _), toks = \
+        jax.lax.scan(
+            body,
+            (pool["k"], pool["v"], pool.get("k_scale"), pool.get("v_scale"),
+             pool["logits"], pool["slot_mask"], pool["pos"], pool["write"],
+             key),
+            None,
+            length=n_steps,
+        )
+    out = {**pool, "k": k_c, "v": v_c, "logits": logits,
+           "slot_mask": slot_mask, "pos": pos, "write": write}
+    if quant:
+        out["k_scale"], out["v_scale"] = ks_c, vs_c
+    return out, toks
+
+
+# ---- self-speculative decoding --------------------------------------------
+#
+# Decode is memory-bound: every step streams the full parameter set +
+# the live KV from HBM to emit ONE token per lane. Self-speculative
+# decode amortizes that stream: the first D layers of the SAME model
+# (the cascade's first-N-layers trick, transformer.encode(n_layers=))
+# draft k cheap continuation tokens, then ONE full-model pass scores
+# all k+1 positions at once — a multi-token verify streams the weights
+# once, exactly like one plain step. The longest draft prefix matching
+# the full model's argmaxes is accepted, so with acceptance rate a the
+# pool advances 1+a*k tokens per weight-stream instead of 1, and with
+# a = 0 it still advances 1 (the cycle's first token needs no draft to
+# be correct). Greedy-only: acceptance compares argmaxes, which makes
+# spec-on output BYTE-IDENTICAL to plain greedy decode by construction.
+# No second model, no extra params: the draft's KV is a depth-prefix of
+# the same slot pool.
+
+
+def _draft_scan(params, cfg: DecoderConfig, kd, vd, ksd, vsd, slot_mask,
+                pos, w, t0, active, n_draft: int):
+    """``n_draft`` greedy draft steps over a DEPTH-PREFIX KV stack.
+
+    ``kd``/``vd`` carry the first D layers' caches only (D = their
+    leading dim); ``ksd``/``vsd`` are the matching scale planes (None
+    when unquantized). Starting from certain token ``t0`` at cache
+    column ``w`` / position ``pos``, each step writes the fed token's
+    shallow KV at its column and predicts the next via the final LN +
+    tied head over the truncated stack. Returns ``(drafts (B, n_draft),
+    kd, vd, ksd, vsd)`` — the drafted continuation d_1..d_k and the
+    updated depth-prefix (callers fusing a verify pass discard it: the
+    verify rewrites those columns for ALL layers)."""
+    D = kd.shape[0]
+    layers_d = jax.tree.map(lambda a: a[:D], params["layers"])
+    B, C = t0.shape[0], kd.shape[3]
+    b_idx = jnp.arange(B)
+    act_b = active[:, None, None]
+    idxs = jnp.arange(C)[None, :]
+    quant = ksd is not None
+
+    def step(carry, j):
+        kd, vd, ksd, vsd, tok = carry
+        col = jnp.minimum(w + j, C - 1)
+        p = jnp.clip(pos + j, 0, cfg.max_position - 1)
+        x = (params["wte"][tok][:, None, :]
+             + params["wpe"][p][:, None, :]).astype(cfg.dtype)
+        # attend the live cache plus every column this cycle already
+        # wrote (w..col) — the draft's own freshly-drafted context
+        allowed = (slot_mask > 0) | ((idxs >= w[:, None])
+                                     & (idxs <= col[:, None]))
+        mask_bias = jnp.where(allowed, 0.0, -1e9
+                              ).astype(jnp.float32)[:, None, None, :]
+
+        def layer(x, inp):
+            lp, kl, vl, ksl, vsl = inp
+            k_new, v_new = _prefill_kv(x, lp, cfg)  # (B, nh, 1, hd)
+            if quant:
+                k_new, sk = _kv_quant(k_new)
+                v_new, sv = _kv_quant(v_new)
+                ksl = ksl.at[b_idx, :, col, :].set(
+                    jnp.where(act_b, sk[:, :, 0, :],
+                              ksl[b_idx, :, col, :])
+                )
+                vsl = vsl.at[b_idx, :, col, :].set(
+                    jnp.where(act_b, sv[:, :, 0, :],
+                              vsl[b_idx, :, col, :])
+                )
+            kl = kl.at[b_idx, :, col, :].set(
+                jnp.where(act_b, k_new[:, :, 0, :], kl[b_idx, :, col, :])
+            )
+            vl = vl.at[b_idx, :, col, :].set(
+                jnp.where(act_b, v_new[:, :, 0, :], vl[b_idx, :, col, :])
+            )
+            x, _, _ = _block(x, lp, kl, vl, mask_bias, cfg,
+                             k_scale=ksl, v_scale=vsl)
+            return x, (kl, vl, ksl, vsl)
+
+        x, (kd, vd, ksd, vsd) = jax.lax.scan(
+            layer, x, (layers_d, kd, vd, ksd, vsd)
+        )
+        nxt = jnp.argmax(_logits(params, x, cfg)[:, 0, :], axis=-1
+                         ).astype(jnp.int32)
+        return (kd, vd, ksd, vsd, nxt), nxt
+
+    (kd, vd, ksd, vsd, _), drafts = jax.lax.scan(
+        step, (kd, vd, ksd, vsd, t0), jnp.arange(n_draft)
     )
-    return (
-        {**pool, "k": k_c, "v": v_c, "logits": logits,
-         "slot_mask": slot_mask, "pos": pos, "write": write},
-        toks,
+    return drafts.T, kd, vd, ksd, vsd  # drafts (B, n_draft)
+
+
+def pool_decode_draft(params: dict, pool: dict, active: jax.Array,
+                      cfg: DecoderConfig, *, draft_layers: int,
+                      n_draft: int) -> jax.Array:
+    """Draft ``n_draft`` greedy tokens per active lane with the first
+    ``draft_layers`` layers of the stack. Pure with respect to the pool:
+    the shallow KV writes live in a local copy of the depth-prefix, so a
+    discarded draft costs nothing — :func:`pool_decode_spec`'s verify
+    pass owns every persistent write. Exposed standalone for tests and
+    draft-quality probing; the serving path uses the fused cycle."""
+    C = pool["k"].shape[3]
+    D = draft_layers
+    quant = pool_quantized(pool)
+    t0 = jnp.argmax(pool["logits"], axis=-1).astype(jnp.int32)
+    w = jnp.minimum(pool["write"], C - n_draft)
+    drafts, *_ = _draft_scan(
+        params, cfg, pool["k"][:D], pool["v"][:D],
+        pool["k_scale"][:D] if quant else None,
+        pool["v_scale"][:D] if quant else None,
+        pool["slot_mask"], pool["pos"], w, t0, active, n_draft,
     )
+    return drafts
+
+
+def pool_decode_spec(params: dict, pool: dict, active: jax.Array,
+                     cfg: DecoderConfig, n_cycles: int, *,
+                     draft_layers: int, n_spec: int):
+    """``n_cycles`` draft/verify/accept cycles over every active lane in
+    ONE dispatch — the speculative counterpart of
+    :func:`pool_decode_chunk` (greedy only).
+
+    Per cycle: (1) the staged logits' argmax is the cycle's first token
+    t0 — plain greedy decode would emit exactly it, so it is CERTAIN;
+    (2) the first ``draft_layers`` layers draft ``n_spec`` continuation
+    tokens one step at a time (:func:`_draft_scan`); (3) one full-model
+    pass scores all ``n_spec + 1`` positions at once, writing their KV
+    at columns ``w..w+n_spec`` — its per-position logits are elementwise
+    what sequential decode would produce, because layer i at position t
+    reads only layers < i at positions <= t (the same invariant the
+    chunked-prefill byte-equality tests pin); (4) the longest draft
+    prefix matching the full model's argmaxes is accepted: the lane
+    emits ``1 + accepted`` tokens, the staged logits become the verify
+    logits at the last accepted position (their argmax IS the
+    correction token — it becomes the next cycle's certain t0), and the
+    rejected tail's columns simply stay masked out of ``slot_mask`` —
+    the rewind is a mask, not a copy; the next cycle's verify overwrites
+    them. Inactive lanes compute but do not advance.
+
+    Returns ``(pool, toks (n_cycles, n_slots, n_spec + 1), n_emit
+    (n_cycles, n_slots))``: the host consumes each cycle's first
+    ``n_emit`` tokens per lane and ignores the rest."""
+    B = pool["logits"].shape[0]
+    C = pool["k"].shape[3]
+    D, k = draft_layers, n_spec
+    quant = pool_quantized(pool)
+    b_idx = jnp.arange(B)
+    idxs = jnp.arange(C)
+    offs = jnp.arange(k + 1)
+    act_bt = active[:, None, None, None]
+
+    def cycle(carry, _):
+        k_c, v_c, ks_c, vs_c, logits, slot_mask, pos, write = carry
+        # verify writes k+1 columns; clamp like pool_decode_chunk's w so
+        # an over-budget lane (tokens still draining) never writes past
+        # the cache — the host sizes slack so live lanes never clamp
+        w = jnp.minimum(write, C - 1 - k)
+        t0 = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        drafts, *_ = _draft_scan(
+            params, cfg, k_c[:D], v_c[:D],
+            ks_c[:D] if quant else None, vs_c[:D] if quant else None,
+            slot_mask, pos, w, t0, active, k,
+        )
+        u = jnp.concatenate([t0[:, None], drafts], axis=1)  # (B, k+1)
+        p = jnp.clip(pos[:, None] + offs[None, :], 0, cfg.max_position - 1)
+        x = (params["wte"][u] + params["wpe"][p]).astype(cfg.dtype)
+        qcol = w[:, None] + offs[None, :]  # (B, k+1) per-query column
+        # query i attends the live cache plus this cycle's columns up to
+        # its own (w..w+i) — causal within the speculated window, the
+        # union of what i sequential decode steps would each have seen
+        allowed = (slot_mask[:, None, :] > 0) | (
+            (idxs[None, None, :] >= w[:, None, None])
+            & (idxs[None, None, :] <= qcol[:, :, None])
+        )
+        mask_bias = jnp.where(allowed, 0.0, -1e9
+                              ).astype(jnp.float32)[:, None, :, :]
+
+        def vlayer(x, inp):
+            lp, kl, vl, ksl, vsl = inp
+            k_new, v_new = _prefill_kv(x, lp, cfg)  # (B, nh, k+1, hd)
+            kt = k_new.transpose(0, 2, 1, 3)  # (B, k+1, nh, hd)
+            vt = v_new.transpose(0, 2, 1, 3)
+            if quant:
+                kt, skt = _kv_quant(kt)
+                vt, svt = _kv_quant(vt)
+                ksl = ksl.at[b_idx[:, None], :, qcol, :].set(
+                    jnp.where(act_bt, skt,
+                              ksl[b_idx[:, None], :, qcol, :])
+                )
+                vsl = vsl.at[b_idx[:, None], :, qcol, :].set(
+                    jnp.where(act_bt, svt,
+                              vsl[b_idx[:, None], :, qcol, :])
+                )
+            # advanced indexing (b, col) pairs land each row's k+1 new
+            # entries at ITS columns; inactive lanes keep their bytes
+            kl = kl.at[b_idx[:, None], :, qcol, :].set(
+                jnp.where(act_bt, kt.astype(kl.dtype),
+                          kl[b_idx[:, None], :, qcol, :])
+            )
+            vl = vl.at[b_idx[:, None], :, qcol, :].set(
+                jnp.where(act_bt, vt.astype(vl.dtype),
+                          vl[b_idx[:, None], :, qcol, :])
+            )
+            x, _, _ = _block(x, lp, kl, vl, mask_bias, cfg,
+                             k_scale=ksl, v_scale=vsl)
+            return x, (kl, vl, ksl, vsl)
+
+        x, (k_c, v_c, ks_c, vs_c) = jax.lax.scan(
+            vlayer, x, (params["layers"], k_c, v_c, ks_c, vs_c)
+        )
+        out_logits = _logits(params, x, cfg)  # (B, k+1, V) f32
+        g = jnp.argmax(out_logits, axis=-1).astype(jnp.int32)  # (B, k+1)
+        # g[:, i] is the TRUE next token after u_0..u_i; accept drafts
+        # while they match it — the longest greedy-agreeing prefix
+        match = (drafts == g[:, :k]).astype(jnp.int32)
+        acc = jnp.cumprod(match, axis=1).sum(axis=1)  # (B,) in [0, k]
+        n_emit = jnp.where(active, acc + 1, 0).astype(jnp.int32)
+        # the logits AT the last accepted position: their argmax is the
+        # correction token g_acc — the next cycle's certain t0, so a
+        # rejected draft costs nothing beyond its wasted column
+        new_logits = jnp.take_along_axis(
+            out_logits, acc[:, None, None], axis=1
+        )[:, 0, :]
+        logits = jnp.where(active[:, None], new_logits, logits)
+        # accept = mask in columns w..w+acc; the rejected tail's KV
+        # stays masked (and is overwritten by the next cycle's verify)
+        live = ((idxs[None, :] >= w[:, None])
+                & (idxs[None, :] <= (w + acc)[:, None])
+                & active[:, None])
+        slot_mask = jnp.where(live, 1, slot_mask)
+        return (k_c, v_c, ks_c, vs_c, logits, slot_mask,
+                pos + n_emit, write + n_emit), (u, n_emit)
+
+    carry0 = (pool["k"], pool["v"], pool.get("k_scale"),
+              pool.get("v_scale"), pool["logits"], pool["slot_mask"],
+              pool["pos"], pool["write"])
+    (k_c, v_c, ks_c, vs_c, logits, slot_mask, pos, write), (toks, n_emit) = \
+        jax.lax.scan(cycle, carry0, None, length=n_cycles)
+    out = {**pool, "k": k_c, "v": v_c, "logits": logits,
+           "slot_mask": slot_mask, "pos": pos, "write": write}
+    if quant:
+        out["k_scale"], out["v_scale"] = ks_c, vs_c
+    return out, toks, n_emit
 
 
 def cast_params_for_inference(params: dict, cfg: DecoderConfig) -> dict:

@@ -245,6 +245,10 @@ class TPUDecoderChat(BaseChat):
         prefix_cache: bool | None = None,
         prefix_cache_mb: float | None = None,
         prefix_block: int | None = None,
+        spec_decode: bool | None = None,
+        spec_draft_layers: int | None = None,
+        spec_k: int | None = None,
+        kv_quant: str | bool | None = None,
     ):
         # continuous=True: requests are served by a persistent slot-pool
         # loop (_ContinuousServer) — new rows admit into the IN-FLIGHT
@@ -323,6 +327,10 @@ class TPUDecoderChat(BaseChat):
                 prefix_cache=prefix_cache,
                 prefix_cache_mb=prefix_cache_mb,
                 prefix_block=prefix_block,
+                spec_decode=spec_decode,
+                spec_draft_layers=spec_draft_layers,
+                spec_k=spec_k,
+                kv_quant=kv_quant,
             )
             # the two-phase engine protocol only exists in continuous
             # mode — exposing these as CLASS methods would activate the
@@ -554,7 +562,20 @@ class _ContinuousServer:
       slots sooner); an idle queue grows it back toward the
       constructor value (fewer dispatches per token). Candidates are
       halvings of the constructor value, so the KV-cache slack sizing
-      stays valid."""
+      stays valid.
+    * **self-speculative decode** (PATHWAY_TPU_SPEC_DECODE, greedy
+      servers only) — decode chunks become draft/verify/accept cycles:
+      the first ``PATHWAY_TPU_SPEC_DECODE_DRAFT_LAYERS`` layers draft
+      ``PATHWAY_TPU_SPEC_DECODE_K`` tokens against a depth-prefix of
+      the same KV pool and ONE full-model dispatch verifies all of
+      them, emitting 1..k+1 byte-identical greedy tokens per lane per
+      weight stream (``pool_decode_spec``). The drain keeps an
+      acceptance-rate EMA and latches back to plain chunks when the
+      drafts stop paying (< 0.25 after 4 drains).
+    * **int8 KV** (PATHWAY_TPU_KV_QUANT=int8) — the slot pool and the
+      prefix arena store KV as symmetric int8 + f32 per-token scales
+      (~2x slots and cached blocks per HBM byte), dequantized on read
+      inside attention."""
 
     def __init__(self, params, cfg, tokenizer, *, n_slots: int,
                  chunk_steps: int, max_prompt_tokens: int,
@@ -565,12 +586,17 @@ class _ContinuousServer:
                  eager_refill: bool | None = None,
                  prefix_cache: bool | None = None,
                  prefix_cache_mb: float | None = None,
-                 prefix_block: int | None = None):
+                 prefix_block: int | None = None,
+                 spec_decode: bool | None = None,
+                 spec_draft_layers: int | None = None,
+                 spec_k: int | None = None,
+                 kv_quant: str | bool | None = None):
         import threading
         from collections import deque
 
         import jax
 
+        from pathway_tpu.internals import config as _config_mod
         from pathway_tpu.internals.config import pathway_config
         from pathway_tpu.models import decoder as decoder_mod
         from pathway_tpu.ops import next_pow2
@@ -588,9 +614,53 @@ class _ContinuousServer:
         # may overrun its budget until its tokens drain, so give one
         # chunk of cache slack per in-flight chunk plus the current one.
         self.pipeline_depth = max(0, int(pipeline_depth))
+        # self-speculative decode (PATHWAY_TPU_SPEC_DECODE): greedy lanes
+        # advance via draft/verify/accept cycles — the first
+        # spec_draft_layers layers draft spec_k tokens, one full-model
+        # dispatch verifies them all (models/decoder.py:pool_decode_spec).
+        # Greedy-only by construction (acceptance compares argmaxes), so
+        # sampling servers always take the plain chunk path; a 1-layer
+        # model has no shallower draft stack, so it does too.
+        want_spec = (
+            pathway_config.spec_decode
+            if spec_decode is None else bool(spec_decode)
+        )
+        self.spec_decode = bool(
+            want_spec and float(temperature) == 0.0
+            and top_k is None and top_p is None and cfg.layers >= 2
+        )
+        d = (
+            pathway_config.spec_draft_layers
+            if spec_draft_layers is None else int(spec_draft_layers)
+        )
+        if d <= 0:
+            d = max(1, cfg.layers // 4)
+        self.spec_draft_layers = max(1, min(d, cfg.layers - 1))
+        self.spec_k = max(1, (
+            pathway_config.spec_k if spec_k is None else int(spec_k)
+        ))
+        # adaptive fallback: spec decode must never LOSE throughput, so
+        # after a few drained dispatches with the acceptance EMA below
+        # threshold the server latches back to plain chunks (safe: both
+        # paths emit identical greedy tokens, latching changes cost only)
+        self._spec_off = False
+        self._spec_drains = 0
+        self._accept_ema: float | None = None
+        # int8 KV (PATHWAY_TPU_KV_QUANT): the slot pool + prefix arena
+        # store KV as symmetric int8 with per-(layer, slot, head, token)
+        # f32 scales, dequantized on read inside attention
+        kvq = pathway_config.kv_quant if kv_quant is None else kv_quant
+        kvq = "int8" if kvq is True else ("" if kvq in (False, None) else kvq)
+        self.kv_quant = _config_mod._parse_kv_quant(str(kvq))
+        # a spec dispatch writes up to n_cycles*(spec_k+1) KV columns per
+        # lane — bounded by max(chunk_steps, spec_k+1) — so the per-chunk
+        # over-budget slack widens to that bound when spec is on
+        slack = max(
+            chunk_steps, (self.spec_k + 1) if self.spec_decode else 0
+        )
         self.cache_len = (
             self.max_prompt_bucket + default_max_new
-            + (self.pipeline_depth + 1) * chunk_steps
+            + (self.pipeline_depth + 1) * slack
         )
         self.eos_id = getattr(tokenizer, "eos_id", None)
         self.chunked_prefill = (
@@ -648,9 +718,14 @@ class _ContinuousServer:
             # suffix never writes past the prompt's pow2 bucket
             blk = next_pow2(max(blk, self.prefill_chunk), self.prefill_chunk)
             itemsize = _np_mod.dtype(cfg.dtype).itemsize
-            block_bytes = (
-                2 * cfg.layers * cfg.heads * blk * cfg.head_dim * itemsize
+            # int8 KV: each cached head-token costs head_dim int8 bytes
+            # plus one f32 scale instead of head_dim full-precision
+            # bytes, so the same MB budget holds ~2x the blocks
+            per_tok = (
+                cfg.head_dim + 4 if self.kv_quant
+                else cfg.head_dim * itemsize
             )
+            block_bytes = 2 * cfg.layers * cfg.heads * blk * per_tok
             n_blocks = int(mb * (1 << 20) // block_bytes)
             if n_blocks >= 1:
                 self.prefix_block = blk
@@ -678,7 +753,22 @@ class _ContinuousServer:
             params, cfg, n_slots, self.cache_len,
             arena_blocks=(self.prefix.capacity_blocks if self.prefix else 0),
             arena_block=self.prefix_block,
+            kv_quant=bool(self.kv_quant),
         )
+        self.kv_bytes_saved = 0
+        if self.kv_quant:
+            # ledger the HBM the int8 pool did NOT allocate vs the same
+            # pool at full precision (recorded once; bench surfaces it)
+            from pathway_tpu.engine.probes import record_spec
+
+            it = _np_mod.dtype(cfg.dtype).itemsize
+            base = sum(
+                int(self.pool[c].size) * it
+                for c in ("k", "v", "arena_k", "arena_v")
+                if c in self.pool
+            )
+            self.kv_bytes_saved = base - decoder_mod.pool_bytes(self.pool)
+            record_spec("kv_bytes_saved", self.kv_bytes_saved)
         self._admit_fns: dict = {}
         self._admit_batch_fns: dict = {}
         self._prefill_fns: dict = {}
@@ -697,6 +787,8 @@ class _ContinuousServer:
         # state-in/state-out — without donation every chunk would copy the
         # whole pool and double peak memory.
         self._chunk_fns: dict[int, Any] = {}
+        # n_cycles -> jitted spec draft/verify/accept executable
+        self._spec_fns: dict[int, Any] = {}
         self._key = jax.random.PRNGKey(seed)
         self._ticks = 0
         self.queue: deque = deque()
@@ -711,7 +803,9 @@ class _ContinuousServer:
             "slot_steps_total": 0, "prefill_chunks": 0,
             "admit_dispatches": 0, "prefix_hit_tokens": 0,
             "prefix_miss_tokens": 0, "prefix_hit_requests": 0,
-            "prefix_requests": 0,
+            "prefix_requests": 0, "spec_dispatches": 0,
+            "spec_cycles": 0, "spec_drafted": 0, "spec_accepted": 0,
+            "spec_emitted": 0, "spec_verify_steps": 0,
         }
         # in-flight chunk records, oldest first; an attribute (not a loop
         # local) so the failure sweep can fail eagerly-freed requests
@@ -827,6 +921,37 @@ class _ContinuousServer:
             fn = jax.jit(chunk, donate_argnums=(1,))
             self._chunk_fns[steps] = fn
         return fn
+
+    def _spec_fn_for(self, n_cycles: int):
+        fn = self._spec_fns.get(n_cycles)
+        if fn is None:
+            import jax
+
+            D, cfgc = self._D, self.cfg
+            dl, kk = self.spec_draft_layers, self.spec_k
+
+            def spec(params_, pool, active):
+                return D.pool_decode_spec(
+                    params_, pool, active, cfgc, n_cycles,
+                    draft_layers=dl, n_spec=kk,
+                )
+
+            fn = jax.jit(spec, donate_argnums=(1,))
+            self._spec_fns[n_cycles] = fn
+        return fn
+
+    def spec_acceptance(self) -> float:
+        """Drained draft-token acceptance rate of this server (0.0 before
+        any speculative dispatch drained)."""
+        d = self.stats["spec_drafted"]
+        return self.stats["spec_accepted"] / d if d else 0.0
+
+    def tokens_per_dispatch(self) -> float:
+        """Tokens emitted per full-model lane-cycle (the unit one plain
+        decode lane-step also costs; 1.0 is the plain-decode baseline)."""
+        v = self.stats["spec_verify_steps"]
+        # a plain chunk emits exactly one token per lane-step
+        return self.stats["spec_emitted"] / v if v else 1.0
 
     def _pick_steps(self, queue_len: int) -> int:
         """Decode-chunk step count for this tick. Under queue pressure the
@@ -961,7 +1086,7 @@ class _ContinuousServer:
         import jax
         import numpy as np
 
-        from pathway_tpu.engine.probes import record_prefix
+        from pathway_tpu.engine.probes import record_prefix, record_spec
         from pathway_tpu.ops import next_pow2
 
         active = np.zeros(self.n_slots, dtype=bool)
@@ -985,26 +1110,47 @@ class _ContinuousServer:
                     else 0.7 * self._step_wall_ema + 0.3 * per
                 )
             self._last_dispatch_t = now
-            self._last_dispatch_steps = steps
             self._ticks += 1
-            key = jax.random.fold_in(self._key, self._ticks)
-            self.pool, toks_dev = self._chunk_fn_for(steps)(
-                self.params, self.pool, active, key
-            )
+            if self.spec_decode and not self._spec_off:
+                # speculative path: a chunk of `steps` plain lane-steps
+                # becomes n_cycles draft/verify/accept cycles — each
+                # cycle costs ~one full-model stream (the verify) and
+                # emits 1..spec_k+1 tokens per lane, so lane budgets
+                # and the autotuner account in CYCLES here
+                n_cycles = max(1, steps // (self.spec_k + 1))
+                self._last_dispatch_steps = n_cycles
+                self.pool, toks_dev, emit_dev = self._spec_fn_for(
+                    n_cycles
+                )(self.params, self.pool, active)
+                payload = (toks_dev, emit_dev)
+                lane_steps = n_cycles
+                self.stats["spec_dispatches"] += 1
+                self.stats["spec_cycles"] += n_cycles
+            else:
+                self._last_dispatch_steps = steps
+                key = jax.random.fold_in(self._key, self._ticks)
+                self.pool, toks_dev = self._chunk_fn_for(steps)(
+                    self.params, self.pool, active, key
+                )
+                payload = toks_dev
+                emit_dev = None
+                lane_steps = steps
             try:
                 # start the device->host token copy NOW: the block
                 # lands while the next pipeline_depth chunks compute,
                 # so the eventual read is local instead of a relay
                 # round trip (measured ~100ms -> ~1ms per chunk)
                 toks_dev.copy_to_host_async()
+                if emit_dev is not None:
+                    emit_dev.copy_to_host_async()
             except Exception:  # noqa: BLE001 - platform-optional
                 pass
             self.stats["chunks"] += 1
-            self.stats["slot_steps_total"] += self.n_slots * steps
+            self.stats["slot_steps_total"] += self.n_slots * lane_steps
             # snapshot WHICH request each lane served: by the time
             # these tokens drain the slot may have been freed and
             # re-admitted to a different request
-            inflight.append((toks_dev, active.copy(), list(self.slots)))
+            inflight.append((payload, active.copy(), list(self.slots)))
             for slot in np.nonzero(active)[0]:
                 req = self.slots[slot]
                 if req is None:
@@ -1012,11 +1158,15 @@ class _ContinuousServer:
                 # occupancy numerator counts USEFUL slot-steps only:
                 # a lane decoding past its budget while its tokens
                 # drain is busy but wasted, exactly the idle-by-
-                # another-name this metric exists to expose
+                # another-name this metric exists to expose. Spec
+                # cycles count conservatively as one step each (a
+                # cycle emits AT LEAST one token), so eager refill
+                # never frees a lane before its budget is truly
+                # covered by dispatched work.
                 self.stats["steps"] += min(
-                    steps, max(0, req.max_new - self._sent[slot])
+                    lane_steps, max(0, req.max_new - self._sent[slot])
                 )
-                self._sent[slot] += steps
+                self._sent[slot] += lane_steps
                 if self.eager_refill and self._sent[slot] >= req.max_new:
                     # budget exhaustion is host-knowable at DISPATCH
                     # time: no further chunk can add to this lane's
@@ -1231,14 +1381,57 @@ class _ContinuousServer:
                 self.wake.wait(timeout=0.05)
                 continue
             prev = inflight.popleft()
-            toks, was_active, snap_slots = (
-                np.asarray(prev[0]), prev[1], prev[2]
-            )
+            payload, was_active, snap_slots = prev
+            spec_rec = isinstance(payload, tuple)
+            if spec_rec:
+                # (n_cycles, n_slots, spec_k+1) proposed tokens and the
+                # (n_cycles, n_slots) per-cycle accepted counts: a
+                # lane's stream is each cycle's first n_emit tokens
+                toks = np.asarray(payload[0])
+                emit = np.asarray(payload[1])
+                lanes = np.nonzero(was_active)[0]
+                cyc, kk = toks.shape[0], toks.shape[2] - 1
+                n_act = len(lanes)
+                drafted = cyc * n_act * kk
+                emitted = int(emit[:, lanes].sum()) if n_act else 0
+                accepted = emitted - cyc * n_act
+                record_spec("dispatches", 1)
+                record_spec("verify_steps", cyc * n_act)
+                record_spec("draft_steps", drafted)
+                record_spec("drafted", drafted)
+                record_spec("accepted", accepted)
+                record_spec("emitted", emitted)
+                self.stats["spec_verify_steps"] += cyc * n_act
+                self.stats["spec_drafted"] += drafted
+                self.stats["spec_accepted"] += accepted
+                self.stats["spec_emitted"] += emitted
+                if drafted:
+                    rate = accepted / drafted
+                    self._accept_ema = (
+                        rate if self._accept_ema is None
+                        else 0.7 * self._accept_ema + 0.3 * rate
+                    )
+                    self._spec_drains += 1
+                    # below ~1/(k+1) acceptance the drafts are noise:
+                    # latch back to plain chunks (identical tokens,
+                    # none of the draft cost)
+                    if (self._spec_drains >= 4
+                            and self._accept_ema < 0.25):
+                        self._spec_off = True
+            else:
+                toks = np.asarray(payload)
             for slot in np.nonzero(was_active)[0]:
                 req = snap_slots[slot]
                 if req is None or req.done.is_set():
                     continue  # freed by an earlier chunk's tail
-                for t in toks[:, slot].tolist():
+                if spec_rec:
+                    stream = [
+                        int(t) for c in range(toks.shape[0])
+                        for t in toks[c, slot, : emit[c, slot]]
+                    ]
+                else:
+                    stream = toks[:, slot].tolist()
+                for t in stream:
                     if self.eos_id is not None and t == self.eos_id:
                         req.max_new = 0  # stream closed
                         break

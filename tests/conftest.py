@@ -5,6 +5,15 @@ multi-chip path; benches use the real chip)."""
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Persistent XLA compile cache for the whole sweep (and the bench-smoke
+# subprocess, which inherits the env): the suite compiles hundreds of
+# bucket-shaped executables whose compile time dominates tiny-model test
+# runtime — warm runs cut it by >2x. Opt out by exporting
+# PATHWAY_TPU_COMPILE_CACHE="" (the package treats empty as unset).
+os.environ.setdefault(
+    "PATHWAY_TPU_COMPILE_CACHE",
+    os.path.join(os.path.dirname(__file__), "..", ".xla_cache"),
+)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

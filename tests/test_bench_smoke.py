@@ -75,7 +75,9 @@ def test_bench_smoke_schema():
         "throughput_x", "p50_x", "occupancy", "static_tok_s",
         "continuous_tok_s", "measured_path", "direct_api_throughput_x",
         "direct_api_p50_x", "prefix_hit_rate", "prefill_tokens_saved",
-        "ttft_p50_ms",
+        "ttft_p50_ms", "spec_acceptance_rate", "tokens_per_dispatch",
+        "spec_tok_s", "plain_tok_s", "spec_speedup_x", "kv_quant_tok_s",
+        "kv_bytes_saved",
     ):
         assert srv.get(key) is not None, key
     assert 0.0 < srv["occupancy"] <= 1.0
@@ -86,3 +88,12 @@ def test_bench_smoke_schema():
     assert 0.0 < srv["prefix_hit_rate"] <= 1.0
     assert srv["prefill_tokens_saved"] > 0
     assert srv["ttft_p50_ms"] > 0
+    # the speculative-decode trace: the shallow draft must agree with the
+    # full model well above chance, and every verify dispatch must have
+    # amortised over more than 1.5 emitted tokens on the shared-head trace
+    assert srv["spec_acceptance_rate"] > 0.3
+    assert srv["tokens_per_dispatch"] > 1.5
+    assert srv["spec_tok_s"] > 0 and srv["plain_tok_s"] > 0
+    assert srv["kv_quant_tok_s"] > 0
+    # the int8 arm actually shrank the KV footprint
+    assert srv["kv_bytes_saved"] > 0

@@ -1,0 +1,73 @@
+"""The single flag registry (`internals/config.py::FLAG_REGISTRY`): every
+`PATHWAY_TPU_*` knob is declared exactly once, the `PathwayConfig`
+properties are generated from the declarations, and the README flag
+tables are generated output — so docs, env parsing, and defaults cannot
+drift apart."""
+
+import os
+import re
+
+import pytest
+
+from pathway_tpu.internals import config as C
+
+
+def _readme_block(group: str) -> str:
+    path = os.path.join(os.path.dirname(__file__), "..", "README.md")
+    text = open(path, encoding="utf-8").read()
+    m = re.search(
+        rf"<!-- flags:{group} -->\n(.*?)<!-- /flags:{group} -->",
+        text, re.S,
+    )
+    assert m, f"README missing <!-- flags:{group} --> block"
+    return m.group(1).strip()
+
+
+@pytest.mark.parametrize("group", ["pipeline", "query"])
+def test_readme_tables_are_generated_output(group):
+    """README tables match `render_flag_table` byte-for-byte; regenerate
+    with `python -m pathway_tpu.internals.config` after editing a Flag."""
+    assert _readme_block(group) == C.render_flag_table(group).strip()
+
+
+def test_registry_env_and_attr_unique():
+    envs = [f.env for f in C.FLAG_REGISTRY]
+    assert len(envs) == len(set(envs))
+    attrs = [f.attr for f in C.FLAG_REGISTRY if f.attr]
+    assert len(attrs) == len(set(attrs))
+
+
+def test_every_attr_resolves_on_live_config():
+    for f in C.FLAG_REGISTRY:
+        if f.attr:
+            assert hasattr(C.pathway_config, f.attr), f.attr
+
+
+def test_defaults_when_env_unset(monkeypatch):
+    for f in C.FLAG_REGISTRY:
+        monkeypatch.delenv(f.env, raising=False)
+        assert f.read() == f.default, f.env
+
+
+def test_env_overrides_and_clamps(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_SPEC_DECODE", "0")
+    assert C.pathway_config.spec_decode is False
+    monkeypatch.setenv("PATHWAY_TPU_SPEC_DECODE_K", "0")  # min 1 clamps
+    assert C.pathway_config.spec_k == 1
+    monkeypatch.setenv("PATHWAY_TPU_SPEC_DECODE_DRAFT_LAYERS", "2")
+    assert C.pathway_config.spec_draft_layers == 2
+
+
+@pytest.mark.parametrize("raw,want", [
+    ("int8", "int8"), ("1", "int8"), ("true", "int8"), ("INT8", "int8"),
+    ("0", ""), ("", ""), ("off", ""), ("fp8", ""),
+])
+def test_kv_quant_parse(monkeypatch, raw, want):
+    monkeypatch.setenv("PATHWAY_TPU_KV_QUANT", raw)
+    assert C.pathway_config.kv_quant == want
+
+
+def test_every_declared_doc_nonempty():
+    for f in C.FLAG_REGISTRY:
+        assert f.doc.strip(), f.env
+        assert f.env.startswith(("PATHWAY_TPU_", "PATHWAY_")), f.env

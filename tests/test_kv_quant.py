@@ -1,0 +1,203 @@
+"""int8 KV quantization (PATHWAY_TPU_KV_QUANT=int8): per-(layer, slot,
+head, token) symmetric scales over the head dim, quantize-on-write at
+every pool write path, dequantize-on-read inside ``_block``.
+
+Pinned here: the kill switch is byte-identical to the bf16/f32 pool, the
+capacity claim (>= 1.8x slots per HBM byte at serving head dims), the
+quality bound (top-1 agreement >= 0.99 vs the unquantized pool), and
+that spec decode + prefix cache still compose on a quantized pool."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models import decoder as D
+from tests.utils import ToyCharTokenizer
+
+TINY = D.DecoderConfig(
+    vocab_size=128, hidden=32, layers=2, heads=4, intermediate=64,
+    max_position=128, dtype=jnp.float32,
+)
+# serving-shaped head dim: hd = 256 / 4 = 64 at bf16 — the capacity claim
+BF16 = D.DecoderConfig(
+    vocab_size=128, hidden=256, layers=2, heads=4, intermediate=256,
+    max_position=128, dtype=jnp.bfloat16,
+)
+N_SLOTS, CACHE_LEN = 4, 96
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return D.init_params(jax.random.PRNGKey(0), TINY)
+
+
+def _admitted_pool(params, cfg, kv_quant):
+    S = 16
+    rng = np.random.default_rng(3)
+    ids = np.zeros((N_SLOTS, S), np.int32)
+    mask = np.zeros((N_SLOTS, S), np.int32)
+    for r, n in enumerate([6, 10, 4, 8]):
+        ids[r, S - n:] = rng.integers(1, 97, n)
+        mask[r, S - n:] = 1
+    pool = D.pool_init(params, cfg, N_SLOTS, CACHE_LEN, kv_quant=kv_quant)
+    return D.pool_admit_batch(
+        params, jnp.asarray(ids), jnp.asarray(mask), pool,
+        jnp.arange(N_SLOTS, dtype=jnp.int32), cfg,
+    )
+
+
+def _decode(params, cfg, pool, n):
+    pool, toks = D.pool_decode_chunk(
+        params, pool, jnp.ones((N_SLOTS,), bool), jax.random.PRNGKey(1),
+        cfg, n,
+    )
+    return np.asarray(toks).T  # (n_slots, n)
+
+
+# -- quant mechanics ---------------------------------------------------------
+
+
+def test_kv_quant_roundtrip_error_bounded():
+    """Symmetric int8 with a per-head-token scale: worst-case abs error
+    is half a quantization step of that token's own max."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 3.0, (2, 4, 16, 8)).astype(np.float32))
+    q, s = D._kv_quant(x)
+    assert q.dtype == jnp.int8 and s.shape == (2, 4, 16, 1)
+    err = np.abs(np.asarray(q, np.float32) * np.asarray(s) - np.asarray(x))
+    step = np.asarray(s)  # one int8 step in original units
+    assert (err <= 0.5 * step + 1e-6).all()
+
+
+def test_pool_quantized_marker(tiny_params):
+    assert not D.pool_quantized(
+        D.pool_init(tiny_params, TINY, N_SLOTS, CACHE_LEN)
+    )
+    qp = D.pool_init(tiny_params, TINY, N_SLOTS, CACHE_LEN, kv_quant=True)
+    assert D.pool_quantized(qp)
+    assert qp["k"].dtype == jnp.int8 and qp["v"].dtype == jnp.int8
+    assert qp["k_scale"].dtype == jnp.float32
+
+
+def test_capacity_at_serving_head_dim():
+    """The HBM claim: at bf16 / head_dim 64, int8+scale KV stores
+    >= 1.8x the tokens per byte (64B + 4B scale vs 128B per head-token)."""
+    params = D.init_params(jax.random.PRNGKey(0), BF16)
+    b16 = D.pool_bytes(D.pool_init(params, BF16, N_SLOTS, CACHE_LEN))
+    q8 = D.pool_bytes(
+        D.pool_init(params, BF16, N_SLOTS, CACHE_LEN, kv_quant=True)
+    )
+    assert b16 / q8 >= 1.8
+
+
+def test_quant_pool_decode_self_consistent(tiny_params):
+    """A quantized pool is internally exact: spec decode on int8 KV
+    emits byte-identically to plain decode on int8 KV."""
+    plain = _decode(
+        tiny_params, TINY, _admitted_pool(tiny_params, TINY, True), 16
+    )
+    _, toks, n_emit = D.pool_decode_spec(
+        tiny_params, _admitted_pool(tiny_params, TINY, True),
+        jnp.ones((N_SLOTS,), bool), TINY, 16, draft_layers=1, n_spec=3,
+    )
+    toks, n_emit = np.asarray(toks), np.asarray(n_emit)
+    for b in range(N_SLOTS):
+        seq = [int(t) for c in range(toks.shape[0])
+               for t in toks[c, b, : n_emit[c, b]]]
+        assert seq[:16] == plain[b].tolist()
+
+
+def test_quality_top1_agreement(tiny_params):
+    """Quality bound: over 4 lanes x 32 greedy steps the int8 pool's
+    token stream agrees with the unquantized pool >= 99% top-1."""
+    ref = _decode(
+        tiny_params, TINY, _admitted_pool(tiny_params, TINY, False), 32
+    )
+    q = _decode(
+        tiny_params, TINY, _admitted_pool(tiny_params, TINY, True), 32
+    )
+    assert (ref == q).mean() >= 0.99
+
+
+# -- serving -----------------------------------------------------------------
+
+
+PROMPTS = ["hello world", "continuous batching", "abc", "qrs tuv"]
+HEAD = "x" * 56
+
+
+def _serve(tiny_params, prompts, **kw):
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    chat = TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(96),
+        max_new_tokens=10, temperature=0.0, max_prompt_tokens=96,
+        continuous=True, n_slots=4, chunk_steps=4, pipeline_depth=2,
+        prefill_chunk=8, **kw,
+    )
+    try:
+        out = []
+        for p in prompts:
+            r = chat.submit_batch([p])[0]
+            assert r.done.wait(timeout=180)
+            out.append(r.text)
+        return out, dict(chat._server.stats), chat._server
+    finally:
+        chat.close()
+
+
+@pytest.fixture(scope="module")
+def plain_burst(tiny_params):
+    """One full-precision serving pass over PROMPTS (explicit
+    kv_quant=''), shared by the kill-switch and quality tests."""
+    texts, _, _ = _serve(tiny_params, PROMPTS, kv_quant="")
+    return texts
+
+
+def test_kill_switch_byte_equality(tiny_params, plain_burst, monkeypatch):
+    """PATHWAY_TPU_KV_QUANT unset/0: the pool is plain-dtype and serving
+    output is byte-identical to an explicit kv_quant='' server."""
+    monkeypatch.setenv("PATHWAY_TPU_KV_QUANT", "0")
+    off, _, srv = _serve(tiny_params, PROMPTS, kv_quant=None)
+    assert srv.kv_quant == "" and srv.kv_bytes_saved == 0
+    assert not D.pool_quantized(srv.pool)
+    assert off == plain_burst
+
+
+def test_env_flag_enables_quant(tiny_params, monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_KV_QUANT", "int8")
+    _, _, srv = _serve(tiny_params, PROMPTS[:1], kv_quant=None)
+    assert srv.kv_quant == "int8"
+    assert D.pool_quantized(srv.pool)
+    assert srv.kv_bytes_saved > 0
+
+
+def test_quant_serving_composes_with_spec_and_prefix(tiny_params):
+    """spec decode + prefix cache + int8 pool: quantized arms agree with
+    each other (spec on == spec off on the SAME quantized pool), and the
+    arena round-trip (kv_extract/kv_insert on int8 blocks) still admits
+    prefix hits."""
+    prompts = [HEAD + f"q{k:02d}xx" for k in range(4)]
+    a, _, _ = _serve(
+        tiny_params, prompts, kv_quant="int8", spec_decode=False,
+        prefix_cache=True,
+    )
+    b, stats, _ = _serve(
+        tiny_params, prompts, kv_quant="int8", spec_decode=True,
+        prefix_cache=True,
+    )
+    assert stats["prefix_hit_requests"] > 0
+    assert stats["spec_dispatches"] > 0
+    assert a == b
+
+
+def test_quant_serving_quality(tiny_params, plain_burst):
+    """End-to-end top-1 agreement between int8 and plain serving stays
+    >= 0.99 over the burst (tiny f32 checkpoint: expected exact)."""
+    quant, _, _ = _serve(tiny_params, PROMPTS, kv_quant="int8")
+    ref = "".join(plain_burst)
+    got = "".join(quant)
+    agree = sum(x == y for x, y in zip(ref, got)) / max(len(ref), 1)
+    assert len(got) == len(ref) and agree >= 0.99

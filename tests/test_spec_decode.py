@@ -1,0 +1,258 @@
+"""Self-speculative decoding (PATHWAY_TPU_SPEC_DECODE): the first-N-layer
+stack drafts k tokens against a depth-prefix of the SAME slot-pool KV, one
+full-model dispatch verifies all k+1 positions, and the longest
+greedy-matching prefix is accepted.
+
+The contract under test: greedy spec-on output is BYTE-IDENTICAL to
+spec-off — per pool lane at the decode-chunk level, and end-to-end through
+the continuous server crossed with the prefix cache and chunked prefill.
+The kill switch must fall back to the plain dispatch path exactly."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models import decoder as D
+from tests.utils import ToyCharTokenizer
+
+TINY = D.DecoderConfig(
+    vocab_size=128, hidden=32, layers=4, heads=4, intermediate=64,
+    max_position=128, dtype=jnp.float32,
+)
+N_SLOTS, CACHE_LEN, NEW = 4, 96, 16
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return D.init_params(jax.random.PRNGKey(0), TINY)
+
+
+def _admitted_pool(params, kv_quant=False):
+    """Four left-padded prompts of mixed lengths admitted into a pool."""
+    S = 16
+    rng = np.random.default_rng(0)
+    ids = np.zeros((N_SLOTS, S), np.int32)
+    mask = np.zeros((N_SLOTS, S), np.int32)
+    for r, n in enumerate([5, 9, 3, 7]):
+        ids[r, S - n:] = rng.integers(1, 97, n)
+        mask[r, S - n:] = 1
+    pool = D.pool_init(params, TINY, N_SLOTS, CACHE_LEN, kv_quant=kv_quant)
+    return D.pool_admit_batch(
+        params, jnp.asarray(ids), jnp.asarray(mask), pool,
+        jnp.arange(N_SLOTS, dtype=jnp.int32), TINY,
+    )
+
+
+def _spec_streams(toks, n_emit):
+    """Flatten (n_cycles, B, k+1) verify outputs into per-lane emitted
+    token streams using the per-cycle emit counts."""
+    toks, n_emit = np.asarray(toks), np.asarray(n_emit)
+    return [
+        [int(t) for c in range(toks.shape[0])
+         for t in toks[c, b, : n_emit[c, b]]]
+        for b in range(toks.shape[1])
+    ]
+
+
+def _plain_streams(params, pool, n_steps):
+    _, toks = D.pool_decode_chunk(
+        params, pool, jnp.ones((N_SLOTS,), bool), jax.random.PRNGKey(1),
+        TINY, n_steps,
+    )
+    return np.asarray(toks).T  # (n_slots, n_steps)
+
+
+# -- pool level --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("draft_layers,k", [(1, 3), (2, 2), (3, 4)])
+def test_pool_spec_equals_plain_greedy(tiny_params, draft_layers, k):
+    """Every (draft depth, k) config emits the plain greedy stream per
+    lane — acceptance only changes HOW FAST tokens come, never which."""
+    plain = _plain_streams(tiny_params, _admitted_pool(tiny_params), NEW)
+    _, toks, n_emit = D.pool_decode_spec(
+        tiny_params, _admitted_pool(tiny_params),
+        jnp.ones((N_SLOTS,), bool), TINY, NEW,
+        draft_layers=draft_layers, n_spec=k,
+    )
+    for b, seq in enumerate(_spec_streams(toks, n_emit)):
+        assert seq[:NEW] == plain[b].tolist(), (draft_layers, k, b)
+
+
+def test_full_depth_draft_accepts_everything(tiny_params):
+    """draft_layers == cfg.layers makes the draft the full model, so every
+    cycle must accept all k drafts (n_emit == k+1 on active lanes)."""
+    k = 3
+    _, _, n_emit = D.pool_decode_spec(
+        tiny_params, _admitted_pool(tiny_params),
+        jnp.ones((N_SLOTS,), bool), TINY, 4,
+        draft_layers=TINY.layers, n_spec=k,
+    )
+    assert np.asarray(n_emit).min() == k + 1
+
+
+def test_pool_decode_draft_shapes_and_range(tiny_params):
+    drafts = D.pool_decode_draft(
+        tiny_params, _admitted_pool(tiny_params),
+        jnp.ones((N_SLOTS,), bool), TINY, draft_layers=2, n_draft=3,
+    )
+    drafts = np.asarray(drafts)
+    assert drafts.shape == (N_SLOTS, 3)
+    assert (drafts >= 0).all() and (drafts < TINY.vocab_size).all()
+
+
+def test_decode_step_n_layers_prefix(tiny_params):
+    """``decode_step(n_layers=)``: full depth matches the default path
+    bit-for-bit, and a shallow call leaves deeper KV untouched."""
+    ids = jnp.asarray([[0, 0, 3, 7, 11]], jnp.int32)
+    mask = jnp.asarray([[0, 0, 1, 1, 1]], jnp.int32)
+    logits, cache = D.prefill(tiny_params, ids, mask, TINY, cache_len=32)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    slot_mask = jnp.concatenate(
+        [mask, jnp.zeros((1, 32 - 5), jnp.int32)], axis=1
+    ).at[:, 5].set(1)
+    pos = jnp.asarray([3], jnp.int32)
+    full_l, full_c = D.decode_step(
+        tiny_params, tok, pos, 5, slot_mask, cache, TINY
+    )
+    expl_l, expl_c = D.decode_step(
+        tiny_params, tok, pos, 5, slot_mask, cache, TINY,
+        n_layers=TINY.layers,
+    )
+    np.testing.assert_array_equal(np.asarray(full_l), np.asarray(expl_l))
+    np.testing.assert_array_equal(
+        np.asarray(full_c["k"]), np.asarray(expl_c["k"])
+    )
+    _, shallow_c = D.decode_step(
+        tiny_params, tok, pos, 5, slot_mask, cache, TINY, n_layers=1
+    )
+    np.testing.assert_array_equal(  # layers >= 1 pass through untouched
+        np.asarray(shallow_c["k"][1:]), np.asarray(cache["k"][1:])
+    )
+    assert not np.array_equal(
+        np.asarray(shallow_c["k"][0]), np.asarray(cache["k"][0])
+    )
+
+
+def test_spec_respects_inactive_lanes(tiny_params):
+    """Inactive lanes emit nothing and their KV/logits stay frozen."""
+    active = jnp.asarray([True, False, True, False])
+    pool0 = _admitted_pool(tiny_params)
+    pool, toks, n_emit = D.pool_decode_spec(
+        tiny_params, pool0, active, TINY, 4, draft_layers=2, n_spec=3,
+    )
+    n_emit = np.asarray(n_emit)
+    assert (n_emit[:, [1, 3]] == 0).all()
+    assert (n_emit[:, [0, 2]] >= 1).all()
+    np.testing.assert_array_equal(
+        np.asarray(pool["logits"])[[1, 3]],
+        np.asarray(_admitted_pool(tiny_params)["logits"])[[1, 3]],
+    )
+
+
+# -- serving level -----------------------------------------------------------
+
+
+PROMPTS = ["hello world", "continuous batching", "abc", "qrs tuv",
+           "slot pool", "zzz"]
+HEAD = "x" * 56  # block-aligned shared head for the prefix-cache cross
+
+
+def _serve(tiny_params, prompts, **kw):
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    kw.setdefault("prefill_chunk", 8)
+    chat = TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(96),
+        max_new_tokens=10, temperature=0.0, max_prompt_tokens=96,
+        continuous=True, n_slots=4, chunk_steps=4, pipeline_depth=2,
+        **kw,
+    )
+    try:
+        out = []
+        for p in prompts:  # sequential so prefix hits actually land
+            r = chat.submit_batch([p])[0]
+            assert r.done.wait(timeout=180)
+            out.append(r.text)
+        return out, dict(chat._server.stats), chat._server
+    finally:
+        chat.close()
+
+
+@pytest.fixture(scope="module")
+def spec_on_burst(tiny_params):
+    """One spec-on serving pass over PROMPTS, shared by the kill-switch
+    and ledger tests (with the probes ledger reset just before it)."""
+    from pathway_tpu.engine import probes
+
+    probes.reset_spec_stats()
+    texts, stats, srv = _serve(tiny_params, PROMPTS, spec_decode=True)
+    return texts, stats, srv
+
+
+@pytest.mark.parametrize("prefix_cache", [False, True])
+@pytest.mark.parametrize("chunked_prefill", [False, True])
+def test_serving_spec_equivalence_grid(tiny_params, prefix_cache,
+                                       chunked_prefill):
+    """Greedy spec on == spec off crossed with prefix-cache x chunked
+    prefill — the composition the continuous server actually runs."""
+    prompts = [HEAD + f"q{k:02d}xx" for k in range(4)]
+    plain, _, _ = _serve(
+        tiny_params, prompts, spec_decode=False,
+        prefix_cache=prefix_cache, chunked_prefill=chunked_prefill,
+    )
+    spec, stats, _ = _serve(
+        tiny_params, prompts, spec_decode=True,
+        prefix_cache=prefix_cache, chunked_prefill=chunked_prefill,
+    )
+    assert stats["spec_dispatches"] > 0
+    if prefix_cache and chunked_prefill:
+        assert stats["prefix_hit_requests"] > 0
+    assert spec == plain
+
+
+def test_spec_kill_switch_byte_equality(tiny_params, spec_on_burst,
+                                        monkeypatch):
+    """PATHWAY_TPU_SPEC_DECODE=0: the spec executable never runs and the
+    output is byte-identical to the spec-on path."""
+    spec, stats_on, _ = spec_on_burst
+    assert stats_on["spec_dispatches"] > 0
+    monkeypatch.setenv("PATHWAY_TPU_SPEC_DECODE", "0")
+    off, stats_off, srv = _serve(tiny_params, PROMPTS, spec_decode=None)
+    assert srv.spec_decode is False
+    assert stats_off["spec_dispatches"] == 0
+    assert off == spec
+
+
+def test_spec_disabled_for_sampling(tiny_params):
+    """Spec decode requires greedy: temperature / top-k / top-p servers
+    silently fall back to plain dispatch."""
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    chat = TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(64),
+        max_new_tokens=4, temperature=0.8, max_prompt_tokens=32,
+        continuous=True, n_slots=2, spec_decode=True,
+    )
+    try:
+        assert chat._server.spec_decode is False
+    finally:
+        chat.close()
+
+
+def test_spec_ledger_and_rates(spec_on_burst):
+    """The probes ledger and per-server rates agree: tokens-per-dispatch
+    > 1 means the verify dispatches amortised over >1 emitted token."""
+    from pathway_tpu.engine import probes
+
+    _, stats, srv = spec_on_burst
+    assert stats["spec_emitted"] > stats["spec_verify_steps"] > 0
+    assert srv.tokens_per_dispatch() > 1.0
+    assert 0.0 <= srv.spec_acceptance() <= 1.0
+    # the ledger records at DRAIN — the final inflight dispatch may never
+    # drain before close, so it can trail the per-server counter slightly
+    led = probes.spec_stats()
+    assert led["counts"]["dispatches"] > 0
+    assert led["tokens_per_dispatch"] > 1.0
