@@ -2009,6 +2009,7 @@ def _serving_prefix_trace(params, cfg, tok) -> dict:
     servers — cache ON vs OFF — reporting hit rate, prefill tokens saved,
     and TTFT (arrival -> first token drained). Greedy decoding: the two
     arms must emit token-identical generations."""
+    from pathway_tpu.engine import probes
     from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
 
     if _smoke():
@@ -2043,6 +2044,11 @@ def _serving_prefix_trace(params, cfg, tok) -> dict:
                 for r in chat.submit_batch([head + wtail]):
                     r.done.wait(timeout=120)
             srv.prefix_reset()
+            # zero the registry ledgers too, so the arm stats below (read
+            # back through probes — the same series /metrics scrapes) cover
+            # exactly the timed window
+            probes.reset_prefix_stats()
+            probes.reset_latency_metrics()
             t0 = time.perf_counter()
             reqs = []
             for k in range(NREQ):
@@ -2054,16 +2060,25 @@ def _serving_prefix_trace(params, cfg, tok) -> dict:
             for k, r in enumerate(reqs):
                 r.done.wait(timeout=120)
                 ttft.append(r.first_token_at - t0 - arrivals[k])
-            hit = srv.stats["prefix_hit_tokens"]
-            miss = srv.stats["prefix_miss_tokens"]
+            ps = probes.prefix_stats()
+            lat = probes.latency_summary(phase="decode")
             arm = {
                 "ttft_p50_ms": round(
                     float(np.percentile(np.asarray(ttft) * 1e3, 50)), 1
                 ),
-                "hit_rate": round(hit / max(hit + miss, 1), 4),
-                "prefill_tokens_saved": int(hit),
-                "hit_requests": srv.stats["prefix_hit_requests"],
-                "requests": srv.stats["prefix_requests"],
+                "hit_rate": ps["hit_rate"],
+                "prefill_tokens_saved": ps["prefill_tokens_saved"],
+                "hit_requests": ps["counts"].get("hit_requests", 0),
+                "requests": ps["counts"].get("requests", 0),
+                "queue_wait_p50_ms": (
+                    lat.get("queue_wait_seconds") or {}
+                ).get("p50_ms", 0.0),
+                "tpot_p50_ms": (
+                    lat.get("tpot_seconds") or {}
+                ).get("p50_ms", 0.0),
+                "e2e_p50_ms": (
+                    lat.get("e2e_seconds") or {}
+                ).get("p50_ms", 0.0),
             }
             return arm, [list(r.tokens) for r in reqs]
         finally:
@@ -2082,6 +2097,9 @@ def _serving_prefix_trace(params, cfg, tok) -> dict:
         "prefix_hit_rate": on["hit_rate"],
         "prefill_tokens_saved": on["prefill_tokens_saved"],
         "ttft_p50_ms": on["ttft_p50_ms"],
+        "queue_wait_p50_ms": on["queue_wait_p50_ms"],
+        "tpot_p50_ms": on["tpot_p50_ms"],
+        "e2e_p50_ms": on["e2e_p50_ms"],
         "ttft_speedup_x": round(
             off["ttft_p50_ms"] / max(on["ttft_p50_ms"], 1e-9), 2
         ),
@@ -2097,6 +2115,7 @@ def _serving_spec_trace(params, cfg, tok) -> dict:
     to spec-off (``tokens_match``); the decode throughput pair plus
     acceptance rate and tokens-per-dispatch quantify what the draft/verify
     cycles buy on this checkpoint."""
+    from pathway_tpu.engine import probes
     from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
 
     if _smoke():
@@ -2120,6 +2139,9 @@ def _serving_spec_trace(params, cfg, tok) -> dict:
             # outside the timed window
             for r in chat.submit_batch([head + "warmAAxx"] * 2):
                 r.done.wait(timeout=120)
+            # registry spec ledger covers exactly the timed window (the
+            # arm reads it back through probes, same series as /metrics)
+            probes.reset_spec_stats()
             t0 = time.perf_counter()
             reqs = chat.submit_batch(prompts)
             toks = []
@@ -2128,13 +2150,17 @@ def _serving_spec_trace(params, cfg, tok) -> dict:
                 toks.append(list(r.tokens))
             wall = max(r.finished_at for r in reqs) - t0
             gen = sum(len(t) for t in toks)
+            ss = probes.spec_stats()
             arm = {
                 "tok_s": round(gen / max(wall, 1e-9), 1),
                 "generated": gen,
                 "wall_s": round(wall, 3),
                 "spec_dispatches": srv.stats["spec_dispatches"],
-                "acceptance_rate": round(srv.spec_acceptance(), 4),
-                "tokens_per_dispatch": round(srv.tokens_per_dispatch(), 4),
+                "acceptance_rate": ss["acceptance_rate"],
+                # registry reports 0.0 before any verify step; the plain
+                # arm's baseline is the 1.0 tokens-per-dispatch of vanilla
+                # decode, matching srv.tokens_per_dispatch()
+                "tokens_per_dispatch": ss["tokens_per_dispatch"] or 1.0,
                 "kv_bytes_saved": srv.kv_bytes_saved,
             }
             return arm, toks
@@ -2613,6 +2639,15 @@ def main() -> None:
             "ttft_p50_ms": (serving_det.get("prefix") or {}).get(
                 "ttft_p50_ms"
             ),
+            "queue_wait_p50_ms": (serving_det.get("prefix") or {}).get(
+                "queue_wait_p50_ms"
+            ),
+            "tpot_p50_ms": (serving_det.get("prefix") or {}).get(
+                "tpot_p50_ms"
+            ),
+            "e2e_p50_ms": (serving_det.get("prefix") or {}).get(
+                "e2e_p50_ms"
+            ),
             "spec_acceptance_rate": (serving_det.get("spec") or {}).get(
                 "acceptance_rate"
             ),
@@ -2765,6 +2800,7 @@ def main() -> None:
             "continuous_tok_s", "measured_path",
             "direct_api_throughput_x", "direct_api_p50_x",
             "prefix_hit_rate", "prefill_tokens_saved", "ttft_p50_ms",
+            "queue_wait_p50_ms", "tpot_p50_ms", "e2e_p50_ms",
             "spec_acceptance_rate", "tokens_per_dispatch",
             "spec_tok_s", "plain_tok_s", "kv_quant_tok_s",
             "kv_bytes_saved",

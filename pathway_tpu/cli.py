@@ -213,6 +213,67 @@ def spawn_from_env(program, arguments):
     spawn.main(args=argv, standalone_mode=True)
 
 
+@cli.command()
+@click.option("--url", type=str, default=None, metavar="URL",
+              help="base URL of a running server (fetches URL/v1/statistics);"
+                   " omit to read this process's in-memory registry")
+@click.option("--as-json", is_flag=True, help="dump the raw snapshot as JSON")
+def stats(url, as_json):
+    """Pretty-print the unified observability snapshot (serving counters,
+    latency histograms, scheduler summary) — local registry or a remote
+    ``/v1/statistics`` endpoint."""
+    import json
+
+    if url is not None:
+        import urllib.request
+
+        endpoint = url.rstrip("/") + "/v1/statistics"
+        with urllib.request.urlopen(endpoint, timeout=10.0) as resp:  # noqa: S310
+            snap = json.loads(resp.read().decode())
+    else:
+        from pathway_tpu.engine import probes
+        from pathway_tpu.internals import run as run_mod
+
+        snap = probes.unified_snapshot(getattr(run_mod, "LAST_RUN_STATS", None))
+
+    if as_json:
+        click.echo(json.dumps(snap, indent=2, default=str))
+        return
+
+    serving = snap.get("serving") or {}
+
+    def section(title: str, rows: dict) -> None:
+        if not rows:
+            return
+        click.echo(title)
+        width = max(len(str(k)) for k in rows)
+        for k, v in rows.items():
+            click.echo(f"  {str(k):<{width}}  {v}")
+
+    latency = serving.get("latency") or {}
+    for name, summary in sorted(latency.items()):
+        if summary:
+            section(f"latency/{name} (ms)", summary)
+    section("prefix", serving.get("prefix") or {})
+    section("spec", serving.get("spec") or {})
+    section("cascade", serving.get("cascade") or {})
+    section("dispatch", serving.get("dispatch") or {})
+    section("stage_seconds", serving.get("stage_seconds") or {})
+    section("occupancy", serving.get("occupancy") or {})
+    sched = snap.get("scheduler") or {}
+    if sched:
+        section("scheduler", {
+            k: sched[k]
+            for k in ("current_time", "epochs_total", "uptime_s", "finished")
+            if k in sched
+        })
+    if not any((latency, serving.get("prefix"), serving.get("spec"),
+                serving.get("cascade"), serving.get("dispatch"),
+                serving.get("stage_seconds"), serving.get("occupancy"),
+                sched)):
+        click.echo("no metrics recorded yet")
+
+
 @cli.group()
 def airbyte() -> None:
     """Airbyte connector scaffolding (reference ``cli.py:airbyte``)."""

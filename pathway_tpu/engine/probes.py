@@ -23,10 +23,23 @@ Three additions beyond the reference:
   :func:`bubble_attribution` splits a window's wall time across them with
   device compute as the residual, so the non-MFU fraction is attributed
   instead of unexplained.
+
+Since the observability PR every ledger is a thin shim over ONE
+:class:`MetricsRegistry` (``REGISTRY``): a thread-safe store of named
+counters, gauges and log-bucketed histograms with label sets. The shims
+keep the historical ``record_*`` / ``*_stats`` / ``reset_*`` signatures
+and return shapes byte-for-byte, so every existing call site (bench.py,
+kernels, tests) keeps working, while the registry adds what the ledgers
+never had: per-request latency histograms (TTFT / TPOT / queue-wait /
+e2e, fed by ``engine/tracing.py`` spans), one consistent
+:meth:`MetricsRegistry.snapshot` dict, and an OpenMetrics export path
+(``internals/http_server.py``). ``PATHWAY_TPU_METRICS=0`` is the master
+kill switch — record calls become no-ops, outputs stay byte-identical.
 """
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 import threading
 import time
@@ -37,10 +50,330 @@ V5E_PEAK_HBM_BYTES = 819e9
 
 
 # --------------------------------------------------------------------- #
-# device-dispatch counters
+# the unified metrics registry
 
-_dispatch_lock = threading.Lock()
-_dispatch_counts: dict[str, int] = {}
+# log-bucketed (factor 2) latency bounds: 100us .. ~105s, 21 buckets +
+# one +Inf overflow. Wide enough for relay-chip TTFTs, fine enough that
+# interpolated p50/p95 stay within a 2x bucket of the truth.
+_DEFAULT_HIST_BOUNDS = tuple(1e-4 * (2.0 ** i) for i in range(21))
+
+# every family the package emits, so exporters can render HELP/TYPE
+# lines even before the first sample (a scrape during warm-up still
+# shows the full surface): name -> (type, label, help)
+METRIC_FAMILIES: dict[str, tuple[str, str | None, str]] = {
+    "device_dispatch": (
+        "counter", "kind", "Accelerator round trips by dispatch kind"),
+    "cascade_pairs": (
+        "counter", "stage", "Rerank pairs scored per cascade stage"),
+    "cascade_flops": (
+        "counter", "stage", "Model FLOPs paid per cascade stage"),
+    "prefix_events": (
+        "counter", "kind", "Prefix-KV-cache events (hit/miss tokens, "
+        "requests, inserted/evicted blocks)"),
+    "prefix_cached_bytes": (
+        "gauge", None, "Resident KV bytes in the prefix arena"),
+    "spec_events": (
+        "counter", "kind", "Speculative-decode events (drafted/accepted/"
+        "emitted tokens, verify/draft steps)"),
+    "stage_seconds": (
+        "counter", "stage", "Host busy seconds per pipeline stage"),
+    "stage_items": (
+        "counter", "stage", "Items processed per pipeline stage"),
+    "serving_occupancy": (
+        "gauge", "server", "Useful slot-steps / total slot-steps of a "
+        "continuous decode server"),
+    "ttft_seconds": (
+        "histogram", "phase", "Time from request enqueue to first "
+        "drained token"),
+    "tpot_seconds": (
+        "histogram", "phase", "Mean time per output token after the "
+        "first (per request)"),
+    "queue_wait_seconds": (
+        "histogram", "phase", "Time from request enqueue to admission"),
+    "e2e_seconds": (
+        "histogram", "phase", "Time from request enqueue to completion"),
+}
+
+LATENCY_HISTOGRAMS = (
+    "ttft_seconds", "tpot_seconds", "queue_wait_seconds", "e2e_seconds",
+)
+
+
+class MetricsRegistry:
+    """Single thread-safe registry of counters, gauges and log-bucketed
+    histograms, each a family of label-keyed series.
+
+    One lock covers every mutation and the whole :meth:`snapshot`, so a
+    snapshot is CONSISTENT — no torn reads between families the way the
+    five per-ledger locks allowed. Recording is gated on the
+    ``PATHWAY_TPU_METRICS`` kill switch (read per call, so tests can
+    flip it with ``monkeypatch.setenv``); resets always apply."""
+
+    def __init__(self, hist_bounds: tuple = _DEFAULT_HIST_BOUNDS):
+        self._lock = threading.RLock()
+        self.hist_bounds = tuple(float(b) for b in hist_bounds)
+        self._counters: dict[str, dict[tuple, float]] = {}
+        self._gauges: dict[str, dict[tuple, float]] = {}
+        # name -> labelkey -> [bucket counts (len bounds+1), sum, count]
+        self._hists: dict[str, dict[tuple, list]] = {}
+
+    _cfg = None  # cached pathway_config; the flag itself is read per call
+
+    @property
+    def enabled(self) -> bool:
+        cfg = self._cfg
+        if cfg is None:
+            from pathway_tpu.internals.config import pathway_config
+
+            MetricsRegistry._cfg = cfg = pathway_config
+        return bool(cfg.metrics)
+
+    @staticmethod
+    def _key(labels: dict) -> tuple:
+        return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+    # ------------------------------------------------------------ write
+    def counter_add(self, name: str, value: float = 1.0, **labels) -> None:
+        if not self.enabled:
+            return
+        key = self._key(labels)
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            series[key] = series.get(key, 0.0) + value
+
+    def counter_add_many(self, name: str, label: str,
+                         counts: dict) -> None:
+        """Batched :meth:`counter_add` over one label dimension: a single
+        enabled check + lock acquisition for a whole group of updates —
+        what serving hot loops (one spec cycle = six counters) call."""
+        if not self.enabled:
+            return
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            for lv, v in counts.items():
+                key = ((label, str(lv)),)
+                series[key] = series.get(key, 0.0) + v
+
+    def gauge_set(self, name: str, value: float, **labels) -> None:
+        if not self.enabled:
+            return
+        key = self._key(labels)
+        with self._lock:
+            self._gauges.setdefault(name, {})[key] = float(value)
+
+    def gauge_add(self, name: str, value: float, **labels) -> None:
+        if not self.enabled:
+            return
+        key = self._key(labels)
+        with self._lock:
+            series = self._gauges.setdefault(name, {})
+            series[key] = series.get(key, 0.0) + value
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        if not self.enabled:
+            return
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            series = self._hists.setdefault(name, {})
+            rec = series.get(key)
+            if rec is None:
+                rec = series[key] = [
+                    [0] * (len(self.hist_bounds) + 1), 0.0, 0,
+                ]
+            rec[0][bisect.bisect_left(self.hist_bounds, v)] += 1
+            rec[1] += v
+            rec[2] += 1
+
+    # ------------------------------------------------------------- read
+    def labelled(self, name: str, label: str,
+                 kind: str = "counter") -> dict[str, float]:
+        """Series values of ``name`` summed by their ``label`` value."""
+        store = self._counters if kind == "counter" else self._gauges
+        with self._lock:
+            items = list((store.get(name) or {}).items())
+        out: dict[str, float] = {}
+        for key, v in items:
+            lv = dict(key).get(label, "")
+            out[lv] = out.get(lv, 0.0) + v
+        return out
+
+    def gauge_value(self, name: str, **labels) -> float | None:
+        with self._lock:
+            series = self._gauges.get(name)
+            if not series:
+                return None
+            if labels:
+                return series.get(self._key(labels))
+            return sum(series.values())
+
+    def hist_summary(self, name: str, **labels) -> dict | None:
+        """Merged bucket summary of every series of ``name`` whose labels
+        contain ``labels``; quantiles interpolate inside the matched
+        bucket. None before the first observation."""
+        want = set(self._key(labels)) if labels else None
+        merged = [0] * (len(self.hist_bounds) + 1)
+        total, s = 0, 0.0
+        with self._lock:
+            for key, (counts, ssum, cnt) in (
+                self._hists.get(name) or {}
+            ).items():
+                if want is not None and not want <= set(key):
+                    continue
+                for i, c in enumerate(counts):
+                    merged[i] += c
+                s += ssum
+                total += cnt
+        if not total:
+            return None
+        return {
+            "count": total,
+            "sum": s,
+            "mean": s / total,
+            "p50": self._quantile(merged, 0.5),
+            "p95": self._quantile(merged, 0.95),
+        }
+
+    def _quantile(self, counts: list, q: float) -> float:
+        total = sum(counts)
+        if not total:
+            return 0.0
+        rank = q * total
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            if cum + c >= rank:
+                lo = 0.0 if i == 0 else self.hist_bounds[i - 1]
+                hi = (
+                    self.hist_bounds[i] if i < len(self.hist_bounds)
+                    else self.hist_bounds[-1]
+                )
+                frac = max(0.0, min(1.0, (rank - cum) / c))
+                return lo + (hi - lo) * frac
+            cum += c
+        return self.hist_bounds[-1]
+
+    def remove(self, *names: str) -> None:
+        with self._lock:
+            for n in names:
+                self._counters.pop(n, None)
+                self._gauges.pop(n, None)
+                self._hists.pop(n, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    def snapshot(self) -> dict:
+        """One CONSISTENT plain-dict snapshot of every family (single
+        lock acquisition), for exporters / the dashboard / JSON."""
+        with self._lock:
+            counters = {
+                n: {"series": [
+                    {"labels": dict(k), "value": v}
+                    for k, v in sorted(s.items())
+                ]}
+                for n, s in sorted(self._counters.items())
+            }
+            gauges = {
+                n: {"series": [
+                    {"labels": dict(k), "value": v}
+                    for k, v in sorted(s.items())
+                ]}
+                for n, s in sorted(self._gauges.items())
+            }
+            hists = {
+                n: {
+                    "bounds": list(self.hist_bounds),
+                    "series": [
+                        {
+                            "labels": dict(k),
+                            "buckets": list(rec[0]),
+                            "sum": rec[1],
+                            "count": rec[2],
+                        }
+                        for k, rec in sorted(s.items())
+                    ],
+                }
+                for n, s in sorted(self._hists.items())
+            }
+        return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+
+REGISTRY = MetricsRegistry()
+
+
+def observe_latency(name: str, seconds: float, phase: str) -> None:
+    """Feed one request-latency observation into a registry histogram
+    (``name`` in :data:`LATENCY_HISTOGRAMS`, ``phase`` = decode / query /
+    embed). Called by ``engine/tracing.py`` span finish."""
+    REGISTRY.observe(name, seconds, phase=phase)
+
+
+def latency_summary(phase: str | None = None) -> dict:
+    """Per-histogram ms summaries (count / p50 / p95 / mean), optionally
+    filtered to one phase. Families with no observations are omitted."""
+    out: dict = {}
+    for name in LATENCY_HISTOGRAMS:
+        s = REGISTRY.hist_summary(name, **({"phase": phase} if phase else {}))
+        if s is not None:
+            out[name] = {
+                "count": s["count"],
+                "p50_ms": round(s["p50"] * 1e3, 3),
+                "p95_ms": round(s["p95"] * 1e3, 3),
+                "mean_ms": round(s["mean"] * 1e3, 3),
+            }
+    return out
+
+
+def reset_latency_metrics() -> None:
+    REGISTRY.remove(*LATENCY_HISTOGRAMS)
+
+
+def serving_snapshot() -> dict:
+    """The serving-side view every consumer shares — ``/v1/statistics``,
+    the rich dashboard panel and bench.py all read THIS, so bench keys
+    and scraped metrics cannot drift."""
+    return {
+        "prefix": prefix_stats(),
+        "spec": spec_stats(),
+        "cascade": cascade_stats(),
+        "dispatch": dispatch_counts(),
+        "stage_seconds": {
+            k: round(v, 6) for k, v in sorted(stage_seconds().items())
+        },
+        "occupancy": {
+            k: round(v, 4)
+            for k, v in REGISTRY.labelled(
+                "serving_occupancy", "server", kind="gauge"
+            ).items()
+        },
+        "latency": latency_summary(),
+    }
+
+
+def unified_snapshot(scheduler_stats=None) -> dict:
+    """Scheduler + serving + raw-registry in one dict: the payload of
+    ``/v1/statistics`` and the source of the monitoring dashboard."""
+    sched = None
+    if scheduler_stats is not None:
+        sched = (
+            scheduler_stats.snapshot()
+            if hasattr(scheduler_stats, "snapshot") else scheduler_stats
+        )
+    return {
+        "scheduler": sched,
+        "serving": serving_snapshot(),
+        "registry": REGISTRY.snapshot(),
+    }
+
+
+# --------------------------------------------------------------------- #
+# device-dispatch counters (registry shim)
+
 _current_op = threading.local()  # set by Scheduler._step_node
 
 
@@ -48,22 +381,24 @@ def record_device_dispatch(kind: str, n: int = 1) -> None:
     """Count ``n`` accelerator round trips of ``kind`` (e.g. ``embed_submit``,
     ``knn_append``). Cheap and thread-safe: called from kernel wrappers on
     every dispatch. When a scheduler step is on the stack the count is also
-    attributed to the stepping operator."""
-    with _dispatch_lock:
-        _dispatch_counts[kind] = _dispatch_counts.get(kind, 0) + n
+    attributed to the stepping operator (always — operator attribution is
+    scheduler accounting, not registry telemetry, so the kill switch does
+    not gate it)."""
+    REGISTRY.counter_add("device_dispatch", n, kind=kind)
     op = getattr(_current_op, "stats", None)
     if op is not None:
         op.dispatches += n
 
 
 def dispatch_counts() -> dict[str, int]:
-    with _dispatch_lock:
-        return dict(_dispatch_counts)
+    return {
+        k: int(v)
+        for k, v in REGISTRY.labelled("device_dispatch", "kind").items()
+    }
 
 
 def reset_dispatch_counts() -> None:
-    with _dispatch_lock:
-        _dispatch_counts.clear()
+    REGISTRY.remove("device_dispatch")
 
 
 # --------------------------------------------------------------------- #
@@ -76,27 +411,23 @@ def reset_dispatch_counts() -> None:
 # is the fraction of candidates that reached the full pass — the knob the
 # quality/latency trade hangs on.
 
-_cascade_lock = threading.Lock()
-_cascade_pairs: dict[str, int] = {}
-_cascade_flops: dict[str, float] = {}
-
-
 def record_cascade(stage: str, pairs: int, flops: float = 0.0) -> None:
     """Account ``pairs`` scored (and model ``flops`` paid) by cascade
     ``stage`` (``cheap`` / ``full``). Thread-safe; called per dispatch by
     the fused query path."""
-    with _cascade_lock:
-        _cascade_pairs[stage] = _cascade_pairs.get(stage, 0) + pairs
-        _cascade_flops[stage] = _cascade_flops.get(stage, 0.0) + flops
+    REGISTRY.counter_add("cascade_pairs", pairs, stage=stage)
+    if flops:
+        REGISTRY.counter_add("cascade_flops", flops, stage=stage)
 
 
 def cascade_stats() -> dict:
     """Snapshot: per-stage pairs + FLOPs, and the survivor rate (full-pass
     pairs / cheap-pass pairs; 1.0 when the cascade never ran — every
     candidate 'survived' into the only pass there was)."""
-    with _cascade_lock:
-        pairs = dict(_cascade_pairs)
-        flops = dict(_cascade_flops)
+    pairs = {
+        k: int(v) for k, v in REGISTRY.labelled("cascade_pairs", "stage").items()
+    }
+    flops = REGISTRY.labelled("cascade_flops", "stage")
     cheap = pairs.get("cheap", 0)
     full = pairs.get("full", 0)
     rate = (full / cheap) if cheap else 1.0
@@ -108,9 +439,7 @@ def cascade_stats() -> dict:
 
 
 def reset_cascade_stats() -> None:
-    with _cascade_lock:
-        _cascade_pairs.clear()
-        _cascade_flops.clear()
+    REGISTRY.remove("cascade_pairs", "cascade_flops")
 
 
 # --------------------------------------------------------------------- #
@@ -123,26 +452,27 @@ def reset_cascade_stats() -> None:
 # tracks the arena's resident KV bytes (insert adds, evict subtracts),
 # so the HBM budget is observable, not just enforced.
 
-_prefix_lock = threading.Lock()
-_prefix_counts: dict[str, float] = {}
-
-
 def record_prefix(kind: str, n: float = 1) -> None:
     """Account ``n`` of ``kind`` (``hit_tokens`` / ``miss_tokens`` /
     ``requests`` / ``hit_requests`` / ``inserted_blocks`` /
     ``evicted_blocks`` / ``cached_bytes`` — the last is a running delta,
-    negative on eviction). Thread-safe; called by the serving loop and
+    negative on eviction, stored as a gauge). Thread-safe; called by the
+    serving loop and
     :class:`pathway_tpu.engine.prefix_cache.PrefixCache`."""
-    with _prefix_lock:
-        _prefix_counts[kind] = _prefix_counts.get(kind, 0) + n
+    if kind == "cached_bytes":
+        REGISTRY.gauge_add("prefix_cached_bytes", n)
+    else:
+        REGISTRY.counter_add("prefix_events", n, kind=kind)
 
 
 def prefix_stats() -> dict:
     """Snapshot: raw counters plus the token-level ``hit_rate``
     (hit_tokens / (hit_tokens + miss_tokens); 0.0 when the cache never
     saw a prompt) and ``prefill_tokens_saved`` (== hit_tokens)."""
-    with _prefix_lock:
-        c = dict(_prefix_counts)
+    c = REGISTRY.labelled("prefix_events", "kind")
+    cached = REGISTRY.gauge_value("prefix_cached_bytes")
+    if cached is not None:
+        c["cached_bytes"] = cached
     hit = c.get("hit_tokens", 0)
     miss = c.get("miss_tokens", 0)
     total = hit + miss
@@ -157,8 +487,7 @@ def prefix_stats() -> dict:
 
 
 def reset_prefix_stats() -> None:
-    with _prefix_lock:
-        _prefix_counts.clear()
+    REGISTRY.remove("prefix_events", "prefix_cached_bytes")
 
 
 # --------------------------------------------------------------------- #
@@ -176,25 +505,26 @@ def reset_prefix_stats() -> None:
 # init). tokens_per_dispatch = emitted / verify_steps is the headline:
 # 1.0 is plain decode, anything above is amortized weight streaming.
 
-_spec_lock = threading.Lock()
-_spec_counts: dict[str, float] = {}
-
-
 def record_spec(kind: str, n: float = 1) -> None:
     """Account ``n`` of ``kind`` (``drafted`` / ``accepted`` /
     ``emitted`` / ``verify_steps`` / ``draft_steps`` / ``dispatches`` /
     ``kv_bytes_saved``). Thread-safe; called by the continuous server's
     drain (token accounting) and pool init (KV bytes)."""
-    with _spec_lock:
-        _spec_counts[kind] = _spec_counts.get(kind, 0) + n
+    REGISTRY.counter_add("spec_events", n, kind=kind)
+
+
+def record_spec_many(**counts: float) -> None:
+    """Batched :func:`record_spec`: one lock acquisition for a whole spec
+    cycle's counters — the drain path records six kinds per dispatch and
+    sits on the decode critical path."""
+    REGISTRY.counter_add_many("spec_events", "kind", counts)
 
 
 def spec_stats() -> dict:
     """Snapshot: raw counters plus ``acceptance_rate`` (accepted /
     drafted; 0.0 before any draft ran) and ``tokens_per_dispatch``
     (emitted / verify_steps; 1.0 is the plain-decode baseline)."""
-    with _spec_lock:
-        c = dict(_spec_counts)
+    c = REGISTRY.labelled("spec_events", "kind")
     drafted = c.get("drafted", 0)
     accepted = c.get("accepted", 0)
     emitted = c.get("emitted", 0)
@@ -208,8 +538,7 @@ def spec_stats() -> dict:
 
 
 def reset_spec_stats() -> None:
-    with _spec_lock:
-        _spec_counts.clear()
+    REGISTRY.remove("spec_events")
 
 
 # --------------------------------------------------------------------- #
@@ -222,30 +551,21 @@ def reset_spec_stats() -> None:
 # breakdown of wall time, with device compute as the residual (under
 # JAX's async dispatch the host never observes compute directly).
 
-_stage_lock = threading.Lock()
-_stage_seconds: dict[str, float] = {}
-_stage_items: dict[str, int] = {}
-
-
 def record_stage(stage: str, seconds: float, items: int = 1) -> None:
     """Accumulate ``seconds`` of host busy time for pipeline ``stage``
     (e.g. ``tokenize``, ``h2d``, ``dispatch``, ``drain``). Thread-safe;
     called by stage workers, so overlapped stages can legitimately sum to
     more than wall time — that excess IS the overlap evidence."""
-    with _stage_lock:
-        _stage_seconds[stage] = _stage_seconds.get(stage, 0.0) + seconds
-        _stage_items[stage] = _stage_items.get(stage, 0) + items
+    REGISTRY.counter_add("stage_seconds", seconds, stage=stage)
+    REGISTRY.counter_add("stage_items", items, stage=stage)
 
 
 def stage_seconds() -> dict[str, float]:
-    with _stage_lock:
-        return dict(_stage_seconds)
+    return REGISTRY.labelled("stage_seconds", "stage")
 
 
 def reset_stage_seconds() -> None:
-    with _stage_lock:
-        _stage_seconds.clear()
-        _stage_items.clear()
+    REGISTRY.remove("stage_seconds", "stage_items")
 
 
 def bubble_attribution(wall_s: float, stages: dict[str, float] | None = None) -> dict:

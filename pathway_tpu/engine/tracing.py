@@ -1,0 +1,245 @@
+"""Per-request serving spans (reference: xLLM-style SLO telemetry).
+
+A :class:`Span` is one request's timeline through a serving loop: a
+monotonic start plus timestamped events — ``enqueue`` (implicit, at
+construction), ``admit``, ``prefix_match``, ``prefill_chunk``,
+``spec_cycle``, ``decode_chunk``, ``first_token``, ``drain`` — attached
+by the continuous decoder server (``xpacks/llm/llms.py``), the
+``QueryServer`` micro-batcher and the embed pipeline. :meth:`Span.finish`
+derives the SLO metrics the histograms in ``engine/probes.py`` serve
+(queue-wait = admit − enqueue, TTFT = first_token − enqueue, TPOT =
+(drain − first_token)/(tokens − 1), e2e = drain − enqueue), feeds them
+into the registry with the span's ``kind`` as the ``phase`` label, and
+hands the serialized span to three sinks:
+
+* a bounded in-process ring buffer (``PATHWAY_TPU_TRACE_RING`` spans,
+  oldest evicted) behind :func:`recent_traces`;
+* an optional JSONL flight recorder (``PATHWAY_TPU_TRACE_DIR``), one
+  line per span, append-only per pid;
+* the OTel exporter in ``internals/telemetry.py`` when a collector
+  endpoint is configured (``PATHWAY_MONITORING_SERVER``) — a no-op stub
+  otherwise.
+
+``PATHWAY_TPU_METRICS=0`` makes :func:`start_span` return the shared
+:data:`NULL_SPAN`, so instrumented hot loops pay one attribute lookup
+and nothing else; spans never touch compute, so token streams are
+byte-identical either way.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from pathway_tpu.engine import probes
+
+__all__ = [
+    "Span", "NULL_SPAN", "start_span", "recent_traces", "reset_traces",
+]
+
+
+class _NullSpan:
+    """Kill-switch stand-in: every span method is a no-op."""
+
+    __slots__ = ()
+
+    def event(self, name: str, **attrs) -> None:
+        pass
+
+    def finish(self, **attrs) -> None:
+        return None
+
+
+NULL_SPAN = _NullSpan()
+
+_ids = itertools.count(1)
+_ring_lock = threading.Lock()
+_ring: deque = deque()
+_jsonl_lock = threading.Lock()
+_telemetry = None
+_telemetry_lock = threading.Lock()
+
+
+class Span:
+    """One request's event timeline. Event methods are thread-safe in
+    the way the serving loops need: a single producer thread appends at
+    a time (submit thread hands off to the loop thread at admission),
+    and :meth:`finish` is idempotent."""
+
+    __slots__ = (
+        "kind", "request_id", "server", "attrs", "t0", "wall0",
+        "events", "_finished",
+    )
+
+    def __init__(self, kind: str, request_id, server: str | None, attrs: dict):
+        self.kind = kind
+        self.request_id = request_id
+        self.server = server
+        self.attrs = attrs
+        self.t0 = time.perf_counter()
+        self.wall0 = time.time()
+        self.events: list = [("enqueue", self.t0, None)]
+        self._finished = False
+
+    def event(self, name: str, **attrs) -> None:
+        self.events.append((name, time.perf_counter(), attrs or None))
+
+    def first_t(self, name: str) -> float | None:
+        for n, t, _ in self.events:
+            if n == name:
+                return t
+        return None
+
+    def finish(self, **attrs) -> dict | None:
+        """Close the span: derive the SLO metrics, feed the registry
+        histograms (phase = span kind) and record the serialized span.
+        Idempotent — the failure sweep and the drain path may race to
+        close a request; only the first wins."""
+        if self._finished:
+            return None
+        self._finished = True
+        if attrs:
+            self.attrs = {**self.attrs, **attrs}
+        end = self.events[-1][1]
+        t_admit = t_first = t_drain = None  # one pass, first occurrence
+        for n, t, _ in self.events:
+            if n == "admit":
+                if t_admit is None:
+                    t_admit = t
+            elif n == "first_token":
+                if t_first is None:
+                    t_first = t
+            elif n == "drain" and t_drain is None:
+                t_drain = t
+        if t_drain is None:
+            t_drain = end
+        tokens = self.attrs.get("tokens")
+
+        metrics: dict = {"e2e_ms": round((t_drain - self.t0) * 1e3, 3)}
+        probes.observe_latency("e2e_seconds", t_drain - self.t0, self.kind)
+        if t_admit is not None:
+            metrics["queue_wait_ms"] = round((t_admit - self.t0) * 1e3, 3)
+            probes.observe_latency(
+                "queue_wait_seconds", t_admit - self.t0, self.kind
+            )
+        if t_first is not None:
+            metrics["ttft_ms"] = round((t_first - self.t0) * 1e3, 3)
+            probes.observe_latency(
+                "ttft_seconds", t_first - self.t0, self.kind
+            )
+            if isinstance(tokens, int) and tokens > 1:
+                tpot = (t_drain - t_first) / (tokens - 1)
+                metrics["tpot_ms"] = round(tpot * 1e3, 3)
+                probes.observe_latency("tpot_seconds", tpot, self.kind)
+
+        span_dict = {
+            "kind": self.kind,
+            "id": self.request_id,
+            "server": self.server,
+            "start_unix": round(self.wall0, 6),
+            "attrs": self.attrs,
+            "metrics": metrics,
+            "events": [
+                {"name": n, "t_ms": round((t - self.t0) * 1e3, 3),
+                 **(a or {})}
+                for n, t, a in self.events
+            ],
+        }
+        _record(span_dict)
+        return span_dict
+
+
+def start_span(kind: str, request_id=None, server: str | None = None,
+               **attrs):
+    """A live :class:`Span` (enqueue stamped now), or :data:`NULL_SPAN`
+    when ``PATHWAY_TPU_METRICS=0``. ``kind`` becomes the histogram
+    ``phase`` label (``decode`` / ``query`` / ``embed``); ``server``
+    tags the span for :func:`recent_traces` filtering."""
+    if not probes.REGISTRY.enabled:
+        return NULL_SPAN
+    if request_id is None:
+        request_id = next(_ids)
+    return Span(kind, request_id, server, dict(attrs))
+
+
+def recent_traces(server: str | None = None, kind: str | None = None,
+                  n: int | None = None) -> list[dict]:
+    """Most recent completed spans (oldest first), optionally filtered
+    by the ``server`` tag and/or span ``kind``, truncated to the last
+    ``n``."""
+    with _ring_lock:
+        spans = list(_ring)
+    if server is not None:
+        spans = [s for s in spans if s.get("server") == server]
+    if kind is not None:
+        spans = [s for s in spans if s.get("kind") == kind]
+    return spans[-n:] if n else spans
+
+
+def reset_traces() -> None:
+    with _ring_lock:
+        _ring.clear()
+
+
+def _record(span_dict: dict) -> None:
+    from pathway_tpu.internals.config import pathway_config
+
+    limit = max(1, pathway_config.trace_ring)
+    with _ring_lock:
+        _ring.append(span_dict)
+        while len(_ring) > limit:
+            _ring.popleft()
+    trace_dir = pathway_config.trace_dir
+    if trace_dir:
+        _write_jsonl(trace_dir, span_dict)
+    _export_otel(span_dict)
+
+
+def _write_jsonl(trace_dir: str, span_dict: dict) -> None:
+    try:
+        os.makedirs(trace_dir, exist_ok=True)
+        path = os.path.join(trace_dir, f"trace-{os.getpid()}.jsonl")
+        line = json.dumps(span_dict, default=str)
+        with _jsonl_lock, open(path, "a", encoding="utf-8") as f:
+            f.write(line + "\n")
+    except Exception:  # noqa: BLE001 - the recorder must never break serving
+        pass
+
+
+def _get_telemetry():
+    """Lazy per-endpoint ``Telemetry``; rebuilt if the configured
+    collector endpoint changes. None when no endpoint is set."""
+    global _telemetry
+    from pathway_tpu.internals.config import pathway_config
+
+    endpoint = pathway_config.monitoring_server
+    if not endpoint:
+        return None
+    with _telemetry_lock:
+        if _telemetry is None or _telemetry.endpoint != endpoint:
+            from pathway_tpu.internals.telemetry import Telemetry
+
+            _telemetry = Telemetry(endpoint)
+        return _telemetry
+
+
+def _export_otel(span_dict: dict) -> None:
+    tel = _get_telemetry()
+    if tel is None or not tel.enabled:
+        return
+    try:
+        attributes = {
+            "pathway_tpu.request_id": str(span_dict["id"]),
+            "pathway_tpu.server": str(span_dict.get("server")),
+            **{f"pathway_tpu.{k}": v
+               for k, v in span_dict["metrics"].items()},
+        }
+        with tel.span(f"pathway_tpu.{span_dict['kind']}", attributes):
+            for e in span_dict["events"]:
+                tel.event(e["name"], {"t_ms": e["t_ms"]})
+    except Exception:  # noqa: BLE001 - export must never break serving
+        pass

@@ -48,7 +48,8 @@ class Flag:
     doc. ``attr`` is the ``PathwayConfig`` property name (None for knobs
     read elsewhere, e.g. by bench.py, that are registered only so the
     README table includes them); ``group`` places the flag in a README
-    table (``pipeline`` / ``query``); ``minimum`` clamps explicit
+    table (``pipeline`` / ``query`` / ``observability``); ``minimum``
+    clamps explicit
     values (defaults are trusted as-is, matching the historical
     accessors); ``parse`` overrides the ``kind`` parser."""
 
@@ -361,6 +362,33 @@ FLAG_REGISTRY: list[Flag] = [
         doc="Pending-request bound; `submit` blocks (backpressure) "
             "beyond it.",
     ),
+    # ---- observability knobs (README 'observability' table) -----------
+    Flag(
+        env="PATHWAY_TPU_METRICS", kind="bool", default=True,
+        attr="metrics", group="observability",
+        doc="Master kill switch for the observability layer: `0` turns "
+            "every `MetricsRegistry` write (counters, gauges, latency "
+            "histograms) and per-request span into a no-op. Token "
+            "streams and pipeline outputs are byte-identical either way "
+            "— instrumentation never touches compute. Scheduler "
+            "operator attribution (`SchedulerStats`) is engine "
+            "accounting and stays on.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_TRACE_RING", kind="int", default=256,
+        attr="trace_ring", group="observability", minimum=1,
+        doc="Completed request spans kept in the in-process ring buffer "
+            "behind `recent_traces()` (per process, oldest evicted "
+            "first).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_TRACE_DIR", kind="str", default="",
+        attr="trace_dir", group="observability",
+        doc="Flight recorder: when set, every completed span appends "
+            "one JSON line to `<dir>/trace-<pid>.jsonl` (created on "
+            "demand; write errors are swallowed — tracing must never "
+            "break serving). Unset (default) disables the recorder.",
+    ),
 ]
 
 
@@ -520,7 +548,7 @@ def set_monitoring_config(*, server_endpoint: str | None) -> None:
 if __name__ == "__main__":
     # regenerate the README flag tables (paste between the
     # <!-- flags:<group> --> markers)
-    for _group in ("pipeline", "query"):
+    for _group in ("pipeline", "query", "observability"):
         print(f"<!-- flags:{_group} -->")
         print(render_flag_table(_group))
         print(f"<!-- /flags:{_group} -->")

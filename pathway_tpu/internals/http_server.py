@@ -6,6 +6,14 @@ Prometheus exposition endpoint served per process on port
 Implemented on the stdlib ``http.server`` (the reference uses hyper) — the
 metrics names mirror ``metrics_from_stats``: input/output latency analogue,
 per-operator row counters, epoch counters.
+
+:func:`registry_text` renders the unified ``MetricsRegistry``
+(``engine/probes.py``) — counters, gauges, and the serving latency
+histograms — as OpenMetrics families under the ``pathway_tpu_`` prefix;
+:func:`openmetrics_text` is the full scrape body (scheduler gauges, when
+a run has happened, plus the registry, plus the ``# EOF`` terminator)
+that :class:`MetricsServer` and the REST servers' ``/metrics`` route both
+serve, so every scrape path exposes one identical surface.
 """
 
 from __future__ import annotations
@@ -15,6 +23,115 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 BASE_PORT = 20000
+
+_PREFIX = "pathway_tpu_"
+
+
+def _escape_label(v) -> str:
+    return (
+        str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+    )
+
+
+def _labels_text(labels: dict, extra: dict | None = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(merged.items())
+    )
+    return "{" + inner + "}"
+
+
+def _num(v) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def registry_text(snapshot: dict | None = None) -> str:
+    """The ``MetricsRegistry`` snapshot as OpenMetrics text (no ``# EOF``
+    — :func:`openmetrics_text` terminates the full exposition). Every
+    family in ``probes.METRIC_FAMILIES`` gets its HELP/TYPE header even
+    before its first sample, so a scrape during warm-up already shows
+    the whole surface."""
+    from pathway_tpu.engine import probes
+
+    snap = snapshot if snapshot is not None else probes.REGISTRY.snapshot()
+    counters, gauges, hists = (
+        snap["counters"], snap["gauges"], snap["histograms"],
+    )
+    names = sorted(
+        set(probes.METRIC_FAMILIES)
+        | set(counters) | set(gauges) | set(hists)
+    )
+    lines: list[str] = []
+    for name in names:
+        kind, _, help_text = probes.METRIC_FAMILIES.get(
+            name,
+            (
+                "histogram" if name in hists
+                else "gauge" if name in gauges else "counter",
+                None, name.replace("_", " "),
+            ),
+        )
+        full = _PREFIX + name
+        lines.append(f"# HELP {full} {help_text}")
+        lines.append(f"# TYPE {full} {kind}")
+        if kind == "counter":
+            for s in counters.get(name, {}).get("series", []):
+                lines.append(
+                    f"{full}_total{_labels_text(s['labels'])} "
+                    f"{_num(s['value'])}"
+                )
+        elif kind == "gauge":
+            for s in gauges.get(name, {}).get("series", []):
+                lines.append(
+                    f"{full}{_labels_text(s['labels'])} {_num(s['value'])}"
+                )
+        else:
+            fam = hists.get(name)
+            if fam is None:
+                continue
+            bounds = fam["bounds"]
+            for s in fam["series"]:
+                cum = 0
+                for i, c in enumerate(s["buckets"]):
+                    cum += c
+                    le = (
+                        format(bounds[i], "g") if i < len(bounds) else "+Inf"
+                    )
+                    lines.append(
+                        f"{full}_bucket"
+                        f"{_labels_text(s['labels'], {'le': le})} {cum}"
+                    )
+                lines.append(
+                    f"{full}_sum{_labels_text(s['labels'])} "
+                    f"{repr(float(s['sum']))}"
+                )
+                lines.append(
+                    f"{full}_count{_labels_text(s['labels'])} {s['count']}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+def openmetrics_text(scheduler_snapshot: dict | None = None) -> str:
+    """The full scrape body: legacy scheduler gauges (when a snapshot is
+    given, or the last run's stats exist) + the unified registry + the
+    OpenMetrics ``# EOF`` terminator."""
+    parts: list[str] = []
+    if scheduler_snapshot is None:
+        from pathway_tpu.internals import run as run_mod
+
+        stats = getattr(run_mod, "LAST_RUN_STATS", None)
+        if stats is not None:
+            scheduler_snapshot = stats.snapshot()
+    if scheduler_snapshot is not None:
+        parts.append(metrics_from_stats(scheduler_snapshot))
+    parts.append(registry_text())
+    parts.append("# EOF\n")
+    return "".join(parts)
 
 
 def metrics_from_stats(snapshot: dict) -> str:
@@ -77,7 +194,7 @@ class MetricsServer:
                 if self.path not in ("/", "/metrics", "/status"):
                     self.send_error(404)
                     return
-                body = metrics_from_stats(stats.snapshot()).encode()
+                body = openmetrics_text(stats.snapshot()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))

@@ -5,6 +5,11 @@ Live table fed by engine probes): renders connector ingest counters and
 per-operator row/latency stats from the scheduler's ``SchedulerStats``
 (``engine/probes.py``) on a background thread while ``pw.run`` pumps the
 dataflow. ``MonitoringLevel`` mirrors the reference enum surface.
+
+The dashboard reads ``probes.unified_snapshot`` — the same payload that
+``/v1/statistics`` serves and bench.py summarizes — so a serving panel
+(slot occupancy, prefix hit rate, speculative acceptance, TTFT p50/p95)
+appears under the operator table whenever serving metrics exist.
 """
 
 from __future__ import annotations
@@ -44,6 +49,54 @@ class StatsMonitor:
         self._thread: threading.Thread | None = None
 
     # ---------------------------------------------------------------- render
+    def _serving_panel(self, serving: dict | None = None):
+        """Serving metrics (from the unified registry snapshot) as a rich
+        table, or None when nothing has been recorded yet."""
+        from rich.table import Table as RichTable
+
+        if serving is None:
+            from pathway_tpu.engine import probes
+
+            serving = probes.serving_snapshot()
+        occupancy = serving.get("occupancy") or {}
+        prefix = serving.get("prefix") or {}
+        spec = serving.get("spec") or {}
+        latency = serving.get("latency") or {}
+        ttft = latency.get("ttft_seconds") or {}
+        rows: list[tuple[str, str]] = []
+        for server, occ in sorted(occupancy.items()):
+            rows.append((f"occupancy {server}", f"{occ:.2f}"))
+        if (prefix.get("counts") or {}).get("requests"):
+            rows.append(("prefix hit rate", f"{prefix['hit_rate']:.2%}"))
+            rows.append(
+                ("prefill tokens saved", str(prefix["prefill_tokens_saved"]))
+            )
+        if spec.get("acceptance_rate"):
+            rows.append(("spec acceptance", f"{spec['acceptance_rate']:.2%}"))
+            rows.append(
+                ("tokens / dispatch", f"{spec['tokens_per_dispatch']:.2f}")
+            )
+        if ttft:
+            rows.append(("TTFT p50", f"{ttft['p50_ms']:.1f} ms"))
+            rows.append(("TTFT p95", f"{ttft['p95_ms']:.1f} ms"))
+        if not rows:
+            return None
+        panel = RichTable(title="serving")
+        panel.add_column("metric")
+        panel.add_column("value", justify="right")
+        for k, v in rows:
+            panel.add_row(k, v)
+        return panel
+
+    def _render_dashboard(self):
+        """Operator table plus, when serving metrics exist, the serving
+        panel — what the live loop actually displays."""
+        from rich.console import Group
+
+        table = self._render()
+        panel = self._serving_panel()
+        return table if panel is None else Group(table, panel)
+
     def _render(self):
         from rich.table import Table as RichTable
 
@@ -90,10 +143,12 @@ class StatsMonitor:
     def _loop(self) -> None:
         from rich.live import Live
 
-        with Live(self._render(), refresh_per_second=4, transient=False) as live:
+        with Live(
+            self._render_dashboard(), refresh_per_second=4, transient=False
+        ) as live:
             while not self._stop.wait(self.refresh_s):
-                live.update(self._render())
-            live.update(self._render())
+                live.update(self._render_dashboard())
+            live.update(self._render_dashboard())
 
     # ---------------------------------------------------------------- control
     def start(self) -> None:

@@ -98,6 +98,12 @@ class PathwayWebserver:
                 else:
                     payload = dict(request.query)
                 result = await handler(payload)
+                # handlers carrying _raw_content_type return preformatted
+                # text (e.g. the /metrics OpenMetrics exposition) instead
+                # of a JSON document
+                raw_ct = getattr(handler, "_raw_content_type", None)
+                if raw_ct is not None:
+                    return web.Response(text=result, content_type=raw_ct)
                 return web.json_response(result)
             except Exception as exc:  # noqa: BLE001
                 return web.json_response({"error": str(exc)}, status=500)

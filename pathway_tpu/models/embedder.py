@@ -110,12 +110,15 @@ class _PendingEmbed:
     the batch is dispatched and yields the serial-path handle (f16 device
     array, row count). Stage failures surface here, at resolve time."""
 
-    __slots__ = ("_event", "_value", "_error")
+    __slots__ = ("_event", "_value", "_error", "span")
 
     def __init__(self) -> None:
+        from pathway_tpu.engine import tracing
+
         self._event = threading.Event()
         self._value = None
         self._error: BaseException | None = None
+        self.span = tracing.NULL_SPAN  # replaced by _IngestPipeline.submit
 
     def wait(self):
         self._event.wait()
@@ -139,6 +142,8 @@ class _IngestPipeline:
 
     def __init__(self, model: "SentenceEmbedderModel", depth: int, queue_bound: int):
         self._model = model
+        # tags this pipeline's batch spans in the global trace ring
+        self._trace_tag = f"embed:{id(model):x}"
         self._dispatch = StageWorker(
             self._dispatch_one, maxsize=depth, name="pathway-tpu:embed-dispatch"
         )
@@ -147,7 +152,12 @@ class _IngestPipeline:
         )
 
     def submit(self, texts: list[str]) -> _PendingEmbed:
+        from pathway_tpu.engine import tracing
+
         handle = _PendingEmbed()
+        handle.span = tracing.start_span(
+            "embed", server=self._trace_tag, texts=len(texts),
+        )
         self._tokenize.submit((texts, handle))
         return handle
 
@@ -156,11 +166,14 @@ class _IngestPipeline:
         try:
             model = self._model
             t0 = time.perf_counter()
+            handle.span.event("admit")
             ids, mask = model.tokenizer(texts, max_length=model.max_length)
             ids, mask = pad_to_buckets(ids, mask)
             record_stage("tokenize", time.perf_counter() - t0)
+            handle.span.event("tokenize", texts=len(texts))
         except BaseException as exc:  # noqa: BLE001 - surfaces at resolve
             handle._error = exc
+            handle.span.finish(error=True)
             handle._event.set()
             return
         # blocks while `depth` batches are staged/dispatched ahead — the
@@ -184,6 +197,7 @@ class _IngestPipeline:
                 dev_mask = jax.device_put(mask)
             t1 = time.perf_counter()
             record_stage("h2d", t1 - t0)
+            handle.span.event("h2d")
             if fused:
                 out = _embed_fn_packed(model.params, dev_packed, model.cfg)
             else:
@@ -195,9 +209,11 @@ class _IngestPipeline:
             except Exception:  # noqa: BLE001 - platform-optional fast path
                 pass
             record_stage("dispatch", time.perf_counter() - t1)
+            handle.span.event("dispatch", rows=n)
             handle._value = (out, n)
         except BaseException as exc:  # noqa: BLE001 - surfaces at resolve
             handle._error = exc
+            handle.span.finish(error=True)
         handle._event.set()
 
     def close(self) -> None:
@@ -260,6 +276,15 @@ class SentenceEmbedderModel:
             pipe, self._pipeline = self._pipeline, None
         if pipe is not None:
             pipe.close()
+
+    def recent_traces(self, n: int | None = None) -> list[dict]:
+        """Completed per-batch spans of this model's ingest pipeline
+        (oldest first). Empty on the serial path
+        (``PATHWAY_TPU_PIPELINE=0``) and under
+        ``PATHWAY_TPU_METRICS=0``."""
+        from pathway_tpu.engine import tracing
+
+        return tracing.recent_traces(server=f"embed:{id(self):x}", n=n)
 
     @classmethod
     def from_local(cls, path: str, cfg: TransformerConfig = MINILM_L6, **kw):
@@ -347,6 +372,10 @@ class SentenceEmbedderModel:
         fetched = jax.device_get([out for out, _ in resolved])
         record_device_dispatch("embed_drain")
         record_stage("drain", time.perf_counter() - t0)
+        for h in handles:
+            if isinstance(h, _PendingEmbed):
+                h.span.event("drain")
+                h.span.finish()
         return [
             _renorm(np.asarray(o)[:n].astype(np.float32))
             for o, (_, n) in zip(fetched, resolved)

@@ -457,27 +457,31 @@ static PyObject* py_hash_tokenize(PyObject*, PyObject* args) {
 // indices for the Python path (Unicode NFD accent stripping / case
 // folding). Parity with transformers.BertTokenizer is pinned by test.
 
+#include <deque>
 #include <string_view>
 #include <unordered_map>
 
-// transparent hashing: greedy longest-match probes are substrings of the
-// word buffer, looked up as string_views with ZERO per-probe allocations
-// (the old per-probe "##"+substr std::string construction dominated the
-// single-core tokenizer profile)
+// greedy longest-match probes are substrings of the word buffer, looked
+// up as string_views with ZERO per-probe allocations (the old per-probe
+// "##"+substr std::string construction dominated the single-core
+// tokenizer profile). The maps are keyed on string_view directly —
+// backed by owned strings with stable addresses — rather than relying
+// on C++20 heterogeneous unordered lookup (P0919), which libstdc++ only
+// ships from GCC 11.
 struct SvHash {
   using is_transparent = void;
   size_t operator()(std::string_view s) const noexcept {
     return std::hash<std::string_view>{}(s);
   }
-  size_t operator()(const std::string& s) const noexcept {
-    return std::hash<std::string_view>{}(s);
-  }
 };
 
 using WpMap =
-    std::unordered_map<std::string, int32_t, SvHash, std::equal_to<>>;
+    std::unordered_map<std::string_view, int32_t, SvHash, std::equal_to<>>;
 
 struct WordPieceVocab {
+  // deque: push_back never moves earlier elements, so the map's views
+  // into these strings stay valid as the vocab grows
+  std::deque<std::string> storage;
   // word_start also answers single-char punctuation lookups (a 1-char
   // token can never start with "##")
   WpMap word_start;   // tokens NOT starting with "##"
@@ -506,7 +510,8 @@ static PyObject* py_wordpiece_load(PyObject*, PyObject* args) {
     }
     // assignment (not emplace): duplicate tokens keep the LAST id, matching
     // dict comprehension / HF vocab-load semantics
-    std::string tok(s, (size_t)slen);
+    vocab->storage.emplace_back(s, (size_t)slen);
+    std::string_view tok(vocab->storage.back());
     if (slen >= 2 && s[0] == '#' && s[1] == '#') {
       vocab->word_suffix[tok.substr(2)] = (int32_t)i;
     } else {

@@ -38,10 +38,12 @@ class QueryRequest:
 
     __slots__ = (
         "kind", "text", "k", "done", "result", "error",
-        "submitted_at", "finished_at",
+        "submitted_at", "finished_at", "span",
     )
 
     def __init__(self, kind: str, text: str, k: int):
+        from pathway_tpu.engine import tracing
+
         self.kind = kind                # "retrieve" | "rerank"
         self.text = text
         self.k = k
@@ -50,6 +52,7 @@ class QueryRequest:
         self.error: BaseException | None = None
         self.submitted_at = time.monotonic()
         self.finished_at = 0.0
+        self.span = tracing.NULL_SPAN  # replaced by QueryServer.submit
 
     def wait(self, timeout: float | None = None):
         if not self.done.wait(timeout):
@@ -84,10 +87,20 @@ class QueryServer:
         self._dispatches = 0
         self._requests = 0
         self._batch_hist: dict[int, int] = {}
+        # tags this server's request spans in the global trace ring
+        self._trace_tag = f"query:{id(self):x}"
         self._thread = threading.Thread(
             target=self._loop, name="query-server", daemon=True
         )
         self._thread.start()
+
+    def recent_traces(self, n: int | None = None) -> list[dict]:
+        """Completed per-request spans of THIS server (oldest first),
+        from the bounded global trace ring (``PATHWAY_TPU_TRACE_RING``).
+        Empty under ``PATHWAY_TPU_METRICS=0``."""
+        from pathway_tpu.engine import tracing
+
+        return tracing.recent_traces(server=self._trace_tag, n=n)
 
     # ------------------------------------------------------------ submit
     def submit(self, text: str, k: int, *, rerank: bool = False) -> QueryRequest:
@@ -96,7 +109,12 @@ class QueryServer:
         kind = "rerank" if rerank else "retrieve"
         if rerank and self._pipe.reranker is None:
             raise ValueError("pipeline has no reranker")
+        from pathway_tpu.engine import tracing
+
         req = QueryRequest(kind, text, k)
+        req.span = tracing.start_span(
+            "query", server=self._trace_tag, query_kind=kind, k=k,
+        )
         with self._cond:
             while (
                 len(self._queue) >= self.queue_bound
@@ -154,6 +172,7 @@ class QueryServer:
                 for req in batch:
                     req.error = exc
                     req.finished_at = now
+                    req.span.finish(error=True)
                     req.done.set()
                 with self._cond:
                     self.failed = exc
@@ -164,6 +183,7 @@ class QueryServer:
                 for req in pending:
                     req.error = exc
                     req.finished_at = now
+                    req.span.finish(error=True)
                     req.done.set()
                 return
 
@@ -173,6 +193,7 @@ class QueryServer:
         # never changes a request's result
         groups: dict[tuple[str, int], list[QueryRequest]] = {}
         for req in batch:
+            req.span.event("admit", batch=len(batch))
             groups.setdefault((req.kind, req.k), []).append(req)
         for (kind, k), reqs in groups.items():
             texts = [r.text for r in reqs]
@@ -184,6 +205,8 @@ class QueryServer:
             for req, res in zip(reqs, results):
                 req.result = res
                 req.finished_at = now
+                req.span.event("drain", group=len(reqs))
+                req.span.finish()
                 req.done.set()
         with self._stats_lock:
             self._ticks += 1
@@ -218,6 +241,7 @@ class QueryServer:
             if not req.done.is_set():
                 req.error = RuntimeError("query server shut down")
                 req.finished_at = time.monotonic()
+                req.span.finish(error=True)
                 req.done.set()
 
     def __enter__(self):

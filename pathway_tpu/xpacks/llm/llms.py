@@ -342,6 +342,13 @@ class TPUDecoderChat(BaseChat):
         if self._server is not None:
             self._server.shutdown()
 
+    def recent_traces(self, n: int | None = None) -> list[dict]:
+        """Completed request spans of the continuous server (empty for
+        batch-static instances and under ``PATHWAY_TPU_METRICS=0``)."""
+        if self._server is None:
+            return []
+        return self._server.recent_traces(n=n)
+
     # two-phase protocol (continuous mode): submit enqueues every row into
     # the serving loop WITHOUT waiting; resolve blocks on the completions.
     # Combined with deferred=True the engine pump overlaps the decode.
@@ -503,10 +510,12 @@ class _PendingCompletion:
     """One in-flight continuous-batching request (host-side slot record)."""
 
     __slots__ = ("ids", "max_new", "tokens", "done", "text", "finished_at",
-                 "first_token_at")
+                 "first_token_at", "span")
 
     def __init__(self, ids: list, max_new: int):
         import threading
+
+        from pathway_tpu.engine import tracing
 
         self.ids = ids
         self.max_new = max_new
@@ -515,6 +524,7 @@ class _PendingCompletion:
         self.text: str | None = None
         self.finished_at: float | None = None  # time.perf_counter()
         self.first_token_at: float | None = None  # first token DRAINED
+        self.span = tracing.NULL_SPAN  # replaced by submit()
 
 
 class _ContinuousServer:
@@ -646,6 +656,10 @@ class _ContinuousServer:
         self._spec_off = False
         self._spec_drains = 0
         self._accept_ema: float | None = None
+        # spec registry counters accumulate here between flushes (one
+        # registry call per request completion, not six per drain); the
+        # loop thread owns it, so no lock
+        self._spec_accum: dict = {}
         # int8 KV (PATHWAY_TPU_KV_QUANT): the slot pool + prefix arena
         # store KV as symmetric int8 with per-(layer, slot, head, token)
         # f32 scales, dequantized on read inside attention
@@ -811,10 +825,20 @@ class _ContinuousServer:
         # local) so the failure sweep can fail eagerly-freed requests
         # whose tokens never drained
         self._inflight: deque = deque()
+        # tags this server's request spans in the global trace ring
+        self._trace_tag = f"decode:{id(self):x}"
         self.thread = threading.Thread(
             target=self._run_safe, daemon=True, name="pathway:decoder-serve"
         )
         self.thread.start()
+
+    def recent_traces(self, n: int | None = None) -> list[dict]:
+        """Completed per-request spans of THIS server (oldest first),
+        from the bounded global trace ring (``PATHWAY_TPU_TRACE_RING``).
+        Empty under ``PATHWAY_TPU_METRICS=0``."""
+        from pathway_tpu.engine import tracing
+
+        return tracing.recent_traces(server=self._trace_tag, n=n)
 
     def _run_safe(self):
         try:
@@ -841,12 +865,19 @@ class _ContinuousServer:
             for req in pending:
                 if not req.done.is_set():
                     req.text = None  # error sentinel (UDF rows -> ERROR)
+                    req.span.finish(error=True, tokens=len(req.tokens))
                     req.done.set()
 
     def submit(self, prompt_ids: list, max_new: int) -> _PendingCompletion:
         import time as time_mod
 
+        from pathway_tpu.engine import tracing
+
         req = _PendingCompletion(prompt_ids, max_new)
+        req.span = tracing.start_span(
+            "decode", server=self._trace_tag,
+            prompt_tokens=len(prompt_ids), max_new=max_new,
+        )
         now = time_mod.perf_counter()
         with self.lock:
             # checked under the lock: _run_safe drains the queue under it,
@@ -1086,7 +1117,12 @@ class _ContinuousServer:
         import jax
         import numpy as np
 
-        from pathway_tpu.engine.probes import record_prefix, record_spec
+        from pathway_tpu.engine import probes
+        from pathway_tpu.engine.probes import (
+            record_prefix,
+            record_spec,
+            record_spec_many,
+        )
         from pathway_tpu.ops import next_pow2
 
         active = np.zeros(self.n_slots, dtype=bool)
@@ -1147,6 +1183,15 @@ class _ContinuousServer:
                 pass
             self.stats["chunks"] += 1
             self.stats["slot_steps_total"] += self.n_slots * lane_steps
+            # refresh the occupancy gauge on every 8th chunk (and the
+            # first): the panel/scrape readers poll at human timescales,
+            # and a per-chunk gauge write is measurable overhead on the
+            # dispatch hot path
+            if (self.stats["chunks"] & 7) == 1:
+                probes.REGISTRY.gauge_set(
+                    "serving_occupancy", self.occupancy(),
+                    server=self._trace_tag,
+                )
             # snapshot WHICH request each lane served: by the time
             # these tokens drain the slot may have been freed and
             # re-admitted to a different request
@@ -1242,6 +1287,7 @@ class _ContinuousServer:
                 self._sent[slot] = 0
                 e = req.ids[-self.max_prompt_bucket:]
                 n = len(e)
+                req.span.event("admit", slot=int(slot))
                 B = self.prefix_block
                 # prefix-cache accounting + match. A hit never reuses the
                 # prompt's FINAL (partial or last-full) block: at least
@@ -1261,6 +1307,10 @@ class _ContinuousServer:
                     self.stats["prefix_requests"] += 1
                     self.stats["prefix_hit_tokens"] += hit_t
                     self.stats["prefix_miss_tokens"] += n - hit_t
+                    req.span.event(
+                        "prefix_match", hit_blocks=int(m_hit),
+                        hit_tokens=int(hit_t), miss_tokens=int(n - hit_t),
+                    )
                 if m_hit >= 1:
                     # cache hit: pin the matched path, seed the slot's
                     # cache columns [0, m_hit*B) straight from the arena
@@ -1337,6 +1387,10 @@ class _ContinuousServer:
                         direct_inserts.append((slot, ins))
                 self.stats["admitted"] += 1
             admit_direct(direct)
+            for slot, _ids_d, mask_d, _s_d in direct:
+                req_d = self.slots[slot]
+                if req_d is not None:
+                    req_d.span.event("prefill", tokens=int(mask_d.sum()))
             for slot, (req_i, e_i, base_i) in direct_inserts:
                 # after the admit dispatch: the slot's KV now holds the
                 # prompt's blocks — publish the new ones into the arena
@@ -1360,6 +1414,12 @@ class _ContinuousServer:
                         np.int32(lc),
                     )
                 self.stats["prefill_chunks"] += 1
+                req_p = self.slots[slot]
+                if req_p is not None:
+                    req_p.span.event(
+                        "prefill_chunk", offset=int(off),
+                        width=int(p_ids.shape[1]), last=bool(last),
+                    )
                 if last:
                     del self._pending_prefill[slot]
                     active[slot] = True
@@ -1377,6 +1437,7 @@ class _ContinuousServer:
             elif not inflight:
                 if self._pending_prefill:
                     continue
+                self._spec_flush()  # trailing drains past the last finish
                 self.wake.clear()
                 self.wake.wait(timeout=0.05)
                 continue
@@ -1395,12 +1456,16 @@ class _ContinuousServer:
                 drafted = cyc * n_act * kk
                 emitted = int(emit[:, lanes].sum()) if n_act else 0
                 accepted = emitted - cyc * n_act
-                record_spec("dispatches", 1)
-                record_spec("verify_steps", cyc * n_act)
-                record_spec("draft_steps", drafted)
-                record_spec("drafted", drafted)
-                record_spec("accepted", accepted)
-                record_spec("emitted", emitted)
+                # accumulate locally, flush to the registry at request
+                # completions (and loop idle): one registry call per
+                # request instead of six per spec drain
+                acc = self._spec_accum
+                for k, v in (
+                    ("dispatches", 1), ("verify_steps", cyc * n_act),
+                    ("draft_steps", drafted), ("drafted", drafted),
+                    ("accepted", accepted), ("emitted", emitted),
+                ):
+                    acc[k] = acc.get(k, 0) + v
                 self.stats["spec_verify_steps"] += cyc * n_act
                 self.stats["spec_drafted"] += drafted
                 self.stats["spec_accepted"] += accepted
@@ -1429,14 +1494,20 @@ class _ContinuousServer:
                         int(t) for c in range(toks.shape[0])
                         for t in toks[c, slot, : emit[c, slot]]
                     ]
+                    req.span.event(
+                        "spec_cycles", cycles=int(cyc),
+                        emitted=len(stream), accepted=len(stream) - int(cyc),
+                    )
                 else:
                     stream = toks[:, slot].tolist()
+                    req.span.event("decode_chunk", steps=len(stream))
                 for t in stream:
                     if self.eos_id is not None and t == self.eos_id:
                         req.max_new = 0  # stream closed
                         break
                     if not req.tokens:
                         req.first_token_at = time_mod.perf_counter()
+                        req.span.event("first_token")
                     req.tokens.append(int(t))
                     if len(req.tokens) >= req.max_new:
                         break
@@ -1454,7 +1525,25 @@ class _ContinuousServer:
                         with self.lock:
                             self.free.append(int(slot))
                     self._prefix_release(req)
+                    # flush + finish BEFORE done.set(): a waiter that
+                    # wakes on done must find the spec counters and the
+                    # span already recorded
+                    self._spec_flush()
+                    req.span.event("drain")
+                    req.span.finish(tokens=len(req.tokens))
                     req.done.set()
+
+    def _spec_flush(self):
+        """Flush locally-accumulated spec counters to the registry.
+        Called at request completions and loop idle; when the kill
+        switch is off the flush discards (record_spec_many no-ops), so
+        disabled-window counts never leak into an enabled scrape."""
+        acc = self._spec_accum
+        if acc:
+            self._spec_accum = {}
+            from pathway_tpu.engine.probes import record_spec_many
+
+            record_spec_many(**acc)
 
     def shutdown(self):
         self._stop = True

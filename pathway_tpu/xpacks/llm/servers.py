@@ -51,6 +51,35 @@ class BaseRestServer:
         )
         writer(handler(queries))
 
+    def start_observability_endpoints(self) -> None:
+        """Register ``GET /metrics`` (OpenMetrics text over the unified
+        ``MetricsRegistry``) and a registry-JSON ``/v1/statistics`` on
+        the shared webserver. Registered directly (not as dataflow
+        routes), so they answer even while the pipeline is compiling or
+        stalled; dataflow routes register later — at connector start,
+        inside ``pw.run`` — so a server that defines its own
+        ``/v1/statistics`` (e.g. :class:`QARestServer`) overrides the
+        registry JSON for that route while keeping ``/metrics``."""
+        from pathway_tpu.engine import probes
+        from pathway_tpu.internals import run as run_mod
+        from pathway_tpu.internals.http_server import openmetrics_text
+
+        async def metrics_handler(_payload):
+            return openmetrics_text()
+
+        # the io/http.py dispatch returns this as raw text, not JSON
+        metrics_handler._raw_content_type = "text/plain"
+
+        async def statistics_handler(_payload):
+            return probes.unified_snapshot(
+                getattr(run_mod, "LAST_RUN_STATS", None)
+            )
+
+        self.webserver._register("/metrics", ["GET"], metrics_handler)
+        self.webserver._register(
+            "/v1/statistics", ["GET", "POST"], statistics_handler
+        )
+
     def run(
         self,
         threaded: bool = False,
@@ -60,6 +89,7 @@ class BaseRestServer:
         **kwargs,
     ):
         """Start serving (reference ``run``, servers.py:68)."""
+        self.start_observability_endpoints()
 
         def run_pipeline():
             pw.run(

@@ -78,8 +78,17 @@ def test_bench_smoke_schema():
         "ttft_p50_ms", "spec_acceptance_rate", "tokens_per_dispatch",
         "spec_tok_s", "plain_tok_s", "spec_speedup_x", "kv_quant_tok_s",
         "kv_bytes_saved",
+        # registry-sourced latency keys (PR 7): bench re-reads these from
+        # the MetricsRegistry histograms, same series /metrics scrapes
+        "queue_wait_p50_ms", "tpot_p50_ms", "e2e_p50_ms",
     ):
         assert srv.get(key) is not None, key
+    # span-derived latencies are real measurements off the decode phase
+    assert srv["e2e_p50_ms"] > 0
+    assert srv["tpot_p50_ms"] > 0
+    assert srv["queue_wait_p50_ms"] >= 0
+    # e2e covers queue wait + generation, so it bounds both from above
+    assert srv["e2e_p50_ms"] >= srv["tpot_p50_ms"]
     assert 0.0 < srv["occupancy"] <= 1.0
     # the serving headline must come off the product path, not the bare
     # model API

@@ -1,16 +1,268 @@
-"""Observability tests — probes, dashboard renderer, Prometheus endpoint
+"""Observability tests — probes, dashboard renderer, Prometheus endpoint,
+OpenMetrics export surface, per-request spans and the trace ring
 (reference: src/engine/progress_reporter.rs, http_server.rs,
 internals/monitoring.py)."""
 
+import json
+import os
+import re
 import urllib.request
 
+import jax
+import jax.numpy as jnp
+import pytest
+
 import pathway_tpu as pw
+from pathway_tpu.engine import probes, tracing
 from pathway_tpu.engine.probes import SchedulerStats
 from pathway_tpu.internals import run as run_mod
-from pathway_tpu.internals.http_server import MetricsServer, metrics_from_stats
+from pathway_tpu.internals.http_server import (
+    MetricsServer,
+    metrics_from_stats,
+    openmetrics_text,
+    registry_text,
+)
 from pathway_tpu.internals.monitoring import MonitoringLevel, StatsMonitor
+from pathway_tpu.models import decoder as D
 
-from tests.utils import T, _capture_rows
+from tests.utils import T, ToyCharTokenizer, _capture_rows
+
+TINY = D.DecoderConfig(
+    vocab_size=128, hidden=32, layers=2, heads=4, intermediate=64,
+    max_position=128, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return D.init_params(jax.random.PRNGKey(0), TINY)
+
+
+def _decode_burst(tiny_params, n=4, **flags):
+    """A small continuous-serving burst; returns (texts, server tag)."""
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    chat = TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(),
+        max_new_tokens=6, temperature=0.0, max_prompt_tokens=32,
+        continuous=True, n_slots=2, chunk_steps=4, prefill_chunk=8,
+        **flags,
+    )
+    try:
+        prompts = [f"req {k:02d} text" for k in range(n)]
+        reqs = [chat.submit_batch([p])[0] for p in prompts]
+        for r in reqs:
+            assert r.done.wait(timeout=120)
+        return [r.text for r in reqs], chat.recent_traces()
+    finally:
+        chat.close()
+
+
+# one sample line: metric name, optional {labels}, then a number
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' (-?\d+(\.\d+)?([eE][+-]?\d+)?|[+-]Inf|NaN)$'
+)
+_COMMENT_RE = re.compile(
+    r"^# (HELP [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram)"
+    r"|EOF)$"
+)
+
+
+def _assert_openmetrics(text: str) -> None:
+    lines = text.rstrip("\n").split("\n")
+    assert lines[-1] == "# EOF"
+    for line in lines:
+        if line.startswith("#"):
+            assert _COMMENT_RE.match(line), f"bad comment line: {line!r}"
+        else:
+            assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+
+
+def _events(span: dict) -> dict:
+    """First occurrence time of each event name in one span dict."""
+    out: dict = {}
+    for e in span["events"]:
+        out.setdefault(e["name"], e["t_ms"])
+    return out
+
+
+def test_registry_text_renders_all_families_before_first_sample():
+    """An early scrape (nothing recorded) must still expose HELP/TYPE
+    for every declared family — the serving histograms and counters the
+    acceptance criterion names."""
+    probes.REGISTRY.reset()
+    text = registry_text()
+    for fam in (
+        "ttft_seconds", "tpot_seconds", "queue_wait_seconds",
+        "e2e_seconds", "prefix_events", "spec_events", "cascade_pairs",
+        "device_dispatch",
+    ):
+        assert f"# TYPE pathway_tpu_{fam} " in text
+    _assert_openmetrics(text + "# EOF\n")
+
+
+def test_rest_metrics_scrape_during_live_burst(tiny_params):
+    """curl /metrics on a REST server during/after a serving burst:
+    every line parses as OpenMetrics and the latency histograms +
+    serving counters carry real samples."""
+    from pathway_tpu.xpacks.llm.servers import BaseRestServer
+
+    probes.REGISTRY.reset()
+    server = BaseRestServer("127.0.0.1", 0)
+    server.start_observability_endpoints()
+    server.webserver.start()
+    base = f"http://127.0.0.1:{server.webserver.port}"
+
+    # scrape BEFORE the burst: valid exposition, full declared surface
+    early = urllib.request.urlopen(base + "/metrics", timeout=5).read().decode()
+    _assert_openmetrics(early)
+    assert "# TYPE pathway_tpu_ttft_seconds histogram" in early
+
+    texts, _ = _decode_burst(tiny_params)
+    assert all(texts)
+
+    body = urllib.request.urlopen(base + "/metrics", timeout=5).read().decode()
+    _assert_openmetrics(body)
+    for needle in (
+        'pathway_tpu_ttft_seconds_bucket{le="+Inf",phase="decode"}',
+        'pathway_tpu_tpot_seconds_count{phase="decode"}',
+        'pathway_tpu_queue_wait_seconds_sum{phase="decode"}',
+        'pathway_tpu_e2e_seconds_count{phase="decode"}',
+        "pathway_tpu_device_dispatch_total{",
+        "pathway_tpu_serving_occupancy{",
+    ):
+        assert needle in body, needle
+
+    stats = json.loads(
+        urllib.request.urlopen(base + "/v1/statistics", timeout=5)
+        .read().decode()
+    )
+    # the JSON surface and the probes module must agree — same registry
+    want = probes.serving_snapshot()
+    assert stats["serving"]["latency"].keys() == want["latency"].keys()
+    for name, summary in want["latency"].items():
+        assert stats["serving"]["latency"][name]["count"] == summary["count"]
+    assert stats["serving"]["dispatch"] == want["dispatch"]
+    assert set(stats) == {"scheduler", "serving", "registry"}
+
+
+def test_span_ordering_invariants_on_equivalence_grid(tiny_params):
+    """Every span from the serving equivalence grid is complete and its
+    event times are ordered: enqueue <= admit <= first_token <= drain."""
+    tracing.reset_traces()
+    for flags in (
+        {"spec_decode": False},
+        {"spec_decode": True},
+        {"prefix_cache": True, "prefix_cache_mb": 4},
+    ):
+        texts, spans = _decode_burst(tiny_params, **flags)
+        assert len(spans) == len(texts)
+        for span in spans:
+            ev = _events(span)
+            assert ev["enqueue"] == 0.0
+            assert 0.0 <= ev["admit"] <= ev["first_token"] <= ev["drain"]
+            assert 1 <= span["attrs"]["tokens"] <= 6
+            m = span["metrics"]
+            assert m["queue_wait_ms"] <= m["ttft_ms"] <= m["e2e_ms"]
+            if "prefix_cache" in flags:
+                assert "prefix_match" in ev
+
+
+def test_trace_ring_is_bounded(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_TRACE_RING", "3")
+    tracing.reset_traces()
+    for _ in range(7):
+        tracing.start_span("query", server="ring-test").finish()
+    assert len(tracing.recent_traces(server="ring-test")) == 3
+
+
+def test_jsonl_flight_recorder(monkeypatch, tmp_path):
+    monkeypatch.setenv("PATHWAY_TPU_TRACE_DIR", str(tmp_path))
+    span = tracing.start_span("query", server="jsonl-test", k=4)
+    span.event("admit")
+    span.event("drain")
+    span.finish()
+    path = tmp_path / f"trace-{os.getpid()}.jsonl"
+    lines = path.read_text().strip().split("\n")
+    rec = json.loads(lines[-1])
+    assert rec["kind"] == "query" and rec["server"] == "jsonl-test"
+    assert [e["name"] for e in rec["events"]] == ["enqueue", "admit", "drain"]
+    assert rec["attrs"]["k"] == 4
+    assert "e2e_ms" in rec["metrics"] and "queue_wait_ms" in rec["metrics"]
+
+
+def test_kill_switch_byte_identical_outputs(tiny_params, monkeypatch):
+    """PATHWAY_TPU_METRICS=0: token streams identical, no spans, no new
+    registry series — instrumentation never touches compute."""
+    on_texts, on_spans = _decode_burst(tiny_params)
+    assert len(on_spans) == len(on_texts)
+
+    monkeypatch.setenv("PATHWAY_TPU_METRICS", "0")
+    probes.REGISTRY.reset()
+    tracing.reset_traces()
+    off_texts, off_spans = _decode_burst(tiny_params)
+    assert off_texts == on_texts
+    assert off_spans == []
+    snap = probes.REGISTRY.snapshot()
+    assert snap == {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+def test_serving_panel_renders_from_registry():
+    probes.REGISTRY.reset()
+    monitor = StatsMonitor(SchedulerStats(), MonitoringLevel.ALL)
+    assert monitor._serving_panel() is None  # nothing recorded yet
+    probes.record_prefix("requests", 4)
+    probes.record_prefix("hit_requests", 3)
+    probes.record_prefix("hit_tokens", 96)
+    probes.record_prefix("miss_tokens", 32)
+    probes.record_spec("drafted", 10)
+    probes.record_spec("accepted", 8)
+    probes.record_spec("emitted", 12)
+    probes.record_spec("verify_steps", 4)
+    probes.observe_latency("ttft_seconds", 0.03, "decode")
+    probes.REGISTRY.gauge_set("serving_occupancy", 0.8, server="s")
+    panel = monitor._serving_panel()
+    assert panel is not None and panel.row_count >= 6
+    from rich.console import Group
+
+    assert isinstance(monitor._render_dashboard(), Group)
+    probes.REGISTRY.reset()
+    assert monitor._serving_panel() is None
+    # with no serving data the dashboard is just the operator table
+    assert not isinstance(monitor._render_dashboard(), Group)
+
+
+def test_cli_stats_pretty_and_json():
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli
+
+    probes.REGISTRY.reset()
+    probes.record_prefix("requests", 2)
+    probes.record_prefix("hit_tokens", 8)
+    probes.record_prefix("miss_tokens", 8)
+    probes.observe_latency("e2e_seconds", 0.12, "decode")
+    runner = CliRunner()
+    res = runner.invoke(cli, ["stats", "--as-json"])
+    assert res.exit_code == 0, res.output
+    snap = json.loads(res.output)
+    assert snap["serving"]["prefix"]["hit_rate"] == 0.5
+    res = runner.invoke(cli, ["stats"])
+    assert res.exit_code == 0, res.output
+    assert "prefix" in res.output and "latency/e2e_seconds" in res.output
+    probes.REGISTRY.reset()
+
+
+def test_openmetrics_includes_scheduler_gauges():
+    stats = SchedulerStats()
+    stats.record_step(1, "select", 10, 10, 0.001)
+    text = openmetrics_text(stats.snapshot())
+    assert "# TYPE pathway_logical_time gauge" in text
+    assert text.rstrip("\n").endswith("# EOF")
 
 
 def test_scheduler_collects_operator_stats():

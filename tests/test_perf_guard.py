@@ -189,3 +189,105 @@ def test_spec_decode_tok_s_not_worse_than_plain():
     assert spec >= plain * 1.0, (
         f"spec decode {spec:.1f} tok/s slower than plain {plain:.1f} tok/s"
     )
+
+
+@pytest.mark.slow
+def test_instrumentation_overhead_under_three_pct(monkeypatch):
+    """Metrics + tracing on must sustain >= 0.97x the throughput of the
+    PATHWAY_TPU_METRICS=0 kill switch on the same greedy burst, and the
+    two arms must emit byte-identical token streams — observability is
+    bookkeeping around the serving loop, never inside the computation.
+    Warm-up outside both timed windows; 3% slack is the instrumentation
+    budget, not jitter allowance (the burst is long enough that host
+    jitter stays well under it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.engine import probes, tracing
+    from pathway_tpu.models import decoder as D
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+    from tests.utils import ToyCharTokenizer
+
+    cfg = D.DecoderConfig(
+        vocab_size=128, hidden=64, layers=4, heads=4, intermediate=128,
+        max_position=256, dtype=jnp.float32,
+    )
+    params = D.init_params(jax.random.PRNGKey(0), cfg)
+    head = "c" * 40 + "ontext: "
+    # 16 requests x 32 tokens: a long enough timed window (~0.3s steady
+    # state) that a 3% delta is measurement, not noise
+    prompts = [head + f"q{k:02d}tail"[:8].ljust(8, "x") for k in range(16)]
+
+    probes.REGISTRY.reset()
+    tracing.reset_traces()
+    # ONE server for both arms: the kill switch is read per call, so
+    # flipping the env between bursts compares identical compiled
+    # executables and thread state — no cold-start confound
+    chat = TPUDecoderChat(
+        params=params, cfg=cfg, tokenizer=ToyCharTokenizer(128),
+        max_new_tokens=32, temperature=0.0, max_prompt_tokens=64,
+        continuous=True, n_slots=4, chunk_steps=8, pipeline_depth=2,
+        prefill_chunk=8, prefix_cache=False,
+    )
+    try:
+        for r in chat.submit_batch([head + "warmAAxx"] * 2):
+            assert r.done.wait(timeout=120)
+
+        def burst(metrics_on: bool):
+            monkeypatch.setenv(
+                "PATHWAY_TPU_METRICS", "1" if metrics_on else "0"
+            )
+            t0 = time.perf_counter()
+            reqs = chat.submit_batch(prompts)
+            for r in reqs:
+                assert r.done.wait(timeout=120)
+            wall = max(r.finished_at for r in reqs) - t0
+            gen = sum(len(r.tokens) for r in reqs)
+            return gen / max(wall, 1e-9), [list(r.tokens) for r in reqs]
+
+        on_tok_s, on_toks = burst(True)
+        # instrumentation actually ran: 2 warm-up + 16 burst spans
+        assert len(chat.recent_traces()) == len(prompts) + 2
+        off_tok_s, off_toks = burst(False)
+        # kill switch actually killed it: no new spans
+        assert len(chat.recent_traces()) == len(prompts) + 2
+        assert off_toks == on_toks, "kill switch changed the token streams"
+        # a single ~0.2s burst jitters +-5-10% on a loaded CPU host —
+        # far above the 3% bar — so the guard compares TWO robust
+        # estimators over 12 alternating rounds (order flipped each
+        # round, so neither arm systematically lands the warmer slot
+        # while CPU frequency ramps):
+        #   * the median of per-round on/off ratios — robust to the
+        #     occasional GC pause or scheduler hiccup (outliers);
+        #   * the ratio of per-arm peaks — burst noise is one-sided
+        #     (stalls only slow a burst down), so each arm's max
+        #     estimates its clean-host rate.
+        # A real instrumentation regression shifts the whole
+        # distribution and fails BOTH; host noise rarely sinks both at
+        # once, which is what makes a 3% bar decidable at all here.
+        def measure():
+            ons, offs = [on_tok_s], [off_tok_s]
+            for i in range(11):
+                first, second = (True, False) if i % 2 else (False, True)
+                r1 = burst(first)[0]
+                r2 = burst(second)[0]
+                on_r, off_r = (r1, r2) if first else (r2, r1)
+                ons.append(on_r)
+                offs.append(off_r)
+            med = float(np.median(np.asarray(ons) / np.asarray(offs)))
+            return med, max(ons) / max(offs), ons, offs
+
+        med, edge, ons, offs = measure()
+        if max(med, edge) < 0.97:
+            # one remeasure before declaring a regression: a co-tenant
+            # burning the host for a few seconds sinks every round of
+            # one attempt, but a real instrumentation cost fails both
+            med, edge, ons, offs = measure()
+    finally:
+        chat.close()
+    assert max(med, edge) >= 0.97, (
+        f"instrumentation overhead above 3%: median paired ratio "
+        f"{med:.4f}, peak ratio {edge:.4f} over {len(ons)} rounds "
+        f"(on={[f'{v:.0f}' for v in ons]}, "
+        f"off={[f'{v:.0f}' for v in offs]})"
+    )
