@@ -1,0 +1,30 @@
+"""graft-lint: the repo's own invariants as machine-checkable passes.
+
+Seven PRs accreted the conventions that keep this stack correct — every
+knob declared once in ``FLAG_REGISTRY``, every perf feature's kill
+switch pinned byte-identical by a test, no host-side effects inside
+jitted hot paths, lock-guarded shared state in the threaded serving
+components. This package turns each convention into an AST pass with a
+stable rule id (``python -m pathway_tpu.analysis check``), plus a
+runtime lock sanitizer (:mod:`pathway_tpu.analysis.runtime`,
+``PATHWAY_TPU_LOCK_SANITIZER``) that records held-lock sets per thread
+under the existing threaded tests and reports lock-order inversions and
+unguarded guarded-field writes.
+
+Import surface is deliberately lazy: ``annotations`` (the
+``guarded_by`` / ``assumes_held`` decorators) and ``runtime``
+(``make_lock``) are imported by hot modules at package import time, so
+this ``__init__`` must never pull the AST passes in.
+"""
+
+from __future__ import annotations
+
+__all__ = ["check", "analyze_source", "RULES", "Finding"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from pathway_tpu.analysis import core
+
+        return getattr(core, name)
+    raise AttributeError(name)

@@ -1,0 +1,69 @@
+"""GL3xx — kill-switch coverage pass.
+
+PRs 2–7 established the discipline: every perf feature ships with an env
+kill switch, and a test pins the killed path byte-identical to the
+feature path. Until now that was remembered, not enforced. The registry
+now carries the contract explicitly — ``Flag.kill_switch=True`` plus
+``Flag.pinned_by="tests/test_x.py"`` — and **GL301** verifies it stays
+live: the named test file must exist and must actually reference the
+env var (a renamed or deleted pinning test un-pins the switch and fails
+the analyzer, not a human's memory).
+
+Registry-wide by nature: runs only on full-package scans (needs
+``internals/config.py`` in the scanned set). Unit tests drive
+:func:`check_kill_switches` directly with synthetic registries and a
+tmp_path tests tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from pathway_tpu.analysis.core import Finding, PackageCtx
+from pathway_tpu.analysis.flag_hygiene import CONFIG_PATH, _registry_line
+
+
+def check_kill_switches(flags, repo_root: str) -> list[tuple[str, str]]:
+    """``[(env, problem), ...]`` for every ``kill_switch=True`` flag whose
+    pinning contract is broken."""
+    problems: list[tuple[str, str]] = []
+    for flag in flags:
+        if not getattr(flag, "kill_switch", False):
+            continue
+        pinned_by = getattr(flag, "pinned_by", None)
+        if not pinned_by:
+            problems.append(
+                (flag.env, "kill_switch=True but no `pinned_by=` test file")
+            )
+            continue
+        full = os.path.join(repo_root, pinned_by)
+        if not os.path.exists(full):
+            problems.append(
+                (flag.env, f"pinned_by `{pinned_by}` does not exist")
+            )
+            continue
+        with open(full, encoding="utf-8") as f:
+            if flag.env not in f.read():
+                problems.append(
+                    (flag.env,
+                     f"pinned_by `{pinned_by}` never references `{flag.env}` "
+                     "— the pinning test is gone or renamed")
+                )
+    return problems
+
+
+def run(ctx: PackageCtx) -> list[Finding]:
+    config = ctx.module(CONFIG_PATH)
+    if config is None or not ctx.registry_checks:
+        return []
+    from pathway_tpu.internals.config import FLAG_REGISTRY
+
+    findings: list[Finding] = []
+    for env, problem in check_kill_switches(FLAG_REGISTRY, ctx.repo_root):
+        line = _registry_line(config, env)
+        node = ast.Constant(value=env)
+        node.lineno = line
+        config.emit(findings, "GL301", node,
+                    f"`{env}`: {problem}", env)
+    return findings

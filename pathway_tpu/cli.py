@@ -26,6 +26,8 @@ import uuid
 import venv
 from pathlib import Path
 
+from pathway_tpu.internals.config import environ_snapshot, pathway_config
+
 import click
 
 import pathway_tpu as pw
@@ -142,7 +144,7 @@ def cli() -> None:
 def spawn(threads, processes, first_port, record, record_path,
           repository_url, branch, program, arguments):
     """Launch PROGRAM as a multi-process pathway-tpu run."""
-    env = os.environ.copy()
+    env = environ_snapshot()
     if record:
         env["PATHWAY_REPLAY_STORAGE"] = record_path
         env["PATHWAY_SNAPSHOT_ACCESS"] = "record"
@@ -182,7 +184,7 @@ def replay(threads, processes, first_port, record_path, mode,
            continue_after_replay, repository_url, branch, program, arguments):
     """Replay PROGRAM against a recorded input stream (reference
     ``cli.py:replay``)."""
-    env = os.environ.copy()
+    env = environ_snapshot()
     env["PATHWAY_REPLAY_STORAGE"] = record_path
     env["PATHWAY_SNAPSHOT_ACCESS"] = "replay"
     env["PATHWAY_PERSISTENCE_MODE"] = (
@@ -208,7 +210,7 @@ def replay(threads, processes, first_port, record_path, mode,
 def spawn_from_env(program, arguments):
     """Like ``spawn`` but flags come from $PATHWAY_SPAWN_ARGS (reference
     ``cli.py`` spawn-from-env)."""
-    spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "")
+    spawn_args = pathway_config.spawn_args
     argv = [*shlex.split(spawn_args), program, *arguments]
     spawn.main(args=argv, standalone_mode=True)
 

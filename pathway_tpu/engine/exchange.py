@@ -49,11 +49,11 @@ _LEN = struct.Struct("<Q")
 
 import os as _os
 
-_DEBUG = bool(_os.environ.get("PATHWAY_EXCHANGE_DEBUG"))
+from pathway_tpu.internals.config import pathway_config
 
 
 def _dbg(msg: str) -> None:
-    if _DEBUG:
+    if pathway_config.exchange_debug:
         import sys
 
         print(f"[exchange pid={_os.getpid()}] {msg}", file=sys.stderr,
@@ -128,7 +128,7 @@ class PeerMesh:
 
     def _store(self, peer: int, msg: tuple) -> None:
         kind = msg[0]
-        if _DEBUG:
+        if pathway_config.exchange_debug:
             _dbg(f"recv {kind} {msg[1:3] if len(msg) > 2 else msg[1:]} "
                  f"from {peer}")
         with self.lock:
@@ -185,7 +185,7 @@ class PeerMesh:
                 self._recv_lock.release()
 
     def send(self, peer: int, msg: tuple) -> None:
-        if _DEBUG:
+        if pathway_config.exchange_debug:
             _dbg(f"send {msg[0]} "
                  f"{msg[1:3] if len(msg) > 2 else msg[1:]} to {peer}")
         self.send_blob(peer, _encode(msg))
@@ -275,7 +275,7 @@ class ExchangeContext:
     def control_allgather(self, rnd: int, payload, timeout: float = 300.0):
         """Send payload for lockstep round ``rnd``; return {pid: payload}
         for ALL processes (self included)."""
-        if _DEBUG:
+        if pathway_config.exchange_debug:
             _dbg(f"ctl rnd={rnd} payload={payload}")
         for p in self.mesh.peers:
             self.mesh.send(p, ("ctl", rnd, payload))
@@ -302,7 +302,7 @@ class ExchangeContext:
         every peer's DONE marker for the same (ex, t) arrives; return the
         batches peers sent here. ``broadcast`` sends ONE batch to every peer
         (encoded once, not per peer)."""
-        if _DEBUG:
+        if pathway_config.exchange_debug:
             _dbg(f"exchange ex={ex} t={t} "
                  f"out={ {p: len(b) for p, b in outbound.items()} } "
                  f"bcast={len(broadcast) if broadcast is not None else 0}")

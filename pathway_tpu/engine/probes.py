@@ -44,6 +44,9 @@ import dataclasses
 import threading
 import time
 
+from pathway_tpu.analysis.annotations import guarded_by
+from pathway_tpu.analysis.runtime import make_lock
+
 # v5e peak: 197 TFLOP/s bf16 MXU, ~819 GB/s HBM (public TPU v5e specs)
 V5E_PEAK_BF16_FLOPS = 197e12
 V5E_PEAK_HBM_BYTES = 819e9
@@ -99,6 +102,7 @@ LATENCY_HISTOGRAMS = (
 )
 
 
+@guarded_by(_counters="_lock", _gauges="_lock", _hists="_lock")
 class MetricsRegistry:
     """Single thread-safe registry of counters, gauges and log-bucketed
     histograms, each a family of label-keyed series.
@@ -110,7 +114,7 @@ class MetricsRegistry:
     flip it with ``monkeypatch.setenv``); resets always apply."""
 
     def __init__(self, hist_bounds: tuple = _DEFAULT_HIST_BOUNDS):
-        self._lock = threading.RLock()
+        self._lock = make_lock("probes.registry", rlock=True)
         self.hist_bounds = tuple(float(b) for b in hist_bounds)
         self._counters: dict[str, dict[tuple, float]] = {}
         self._gauges: dict[str, dict[tuple, float]] = {}
@@ -189,8 +193,8 @@ class MetricsRegistry:
     def labelled(self, name: str, label: str,
                  kind: str = "counter") -> dict[str, float]:
         """Series values of ``name`` summed by their ``label`` value."""
-        store = self._counters if kind == "counter" else self._gauges
         with self._lock:
+            store = self._counters if kind == "counter" else self._gauges
             items = list((store.get(name) or {}).items())
         out: dict[str, float] = {}
         for key, v in items:
@@ -677,6 +681,7 @@ class PhaseRoofline:
         }
 
 
+@guarded_by(phases="_lock")
 class RooflineModel:
     """Per-phase (seconds, FLOPs, bytes) ledger -> MFU / bandwidth report."""
 
@@ -687,7 +692,7 @@ class RooflineModel:
     ):
         self.peak_flops = peak_flops
         self.peak_bytes = peak_bytes
-        self._lock = threading.Lock()
+        self._lock = make_lock("probes.roofline")
         self.phases: dict[str, PhaseRoofline] = {}
 
     def add(
@@ -739,11 +744,18 @@ class ConnectorStats:
     finished: bool = False
 
 
+@guarded_by(operators="_lock", connectors="_lock", steps_skipped="_lock")
 class SchedulerStats:
-    """Thread-safe stats registry attached to a live scheduler."""
+    """Thread-safe stats registry attached to a live scheduler.
+
+    Only the collections (and the skip counter) are guarded:
+    ``current_time`` / ``epochs_total`` / ``finished`` / ``fused_*`` are
+    written by the single scheduler thread before workers start or after
+    they stop, so declaring them guarded would be a lie the analyzer
+    rightly rejects."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("probes.scheduler_stats")
         self.operators: dict[int, OperatorStats] = {}
         # keyed by connector node id (names may collide across connectors)
         self.connectors: dict[int, ConnectorStats] = {}

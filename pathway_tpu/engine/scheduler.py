@@ -246,7 +246,7 @@ class Scheduler:
             states = ctx.control_allgather(
                 tag, (local_t, frontier, live, inflight)
             )
-            if exchange_mod._DEBUG:
+            if exchange_mod.pathway_config.exchange_debug:
                 exchange_mod._dbg(f"round {rnd} states={states}")
             rnd += 1
             times = [s[0] for s in states.values() if s[0] is not None]

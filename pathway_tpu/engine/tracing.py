@@ -31,15 +31,20 @@ from __future__ import annotations
 import itertools
 import json
 import os
-import threading
 import time
 from collections import deque
 
+from pathway_tpu.analysis.runtime import make_lock
 from pathway_tpu.engine import probes
 
 __all__ = [
     "Span", "NULL_SPAN", "start_span", "recent_traces", "reset_traces",
 ]
+
+# lock-discipline declaration for module globals (enforced by
+# `python -m pathway_tpu.analysis check`, rule GL401): the span ring and
+# the lazy telemetry singleton may only be touched under their locks.
+_GUARDED_BY = {"_ring": "_ring_lock", "_telemetry": "_telemetry_lock"}
 
 
 class _NullSpan:
@@ -57,11 +62,11 @@ class _NullSpan:
 NULL_SPAN = _NullSpan()
 
 _ids = itertools.count(1)
-_ring_lock = threading.Lock()
+_ring_lock = make_lock("tracing.ring")
 _ring: deque = deque()
-_jsonl_lock = threading.Lock()
+_jsonl_lock = make_lock("tracing.jsonl")
 _telemetry = None
-_telemetry_lock = threading.Lock()
+_telemetry_lock = make_lock("tracing.telemetry")
 
 
 class Span:

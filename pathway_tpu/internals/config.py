@@ -51,7 +51,15 @@ class Flag:
     table (``pipeline`` / ``query`` / ``observability``); ``minimum``
     clamps explicit
     values (defaults are trusted as-is, matching the historical
-    accessors); ``parse`` overrides the ``kind`` parser."""
+    accessors); ``parse`` overrides the ``kind`` parser.
+
+    ``kill_switch=True`` declares the PR-2..7 contract explicitly: the
+    flag's off position must leave outputs byte-identical, and
+    ``pinned_by`` names the test file holding the byte-equality pin.
+    The contract is analyzer-enforced (rule ``GL301``,
+    ``python -m pathway_tpu.analysis check``): the file must exist and
+    reference the env var, so renaming or deleting a pinning test fails
+    CI instead of silently un-pinning the switch."""
 
     env: str
     kind: str  # "bool" | "int" | "float" | "str"
@@ -61,6 +69,8 @@ class Flag:
     group: str | None = None
     minimum: float | None = None
     parse: Any = None
+    kill_switch: bool = False
+    pinned_by: str | None = None
 
     def read(self) -> Any:
         if self.kind == "bool":
@@ -87,13 +97,44 @@ FLAG_REGISTRY: list[Flag] = [
     # ---- ungrouped (documented in prose, not a README table) ----------
     Flag(
         env="PATHWAY_FUSION", kind="bool", default=True, attr="fusion",
+        kill_switch=True, pinned_by="tests/test_fusion.py",
         doc="Stateless operator-chain fusion (scheduler plan rewrite, "
             "`engine/graph.py:fuse_chains`); read per scheduler "
             "construction.",
     ),
+    Flag(
+        env="PATHWAY_EXCHANGE_DEBUG", kind="bool", default=False,
+        attr="exchange_debug",
+        doc="Verbose multi-process exchange logging (stderr) in "
+            "`engine/exchange.py`; read per message, so it can be "
+            "flipped without re-importing.",
+    ),
+    Flag(
+        env="PATHWAY_DISABLE_NATIVE", kind="bool", default=False,
+        attr="disable_native",
+        doc="Skip loading the optional native extension in "
+            "`pathway_tpu/native/` and use the pure-Python fallbacks "
+            "(diagnostic escape hatch; read once at first native call).",
+    ),
+    Flag(
+        env="PATHWAY_SPAWN_ARGS", kind="str", default="",
+        attr="spawn_args",
+        doc="Extra whitespace-separated argv appended by `pathway spawn` "
+            "re-exec (internal plumbing between the CLI wrapper and the "
+            "spawned workers).",
+    ),
+    Flag(
+        env="PATHWAY_COORDINATOR", kind="str", default="",
+        attr="coordinator",
+        doc="`host:port` of the jax.distributed coordinator for "
+            "multi-process runs; empty derives "
+            "`localhost:PATHWAY_FIRST_PORT` (see "
+            "`parallel/distributed.py:from_env`).",
+    ),
     # ---- ingest / engine / serving knobs (README 'pipeline' table) ----
     Flag(
         env="PATHWAY_TPU_PIPELINE", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_embedder_pipeline.py",
         attr="tpu_pipeline", group="pipeline",
         doc="Pipelined `embed_submit`: a background tokenizer worker "
             "feeds a bounded queue and a dispatch worker stages the next "
@@ -118,6 +159,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_CHUNKED_PREFILL", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_chunk_admission.py",
         attr="chunked_prefill", group="pipeline",
         doc="Continuous serving: admit a long prompt in "
             "`PATHWAY_TPU_PREFILL_CHUNK`-token pieces interleaved with "
@@ -132,6 +174,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_EAGER_REFILL", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_chunk_admission.py",
         attr="eager_refill", group="pipeline",
         doc="Free a serving slot the moment its request's token budget "
             "is covered by dispatched chunks (tokens drain later from "
@@ -152,12 +195,14 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_FUSED_H2D", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_embedder_pipeline.py",
         attr="fused_h2d", group="pipeline",
         doc="Ingest host→device transfer as one fused int16 ids+mask "
             "staging copy instead of per-array puts.",
     ),
     Flag(
         env="PATHWAY_TPU_COLUMNAR_SUBSCRIBE", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_engine_closeout.py",
         attr="columnar_subscribe", group="pipeline",
         doc="`pw.io.subscribe` formats row callbacks COLUMNARLY on a "
             "named background thread (`pathway:subscribe:<node>`) per "
@@ -167,6 +212,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_DRAIN_COALESCE", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_engine_closeout.py",
         attr="drain_coalesce", group="pipeline",
         doc="Deferred-UDF drainer merges consecutive resolved chunks "
             "into one injected engine batch when the scheduler has no "
@@ -183,6 +229,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_EPOCH_CLOSEOUT", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_engine_closeout.py",
         attr="epoch_closeout", group="pipeline",
         doc="Epoch close-out cuts: batches that are provably "
             "single-sign/distinct carry a consolidation proof through "
@@ -192,6 +239,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_BATCH_ADMIT", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_chunk_admission.py",
         attr="batch_admit", group="pipeline",
         doc="Continuous serving: requests waiting at the same chunk "
             "boundary with the same prompt bucket admit through ONE "
@@ -201,6 +249,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PREFILL_OVERLAP", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_chunk_admission.py",
         attr="prefill_overlap", group="pipeline",
         doc="Serving loop dispatches the next decode chunk BEFORE "
             "scanning for admissions, so admission prefills overlap "
@@ -208,6 +257,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_CHUNK_AUTOTUNE", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_chunk_admission.py",
         attr="chunk_autotune", group="pipeline",
         doc="Serving loop adapts `chunk_steps` to queue pressure (small "
             "chunks while requests wait → lower admission latency; "
@@ -216,6 +266,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PREFIX_CACHE", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_prefix_cache.py",
         attr="prefix_cache", group="pipeline",
         doc="Radix-tree KV prefix cache for continuous serving: "
             "block-aligned prompt prefixes keep their KV in a device "
@@ -243,6 +294,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SPEC_DECODE", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_spec_decode.py",
         attr="spec_decode", group="pipeline",
         doc="Self-speculative decoding for greedy continuous serving: "
             "the first `PATHWAY_TPU_SPEC_DECODE_DRAFT_LAYERS` layers "
@@ -273,6 +325,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_KV_QUANT", kind="str", default="",
+        kill_switch=True, pinned_by="tests/test_kv_quant.py",
         attr="kv_quant", group="pipeline", parse=_parse_kv_quant,
         doc="`int8` stores the KV slot pool AND the prefix-cache arena "
             "as symmetric per-(layer, slot, head, token) int8 with f32 "
@@ -284,6 +337,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_TOKENIZE_CACHE", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_prefix_cache.py",
         attr="tokenize_cache", group="pipeline",
         doc="Content-keyed encode memo in the tokenizers "
             "(HashTokenizer / WordPiece batch paths and whole-text "
@@ -294,6 +348,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_EMBED_DEDUP", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_prefix_cache.py",
         attr="embed_dedup", group="pipeline",
         doc="Content-keyed embedding reuse in "
             "`SentenceTransformerEmbedder`: byte-identical texts "
@@ -313,6 +368,7 @@ FLAG_REGISTRY: list[Flag] = [
     # ---- query-path knobs (README 'query' table) ----------------------
     Flag(
         env="PATHWAY_TPU_PAIR_BUCKETS", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_rerank_cascade.py",
         attr="pair_buckets", group="query",
         doc="Pow2 length-bucketed pair packing in the fused rerank. `0` "
             "pads every pair to the full `pair_seq` window (seed "
@@ -320,6 +376,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_RERANK_CASCADE", kind="bool", default=False,
+        kill_switch=True, pinned_by="tests/test_rerank_cascade.py",
         attr="rerank_cascade", group="query",
         doc="Two-stage early-exit rerank inside the single fused "
             "dispatch. `0` scores every candidate at full depth (seed "
@@ -365,6 +422,7 @@ FLAG_REGISTRY: list[Flag] = [
     # ---- observability knobs (README 'observability' table) -----------
     Flag(
         env="PATHWAY_TPU_METRICS", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_observability.py",
         attr="metrics", group="observability",
         doc="Master kill switch for the observability layer: `0` turns "
             "every `MetricsRegistry` write (counters, gauges, latency "
@@ -389,7 +447,40 @@ FLAG_REGISTRY: list[Flag] = [
             "demand; write errors are swallowed — tracing must never "
             "break serving). Unset (default) disables the recorder.",
     ),
+    Flag(
+        env="PATHWAY_TPU_LOCK_SANITIZER", kind="bool", default=False,
+        attr="lock_sanitizer", group="observability",
+        doc="Runtime race harness (`pathway_tpu/analysis/runtime.py`): "
+            "locks built through `analysis.runtime.make_lock` record "
+            "per-thread held-lock sets, report lock-order inversions "
+            "and writes to `guarded_by` fields outside their lock. Read "
+            "once per lock CONSTRUCTION — when off (default) the "
+            "constructor returns a plain `threading.Lock`/`RLock`, so "
+            "the serving hot paths carry zero wrapper cost "
+            "(`tests/test_perf_guard.py` pins the ON-arm overhead "
+            "≤ 3%, tokens byte-identical either way).",
+    ),
 ]
+
+
+def env_interpolate(name: str) -> str | None:
+    """Read one environment variable by (possibly dynamic) name.
+
+    The audited choke point for the rare legitimate dynamic env read —
+    YAML `$ENV` interpolation, user-named credentials. Everything
+    declared in :data:`FLAG_REGISTRY` must be read through
+    ``pathway_config`` instead; the analyzer (rule ``GL202``) flags any
+    direct ``os.environ`` use outside this module."""
+    return os.environ.get(name)
+
+
+def environ_snapshot(**overrides: str) -> dict[str, str]:
+    """A copy of the current process environment (plus ``overrides``),
+    for handing a subprocess its inherited environment. The audited
+    choke point for whole-environment access outside this module."""
+    env = dict(os.environ)
+    env.update(overrides)
+    return env
 
 
 def render_flag_table(group: str) -> str:
@@ -451,6 +542,10 @@ class PathwayConfig:
     @property
     def first_port(self) -> int:
         return int(os.environ.get("PATHWAY_FIRST_PORT", "10000"))
+
+    @property
+    def persistent_storage(self) -> str | None:
+        return os.environ.get("PATHWAY_PERSISTENT_STORAGE")
 
 
 def _install_flag_properties() -> None:

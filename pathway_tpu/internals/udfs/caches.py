@@ -59,8 +59,12 @@ class DiskCache(CacheStrategy):
 
     def _ensure(self):
         if self._conn is None:
-            root = _persistence_cache_root() or os.environ.get(
-                "PATHWAY_PERSISTENT_STORAGE", os.path.join(os.getcwd(), ".pw-cache")
+            from pathway_tpu.internals.config import pathway_config
+
+            root = (
+                _persistence_cache_root()
+                or pathway_config.persistent_storage
+                or os.path.join(os.getcwd(), ".pw-cache")
             )
             os.makedirs(root, exist_ok=True)
             path = os.path.join(root, f"udf-cache-{self.name or 'default'}.sqlite")

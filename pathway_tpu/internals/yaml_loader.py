@@ -8,11 +8,12 @@ environment variable interpolation.
 from __future__ import annotations
 
 import importlib
-import os
 import re
 from typing import Any, IO
 
 import yaml
+
+from pathway_tpu.internals.config import env_interpolate
 
 
 _ENV_RE = re.compile(r"\$\{?([A-Za-z_][A-Za-z_0-9]*)\}?")
@@ -31,8 +32,10 @@ def _resolve_entry(value: Any, registry: dict[str, Any]) -> Any:
         if value.startswith("$") and value[1:] in registry:
             return registry[value[1:]]
         m = _ENV_RE.fullmatch(value)
-        if m and m.group(1) in os.environ:
-            return os.environ[m.group(1)]
+        if m:
+            env_val = env_interpolate(m.group(1))
+            if env_val is not None:
+                return env_val
     return value
 
 

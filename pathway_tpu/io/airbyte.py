@@ -40,6 +40,7 @@ from typing import Any, Iterable, Sequence
 
 from pathway_tpu.engine.operators.core import InputNode
 from pathway_tpu.engine.value import hash_values
+from pathway_tpu.internals.config import environ_snapshot
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.json import Json
@@ -98,7 +99,7 @@ class ExecutableAirbyteSource:
             if state is not None:
                 command += add_argument("state", state)
         env = (
-            {**os.environ, **self.env_vars} if self.env_vars else None
+            environ_snapshot(**self.env_vars) if self.env_vars else None
         )  # augment, never replace: the connector still needs PATH etc.
         proc = subprocess.Popen(
             command, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,

@@ -60,7 +60,9 @@ def _compile(out_path: str) -> bool:
 
 def _load():
     global AVAILABLE, lib
-    if os.environ.get("PATHWAY_DISABLE_NATIVE"):
+    from pathway_tpu.internals.config import pathway_config
+
+    if pathway_config.disable_native:
         return
     # a pip-built extension (setup.py) is preferred when it is at least as
     # new as the source; a stale binary (source edited after `pip install

@@ -29,6 +29,8 @@ import threading
 import time
 from collections import deque
 
+from pathway_tpu.analysis.annotations import guarded_by
+from pathway_tpu.analysis.runtime import make_lock
 from pathway_tpu.internals.config import pathway_config
 
 
@@ -66,6 +68,11 @@ class QueryRequest:
         return max(0.0, self.finished_at - self.submitted_at)
 
 
+@guarded_by(
+    _queue="_cond", _stop="_cond", failed="_cond",
+    _ticks="_stats_lock", _dispatches="_stats_lock",
+    _requests="_stats_lock", _batch_hist="_stats_lock",
+)
 class QueryServer:
     """Coalesces concurrent retrieve / retrieve-rerank requests into
     batched fused dispatches (one per ``(kind, k)`` group per tick)."""
@@ -78,11 +85,11 @@ class QueryServer:
         self.tick_s = (cfg.query_tick_ms if tick_ms is None else tick_ms) / 1e3
         self.max_batch = max_batch or cfg.query_max_batch
         self.queue_bound = queue_bound or cfg.query_queue
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(make_lock("query_server.cond"))
         self._queue: deque[QueryRequest] = deque()
         self._stop = False
         self.failed: BaseException | None = None
-        self._stats_lock = threading.Lock()
+        self._stats_lock = make_lock("query_server.stats")
         self._ticks = 0
         self._dispatches = 0
         self._requests = 0
@@ -162,7 +169,9 @@ class QueryServer:
         while True:
             batch = self._drain_tick()
             if not batch:
-                if self._stop:
+                with self._cond:
+                    stopping = self._stop
+                if stopping:
                     return
                 continue
             try:
@@ -217,6 +226,8 @@ class QueryServer:
 
     # ------------------------------------------------------------- stats
     def stats(self) -> dict:
+        with self._cond:
+            failed = self.failed is not None
         with self._stats_lock:
             ticks = self._ticks
             reqs = self._requests
@@ -226,7 +237,7 @@ class QueryServer:
                 "dispatches": self._dispatches,
                 "batch_hist": dict(sorted(self._batch_hist.items())),
                 "mean_batch": round(reqs / ticks, 3) if ticks else 0.0,
-                "failed": self.failed is not None,
+                "failed": failed,
             }
 
     def shutdown(self, timeout: float = 10.0) -> None:

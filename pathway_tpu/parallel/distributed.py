@@ -15,9 +15,10 @@ reference's so the CLI feels the same:
 from __future__ import annotations
 
 import dataclasses
-import os
 
 import jax
+
+from pathway_tpu.internals.config import pathway_config
 
 
 @dataclasses.dataclass
@@ -28,13 +29,16 @@ class DistributedConfig:
 
     @classmethod
     def from_env(cls) -> "DistributedConfig":
-        n = int(os.environ.get("PATHWAY_PROCESSES", "1"))
-        pid = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
-        port = int(os.environ.get("PATHWAY_FIRST_PORT", "10000"))
-        addr = os.environ.get(
-            "PATHWAY_COORDINATOR", f"127.0.0.1:{port}" if n > 1 else None
+        n = pathway_config.processes
+        port = pathway_config.first_port
+        addr = pathway_config.coordinator or (
+            f"127.0.0.1:{port}" if n > 1 else None
         )
-        return cls(num_processes=n, process_id=pid, coordinator_address=addr)
+        return cls(
+            num_processes=n,
+            process_id=pathway_config.process_id,
+            coordinator_address=addr,
+        )
 
 
 _initialized = False

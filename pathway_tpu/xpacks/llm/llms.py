@@ -12,6 +12,7 @@ import logging
 from typing import Any
 
 import pathway_tpu as pw
+from pathway_tpu.analysis.annotations import guarded_by
 from pathway_tpu.internals import udfs
 from pathway_tpu.internals.json import Json
 
@@ -527,6 +528,7 @@ class _PendingCompletion:
         self.span = tracing.NULL_SPAN  # replaced by submit()
 
 
+@guarded_by(queue="lock", free="lock")
 class _ContinuousServer:
     """Slot-pool serving loop for ``TPUDecoderChat(continuous=True)``.
 
@@ -805,10 +807,12 @@ class _ContinuousServer:
         self._spec_fns: dict[int, Any] = {}
         self._key = jax.random.PRNGKey(seed)
         self._ticks = 0
+        from pathway_tpu.analysis.runtime import make_lock
+
         self.queue: deque = deque()
         self.slots: list = [None] * n_slots
         self.free = list(range(n_slots))
-        self.lock = threading.Lock()
+        self.lock = make_lock("decode_server.lock")
         self.wake = threading.Event()
         self._stop = False
         self.failed: BaseException | None = None

@@ -96,7 +96,7 @@ class _MutedWake:
         return self._ev.wait(timeout)
 
 
-def _serve_burst(tiny_params):
+def _serve_burst(tiny_params, **chat_kwargs):
     """All prompts submitted in one burst through the continuous server;
     returns their texts (flags are read from the environment at
     construction time)."""
@@ -106,6 +106,7 @@ def _serve_burst(tiny_params):
         params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(),
         max_new_tokens=NEW, temperature=0.0, max_prompt_tokens=32,
         continuous=True, n_slots=4, chunk_steps=8, pipeline_depth=2,
+        **chat_kwargs,
     )
     try:
         srv = chat._server
@@ -180,5 +181,30 @@ def test_chunk_autotune_kill_switch_equivalence(
     monkeypatch.setenv("PATHWAY_TPU_CHUNK_AUTOTUNE", "1")
     got_on = _serve_burst(tiny_params)
     monkeypatch.setenv("PATHWAY_TPU_CHUNK_AUTOTUNE", "0")
+    got_off = _serve_burst(tiny_params)
+    assert got_on == got_off == static_truth
+
+
+def test_chunked_prefill_kill_switch_equivalence(
+    tiny_params, static_truth, monkeypatch
+):
+    """Piece-wise prompt admission (prefill_chunk=8 so the burst's longer
+    prompts actually split) changes scheduling only: tokens identical
+    with PATHWAY_TPU_CHUNKED_PREFILL off."""
+    monkeypatch.setenv("PATHWAY_TPU_CHUNKED_PREFILL", "1")
+    got_on = _serve_burst(tiny_params, prefill_chunk=8)
+    monkeypatch.setenv("PATHWAY_TPU_CHUNKED_PREFILL", "0")
+    got_off = _serve_burst(tiny_params, prefill_chunk=8)
+    assert got_on == got_off == static_truth
+
+
+def test_eager_refill_kill_switch_equivalence(
+    tiny_params, static_truth, monkeypatch
+):
+    """Eagerly recycling finished lanes mid-chunk changes slot reuse
+    timing only: tokens identical with PATHWAY_TPU_EAGER_REFILL off."""
+    monkeypatch.setenv("PATHWAY_TPU_EAGER_REFILL", "1")
+    got_on = _serve_burst(tiny_params)
+    monkeypatch.setenv("PATHWAY_TPU_EAGER_REFILL", "0")
     got_off = _serve_burst(tiny_params)
     assert got_on == got_off == static_truth

@@ -53,6 +53,23 @@ def test_pipeline_matches_serial_bytes(monkeypatch):
         m.close()
 
 
+def test_fused_h2d_kill_switch_byte_equality(monkeypatch):
+    """PATHWAY_TPU_FUSED_H2D packs ids+mask into one transfer; it is
+    read per dispatch, so the same pipelined model must emit identical
+    bytes with the fused transfer on and off."""
+    monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "1")
+    m = _model()
+    try:
+        monkeypatch.setenv("PATHWAY_TPU_FUSED_H2D", "1")
+        fused = [m.embed_batch(t) for t in TEXTS]
+        monkeypatch.setenv("PATHWAY_TPU_FUSED_H2D", "0")
+        split = [m.embed_batch(t) for t in TEXTS]
+        for a, b in zip(fused, split):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        m.close()
+
+
 def test_interleaved_submit_resolve(monkeypatch):
     monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "1")
     m = _model()

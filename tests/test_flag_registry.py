@@ -71,3 +71,34 @@ def test_every_declared_doc_nonempty():
     for f in C.FLAG_REGISTRY:
         assert f.doc.strip(), f.env
         assert f.env.startswith(("PATHWAY_TPU_", "PATHWAY_")), f.env
+
+
+def test_kill_switch_declarations_well_formed():
+    """`kill_switch=True` requires a `pinned_by` test path under tests/;
+    `pinned_by` without `kill_switch` is a declaration typo. Whether the
+    named file still pins the env var is the analyzer's job (GL301)."""
+    for f in C.FLAG_REGISTRY:
+        if f.kill_switch:
+            assert f.pinned_by, f"{f.env}: kill_switch without pinned_by"
+            assert f.pinned_by.startswith("tests/"), f.env
+        else:
+            assert f.pinned_by is None, f"{f.env}: pinned_by without kill_switch"
+
+
+def test_lock_sanitizer_flag_default_off(monkeypatch):
+    monkeypatch.delenv("PATHWAY_TPU_LOCK_SANITIZER", raising=False)
+    assert C.pathway_config.lock_sanitizer is False
+    monkeypatch.setenv("PATHWAY_TPU_LOCK_SANITIZER", "1")
+    assert C.pathway_config.lock_sanitizer is True
+
+
+def test_env_choke_points(monkeypatch):
+    """`env_interpolate` / `environ_snapshot` are the ONLY sanctioned
+    raw-environment accessors outside config.py (analyzer rule GL202)."""
+    monkeypatch.setenv("PATHWAY_TPU_CHOKE_PROBE", "abc")
+    assert C.env_interpolate("PATHWAY_TPU_CHOKE_PROBE") == "abc"
+    assert C.env_interpolate("PATHWAY_TPU_CHOKE_ABSENT") is None
+    snap = C.environ_snapshot(**{"PATHWAY_TPU_CHOKE_PROBE": "xyz"})
+    assert snap["PATHWAY_TPU_CHOKE_PROBE"] == "xyz"
+    assert snap["PATHWAY_TPU_CHOKE_PROBE"] != os.environ["PATHWAY_TPU_CHOKE_PROBE"]
+    assert "PATH" in snap  # a real copy of the environment, plus overrides

@@ -291,3 +291,97 @@ def test_instrumentation_overhead_under_three_pct(monkeypatch):
         f"(on={[f'{v:.0f}' for v in ons]}, "
         f"off={[f'{v:.0f}' for v in offs]})"
     )
+
+
+@pytest.mark.slow
+def test_lock_sanitizer_compiled_out(monkeypatch):
+    """PATHWAY_TPU_LOCK_SANITIZER is read once per lock CONSTRUCTION, so
+    unlike the metrics guard the two arms need separate servers: OFF
+    builds plain stdlib locks (asserted by type — the wrapper is
+    compiled out, not merely quiet) and its throughput must be unchanged
+    (>= 0.97x the ON arm); the ON arm's wrapper bookkeeping must itself
+    fit the same 3% budget. Token streams are byte-identical either way,
+    and a full continuous-decode burst under the sanitizer produces zero
+    reports. Same two robust estimators + remeasure-once policy as
+    ``test_instrumentation_overhead_under_three_pct``."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.analysis import runtime as rt
+    from pathway_tpu.models import decoder as D
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+    from tests.utils import ToyCharTokenizer
+
+    cfg = D.DecoderConfig(
+        vocab_size=128, hidden=64, layers=4, heads=4, intermediate=128,
+        max_position=256, dtype=jnp.float32,
+    )
+    params = D.init_params(jax.random.PRNGKey(0), cfg)
+    head = "c" * 40 + "ontext: "
+    prompts = [head + f"q{k:02d}tail"[:8].ljust(8, "x") for k in range(16)]
+
+    rt.reset()
+
+    def run_arm(sanitizer_on: bool):
+        """One server construction: warm-up, then two timed bursts."""
+        monkeypatch.setenv(
+            "PATHWAY_TPU_LOCK_SANITIZER", "1" if sanitizer_on else "0"
+        )
+        chat = TPUDecoderChat(
+            params=params, cfg=cfg, tokenizer=ToyCharTokenizer(128),
+            max_new_tokens=32, temperature=0.0, max_prompt_tokens=64,
+            continuous=True, n_slots=4, chunk_steps=8, pipeline_depth=2,
+            prefill_chunk=8, prefix_cache=False,
+        )
+        try:
+            assert isinstance(
+                chat._server.lock, rt.SanitizedLock
+            ) is sanitizer_on
+            for r in chat.submit_batch([head + "warmAAxx"] * 2):
+                assert r.done.wait(timeout=120)
+            rates, toks = [], None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                reqs = chat.submit_batch(prompts)
+                for r in reqs:
+                    assert r.done.wait(timeout=120)
+                wall = max(r.finished_at for r in reqs) - t0
+                gen = sum(len(r.tokens) for r in reqs)
+                rates.append(gen / max(wall, 1e-9))
+                if toks is None:
+                    toks = [list(r.tokens) for r in reqs]
+            return rates, toks
+        finally:
+            chat.close()
+
+    def measure():
+        ons, offs = [], []
+        on_toks = off_toks = None
+        for i in range(4):  # alternate construction order per round
+            for s_on in ((True, False) if i % 2 else (False, True)):
+                rates, toks = run_arm(s_on)
+                if s_on:
+                    ons.extend(rates)
+                    on_toks = on_toks or toks
+                else:
+                    offs.extend(rates)
+                    off_toks = off_toks or toks
+        assert off_toks == on_toks, "sanitizer changed the token streams"
+        med = float(np.median(np.asarray(offs) / np.asarray(ons)))
+        return med, max(offs) / max(ons), ons, offs
+
+    med, edge, ons, offs = measure()
+    if max(med, edge) < 0.97 or max(1 / med, max(ons) / max(offs)) < 0.97:
+        med, edge, ons, offs = measure()
+    assert rt.reports() == [], rt.reports()
+    detail = (
+        f"median paired off/on ratio {med:.4f}, peak ratio {edge:.4f} "
+        f"(on={[f'{v:.0f}' for v in ons]}, off={[f'{v:.0f}' for v in offs]})"
+    )
+    assert max(med, edge) >= 0.97, (
+        "sanitizer-off arm slower than sanitizer-on — the off-path is "
+        "not compiled out: " + detail
+    )
+    assert max(1 / med, max(ons) / max(offs)) >= 0.97, (
+        "lock-sanitizer wrapper overhead above 3%: " + detail
+    )
