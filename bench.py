@@ -1858,6 +1858,8 @@ def config_decoder_generate() -> dict:
     except Exception as exc:  # noqa: BLE001 - diagnostic metric only
         serving = {"error": repr(exc)}
 
+    from pathway_tpu.engine import probes as probes_mod
+
     diag(
         phase="decoder_generate",
         tokens_per_sec=round(tps, 1),
@@ -1880,6 +1882,9 @@ def config_decoder_generate() -> dict:
             "decode_hbm_util_pct": round(hbm_util * 100, 1),
             "early_exit": early,
             "serving": serving,
+            # HBM ledger of THIS process (the decoder phase may run in a
+            # subprocess; the parent summary reads the ledger from here)
+            "hbm": probes_mod.hbm_stats(),
         },
     }
 
@@ -2677,6 +2682,21 @@ def main() -> None:
     shiv = _m("sharded_ivf_build_rows")
     ceiling = headline_detail.get("ceiling") or {}
     wc = _m("wordcount_streaming_rows_per_sec")
+    # pipeline-depth observability: per-operator latency from THIS
+    # process's registry (the streaming phases ran here), the HBM ledger
+    # from the decoder phase's process (it may have run in a subprocess
+    # — its detail carries the ledger out) and the SLO watchdog state
+    from pathway_tpu.engine import probes as probes_mod
+    from pathway_tpu.engine import slo as slo_mod
+
+    engine_telemetry = probes_mod.engine_snapshot()
+    dec_hbm = (dec.get("detail") or {}).get("hbm") or {}
+    local_hbm = probes_mod.hbm_stats()
+    hbm_high_water = max(
+        int(dec_hbm.get("high_water_total_bytes") or 0),
+        int(local_hbm.get("high_water_total_bytes") or 0),
+    )
+    slo_state = slo_mod.slo_snapshot()
     summary = {
         "metric": "rag_ingest_embed_index_docs_per_sec",
         "value": round(docs_per_sec, 1),
@@ -2778,6 +2798,24 @@ def main() -> None:
                 )
                 if k in (shiv.get("detail") or {})
             },
+            "engine": {
+                "op_latency_p50_ms": engine_telemetry.get(
+                    "op_latency_p50_ms"
+                ),
+                "operators": len(engine_telemetry.get("operators") or {}),
+                "backlog": engine_telemetry.get("backlog"),
+                "exchange": engine_telemetry.get("exchange"),
+            },
+            "hbm_high_water_bytes": hbm_high_water,
+            "hbm_components": (
+                dec_hbm.get("high_water_bytes")
+                or local_hbm.get("high_water_bytes")
+            ),
+            "slo": {
+                "breaches": slo_state.get("breaches", 0),
+                "alerting": slo_state.get("alerting", []),
+                "enabled": slo_state.get("enabled", False),
+            },
         },
     }
     print(json.dumps(summary), flush=True)
@@ -2822,6 +2860,17 @@ def main() -> None:
             "shards", "rows_total", "build_s", "recall_at_10", "elapsed_s",
         ):
             _chk(f"summary.sharded_ivf.{k}", sh.get(k))
+        # observability keys: operator telemetry and the HBM ledger must
+        # have actually sampled during the run, not merely exist
+        eng = s.get("engine") or {}
+        p50 = eng.get("op_latency_p50_ms")
+        if not (isinstance(p50, (int, float)) and p50 > 0):
+            missing.append("summary.engine.op_latency_p50_ms>0")
+        hbm_hw = s.get("hbm_high_water_bytes")
+        if not (isinstance(hbm_hw, int) and hbm_hw > 0):
+            missing.append("summary.hbm_high_water_bytes>0")
+        if "breaches" not in (s.get("slo") or {}):
+            missing.append("summary.slo.breaches")
         if missing:
             raise SystemExit(
                 "smoke schema check FAILED; missing/empty: "
@@ -2829,10 +2878,95 @@ def main() -> None:
             )
         diag(phase="smoke_ok", summary_keys=len(s))
 
+    sentinel_path = os.environ.get("PATHWAY_BENCH_SENTINEL", "")
+    if sentinel_path:
+        with open(sentinel_path) as fh:
+            baseline = json.load(fh)
+        breaches = sentinel_check(summary, baseline, _smoke())
+        if breaches:
+            diag(phase="sentinel", status="BREACH", breaches=breaches)
+            raise SystemExit(
+                f"bench sentinel BREACH vs {sentinel_path}: "
+                + "; ".join(breaches)
+            )
+        diag(
+            phase="sentinel", status="ok", baseline=sentinel_path,
+            keys=len((baseline.get("parsed") or baseline).get("summary") or {}),
+        )
+
+
+# --------------------------------------------------------------------- #
+# regression sentinel: diff a fresh summary against a checked-in
+# BENCH_*.json baseline and exit nonzero on breach (--sentinel <path>)
+
+# scale-invariant quality metrics: floored against the baseline with an
+# absolute tolerance, stable across machine generations
+_SENTINEL_QUALITY_TOL = {
+    "knn_recall_at_10": 0.05,
+    "ivf_recall_at_10": 0.05,
+}
+# throughput-style metrics breach only on a halving — wall-clock noise
+# and hardware drift make tighter full-run bars flaky
+_SENTINEL_THROUGHPUT_FLOOR = 0.5
+
+
+def sentinel_check(summary: dict, baseline: dict, smoke: bool) -> list:
+    """Compare a freshly produced ``summary`` against a checked-in
+    ``BENCH_*.json`` baseline; returns breach strings (empty = clean).
+    Smoke runs check schema and sanity only — smoke shapes are tiny, so
+    magnitudes are meaningless against a full-run baseline — while full
+    runs add numeric floors on quality and throughput metrics."""
+    breaches: list = []
+    base = (baseline.get("parsed") or baseline).get("summary") or {}
+    new = summary.get("summary") or {}
+    for key, bval in sorted(base.items()):
+        if bval is None:
+            continue
+        nval = new.get(key)
+        if nval is None or (isinstance(nval, (dict, list, str)) and not nval):
+            breaches.append(f"summary.{key}: missing (baseline={bval!r})")
+            continue
+        if (
+            smoke
+            or isinstance(bval, bool)
+            or not isinstance(bval, (int, float))
+            or not isinstance(nval, (int, float))
+        ):
+            continue
+        if key in _SENTINEL_QUALITY_TOL:
+            tol = _SENTINEL_QUALITY_TOL[key]
+            if nval < bval - tol:
+                breaches.append(
+                    f"summary.{key}: {nval} < baseline {bval} - {tol}"
+                )
+        elif bval > 0 and nval < _SENTINEL_THROUGHPUT_FLOOR * bval:
+            breaches.append(
+                f"summary.{key}: {nval} < {_SENTINEL_THROUGHPUT_FLOOR}x "
+                f"baseline {bval}"
+            )
+    # sanity floors that hold at any scale, smoke included
+    for key in _SENTINEL_QUALITY_TOL:
+        nval = new.get(key)
+        if isinstance(nval, (int, float)) and not 0.0 <= nval <= 1.0:
+            breaches.append(f"summary.{key}: {nval} outside [0, 1]")
+    # observability keys are gated even against pre-observability baselines
+    eng = new.get("engine") or {}
+    if not isinstance(eng.get("op_latency_p50_ms"), (int, float)):
+        breaches.append("summary.engine.op_latency_p50_ms: missing")
+    if not isinstance(new.get("hbm_high_water_bytes"), int):
+        breaches.append("summary.hbm_high_water_bytes: missing")
+    if "breaches" not in (new.get("slo") or {}):
+        breaches.append("summary.slo.breaches: missing")
+    return breaches
+
 
 if __name__ == "__main__":
     if "--smoke" in sys.argv:
         os.environ["PATHWAY_BENCH_SMOKE"] = "1"
+    if "--sentinel" in sys.argv:
+        os.environ["PATHWAY_BENCH_SENTINEL"] = sys.argv[
+            sys.argv.index("--sentinel") + 1
+        ]
     if "--phase" in sys.argv:
         run_single_phase(sys.argv[sys.argv.index("--phase") + 1])
     else:

@@ -276,6 +276,78 @@ def stats(url, as_json):
         click.echo("no metrics recorded yet")
 
 
+@cli.command()
+@click.option("--url", type=str, default=None, metavar="URL",
+              help="base URL of a running server (fetches URL/v1/statistics);"
+                   " omit to watch this process's in-memory registry")
+@click.option("--interval", type=float, default=2.0, show_default=True,
+              help="seconds between evaluations")
+@click.option("--iterations", type=int, default=0,
+              help="stop after N evaluations (0 = run until interrupted)")
+@click.option("--fail-on-alert", is_flag=True,
+              help="exit nonzero if any SLO alert is firing at the end")
+def watch(url, interval, iterations, fail_on_alert):
+    """Live SLO watchdog view: evaluates the configured
+    ``PATHWAY_TPU_SLO_*`` objectives (or reads a remote server's
+    ``/v1/statistics`` slo section) every ``--interval`` seconds and
+    prints per-objective burn rates and alert state."""
+    import json
+    import time as time_mod
+
+    def one_pass() -> dict:
+        if url is not None:
+            import urllib.request
+
+            endpoint = url.rstrip("/") + "/v1/statistics"
+            with urllib.request.urlopen(endpoint, timeout=10.0) as resp:  # noqa: S310
+                return json.loads(resp.read().decode()).get("slo") or {}
+        from pathway_tpu.engine import slo as slo_mod
+
+        wd = slo_mod.get_watchdog()
+        return wd.tick() if wd.objectives else wd.state()
+
+    n = 0
+    state: dict = {}
+    try:
+        while True:
+            state = one_pass()
+            n += 1
+            objectives = state.get("objectives") or {}
+            if not objectives:
+                click.echo(
+                    "no SLO objectives configured "
+                    "(set PATHWAY_TPU_SLO_* thresholds)"
+                )
+            else:
+                stamp = time_mod.strftime("%H:%M:%S")
+                alerting = state.get("alerting") or []
+                click.echo(
+                    f"[{stamp}] slo: "
+                    + ("ALERT " + ",".join(alerting) if alerting else "ok")
+                )
+                for name, o in sorted(objectives.items()):
+                    value = o.get("value")
+                    vtxt = (
+                        f"{value:.3f}{o.get('unit', '')}"
+                        if isinstance(value, (int, float)) else "-"
+                    )
+                    mark = "!" if o.get("alert") else " "
+                    click.echo(
+                        f" {mark} {name:<16} value={vtxt:<12} "
+                        f"target {o['kind']} {o['threshold']} "
+                        f"burn fast={o['burn_fast']:.2f} "
+                        f"slow={o['burn_slow']:.2f} "
+                        f"breaches={o['breaches']}"
+                    )
+            if iterations and n >= iterations:
+                break
+            time_mod.sleep(max(interval, 0.05))
+    except KeyboardInterrupt:
+        pass
+    if fail_on_alert and state.get("alerting"):
+        raise SystemExit(1)
+
+
 @cli.group()
 def airbyte() -> None:
     """Airbyte connector scaffolding (reference ``cli.py:airbyte``)."""

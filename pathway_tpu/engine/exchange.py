@@ -400,6 +400,11 @@ class ExchangeNode(Node):
             received = self.ctx.exchange(
                 self.ex_id, time, {}, broadcast=local
             )
+            self._record_rows(
+                broadcast=(len(local) * len(self.ctx.mesh.peers)
+                           if local is not None else 0),
+                received=sum(len(b) for b in received),
+            )
             parts = [b for b in [local, *received]
                      if b is not None and len(b)]
             return concat_batches(parts) if parts else None
@@ -417,10 +422,25 @@ class ExchangeNode(Node):
                     if m.any():
                         outbound[p] = batch.take(m)
         received = self.ctx.exchange(self.ex_id, time, outbound)
+        self._record_rows(
+            local=len(local) if local is not None else 0,
+            sent=sum(len(b) for b in outbound.values()),
+            received=sum(len(b) for b in received),
+        )
         parts = [b for b in [local, *received] if b is not None and len(b)]
         if not parts:
             return None
         return concat_batches(parts)
+
+    def _record_rows(self, **rows: int) -> None:
+        """Exchange row counters, gated on the owning scheduler's cached
+        operator-telemetry switch (see ``Scheduler.op_metrics``)."""
+        sched = getattr(self, "scheduler", None)
+        if sched is None or not getattr(sched, "op_metrics", False):
+            return
+        from pathway_tpu.engine import probes
+
+        probes.record_exchange(**rows)
 
 
 def splice_exchanges(graph, order: list[Node],

@@ -318,6 +318,17 @@ class RowwiseNode(Node):
 
         sub = {name: col[sel] for name, col in out_cols.items()}
         sched.inject(self, next_commit_time(), Batch(keys[sel], sub, diffs[sel]))
+        # deferred emissions bypass the scheduler's step accounting (the
+        # originating step returned None) — count the injected rows as
+        # this operator's output so `op_rows{direction=out}` stays honest
+        if getattr(sched, "op_metrics", False):
+            from pathway_tpu.engine import probes
+
+            probes.REGISTRY.counter_add(
+                "op_rows", int(len(sel)),
+                operator=self.name, direction="out",
+            )
+            probes.record_backlog("pending_epochs", sched.pending_backlog())
 
     def _step_consistent(self, batch):
         from pathway_tpu.engine.value import hash_values

@@ -16,9 +16,42 @@ import numpy as np
 
 from pathway_tpu.engine.batch import Batch
 from pathway_tpu.engine.graph import Node
+from pathway_tpu.engine import probes
 from pathway_tpu.engine.state import rows_equal
 from pathway_tpu.engine.value import ERROR, Pointer, hash_values
 from pathway_tpu.internals.errors import get_global_error_log
+
+
+def _numeric(v) -> float | None:
+    """Best-effort float view of a time-column value (numbers and
+    datetime-likes); None for anything else — watermark lag is telemetry,
+    not semantics, so non-numeric time columns just skip the gauge."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        ts = getattr(v, "timestamp", None)
+        if callable(ts):
+            try:
+                return float(ts())
+            except Exception:  # noqa: BLE001 - telemetry must not raise
+                return None
+        return None
+
+
+def _record_temporal(node: Node, held_rows: int, min_threshold) -> None:
+    """Held-backlog + watermark-lag gauges for a stateful temporal node.
+    Gated on the owning scheduler's cached op-metrics switch, so the
+    per-step cost with telemetry off is one attribute read."""
+    sched = getattr(node, "scheduler", None)
+    if sched is None or not getattr(sched, "op_metrics", False):
+        return
+    lag = None
+    if min_threshold is not None and node._watermark is not None:
+        wm = _numeric(node._watermark)
+        thr = _numeric(min_threshold)
+        if wm is not None and thr is not None:
+            lag = thr - wm
+    probes.record_watermark(node.name, held_rows, lag)
 
 
 class BufferNode(Node):
@@ -74,6 +107,17 @@ class BufferNode(Node):
                 else:
                     del self._held[key]
             out_rows.extend(released)
+        held = sum(len(entries) for entries in self._held.values())
+        min_thr = None
+        if held:
+            thrs = [
+                row[hi]
+                for entries in self._held.values()
+                for row, _diff in entries
+                if row[hi] is not ERROR
+            ]
+            min_thr = min(thrs) if thrs else None
+        _record_temporal(self, held, min_thr)
         if not out_rows:
             return None
         return Batch.from_rows(names, out_rows)
@@ -157,6 +201,17 @@ class ForgetNode(Node):
                     self._alive[key] = keep
                 else:
                     del self._alive[key]
+        alive = sum(len(rows_) for rows_ in self._alive.values())
+        min_thr = None
+        if alive:
+            thrs = [
+                row[hi]
+                for rows_ in self._alive.values()
+                for row in rows_
+                if row[hi] is not ERROR
+            ]
+            min_thr = min(thrs) if thrs else None
+        _record_temporal(self, alive, min_thr)
         if not out_rows:
             return None
         return Batch.from_rows(names, out_rows)

@@ -95,6 +95,42 @@ METRIC_FAMILIES: dict[str, tuple[str, str | None, str]] = {
         "histogram", "phase", "Time from request enqueue to admission"),
     "e2e_seconds": (
         "histogram", "phase", "Time from request enqueue to completion"),
+    "op_step_seconds": (
+        "histogram", "operator", "Per-operator epoch-processing latency "
+        "(one observation per stepped operator per epoch)"),
+    "op_rows": (
+        "counter", "operator", "Rows entering (direction=in) and leaving "
+        "(direction=out) each operator"),
+    "op_held_rows": (
+        "gauge", "operator", "Rows currently held back by a stateful "
+        "temporal operator (buffer backlog / forget liveness set)"),
+    "watermark_lag": (
+        "gauge", "operator", "Distance (time-column units) between a "
+        "temporal operator's watermark and its oldest held threshold"),
+    "engine_backlog": (
+        "gauge", "queue", "Dataflow backlog depth (pending injected "
+        "epochs, async in-flight batches)"),
+    "engine_frontier_lag": (
+        "gauge", None, "Epochs the source frontier is ahead of the "
+        "scheduler's last processed time"),
+    "exchange_rows": (
+        "counter", "direction", "Rows routed by the exchange layer "
+        "(local / sent / received / broadcast)"),
+    "hbm_bytes": (
+        "gauge", "component", "Current device-memory ledger bytes per "
+        "component (slot_pool / prefix_arena / kv_scales / ...)"),
+    "hbm_high_water_bytes": (
+        "gauge", "component", "High-water device-memory ledger bytes per "
+        "component, plus the 'total' series across all components"),
+    "slo_burn_rate": (
+        "gauge", "objective", "SLO error-budget burn rate per objective "
+        "and window (fast / slow)"),
+    "slo_alert": (
+        "gauge", "objective", "1 while an SLO objective's multi-window "
+        "burn-rate alert is firing, else 0"),
+    "slo_breaches": (
+        "counter", "objective", "SLO alert activations (ok -> firing "
+        "transitions) per objective"),
 }
 
 LATENCY_HISTOGRAMS = (
@@ -188,6 +224,47 @@ class MetricsRegistry:
             rec[0][bisect.bisect_left(self.hist_bounds, v)] += 1
             rec[1] += v
             rec[2] += 1
+
+    def gauge_max(self, name: str, value: float, **labels) -> None:
+        """Set the gauge to ``max(current, value)`` — the high-water
+        primitive the HBM ledger rides. Atomic under the registry lock."""
+        if not self.enabled:
+            return
+        key = self._key(labels)
+        v = float(value)
+        with self._lock:
+            series = self._gauges.setdefault(name, {})
+            cur = series.get(key)
+            if cur is None or v > cur:
+                series[key] = v
+
+    def observe_op_step(
+        self, operator: str, seconds: float, rows_in: int, rows_out: int
+    ) -> None:
+        """One stepped operator epoch: latency histogram observation plus
+        rows-in/rows-out counters under a SINGLE enabled check + lock
+        acquisition — this sits on the scheduler's per-step hot path."""
+        if not self.enabled:
+            return
+        v = float(seconds)
+        hkey = (("operator", operator),)
+        with self._lock:
+            series = self._hists.setdefault("op_step_seconds", {})
+            rec = series.get(hkey)
+            if rec is None:
+                rec = series[hkey] = [
+                    [0] * (len(self.hist_bounds) + 1), 0.0, 0,
+                ]
+            rec[0][bisect.bisect_left(self.hist_bounds, v)] += 1
+            rec[1] += v
+            rec[2] += 1
+            rows = self._counters.setdefault("op_rows", {})
+            if rows_in:
+                key = (("direction", "in"), ("operator", operator))
+                rows[key] = rows.get(key, 0.0) + rows_in
+            if rows_out:
+                key = (("direction", "out"), ("operator", operator))
+                rows[key] = rows.get(key, 0.0) + rows_out
 
     # ------------------------------------------------------------- read
     def labelled(self, name: str, label: str,
@@ -360,19 +437,217 @@ def serving_snapshot() -> dict:
 
 
 def unified_snapshot(scheduler_stats=None) -> dict:
-    """Scheduler + serving + raw-registry in one dict: the payload of
-    ``/v1/statistics`` and the source of the monitoring dashboard."""
+    """Scheduler + serving + engine + device-memory + SLO + raw-registry
+    in one dict: the payload of ``/v1/statistics`` and the source of the
+    monitoring dashboard."""
     sched = None
     if scheduler_stats is not None:
         sched = (
             scheduler_stats.snapshot()
             if hasattr(scheduler_stats, "snapshot") else scheduler_stats
         )
+    from pathway_tpu.engine import slo as slo_mod
+
     return {
         "scheduler": sched,
         "serving": serving_snapshot(),
+        "engine": engine_snapshot(),
+        "hbm": hbm_stats(),
+        "slo": slo_mod.slo_snapshot(),
         "registry": REGISTRY.snapshot(),
     }
+
+
+# --------------------------------------------------------------------- #
+# per-operator dataflow telemetry (registry-backed)
+#
+# The scheduler already times every operator step for SchedulerStats;
+# since the observability PR the same measurement also lands in the
+# registry — `op_step_seconds{operator=}` histograms and
+# `op_rows{operator=,direction=}` counters — so latency DISTRIBUTIONS
+# (not just totals) are scrapeable per operator. Temporal operators add
+# `op_held_rows` / `watermark_lag` gauges, the scheduler an
+# `engine_backlog{queue=}` gauge riding `pending_backlog()`, and the
+# exchange layer `exchange_rows{direction=}` counters. All of it is
+# gated twice: PATHWAY_TPU_METRICS (master, per call inside the
+# registry) and PATHWAY_TPU_OP_METRICS (operator-telemetry kill switch,
+# read once per scheduler construction so the hot path never touches
+# the environment).
+
+def record_op_step(
+    operator: str, seconds: float, rows_in: int, rows_out: int
+) -> None:
+    """Per-operator epoch record: latency observation + row counters in
+    one registry transaction. Called by ``Scheduler._step_node``."""
+    REGISTRY.observe_op_step(operator, seconds, rows_in, rows_out)
+
+
+def record_backlog(queue: str, depth: int) -> None:
+    """Backlog depth gauge (``queue`` = pending_epochs / async_inflight /
+    drain_group). Throttled by callers — gauges only need freshness, not
+    every transition."""
+    REGISTRY.gauge_set("engine_backlog", depth, queue=queue)
+
+
+def record_frontier_lag(lag: float) -> None:
+    REGISTRY.gauge_set("engine_frontier_lag", max(0.0, float(lag)))
+
+
+def record_watermark(operator: str, held_rows: int,
+                     lag: float | None) -> None:
+    """Temporal-operator state: rows currently held back and, when the
+    time column is numeric, how far the oldest held threshold trails the
+    watermark."""
+    REGISTRY.gauge_set("op_held_rows", held_rows, operator=operator)
+    if lag is not None:
+        REGISTRY.gauge_set(
+            "watermark_lag", max(0.0, float(lag)), operator=operator
+        )
+
+
+def record_exchange(**rows: int) -> None:
+    """Exchange-layer row accounting by direction (``local`` / ``sent`` /
+    ``received`` / ``broadcast``): one lock acquisition per step."""
+    REGISTRY.counter_add_many(
+        "exchange_rows", "direction", {k: v for k, v in rows.items() if v}
+    )
+
+
+def engine_snapshot() -> dict:
+    """Per-operator registry view: latency quantiles + row counters per
+    operator, backlog gauges, watermark lag, exchange counters. The
+    'engine' section of :func:`unified_snapshot` and the source of the
+    per-operator dashboard panel."""
+    snap = REGISTRY.snapshot()
+    ops: dict[str, dict] = {}
+    for series in snap["histograms"].get("op_step_seconds", {}).get(
+        "series", []
+    ):
+        name = series["labels"].get("operator", "")
+        s = REGISTRY.hist_summary("op_step_seconds", operator=name)
+        if s is None:
+            continue
+        ops[name] = {
+            "steps": s["count"],
+            "p50_ms": round(s["p50"] * 1e3, 3),
+            "p95_ms": round(s["p95"] * 1e3, 3),
+            "mean_ms": round(s["mean"] * 1e3, 3),
+            "rows_in": 0,
+            "rows_out": 0,
+        }
+    for series in snap["counters"].get("op_rows", {}).get("series", []):
+        labels = series["labels"]
+        op = ops.setdefault(labels.get("operator", ""), {
+            "steps": 0, "p50_ms": 0.0, "p95_ms": 0.0, "mean_ms": 0.0,
+            "rows_in": 0, "rows_out": 0,
+        })
+        key = "rows_in" if labels.get("direction") == "in" else "rows_out"
+        op[key] = int(series["value"])
+    backlog = {
+        k: int(v)
+        for k, v in REGISTRY.labelled(
+            "engine_backlog", "queue", kind="gauge"
+        ).items()
+    }
+    held = {
+        k: int(v)
+        for k, v in REGISTRY.labelled(
+            "op_held_rows", "operator", kind="gauge"
+        ).items()
+    }
+    lag = REGISTRY.labelled("watermark_lag", "operator", kind="gauge")
+    frontier = REGISTRY.gauge_value("engine_frontier_lag")
+    out: dict = {
+        "operators": {k: ops[k] for k in sorted(ops)},
+        "backlog": backlog,
+        "held_rows": held,
+        "watermark_lag": {k: round(v, 6) for k, v in sorted(lag.items())},
+        "exchange": {
+            k: int(v)
+            for k, v in REGISTRY.labelled(
+                "exchange_rows", "direction"
+            ).items()
+        },
+    }
+    if frontier is not None:
+        out["frontier_lag"] = frontier
+    summaries = [o["p50_ms"] for o in ops.values() if o.get("steps")]
+    out["op_latency_p50_ms"] = (
+        round(sum(summaries) / len(summaries), 3) if summaries else 0.0
+    )
+    return out
+
+
+def reset_engine_stats() -> None:
+    REGISTRY.remove(
+        "op_step_seconds", "op_rows", "op_held_rows", "watermark_lag",
+        "engine_backlog", "engine_frontier_lag", "exchange_rows",
+    )
+
+
+# --------------------------------------------------------------------- #
+# HBM ledger
+#
+# models/decoder.py `pool_bytes` knows how big ONE pool is the moment it
+# is built; the ledger keeps that knowledge live and cumulative:
+# per-component current bytes (`hbm_bytes{component=}`), per-component
+# high-water, and a `total` high-water across all components — the
+# number a capacity planner actually wants. Components re-record freely
+# (pool rebuilds overwrite current, high-water is monotone). State lives
+# in a module dict so the total high-water is computed atomically even
+# though the registry only sees per-series writes.
+
+_hbm_lock = make_lock("probes.hbm")
+_hbm_current: dict[str, int] = {}
+_hbm_high_water: dict[str, int] = {}
+
+_GUARDED_BY = {
+    "_hbm_current": "_hbm_lock",
+    "_hbm_high_water": "_hbm_lock",
+}
+
+
+def record_hbm(component: str, nbytes: int) -> None:
+    """Record ``component``'s current device-memory footprint (bytes).
+    Updates the current gauge, the per-component high-water and the
+    cross-component ``total`` high-water. Called at pool/arena build
+    time — never on the per-token path."""
+    if not REGISTRY.enabled:
+        return
+    n = int(nbytes)
+    with _hbm_lock:
+        _hbm_current[component] = n
+        if n > _hbm_high_water.get(component, -1):
+            _hbm_high_water[component] = n
+        total = sum(_hbm_current.values())
+        if total > _hbm_high_water.get("total", -1):
+            _hbm_high_water["total"] = total
+        high = dict(_hbm_high_water)
+    REGISTRY.gauge_set("hbm_bytes", n, component=component)
+    for comp, hw in high.items():
+        REGISTRY.gauge_max("hbm_high_water_bytes", hw, component=comp)
+
+
+def hbm_stats() -> dict:
+    """Snapshot: current bytes per component, per-component high-water,
+    and the total high-water across components."""
+    with _hbm_lock:
+        current = dict(_hbm_current)
+        high = dict(_hbm_high_water)
+    total_high = high.pop("total", sum(current.values()))
+    return {
+        "current_bytes": {k: current[k] for k in sorted(current)},
+        "high_water_bytes": {k: high[k] for k in sorted(high)},
+        "current_total_bytes": sum(current.values()),
+        "high_water_total_bytes": total_high,
+    }
+
+
+def reset_hbm_stats() -> None:
+    with _hbm_lock:
+        _hbm_current.clear()
+        _hbm_high_water.clear()
+    REGISTRY.remove("hbm_bytes", "hbm_high_water_bytes")
 
 
 # --------------------------------------------------------------------- #

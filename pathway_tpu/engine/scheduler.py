@@ -17,6 +17,7 @@ from typing import Any, Callable
 
 from pathway_tpu.engine.batch import Batch, concat_batches, consolidate
 from pathway_tpu.engine.graph import EngineGraph, Node, fuse_chains
+from pathway_tpu.engine import probes
 from pathway_tpu.engine.probes import SchedulerStats, _current_op
 
 
@@ -109,6 +110,13 @@ class Scheduler:
         self._async_inflight = 0
         self._stopped = False
         self.current_time: int = -1
+        # operator-telemetry kill switch, read ONCE here so the per-step
+        # hot path never touches the environment (PATHWAY_TPU_METRICS,
+        # the master switch, is still checked per call inside the
+        # registry). Temporal/exchange operators read the cached value
+        # through ``self.scheduler.op_metrics``.
+        self.op_metrics: bool = bool(config_mod.pathway_config.op_metrics)
+        self._backlog_counter = 0
         self.stats = SchedulerStats()
         self.stats.fused_chains = len(self.fused_chains)
         self.stats.fused_nodes = sum(len(c) for c in self.fused_chains)
@@ -326,18 +334,33 @@ class Scheduler:
             len(b) for b in (extra or [])
         )
         if rows_in or result is not None:
-            self.stats.record_step(
-                node.id,
-                node.name,
-                rows_in,
-                len(result) if result is not None else 0,
-                time.perf_counter() - started,
-            )
+            rows_out = len(result) if result is not None else 0
+            dt = time.perf_counter() - started
+            self.stats.record_step(node.id, node.name, rows_in, rows_out, dt)
+            if self.op_metrics:
+                probes.record_op_step(node.name, dt, rows_in, rows_out)
+
+    def _record_backlog(self, t: int) -> None:
+        """Backlog/frontier gauges, throttled to every 8th epoch (gauges
+        need freshness, not every transition — same cadence the serving
+        occupancy gauge uses)."""
+        with self._lock:
+            pending = len(self._pending)
+            inflight = self._async_inflight
+            frontier = min(self._source_frontiers.values(), default=None)
+        probes.record_backlog("pending_epochs", pending)
+        probes.record_backlog("async_inflight", inflight)
+        if frontier is not None:
+            probes.record_frontier_lag(frontier - t - 1)
 
     def _run_epoch(self, t: int, injected: dict[int, list[Batch]]) -> None:
         self.current_time = t
         self.stats.current_time = t
         self.stats.epochs_total += 1
+        if self.op_metrics:
+            self._backlog_counter += 1
+            if self._backlog_counter % 8 == 1:
+                self._record_backlog(t)
         outputs: dict[int, Batch | None] = {}
         if self._pool is not None and self._levels is not None:
             for level in self._levels:

@@ -1,0 +1,305 @@
+"""SLO burn-rate watchdog — declarative objectives over the metrics
+registry, evaluated on rolling windows with multi-window alerting.
+
+ROADMAP #2 (SLO-aware multi-tenant scheduling) needs a machine-readable
+"are we meeting our latency promises RIGHT NOW" signal; this module
+turns the registry's raw histograms and gauges into one. An
+:class:`Objective` declares a promise (``ttft_p95_ms <= 500``,
+``occupancy >= 0.4``); the :class:`SloWatchdog` samples each objective
+on every :meth:`~SloWatchdog.tick`, classifies the sample as inside or
+outside the promise, and keeps the per-objective sample history needed
+to compute ERROR-BUDGET BURN RATES over two windows:
+
+* **fast** (default 60 s) — catches a cliff quickly,
+* **slow** (default 600 s) — confirms it is sustained, not a blip.
+
+``burn = (violating fraction in window) / budget`` where ``budget`` is
+the tolerated violating fraction (default 0.1). The alert for an
+objective FIRES when both windows burn at or above the threshold
+(default 1.0 — spending budget faster than allowed) and CLEARS when the
+fast window recovers — the standard multi-window, multi-burn-rate
+pattern, sized down to this engine's time scales. Transitions export to
+the registry (``slo_alert`` / ``slo_breaches`` / ``slo_burn_rate``) so
+``/metrics`` and ``/v1/statistics`` carry alert state, and
+``python -m pathway_tpu.cli watch`` renders it live.
+
+Objectives come from ``PATHWAY_TPU_SLO_*`` flags (a threshold of 0
+disables an objective; all default 0, so the watchdog is opt-in). The
+clock is injectable so the burn-rate state machine is testable on a
+synthetic trace with no sleeping.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Callable
+
+from pathway_tpu.analysis.annotations import guarded_by
+from pathway_tpu.analysis.runtime import make_lock
+from pathway_tpu.engine import probes
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """One declarative service-level objective.
+
+    ``kind`` is ``ceiling`` (healthy while ``value <= threshold``, e.g.
+    latency) or ``floor`` (healthy while ``value >= threshold``, e.g.
+    occupancy). ``sample`` returns the current value, or None when the
+    signal has no data yet — unsampled ticks don't consume budget."""
+
+    name: str
+    kind: str  # "ceiling" | "floor"
+    threshold: float
+    sample: Callable[[], float | None] | None = None
+    unit: str = ""
+
+    def violated(self, value: float) -> bool:
+        if self.kind == "floor":
+            return value < self.threshold
+        return value > self.threshold
+
+
+# ---- built-in signal samplers ---------------------------------------- #
+
+def _ttft_p95_ms() -> float | None:
+    s = probes.REGISTRY.hist_summary("ttft_seconds")
+    return None if s is None else s["p95"] * 1e3
+
+
+def _e2e_p95_ms() -> float | None:
+    s = probes.REGISTRY.hist_summary("e2e_seconds")
+    return None if s is None else s["p95"] * 1e3
+
+
+def _occupancy() -> float | None:
+    per_server = probes.REGISTRY.labelled(
+        "serving_occupancy", "server", kind="gauge"
+    )
+    if not per_server:
+        return None
+    return sum(per_server.values()) / len(per_server)
+
+
+def _prefix_hit_rate() -> float | None:
+    stats = probes.prefix_stats()
+    if not stats["counts"].get("requests"):
+        return None
+    return stats["hit_rate"]
+
+
+@guarded_by(_samples="_lock", _values="_lock", _alerts="_lock",
+            _breaches="_lock", _last_tick="_lock")
+class SloWatchdog:
+    """Rolling-window burn-rate evaluator over a set of objectives."""
+
+    def __init__(
+        self,
+        objectives: list[Objective],
+        *,
+        fast_window_s: float = 60.0,
+        slow_window_s: float = 600.0,
+        burn_threshold: float = 1.0,
+        budget: float = 0.1,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.objectives = {o.name: o for o in objectives}
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_threshold = float(burn_threshold)
+        self.budget = max(float(budget), 1e-9)
+        self.clock = clock
+        self._lock = make_lock("slo.watchdog")
+        # name -> deque[(t, violated)] bounded by the slow window (and a
+        # hard cap so a hammering scraper can't grow memory unboundedly)
+        self._samples: dict[str, collections.deque] = {
+            name: collections.deque(maxlen=4096) for name in self.objectives
+        }
+        self._values: dict[str, float] = {}
+        self._alerts: dict[str, bool] = {
+            name: False for name in self.objectives
+        }
+        self._breaches: dict[str, int] = {
+            name: 0 for name in self.objectives
+        }
+        self._last_tick: float = float("-inf")
+
+    # ------------------------------------------------------------ write
+    def tick(self, now: float | None = None) -> dict:
+        """Sample every objective and advance the state machine. Returns
+        :meth:`state`."""
+        values = {}
+        for name, obj in self.objectives.items():
+            if obj.sample is None:
+                continue
+            v = obj.sample()
+            if v is not None:
+                values[name] = v
+        return self.observe(values, now)
+
+    def maybe_tick(self, min_interval_s: float = 1.0) -> None:
+        """Scrape-driven tick, rate-limited so concurrent scrapers don't
+        multiply samples (each scrape would otherwise count as one
+        budget-window observation)."""
+        now = self.clock()
+        with self._lock:
+            if now - self._last_tick < min_interval_s:
+                return
+            self._last_tick = now
+        self.tick(now)
+
+    def observe(self, values: dict, now: float | None = None) -> dict:
+        """Feed one sample per objective (synthetic traces use this
+        directly), update burn rates and alert state, export to the
+        registry."""
+        if now is None:
+            now = self.clock()
+        transitions: list[tuple[str, bool]] = []
+        burns: dict[str, tuple[float, float]] = {}
+        with self._lock:
+            for name, obj in self.objectives.items():
+                if name not in values:
+                    continue
+                v = float(values[name])
+                self._values[name] = v
+                self._samples[name].append((now, obj.violated(v)))
+            for name in self.objectives:
+                fast = self._burn_locked(name, now, self.fast_window_s)
+                slow = self._burn_locked(name, now, self.slow_window_s)
+                burns[name] = (fast, slow)
+                firing = self._alerts[name]
+                if not firing:
+                    if (fast >= self.burn_threshold
+                            and slow >= self.burn_threshold):
+                        self._alerts[name] = True
+                        self._breaches[name] += 1
+                        transitions.append((name, True))
+                elif fast < self.burn_threshold:
+                    self._alerts[name] = False
+                    transitions.append((name, False))
+        reg = probes.REGISTRY
+        for name, (fast, slow) in burns.items():
+            reg.gauge_set("slo_burn_rate", fast, objective=name,
+                          window="fast")
+            reg.gauge_set("slo_burn_rate", slow, objective=name,
+                          window="slow")
+        for name, firing in transitions:
+            reg.gauge_set("slo_alert", 1.0 if firing else 0.0,
+                          objective=name)
+            if firing:
+                reg.counter_add("slo_breaches", objective=name)
+        return self.state()
+
+    def _burn_locked(self, name: str, now: float, window: float) -> float:
+        dq = self._samples[name]  # graft-lint: allow[GL401] _locked contract: every caller (observe/state) holds self._lock
+        cutoff = now - window
+        n = bad = 0
+        for t, violated in reversed(dq):
+            if t < cutoff:
+                break
+            n += 1
+            bad += violated
+        if not n:
+            return 0.0
+        return (bad / n) / self.budget
+
+    # ------------------------------------------------------------- read
+    def state(self) -> dict:
+        """Per-objective alert/burn view plus the aggregate ``breaches``
+        count — the 'slo' section of :func:`probes.unified_snapshot` and
+        the payload ``cli watch`` renders."""
+        with self._lock:
+            now = self.clock()
+            out: dict = {"objectives": {}, "alerting": [], "breaches": 0}
+            for name, obj in self.objectives.items():
+                fast = self._burn_locked(name, now, self.fast_window_s)
+                slow = self._burn_locked(name, now, self.slow_window_s)
+                firing = self._alerts[name]
+                out["objectives"][name] = {
+                    "kind": obj.kind,
+                    "threshold": obj.threshold,
+                    "unit": obj.unit,
+                    "value": self._values.get(name),
+                    "burn_fast": round(fast, 4),
+                    "burn_slow": round(slow, 4),
+                    "alert": firing,
+                    "breaches": self._breaches[name],
+                }
+                if firing:
+                    out["alerting"].append(name)
+                out["breaches"] += self._breaches[name]
+            out["enabled"] = bool(self.objectives)
+            return out
+
+
+# --------------------------------------------------------------------- #
+# flag-configured module singleton
+
+_watchdog: SloWatchdog | None = None
+_watchdog_lock = make_lock("slo.singleton")
+
+_GUARDED_BY = {"_watchdog": "_watchdog_lock"}
+
+
+def default_objectives() -> list[Objective]:
+    """Objectives declared via ``PATHWAY_TPU_SLO_*`` flags; a threshold
+    of 0 leaves that objective out."""
+    from pathway_tpu.internals.config import pathway_config as cfg
+
+    out: list[Objective] = []
+    if cfg.slo_ttft_p95_ms > 0:
+        out.append(Objective(
+            "ttft_p95", "ceiling", cfg.slo_ttft_p95_ms,
+            sample=_ttft_p95_ms, unit="ms"))
+    if cfg.slo_e2e_p95_ms > 0:
+        out.append(Objective(
+            "e2e_p95", "ceiling", cfg.slo_e2e_p95_ms,
+            sample=_e2e_p95_ms, unit="ms"))
+    if cfg.slo_occupancy_min > 0:
+        out.append(Objective(
+            "occupancy", "floor", cfg.slo_occupancy_min,
+            sample=_occupancy))
+    if cfg.slo_prefix_hit_min > 0:
+        out.append(Objective(
+            "prefix_hit_rate", "floor", cfg.slo_prefix_hit_min,
+            sample=_prefix_hit_rate))
+    return out
+
+
+def get_watchdog() -> SloWatchdog:
+    """The flag-configured singleton (built lazily so tests that flip
+    ``PATHWAY_TPU_SLO_*`` envs see their values after
+    :func:`reset_watchdog`)."""
+    global _watchdog
+    with _watchdog_lock:
+        if _watchdog is None:
+            from pathway_tpu.internals.config import pathway_config as cfg
+
+            _watchdog = SloWatchdog(
+                default_objectives(),
+                fast_window_s=cfg.slo_window_fast_s,
+                slow_window_s=cfg.slo_window_slow_s,
+                burn_threshold=cfg.slo_burn_threshold,
+                budget=cfg.slo_budget,
+            )
+        return _watchdog
+
+
+def reset_watchdog() -> None:
+    global _watchdog
+    with _watchdog_lock:
+        _watchdog = None
+    probes.REGISTRY.remove("slo_burn_rate", "slo_alert", "slo_breaches")
+
+
+def slo_snapshot(tick: bool = True) -> dict:
+    """The 'slo' section of :func:`probes.unified_snapshot`. Scrapes
+    drive evaluation: each snapshot advances the rolling windows (at
+    most once per second), so a server that is only being watched is
+    also being judged."""
+    wd = get_watchdog()
+    if tick and wd.objectives:
+        wd.maybe_tick()
+    return wd.state()

@@ -15,7 +15,9 @@ hands the serialized span to three sinks:
 * a bounded in-process ring buffer (``PATHWAY_TPU_TRACE_RING`` spans,
   oldest evicted) behind :func:`recent_traces`;
 * an optional JSONL flight recorder (``PATHWAY_TPU_TRACE_DIR``), one
-  line per span, append-only per pid;
+  line per span, append-only per pid, through a persistent buffered
+  handle flushed every :data:`_JSONL_FLUSH_EVERY` spans and drained by
+  :func:`flush_traces` on server shutdown (and atexit);
 * the OTel exporter in ``internals/telemetry.py`` when a collector
   endpoint is configured (``PATHWAY_MONITORING_SERVER``) — a no-op stub
   otherwise.
@@ -28,6 +30,7 @@ byte-identical either way.
 
 from __future__ import annotations
 
+import atexit
 import itertools
 import json
 import os
@@ -39,12 +42,20 @@ from pathway_tpu.engine import probes
 
 __all__ = [
     "Span", "NULL_SPAN", "start_span", "recent_traces", "reset_traces",
+    "flush_traces",
 ]
 
 # lock-discipline declaration for module globals (enforced by
-# `python -m pathway_tpu.analysis check`, rule GL401): the span ring and
-# the lazy telemetry singleton may only be touched under their locks.
-_GUARDED_BY = {"_ring": "_ring_lock", "_telemetry": "_telemetry_lock"}
+# `python -m pathway_tpu.analysis check`, rule GL401): the span ring,
+# the flight recorder's file-handle state and the lazy telemetry
+# singleton may only be touched under their locks.
+_GUARDED_BY = {
+    "_ring": "_ring_lock",
+    "_jsonl_file": "_jsonl_lock",
+    "_jsonl_path": "_jsonl_lock",
+    "_jsonl_unflushed": "_jsonl_lock",
+    "_telemetry": "_telemetry_lock",
+}
 
 
 class _NullSpan:
@@ -204,15 +215,69 @@ def _record(span_dict: dict) -> None:
     _export_otel(span_dict)
 
 
+# flight-recorder file state: ONE persistent buffered append handle per
+# process (re-opened if PATHWAY_TPU_TRACE_DIR changes, e.g. across
+# tests) instead of an open/close per span. Buffered writes are flushed
+# every _JSONL_FLUSH_EVERY spans — bounding what an abrupt kill can
+# drop — and drained completely by flush_traces() on server shutdown.
+_JSONL_FLUSH_EVERY = 32
+_jsonl_file = None
+_jsonl_path: str | None = None
+_jsonl_unflushed = 0
+
+
 def _write_jsonl(trace_dir: str, span_dict: dict) -> None:
+    global _jsonl_file, _jsonl_path, _jsonl_unflushed
     try:
-        os.makedirs(trace_dir, exist_ok=True)
-        path = os.path.join(trace_dir, f"trace-{os.getpid()}.jsonl")
         line = json.dumps(span_dict, default=str)
-        with _jsonl_lock, open(path, "a", encoding="utf-8") as f:
-            f.write(line + "\n")
+        path = os.path.join(trace_dir, f"trace-{os.getpid()}.jsonl")
+        with _jsonl_lock:
+            if _jsonl_file is None or _jsonl_path != path:
+                if _jsonl_file is not None:
+                    try:
+                        _jsonl_file.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                os.makedirs(trace_dir, exist_ok=True)
+                _jsonl_file = open(path, "a", encoding="utf-8")
+                _jsonl_path = path
+                _jsonl_unflushed = 0
+            _jsonl_file.write(line + "\n")
+            _jsonl_unflushed += 1
+            if _jsonl_unflushed >= _JSONL_FLUSH_EVERY:
+                _jsonl_file.flush()
+                _jsonl_unflushed = 0
     except Exception:  # noqa: BLE001 - the recorder must never break serving
         pass
+
+
+def flush_traces(close: bool = True) -> None:
+    """Drain the flight recorder's buffered JSONL lines to disk; with
+    ``close`` (the default) also release the file handle so a finished
+    server leaves nothing open. Safe to call any number of times, from
+    any thread, recorder configured or not — server shutdown paths
+    (``_ContinuousServer.shutdown``, ``GraphRunner.run`` teardown,
+    ``BaseRestServer.run``) and ``atexit`` all call it."""
+    global _jsonl_file, _jsonl_path, _jsonl_unflushed
+    with _jsonl_lock:
+        f = _jsonl_file
+        if f is None:
+            return
+        try:
+            f.flush()
+        except Exception:  # noqa: BLE001 - never break shutdown
+            pass
+        _jsonl_unflushed = 0
+        if close:
+            try:
+                f.close()
+            except Exception:  # noqa: BLE001
+                pass
+            _jsonl_file = None
+            _jsonl_path = None
+
+
+atexit.register(flush_traces)
 
 
 def _get_telemetry():

@@ -460,6 +460,84 @@ FLAG_REGISTRY: list[Flag] = [
             "(`tests/test_perf_guard.py` pins the ON-arm overhead "
             "≤ 3%, tokens byte-identical either way).",
     ),
+    Flag(
+        env="PATHWAY_TPU_OP_METRICS", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_engine_telemetry.py",
+        attr="op_metrics", group="observability",
+        doc="Per-operator dataflow telemetry (registry "
+            "`op_step_seconds` / `op_rows` / `op_held_rows` / "
+            "`watermark_lag` / `engine_backlog` / `exchange_rows` "
+            "families): `0` drops the engine-side registry writes while "
+            "`SchedulerStats` accounting stays on. Read once per "
+            "scheduler construction so the per-step hot path never "
+            "touches the environment; pipeline outputs are "
+            "byte-identical either way. Subordinate to "
+            "`PATHWAY_TPU_METRICS`.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PROFILE_DIR", kind="str", default="",
+        attr="profile_dir", group="observability",
+        doc="On-demand device profiling: when set, `GET "
+            "/debug/profile?ms=N` on any REST server captures a "
+            "`jax.profiler` trace of the next N milliseconds into a "
+            "fresh subdirectory and returns its path. Unset (default) "
+            "the endpoint refuses — profiling is opt-in because traces "
+            "can be large and briefly perturb serving.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SLO_TTFT_P95_MS", kind="float", default=0.0,
+        attr="slo_ttft_p95_ms", group="observability",
+        doc="SLO objective: serving TTFT p95 ceiling in ms "
+            "(`engine/slo.py` watchdog). `0` (default) disables the "
+            "objective.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SLO_E2E_P95_MS", kind="float", default=0.0,
+        attr="slo_e2e_p95_ms", group="observability",
+        doc="SLO objective: request end-to-end p95 ceiling in ms. `0` "
+            "(default) disables the objective.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SLO_OCCUPANCY_MIN", kind="float", default=0.0,
+        attr="slo_occupancy_min", group="observability",
+        doc="SLO objective: continuous-batching occupancy floor "
+            "(useful slot-steps / total, 0..1). `0` (default) disables "
+            "the objective.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SLO_PREFIX_HIT_MIN", kind="float", default=0.0,
+        attr="slo_prefix_hit_min", group="observability",
+        doc="SLO objective: prefix-KV-cache token hit-rate floor "
+            "(0..1; only judged once the cache has seen requests). `0` "
+            "(default) disables the objective.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SLO_WINDOW_FAST_S", kind="float", default=60.0,
+        attr="slo_window_fast_s", group="observability", minimum=1,
+        doc="Fast burn-rate window in seconds: catches an SLO cliff "
+            "quickly; the alert clears when this window recovers.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SLO_WINDOW_SLOW_S", kind="float", default=600.0,
+        attr="slo_window_slow_s", group="observability", minimum=1,
+        doc="Slow burn-rate window in seconds: confirms a breach is "
+            "sustained before the alert fires (both windows must burn "
+            "above threshold).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SLO_BURN_THRESHOLD", kind="float", default=1.0,
+        attr="slo_burn_threshold", group="observability",
+        doc="Burn-rate alert threshold: alert when (violating fraction "
+            "in window) / budget reaches this in BOTH windows. `1.0` "
+            "means 'spending the error budget exactly as fast as "
+            "allowed'.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SLO_BUDGET", kind="float", default=0.1,
+        attr="slo_budget", group="observability",
+        doc="Error budget: the tolerated fraction of violating samples "
+            "within a window (SRE error-budget fraction).",
+    ),
 ]
 
 

@@ -129,6 +129,13 @@ def openmetrics_text(scheduler_snapshot: dict | None = None) -> str:
             scheduler_snapshot = stats.snapshot()
     if scheduler_snapshot is not None:
         parts.append(metrics_from_stats(scheduler_snapshot))
+    # scrapes drive SLO evaluation: a deployment watched only through
+    # Prometheus must still be judged (rate-limited inside maybe_tick)
+    from pathway_tpu.engine import slo
+
+    wd = slo.get_watchdog()
+    if wd.objectives:
+        wd.maybe_tick()
     parts.append(registry_text())
     parts.append("# EOF\n")
     return "".join(parts)

@@ -88,14 +88,60 @@ class StatsMonitor:
             panel.add_row(k, v)
         return panel
 
+    def _engine_panel(self, engine: dict | None = None):
+        """Per-operator registry telemetry (latency quantiles, rows,
+        held backlog, watermark lag) as a rich table, or None while the
+        telemetry families are empty (kill switch off, or no epochs
+        yet)."""
+        from rich.table import Table as RichTable
+
+        if engine is None:
+            from pathway_tpu.engine import probes
+
+            engine = probes.engine_snapshot()
+        ops = engine.get("operators") or {}
+        if not ops:
+            return None
+        held = engine.get("held_rows") or {}
+        lag = engine.get("watermark_lag") or {}
+        panel = RichTable(title="per-operator telemetry")
+        panel.add_column("operator")
+        panel.add_column("steps", justify="right")
+        panel.add_column("p50 [ms]", justify="right")
+        panel.add_column("p95 [ms]", justify="right")
+        panel.add_column("rows in", justify="right")
+        panel.add_column("rows out", justify="right")
+        panel.add_column("held", justify="right")
+        panel.add_column("wm lag", justify="right")
+        for name, o in ops.items():
+            panel.add_row(
+                name,
+                str(o["steps"]),
+                f"{o['p50_ms']:.2f}",
+                f"{o['p95_ms']:.2f}",
+                str(o["rows_in"]),
+                str(o["rows_out"]),
+                str(held.get(name, "-")),
+                f"{lag[name]:.1f}" if name in lag else "-",
+            )
+        backlog = engine.get("backlog") or {}
+        if backlog:
+            panel.caption = "backlog: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(backlog.items())
+            )
+        return panel
+
     def _render_dashboard(self):
-        """Operator table plus, when serving metrics exist, the serving
-        panel — what the live loop actually displays."""
+        """Operator table plus, when telemetry exists, the per-operator
+        and serving panels — what the live loop actually displays."""
         from rich.console import Group
 
         table = self._render()
-        panel = self._serving_panel()
-        return table if panel is None else Group(table, panel)
+        panels = [
+            p for p in (self._engine_panel(), self._serving_panel())
+            if p is not None
+        ]
+        return table if not panels else Group(table, *panels)
 
     def _render(self):
         from rich.table import Table as RichTable

@@ -1,0 +1,69 @@
+"""On-demand device profiling — `jax.profiler` trace capture behind a
+flag.
+
+``GET /debug/profile?ms=N`` on any :class:`BaseRestServer` calls
+:func:`capture_trace` (in an executor thread, so the event loop keeps
+serving while the trace runs). The endpoint is OPT-IN via
+``PATHWAY_TPU_PROFILE_DIR``: traces can be hundreds of MB and capture
+briefly perturbs serving, so an unset flag (the default) refuses with a
+JSON error instead of profiling. Each capture lands in a fresh
+``<dir>/profile-<pid>-<seq>`` subdirectory (TensorBoard / Perfetto
+readable) and captures serialize on one lock — ``jax.profiler`` cannot
+nest traces, so a second concurrent request waits its turn.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from pathway_tpu.analysis.runtime import make_lock
+
+# one capture at a time; the sequence number keys capture subdirectories
+_capture_lock = make_lock("profiling.capture")
+_capture_seq = 0
+
+_GUARDED_BY = {"_capture_seq": "_capture_lock"}
+
+# ceiling on a single capture — a fat-fingered ms=3600000 must not pin
+# the profiler (and an executor thread) for an hour
+MAX_CAPTURE_MS = 10_000.0
+
+
+def capture_trace(ms, sleep=time.sleep) -> dict:
+    """Capture ``ms`` milliseconds of device timeline into a fresh
+    subdirectory of ``PATHWAY_TPU_PROFILE_DIR``; returns ``{"trace_dir",
+    "ms"}`` or ``{"error": ...}``. Never raises — this backs a debug
+    endpoint on a live server. ``sleep`` is injectable for tests."""
+    from pathway_tpu.internals.config import pathway_config
+
+    profile_dir = pathway_config.profile_dir
+    if not profile_dir:
+        return {
+            "error": "profiling disabled: set PATHWAY_TPU_PROFILE_DIR "
+                     "to enable /debug/profile",
+        }
+    try:
+        ms_f = float(ms)
+    except (TypeError, ValueError):
+        return {"error": f"bad ms value: {ms!r}"}
+    ms_f = max(1.0, min(ms_f, MAX_CAPTURE_MS))
+    global _capture_seq
+    with _capture_lock:
+        _capture_seq += 1
+        seq = _capture_seq
+        trace_dir = os.path.join(
+            profile_dir, f"profile-{os.getpid()}-{seq:03d}"
+        )
+        try:
+            import jax
+
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+            try:
+                sleep(ms_f / 1e3)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001 - debug surface, not serving
+            return {"error": f"{type(exc).__name__}: {exc}"}
+    return {"trace_dir": trace_dir, "ms": ms_f}

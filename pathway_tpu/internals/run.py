@@ -191,6 +191,11 @@ class GraphRunner:
             sched.teardown_exchanges()
             sched.shutdown()
             telemetry.shutdown()
+            # drain the span flight recorder's buffered JSONL lines —
+            # the run's serving/ingest spans are all finished by now
+            from pathway_tpu.engine import tracing
+
+            tracing.flush_traces()
             sched.stats.finished = True
             if monitor is not None:
                 monitor.stop()

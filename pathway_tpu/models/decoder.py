@@ -512,13 +512,31 @@ def pool_init(params: dict, cfg: DecoderConfig, n_slots: int,
     return pool
 
 
+def pool_component_bytes(pool: dict) -> dict[str, int]:
+    """HBM bytes of the pool's KV storage split by ledger component:
+    ``slot_pool`` (per-slot caches), ``kv_scales`` (int8 dequant scales),
+    ``prefix_arena`` (+ ``arena_scales``). The HBM ledger
+    (``probes.record_hbm``) records these per component at pool build;
+    :func:`pool_bytes` sums them for the historical total."""
+    groups = {
+        "slot_pool": ("k", "v"),
+        "kv_scales": ("k_scale", "v_scale"),
+        "prefix_arena": ("arena_k", "arena_v"),
+        "arena_scales": ("arena_k_scale", "arena_v_scale"),
+    }
+    out: dict[str, int] = {}
+    for component, keys in groups.items():
+        n = sum(int(pool[c].size) * pool[c].dtype.itemsize
+                for c in keys if c in pool)
+        if n:
+            out[component] = n
+    return out
+
+
 def pool_bytes(pool: dict) -> int:
     """HBM bytes of the pool's KV storage (caches + arena + scales) —
     the denominator of the kv_quant capacity claim."""
-    keys = ("k", "v", "k_scale", "v_scale", "arena_k", "arena_v",
-            "arena_k_scale", "arena_v_scale")
-    return sum(int(pool[c].size) * pool[c].dtype.itemsize
-               for c in keys if c in pool)
+    return sum(pool_component_bytes(pool).values())
 
 
 def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,

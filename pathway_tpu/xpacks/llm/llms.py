@@ -785,6 +785,16 @@ class _ContinuousServer:
             )
             self.kv_bytes_saved = base - decoder_mod.pool_bytes(self.pool)
             record_spec("kv_bytes_saved", self.kv_bytes_saved)
+        # HBM ledger: per-component footprint of the pool just built
+        # (slot caches / dequant scales / prefix arena). Recorded once
+        # here — never on the per-token path — feeding the
+        # `hbm_bytes{component=}` gauges and the total high-water.
+        from pathway_tpu.engine.probes import record_hbm
+
+        for comp, nbytes in decoder_mod.pool_component_bytes(
+            self.pool
+        ).items():
+            record_hbm(comp, nbytes)
         self._admit_fns: dict = {}
         self._admit_batch_fns: dict = {}
         self._prefill_fns: dict = {}
@@ -1557,6 +1567,11 @@ class _ContinuousServer:
             # join so interpreter teardown never kills the thread mid
             # device call (jax runtime aborts on threads dying inside it)
             t.join(timeout=10)
+        # the loop thread is down: every span it will ever write has been
+        # written, so drain the flight recorder's buffered JSONL lines
+        from pathway_tpu.engine import tracing
+
+        tracing.flush_traces()
 
 
 @pw.udf

@@ -53,15 +53,19 @@ class BaseRestServer:
 
     def start_observability_endpoints(self) -> None:
         """Register ``GET /metrics`` (OpenMetrics text over the unified
-        ``MetricsRegistry``) and a registry-JSON ``/v1/statistics`` on
-        the shared webserver. Registered directly (not as dataflow
+        ``MetricsRegistry``), a registry-JSON ``/v1/statistics`` and the
+        opt-in ``GET /debug/profile?ms=N`` device-trace capture on the
+        shared webserver. Registered directly (not as dataflow
         routes), so they answer even while the pipeline is compiling or
         stalled; dataflow routes register later — at connector start,
         inside ``pw.run`` — so a server that defines its own
         ``/v1/statistics`` (e.g. :class:`QARestServer`) overrides the
         registry JSON for that route while keeping ``/metrics``."""
+        import asyncio
+        import functools
+
         from pathway_tpu.engine import probes
-        from pathway_tpu.internals import run as run_mod
+        from pathway_tpu.internals import profiling, run as run_mod
         from pathway_tpu.internals.http_server import openmetrics_text
 
         async def metrics_handler(_payload):
@@ -75,9 +79,20 @@ class BaseRestServer:
                 getattr(run_mod, "LAST_RUN_STATS", None)
             )
 
+        async def profile_handler(payload):
+            # capture in an executor thread: the profiler sleeps for the
+            # requested window and the event loop must keep serving
+            ms = (payload or {}).get("ms", 100)
+            return await asyncio.get_event_loop().run_in_executor(
+                None, functools.partial(profiling.capture_trace, ms)
+            )
+
         self.webserver._register("/metrics", ["GET"], metrics_handler)
         self.webserver._register(
             "/v1/statistics", ["GET", "POST"], statistics_handler
+        )
+        self.webserver._register(
+            "/debug/profile", ["GET", "POST"], profile_handler
         )
 
     def run(

@@ -18,7 +18,13 @@ def test_bench_smoke_schema():
     env.pop("XLA_FLAGS", None)  # bench measures on ONE device, not the
     # conftest's virtual 8-CPU mesh
     p = subprocess.run(
-        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        [
+            sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+            # regression sentinel rides the same invocation: schema-diffs
+            # the fresh summary against the checked-in baseline and fails
+            # the run (nonzero exit) on breach
+            "--sentinel", os.path.join(REPO, "BENCH_r05.json"),
+        ],
         capture_output=True, text=True, timeout=420, cwd=REPO, env=env,
     )
     assert p.returncode == 0, p.stderr[-4000:]
@@ -106,3 +112,15 @@ def test_bench_smoke_schema():
     assert srv["kv_quant_tok_s"] > 0
     # the int8 arm actually shrank the KV footprint
     assert srv["kv_bytes_saved"] > 0
+    # pipeline-depth observability (PR 9): per-operator latency telemetry
+    # sampled during the streaming phases, the HBM ledger saw the decoder
+    # pools, and the SLO watchdog state rode the summary out
+    eng = s["engine"]
+    assert eng["op_latency_p50_ms"] > 0
+    assert eng["operators"] > 0
+    assert s["hbm_high_water_bytes"] > 0
+    comps = s["hbm_components"]
+    assert comps.get("slot_pool", 0) > 0, comps
+    slo = s["slo"]
+    assert slo["breaches"] == 0 and slo["alerting"] == []
+    assert slo["enabled"] in (True, False)

@@ -147,7 +147,33 @@ def test_rest_metrics_scrape_during_live_burst(tiny_params):
     for name, summary in want["latency"].items():
         assert stats["serving"]["latency"][name]["count"] == summary["count"]
     assert stats["serving"]["dispatch"] == want["dispatch"]
-    assert set(stats) == {"scheduler", "serving", "registry"}
+    assert set(stats) == {
+        "scheduler", "serving", "engine", "hbm", "slo", "registry",
+    }
+    # the decode burst built a slot pool, so the HBM ledger has data and
+    # it rides the same scrape surface
+    assert stats["hbm"]["high_water_total_bytes"] > 0
+    assert 'pathway_tpu_hbm_high_water_bytes{component="slot_pool"}' in body
+    assert 'pathway_tpu_hbm_high_water_bytes{component="total"}' in body
+
+    # a dataflow run in the same process lands per-operator families in
+    # the SAME live scrape (acceptance criterion: operator label on the
+    # op_step histogram and row counters)
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    rows, _ = _capture_rows(t.select(c=pw.this.a + pw.this.b))
+    assert len(rows) == 2
+    body = urllib.request.urlopen(base + "/metrics", timeout=5).read().decode()
+    _assert_openmetrics(body)
+    assert "pathway_tpu_op_step_seconds_bucket{" in body
+    assert "pathway_tpu_op_rows_total{" in body
+    assert 'operator="' in body
+    assert 'pathway_tpu_engine_backlog{queue="pending_epochs"}' in body
 
 
 def test_span_ordering_invariants_on_equivalence_grid(tiny_params):
@@ -182,17 +208,97 @@ def test_trace_ring_is_bounded(monkeypatch):
 
 def test_jsonl_flight_recorder(monkeypatch, tmp_path):
     monkeypatch.setenv("PATHWAY_TPU_TRACE_DIR", str(tmp_path))
+    tracing.flush_traces()  # drop any handle aimed at a prior test's dir
     span = tracing.start_span("query", server="jsonl-test", k=4)
     span.event("admit")
     span.event("drain")
     span.finish()
     path = tmp_path / f"trace-{os.getpid()}.jsonl"
+    # one span < the 32-span flush threshold: still in the buffered
+    # handle, nothing on disk yet
+    assert path.read_text() == ""
+    tracing.flush_traces()
     lines = path.read_text().strip().split("\n")
     rec = json.loads(lines[-1])
     assert rec["kind"] == "query" and rec["server"] == "jsonl-test"
     assert [e["name"] for e in rec["events"]] == ["enqueue", "admit", "drain"]
     assert rec["attrs"]["k"] == 4
     assert "e2e_ms" in rec["metrics"] and "queue_wait_ms" in rec["metrics"]
+    tracing.flush_traces()  # idempotent after close
+
+
+def test_flight_recorder_flushed_on_server_shutdown(
+    monkeypatch, tmp_path, tiny_params
+):
+    """Server shutdown drains the recorder: a burst far below the flush
+    threshold must still be fully on disk once the chat closes."""
+    monkeypatch.setenv("PATHWAY_TPU_TRACE_DIR", str(tmp_path))
+    tracing.flush_traces()
+    tracing.reset_traces()
+    texts, spans = _decode_burst(tiny_params, n=3)
+    assert len(spans) == 3
+    # _decode_burst closed the chat; _ContinuousServer.shutdown flushed
+    path = tmp_path / f"trace-{os.getpid()}.jsonl"
+    recs = [json.loads(li) for li in path.read_text().strip().splitlines()]
+    assert len(recs) >= 3
+    assert all(r["kind"] == "decode" for r in recs[-3:])
+
+
+def test_concurrent_scrapes_during_live_burst(tiny_params):
+    """/metrics and /v1/statistics hammered from four threads while a
+    serving burst runs: every scrape must parse (the registry snapshot
+    is taken under one lock, so no torn exposition) and none may error."""
+    import threading
+
+    from pathway_tpu.xpacks.llm.servers import BaseRestServer
+
+    probes.REGISTRY.reset()
+    server = BaseRestServer("127.0.0.1", 0)
+    server.start_observability_endpoints()
+    server.webserver.start()
+    base = f"http://127.0.0.1:{server.webserver.port}"
+
+    errors: list = []
+    counts = [0, 0]
+    stop = threading.Event()
+
+    def scraper(idx, path, check):
+        while not stop.is_set():
+            try:
+                body = urllib.request.urlopen(
+                    base + path, timeout=10
+                ).read().decode()
+                check(body)
+                counts[idx] += 1
+            except Exception as exc:  # noqa: BLE001 - collected, asserted
+                errors.append((path, repr(exc)))
+                return
+
+    threads = [
+        threading.Thread(
+            target=scraper, args=(0, "/metrics", _assert_openmetrics),
+            daemon=True,
+        )
+        for _ in range(2)
+    ] + [
+        threading.Thread(
+            target=scraper,
+            args=(1, "/v1/statistics", lambda b: json.loads(b)["registry"]),
+            daemon=True,
+        )
+        for _ in range(2)
+    ]
+    for th in threads:
+        th.start()
+    try:
+        texts, _ = _decode_burst(tiny_params, n=6)
+        assert all(texts)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+    assert errors == [], errors
+    assert counts[0] > 0 and counts[1] > 0  # both surfaces actually scraped
 
 
 def test_kill_switch_byte_identical_outputs(tiny_params, monkeypatch):
@@ -234,6 +340,21 @@ def test_serving_panel_renders_from_registry():
     assert monitor._serving_panel() is None
     # with no serving data the dashboard is just the operator table
     assert not isinstance(monitor._render_dashboard(), Group)
+
+
+def test_engine_panel_renders_from_registry():
+    probes.REGISTRY.reset()
+    monitor = StatsMonitor(SchedulerStats(), MonitoringLevel.ALL)
+    assert monitor._engine_panel() is None  # nothing recorded yet
+    probes.record_op_step("select", 0.002, 10, 10)
+    probes.record_op_step("filter", 0.001, 10, 7)
+    probes.record_backlog("pending_epochs", 3)
+    probes.record_watermark("select", 5, 1.5)
+    panel = monitor._engine_panel()
+    assert panel is not None and panel.row_count == 2
+    assert "pending_epochs=3" in panel.caption
+    probes.reset_engine_stats()
+    assert monitor._engine_panel() is None
 
 
 def test_cli_stats_pretty_and_json():

@@ -1,0 +1,191 @@
+"""SLO burn-rate watchdog: objective semantics, the multi-window alert
+state machine on a synthetic trace (breach -> fast alert -> recovery),
+flag-driven configuration and the registry export surface."""
+
+import pathway_tpu  # noqa: F401 - flag registry import order
+from pathway_tpu.engine import probes, slo
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _watchdog(clock, **kw):
+    kw.setdefault("fast_window_s", 60.0)
+    kw.setdefault("slow_window_s", 600.0)
+    kw.setdefault("budget", 0.1)
+    return slo.SloWatchdog(
+        [slo.Objective("ttft_p95", "ceiling", 500.0, unit="ms")],
+        clock=clock, **kw,
+    )
+
+
+def test_objective_kinds():
+    ceil = slo.Objective("lat", "ceiling", 500.0)
+    assert not ceil.violated(500.0) and ceil.violated(500.1)
+    floor = slo.Objective("occ", "floor", 0.4)
+    assert not floor.violated(0.4) and floor.violated(0.39)
+
+
+def test_burn_rate_state_machine_breach_alert_recover():
+    """Healthy trace -> zero burn; sustained breach long enough to fill
+    BOTH windows -> alert fires and the breach counter increments once;
+    fast-window recovery -> alert clears without touching the counter."""
+    probes.REGISTRY.remove("slo_burn_rate", "slo_alert", "slo_breaches")
+    clock = FakeClock()
+    wd = _watchdog(clock)
+
+    # 10 minutes of healthy samples at 10s cadence
+    for _ in range(60):
+        state = wd.observe({"ttft_p95": 120.0}, clock.advance(10.0))
+    obj = state["objectives"]["ttft_p95"]
+    assert obj["burn_fast"] == obj["burn_slow"] == 0.0
+    assert not obj["alert"] and state["alerting"] == []
+
+    # cliff: every sample violates. The fast window saturates within
+    # a minute (burn = 1/0.1 = 10x) but the slow window still remembers
+    # the healthy tail, so the alert must NOT fire on the first bad
+    # samples...
+    for _ in range(6):
+        state = wd.observe({"ttft_p95": 900.0}, clock.advance(10.0))
+    obj = state["objectives"]["ttft_p95"]
+    assert obj["burn_fast"] >= wd.burn_threshold
+    assert not obj["alert"], "alert fired before the slow window confirmed"
+
+    # ...and fires once the violating fraction of the slow window also
+    # burns at >= threshold (budget 0.1 -> >10% of 10 min violating)
+    for _ in range(6):
+        state = wd.observe({"ttft_p95": 900.0}, clock.advance(10.0))
+    obj = state["objectives"]["ttft_p95"]
+    assert obj["alert"] and state["alerting"] == ["ttft_p95"]
+    assert obj["breaches"] == 1 and state["breaches"] == 1
+    assert probes.REGISTRY.gauge_value(
+        "slo_alert", objective="ttft_p95") == 1.0
+
+    # sustained alert does NOT re-count the breach
+    state = wd.observe({"ttft_p95": 900.0}, clock.advance(10.0))
+    assert state["breaches"] == 1
+
+    # recovery: healthy samples wash the fast window -> alert clears,
+    # breach count is history, not state
+    for _ in range(7):
+        state = wd.observe({"ttft_p95": 110.0}, clock.advance(10.0))
+    obj = state["objectives"]["ttft_p95"]
+    assert not obj["alert"] and state["alerting"] == []
+    assert obj["breaches"] == 1 and state["breaches"] == 1
+    assert probes.REGISTRY.gauge_value(
+        "slo_alert", objective="ttft_p95") == 0.0
+    # burn gauges exported for both windows
+    assert probes.REGISTRY.gauge_value(
+        "slo_burn_rate", objective="ttft_p95", window="fast") is not None
+    assert probes.REGISTRY.gauge_value(
+        "slo_burn_rate", objective="ttft_p95", window="slow") is not None
+
+
+def test_unsampled_objectives_burn_nothing():
+    """No data -> no budget spend: a watchdog whose signal never samples
+    stays at zero burn and never alerts."""
+    clock = FakeClock()
+    wd = _watchdog(clock)
+    for _ in range(20):
+        state = wd.observe({}, clock.advance(10.0))
+    obj = state["objectives"]["ttft_p95"]
+    assert obj["burn_fast"] == 0.0 and not obj["alert"]
+    assert obj["value"] is None
+
+
+def test_maybe_tick_rate_limited():
+    """Concurrent scrapers collapse to at most one sample per interval —
+    a hammering scraper must not multiply budget-window observations."""
+    clock = FakeClock()
+    calls = []
+    wd = slo.SloWatchdog(
+        [slo.Objective(
+            "sig", "ceiling", 1.0,
+            sample=lambda: calls.append(1) or 0.5,
+        )],
+        clock=clock,
+    )
+    for _ in range(10):
+        wd.maybe_tick(min_interval_s=1.0)
+    assert len(calls) == 1
+    clock.advance(1.5)
+    for _ in range(10):
+        wd.maybe_tick(min_interval_s=1.0)
+    assert len(calls) == 2
+
+
+def test_flag_configured_watchdog(monkeypatch):
+    """PATHWAY_TPU_SLO_* flags build the singleton: thresholds of 0 keep
+    objectives out (opt-in), nonzero thresholds wire the built-in
+    samplers, and the snapshot reports enabled accordingly."""
+    slo.reset_watchdog()
+    try:
+        snap = slo.slo_snapshot()
+        assert snap["enabled"] is False and snap["objectives"] == {}
+
+        monkeypatch.setenv("PATHWAY_TPU_SLO_TTFT_P95_MS", "500")
+        monkeypatch.setenv("PATHWAY_TPU_SLO_OCCUPANCY_MIN", "0.4")
+        monkeypatch.setenv("PATHWAY_TPU_SLO_WINDOW_FAST_S", "30")
+        slo.reset_watchdog()
+        wd = slo.get_watchdog()
+        assert set(wd.objectives) == {"ttft_p95", "occupancy"}
+        assert wd.fast_window_s == 30.0
+        assert wd.objectives["occupancy"].kind == "floor"
+        snap = slo.slo_snapshot()
+        assert snap["enabled"] is True
+        assert set(snap["objectives"]) == {"ttft_p95", "occupancy"}
+    finally:
+        slo.reset_watchdog()
+
+
+def test_cli_watch(monkeypatch):
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli
+
+    runner = CliRunner()
+    slo.reset_watchdog()
+    try:
+        res = runner.invoke(cli, ["watch", "--iterations", "1"])
+        assert res.exit_code == 0, res.output
+        assert "no SLO objectives configured" in res.output
+
+        monkeypatch.setenv("PATHWAY_TPU_SLO_TTFT_P95_MS", "500")
+        slo.reset_watchdog()
+        res = runner.invoke(cli, ["watch", "--iterations", "1"])
+        assert res.exit_code == 0, res.output
+        assert "ttft_p95" in res.output and "burn fast=" in res.output
+
+        # fire an alert on the singleton (one violating sample with no
+        # healthy history saturates both windows), then --fail-on-alert
+        # must exit nonzero
+        slo.get_watchdog().observe({"ttft_p95": 900.0})
+        res = runner.invoke(
+            cli, ["watch", "--iterations", "1", "--fail-on-alert"]
+        )
+        assert res.exit_code == 1, res.output
+        assert "ALERT ttft_p95" in res.output
+    finally:
+        slo.reset_watchdog()
+
+
+def test_slo_section_in_unified_snapshot():
+    slo.reset_watchdog()
+    try:
+        snap = probes.unified_snapshot()
+        assert set(snap) == {
+            "scheduler", "serving", "engine", "hbm", "slo", "registry",
+        }
+        assert snap["slo"]["breaches"] == 0
+        assert snap["slo"]["alerting"] == []
+    finally:
+        slo.reset_watchdog()
