@@ -2395,6 +2395,12 @@ def _decoder_serving_compare(params, cfg) -> dict:
         ),
         "batch_static": rest_static,
         "continuous": rest_cont,
+        # fault-tolerance accounting off the continuous server: chaos is
+        # off in bench runs, so nonzero sheds/restarts are themselves a
+        # regression signal (the sentinel gates requests_shed exactly)
+        "requests_shed": int(srv.stats["shed"]),
+        "restarts": int(srv.stats["restarts"]),
+        "degradation_level": int(srv._degradation_level),
         "throughput_x": round(
             rest_cont["useful_tokens_per_sec"]
             / max(rest_static["useful_tokens_per_sec"], 1e-9), 2
@@ -2674,6 +2680,9 @@ def main() -> None:
             "kv_bytes_saved": (serving_det.get("spec") or {}).get(
                 "kv_bytes_saved"
             ),
+            "requests_shed": serving_det.get("requests_shed"),
+            "restarts": serving_det.get("restarts"),
+            "degradation_level": serving_det.get("degradation_level"),
         }
         if serving_det and "error" not in serving_det
         else serving_det or None
@@ -2841,7 +2850,8 @@ def main() -> None:
             "queue_wait_p50_ms", "tpot_p50_ms", "e2e_p50_ms",
             "spec_acceptance_rate", "tokens_per_dispatch",
             "spec_tok_s", "plain_tok_s", "kv_quant_tok_s",
-            "kv_bytes_saved",
+            "kv_bytes_saved", "requests_shed", "restarts",
+            "degradation_level",
         ):
             _chk(f"summary.serving.{k}", srv.get(k))
         # acceptance floor on the shared-head trace: the draft stack
@@ -2957,6 +2967,18 @@ def sentinel_check(summary: dict, baseline: dict, smoke: bool) -> list:
         breaches.append("summary.hbm_high_water_bytes: missing")
     if "breaches" not in (new.get("slo") or {}):
         breaches.append("summary.slo.breaches: missing")
+    # fault-tolerance gate, exact and enforced at every scale: bench runs
+    # with chaos off, so ANY shed request on the serving trace means
+    # admission control fired on a clean workload — a real regression,
+    # not noise, hence no ratio tolerance
+    srv_new = new.get("serving") or {}
+    shed = srv_new.get("requests_shed")
+    if not isinstance(shed, (int, float)) or isinstance(shed, bool):
+        breaches.append("summary.serving.requests_shed: missing")
+    elif shed > 0:
+        breaches.append(
+            f"summary.serving.requests_shed: {shed} > 0 on a chaos-off run"
+        )
     return breaches
 
 

@@ -1,0 +1,100 @@
+"""Deterministic, site-addressable fault injection for the serving stack.
+
+The fault-tolerance layer (supervised serving loops, per-request
+isolation, shedding) is only trustworthy if its failure paths are
+exercised on every CI run — and failure paths exercised by real
+hardware faults are neither deterministic nor cheap. This module plants
+named injection points ("sites") on the hot paths that talk to the
+device, the network or the persistence backend; each armed site rolls a
+seeded per-site RNG and raises a typed :class:`InjectedFault` at the
+configured rate. Tests assert provenance off the exception's ``site``
+and ``seq`` fields, and the seed makes a chaos trace replayable.
+
+Sites in the tree (grep for ``chaos.site(``):
+
+* ``decode.admit``    — per-request admission work in ``_ContinuousServer``
+                        (request-scoped: supervision fails one request)
+* ``decode.dispatch`` — the decode-chunk device dispatch (loop-scoped:
+                        supervision restarts the serving loop)
+* ``embed.h2d``       — the ingest pipeline's host->device staging
+* ``query.tick``      — one ``QueryServer`` tick-body group dispatch
+* ``persist.put``     — snapshot chunk ``put_value``
+* ``connector.read``  — ``BaseConnector.commit_rows``
+
+Kill switch: ``PATHWAY_TPU_CHAOS`` (a fault rate in [0, 1], default 0)
+is read ONCE when a holder constructs its site — like the lock
+sanitizer's ``make_lock`` — and :func:`site` returns ``None`` when the
+rate is 0, so the off position costs the hot path exactly one ``is not
+None`` check. ``PATHWAY_TPU_CHAOS_SEED`` seeds the per-site RNGs;
+``PATHWAY_TPU_CHAOS_SITES`` (comma-separated names or dotted prefixes)
+arms a subset of sites, empty meaning all.
+"""
+
+from __future__ import annotations
+
+import random
+import zlib
+
+from pathway_tpu.analysis.annotations import guarded_by
+from pathway_tpu.analysis.runtime import make_lock
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by an armed chaos site — never by real code paths,
+    so tests (and the error log) can attribute it unambiguously."""
+
+    def __init__(self, site: str, seq: int):
+        super().__init__(f"injected fault at {site} (op #{seq})")
+        self.site = site
+        self.seq = seq
+
+
+@guarded_by(_seq="_lock")
+class ChaosSite:
+    """One armed injection point: a per-site deterministic RNG plus an
+    operation counter, so the Nth pass through a site faults (or not)
+    identically across runs with the same seed."""
+
+    def __init__(self, name: str, rate: float, seed: int):
+        self.name = name
+        self.rate = float(rate)
+        # hash() is per-process randomized; crc32 keeps (seed, name) ->
+        # fault schedule stable across processes and runs
+        self._rng = random.Random((int(seed) << 32) ^ zlib.crc32(name.encode()))
+        self._lock = make_lock(f"chaos.{name}")
+        self._seq = 0
+
+    def maybe_fail(self) -> None:
+        """Count one operation; raise :class:`InjectedFault` at the
+        configured rate. Call BEFORE the guarded operation so an
+        injected fault never leaves device or backend state torn."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            fault = self._rng.random() < self.rate
+        if fault:
+            raise InjectedFault(self.name, seq)
+
+
+def _armed(name: str, sites_spec: str) -> bool:
+    entries = [s.strip() for s in sites_spec.split(",") if s.strip()]
+    if not entries:
+        return True
+    return any(
+        name == e or name.startswith(e + ".") for e in entries
+    )
+
+
+def site(name: str) -> ChaosSite | None:
+    """Construct the injection point ``name`` from the chaos flags, or
+    ``None`` when chaos is off (or this site is filtered out) — holders
+    keep the result and guard calls with ``if self._chaos is not None``,
+    so a disabled harness never touches the environment again."""
+    from pathway_tpu.internals.config import pathway_config
+
+    rate = pathway_config.chaos
+    if rate <= 0.0:
+        return None
+    if not _armed(name, pathway_config.chaos_sites):
+        return None
+    return ChaosSite(name, min(rate, 1.0), pathway_config.chaos_seed)

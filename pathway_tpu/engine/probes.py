@@ -131,6 +131,18 @@ METRIC_FAMILIES: dict[str, tuple[str, str | None, str]] = {
     "slo_breaches": (
         "counter", "objective", "SLO alert activations (ok -> firing "
         "transitions) per objective"),
+    "serve_restarts": (
+        "counter", "server", "Supervised serving-loop restarts "
+        "(crash -> backoff -> re-enter) per server"),
+    "requests_shed": (
+        "counter", "reason", "Requests shed by admission control "
+        "(deadline / queue_full / degraded)"),
+    "degradation_level": (
+        "gauge", None, "Current SLO-driven degradation ladder level "
+        "(0 = full service, 3 = shedding low-priority admissions)"),
+    "requests_isolated": (
+        "counter", "outcome", "Request-scoped serving errors handled by "
+        "per-request isolation (retried / failed)"),
 }
 
 LATENCY_HISTOGRAMS = (

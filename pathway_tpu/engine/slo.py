@@ -234,13 +234,90 @@ class SloWatchdog:
             return out
 
 
+@guarded_by(_level="_lock", _last_change="_lock", _last_eval="_lock")
+class DegradationController:
+    """SLO-driven degradation ladder over the watchdog's alert state.
+
+    While any objective alerts, :meth:`evaluate` climbs one level per
+    ``step_s`` seconds; while none alert it walks back down at the same
+    cadence — so a transient blip costs at most one step and a sustained
+    breach ratchets service down progressively instead of cliffing.
+    The LEVELS are consumed by ``_ContinuousServer`` admission:
+
+    * **0** — full service.
+    * **1** — clamp each admission's ``max_new`` to half the server
+      default (shorter answers, faster slot recycling).
+    * **2** — additionally disable speculative decode (frees the draft
+      compute; tokens are identical, only cost changes).
+    * **3** — additionally shed admissions submitted with
+      ``priority <= 0`` (lowest class first; default-priority traffic
+      still serves).
+
+    The clock is injectable so the state machine is testable on a
+    synthetic trace; transitions export to the ``degradation_level``
+    gauge."""
+
+    MAX_LEVEL = 3
+
+    def __init__(self, watchdog: SloWatchdog, *, step_s: float = 5.0,
+                 clock: Callable[[], float] | None = None):
+        self.watchdog = watchdog
+        self.step_s = float(step_s)
+        self.clock = clock if clock is not None else watchdog.clock
+        self._lock = make_lock("slo.degradation")
+        self._level = 0
+        self._last_change = float("-inf")
+        self._last_eval = float("-inf")
+
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def evaluate(self, now: float | None = None) -> int:
+        """Advance the ladder against the watchdog's current alert state
+        (at most one level per ``step_s``) and return the level."""
+        if now is None:
+            now = self.clock()
+        alerting = bool(self.watchdog.state()["alerting"])
+        changed = None
+        with self._lock:
+            if now - self._last_change >= self.step_s:
+                if alerting and self._level < self.MAX_LEVEL:
+                    self._level += 1
+                    self._last_change = now
+                    changed = self._level
+                elif not alerting and self._level > 0:
+                    self._level -= 1
+                    self._last_change = now
+                    changed = self._level
+            lvl = self._level
+        if changed is not None:
+            probes.REGISTRY.gauge_set("degradation_level", float(changed))
+        return lvl
+
+    def maybe_evaluate(self, min_interval_s: float = 1.0) -> int:
+        """Serving-loop-driven :meth:`evaluate`, rate-limited so a tick
+        loop spinning at chunk cadence doesn't pay the watchdog burn
+        computation per chunk."""
+        now = self.clock()
+        with self._lock:
+            if now - self._last_eval < min_interval_s:
+                return self._level
+            self._last_eval = now
+        return self.evaluate(now)
+
+
 # --------------------------------------------------------------------- #
-# flag-configured module singleton
+# flag-configured module singletons
 
 _watchdog: SloWatchdog | None = None
+_degradation: DegradationController | None = None
 _watchdog_lock = make_lock("slo.singleton")
 
-_GUARDED_BY = {"_watchdog": "_watchdog_lock"}
+_GUARDED_BY = {
+    "_watchdog": "_watchdog_lock",
+    "_degradation": "_watchdog_lock",
+}
 
 
 def default_objectives() -> list[Objective]:
@@ -287,11 +364,25 @@ def get_watchdog() -> SloWatchdog:
         return _watchdog
 
 
+def get_degradation_controller() -> DegradationController:
+    """The flag-configured ladder over :func:`get_watchdog` (shared by
+    every server so all admission paths degrade in lockstep)."""
+    global _degradation
+    wd = get_watchdog()
+    with _watchdog_lock:
+        if _degradation is None or _degradation.watchdog is not wd:
+            _degradation = DegradationController(wd)
+        return _degradation
+
+
 def reset_watchdog() -> None:
-    global _watchdog
+    global _watchdog, _degradation
     with _watchdog_lock:
         _watchdog = None
-    probes.REGISTRY.remove("slo_burn_rate", "slo_alert", "slo_breaches")
+        _degradation = None
+    probes.REGISTRY.remove(
+        "slo_burn_rate", "slo_alert", "slo_breaches", "degradation_level"
+    )
 
 
 def slo_snapshot(tick: bool = True) -> dict:

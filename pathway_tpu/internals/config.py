@@ -538,6 +538,84 @@ FLAG_REGISTRY: list[Flag] = [
         doc="Error budget: the tolerated fraction of violating samples "
             "within a window (SRE error-budget fraction).",
     ),
+    # ------------------------------------------------ fault tolerance
+    Flag(
+        env="PATHWAY_TPU_CHAOS", kind="float", default=0.0,
+        kill_switch=True, pinned_by="tests/test_chaos.py",
+        attr="chaos", group="fault", minimum=0,
+        doc="Deterministic fault injection (`engine/chaos.py`): the "
+            "probability in [0, 1] that an armed chaos site raises a "
+            "typed `InjectedFault` on one pass. Read once per site "
+            "CONSTRUCTION — `0` (default) makes `chaos.site()` return "
+            "None, so the serving hot paths pay one `is not None` "
+            "check and outputs stay byte-identical.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_CHAOS_SEED", kind="int", default=0,
+        attr="chaos_seed", group="fault",
+        doc="Seed for the per-site chaos RNGs: the same (seed, site) "
+            "pair yields the same fault schedule across runs and "
+            "processes, so a chaos failure is replayable.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_CHAOS_SITES", kind="str", default="",
+        attr="chaos_sites", group="fault",
+        doc="Comma-separated chaos site names (or dotted prefixes, e.g. "
+            "`decode` arms `decode.admit` and `decode.dispatch`) to "
+            "arm. Empty (default) arms every site when "
+            "`PATHWAY_TPU_CHAOS` > 0.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SERVE_RESTARTS", kind="int", default=0,
+        kill_switch=True, pinned_by="tests/test_chaos.py",
+        attr="serve_restarts", group="fault", minimum=0,
+        doc="Supervised serving: how many times a crashed serving loop "
+            "(`_ContinuousServer`, `QueryServer`) restarts with "
+            "exponential backoff before latching failed. Also gates "
+            "per-request isolation (a request-scoped error fails one "
+            "request, not the server). `0` (default) keeps the "
+            "historical latch-on-first-error behavior, byte-identical.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SERVE_RETRIES", kind="int", default=1,
+        attr="serve_retries", group="fault", minimum=0,
+        doc="Per-request retry budget under supervised serving: a "
+            "request whose admission work faults re-queues up to this "
+            "many times before failing alone. Inert while "
+            "`PATHWAY_TPU_SERVE_RESTARTS` is 0.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_REQUEST_DEADLINE_MS", kind="float", default=0.0,
+        kill_switch=True, pinned_by="tests/test_chaos.py",
+        attr="request_deadline_ms", group="fault", minimum=0,
+        doc="Per-request serving deadline in ms, enforced at admission "
+            "and while queued: an expired request is SHED with a "
+            "structured error (HTTP 503 + Retry-After on the REST "
+            "path) instead of occupying a slot. `0` (default) disables "
+            "deadlines; serving is byte-identical.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_SERVE_QUEUE", kind="int", default=0,
+        kill_switch=True, pinned_by="tests/test_chaos.py",
+        attr="serve_queue", group="fault", minimum=0,
+        doc="Continuous-server submit-queue watermark: a submit landing "
+            "on a queue already this deep is shed immediately "
+            "(structured error -> HTTP 503) instead of waiting "
+            "unboundedly. `0` (default) keeps the unbounded queue, "
+            "byte-identical.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_DEGRADATION", kind="bool", default=True,
+        kill_switch=True, pinned_by="tests/test_chaos.py",
+        attr="degradation", group="fault",
+        doc="SLO-driven degradation ladder (`engine/slo.py`): while the "
+            "watchdog alerts, admission degrades progressively — clamp "
+            "`max_new`, disable speculative decode, shed low-priority "
+            "admissions — and walks back up as the fast window "
+            "recovers. Inert without `PATHWAY_TPU_SLO_*` objectives "
+            "(no alert can fire); `0` disables the ladder entirely, "
+            "byte-identical.",
+    ),
 ]
 
 
@@ -721,7 +799,7 @@ def set_monitoring_config(*, server_endpoint: str | None) -> None:
 if __name__ == "__main__":
     # regenerate the README flag tables (paste between the
     # <!-- flags:<group> --> markers)
-    for _group in ("pipeline", "query", "observability"):
+    for _group in ("pipeline", "query", "observability", "fault"):
         print(f"<!-- flags:{_group} -->")
         print(render_flag_table(_group))
         print(f"<!-- /flags:{_group} -->")

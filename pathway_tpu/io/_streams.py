@@ -35,6 +35,8 @@ class BaseConnector:
     shardable: bool = False
 
     def __init__(self, node: Node):
+        from pathway_tpu.engine import chaos
+
         self.node = node
         self._thread: threading.Thread | None = None
         self._hb_thread: threading.Thread | None = None
@@ -46,6 +48,7 @@ class BaseConnector:
         self.persistent_id: str | None = None
         self._persistence = None  # PersistenceManager when persistence is on
         self._snapshot_writer = None
+        self._chaos_read = chaos.site("connector.read")
 
     # -- persistence hooks (reference: Reader::seek + SnapshotEvent log) ----
     def setup_persistence(self, manager) -> None:
@@ -90,6 +93,10 @@ class BaseConnector:
     ) -> int:
         """Atomically emit ``rows`` at a fresh commit time and advance the
         frontier past it (safe against the heartbeat)."""
+        if self._chaos_read is not None:
+            # raise BEFORE the commit: the batch is either fully injected
+            # or not at all, like a real source read failure
+            self._chaos_read.maybe_fail()
         with self._time_mutex:
             t = next_commit_time()
             self.emit(t, rows)

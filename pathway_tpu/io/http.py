@@ -59,6 +59,21 @@ class EndpointDocumentation:
         self.method_types = list(method_types)
 
 
+class RestApiError(Exception):
+    """A structured HTTP failure a handler wants returned verbatim:
+    ``status`` + JSON ``payload`` (+ optional ``Retry-After``), instead of
+    the generic 500 wrapper. Raised by ``_RestConnector._handle`` when the
+    resolved result carries the ``_pw_http_error`` envelope that the
+    serving layers use to ship typed failures through the dataflow."""
+
+    def __init__(self, status: int, payload: dict,
+                 retry_after: float | None = None):
+        super().__init__(payload.get("error", "request failed"))
+        self.status = int(status)
+        self.payload = payload
+        self.retry_after = retry_after
+
+
 class PathwayWebserver:
     """Shared aiohttp server hosting one or more rest_connector routes."""
 
@@ -105,6 +120,15 @@ class PathwayWebserver:
                 if raw_ct is not None:
                     return web.Response(text=result, content_type=raw_ct)
                 return web.json_response(result)
+            except RestApiError as exc:
+                headers = {}
+                if exc.retry_after is not None:
+                    headers["Retry-After"] = str(
+                        max(1, int(round(exc.retry_after)))
+                    )
+                return web.json_response(
+                    exc.payload, status=exc.status, headers=headers
+                )
             except Exception as exc:  # noqa: BLE001
                 return web.json_response({"error": str(exc)}, status=500)
 
@@ -159,6 +183,17 @@ class _RestConnector(BaseConnector):
         result = await fut
         if self.delete_completed:
             self.commit_rows([(key, row, -1)])
+        if isinstance(result, dict) and "_pw_http_error" in result:
+            # typed failure envelope from the serving layers (see
+            # xpacks/llm/servers.map_serving_errors): surface it as the
+            # HTTP status it names instead of a 200 with an error body
+            err = result["_pw_http_error"]
+            raise RestApiError(
+                int(err.get("status", 500)),
+                {"error": err.get("error", "request failed"),
+                 "reason": err.get("reason", "error")},
+                retry_after=err.get("retry_after"),
+            )
         return result
 
     def resolve(self, key: int, result: Any) -> None:

@@ -141,9 +141,20 @@ class _IngestPipeline:
     executables are reused exactly as on the serial path."""
 
     def __init__(self, model: "SentenceEmbedderModel", depth: int, queue_bound: int):
+        from pathway_tpu.engine import chaos
+        from pathway_tpu.internals.config import pathway_config
+
         self._model = model
         # tags this pipeline's batch spans in the global trace ring
         self._trace_tag = f"embed:{id(model):x}"
+        # fault tolerance, read once: with PATHWAY_TPU_SERVE_RESTARTS > 0
+        # a transient h2d/dispatch failure is retried (bounded, backoff)
+        # before it surfaces at resolve time
+        self._chaos_h2d = chaos.site("embed.h2d")
+        self._retries = (
+            int(pathway_config.serve_retries)
+            if int(pathway_config.serve_restarts) > 0 else 0
+        )
         self._dispatch = StageWorker(
             self._dispatch_one, maxsize=depth, name="pathway-tpu:embed-dispatch"
         )
@@ -181,40 +192,57 @@ class _IngestPipeline:
         self._dispatch.submit((ids, mask, len(texts), handle))
 
     def _dispatch_one(self, item) -> None:
-        from pathway_tpu.internals.config import pathway_config
-
         ids, mask, n, handle = item
         try:
-            model = self._model
-            fused = pathway_config.fused_h2d
-            t0 = time.perf_counter()
-            if fused:
-                # one contiguous transfer instead of two (ids and mask are
-                # both int32, so the stack is a cheap host-side copy)
-                dev_packed = jax.device_put(np.stack((ids, mask)))
+            if self._retries > 0:
+                from pathway_tpu.internals.udfs.retries import (
+                    ExponentialBackoffRetryStrategy,
+                )
+
+                ExponentialBackoffRetryStrategy(
+                    max_retries=self._retries, initial_delay=20,
+                    backoff_factor=2, jitter_ms=10, max_delay_ms=1000,
+                ).invoke_sync(
+                    lambda: self._stage_and_dispatch(ids, mask, n, handle)
+                )
             else:
-                dev_ids = jax.device_put(ids)
-                dev_mask = jax.device_put(mask)
-            t1 = time.perf_counter()
-            record_stage("h2d", t1 - t0)
-            handle.span.event("h2d")
-            if fused:
-                out = _embed_fn_packed(model.params, dev_packed, model.cfg)
-            else:
-                out = _embed_fn_donated(model.params, dev_ids, dev_mask, model.cfg)
-            record_device_dispatch("embed_dispatch")
-            out = out.astype(jnp.float16)
-            try:
-                out.copy_to_host_async()
-            except Exception:  # noqa: BLE001 - platform-optional fast path
-                pass
-            record_stage("dispatch", time.perf_counter() - t1)
-            handle.span.event("dispatch", rows=n)
-            handle._value = (out, n)
+                self._stage_and_dispatch(ids, mask, n, handle)
         except BaseException as exc:  # noqa: BLE001 - surfaces at resolve
             handle._error = exc
             handle.span.finish(error=True)
         handle._event.set()
+
+    def _stage_and_dispatch(self, ids, mask, n, handle) -> None:
+        from pathway_tpu.internals.config import pathway_config
+
+        if self._chaos_h2d is not None:
+            self._chaos_h2d.maybe_fail()
+        model = self._model
+        fused = pathway_config.fused_h2d
+        t0 = time.perf_counter()
+        if fused:
+            # one contiguous transfer instead of two (ids and mask are
+            # both int32, so the stack is a cheap host-side copy)
+            dev_packed = jax.device_put(np.stack((ids, mask)))
+        else:
+            dev_ids = jax.device_put(ids)
+            dev_mask = jax.device_put(mask)
+        t1 = time.perf_counter()
+        record_stage("h2d", t1 - t0)
+        handle.span.event("h2d")
+        if fused:
+            out = _embed_fn_packed(model.params, dev_packed, model.cfg)
+        else:
+            out = _embed_fn_donated(model.params, dev_ids, dev_mask, model.cfg)
+        record_device_dispatch("embed_dispatch")
+        out = out.astype(jnp.float16)
+        try:
+            out.copy_to_host_async()
+        except Exception:  # noqa: BLE001 - platform-optional fast path
+            pass
+        record_stage("dispatch", time.perf_counter() - t1)
+        handle.span.event("dispatch", rows=n)
+        handle._value = (out, n)
 
     def close(self) -> None:
         self._tokenize.close()

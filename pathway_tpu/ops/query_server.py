@@ -72,6 +72,8 @@ class QueryRequest:
     _queue="_cond", _stop="_cond", failed="_cond",
     _ticks="_stats_lock", _dispatches="_stats_lock",
     _requests="_stats_lock", _batch_hist="_stats_lock",
+    _restarts="_stats_lock", _group_failures="_stats_lock",
+    _leaked_thread="_stats_lock",
 )
 class QueryServer:
     """Coalesces concurrent retrieve / retrieve-rerank requests into
@@ -94,6 +96,17 @@ class QueryServer:
         self._dispatches = 0
         self._requests = 0
         self._batch_hist: dict[int, int] = {}
+        self._restarts = 0
+        self._group_failures = 0
+        self._leaked_thread = 0
+        # fault-tolerance knobs, read once (kill switches): budget == 0
+        # keeps the historical latch-on-first-error behavior exactly
+        from pathway_tpu.engine import chaos
+
+        self._restart_budget = int(cfg.serve_restarts)
+        self._supervised = self._restart_budget > 0
+        self._restarts_left = self._restart_budget
+        self._chaos_tick = chaos.site("query.tick")
         # tags this server's request spans in the global trace ring
         self._trace_tag = f"query:{id(self):x}"
         self._thread = threading.Thread(
@@ -183,6 +196,26 @@ class QueryServer:
                     req.finished_at = now
                     req.span.finish(error=True)
                     req.done.set()
+                if self._supervised and self._restarts_left > 0:
+                    # supervised restart: the crashed tick's batch failed
+                    # above, but queued/future requests keep being served
+                    # until the budget runs out — then latch as before
+                    self._restarts_left -= 1
+                    from pathway_tpu.engine import probes
+                    from pathway_tpu.internals.errors import (
+                        get_global_error_log,
+                    )
+
+                    get_global_error_log().log(
+                        f"query server tick crashed "
+                        f"({type(exc).__name__}: {exc}); supervised restart"
+                    )
+                    probes.REGISTRY.counter_add(
+                        "serve_restarts", server=self._trace_tag
+                    )
+                    with self._stats_lock:
+                        self._restarts += 1
+                    continue
                 with self._cond:
                     self.failed = exc
                     self._stop = True
@@ -204,12 +237,36 @@ class QueryServer:
         for req in batch:
             req.span.event("admit", batch=len(batch))
             groups.setdefault((req.kind, req.k), []).append(req)
+        failed_groups = 0
         for (kind, k), reqs in groups.items():
-            texts = [r.text for r in reqs]
-            if kind == "rerank":
-                results = self._pipe.retrieve_rerank_batch(texts, k)
-            else:
-                results = self._pipe.retrieve(texts, k)
+            try:
+                if self._chaos_tick is not None:
+                    self._chaos_tick.maybe_fail()
+                texts = [r.text for r in reqs]
+                if kind == "rerank":
+                    results = self._pipe.retrieve_rerank_batch(texts, k)
+                else:
+                    results = self._pipe.retrieve(texts, k)
+            except BaseException as exc:  # noqa: BLE001 - group isolation
+                if not self._supervised:
+                    raise
+                # group-scoped isolation: only THIS (kind, k) group's
+                # requests fail; sibling groups in the same tick — and
+                # everything queued — keep serving
+                from pathway_tpu.engine import probes
+
+                now = time.monotonic()
+                for req in reqs:
+                    req.error = exc
+                    req.finished_at = now
+                    req.span.finish(error=True)
+                    req.done.set()
+                probes.REGISTRY.counter_add(
+                    "requests_isolated", float(len(reqs)),
+                    outcome="failed",
+                )
+                failed_groups += 1
+                continue
             now = time.monotonic()
             for req, res in zip(reqs, results):
                 req.result = res
@@ -221,6 +278,7 @@ class QueryServer:
             self._ticks += 1
             self._dispatches += len(groups)
             self._requests += len(batch)
+            self._group_failures += failed_groups
             n = len(batch)
             self._batch_hist[n] = self._batch_hist.get(n, 0) + 1
 
@@ -238,6 +296,9 @@ class QueryServer:
                 "batch_hist": dict(sorted(self._batch_hist.items())),
                 "mean_batch": round(reqs / ticks, 3) if ticks else 0.0,
                 "failed": failed,
+                "restarts": self._restarts,
+                "group_failures": self._group_failures,
+                "leaked_thread": self._leaked_thread,
             }
 
     def shutdown(self, timeout: float = 10.0) -> None:
@@ -245,6 +306,15 @@ class QueryServer:
             self._stop = True
             self._cond.notify_all()
         self._thread.join(timeout)
+        if self._thread.is_alive():
+            from pathway_tpu.internals.errors import get_global_error_log
+
+            with self._stats_lock:
+                self._leaked_thread += 1
+            get_global_error_log().log(
+                f"query server thread still alive {timeout}s after "
+                f"shutdown join"
+            )
         with self._cond:
             pending = list(self._queue)
             self._queue.clear()

@@ -38,10 +38,13 @@ class SnapshotLogWriter:
         worker_id: int = 0,
         flush_every_rows: int = 100_000,
     ):
+        from pathway_tpu.engine import chaos
+
         self.backend = backend
         self.persistent_id = persistent_id
         self.worker_id = worker_id
         self.flush_every_rows = flush_every_rows
+        self._chaos_put = chaos.site("persist.put")
         existing = backend.list_prefix(f"streams/{persistent_id}/{worker_id}/")
         self._seq = (
             max(int(k.rsplit("/", 1)[1]) for k in existing) + 1 if existing else 0
@@ -66,6 +69,10 @@ class SnapshotLogWriter:
             "time": time,
             "offset": offset,
         }
+        if self._chaos_put is not None:
+            # raise BEFORE the put: the buffered rows stay queued for the
+            # next flush, matching a real backend write failure
+            self._chaos_put.maybe_fail()
         self.backend.put_value(
             _chunk_key(self.persistent_id, self.worker_id, self._seq),
             pickle.dumps(chunk, protocol=pickle.HIGHEST_PROTOCOL),
@@ -121,8 +128,25 @@ class SnapshotLogReader:
             if cut:
                 stale.append(key)
                 continue
-            chunk = pickle.loads(self.backend.get_value(key))
-            t = chunk.get("time")
+            try:
+                chunk = pickle.loads(self.backend.get_value(key))
+                t = chunk.get("time")
+            except Exception as exc:  # noqa: BLE001 - torn trailing chunk
+                # a crash mid-put can leave a truncated/corrupt chunk as
+                # the log's tail; its rows are re-read via the stored
+                # reader offset (which predates it), so cut HERE — keep
+                # everything already consolidated, mark the rest stale
+                from pathway_tpu.internals.errors import get_global_error_log
+
+                get_global_error_log().log(
+                    f"snapshot replay: skipping torn chunk {key} "
+                    f"({type(exc).__name__}: {exc})"
+                )
+                cut = True
+                stale.extend(k for k, _ in pending)
+                pending = []
+                stale.append(key)
+                continue
             if t is None:
                 pending.append((key, chunk))
                 continue

@@ -40,6 +40,38 @@ def _prep_message_log(messages: list[dict], verbose: bool) -> str:
     ])
 
 
+# Serving failures travel the string-typed response channel as a
+# reserved-prefix marker (the \x00 prefix cannot appear in decoded
+# model output): the continuous server's resolve encodes WHY a request
+# failed or was shed, and the REST layer (``xpacks/llm/servers.py``
+# ``map_serving_errors``) decodes it into a structured JSON 500/503
+# instead of the opaque null body it used to be.
+SERVE_ERROR_MARKER = "\x00pathway_tpu:serve_error\x00"
+
+
+def encode_serve_error(reason: str,
+                       retry_after: float | None = None) -> str:
+    import json as json_mod
+
+    payload: dict = {"reason": reason}
+    if retry_after is not None:
+        payload["retry_after"] = retry_after
+    return SERVE_ERROR_MARKER + json_mod.dumps(payload)
+
+
+def decode_serve_error(text: Any) -> dict | None:
+    """The structured error a serving response string carries, or None
+    for ordinary responses."""
+    import json as json_mod
+
+    if not isinstance(text, str) or not text.startswith(SERVE_ERROR_MARKER):
+        return None
+    try:
+        return json_mod.loads(text[len(SERVE_ERROR_MARKER):])
+    except ValueError:
+        return {"reason": "serve_failed"}
+
+
 class BaseChat(pw.UDF):
     """Base chat UDF (reference ``BaseChat``, llms.py:27)."""
 
@@ -357,6 +389,7 @@ class TPUDecoderChat(BaseChat):
         if self._server is None:
             raise TypeError("submit_batch requires continuous=True")
         max_new = int(kwargs.pop("max_new_tokens", self.max_new_tokens))
+        priority = int(kwargs.pop("priority", 1))
         if kwargs:
             # sampling params are compiled into the serving loop; per-call
             # overrides would silently apply to OTHER rows' chunks
@@ -384,7 +417,7 @@ class TPUDecoderChat(BaseChat):
         reqs = []
         for m in messages:
             ids = self.tokenizer.encode(self._format_prompt(m))[-prompt_cap:]
-            reqs.append(self._server.submit(ids, max_new))
+            reqs.append(self._server.submit(ids, max_new, priority=priority))
         return reqs
 
     def _resolve_batch_continuous(self, handles) -> list:
@@ -393,7 +426,15 @@ class TPUDecoderChat(BaseChat):
             texts = []
             for req in reqs:
                 req.done.wait()
-                texts.append(req.text)
+                if req.text is None:
+                    # failed or shed: surface the structured reason
+                    # through the string channel instead of a bare null
+                    texts.append(encode_serve_error(
+                        req.error_reason or "serve_failed",
+                        retry_after=req.retry_after,
+                    ))
+                else:
+                    texts.append(req.text)
             out.append(texts)
         return out
 
@@ -511,7 +552,8 @@ class _PendingCompletion:
     """One in-flight continuous-batching request (host-side slot record)."""
 
     __slots__ = ("ids", "max_new", "tokens", "done", "text", "finished_at",
-                 "first_token_at", "span")
+                 "first_token_at", "span", "retries", "error_reason",
+                 "retry_after", "deadline", "priority")
 
     def __init__(self, ids: list, max_new: int):
         import threading
@@ -526,6 +568,16 @@ class _PendingCompletion:
         self.finished_at: float | None = None  # time.perf_counter()
         self.first_token_at: float | None = None  # first token DRAINED
         self.span = tracing.NULL_SPAN  # replaced by submit()
+        # fault-tolerance bookkeeping: isolation/restart retry count, the
+        # structured failure reason behind a text=None sentinel (resolve
+        # encodes it via encode_serve_error), the shed Retry-After hint,
+        # the absolute perf_counter deadline, and the admission priority
+        # class (level-3 degradation sheds priority <= 0)
+        self.retries = 0
+        self.error_reason: str | None = None
+        self.retry_after: float | None = None
+        self.deadline: float | None = None
+        self.priority = 1
 
 
 @guarded_by(queue="lock", free="lock")
@@ -765,12 +817,7 @@ class _ContinuousServer:
         self._last_dispatch_t: float | None = None
         self._last_dispatch_steps = 0
         self._D = decoder_mod
-        self.pool = decoder_mod.pool_init(
-            params, cfg, n_slots, self.cache_len,
-            arena_blocks=(self.prefix.capacity_blocks if self.prefix else 0),
-            arena_block=self.prefix_block,
-            kv_quant=bool(self.kv_quant),
-        )
+        self.pool = self._build_pool()
         self.kv_bytes_saved = 0
         if self.kv_quant:
             # ledger the HBM the int8 pool did NOT allocate vs the same
@@ -826,6 +873,29 @@ class _ContinuousServer:
         self.wake = threading.Event()
         self._stop = False
         self.failed: BaseException | None = None
+        # fault tolerance (all flags read ONCE here, so the serving hot
+        # path never touches the environment): supervision gates both
+        # per-request isolation and bounded loop restarts; deadlines and
+        # the queue watermark shed instead of blocking; the degradation
+        # ladder follows the SLO watchdog's alert state. Every default
+        # keeps the pre-supervision behavior byte-identical
+        # (tests/test_chaos.py pins it).
+        self._restart_budget = int(pathway_config.serve_restarts)
+        self._supervised = self._restart_budget > 0
+        self._retry_budget = int(pathway_config.serve_retries)
+        self._deadline_s = float(pathway_config.request_deadline_ms) / 1e3
+        self._queue_bound = int(pathway_config.serve_queue)
+        self._default_max_new = int(default_max_new)
+        self._degradation_level = 0
+        self._degrade = None
+        if pathway_config.degradation:
+            from pathway_tpu.engine import slo as slo_mod
+
+            self._degrade = slo_mod.get_degradation_controller()
+        from pathway_tpu.engine import chaos as chaos_mod
+
+        self._chaos_admit = chaos_mod.site("decode.admit")
+        self._chaos_dispatch = chaos_mod.site("decode.dispatch")
         self.stats = {
             "chunks": 0, "admitted": 0, "steps": 0,
             "slot_steps_total": 0, "prefill_chunks": 0,
@@ -834,6 +904,8 @@ class _ContinuousServer:
             "prefix_requests": 0, "spec_dispatches": 0,
             "spec_cycles": 0, "spec_drafted": 0, "spec_accepted": 0,
             "spec_emitted": 0, "spec_verify_steps": 0,
+            "restarts": 0, "request_failures": 0, "request_retries": 0,
+            "shed": 0, "leaked_thread": 0,
         }
         # in-flight chunk records, oldest first; an attribute (not a loop
         # local) so the failure sweep can fail eagerly-freed requests
@@ -854,9 +926,158 @@ class _ContinuousServer:
 
         return tracing.recent_traces(server=self._trace_tag, n=n)
 
+    def _build_pool(self):
+        """A fresh ``pool_init`` state sized for this server — used at
+        construction and again by the supervised restart path (a crash
+        mid-dispatch may have invalidated the donated pool buffers)."""
+        return self._D.pool_init(
+            self.params, self.cfg, self.n_slots, self.cache_len,
+            arena_blocks=(self.prefix.capacity_blocks if self.prefix else 0),
+            arena_block=self.prefix_block,
+            kv_quant=bool(self.kv_quant),
+        )
+
+    def _recover_after_crash(self, exc: BaseException) -> None:
+        """Reset the server to an admittable state after a loop-scoped
+        crash: rebuild the device pool, clear the host slot/prefill/
+        in-flight bookkeeping, drop the (now-unbacked) prefix tree, and
+        re-queue every interrupted request within its retry budget."""
+        from pathway_tpu.engine import probes
+        from pathway_tpu.internals.errors import get_global_error_log
+
+        get_global_error_log().log(
+            f"decoder serving loop crashed "
+            f"({type(exc).__name__}: {exc}); supervised restart"
+        )
+        probes.REGISTRY.counter_add(
+            "serve_restarts", server=self._trace_tag
+        )
+        victims: list = []
+        with self.lock:
+            for rec in list(self._inflight):
+                victims.extend(r for r in rec[2] if r is not None)
+            self._inflight.clear()
+            victims.extend(r for r in self.slots if r is not None)
+            for i in range(self.n_slots):
+                self.slots[i] = None
+            self.free = list(range(self.n_slots))
+            self.stats["restarts"] += 1
+        self._pending_prefill.clear()
+        self._sent = [0] * self.n_slots
+        self.pool = self._build_pool()
+        # the rebuilt pool's prefix arena is empty: reset the host radix
+        # tree to match (prefix_reset also drops the per-request pins)
+        self.prefix_reset()
+        seen: set[int] = set()
+        requeue: list = []
+        for req in victims:
+            if id(req) in seen or req.done.is_set():
+                continue
+            seen.add(id(req))
+            req.retries += 1
+            if req.retries <= self._retry_budget:
+                # restart re-decodes from the prompt: drop partial output
+                req.tokens = []
+                req.first_token_at = None
+                req.span.event("restart_requeue", attempt=req.retries)
+                probes.REGISTRY.counter_add(
+                    "requests_isolated", outcome="retried"
+                )
+                with self.lock:
+                    self.stats["request_retries"] += 1
+                requeue.append(req)
+            else:
+                self._fail_request(req, "failed")
+        with self.lock:
+            for req in reversed(requeue):
+                self.queue.appendleft(req)
+
+    def _fail_request(self, req, reason: str) -> None:
+        """Terminal failure of ONE request (server keeps serving): the
+        text=None sentinel plus a structured reason for the REST layer."""
+        from pathway_tpu.engine import probes
+
+        req.error_reason = reason
+        req.text = None
+        probes.REGISTRY.counter_add(
+            "requests_isolated", outcome="failed"
+        )
+        with self.lock:
+            self.stats["request_failures"] += 1
+        req.span.finish(error=True, tokens=len(req.tokens))
+        req.done.set()
+
+    def _shed_request(self, req, reason: str) -> None:
+        """Admission-control shed (deadline / queue_full / degraded):
+        terminal, structured, and counted — REST maps it to 503 +
+        Retry-After."""
+        from pathway_tpu.engine import probes
+
+        req.error_reason = f"shed:{reason}"
+        req.retry_after = 1.0
+        req.text = None
+        probes.REGISTRY.counter_add("requests_shed", reason=reason)
+        with self.lock:
+            self.stats["shed"] += 1
+        req.span.finish(error=True, tokens=len(req.tokens))
+        req.done.set()
+
+    def _isolate_admission_failure(self, slot: int, req, exc: Exception,
+                                   active=None) -> None:
+        """Rewind ONE request's admission — slot record, pending prefill
+        pieces, prefix pins, lane mask — and re-queue it within its
+        retry budget; past the budget it fails alone. The rest of the
+        pool keeps serving."""
+        from pathway_tpu.internals.errors import get_global_error_log
+
+        self.slots[slot] = None
+        self._pending_prefill.pop(slot, None)
+        if active is not None:
+            active[slot] = False
+        self._prefix_release(req)
+        with self.lock:
+            self.free.append(int(slot))
+        req.retries += 1
+        if req.retries <= self._retry_budget:
+            from pathway_tpu.engine import probes
+
+            req.span.event("retry", error=type(exc).__name__)
+            probes.REGISTRY.counter_add(
+                "requests_isolated", outcome="retried"
+            )
+            with self.lock:
+                self.stats["request_retries"] += 1
+                self.queue.appendleft(req)
+        else:
+            get_global_error_log().log(
+                f"request failed after {req.retries - 1} retries: "
+                f"{type(exc).__name__}: {exc}"
+            )
+            self._fail_request(req, "failed")
+
     def _run_safe(self):
         try:
-            self._loop()
+            if self._restart_budget > 0:
+                # supervised: a crashed loop recovers and re-enters with
+                # exponential backoff, up to the restart budget — then
+                # (and only then) the failure latches as before
+                from pathway_tpu.internals.udfs.retries import (
+                    ExponentialBackoffRetryStrategy,
+                )
+
+                def cycle():
+                    try:
+                        self._loop()
+                    except Exception as exc:
+                        self._recover_after_crash(exc)
+                        raise
+
+                ExponentialBackoffRetryStrategy(
+                    max_retries=self._restart_budget, initial_delay=20,
+                    backoff_factor=2, jitter_ms=10, max_delay_ms=2000,
+                ).invoke_sync(cycle)
+            else:
+                self._loop()
         except BaseException as exc:  # noqa: BLE001 - never hang waiters
             self.failed = exc
             from pathway_tpu.internals.errors import get_global_error_log
@@ -882,17 +1103,23 @@ class _ContinuousServer:
                     req.span.finish(error=True, tokens=len(req.tokens))
                     req.done.set()
 
-    def submit(self, prompt_ids: list, max_new: int) -> _PendingCompletion:
+    def submit(self, prompt_ids: list, max_new: int, *,
+               priority: int = 1) -> _PendingCompletion:
         import time as time_mod
 
         from pathway_tpu.engine import tracing
 
         req = _PendingCompletion(prompt_ids, max_new)
+        req.priority = int(priority)
         req.span = tracing.start_span(
             "decode", server=self._trace_tag,
             prompt_tokens=len(prompt_ids), max_new=max_new,
         )
         now = time_mod.perf_counter()
+        if self._deadline_s > 0:
+            # monotonic, matching the loop's queue sweep clock
+            req.deadline = time_mod.monotonic() + self._deadline_s
+        shed_reason = None
         with self.lock:
             # checked under the lock: _run_safe drains the queue under it,
             # so a dead server can never strand a late submit
@@ -902,15 +1129,27 @@ class _ContinuousServer:
                 )
             if self._stop:
                 raise RuntimeError("decoder serving loop is shut down")
-            self.queue.append(req)
-            # observed arrival rate feeds the chunk-steps autotuner
-            if self._last_submit_t is not None:
-                gap = now - self._last_submit_t
-                self._arrival_ema = (
-                    gap if self._arrival_ema is None
-                    else 0.8 * self._arrival_ema + 0.2 * gap
-                )
-            self._last_submit_t = now
+            if (self._queue_bound > 0
+                    and len(self.queue) >= self._queue_bound):
+                # over the watermark: shed NOW instead of blocking the
+                # submitter or growing the queue past what deadlines
+                # could ever drain
+                shed_reason = "queue_full"
+            elif self._degradation_level >= 3 and req.priority <= 0:
+                shed_reason = "degraded"
+            else:
+                self.queue.append(req)
+                # observed arrival rate feeds the chunk-steps autotuner
+                if self._last_submit_t is not None:
+                    gap = now - self._last_submit_t
+                    self._arrival_ema = (
+                        gap if self._arrival_ema is None
+                        else 0.8 * self._arrival_ema + 0.2 * gap
+                    )
+                self._last_submit_t = now
+        if shed_reason is not None:
+            self._shed_request(req, shed_reason)
+            return req
         self.wake.set()
         return req
 
@@ -1125,6 +1364,161 @@ class _ContinuousServer:
                   "prefix_hit_requests", "prefix_requests"):
             self.stats[k] = 0
 
+    def _admit_one(self, slot: int, req, direct: list,
+                   direct_inserts: list) -> None:
+        """Admission host work for ONE request — prefix match, cached
+        seeding, prompt padding, prefill scheduling. A method (not loop
+        body) so supervised serving can isolate a request-scoped fault
+        here to this request alone."""
+        import numpy as np
+
+        from pathway_tpu.engine.probes import record_prefix
+        from pathway_tpu.ops import next_pow2
+
+        e = req.ids[-self.max_prompt_bucket:]
+        n = len(e)
+        req.span.event("admit", slot=int(slot))
+        if self._degradation_level >= 1:
+            # ladder level 1+: clamp the answer budget so slots recycle
+            # sooner while the SLO alert is firing
+            req.max_new = min(
+                req.max_new, max(1, self._default_max_new // 2)
+            )
+        B = self.prefix_block
+        # prefix-cache accounting + match. A hit never reuses the
+        # prompt's FINAL (partial or last-full) block: at least
+        # one suffix token must run through pool_prefill_chunk to
+        # produce the first-token logits.
+        m_hit, arena_ids, node = 0, [], None
+        if self.prefix is not None and n > B:
+            m, arena_ids, node = self.prefix.match(e)
+            m_hit = min(m, (n - 1) // B)
+            hit_t = m_hit * B
+            record_prefix("requests", 1)
+            record_prefix("hit_tokens", hit_t)
+            record_prefix("miss_tokens", n - hit_t)
+            if m_hit:
+                record_prefix("hit_requests", 1)
+                self.stats["prefix_hit_requests"] += 1
+            self.stats["prefix_requests"] += 1
+            self.stats["prefix_hit_tokens"] += hit_t
+            self.stats["prefix_miss_tokens"] += n - hit_t
+            req.span.event(
+                "prefix_match", hit_blocks=int(m_hit),
+                hit_tokens=int(hit_t), miss_tokens=int(n - hit_t),
+            )
+        if m_hit >= 1:
+            # cache hit: pin the matched path, seed the slot's
+            # cache columns [0, m_hit*B) straight from the arena
+            # (one copy dispatch, no compute), then prefill only
+            # the suffix — RIGHT-padded, so token i sits at cache
+            # column i exactly like the arena blocks expect.
+            self.prefix.acquire(node)
+            self._prefix_nodes[req] = node
+            self.pool = self._admit_cached_fn(m_hit)(
+                self.pool, np.int32(slot),
+                np.asarray(arena_ids[:m_hit], np.int32),
+            )
+            n_cached = m_hit * B
+            P = self.prefill_chunk
+            W = n_cached + -((n_cached - n) // P) * P
+            r_ids = np.zeros((1, W), np.int32)
+            r_mask = np.zeros((1, W), np.int32)
+            r_ids[0, :n] = e
+            r_mask[0, :n] = 1
+            pos = np.minimum(
+                np.arange(W), n - 1
+            )[None, :].astype(np.int32)
+            n_prompt = np.asarray([n], np.int32)
+            pieces = [
+                (r_ids[:, o:o + P], r_mask[:, o:o + P],
+                 pos[:, o:o + P], o)
+                for o in range(n_cached, W, P)
+            ]
+            # the final piece may end on pad columns: the real
+            # last token's in-piece column rides along traced
+            # (None when it IS the final column — static path)
+            lc = (n - 1) - (W - P)
+            meta = {
+                "last_col": None if lc == P - 1 else lc,
+                "insert": (req, e, 0),
+            }
+            self._pending_prefill[slot] = (pieces, n_prompt, meta)
+            self.stats["admitted"] += 1
+            return
+        ins = (
+            (req, e, 0) if self.prefix is not None and n >= B
+            else None
+        )
+        s = max(8, next_pow2(max(len(e), 1), 8))
+        ids = np.zeros((1, s), np.int32)
+        mask = np.zeros((1, s), np.int32)
+        if e:
+            ids[0, s - len(e):] = e
+            mask[0, s - len(e):] = 1
+        else:
+            mask[0, -1] = 1
+        if ins is not None:
+            # left-padded admission: token 0 sits at column s-n
+            ins = (req, e, s - n)
+        if self.chunked_prefill and s > self.prefill_chunk:
+            # split into fixed-size pieces, dispatched ONE per
+            # loop tick below — the active lanes keep decoding
+            # between pieces instead of stalling for the whole
+            # prompt's prefill
+            pos = np.clip(
+                np.cumsum(mask[0]) - 1, 0, None
+            )[None, :].astype(np.int32)
+            n_prompt = np.asarray([int(mask.sum())], np.int32)
+            P = self.prefill_chunk
+            pieces = [
+                (ids[:, o:o + P], mask[:, o:o + P], pos[:, o:o + P], o)
+                for o in range(0, s, P)
+            ]
+            meta = {"insert": ins} if ins is not None else None
+            self._pending_prefill[slot] = (pieces, n_prompt, meta)
+        else:
+            direct.append((slot, ids, mask, s))
+            if ins is not None:
+                direct_inserts.append((slot, ins))
+        self.stats["admitted"] += 1
+
+    def _prefill_piece(self, slot: int, active) -> None:
+        """Dispatch one pending prefill piece for ``slot`` (a method so
+        supervised serving can rewind just this slot on a fault)."""
+        import numpy as np
+
+        pieces, n_prompt, meta = self._pending_prefill[slot]
+        p_ids, p_mask, p_pos, off = pieces.pop(0)
+        first, last = off == 0, not pieces
+        lc = meta.get("last_col") if (meta and last) else None
+        if lc is None:
+            self.pool = self._prefill_fn(p_ids.shape[1], first, last)(
+                self.params, p_ids, p_mask, p_pos, self.pool,
+                np.int32(slot), np.int32(off), n_prompt,
+            )
+        else:
+            self.pool = self._prefill_fn(
+                p_ids.shape[1], first, last, True
+            )(
+                self.params, p_ids, p_mask, p_pos, self.pool,
+                np.int32(slot), np.int32(off), n_prompt,
+                np.int32(lc),
+            )
+        self.stats["prefill_chunks"] += 1
+        req_p = self.slots[slot]
+        if req_p is not None:
+            req_p.span.event(
+                "prefill_chunk", offset=int(off),
+                width=int(p_ids.shape[1]), last=bool(last),
+            )
+        if last:
+            del self._pending_prefill[slot]
+            active[slot] = True
+            if meta and meta.get("insert") is not None:
+                req_i, e_i, base_i = meta["insert"]
+                self._prefix_insert(slot, req_i, e_i, base_i)
+
     def _loop(self):
         import time as time_mod
 
@@ -1132,12 +1526,7 @@ class _ContinuousServer:
         import numpy as np
 
         from pathway_tpu.engine import probes
-        from pathway_tpu.engine.probes import (
-            record_prefix,
-            record_spec,
-            record_spec_many,
-        )
-        from pathway_tpu.ops import next_pow2
+        from pathway_tpu.engine.probes import record_spec, record_spec_many
 
         active = np.zeros(self.n_slots, dtype=bool)
         inflight = self._inflight
@@ -1146,6 +1535,10 @@ class _ContinuousServer:
             """One decode chunk over the active lanes; False if none."""
             if not active.any():
                 return False
+            if self._chaos_dispatch is not None:
+                # loop-scoped fault: every in-flight lane is affected, so
+                # recovery is a supervised restart, not per-request
+                self._chaos_dispatch.maybe_fail()
             with self.lock:
                 qlen = len(self.queue)
             steps = self._pick_steps(qlen)
@@ -1161,7 +1554,8 @@ class _ContinuousServer:
                 )
             self._last_dispatch_t = now
             self._ticks += 1
-            if self.spec_decode and not self._spec_off:
+            if (self.spec_decode and not self._spec_off
+                    and self._degradation_level < 2):
                 # speculative path: a chunk of `steps` plain lane-steps
                 # becomes n_cycles draft/verify/accept cycles — each
                 # cycle costs ~one full-model stream (the verify) and
@@ -1287,10 +1681,36 @@ class _ContinuousServer:
             # delaying it. Newcomers join the next chunk — they waited one
             # chunk boundary either way; the chunk just starts earlier.
             dispatched = self.prefill_overlap and dispatch_decode()
+            if self._degrade is not None:
+                # one rate-limited watchdog read per tick; levels are
+                # consumed below (clamp / spec gate / shed)
+                self._degradation_level = self._degrade.maybe_evaluate()
             admissions = []
+            shed: list = []
             with self.lock:
+                if self._deadline_s > 0.0 and self.queue:
+                    # sweep requests whose deadline lapsed while queued:
+                    # running them now wastes device time on an answer
+                    # the caller already gave up on
+                    now_d = time_mod.monotonic()
+                    kept = []
+                    for r in self.queue:
+                        if r.deadline is not None and r.deadline <= now_d:
+                            shed.append((r, "deadline"))
+                        else:
+                            kept.append(r)
+                    if shed:
+                        self.queue.clear()
+                        self.queue.extend(kept)
                 while self.queue and self.free:
-                    admissions.append((self.free.pop(), self.queue.popleft()))
+                    req = self.queue.popleft()
+                    if (self._degradation_level >= 3
+                            and req.priority <= 0):
+                        shed.append((req, "degraded"))
+                        continue
+                    admissions.append((self.free.pop(), req))
+            for req, reason in shed:
+                self._shed_request(req, reason)
             direct = []
             direct_inserts = []
             for slot, req in admissions:
@@ -1299,107 +1719,17 @@ class _ContinuousServer:
                 # request instead of stranding its waiter
                 self.slots[slot] = req
                 self._sent[slot] = 0
-                e = req.ids[-self.max_prompt_bucket:]
-                n = len(e)
-                req.span.event("admit", slot=int(slot))
-                B = self.prefix_block
-                # prefix-cache accounting + match. A hit never reuses the
-                # prompt's FINAL (partial or last-full) block: at least
-                # one suffix token must run through pool_prefill_chunk to
-                # produce the first-token logits.
-                m_hit, arena_ids, node = 0, [], None
-                if self.prefix is not None and n > B:
-                    m, arena_ids, node = self.prefix.match(e)
-                    m_hit = min(m, (n - 1) // B)
-                    hit_t = m_hit * B
-                    record_prefix("requests", 1)
-                    record_prefix("hit_tokens", hit_t)
-                    record_prefix("miss_tokens", n - hit_t)
-                    if m_hit:
-                        record_prefix("hit_requests", 1)
-                        self.stats["prefix_hit_requests"] += 1
-                    self.stats["prefix_requests"] += 1
-                    self.stats["prefix_hit_tokens"] += hit_t
-                    self.stats["prefix_miss_tokens"] += n - hit_t
-                    req.span.event(
-                        "prefix_match", hit_blocks=int(m_hit),
-                        hit_tokens=int(hit_t), miss_tokens=int(n - hit_t),
-                    )
-                if m_hit >= 1:
-                    # cache hit: pin the matched path, seed the slot's
-                    # cache columns [0, m_hit*B) straight from the arena
-                    # (one copy dispatch, no compute), then prefill only
-                    # the suffix — RIGHT-padded, so token i sits at cache
-                    # column i exactly like the arena blocks expect.
-                    self.prefix.acquire(node)
-                    self._prefix_nodes[req] = node
-                    self.pool = self._admit_cached_fn(m_hit)(
-                        self.pool, np.int32(slot),
-                        np.asarray(arena_ids[:m_hit], np.int32),
-                    )
-                    n_cached = m_hit * B
-                    P = self.prefill_chunk
-                    W = n_cached + -((n_cached - n) // P) * P
-                    r_ids = np.zeros((1, W), np.int32)
-                    r_mask = np.zeros((1, W), np.int32)
-                    r_ids[0, :n] = e
-                    r_mask[0, :n] = 1
-                    pos = np.minimum(
-                        np.arange(W), n - 1
-                    )[None, :].astype(np.int32)
-                    n_prompt = np.asarray([n], np.int32)
-                    pieces = [
-                        (r_ids[:, o:o + P], r_mask[:, o:o + P],
-                         pos[:, o:o + P], o)
-                        for o in range(n_cached, W, P)
-                    ]
-                    # the final piece may end on pad columns: the real
-                    # last token's in-piece column rides along traced
-                    # (None when it IS the final column — static path)
-                    lc = (n - 1) - (W - P)
-                    meta = {
-                        "last_col": None if lc == P - 1 else lc,
-                        "insert": (req, e, 0),
-                    }
-                    self._pending_prefill[slot] = (pieces, n_prompt, meta)
-                    self.stats["admitted"] += 1
-                    continue
-                ins = (
-                    (req, e, 0) if self.prefix is not None and n >= B
-                    else None
-                )
-                s = max(8, next_pow2(max(len(e), 1), 8))
-                ids = np.zeros((1, s), np.int32)
-                mask = np.zeros((1, s), np.int32)
-                if e:
-                    ids[0, s - len(e):] = e
-                    mask[0, s - len(e):] = 1
-                else:
-                    mask[0, -1] = 1
-                if ins is not None:
-                    # left-padded admission: token 0 sits at column s-n
-                    ins = (req, e, s - n)
-                if self.chunked_prefill and s > self.prefill_chunk:
-                    # split into fixed-size pieces, dispatched ONE per
-                    # loop tick below — the active lanes keep decoding
-                    # between pieces instead of stalling for the whole
-                    # prompt's prefill
-                    pos = np.clip(
-                        np.cumsum(mask[0]) - 1, 0, None
-                    )[None, :].astype(np.int32)
-                    n_prompt = np.asarray([int(mask.sum())], np.int32)
-                    P = self.prefill_chunk
-                    pieces = [
-                        (ids[:, o:o + P], mask[:, o:o + P], pos[:, o:o + P], o)
-                        for o in range(0, s, P)
-                    ]
-                    meta = {"insert": ins} if ins is not None else None
-                    self._pending_prefill[slot] = (pieces, n_prompt, meta)
-                else:
-                    direct.append((slot, ids, mask, s))
-                    if ins is not None:
-                        direct_inserts.append((slot, ins))
-                self.stats["admitted"] += 1
+                try:
+                    if self._chaos_admit is not None:
+                        # request-scoped fault: only this request's host
+                        # bookkeeping is torn, so supervision rewinds the
+                        # one slot instead of restarting the loop
+                        self._chaos_admit.maybe_fail()
+                    self._admit_one(slot, req, direct, direct_inserts)
+                except Exception as exc:  # noqa: BLE001 - isolation gate
+                    if not self._supervised:
+                        raise
+                    self._isolate_admission_failure(slot, req, exc, active)
             admit_direct(direct)
             for slot, _ids_d, mask_d, _s_d in direct:
                 req_d = self.slots[slot]
@@ -1410,36 +1740,15 @@ class _ContinuousServer:
                 # prompt's blocks — publish the new ones into the arena
                 self._prefix_insert(slot, req_i, e_i, base_i)
             for slot in list(self._pending_prefill):
-                pieces, n_prompt, meta = self._pending_prefill[slot]
-                p_ids, p_mask, p_pos, off = pieces.pop(0)
-                first, last = off == 0, not pieces
-                lc = meta.get("last_col") if (meta and last) else None
-                if lc is None:
-                    self.pool = self._prefill_fn(p_ids.shape[1], first, last)(
-                        self.params, p_ids, p_mask, p_pos, self.pool,
-                        np.int32(slot), np.int32(off), n_prompt,
+                try:
+                    self._prefill_piece(slot, active)
+                except Exception as exc:  # noqa: BLE001 - isolation gate
+                    req_p = self.slots[slot]
+                    if not self._supervised or req_p is None:
+                        raise
+                    self._isolate_admission_failure(
+                        slot, req_p, exc, active
                     )
-                else:
-                    self.pool = self._prefill_fn(
-                        p_ids.shape[1], first, last, True
-                    )(
-                        self.params, p_ids, p_mask, p_pos, self.pool,
-                        np.int32(slot), np.int32(off), n_prompt,
-                        np.int32(lc),
-                    )
-                self.stats["prefill_chunks"] += 1
-                req_p = self.slots[slot]
-                if req_p is not None:
-                    req_p.span.event(
-                        "prefill_chunk", offset=int(off),
-                        width=int(p_ids.shape[1]), last=bool(last),
-                    )
-                if last:
-                    del self._pending_prefill[slot]
-                    active[slot] = True
-                    if meta and meta.get("insert") is not None:
-                        req_i, e_i, base_i = meta["insert"]
-                        self._prefix_insert(slot, req_i, e_i, base_i)
             if not dispatched:
                 # legacy ordering (kill switch off) — or the pool was
                 # empty at the top of the tick and admissions just
@@ -1559,14 +1868,26 @@ class _ContinuousServer:
 
             record_spec_many(**acc)
 
-    def shutdown(self):
+    def shutdown(self, timeout: float = 10.0):
         self._stop = True
         self.wake.set()
         t = self.thread
         if t is not None and t.is_alive():
             # join so interpreter teardown never kills the thread mid
             # device call (jax runtime aborts on threads dying inside it)
-            t.join(timeout=10)
+            t.join(timeout=timeout)
+            if t.is_alive():
+                # a leaked serving thread is a wedged device call or a
+                # stuck lock — record it loudly instead of exiting as if
+                # the shutdown were clean
+                from pathway_tpu.internals.errors import get_global_error_log
+
+                with self.lock:
+                    self.stats["leaked_thread"] += 1
+                get_global_error_log().log(
+                    f"serving loop thread {t.name!r} still alive "
+                    f"{timeout}s after shutdown join"
+                )
         # the loop thread is down: every span it will ever write has been
         # written, so drain the flight recorder's buffered JSONL lines
         from pathway_tpu.engine import tracing

@@ -19,6 +19,65 @@ from pathway_tpu.internals.table import Table
 logger = logging.getLogger(__name__)
 
 
+def map_serving_errors(handler: Callable[[Table], Table]) -> Callable[[Table], Table]:
+    """Wrap an endpoint handler so serving failures come back as typed
+    HTTP errors instead of a 200 whose body happens to contain an error.
+
+    The continuous decode server ships per-request failures through the
+    string-typed response channel as a reserved-prefix marker (see
+    ``llms.encode_serve_error``). This wrapper decodes that marker out of
+    the handler's ``result`` column and rewrites the row to the
+    ``_pw_http_error`` envelope the webserver maps to a real status:
+    admission-control sheds (``shed:*`` reasons) become 503 +
+    ``Retry-After``; everything else becomes a structured 500."""
+    from pathway_tpu.internals.json import Json
+    from pathway_tpu.xpacks.llm.llms import decode_serve_error
+
+    def _envelope(err: dict) -> Json:
+        reason = err.get("reason", "serve_failed")
+        shed = reason.startswith("shed:")
+        body: dict = {
+            "status": 503 if shed else 500,
+            "reason": reason,
+            "error": (
+                "request shed by admission control; retry later"
+                if shed else "model serving failed for this request"
+            ),
+        }
+        if err.get("retry_after") is not None:
+            body["retry_after"] = err["retry_after"]
+        elif shed:
+            body["retry_after"] = 1.0
+        return Json({"_pw_http_error": body})
+
+    @pw.udf
+    def _rewrite(result):
+        value = result.value if isinstance(result, Json) else result
+        if isinstance(value, str):
+            err = decode_serve_error(value)
+            if err is not None:
+                return _envelope(err)
+        elif isinstance(value, dict):
+            resp = value.get("response")
+            if isinstance(resp, str):
+                err = decode_serve_error(resp)
+                if err is not None:
+                    return _envelope(err)
+        return result
+
+    def wrapped(queries: Table) -> Table:
+        out = handler(queries)
+        names = list(out.column_names())
+        if "result" not in names:
+            return out
+        return out.select(**{
+            c: (_rewrite(out[c]) if c == "result" else out[c])
+            for c in names
+        })
+
+    return wrapped
+
+
 class BaseRestServer:
     """Route registry over a shared webserver (reference ``BaseRestServer``,
     servers.py:16)."""
@@ -149,11 +208,11 @@ class QARestServer(BaseRestServer):
         super().__init__(host, port, **rest_kwargs)
         self.serve(
             "/v1/pw_ai_answer", rag_question_answerer.AnswerQuerySchema,
-            rag_question_answerer.answer_query,
+            map_serving_errors(rag_question_answerer.answer_query),
         )
         self.serve(
             "/v2/answer", rag_question_answerer.AnswerQuerySchema,
-            rag_question_answerer.answer_query,
+            map_serving_errors(rag_question_answerer.answer_query),
         )
         self.serve(
             "/v1/retrieve", rag_question_answerer.RetrieveQuerySchema,

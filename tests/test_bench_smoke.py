@@ -87,6 +87,9 @@ def test_bench_smoke_schema():
         # registry-sourced latency keys (PR 7): bench re-reads these from
         # the MetricsRegistry histograms, same series /metrics scrapes
         "queue_wait_p50_ms", "tpot_p50_ms", "e2e_p50_ms",
+        # fault-tolerance accounting (PR 10): a clean smoke run reports
+        # zero sheds/restarts and a quiescent degradation ladder
+        "requests_shed", "restarts", "degradation_level",
     ):
         assert srv.get(key) is not None, key
     # span-derived latencies are real measurements off the decode phase
@@ -99,6 +102,11 @@ def test_bench_smoke_schema():
     # the serving headline must come off the product path, not the bare
     # model API
     assert "pw_ai_answer" in srv["measured_path"]
+    # chaos is off in the smoke run, so nothing may shed, restart, or
+    # climb the degradation ladder (the sentinel enforces the same)
+    assert srv["requests_shed"] == 0
+    assert srv["restarts"] == 0
+    assert srv["degradation_level"] == 0
     # the shared-prefix trace actually exercised the KV prefix cache
     assert 0.0 < srv["prefix_hit_rate"] <= 1.0
     assert srv["prefill_tokens_saved"] > 0

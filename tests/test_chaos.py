@@ -1,0 +1,621 @@
+"""Fault-tolerant serving: the deterministic chaos harness, supervised
+restarts with per-request isolation, deadline / queue shedding, the
+SLO-driven degradation ladder, typed REST error mapping and leaked-
+thread detection at shutdown.
+
+Pins the fault-tolerance kill switches: with ``PATHWAY_TPU_CHAOS`` at 0
+and ``PATHWAY_TPU_SERVE_RESTARTS`` at 0 (the defaults) the serving path
+is byte-identical to pre-supervision serving, and enabling
+``PATHWAY_TPU_REQUEST_DEADLINE_MS`` / ``PATHWAY_TPU_SERVE_QUEUE`` /
+``PATHWAY_TPU_DEGRADATION`` with headroom to spare changes nothing.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import chaos, probes, slo
+from pathway_tpu.models import decoder as D
+from tests.utils import ToyCharTokenizer
+
+TINY = D.DecoderConfig(
+    vocab_size=128, hidden=32, layers=2, heads=4, intermediate=64,
+    max_position=128, dtype=jnp.float32,
+)
+
+PROMPTS = [
+    "hello world", "z" * 30, "abc", "continuous batching", "qrs tuv",
+    "slot pool",
+]
+BUDGETS = [4, 6, 2, 6, 3, 5]
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return D.init_params(jax.random.PRNGKey(0), TINY)
+
+
+def _chat(tiny_params, **kw):
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    kw.setdefault("n_slots", 2)
+    return TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(),
+        max_new_tokens=max(BUDGETS), temperature=0.0,
+        max_prompt_tokens=32, continuous=True, chunk_steps=4,
+        pipeline_depth=2, prefill_chunk=8, **kw,
+    )
+
+
+def _serve(tiny_params, prompts=PROMPTS, budgets=BUDGETS, timeout=180.0,
+           **kw):
+    chat = _chat(tiny_params, **kw)
+    try:
+        reqs = [
+            chat.submit_batch([p], max_new_tokens=b)[0]
+            for p, b in zip(prompts, budgets)
+        ]
+        for r in reqs:
+            assert r.done.wait(timeout=timeout), "request hung"
+        return [r.text for r in reqs], dict(chat._server.stats)
+    finally:
+        chat.close()
+
+
+# ------------------------------------------------------------- harness
+
+
+def test_chaos_site_determinism_and_provenance():
+    """Same (seed, name) -> identical fault schedule across runs; the
+    raised fault carries site + operation-sequence provenance."""
+    def schedule(name, seed, rate, n=200):
+        s = chaos.ChaosSite(name, rate, seed)
+        out = []
+        for _ in range(n):
+            try:
+                s.maybe_fail()
+                out.append(0)
+            except chaos.InjectedFault:
+                out.append(1)
+        return out
+
+    a = schedule("decode.dispatch", 7, 0.3)
+    b = schedule("decode.dispatch", 7, 0.3)
+    assert a == b and sum(a) > 0
+    # a different site with the same seed faults on a DIFFERENT schedule
+    assert schedule("embed.h2d", 7, 0.3) != a
+
+    hot = chaos.ChaosSite("persist.put", 1.0, 0)
+    with pytest.raises(chaos.InjectedFault) as ei:
+        hot.maybe_fail()
+    assert ei.value.site == "persist.put" and ei.value.seq == 1
+    with pytest.raises(chaos.InjectedFault) as ei:
+        hot.maybe_fail()
+    assert ei.value.seq == 2
+
+
+def test_chaos_kill_switch_and_site_filter(monkeypatch):
+    """PATHWAY_TPU_CHAOS=0 (default) costs a single None check: site()
+    returns None. PATHWAY_TPU_CHAOS_SITES arms exact names or dotted
+    prefixes only."""
+    assert chaos.site("decode.admit") is None  # default: off
+
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS", "0.5")
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS_SITES", "decode, persist.put")
+    assert chaos.site("decode.admit") is not None   # prefix match
+    assert chaos.site("decode.dispatch") is not None
+    assert chaos.site("persist.put") is not None    # exact match
+    assert chaos.site("embed.h2d") is None          # filtered out
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS_SITES", "")
+    assert chaos.site("embed.h2d") is not None      # empty spec arms all
+
+
+# ------------------------------------- kill-switch byte equality (pin)
+
+
+def test_fault_flags_inert_byte_equality(tiny_params, monkeypatch):
+    """Pinned: supervision + deadlines + queue bound + degradation all
+    ENABLED but unexercised (chaos off, generous limits, healthy SLO)
+    serve byte-identically to the all-defaults path."""
+    base, base_stats = _serve(tiny_params)
+    assert base_stats["shed"] == 0 and base_stats["restarts"] == 0
+
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS", "0")
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_RESTARTS", "2")
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_RETRIES", "3")
+    monkeypatch.setenv("PATHWAY_TPU_REQUEST_DEADLINE_MS", "600000")
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_QUEUE", "64")
+    monkeypatch.setenv("PATHWAY_TPU_DEGRADATION", "1")
+    armed, armed_stats = _serve(tiny_params)
+    assert armed == base
+    assert armed_stats["shed"] == 0 and armed_stats["restarts"] == 0
+
+    monkeypatch.setenv("PATHWAY_TPU_DEGRADATION", "0")
+    off, _ = _serve(tiny_params)
+    assert off == base
+
+
+# --------------------------------------------- per-request isolation
+
+
+def test_single_poisoned_request_fails_alone(tiny_params, monkeypatch):
+    """A request-scoped fault fails exactly one request: the server does
+    not latch, and the next submit completes normally."""
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_RESTARTS", "1")
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_RETRIES", "0")
+    chat = _chat(tiny_params)
+    try:
+        srv = chat._server
+        srv._chaos_admit = chaos.ChaosSite("decode.admit", 1.0, 0)
+        bad = chat.submit_batch(["poisoned"], max_new_tokens=4)[0]
+        assert bad.done.wait(timeout=60)
+        assert bad.text is None and bad.error_reason == "failed"
+        assert srv.failed is None, "request-scoped fault latched server"
+
+        srv._chaos_admit = None
+        good = chat.submit_batch(["healthy"], max_new_tokens=4)[0]
+        assert good.done.wait(timeout=60)
+        assert isinstance(good.text, str)
+        assert srv.stats["request_failures"] == 1
+    finally:
+        chat.close()
+
+
+def test_request_retry_budget_recovers(tiny_params, monkeypatch):
+    """Within PATHWAY_TPU_SERVE_RETRIES a faulted admission re-queues and
+    the request still completes with real text."""
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_RESTARTS", "1")
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_RETRIES", "3")
+    chat = _chat(tiny_params)
+    try:
+        srv = chat._server
+
+        class _FailOnce:
+            def __init__(self):
+                self.calls = 0
+
+            def maybe_fail(self):
+                self.calls += 1
+                if self.calls == 1:
+                    raise chaos.InjectedFault("decode.admit", self.calls)
+
+        srv._chaos_admit = _FailOnce()
+        req = chat.submit_batch(["retry me"], max_new_tokens=4)[0]
+        assert req.done.wait(timeout=60)
+        assert isinstance(req.text, str)
+        assert req.retries == 1
+        assert srv.stats["request_retries"] == 1
+        assert srv.stats["request_failures"] == 0
+    finally:
+        chat.close()
+
+
+# ------------------------------------------------------- chaos grid
+
+
+@pytest.mark.parametrize("rate", [0.0, 0.05])
+@pytest.mark.parametrize("sites", ["decode.admit", "decode.dispatch"])
+def test_chaos_grid_all_requests_terminal(tiny_params, monkeypatch, rate,
+                                          sites):
+    """Chaos bursts over request- and loop-scoped decode sites with
+    supervision on: no hangs, no full-server death, every request
+    reaches a terminal state in bounded time."""
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS", str(rate))
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS_SITES", sites)
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS_SEED", "7")
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_RESTARTS", "50")
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_RETRIES", "4")
+    texts, stats = _serve(tiny_params)
+    assert len(texts) == len(PROMPTS)
+    for t in texts:
+        assert t is None or isinstance(t, str)
+    if rate == 0.0:
+        # chaos fully disarmed: nothing injected, nothing restarted
+        assert all(isinstance(t, str) for t in texts)
+        assert stats["restarts"] == 0 and stats["request_failures"] == 0
+
+
+# ---------------------------------------- deadlines, queue, shedding
+
+
+def test_deadline_shedding(tiny_params, monkeypatch):
+    """Queue-expired requests shed with a structured reason instead of
+    wasting device time; everything stays terminal."""
+    monkeypatch.setenv("PATHWAY_TPU_REQUEST_DEADLINE_MS", "1")
+    chat = _chat(tiny_params, n_slots=1)
+    try:
+        reqs = [
+            chat.submit_batch([p], max_new_tokens=b)[0]
+            for p, b in zip(PROMPTS, BUDGETS)
+        ]
+        for r in reqs:
+            assert r.done.wait(timeout=180)
+        shed = [r for r in reqs if r.error_reason == "shed:deadline"]
+        assert shed, "1ms deadline shed nothing on a 1-slot queue"
+        for r in shed:
+            assert r.text is None and r.retry_after is not None
+        assert chat._server.stats["shed"] == len(shed)
+    finally:
+        chat.close()
+
+
+def test_queue_bound_shedding(tiny_params, monkeypatch):
+    """PATHWAY_TPU_SERVE_QUEUE bounds the submit queue: overflow sheds
+    synchronously (reason queue_full) instead of queueing unboundedly."""
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_QUEUE", "1")
+    chat = _chat(tiny_params, n_slots=1)
+    try:
+        reqs = [
+            chat.submit_batch([p], max_new_tokens=4)[0] for p in PROMPTS
+        ]
+        for r in reqs:
+            assert r.done.wait(timeout=180)
+        shed = [r for r in reqs if r.error_reason == "shed:queue_full"]
+        assert shed, "queue bound of 1 shed nothing under a 6-burst"
+        served = [r for r in reqs if r.text is not None]
+        assert served, "shedding must not starve the queue entirely"
+    finally:
+        chat.close()
+
+
+def test_fault_counter_families_export_with_single_total_suffix():
+    """The OpenMetrics exporter appends ``_total`` to counter family
+    names, so the registry-side names must NOT carry the suffix — a
+    ``_total``-suffixed family would scrape as ``..._total_total``."""
+    from pathway_tpu.internals.http_server import registry_text
+
+    fams = ("requests_shed", "serve_restarts", "requests_isolated")
+    probes.REGISTRY.remove(*fams)
+    try:
+        probes.REGISTRY.counter_add("requests_shed", reason="deadline")
+        probes.REGISTRY.counter_add("serve_restarts", server="decode")
+        probes.REGISTRY.counter_add("requests_isolated", outcome="failed")
+        text = registry_text()
+        assert 'pathway_tpu_requests_shed_total{reason="deadline"}' in text
+        assert 'pathway_tpu_serve_restarts_total{server="decode"}' in text
+        assert (
+            'pathway_tpu_requests_isolated_total{outcome="failed"}' in text
+        )
+        assert "_total_total" not in text
+    finally:
+        probes.REGISTRY.remove(*fams)
+
+
+# ------------------------------------------------- degradation ladder
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def test_degradation_ladder_state_machine():
+    """Alert climbs the ladder one level per step; recovery walks it
+    back down at the same cadence; the gauge tracks transitions."""
+    probes.REGISTRY.remove("degradation_level")
+    clock = FakeClock()
+    state = {"alerting": ["ttft_p95"]}
+    wd = SimpleNamespace(state=lambda: state, clock=clock)
+    ctl = slo.DegradationController(wd, step_s=5.0, clock=clock)
+    assert ctl.level() == 0
+
+    assert ctl.evaluate() == 1          # first step is immediate
+    assert ctl.evaluate() == 1          # rate-limited within step_s
+    clock.advance(5.0)
+    assert ctl.evaluate() == 2
+    clock.advance(5.0)
+    assert ctl.evaluate() == 3
+    clock.advance(5.0)
+    assert ctl.evaluate() == 3          # capped at MAX_LEVEL
+    assert probes.REGISTRY.gauge_value("degradation_level") == 3.0
+
+    state = {"alerting": []}
+    wd.state = lambda: state
+    for want in (2, 1, 0):
+        clock.advance(5.0)
+        assert ctl.evaluate() == want
+    clock.advance(5.0)
+    assert ctl.evaluate() == 0
+    assert probes.REGISTRY.gauge_value("degradation_level") == 0.0
+    probes.REGISTRY.remove("degradation_level")
+
+
+def test_degradation_changes_admission(tiny_params):
+    """Level 3 sheds priority<=0 admissions; level 2 disables spec
+    decode; walking back to 0 restores full service on the SAME server."""
+    chat = _chat(tiny_params, spec_decode=True)
+    try:
+        srv = chat._server
+        assert srv.spec_decode is True
+        srv._degrade = None  # pin the level manually for the test
+
+        srv._degradation_level = 3
+        low = chat.submit_batch(
+            ["best effort"], max_new_tokens=4, priority=0
+        )[0]
+        assert low.done.wait(timeout=60)
+        assert low.text is None and low.error_reason == "shed:degraded"
+        normal = chat.submit_batch(["paid tier"], max_new_tokens=4)[0]
+        assert normal.done.wait(timeout=60)
+        assert isinstance(normal.text, str)
+
+        srv._degradation_level = 2
+        r2 = chat.submit_batch(["spec off"], max_new_tokens=6)[0]
+        assert r2.done.wait(timeout=60)
+        spec_before = srv.stats["spec_dispatches"]
+
+        srv._degradation_level = 0  # recovery: spec re-enables
+        r0 = chat.submit_batch(["spec back"], max_new_tokens=6)[0]
+        assert r0.done.wait(timeout=60)
+        assert srv.stats["spec_dispatches"] > spec_before
+        assert srv.stats["shed"] == 1
+    finally:
+        chat.close()
+
+
+# --------------------------------------- other sites: embed / persist /
+# connector
+
+
+def test_embed_h2d_chaos_provenance_and_retry():
+    """The ingest pipeline's h2d site faults with provenance; the
+    bounded retry re-attempts the stage before surfacing the error."""
+    from pathway_tpu.models.embedder import _IngestPipeline, _PendingEmbed
+
+    pipe = _IngestPipeline.__new__(_IngestPipeline)
+    site = chaos.ChaosSite("embed.h2d", 1.0, 0)
+    pipe._chaos_h2d = site
+    pipe._retries = 1
+    handle = _PendingEmbed()
+    pipe._dispatch_one((None, None, 1, handle))
+    assert handle._event.is_set()
+    assert isinstance(handle._error, chaos.InjectedFault)
+    assert handle._error.site == "embed.h2d"
+    # one retry happened: the site counted the initial try AND the retry
+    assert handle._error.seq == 2
+
+
+def test_persist_put_chaos(monkeypatch):
+    """SnapshotLogWriter.flush faults BEFORE the backend put: the rows
+    stay buffered for the next flush, nothing is torn."""
+    from pathway_tpu.persistence.backends import MemoryBackend
+    from pathway_tpu.persistence.snapshot import SnapshotLogWriter
+
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS", "1.0")
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS_SITES", "persist.put")
+    b = MemoryBackend()
+    w = SnapshotLogWriter(b, "src", 0)
+    w.write_rows([(1, ("a",), 1)])
+    with pytest.raises(chaos.InjectedFault) as ei:
+        w.advance(100)
+    assert ei.value.site == "persist.put"
+    assert not b.list_prefix("streams/"), "faulted put must not persist"
+    assert w._rows, "buffered rows must survive a faulted flush"
+
+    w._chaos_put = None
+    w.advance(100)
+    assert len(b.list_prefix("streams/src/0/")) == 1
+
+
+def test_connector_read_chaos(monkeypatch):
+    """BaseConnector.commit_rows faults before the commit: the batch is
+    all-or-nothing, like a real source read failure."""
+    from pathway_tpu.io._streams import BaseConnector
+
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS", "1.0")
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS_SITES", "connector.read")
+    conn = BaseConnector(SimpleNamespace(column_names=["a"], id=0))
+    with pytest.raises(chaos.InjectedFault) as ei:
+        conn.commit_rows([(1, ("x",), 1)])
+    assert ei.value.site == "connector.read"
+
+
+# ------------------------------------------------- QueryServer faults
+
+
+class _FakePipe:
+    """retrieve(texts, k) fails for k == 13 — one poisoned (kind, k)
+    group per tick."""
+
+    reranker = None
+
+    def retrieve(self, texts, k):
+        if k == 13:
+            raise RuntimeError("boom")
+        return [[f"doc{k}"] for _ in texts]
+
+
+def test_query_server_group_isolation(monkeypatch):
+    """Supervised: a poisoned (kind, k) group fails alone — sibling
+    groups and later submits keep serving."""
+    from pathway_tpu.ops.query_server import QueryServer
+
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_RESTARTS", "2")
+    with QueryServer(_FakePipe(), tick_ms=30.0, max_batch=8) as qs:
+        good = qs.submit("fine", 1)
+        bad = qs.submit("poisoned", 13)
+        assert good.wait(timeout=30) == ["doc1"]
+        with pytest.raises(RuntimeError, match="boom"):
+            bad.wait(timeout=30)
+        assert qs.submit("still alive", 2).wait(timeout=30) == ["doc2"]
+        st = qs.stats()
+        assert st["failed"] is False and st["group_failures"] == 1
+
+
+def test_query_server_latches_without_supervision():
+    """Default (PATHWAY_TPU_SERVE_RESTARTS=0): first tick error still
+    latches the whole server — the historical contract, pinned."""
+    from pathway_tpu.ops.query_server import QueryServer
+
+    qs = QueryServer(_FakePipe(), tick_ms=10.0, max_batch=8)
+    try:
+        bad = qs.submit("poisoned", 13)
+        with pytest.raises(RuntimeError, match="boom"):
+            bad.wait(timeout=30)
+        deadline = 50
+        while not qs.stats()["failed"] and deadline:
+            deadline -= 1
+            import time as _t
+
+            _t.sleep(0.05)
+        assert qs.stats()["failed"] is True
+        with pytest.raises(RuntimeError, match="failed"):
+            qs.submit("after latch", 1)
+    finally:
+        qs.shutdown()
+
+
+def test_query_server_tick_chaos_isolated(monkeypatch):
+    """query.tick chaos at rate 1.0 with supervision: every group
+    dispatch faults, per-group isolation absorbs them — requests error
+    with provenance instead of hanging, and the server never latches."""
+    from pathway_tpu.ops.query_server import QueryServer
+
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS", "1.0")
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS_SITES", "query.tick")
+    monkeypatch.setenv("PATHWAY_TPU_SERVE_RESTARTS", "4")
+    with QueryServer(_FakePipe(), tick_ms=10.0, max_batch=4) as qs:
+        reqs = [qs.submit(f"q{i}", 1) for i in range(3)]
+        for r in reqs:
+            with pytest.raises(chaos.InjectedFault):
+                r.wait(timeout=30)
+        assert qs.stats()["failed"] is False
+
+
+# ------------------------------------------ shutdown leaked threads
+
+
+def test_continuous_server_shutdown_detects_leaked_thread():
+    """A serving thread that survives the join timeout is recorded in
+    stats and the global error log instead of leaking silently."""
+    from pathway_tpu.analysis.runtime import make_lock
+    from pathway_tpu.xpacks.llm.llms import _ContinuousServer
+
+    release = threading.Event()
+    srv = object.__new__(_ContinuousServer)
+    srv._stop = False
+    srv.wake = threading.Event()
+    srv.lock = make_lock("test.leak")
+    srv.stats = {"leaked_thread": 0}
+    srv.thread = threading.Thread(target=release.wait, daemon=True)
+    srv.thread.start()
+    try:
+        srv.shutdown(timeout=0.2)
+        assert srv.stats["leaked_thread"] == 1
+    finally:
+        release.set()
+
+
+def test_query_server_shutdown_detects_leaked_thread():
+    """A tick blocked inside the pipeline past the join timeout surfaces
+    as leaked_thread in stats()."""
+    from pathway_tpu.ops.query_server import QueryServer
+
+    release = threading.Event()
+
+    class _BlockingPipe:
+        reranker = None
+
+        def retrieve(self, texts, k):
+            release.wait()
+            return [[] for _ in texts]
+
+    qs = QueryServer(_BlockingPipe(), tick_ms=5.0, max_batch=2)
+    try:
+        qs.submit("stuck", 1)
+        import time as _t
+
+        _t.sleep(0.1)  # let the loop enter the blocked dispatch
+        qs.shutdown(timeout=0.3)
+        assert qs.stats()["leaked_thread"] == 1
+    finally:
+        release.set()
+        qs._thread.join(timeout=10)
+
+
+# --------------------------------------------------- REST error mapping
+
+
+class _QSchema(pw.Schema):
+    q: str
+
+
+def test_rest_serving_error_status_mapping():
+    """Serve-error markers in the result column come back as typed HTTP
+    statuses: failure -> 500 JSON, shed -> 503 + Retry-After; healthy
+    rows stay 200."""
+    from pathway_tpu.xpacks.llm.llms import encode_serve_error
+    from pathway_tpu.xpacks.llm.servers import map_serving_errors
+
+    queries, writer = pw.io.http.rest_connector(
+        port=0, schema=_QSchema, delete_completed_queries=False
+    )
+
+    @pw.udf
+    def answer(q: str) -> str:
+        if q == "fail":
+            return encode_serve_error("failed")
+        if q == "shed":
+            return encode_serve_error("shed:deadline", retry_after=2.0)
+        return q + "!"
+
+    handler = map_serving_errors(
+        lambda t: t.select(result=answer(t.q))
+    )
+    writer(handler(queries))
+    conns = list(pw.G.connectors)
+    from pathway_tpu.io.http import _RestConnector
+
+    rest = next(c for c in conns if isinstance(c, _RestConnector))
+    out = {}
+
+    def _post(port, q):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            data=json.dumps({"q": q}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            resp = urllib.request.urlopen(req, timeout=20)
+            return resp.status, json.loads(resp.read()), {}
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), dict(e.headers)
+
+    def client():
+        rest.webserver._started.wait(timeout=20)
+        port = rest.webserver.port
+        try:
+            out["ok"] = _post(port, "hi")
+            out["fail"] = _post(port, "fail")
+            out["shed"] = _post(port, "shed")
+        finally:
+            for c in conns:
+                c._stop.set()
+                c.close()
+
+    threading.Thread(target=client, daemon=True).start()
+    pw.run()
+
+    status, body, _ = out["ok"]
+    assert status == 200 and body == "hi!"
+    status, body, _ = out["fail"]
+    assert status == 500
+    assert body["reason"] == "failed" and "error" in body
+    status, body, headers = out["shed"]
+    assert status == 503
+    assert body["reason"] == "shed:deadline"
+    assert headers.get("Retry-After") == "2"

@@ -78,6 +78,45 @@ def test_snapshot_writer_resumes_sequence():
     assert sorted(rows) == [(1, ("a",), 1), (2, ("b",), 1)]
 
 
+def test_snapshot_replay_tolerates_torn_trailing_chunk():
+    """A crash mid-put can leave truncated bytes as the log's tail:
+    replay keeps everything before the torn chunk, marks the torn chunk
+    (and anything after it) stale, and never raises."""
+    from pathway_tpu.persistence.snapshot import _chunk_key
+
+    b = MemoryBackend()
+    w = SnapshotLogWriter(b, "src", 0)
+    w.write_rows([(1, ("a",), 1)])
+    w.advance(100, offset={"f": 1})
+    w.write_rows([(2, ("b",), 1)])
+    w.advance(200, offset={"f": 2})
+    torn = _chunk_key("src", 0, 2)
+    b.put_value(torn, b"\x80\x04truncated-mid-write")
+    rows, offset, stale = SnapshotLogReader(b, "src", 0).replay()
+    assert sorted(rows) == [(1, ("a",), 1), (2, ("b",), 1)]
+    assert offset == {"f": 2}
+    assert torn in stale
+
+
+def test_snapshot_replay_torn_chunk_cuts_the_rest():
+    """Chunks AFTER a torn chunk are unreachable history: they go stale
+    with it (their data is re-read via the stored offset), keeping the
+    replayed prefix consistent."""
+    from pathway_tpu.persistence.snapshot import _chunk_key
+
+    b = MemoryBackend()
+    w = SnapshotLogWriter(b, "src", 0)
+    w.write_rows([(1, ("a",), 1)])
+    w.advance(100, offset={"f": 1})
+    w.write_rows([(2, ("b",), 1)])
+    w.advance(200, offset={"f": 2})
+    b.put_value(_chunk_key("src", 0, 1), b"not a pickle at all")
+    rows, offset, stale = SnapshotLogReader(b, "src", 0).replay()
+    assert rows == [(1, ("a",), 1)]
+    assert offset == {"f": 1}
+    assert stale == [_chunk_key("src", 0, 1)]
+
+
 def test_metadata_threshold_consensus():
     b = MemoryBackend()
     m0 = MetadataAccessor(b, worker_id=0, total_workers=2)

@@ -1,0 +1,121 @@
+"""Retry strategies: delay schedule, the ``max_delay_ms`` cap, jitter
+bounds and attempt counts — async and the synchronous twin the serving
+supervisors run on."""
+
+import asyncio
+
+import pytest
+
+from pathway_tpu.internals.udfs.retries import (
+    ExponentialBackoffRetryStrategy,
+    FixedDelayRetryStrategy,
+    NoRetryStrategy,
+)
+
+
+def _run_schedule(strategy, failures):
+    """Drive invoke_sync against an action failing ``failures`` times;
+    return (recorded sleeps, total calls)."""
+    sleeps = []
+    calls = {"n": 0}
+
+    def action():
+        calls["n"] += 1
+        if calls["n"] <= failures:
+            raise ValueError("transient")
+        return "ok"
+
+    result = strategy.invoke_sync(action, sleep=sleeps.append)
+    assert result == "ok"
+    return sleeps, calls["n"]
+
+
+def test_invoke_sync_attempt_count_and_success():
+    s = ExponentialBackoffRetryStrategy(
+        max_retries=3, initial_delay=10, backoff_factor=2, jitter_ms=0
+    )
+    sleeps, calls = _run_schedule(s, failures=2)
+    assert calls == 3                      # 2 failures + 1 success
+    assert sleeps == [0.01, 0.02]          # geometric, no jitter
+
+
+def test_invoke_sync_exhausted_budget_raises_last_error():
+    s = ExponentialBackoffRetryStrategy(
+        max_retries=2, initial_delay=1, jitter_ms=0
+    )
+    calls = {"n": 0}
+
+    def action():
+        calls["n"] += 1
+        raise KeyError("persistent")
+
+    with pytest.raises(KeyError):
+        s.invoke_sync(action, sleep=lambda _d: None)
+    assert calls["n"] == 3                 # initial + max_retries
+
+
+def test_max_delay_caps_the_schedule():
+    s = ExponentialBackoffRetryStrategy(
+        max_retries=6, initial_delay=100, backoff_factor=2, jitter_ms=0,
+        max_delay_ms=350,
+    )
+    sleeps, _ = _run_schedule(s, failures=6)
+    assert sleeps == [0.1, 0.2, 0.35, 0.35, 0.35, 0.35]
+
+
+def test_max_delay_caps_a_large_initial_delay():
+    s = ExponentialBackoffRetryStrategy(
+        max_retries=1, initial_delay=5000, jitter_ms=0, max_delay_ms=200
+    )
+    sleeps, _ = _run_schedule(s, failures=1)
+    assert sleeps == [0.2]
+
+
+def test_jitter_bounds():
+    """Each sleep lands in [base, base + jitter); the cap applies to the
+    base BEFORE jitter (matching the async path)."""
+    s = ExponentialBackoffRetryStrategy(
+        max_retries=5, initial_delay=100, backoff_factor=2,
+        jitter_ms=300, max_delay_ms=400,
+    )
+    sleeps, _ = _run_schedule(s, failures=5)
+    bases = [0.1, 0.2, 0.4, 0.4, 0.4]
+    for got, base in zip(sleeps, bases):
+        assert base <= got < base + 0.3 + 1e-9
+
+
+def test_async_invoke_cap_matches_sync(monkeypatch):
+    recorded = []
+
+    async def fake_sleep(d):
+        recorded.append(d)
+
+    monkeypatch.setattr(asyncio, "sleep", fake_sleep)
+    s = ExponentialBackoffRetryStrategy(
+        max_retries=3, initial_delay=100, backoff_factor=2, jitter_ms=0,
+        max_delay_ms=250,
+    )
+    calls = {"n": 0}
+
+    async def action():
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            raise ValueError("transient")
+        return "ok"
+
+    assert asyncio.run(s.invoke(action)) == "ok"
+    assert recorded == [0.1, 0.2, 0.25]
+
+
+def test_fixed_delay_strategy_schedule():
+    s = FixedDelayRetryStrategy(max_retries=3, delay_ms=50)
+    sleeps, calls = _run_schedule(s, failures=3)
+    assert calls == 4
+    assert sleeps == [0.05, 0.05, 0.05]
+
+
+def test_no_retry_strategy_sync():
+    s = NoRetryStrategy()
+    assert s.invoke_sync(lambda: 41 + 1) == 42
+    with pytest.raises(ValueError):
+        s.invoke_sync(lambda: (_ for _ in ()).throw(ValueError("x")))
