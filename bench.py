@@ -2199,6 +2199,114 @@ def _serving_spec_trace(params, cfg, tok) -> dict:
     }
 
 
+def _serving_paged_trace(params, cfg, tok) -> dict:
+    """Paged KV serving claim (PATHWAY_TPU_PAGED_KV): a mixed
+    long-context/short-answer greedy trace through two continuous
+    servers — dense slot pool vs paged block pool. A dense slot pins
+    ``cache_len`` KV rows whatever the request looks like; the paged
+    pool allocates only the blocks a request can reach, so the stranded
+    fraction (``serving.kv_fragmentation``) collapses and the same HBM
+    budget admits strictly more concurrent requests
+    (``paged_max_slots`` vs ``dense_max_slots`` — exact arithmetic from
+    this trace's request shapes). Greedy decoding: the arms must emit
+    token-identical streams (``tokens_match``)."""
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    if _smoke():
+        NREQ, MAXNEW, N_SLOTS, CHUNK, DEPTH = 12, 8, 4, 4, 2
+    else:
+        NREQ, MAXNEW, N_SLOTS, CHUNK, DEPTH = 48, 16, 16, 8, 4
+    rng = np.random.default_rng(11)
+    head = "c" * 40 + "ontext: "
+    # 1-in-4 requests carry the long retrieved context (56 tokens in the
+    # 64 bucket); the rest are short questions (6..10 tokens). Answers
+    # are uniformly short — the regime where a dense pool strands most
+    # of every short request's slot.
+    prompts = []
+    for k in range(NREQ):
+        if k % 4 == 0:
+            prompts.append(head + f"q{k:02d}tail"[:8].ljust(8, "x"))
+        else:
+            prompts.append(f"q{k:02d}" + "y" * int(rng.integers(2, 7)))
+
+    def run_arm(paged: bool):
+        chat = TPUDecoderChat(
+            params=params, cfg=cfg, tokenizer=tok,
+            max_new_tokens=MAXNEW, temperature=0.0, max_prompt_tokens=64,
+            continuous=True, n_slots=N_SLOTS, chunk_steps=CHUNK,
+            pipeline_depth=DEPTH, prefill_chunk=8, prefix_cache=False,
+            paged_kv=paged,
+        )
+        try:
+            srv = chat._server
+            # warm BOTH admission shapes (long bucket + short bucket) so
+            # neither arm pays a jit inside the timed window
+            for r in chat.submit_batch([head + "warmAAxx", "qWWyyyy"]):
+                r.done.wait(timeout=120)
+            # fragmentation accumulator covers the timed window only
+            srv._frag_sum, srv._frag_n = 0.0, 0
+            t0 = time.perf_counter()
+            reqs = chat.submit_batch(prompts)
+            toks = []
+            for r in reqs:
+                r.done.wait(timeout=120)
+                toks.append(list(r.tokens))
+            wall = max(r.finished_at for r in reqs) - t0
+            gen = sum(len(t) for t in toks)
+            arm = {
+                "tok_s": round(gen / max(wall, 1e-9), 1),
+                "generated": gen,
+                "wall_s": round(wall, 3),
+                "kv_fragmentation": round(
+                    srv.kv_fragmentation()["mean"], 4
+                ),
+            }
+            info = {
+                "cache_len": srv.cache_len, "block": srv.paged_block,
+                "slack": srv._slack, "depth": srv.pipeline_depth,
+            }
+            return arm, toks, info
+        finally:
+            chat.close()
+
+    paged_arm, toks_p, info = run_arm(True)
+    dense_arm, toks_d, _ = run_arm(False)
+    # admissible concurrency at a FIXED HBM budget (the dense pool's KV
+    # tokens, N_SLOTS * cache_len): a dense pool admits exactly N_SLOTS
+    # whatever the requests look like; the paged pool admits until the
+    # allocator runs dry, i.e. budget / mean-allocated-tokens of THIS
+    # trace's request shapes (exact arithmetic, no timing noise)
+    B = info["block"]
+    budget_tokens = N_SLOTS * info["cache_len"]
+    covers = [
+        min(
+            info["cache_len"],
+            len(tok.encode(p)) + MAXNEW
+            + (info["depth"] + 1) * info["slack"],
+        )
+        for p in prompts
+    ]
+    mean_alloc = float(np.mean([-(-c // B) * B for c in covers]))
+    paged_max_slots = int(budget_tokens // max(mean_alloc, 1.0))
+    return {
+        "trace": (
+            f"{NREQ} mixed greedy requests (1-in-4 long-context "
+            f"{len(head) + 8}-token, rest 6..10-token), {MAXNEW} new "
+            f"tokens each, {N_SLOTS} slots"
+        ),
+        "paged": paged_arm,
+        "dense": dense_arm,
+        "paged_tok_s": paged_arm["tok_s"],
+        "dense_tok_s": dense_arm["tok_s"],
+        "kv_fragmentation": paged_arm["kv_fragmentation"],
+        "kv_fragmentation_dense": dense_arm["kv_fragmentation"],
+        "paged_max_slots": paged_max_slots,
+        "dense_max_slots": N_SLOTS,
+        "max_slots_x": round(paged_max_slots / max(N_SLOTS, 1), 2),
+        "tokens_match": toks_p == toks_d,
+    }
+
+
 def _decoder_serving_compare(params, cfg) -> dict:
     """Poisson-arrival serving comparison through ``TPUDecoderChat``,
     measured on the PRODUCT path: both arms play the same trace through
@@ -2383,6 +2491,7 @@ def _decoder_serving_compare(params, cfg) -> dict:
         chat_c.close()
     prefix = _serving_prefix_trace(params, cfg, _Tok())
     spec = _serving_spec_trace(params, cfg, _Tok())
+    paged = _serving_paged_trace(params, cfg, _Tok())
     return {
         # headline figures come from the REST product path
         "poisson_lambda_req_per_s": LAM_REST,
@@ -2412,6 +2521,8 @@ def _decoder_serving_compare(params, cfg) -> dict:
         "prefix": prefix,
         # self-speculative decode + int8 KV arms on the same checkpoint
         "spec": spec,
+        # paged block-table KV pool vs the dense slot pool
+        "paged": paged,
         # bare-model comparison (per-request budgets, no engine): kept for
         # continuity with the r4/r5 records
         "direct_api": {
@@ -2679,6 +2790,27 @@ def main() -> None:
             ).get("tok_s"),
             "kv_bytes_saved": (serving_det.get("spec") or {}).get(
                 "kv_bytes_saved"
+            ),
+            "kv_fragmentation": (serving_det.get("paged") or {}).get(
+                "kv_fragmentation"
+            ),
+            "kv_fragmentation_dense": (
+                serving_det.get("paged") or {}
+            ).get("kv_fragmentation_dense"),
+            "paged_tok_s": (serving_det.get("paged") or {}).get(
+                "paged_tok_s"
+            ),
+            "dense_tok_s": (serving_det.get("paged") or {}).get(
+                "dense_tok_s"
+            ),
+            "paged_max_slots": (serving_det.get("paged") or {}).get(
+                "paged_max_slots"
+            ),
+            "dense_max_slots": (serving_det.get("paged") or {}).get(
+                "dense_max_slots"
+            ),
+            "paged_tokens_match": (serving_det.get("paged") or {}).get(
+                "tokens_match"
             ),
             "requests_shed": serving_det.get("requests_shed"),
             "restarts": serving_det.get("restarts"),
@@ -2978,6 +3110,18 @@ def sentinel_check(summary: dict, baseline: dict, smoke: bool) -> list:
     elif shed > 0:
         breaches.append(
             f"summary.serving.requests_shed: {shed} > 0 on a chaos-off run"
+        )
+    # paged-KV gates, exact at every scale: greedy paged serving must be
+    # token-identical to dense, and the stranded-KV gauge is a fraction
+    for fk in ("kv_fragmentation", "kv_fragmentation_dense"):
+        fv = srv_new.get(fk)
+        if isinstance(fv, (int, float)) and not 0.0 <= fv <= 1.0:
+            breaches.append(f"summary.serving.{fk}: {fv} outside [0, 1]")
+    ptm = srv_new.get("paged_tokens_match")
+    if ptm is not None and not ptm:
+        breaches.append(
+            "summary.serving.paged_tokens_match: paged arm diverged from "
+            "dense on a greedy trace"
         )
     return breaches
 

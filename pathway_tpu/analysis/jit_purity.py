@@ -2,9 +2,12 @@
 
 Finds every function reachable from a ``jax.jit`` boundary — decorator
 forms (``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``) and call
-forms (``jax.jit(f)``, ``jax.jit(partial(mod.f, ...))``) — then walks
-the call graph across modules (import-alias resolution, absolute and
-relative) and flags, inside the reachable set:
+forms (``jax.jit(f)``, ``jax.jit(partial(mod.f, ...))``) — plus
+``pl.pallas_call(kernel, ...)`` boundaries (a Pallas kernel body is
+traced exactly like a jitted function, so host effects inside it are
+the same bug) — then walks the call graph across modules (import-alias
+resolution, absolute and relative) and flags, inside the reachable
+set:
 
 * **GL101** host-side effects: ``print``, ``time.*``, ``os.environ`` /
   ``os.getenv``, ``pathway_config.*`` reads, and calls into the
@@ -110,6 +113,24 @@ def _is_jax_jit(node: ast.AST, imps: _Imports) -> bool:
     if tail == "jit" and imps.mod_alias.get(head) == "jax":
         return True
     if not tail and imps.from_names.get(head) == ("jax", "jit"):
+        return True
+    return False
+
+
+def _is_pallas_call(node: ast.AST, imps: _Imports) -> bool:
+    """``pl.pallas_call`` / ``pallas.pallas_call`` / a bare
+    ``pallas_call`` from-import — the kernel argument is a trace
+    boundary exactly like ``jax.jit``'s."""
+    d = _dotted(node)
+    if d is None:
+        return False
+    head, _, tail = d.partition(".")
+    if tail == "pallas_call" and (
+        imps.mod_alias.get(head) in ("jax.experimental.pallas",
+                                     "jax.experimental.pallas.tpu")
+    ):
+        return True
+    if not tail and imps.from_names.get(head, ("", ""))[1] == "pallas_call":
         return True
     return False
 
@@ -285,9 +306,12 @@ def _collect_roots(
                         ref.entry = True
                         ref.static |= _static_argnames(call)
                         roots.append(ref)
-        # call form: jax.jit(f) / jax.jit(partial(mod.f, ...))
+        # call form: jax.jit(f) / jax.jit(partial(mod.f, ...)) /
+        # pl.pallas_call(kernel, ...)
         for node in ast.walk(src.tree):
-            if not (isinstance(node, ast.Call) and _is_jax_jit(node.func, imps)):
+            if not (isinstance(node, ast.Call)
+                    and (_is_jax_jit(node.func, imps)
+                         or _is_pallas_call(node.func, imps))):
                 continue
             if not node.args:
                 continue

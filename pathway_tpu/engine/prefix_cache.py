@@ -31,6 +31,18 @@ is plain Python — no jax — so tier-1 exercises it CPU-only:
 Insert/evict keep the ``record_prefix`` ledger in ``engine/probes.py``
 current (``inserted_blocks`` / ``evicted_blocks`` / ``cached_bytes``);
 the serving loop accounts hit/miss tokens at admission time.
+
+Under the paged KV pool (``PATHWAY_TPU_PAGED_KV``) the same tree runs in
+ADOPTED mode: there is no separate arena — cached blocks ARE the slot's
+own blocks in the global paged pool, pinned via the ``pin``/``unpin``
+allocator callbacks instead of allocated from a private free list.
+``insert(..., block_ids=)`` adopts the slot's block-table entries
+zero-copy (no ``kv_extract``, no duplicate HBM bytes), ``n_blocks`` is a
+budget rather than a preallocated arena size, and eviction unpins —
+returning blocks to the global allocator once no live slot shares them.
+A hit then seeds a slot by writing the pinned ids into its block table
+(``paged_admit_cached``), copy-on-write: suffix and decode writes land
+in blocks past the shared run, so shared bytes are never written.
 """
 
 from __future__ import annotations
@@ -65,14 +77,25 @@ class PrefixCache:
     enforced in blocks (the arena is preallocated, so the byte budget is
     exact by construction)."""
 
-    def __init__(self, *, n_blocks: int, block: int, block_bytes: int):
+    def __init__(self, *, n_blocks: int, block: int, block_bytes: int,
+                 pin=None, unpin=None):
         self.block = int(block)
         self.block_bytes = int(block_bytes)
         self.capacity_blocks = int(n_blocks)
         self._root = _Node(None, [], [])
+        # ADOPTED mode (paged pool): no private arena — cached ids are
+        # global pool blocks held alive through the pin/unpin refcount
+        # callbacks (BlockAllocator.pin / .release); n_blocks is a
+        # budget, tracked by self._used.
+        self._pin = pin
+        self._unpin = unpin
+        self._adopted = pin is not None
+        if self._adopted and unpin is None:
+            raise ValueError("adopted mode needs both pin and unpin")
+        self._used = 0
         # pop() takes from the tail: reversed so low ids allocate first
         # (deterministic layouts make the tests' arena assertions exact)
-        self._free = list(range(int(n_blocks)))[::-1]
+        self._free = [] if self._adopted else list(range(int(n_blocks)))[::-1]
         self._clock = 0
 
     # -- tree internals ------------------------------------------------
@@ -143,8 +166,9 @@ class PrefixCache:
             n.refs -= 1
             n = n.parent
 
-    def insert(self, tokens: Sequence[int],
-               n_blocks: int | None = None) -> tuple[_Node, int, list[int]]:
+    def insert(self, tokens: Sequence[int], n_blocks: int | None = None,
+               block_ids: Sequence[int] | None = None,
+               ) -> tuple[_Node, int, list[int]]:
         """Ensure the first ``n_blocks`` full blocks of ``tokens`` are in
         the tree. Returns ``(node, first_new, new_ids)``: the deepest
         node now covering the prompt's cached prefix, the block index
@@ -152,19 +176,42 @@ class PrefixCache:
         caller must copy the slot's KV spans into them (``kv_extract``).
         Allocation evicts LRU unreferenced leaves when the free list is
         dry; if the arena is exhausted the tail is simply not cached
-        (``new_ids`` comes back short, or empty)."""
+        (``new_ids`` comes back short, or empty).
+
+        ADOPTED mode instead takes ``block_ids`` — the slot's block-table
+        ids covering blocks ``[0, n_blocks)`` of the prompt — and pins
+        ``block_ids[first_new:n_blocks]`` into the tree zero-copy; the
+        budget evicts cold edges (unpinning them) to make room, and the
+        tail is dropped if the budget still doesn't stretch."""
         if n_blocks is None:
             n_blocks = len(tokens) // self.block
         j, _, node = self.match(tokens[: n_blocks * self.block])
         if j >= n_blocks:
             return node, j, []
         want = self._block_keys(tokens, n_blocks)[j:]
-        new_ids: list[int] = []
-        for _ in want:
-            a = self._alloc(protect=node)
-            if a is None:
-                break
-            new_ids.append(a)
+        if self._adopted:
+            if block_ids is None:
+                raise ValueError(
+                    "adopted-mode insert needs the slot's block ids"
+                )
+            adopt = list(block_ids)[j:n_blocks]
+            while self._used + len(adopt) > self.capacity_blocks:
+                if not self._evict_one(node):
+                    adopt = adopt[: max(0, self.capacity_blocks
+                                        - self._used)]
+                    break
+            if not adopt:
+                return node, j, []
+            self._pin(adopt)
+            self._used += len(adopt)
+            new_ids = adopt
+        else:
+            new_ids = []
+            for _ in want:
+                a = self._alloc(protect=node)
+                if a is None:
+                    break
+                new_ids.append(a)
         if not new_ids:
             return node, j, []
         child = _Node(node, want[: len(new_ids)], new_ids)
@@ -201,15 +248,41 @@ class PrefixCache:
         if best is None:
             return False
         del best.parent.children[best.keys[0]]
-        self._free.extend(best.blocks)
+        if self._adopted:
+            self._unpin(best.blocks)
+            self._used -= len(best.blocks)
+        else:
+            self._free.extend(best.blocks)
         record_prefix("evicted_blocks", len(best.blocks))
         record_prefix("cached_bytes", -len(best.blocks) * self.block_bytes)
         return True
+
+    def reset(self) -> None:
+        """Drop the whole tree. ADOPTED mode unpins every cached block
+        back into the global allocator — only call with no live refs
+        (e.g. the bench's between-arm reset); arena mode returns every
+        block to the private free list."""
+        blocks, stack = [], list(self._root.children.values())
+        while stack:
+            nd = stack.pop()
+            stack.extend(nd.children.values())
+            blocks.extend(nd.blocks)
+        if blocks:
+            if self._adopted:
+                self._unpin(blocks)
+                self._used = 0
+            else:
+                self._free.extend(blocks)
+            record_prefix("evicted_blocks", len(blocks))
+            record_prefix("cached_bytes", -len(blocks) * self.block_bytes)
+        self._root = _Node(None, [], [])
 
     # -- observability ---------------------------------------------------
 
     @property
     def used_blocks(self) -> int:
+        if self._adopted:
+            return self._used
         return self.capacity_blocks - len(self._free)
 
     def stats(self) -> dict:

@@ -143,6 +143,11 @@ METRIC_FAMILIES: dict[str, tuple[str, str | None, str]] = {
     "requests_isolated": (
         "counter", "outcome", "Request-scoped serving errors handled by "
         "per-request isolation (retried / failed)"),
+    "kv_fragmentation": (
+        "gauge", "server", "Fraction of a serving pool's allocated KV "
+        "bytes stranded beyond what active requests can reach "
+        "(0 = perfectly packed; dense right-padded slots strand the "
+        "whole row tail, paged allocation only the final block's)"),
 }
 
 LATENCY_HISTOGRAMS = (
@@ -662,6 +667,25 @@ def reset_hbm_stats() -> None:
     REGISTRY.remove("hbm_bytes", "hbm_high_water_bytes")
 
 
+def record_kv_fragmentation(value: float, server: str = "decoder") -> None:
+    """Set the ``kv_fragmentation{server=}`` gauge: the fraction of the
+    serving pool's allocated KV bytes that no active request can reach
+    (1 - reachable/allocated over admitted slots; 0.0 when idle). The
+    dense right-padded pool strands every slot's row tail beyond its
+    prompt+budget, so short requests push this past 0.3; paged
+    allocation strands at most the final partial block per request.
+    Updated by ``_ContinuousServer`` at every admission and drain."""
+    REGISTRY.gauge_set("kv_fragmentation", value, server=server)
+
+
+def kv_fragmentation_value(server: str = "decoder"):
+    """Current ``kv_fragmentation`` gauge for ``server`` (None before
+    the first admission)."""
+    return REGISTRY.labelled(
+        "kv_fragmentation", "server", kind="gauge"
+    ).get(server)
+
+
 # --------------------------------------------------------------------- #
 # device-dispatch counters (registry shim)
 
@@ -746,9 +770,14 @@ def reset_cascade_stats() -> None:
 def record_prefix(kind: str, n: float = 1) -> None:
     """Account ``n`` of ``kind`` (``hit_tokens`` / ``miss_tokens`` /
     ``requests`` / ``hit_requests`` / ``inserted_blocks`` /
-    ``evicted_blocks`` / ``cached_bytes`` — the last is a running delta,
-    negative on eviction, stored as a gauge). Thread-safe; called by the
-    serving loop and
+    ``evicted_blocks`` / ``copy_bytes`` / ``cached_bytes`` — the last is
+    a running delta, negative on eviction, stored as a gauge).
+    ``copy_bytes`` counts HBM bytes physically DUPLICATED to serve a
+    hit: the dense pool's arena->slot block copies. A cache hit that
+    copies still saves the prefill compute, but the "tokens saved" claim
+    costs those bytes twice — under the paged pool hits pin shared
+    blocks instead, so the counter staying at zero is the copy-on-write
+    proof. Thread-safe; called by the serving loop and
     :class:`pathway_tpu.engine.prefix_cache.PrefixCache`."""
     if kind == "cached_bytes":
         REGISTRY.gauge_add("prefix_cached_bytes", n)
@@ -774,6 +803,7 @@ def prefix_stats() -> dict:
         "prefill_tokens_saved": int(hit),
         "evicted_blocks": int(c.get("evicted_blocks", 0)),
         "cached_bytes": int(c.get("cached_bytes", 0)),
+        "copy_bytes": int(c.get("copy_bytes", 0)),
     }
 
 

@@ -336,6 +336,52 @@ FLAG_REGISTRY: list[Flag] = [
             "(`tests/test_kv_quant.py`).",
     ),
     Flag(
+        env="PATHWAY_TPU_PAGED_KV", kind="bool", default=False,
+        kill_switch=True, pinned_by="tests/test_paged_kv.py",
+        attr="paged_kv", group="pipeline",
+        doc="Paged KV store for continuous serving: slots reference "
+            "fixed-size blocks in one global pool through a per-slot "
+            "block table, admission allocates only the blocks a request "
+            "can actually reach, and cached prompt prefixes are PINNED "
+            "copy-on-write instead of copied (see \"Paged KV & paged "
+            "attention\" below). Greedy token streams are byte-identical "
+            "to the dense pool across the spec x prefix x int8 grid, and "
+            "`0` (default) keeps the dense right-padded pool bit-exactly "
+            "(`tests/test_paged_kv.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PAGED_KV_BLOCK", kind="int", default=0,
+        attr="paged_kv_block", group="pipeline", minimum=0,
+        doc="Paged KV block size in tokens; `0` = auto (the prefix-cache "
+            "block, itself pow2-rounded from the prefill chunk). The "
+            "serving cache length rounds UP to a block multiple, and the "
+            "prefix block is forced equal so pinned prefixes stay "
+            "block-aligned.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PAGED_KV_BLOCKS", kind="int", default=0,
+        attr="paged_kv_blocks", group="pipeline", minimum=0,
+        doc="Total physical blocks in the paged pool; `0` = auto (every "
+            "slot's worst case plus the prefix-cache budget plus the "
+            "sentinel — capacity-equivalent to dense + arena). Setting "
+            "it LOWER oversubscribes: admission takes only what each "
+            "request needs, `PagedPoolOOM` requeues what no longer fits.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PAGED_KERNEL", kind="bool", default=False,
+        kill_switch=True, pinned_by="tests/test_paged_kv.py",
+        attr="paged_kernel", group="pipeline",
+        doc="Pallas paged-attention decode kernel (requires "
+            "`PATHWAY_TPU_PAGED_KV`): plain decode chunks walk the block "
+            "table directly with int8 dequant fused into the attention "
+            "read, skipping the gather/scatter the reference path pays. "
+            "Online softmax is allclose-not-bitwise vs dense attention, "
+            "so the kernel rides its own kill switch; spec decode always "
+            "uses the reference path. `tests/test_paged_kv.py` pins "
+            "kernel numerics against `_attn_ctx` at every (heads, block, "
+            "seq) corner.",
+    ),
+    Flag(
         env="PATHWAY_TPU_TOKENIZE_CACHE", kind="bool", default=True,
         kill_switch=True, pinned_by="tests/test_prefix_cache.py",
         attr="tokenize_cache", group="pipeline",

@@ -158,28 +158,15 @@ def _kv_quant(x):
 
 
 def pool_quantized(pool: dict) -> bool:
-    """True when the pool stores int8 KV (``pool_init(kv_quant=True)``)."""
-    return "k_scale" in pool
+    """True when the pool stores int8 KV (``pool_init(kv_quant=True)`` /
+    ``paged_pool_init(kv_quant=True)``)."""
+    return "k_scale" in pool or "kb_scale" in pool
 
 
-def _block(x, lp, k, v, mask_bias, cfg: DecoderConfig, k_scale=None,
-           v_scale=None):
-    """One pre-LN GPT-2 block over ALREADY-PROJECTED k/v (B, nh, Skv, hd).
-
-    The caller owns the KV source — the in-sequence keys for prefill, the
-    cache for decode — so prefill and decode share one block body and
-    cannot diverge numerically. With ``k_scale``/``v_scale`` given
-    ((B, nh, Skv, 1) f32), k/v arrive as int8 payloads and dequantize
-    here, on read — the one place every decode/prefill variant funnels
-    through, so quantized serving cannot fork the numerics either."""
-    if k_scale is not None:
-        k = (k.astype(jnp.float32) * k_scale).astype(cfg.dtype)
-        v = (v.astype(jnp.float32) * v_scale).astype(cfg.dtype)
-    # matmul outputs / bias / gelu / residuals stay in cfg.dtype (the MXU
-    # accumulates f32 internally; attention SCORES and layernorm statistics
-    # stay f32) — same HBM-traffic optimization as the encoder's _layer,
-    # bit-unchanged for f32 configs
-    B, S, H = x.shape
+def _block_qkv(x, lp, cfg: DecoderConfig):
+    """Pre-LN + fused QKV projection, head-split: ``(q, k_new, v_new)``
+    each (B, nh, S, hd). Shared by :func:`_block` and the paged-kernel
+    decode path, so both read identical projections."""
     nh, hd = cfg.heads, cfg.head_dim
     h1 = _ln(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
     qkv = jnp.einsum("bsh,hk->bsk", h1.astype(cfg.dtype),
@@ -187,16 +174,37 @@ def _block(x, lp, k, v, mask_bias, cfg: DecoderConfig, k_scale=None,
                      preferred_element_type=cfg.dtype)
     qkv = qkv + lp["qkv_b"].astype(cfg.dtype)
     q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
-    q = _split_heads(q, nh, hd)
+    return (_split_heads(q, nh, hd), _split_heads(k_new, nh, hd),
+            _split_heads(v_new, nh, hd))
+
+
+def _attn_ctx(q, k, v, mask_bias, cfg: DecoderConfig, k_scale=None,
+              v_scale=None):
+    """Attention read over ALREADY-PROJECTED k/v: scores in f32, softmax,
+    f32-accumulated probs@v. With ``k_scale``/``v_scale`` given, k/v
+    arrive as int8 payloads and dequantize here, on read — the one place
+    every dense decode/prefill variant funnels through, so quantized
+    serving cannot fork the numerics. The Pallas paged kernel
+    (``models/paged_attention.py``) is the block-table counterpart of
+    exactly this function."""
+    if k_scale is not None:
+        k = (k.astype(jnp.float32) * k_scale).astype(cfg.dtype)
+        v = (v.astype(jnp.float32) * v_scale).astype(cfg.dtype)
     scores = jnp.einsum("bnqd,bnkd->bnqk", q, k.astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
-    scores = scores / math.sqrt(hd) + mask_bias
+    scores = scores / math.sqrt(cfg.head_dim) + mask_bias
     probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
     # the weighted-sum over up to cache_len values keeps GUARANTEED f32
     # accumulation (same as the encoder's explicit-softmax path) — with a
     # bf16 preference some backends may use bf16 partial sums
-    ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, v.astype(cfg.dtype),
-                     preferred_element_type=jnp.float32).astype(cfg.dtype)
+    return jnp.einsum("bnqk,bnkd->bnqd", probs, v.astype(cfg.dtype),
+                      preferred_element_type=jnp.float32).astype(cfg.dtype)
+
+
+def _block_finish(x, lp, ctx, cfg: DecoderConfig):
+    """Post-attention half of the block: output projection, residual,
+    MLP. ``ctx`` is the attention read (B, nh, S, hd)."""
+    B, S, H = x.shape
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H)
     attn = jnp.einsum("bsh,hk->bsk", ctx, lp["attn_out_w"].astype(cfg.dtype),
                       preferred_element_type=cfg.dtype)
@@ -210,8 +218,25 @@ def _block(x, lp, k, v, mask_bias, cfg: DecoderConfig, k_scale=None,
     m = jnp.einsum("bsi,ih->bsh", m, lp["mlp_out_w"].astype(cfg.dtype),
                    preferred_element_type=cfg.dtype)
     x = x + m + lp["mlp_out_b"].astype(cfg.dtype)
-    return x.astype(cfg.dtype), _split_heads(k_new, nh, hd), \
-        _split_heads(v_new, nh, hd)
+    return x.astype(cfg.dtype)
+
+
+def _block(x, lp, k, v, mask_bias, cfg: DecoderConfig, k_scale=None,
+           v_scale=None):
+    """One pre-LN GPT-2 block over ALREADY-PROJECTED k/v (B, nh, Skv, hd).
+
+    The caller owns the KV source — the in-sequence keys for prefill, the
+    cache for decode — so prefill and decode share one block body and
+    cannot diverge numerically. Composed of :func:`_block_qkv` →
+    :func:`_attn_ctx` → :func:`_block_finish`; matmul outputs / bias /
+    gelu / residuals stay in cfg.dtype (the MXU accumulates f32
+    internally; attention SCORES and layernorm statistics stay f32) —
+    same HBM-traffic optimization as the encoder's _layer, bit-unchanged
+    for f32 configs."""
+    q, k_new, v_new = _block_qkv(x, lp, cfg)
+    ctx = _attn_ctx(q, k, v, mask_bias, cfg, k_scale, v_scale)
+    x = _block_finish(x, lp, ctx, cfg)
+    return x, k_new, v_new
 
 
 def _logits(params, x, cfg):
@@ -515,12 +540,16 @@ def pool_init(params: dict, cfg: DecoderConfig, n_slots: int,
 def pool_component_bytes(pool: dict) -> dict[str, int]:
     """HBM bytes of the pool's KV storage split by ledger component:
     ``slot_pool`` (per-slot caches), ``kv_scales`` (int8 dequant scales),
-    ``prefix_arena`` (+ ``arena_scales``). The HBM ledger
-    (``probes.record_hbm``) records these per component at pool build;
-    :func:`pool_bytes` sums them for the historical total."""
+    ``prefix_arena`` (+ ``arena_scales``); a PAGED pool reports
+    ``kv_blocks`` (the global block pool — which also absorbs the
+    prefix arena's role), ``kv_scales``, and ``block_table``. The HBM
+    ledger (``probes.record_hbm``) records these per component at pool
+    build; :func:`pool_bytes` sums them for the historical total."""
     groups = {
         "slot_pool": ("k", "v"),
-        "kv_scales": ("k_scale", "v_scale"),
+        "kv_blocks": ("kb", "vb"),
+        "kv_scales": ("k_scale", "v_scale", "kb_scale", "vb_scale"),
+        "block_table": ("block_tbl",),
         "prefix_arena": ("arena_k", "arena_v"),
         "arena_scales": ("arena_k_scale", "arena_v_scale"),
     }
@@ -534,16 +563,279 @@ def pool_component_bytes(pool: dict) -> dict[str, int]:
 
 
 def pool_bytes(pool: dict) -> int:
-    """HBM bytes of the pool's KV storage (caches + arena + scales) —
-    the denominator of the kv_quant capacity claim."""
+    """HBM bytes of the pool's KV storage (caches + arena + scales, or
+    the block pool + table when paged) — the denominator of the kv_quant
+    capacity claim and the number the HBM ledger records. Derived from
+    :func:`pool_component_bytes`, which knows both layouts, so
+    ``hbm_bytes{component=}`` and ``cli stats`` stay honest under
+    ``PATHWAY_TPU_PAGED_KV=1``."""
     return sum(pool_component_bytes(pool).values())
+
+
+# ---- paged block-table KV store (PATHWAY_TPU_PAGED_KV) ---------------------
+#
+# The dense pool above strands HBM: every slot owns a full
+# ``cache_len`` row sized for the worst-case request, so a short
+# request wastes most of its row, and ``pool_admit_cached`` COPIES
+# arena blocks into the row instead of referencing them. The paged
+# store replaces per-slot rows with ONE global pool of fixed-size KV
+# blocks plus a per-slot block table: slot ``s``'s logical cache
+# column ``c`` lives at block ``block_tbl[s, c // block]``, block-local
+# column ``c % block``. The host allocates only the blocks a request
+# actually needs (``ceil((prompt + budget + slack) / block)``), frees
+# them the moment the slot drains, and shares prompt-prefix blocks
+# BETWEEN slots copy-on-write: a cached prefix is pinned into a new
+# slot's table (refcount++) with zero data movement, and is never
+# written again because suffix writes start past it.
+#
+# Reference semantics (this file) are gather-run-scatter: each jitted
+# pool op gathers the table rows into the dense per-slot layout, runs
+# the UNCHANGED dense computation, and scatters written rows back into
+# their blocks. Gathered bytes at live columns are exactly what the
+# dense pool would hold, and dead columns contribute exactly 0.0 to
+# attention (the -1e9 mask bias underflows softmax in f32), so paged
+# greedy tokens are byte-identical to the dense pool — the grid
+# ``tests/test_paged_kv.py`` pins. The scatter's duplicate indices
+# (COW-shared blocks, the sentinel) always carry identical values, so
+# write order cannot matter. The TPU fast path skips the gather
+# entirely: ``models/paged_attention.py`` walks the table per slot
+# inside a Pallas kernel (``PATHWAY_TPU_PAGED_KERNEL``).
+#
+# Block 0 is a SENTINEL: never allocated, every unallocated table entry
+# points at it, so gathers of unallocated tails read zeros and scatters
+# write the zeros straight back. The allocator below is pure host
+# state — frees touch no device memory (a stale table row gathers
+# masked garbage, which is harmless by the argument above).
+
+
+class PagedPoolOOM(RuntimeError):
+    """Typed allocation failure of the paged KV block pool. Raised on
+    the HOST before any device mutation: a failed allocation leaves the
+    allocator, the block table, and every refcount exactly as they
+    were — no torn state for the serving loop to unwind."""
+
+    def __init__(self, want: int, free: int):
+        super().__init__(
+            f"paged KV pool exhausted: need {want} blocks, {free} free"
+        )
+        self.want = want
+        self.free = free
+
+
+class BlockAllocator:
+    """Host-side free list + refcounts over the paged pool's blocks.
+
+    Block ids are global pool indices in ``[1, n_blocks)`` — block 0 is
+    the sentinel and never handed out. ``alloc`` is atomic (all-or-
+    nothing, raising :class:`PagedPoolOOM` otherwise); ``pin`` adds a
+    reference to an already-live block (copy-on-write prefix sharing);
+    ``release`` drops one reference per id and returns a block to the
+    free list only when its count hits zero. Everything here is plain
+    Python — the serving loop owns it from one thread, and frees need
+    no device work at all."""
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("paged pool needs >= 2 blocks (one sentinel)")
+        self.n_blocks = int(n_blocks)
+        # pop() takes from the tail: reversed so low ids allocate first
+        # (deterministic layouts keep the tests' table assertions exact)
+        self._free = list(range(self.n_blocks - 1, 0, -1))
+        self._refs: dict[int, int] = {}
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_allocated(self) -> int:
+        return (self.n_blocks - 1) - len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise PagedPoolOOM(n, len(self._free))
+        ids = [self._free.pop() for _ in range(n)]
+        for i in ids:
+            self._refs[i] = 1
+        return ids
+
+    def pin(self, ids) -> None:
+        for i in ids:
+            if i not in self._refs:
+                raise ValueError(f"pin of unallocated block {i}")
+            self._refs[i] += 1
+
+    def release(self, ids) -> int:
+        """Drop one reference per id; returns how many blocks were
+        actually freed (refcount reached zero)."""
+        freed = 0
+        for i in ids:
+            r = self._refs.get(i, 0) - 1
+            if r > 0:
+                self._refs[i] = r
+            elif r == 0:
+                del self._refs[i]
+                self._free.append(i)
+                freed += 1
+            else:
+                raise ValueError(f"release of unallocated block {i}")
+        return freed
+
+    def stats(self) -> dict:
+        return {
+            "n_blocks": self.n_blocks,
+            "free": self.n_free,
+            "allocated": self.n_allocated,
+            "shared": sum(1 for r in self._refs.values() if r > 1),
+        }
+
+
+# pool keys private to the paged layout (everything else — logits,
+# slot_mask, cursors — is shared with the dense layout verbatim)
+_PAGED_KEYS = ("kb", "vb", "kb_scale", "vb_scale", "block_tbl")
+
+
+def pool_paged(pool: dict) -> bool:
+    """True when the pool stores KV as a global block pool + per-slot
+    block table (``paged_pool_init``)."""
+    return "block_tbl" in pool
+
+
+def paged_block(pool: dict) -> int:
+    """Tokens per KV block of a paged pool."""
+    return pool["kb"].shape[3]
+
+
+def paged_pool_init(params: dict, cfg: DecoderConfig, n_slots: int,
+                    cache_len: int, n_blocks: int, block: int,
+                    kv_quant: bool = False) -> dict:
+    """Empty PAGED serving pool: ``n_blocks`` KV blocks of ``block``
+    tokens each (block 0 reserved as the sentinel) plus an
+    ``(n_slots, cache_len // block)`` block table, alongside the same
+    logits / slot_mask / cursor planes as :func:`pool_init`.
+    ``cache_len`` must be a multiple of ``block`` so a gathered table
+    row is layout-identical to a dense slot row. The table rides the
+    donated pool pytree; WHICH blocks a slot owns is host state
+    (:class:`BlockAllocator`)."""
+    if cache_len % block != 0:
+        raise ValueError(
+            f"cache_len ({cache_len}) must be a multiple of the paged "
+            f"block size ({block})"
+        )
+    if n_blocks < 2:
+        raise ValueError("paged pool needs >= 2 blocks (one sentinel)")
+    L, nh, hd = cfg.layers, cfg.heads, cfg.head_dim
+    del params
+    kv_dtype = jnp.int8 if kv_quant else cfg.dtype
+    pool = {
+        "kb": jnp.zeros((L, n_blocks, nh, block, hd), kv_dtype),
+        "vb": jnp.zeros((L, n_blocks, nh, block, hd), kv_dtype),
+        "block_tbl": jnp.zeros((n_slots, cache_len // block), jnp.int32),
+        "logits": jnp.zeros((n_slots, cfg.vocab_size), jnp.float32),
+        "slot_mask": jnp.zeros((n_slots, cache_len), jnp.int32),
+        "pos": jnp.zeros((n_slots,), jnp.int32),
+        "write": jnp.zeros((n_slots,), jnp.int32),
+    }
+    if kv_quant:
+        sshape = (L, n_blocks, nh, block, 1)
+        pool["kb_scale"] = jnp.zeros(sshape, jnp.float32)
+        pool["vb_scale"] = jnp.zeros(sshape, jnp.float32)
+    return pool
+
+
+def _paged_gather(pool: dict) -> dict:
+    """Dense VIEW of a paged pool: gather every slot's table row into the
+    per-slot layout the dense pool functions consume. At live columns the
+    view is byte-identical to what the dense pool would hold; unallocated
+    tails read the sentinel block (zeros). The non-KV planes pass through
+    by reference."""
+    tbl = pool["block_tbl"]  # (n_slots, max_blocks)
+    L = pool["kb"].shape[0]
+    nh = pool["kb"].shape[2]
+    Bk = pool["kb"].shape[3]
+    S, M = tbl.shape
+
+    def g(plane):
+        d = plane.shape[-1]
+        x = plane[:, tbl]  # (L, S, M, nh, Bk, d)
+        return x.transpose(0, 1, 3, 2, 4, 5).reshape(L, S, nh, M * Bk, d)
+
+    view = {k: v for k, v in pool.items() if k not in _PAGED_KEYS}
+    view["k"] = g(pool["kb"])
+    view["v"] = g(pool["vb"])
+    if "kb_scale" in pool:
+        view["k_scale"] = g(pool["kb_scale"])
+        view["v_scale"] = g(pool["vb_scale"])
+    return view
+
+
+def _paged_scatter(pool: dict, view: dict) -> dict:
+    """Write a dense view produced by :func:`_paged_gather` (and advanced
+    by a dense pool op) back into the block pool. Duplicate table entries
+    (COW-shared blocks, sentinel tails) always scatter identical bytes —
+    shared columns are never written by the op — so write order cannot
+    matter."""
+    tbl = pool["block_tbl"]
+    Bk = pool["kb"].shape[3]
+
+    def s(plane, row):
+        L, S, nh, C, d = row.shape
+        x = row.reshape(L, S, nh, C // Bk, Bk, d).transpose(0, 1, 3, 2, 4, 5)
+        return plane.at[:, tbl].set(x)
+
+    out = dict(pool)
+    out["kb"] = s(pool["kb"], view["k"])
+    out["vb"] = s(pool["vb"], view["v"])
+    if "kb_scale" in pool:
+        out["kb_scale"] = s(pool["kb_scale"], view["k_scale"])
+        out["vb_scale"] = s(pool["vb_scale"], view["v_scale"])
+    for key, val in view.items():
+        if key not in ("k", "v", "k_scale", "v_scale"):
+            out[key] = val
+    return out
+
+
+def paged_table_set(pool: dict, slot: jax.Array, row: jax.Array) -> dict:
+    """Install ``slot``'s block-table row (``row`` (max_blocks,) int32,
+    unallocated tail = sentinel 0). The one device-side edit an admission
+    needs beyond the prefill itself; jit with the pool donated, like
+    every other pool op. ``slot`` and ``row`` are traced."""
+    return {**pool, "block_tbl": pool["block_tbl"].at[slot].set(row)}
+
+
+def paged_admit_cached(pool: dict, slot: jax.Array, row: jax.Array,
+                       n_cached: int) -> dict:
+    """Copy-on-write counterpart of :func:`pool_admit_cached`: install
+    ``slot``'s table row (whose first ``n_cached // block`` entries are
+    PINNED shared blocks holding the cached prompt prefix) and mark the
+    first ``n_cached`` mask columns live. No KV bytes move — that is the
+    whole point. The host drives the uncached suffix through ordinary
+    right-padded prefill pieces (``first=False``), whose writes start at
+    column ``n_cached`` and therefore never touch a shared block. jit per
+    n_cached; ``slot``/``row`` are traced."""
+    C = pool["slot_mask"].shape[1]
+    out = paged_table_set(pool, slot, row)
+    row_mask = (jnp.arange(C)[None, :] < n_cached).astype(jnp.int32)
+    out["slot_mask"] = jax.lax.dynamic_update_slice(
+        pool["slot_mask"], row_mask, (slot, 0)
+    )
+    return out
 
 
 def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,
                slot: jax.Array, cfg: DecoderConfig) -> dict:
     """Prefill ONE left-padded prompt (``ids``/``mask`` shaped (1, S))
     and install it in ``slot``: KV written, cursors set, first-token
-    logits staged. jit per prompt-length bucket; ``slot`` is traced."""
+    logits staged. jit per prompt-length bucket; ``slot`` is traced.
+
+    PAGED pools run the identical computation over a gathered dense
+    view and scatter the written row back into the slot's table blocks
+    — the dict-key branch is static under jit."""
+    if pool_paged(pool):
+        return _paged_scatter(
+            pool, pool_admit(params, ids, mask, _paged_gather(pool),
+                             slot, cfg)
+        )
     C = pool["k"].shape[3]
     S = ids.shape[1]
     last_logits, cache = prefill(params, ids, mask, cfg, cache_len=C)
@@ -595,7 +887,13 @@ def pool_admit_batch(params: dict, ids: jax.Array, mask: jax.Array,
     into one kernel and the M dispatches collapse into one, so a burst of
     same-bucket arrivals costs one admission RTT instead of M
     (``PATHWAY_TPU_BATCH_ADMIT``). jit per (M, prompt-bucket);
-    ``slots`` is traced."""
+    ``slots`` is traced. Paged pools gather-run-scatter (see
+    :func:`pool_admit`)."""
+    if pool_paged(pool):
+        return _paged_scatter(
+            pool, pool_admit_batch(params, ids, mask, _paged_gather(pool),
+                                   slots, cfg)
+        )
     C = pool["k"].shape[3]
     M, S = ids.shape
     last_logits, cache = prefill(params, ids, mask, cfg, cache_len=C)
@@ -653,7 +951,15 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
     ends on the last real token. The prefix-cache path admits prompts
     RIGHT-padded (token i must sit at cache column i for arena blocks
     to be layout-exact), so its final piece may end on pad columns and
-    the next-token logits live mid-piece."""
+    the next-token logits live mid-piece. Paged pools gather-run-
+    scatter (see :func:`pool_admit`)."""
+    if pool_paged(pool):
+        return _paged_scatter(
+            pool, pool_prefill_chunk(
+                params, ids, mask, pos, _paged_gather(pool), slot, start,
+                n_prompt, cfg, first=first, last=last, last_col=last_col,
+            )
+        )
     C = pool["k"].shape[3]
     T = ids.shape[1]
     nh, hd = cfg.heads, cfg.head_dim
@@ -751,7 +1057,14 @@ def kv_extract(pool: dict, slot: jax.Array, start: jax.Array,
     after a prompt's prefill lands, to publish its freshly-computed
     blocks into the prefix-cache arena. Pure data movement — no
     compute — so the cached bytes are bit-identical to what the slot
-    holds. jit per n; ``slot``/``start``/``idxs`` are traced."""
+    holds. jit per n; ``slot``/``start``/``idxs`` are traced. Paged
+    pools never extract — they pin their own blocks into the prefix
+    cache (zero copy)."""
+    if pool_paged(pool):
+        raise ValueError(
+            "kv_extract is dense-arena machinery; a paged pool publishes "
+            "prefixes by pinning its own blocks (paged_admit_cached)"
+        )
     del cfg
     L, _, nh, _, _ = pool["k"].shape
     Bk = pool["arena_k"].shape[3]
@@ -775,6 +1088,11 @@ def kv_insert(pool: dict, slot: jax.Array, start: jax.Array,
     i % block, so the copy is layout-exact only when the receiving
     prompt ALSO places token i at cache column i (right-padded
     admission, ``start = 0``). jit per n; traced like extract."""
+    if pool_paged(pool):
+        raise ValueError(
+            "kv_insert is dense-arena machinery; a paged pool admits "
+            "cached prefixes by table edit (paged_admit_cached)"
+        )
     del cfg
     L, _, nh, _, _ = pool["k"].shape
     Bk = pool["arena_k"].shape[3]
@@ -803,7 +1121,13 @@ def pool_admit_cached(pool: dict, slot: jax.Array, idxs: jax.Array,
     numerics: the suffix attends to seeded KV that is bit-identical to
     what it would have computed itself. No logits/cursor writes — the
     suffix's ``last`` piece owns those. jit per n; ``slot``/``idxs``
-    are traced."""
+    are traced. Paged pools use :func:`paged_admit_cached` — pinning
+    shared blocks instead of copying them."""
+    if pool_paged(pool):
+        raise ValueError(
+            "pool_admit_cached copies arena blocks; paged pools pin "
+            "shared blocks copy-on-write (paged_admit_cached)"
+        )
     out = kv_insert(pool, slot, jnp.int32(0), idxs, cfg)
     C = pool["k"].shape[3]
     Bk = pool["arena_k"].shape[3]
@@ -819,12 +1143,29 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
                       key: jax.Array, cfg: DecoderConfig, n_steps: int,
                       temperature: float = 0.0,
                       top_k: int | None = None,
-                      top_p: float | None = None) -> tuple[dict, jax.Array]:
+                      top_p: float | None = None,
+                      paged_kernel: bool = False) -> tuple[dict, jax.Array]:
     """Advance every ``active`` slot ``n_steps`` decode steps in ONE
     dispatch. Returns ``(pool, tokens (n_steps, n_slots))`` — the host
     truncates each slot's stream at EOS / its budget (a lane keeps
     decoding garbage past its own EOS until the chunk ends; discarded).
-    Inactive lanes compute but their state does not advance."""
+    Inactive lanes compute but their state does not advance.
+
+    Paged pools gather-run-scatter (see :func:`pool_admit`) unless
+    ``paged_kernel`` is set, in which case the chunk runs directly on
+    the block planes with the Pallas paged-attention kernel — no dense
+    materialization, int8 dequant fused into the attention read."""
+    if pool_paged(pool):
+        if paged_kernel:
+            return _paged_decode_chunk_kernel(
+                params, pool, active, key, cfg, n_steps,
+                temperature, top_k, top_p,
+            )
+        view, toks = pool_decode_chunk(
+            params, _paged_gather(pool), active, key, cfg, n_steps,
+            temperature, top_k, top_p,
+        )
+        return _paged_scatter(pool, view), toks
     B = pool["logits"].shape[0]
     C = pool["k"].shape[3]
     b_idx = jnp.arange(B)
@@ -899,6 +1240,104 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
            "slot_mask": slot_mask, "pos": pos, "write": write}
     if quant:
         out["k_scale"], out["v_scale"] = ks_c, vs_c
+    return out, toks
+
+
+def _paged_decode_chunk_kernel(params, pool, active, key, cfg, n_steps,
+                               temperature, top_k, top_p):
+    """:func:`pool_decode_chunk` running DIRECTLY on the paged block
+    planes — no dense gather/scatter. Each step writes the new token's
+    KV into its slot's current physical block (one advanced-index
+    scatter per layer instead of a full-pool materialization) and reads
+    attention through the Pallas paged kernel
+    (:mod:`pathway_tpu.models.paged_attention`), which walks the block
+    table and fuses int8 dequant into the read. Same op sequence as the
+    dense chunk otherwise (embedding, QKV, MLP, logits), so tokens
+    match the reference path at online-softmax tolerance."""
+    from pathway_tpu.models import paged_attention as _pa
+
+    B, C = pool["slot_mask"].shape
+    Bk = paged_block(pool)
+    tbl = pool["block_tbl"]
+    b_idx = jnp.arange(B)
+    act_i = active.astype(jnp.int32)
+    act_b = active[:, None, None]
+    quant = pool_quantized(pool)
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
+
+    def body(carry, _):
+        kb_c, vb_c, kbs_c, vbs_c, logits, slot_mask, pos, write, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)
+        w = jnp.minimum(write, C - 1)
+        slot_mask = jnp.where(
+            active[:, None] & (jnp.arange(C)[None, :] == w[:, None]),
+            1, slot_mask,
+        )
+        p = jnp.minimum(pos, cfg.max_position - 1)
+        x = (params["wte"][tok][:, None, :]
+             + params["wpe"][p][:, None, :]).astype(cfg.dtype)
+        # each lane's write column in PHYSICAL coordinates: the block
+        # table maps its logical block, the remainder is the in-block
+        # column. Active lanes own disjoint blocks; inactive lanes
+        # write their old bytes back (possibly into the sentinel), so
+        # duplicate indices always carry identical values.
+        dst_b = tbl[b_idx, w // Bk]
+        dst_c = w % Bk
+
+        def layer(x, inp):
+            lp, kbl, vbl, kbsl, vbsl = inp
+            q, k_new, v_new = _block_qkv(x, lp, cfg)  # (B, nh, 1, hd)
+            if quant:
+                k_new, sk = _kv_quant(k_new)
+                v_new, sv = _kv_quant(v_new)
+                kbsl = kbsl.at[dst_b, :, dst_c, :].set(
+                    jnp.where(act_b, sk[:, :, 0, :],
+                              kbsl[dst_b, :, dst_c, :])
+                )
+                vbsl = vbsl.at[dst_b, :, dst_c, :].set(
+                    jnp.where(act_b, sv[:, :, 0, :],
+                              vbsl[dst_b, :, dst_c, :])
+                )
+            kbl = kbl.at[dst_b, :, dst_c, :].set(
+                jnp.where(act_b, k_new[:, :, 0, :], kbl[dst_b, :, dst_c, :])
+            )
+            vbl = vbl.at[dst_b, :, dst_c, :].set(
+                jnp.where(act_b, v_new[:, :, 0, :], vbl[dst_b, :, dst_c, :])
+            )
+            ctx = _pa.paged_attn_decode(
+                q[:, :, 0, :], kbl, vbl, kbsl, vbsl, tbl, slot_mask,
+            )
+            x = _block_finish(x, lp, ctx[:, :, None, :], cfg)
+            return x, (kbl, vbl, kbsl, vbsl)
+
+        x, (kb_c, vb_c, kbs_c, vbs_c) = jax.lax.scan(
+            layer, x, (params["layers"], kb_c, vb_c, kbs_c, vbs_c)
+        )
+        new_logits = _logits(params, x, cfg)[:, 0, :]
+        logits = jnp.where(active[:, None], new_logits, logits)
+        return (kb_c, vb_c, kbs_c, vbs_c, logits, slot_mask, pos + act_i,
+                write + act_i, key), tok
+
+    (kb_c, vb_c, kbs_c, vbs_c, logits, slot_mask, pos, write, _), toks = \
+        jax.lax.scan(
+            body,
+            (pool["kb"], pool["vb"],
+             pool.get("kb_scale"), pool.get("vb_scale"),
+             pool["logits"], pool["slot_mask"], pool["pos"], pool["write"],
+             key),
+            None,
+            length=n_steps,
+        )
+    out = {**pool, "kb": kb_c, "vb": vb_c, "logits": logits,
+           "slot_mask": slot_mask, "pos": pos, "write": write}
+    if quant:
+        out["kb_scale"], out["vb_scale"] = kbs_c, vbs_c
     return out, toks
 
 
@@ -999,7 +1438,14 @@ def pool_decode_draft(params: dict, pool: dict, active: jax.Array,
     the shallow KV writes live in a local copy of the depth-prefix, so a
     discarded draft costs nothing — :func:`pool_decode_spec`'s verify
     pass owns every persistent write. Exposed standalone for tests and
-    draft-quality probing; the serving path uses the fused cycle."""
+    draft-quality probing; the serving path uses the fused cycle.
+    Paged pools gather-run-scatter (see :func:`pool_admit`); drafting
+    never writes, so only the gather side is needed."""
+    if pool_paged(pool):
+        return pool_decode_draft(
+            params, _paged_gather(pool), active, cfg,
+            draft_layers=draft_layers, n_draft=n_draft,
+        )
     C = pool["k"].shape[3]
     D = draft_layers
     quant = pool_quantized(pool)
@@ -1040,7 +1486,17 @@ def pool_decode_spec(params: dict, pool: dict, active: jax.Array,
 
     Returns ``(pool, toks (n_cycles, n_slots, n_spec + 1), n_emit
     (n_cycles, n_slots))``: the host consumes each cycle's first
-    ``n_emit`` tokens per lane and ignores the rest."""
+    ``n_emit`` tokens per lane and ignores the rest.
+
+    Paged pools gather-run-scatter (see :func:`pool_admit`); the paged
+    kernel does not apply to the spec path — verify scores ``n_spec+1``
+    query positions, while the kernel is single-query decode."""
+    if pool_paged(pool):
+        view, toks, n_emit = pool_decode_spec(
+            params, _paged_gather(pool), active, cfg, n_cycles,
+            draft_layers=draft_layers, n_spec=n_spec,
+        )
+        return _paged_scatter(pool, view), toks, n_emit
     B = pool["logits"].shape[0]
     C = pool["k"].shape[3]
     D, k = draft_layers, n_spec

@@ -282,6 +282,10 @@ class TPUDecoderChat(BaseChat):
         spec_draft_layers: int | None = None,
         spec_k: int | None = None,
         kv_quant: str | bool | None = None,
+        paged_kv: bool | None = None,
+        paged_kv_block: int | None = None,
+        paged_kv_blocks: int | None = None,
+        paged_kernel: bool | None = None,
     ):
         # continuous=True: requests are served by a persistent slot-pool
         # loop (_ContinuousServer) — new rows admit into the IN-FLIGHT
@@ -364,6 +368,10 @@ class TPUDecoderChat(BaseChat):
                 spec_draft_layers=spec_draft_layers,
                 spec_k=spec_k,
                 kv_quant=kv_quant,
+                paged_kv=paged_kv,
+                paged_kv_block=paged_kv_block,
+                paged_kv_blocks=paged_kv_blocks,
+                paged_kernel=paged_kernel,
             )
             # the two-phase engine protocol only exists in continuous
             # mode — exposing these as CLASS methods would activate the
@@ -639,7 +647,21 @@ class _ContinuousServer:
     * **int8 KV** (PATHWAY_TPU_KV_QUANT=int8) — the slot pool and the
       prefix arena store KV as symmetric int8 + f32 per-token scales
       (~2x slots and cached blocks per HBM byte), dequantized on read
-      inside attention."""
+      inside attention.
+    * **paged KV** (PATHWAY_TPU_PAGED_KV) — slots stop owning dense
+      ``cache_len`` KV rows; KV lives in one global pool of fixed-size
+      blocks addressed through a per-slot block table, and admission
+      allocates only the blocks a request can actually reach (prompt +
+      its own ``max_new`` + pipeline slack) from a host
+      ``BlockAllocator``. The prefix cache runs in ADOPTED mode: a
+      finished prompt's blocks publish into the radix tree zero-copy
+      (pin, not ``kv_extract``) and a hit seeds a newcomer by writing
+      the shared ids into its block table copy-on-write — no arena
+      copies, so the ``prefix_copy_bytes`` ledger stays at zero.
+      Stranded bytes surface as the ``kv_fragmentation`` gauge.
+      PATHWAY_TPU_PAGED_KERNEL additionally routes plain decode chunks
+      through the Pallas paged-attention kernel
+      (``models/paged_attention.py``)."""
 
     def __init__(self, params, cfg, tokenizer, *, n_slots: int,
                  chunk_steps: int, max_prompt_tokens: int,
@@ -654,7 +676,11 @@ class _ContinuousServer:
                  spec_decode: bool | None = None,
                  spec_draft_layers: int | None = None,
                  spec_k: int | None = None,
-                 kv_quant: str | bool | None = None):
+                 kv_quant: str | bool | None = None,
+                 paged_kv: bool | None = None,
+                 paged_kv_block: int | None = None,
+                 paged_kv_blocks: int | None = None,
+                 paged_kernel: bool | None = None):
         import threading
         from collections import deque
 
@@ -726,6 +752,7 @@ class _ContinuousServer:
         slack = max(
             chunk_steps, (self.spec_k + 1) if self.spec_decode else 0
         )
+        self._slack = slack
         self.cache_len = (
             self.max_prompt_bucket + default_max_new
             + (self.pipeline_depth + 1) * slack
@@ -743,6 +770,46 @@ class _ContinuousServer:
             pathway_config.eager_refill
             if eager_refill is None else bool(eager_refill)
         )
+        # paged KV (PATHWAY_TPU_PAGED_KV): KV lives in a global pool of
+        # fixed-size blocks behind a per-slot block table
+        # (models/decoder.py paged_pool_init). The block size is a pow2
+        # multiple of the prefill chunk so cached prefixes end on piece
+        # boundaries; cache_len rounds UP to a whole number of blocks
+        # (table rows address whole blocks). The kill switch
+        # (PATHWAY_TPU_PAGED_KV=0) keeps the dense pool byte-identical.
+        self.paged_kv = bool(
+            pathway_config.paged_kv if paged_kv is None else paged_kv
+        )
+        self.paged_kernel = bool(self.paged_kv and (
+            pathway_config.paged_kernel
+            if paged_kernel is None else bool(paged_kernel)
+        ))
+        self.paged_block = 0
+        self._paged_blocks_override = 0
+        self._allocator = None
+        self._total_blocks = 0
+        # slot -> list of block ids the slot holds references on (its
+        # table row, sentinel-padded on device); slot -> reachable tokens
+        # (the fragmentation gauge's "needed" numerator, dense too)
+        self._slot_blocks: dict[int, list] = {}
+        self._slot_cover: dict[int, int] = {}
+        self._kv_frag = 0.0
+        self._frag_sum = 0.0
+        self._frag_n = 0
+        if self.paged_kv:
+            pb = (
+                pathway_config.paged_kv_block
+                if paged_kv_block is None else int(paged_kv_block)
+            )
+            self.paged_block = next_pow2(
+                max(pb, self.prefill_chunk), self.prefill_chunk
+            )
+            self.cache_len = -(-self.cache_len
+                               // self.paged_block) * self.paged_block
+            self._paged_blocks_override = max(0, (
+                pathway_config.paged_kv_blocks
+                if paged_kv_blocks is None else int(paged_kv_blocks)
+            ))
         # chunk-admission serving knobs (internals/config.py):
         # * batch_admit — same-bucket arrivals prefill in ONE grouped
         #   pool_admit_batch dispatch instead of one dispatch each;
@@ -783,8 +850,12 @@ class _ContinuousServer:
             )
             # block must be a pow2 multiple of the prefill chunk: cached
             # prefixes then end on piece boundaries, so the right-padded
-            # suffix never writes past the prompt's pow2 bucket
+            # suffix never writes past the prompt's pow2 bucket. Paged
+            # mode pins it to the POOL block — a cached block there IS a
+            # pool block (adopted zero-copy), so the sizes must agree.
             blk = next_pow2(max(blk, self.prefill_chunk), self.prefill_chunk)
+            if self.paged_kv:
+                blk = self.paged_block
             itemsize = _np_mod.dtype(cfg.dtype).itemsize
             # int8 KV: each cached head-token costs head_dim int8 bytes
             # plus one f32 scale instead of head_dim full-precision
@@ -797,9 +868,10 @@ class _ContinuousServer:
             n_blocks = int(mb * (1 << 20) // block_bytes)
             if n_blocks >= 1:
                 self.prefix_block = blk
-                self.prefix = PrefixCache(
+                self._prefix_kwargs = dict(
                     n_blocks=n_blocks, block=blk, block_bytes=block_bytes
                 )
+                self.prefix = self._make_prefix_cache()
         # request -> radix node whose root-path the request has pinned
         # (released when the request completes)
         self._prefix_nodes: dict = {}
@@ -827,7 +899,7 @@ class _ContinuousServer:
             it = _np_mod.dtype(cfg.dtype).itemsize
             base = sum(
                 int(self.pool[c].size) * it
-                for c in ("k", "v", "arena_k", "arena_v")
+                for c in ("k", "v", "kb", "vb", "arena_k", "arena_v")
                 if c in self.pool
             )
             self.kv_bytes_saved = base - decoder_mod.pool_bytes(self.pool)
@@ -847,6 +919,11 @@ class _ContinuousServer:
         self._prefill_fns: dict = {}
         self._admit_cached_fns: dict = {}
         self._extract_fns: dict = {}
+        # paged-mode jitted table editors (block shapes are static, so
+        # each is a singleton): admission seed (table row + cached-column
+        # mask) and the free-time row clear back to the sentinel block
+        self._paged_seed_jit = None
+        self._table_clear_jit = None
         # slot -> (remaining prefill pieces, n_prompt); drained one piece
         # per loop tick so prefill interleaves with decode chunks
         self._pending_prefill: dict[int, tuple] = {}
@@ -905,7 +982,7 @@ class _ContinuousServer:
             "spec_cycles": 0, "spec_drafted": 0, "spec_accepted": 0,
             "spec_emitted": 0, "spec_verify_steps": 0,
             "restarts": 0, "request_failures": 0, "request_retries": 0,
-            "shed": 0, "leaked_thread": 0,
+            "shed": 0, "leaked_thread": 0, "paged_oom": 0,
         }
         # in-flight chunk records, oldest first; an attribute (not a loop
         # local) so the failure sweep can fail eagerly-freed requests
@@ -929,13 +1006,135 @@ class _ContinuousServer:
     def _build_pool(self):
         """A fresh ``pool_init`` state sized for this server — used at
         construction and again by the supervised restart path (a crash
-        mid-dispatch may have invalidated the donated pool buffers)."""
+        mid-dispatch may have invalidated the donated pool buffers).
+        Paged mode instead builds ``paged_pool_init`` plus a fresh host
+        ``BlockAllocator``; the block count defaults to the dense pool's
+        capacity (every slot's full table plus the prefix budget plus
+        the sentinel), and ``PATHWAY_TPU_PAGED_KV_BLOCKS`` overrides it
+        for oversubscription (allocator raises ``PagedPoolOOM`` when a
+        burst doesn't fit — admission parks the request)."""
+        if self.paged_kv:
+            per_slot = self.cache_len // self.paged_block
+            auto = self.n_slots * per_slot + (
+                self.prefix.capacity_blocks if self.prefix is not None else 0
+            ) + 1
+            self._total_blocks = max(2, self._paged_blocks_override or auto)
+            self._allocator = self._D.BlockAllocator(self._total_blocks)
+            self._slot_blocks = {}
+            self._paged_seed_jit = None
+            self._table_clear_jit = None
+            return self._D.paged_pool_init(
+                self.params, self.cfg, self.n_slots, self.cache_len,
+                n_blocks=self._total_blocks, block=self.paged_block,
+                kv_quant=bool(self.kv_quant),
+            )
         return self._D.pool_init(
             self.params, self.cfg, self.n_slots, self.cache_len,
             arena_blocks=(self.prefix.capacity_blocks if self.prefix else 0),
             arena_block=self.prefix_block,
             kv_quant=bool(self.kv_quant),
         )
+
+    def _make_prefix_cache(self):
+        """The prefix tree for this server: arena-backed normally;
+        ADOPTED in paged mode — cached ids are global-pool blocks held
+        through the allocator's pin/release refcounts (the lambdas
+        late-bind ``self._allocator`` so a supervised pool rebuild swaps
+        the allocator under the same tree factory)."""
+        from pathway_tpu.engine.prefix_cache import PrefixCache
+
+        kw = dict(self._prefix_kwargs)
+        if self.paged_kv:
+            kw["pin"] = lambda ids: self._allocator.pin(ids)
+            kw["unpin"] = lambda ids: self._allocator.release(ids)
+        return PrefixCache(**kw)
+
+    def _paged_seed_fn(self):
+        """Jitted paged admission seed: install a slot's block-table row
+        and its cached-column mask in one donated table edit
+        (``paged_admit_cached`` — COW, no KV bytes move)."""
+        if self._paged_seed_jit is None:
+            import jax
+
+            D = self._D
+
+            def seed(pool, slot, row, n_cached):
+                return D.paged_admit_cached(pool, slot, row, n_cached)
+
+            self._paged_seed_jit = jax.jit(seed, donate_argnums=(0,))
+        return self._paged_seed_jit
+
+    def _table_clear_fn(self):
+        """Jitted free-time row clear: point every entry of a freed
+        slot's table row at the sentinel block BEFORE its blocks return
+        to the allocator. Without this, a stale row and a new owner's
+        row could reference the same physical block and the
+        gather-run-scatter round trip would write both copies back in
+        nondeterministic order."""
+        if self._table_clear_jit is None:
+            import jax
+            import jax.numpy as jnp
+
+            D = self._D
+            M = self.cache_len // self.paged_block
+
+            def clear(pool, slot):
+                return D.paged_table_set(
+                    pool, slot, jnp.zeros((M,), jnp.int32)
+                )
+
+            self._table_clear_jit = jax.jit(clear, donate_argnums=(0,))
+        return self._table_clear_jit
+
+    def _release_slot_kv(self, slot: int) -> None:
+        """Host-side KV bookkeeping when a slot frees: drop its
+        fragmentation cover and, in paged mode, clear its table row and
+        release its block references (blocks a prefix node still pins
+        stay resident)."""
+        self._slot_cover.pop(slot, None)
+        if self._allocator is not None:
+            row = self._slot_blocks.pop(slot, None)
+            if row:
+                import numpy as np
+
+                self.pool = self._table_clear_fn()(
+                    self.pool, np.int32(slot)
+                )
+                self._allocator.release(row)
+        self._update_fragmentation()
+
+    def _update_fragmentation(self) -> None:
+        """Refresh the ``kv_fragmentation`` gauge: 1 - reachable/allocated
+        KV bytes over the active slots. A dense slot always allocates the
+        full ``cache_len`` row; a paged slot allocates only its table's
+        blocks, so the gauge is the direct HBM-stranding comparison the
+        bench surfaces (``serving.kv_fragmentation``)."""
+        from pathway_tpu.engine.probes import record_kv_fragmentation
+
+        covers = self._slot_cover
+        if not covers:
+            frag = 0.0
+        else:
+            needed = sum(covers.values())
+            if self.paged_kv:
+                alloc = sum(
+                    len(self._slot_blocks.get(s, ())) * self.paged_block
+                    for s in covers
+                )
+            else:
+                alloc = len(covers) * self.cache_len
+            frag = max(0.0, 1.0 - needed / alloc) if alloc else 0.0
+            self._frag_sum += frag
+            self._frag_n += 1
+        self._kv_frag = frag
+        record_kv_fragmentation(frag, server=self._trace_tag)
+
+    def kv_fragmentation(self) -> dict:
+        """Current and admission-averaged stranded-KV fraction."""
+        return {
+            "current": float(self._kv_frag),
+            "mean": (self._frag_sum / self._frag_n) if self._frag_n else 0.0,
+        }
 
     def _recover_after_crash(self, exc: BaseException) -> None:
         """Reset the server to an admittable state after a loop-scoped
@@ -964,10 +1163,16 @@ class _ContinuousServer:
             self.stats["restarts"] += 1
         self._pending_prefill.clear()
         self._sent = [0] * self.n_slots
+        self._slot_cover.clear()
+        self._slot_blocks.clear()
         self.pool = self._build_pool()
-        # the rebuilt pool's prefix arena is empty: reset the host radix
-        # tree to match (prefix_reset also drops the per-request pins)
-        self.prefix_reset()
+        # the rebuilt pool's prefix arena/allocator is empty: reset the
+        # host radix tree to match (prefix_reset also drops the
+        # per-request pins). unpin=False — the old tree's block pins
+        # died with the allocator _build_pool just replaced, so they
+        # must NOT release into the fresh one.
+        self.prefix_reset(unpin=False)
+        self._update_fragmentation()
         seen: set[int] = set()
         requeue: list = []
         for req in victims:
@@ -1035,6 +1240,7 @@ class _ContinuousServer:
         if active is not None:
             active[slot] = False
         self._prefix_release(req)
+        self._release_slot_kv(slot)
         with self.lock:
             self.free.append(int(slot))
         req.retries += 1
@@ -1195,11 +1401,13 @@ class _ContinuousServer:
 
             D, cfgc = self._D, self.cfg
             temp, tk, tp = self._temperature, self._top_k, self._top_p
+            pk = self.paged_kernel
 
             def chunk(params_, pool, active, key):
                 return D.pool_decode_chunk(
                     params_, pool, active, key, cfgc, steps,
                     temperature=temp, top_k=tk, top_p=tp,
+                    paged_kernel=pk,
                 )
 
             fn = jax.jit(chunk, donate_argnums=(1,))
@@ -1328,14 +1536,28 @@ class _ContinuousServer:
 
         from pathway_tpu.engine import probes
 
-        node, first_new, new_ids = self.prefix.insert(e)
-        if new_ids:
-            self.pool = self._extract_fn(len(new_ids))(
-                self.pool, np.int32(slot),
-                np.int32(base + first_new * self.prefix_block),
-                np.asarray(new_ids, np.int32),
+        if self.paged_kv:
+            # zero-copy adoption: the slot's OWN blocks (its table row)
+            # become the cached prefix — the tree pins them through the
+            # allocator, no kv_extract dispatch, no duplicate HBM bytes.
+            # Right-padded paged admission puts block i of the prompt in
+            # row entry i, so the row prefix IS the block_ids argument.
+            row = self._slot_blocks.get(slot)
+            if row is None:
+                return
+            nfull = min(len(e) // self.prefix_block, len(row))
+            node, _first_new, _new = self.prefix.insert(
+                e, n_blocks=nfull, block_ids=row
             )
-            probes.record_device_dispatch("prefix_extract")
+        else:
+            node, first_new, new_ids = self.prefix.insert(e)
+            if new_ids:
+                self.pool = self._extract_fn(len(new_ids))(
+                    self.pool, np.int32(slot),
+                    np.int32(base + first_new * self.prefix_block),
+                    np.asarray(new_ids, np.int32),
+                )
+                probes.record_device_dispatch("prefix_extract")
         old = self._prefix_nodes.get(req)
         self.prefix.acquire(node)
         if old is not None:
@@ -1347,19 +1569,21 @@ class _ContinuousServer:
         if node is not None and self.prefix is not None:
             self.prefix.release(node)
 
-    def prefix_reset(self) -> None:
+    def prefix_reset(self, *, unpin: bool = True) -> None:
         """Drop every cached prefix and zero the per-server prefix
         counters (bench: warm up the executables, then measure a clean
-        trace). Only call while no requests are in flight."""
+        trace). Only call while no requests are in flight. In paged
+        mode the tree's adopted blocks unpin back into the allocator;
+        the supervised restart path passes ``unpin=False`` because its
+        pool rebuild already replaced the allocator the old pins lived
+        in."""
         if self.prefix is None:
             return
-        from pathway_tpu.engine.prefix_cache import PrefixCache
-
         self._prefix_nodes.clear()
-        self.prefix = PrefixCache(
-            n_blocks=self.prefix.capacity_blocks, block=self.prefix.block,
-            block_bytes=self.prefix.block_bytes,
-        )
+        if self.paged_kv and unpin:
+            self.prefix.reset()
+        else:
+            self.prefix = self._make_prefix_cache()
         for k in ("prefix_hit_tokens", "prefix_miss_tokens",
                   "prefix_hit_requests", "prefix_requests"):
             self.stats[k] = 0
@@ -1384,6 +1608,16 @@ class _ContinuousServer:
             req.max_new = min(
                 req.max_new, max(1, self._default_max_new // 2)
             )
+        if self.paged_kv:
+            self._admit_one_paged(slot, req, e, n)
+            return
+        # reachable span for the fragmentation gauge: a dense slot pins
+        # the whole cache_len row regardless
+        self._slot_cover[slot] = min(
+            self.cache_len,
+            n + req.max_new + (self.pipeline_depth + 1) * self._slack,
+        )
+        self._update_fragmentation()
         B = self.prefix_block
         # prefix-cache accounting + match. A hit never reuses the
         # prompt's FINAL (partial or last-full) block: at least
@@ -1419,6 +1653,11 @@ class _ContinuousServer:
                 self.pool, np.int32(slot),
                 np.asarray(arena_ids[:m_hit], np.int32),
             )
+            # the seed COPIES arena blocks into the slot row: those KV
+            # bytes now exist twice in HBM until the slot frees. The
+            # ledger makes the double-count visible (the paged pool's
+            # copy-on-write tables drive it to zero).
+            record_prefix("copy_bytes", m_hit * self.prefix.block_bytes)
             n_cached = m_hit * B
             P = self.prefill_chunk
             W = n_cached + -((n_cached - n) // P) * P
@@ -1482,6 +1721,111 @@ class _ContinuousServer:
             if ins is not None:
                 direct_inserts.append((slot, ins))
         self.stats["admitted"] += 1
+
+    def _admit_one_paged(self, slot: int, req, e: list, n: int) -> None:
+        """Paged admission: allocate exactly the blocks this request can
+        reach, install the slot's block-table row, seed any cached
+        prefix by SHARING blocks (copy-on-write pins — no arena copy
+        dispatch), and schedule the prompt as right-padded prefill
+        pieces. Every paged admission right-pads (token i at cache
+        column i): that is the layout invariant that lets a finished
+        prompt's blocks publish into the prefix tree zero-copy. On
+        ``PagedPoolOOM`` nothing has been written — the request parks
+        at the queue head until blocks free up."""
+        import numpy as np
+
+        from pathway_tpu.engine.probes import record_prefix
+
+        if not e:
+            # degenerate empty prompt: one pad token at column 0 (the
+            # dense path's mask-only-last-column admission computes the
+            # same single-token attention)
+            e, n = [0], 1
+        B = self.paged_block
+        per_slot = self.cache_len // B
+        m_hit, pool_ids, node = 0, [], None
+        if self.prefix is not None and n > B:
+            m, pool_ids, node = self.prefix.match(e)
+            m_hit = min(m, (n - 1) // B)
+            hit_t = m_hit * B
+            record_prefix("requests", 1)
+            record_prefix("hit_tokens", hit_t)
+            record_prefix("miss_tokens", n - hit_t)
+            if m_hit:
+                record_prefix("hit_requests", 1)
+                self.stats["prefix_hit_requests"] += 1
+            self.stats["prefix_requests"] += 1
+            self.stats["prefix_hit_tokens"] += hit_t
+            self.stats["prefix_miss_tokens"] += n - hit_t
+            req.span.event(
+                "prefix_match", hit_blocks=int(m_hit),
+                hit_tokens=int(hit_t), miss_tokens=int(n - hit_t),
+            )
+        # worst-case columns the lane can write: prompt + its own answer
+        # budget + one chunk of overrun slack per in-flight chunk (the
+        # same bound that sizes the dense cache_len)
+        cover = min(
+            self.cache_len,
+            n + req.max_new + (self.pipeline_depth + 1) * self._slack,
+        )
+        need = min(per_slot, -(-cover // B))
+        try:
+            fresh = self._allocator.alloc(need - m_hit)
+        except self._D.PagedPoolOOM as oom:
+            self.slots[slot] = None
+            with self.lock:
+                self.free.append(int(slot))
+            if need - m_hit > self._total_blocks - 1:
+                # can never fit, even against an idle pool
+                self._fail_request(req, "paged_oom")
+                return
+            req.span.event(
+                "paged_oom", want=int(oom.want), free=int(oom.free)
+            )
+            self.stats["paged_oom"] += 1
+            with self.lock:
+                self.queue.appendleft(req)
+            return
+        shared = [int(i) for i in pool_ids[:m_hit]]
+        if shared:
+            # the slot's OWN reference on the shared blocks — balanced
+            # by the release in _release_slot_kv, independent of the
+            # tree's pin (which the prefix node's refcount protects)
+            self._allocator.pin(shared)
+        row = shared + fresh
+        self._slot_blocks[slot] = row
+        self._slot_cover[slot] = cover
+        n_cached = m_hit * B
+        row_arr = np.zeros((per_slot,), np.int32)
+        row_arr[:len(row)] = row
+        # one donated table edit installs the row and the cached-column
+        # mask (all-zero mask when n_cached == 0); shared KV bytes never
+        # move — suffix and decode writes land past the shared run
+        self.pool = self._paged_seed_fn()(
+            self.pool, np.int32(slot), row_arr, np.int32(n_cached)
+        )
+        if m_hit:
+            self.prefix.acquire(node)
+            self._prefix_nodes[req] = node
+        P = self.prefill_chunk
+        W = n_cached + -((n_cached - n) // P) * P
+        r_ids = np.zeros((1, W), np.int32)
+        r_mask = np.zeros((1, W), np.int32)
+        r_ids[0, :n] = e
+        r_mask[0, :n] = 1
+        pos = np.minimum(np.arange(W), n - 1)[None, :].astype(np.int32)
+        n_prompt = np.asarray([n], np.int32)
+        pieces = [
+            (r_ids[:, o:o + P], r_mask[:, o:o + P], pos[:, o:o + P], o)
+            for o in range(n_cached, W, P)
+        ]
+        lc = (n - 1) - (W - P)
+        meta = {"last_col": None if lc == P - 1 else lc}
+        if self.prefix is not None and n >= B:
+            meta["insert"] = (req, e, 0)
+        self._pending_prefill[slot] = (pieces, n_prompt, meta)
+        self.stats["admitted"] += 1
+        self._update_fragmentation()
 
     def _prefill_piece(self, slot: int, active) -> None:
         """Dispatch one pending prefill piece for ``slot`` (a method so
@@ -1630,6 +1974,7 @@ class _ContinuousServer:
                     # enqueued after this chunk.
                     self.slots[slot] = None
                     active[slot] = False
+                    self._release_slot_kv(slot)
                     with self.lock:
                         self.free.append(int(slot))
             return True
@@ -1845,6 +2190,7 @@ class _ContinuousServer:
                     if self.slots[slot] is req:
                         self.slots[slot] = None
                         active[slot] = False
+                        self._release_slot_kv(slot)
                         with self.lock:
                             self.free.append(int(slot))
                     self._prefix_release(req)

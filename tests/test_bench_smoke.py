@@ -90,6 +90,11 @@ def test_bench_smoke_schema():
         # fault-tolerance accounting (PR 10): a clean smoke run reports
         # zero sheds/restarts and a quiescent degradation ladder
         "requests_shed", "restarts", "degradation_level",
+        # paged KV trace (PR 11): both arms' throughput, both gauges,
+        # and the fixed-HBM admissibility comparison
+        "kv_fragmentation", "kv_fragmentation_dense", "paged_tok_s",
+        "dense_tok_s", "paged_max_slots", "dense_max_slots",
+        "paged_tokens_match",
     ):
         assert srv.get(key) is not None, key
     # span-derived latencies are real measurements off the decode phase
@@ -120,6 +125,15 @@ def test_bench_smoke_schema():
     assert srv["kv_quant_tok_s"] > 0
     # the int8 arm actually shrank the KV footprint
     assert srv["kv_bytes_saved"] > 0
+    # the paged-KV trace: identical greedy tokens across arms, a
+    # fragmentation gauge strictly below the dense pool's, and strictly
+    # more admissible slots at the same HBM budget
+    assert srv["paged_tokens_match"]
+    assert 0.0 <= srv["kv_fragmentation"] <= 1.0
+    assert 0.0 <= srv["kv_fragmentation_dense"] <= 1.0
+    assert srv["kv_fragmentation"] < srv["kv_fragmentation_dense"]
+    assert srv["paged_tok_s"] > 0 and srv["dense_tok_s"] > 0
+    assert srv["paged_max_slots"] > srv["dense_max_slots"] > 0
     # pipeline-depth observability (PR 9): per-operator latency telemetry
     # sampled during the streaming phases, the HBM ledger saw the decoder
     # pools, and the SLO watchdog state rode the summary out
@@ -128,7 +142,12 @@ def test_bench_smoke_schema():
     assert eng["operators"] > 0
     assert s["hbm_high_water_bytes"] > 0
     comps = s["hbm_components"]
-    assert comps.get("slot_pool", 0) > 0, comps
+    # dense servers report slot_pool; the paged-arm servers report the
+    # global block pool + table (either proves the ledger saw a pool)
+    assert comps.get("slot_pool", 0) > 0 or comps.get("kv_blocks", 0) > 0, \
+        comps
+    assert comps.get("kv_blocks", 0) > 0 and \
+        comps.get("block_table", 0) > 0, comps
     slo = s["slo"]
     assert slo["breaches"] == 0 and slo["alerting"] == []
     assert slo["enabled"] in (True, False)

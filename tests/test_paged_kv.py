@@ -1,0 +1,423 @@
+"""Paged block-table KV store (PATHWAY_TPU_PAGED_KV) + Pallas paged
+attention (PATHWAY_TPU_PAGED_KERNEL): one global pool of fixed-size KV
+blocks, a per-slot block table, host-side allocation/refcounts, and
+copy-on-write prefix sharing.
+
+Pinned here: the BlockAllocator's determinism / atomic-OOM / refcount
+semantics, the gather-run-scatter byte-equality claim (paged greedy
+tokens == dense pool, across the spec x prefix x int8 grid and both
+kill switches), kernel numerics against the dense attention reference
+at every (heads, block, seq) corner, the zero-copy prefix claim
+(copy_bytes stays flat under PATHWAY_TPU_PAGED_KV), the
+kv_fragmentation gauge, and that a deliberately undersized pool
+(PATHWAY_TPU_PAGED_KV_BLOCKS) parks requests on PagedPoolOOM without
+tearing the block table. PATHWAY_TPU_PAGED_KV_BLOCK sizes the block."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models import decoder as D
+from pathway_tpu.models import paged_attention as PA
+from tests.utils import ToyCharTokenizer
+
+TINY = D.DecoderConfig(
+    vocab_size=128, hidden=32, layers=2, heads=4, intermediate=64,
+    max_position=256, dtype=jnp.float32,
+)
+N_SLOTS, CACHE_LEN, BLOCK = 4, 96, 16
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return D.init_params(jax.random.PRNGKey(0), TINY)
+
+
+# -- allocator units ---------------------------------------------------------
+
+
+def test_allocator_low_ids_first_deterministic():
+    a = D.BlockAllocator(8)
+    assert a.alloc(3) == [1, 2, 3]
+    assert a.alloc(2) == [4, 5]
+    a.release([2])
+    # freed ids recycle before untouched ones (append + tail pop)
+    assert a.alloc(1) == [2]
+    assert a.n_allocated == 5 and a.n_free == 2
+
+
+def test_allocator_oom_is_typed_and_atomic():
+    """alloc raises PagedPoolOOM BEFORE mutating: want/free are carried
+    on the exception and the free list / refcounts are untouched, so a
+    failed admission leaves no torn state to unwind."""
+    a = D.BlockAllocator(6)
+    a.alloc(3)
+    before = a.stats()
+    with pytest.raises(D.PagedPoolOOM) as ei:
+        a.alloc(3)
+    assert ei.value.want == 3 and ei.value.free == 2
+    assert a.stats() == before
+    assert a.alloc(2) == [4, 5]  # the 2 free blocks are still intact
+
+
+def test_allocator_cow_refcounts():
+    a = D.BlockAllocator(4)
+    (b,) = a.alloc(1)
+    a.pin([b])  # a second slot shares the block copy-on-write
+    assert a.stats()["shared"] == 1
+    a.release([b])
+    assert a.n_allocated == 1  # still referenced by the other holder
+    a.release([b])
+    assert a.n_allocated == 0 and a.n_free == 3
+    with pytest.raises(ValueError):
+        a.pin([b])
+    with pytest.raises(ValueError):
+        a.release([b])
+
+
+def test_allocator_needs_sentinel():
+    with pytest.raises(ValueError):
+        D.BlockAllocator(1)
+
+
+# -- paged pool layout -------------------------------------------------------
+
+
+def test_paged_pool_init_validates(tiny_params):
+    with pytest.raises(ValueError):
+        D.paged_pool_init(tiny_params, TINY, N_SLOTS, 100, n_blocks=8,
+                          block=16)  # cache_len % block != 0
+    with pytest.raises(ValueError):
+        D.paged_pool_init(tiny_params, TINY, N_SLOTS, 96, n_blocks=1,
+                          block=16)
+
+
+def test_paged_component_bytes(tiny_params):
+    pool = D.paged_pool_init(tiny_params, TINY, N_SLOTS, CACHE_LEN,
+                             n_blocks=8, block=BLOCK, kv_quant=True)
+    comps = D.pool_component_bytes(pool)
+    assert "kv_blocks" in comps and "block_table" in comps
+    assert "kv_scales" in comps and "slot_pool" not in comps
+    assert comps["block_table"] == N_SLOTS * (CACHE_LEN // BLOCK) * 4
+    assert D.pool_bytes(pool) == sum(comps.values())
+
+
+def test_dense_arena_ops_refuse_paged_pool(tiny_params):
+    pool = D.paged_pool_init(tiny_params, TINY, N_SLOTS, CACHE_LEN,
+                             n_blocks=8, block=BLOCK)
+    idxs = jnp.zeros((1,), jnp.int32)
+    with pytest.raises(ValueError):
+        D.kv_extract(pool, jnp.int32(0), jnp.int32(0), idxs, TINY)
+    with pytest.raises(ValueError):
+        D.kv_insert(pool, jnp.int32(0), jnp.int32(0), idxs, TINY)
+    with pytest.raises(ValueError):
+        D.pool_admit_cached(pool, jnp.int32(0), idxs, TINY)
+
+
+# -- gather-run-scatter byte equality (decoder level) ------------------------
+
+
+def _full_table_pool(params, cfg, kv_quant):
+    """Paged pool whose table gives every slot a full row of DISTINCT
+    blocks — the gathered view is then byte-for-byte a dense pool."""
+    M = CACHE_LEN // BLOCK
+    pool = D.paged_pool_init(params, cfg, N_SLOTS, CACHE_LEN,
+                             n_blocks=N_SLOTS * M + 1, block=BLOCK,
+                             kv_quant=kv_quant)
+    tbl = 1 + np.arange(N_SLOTS * M, dtype=np.int32).reshape(N_SLOTS, M)
+    pool["block_tbl"] = jnp.asarray(tbl)
+    return pool
+
+
+def _admit(params, cfg, pool):
+    S = 16
+    rng = np.random.default_rng(3)
+    ids = np.zeros((N_SLOTS, S), np.int32)
+    mask = np.zeros((N_SLOTS, S), np.int32)
+    for r, n in enumerate([6, 10, 4, 8]):
+        ids[r, S - n:] = rng.integers(1, 97, n)
+        mask[r, S - n:] = 1
+    return D.pool_admit_batch(
+        params, jnp.asarray(ids), jnp.asarray(mask), pool,
+        jnp.arange(N_SLOTS, dtype=jnp.int32), cfg,
+    )
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_grs_byte_equality_admit_and_decode(tiny_params, kv_quant):
+    """The reference-path claim: admit + decode on a paged pool produce
+    byte-identical KV, logits, cursors, and tokens to the dense pool."""
+    dense = _admit(tiny_params, TINY,
+                   D.pool_init(tiny_params, TINY, N_SLOTS, CACHE_LEN,
+                               kv_quant=kv_quant))
+    paged = _admit(tiny_params, TINY,
+                   _full_table_pool(tiny_params, TINY, kv_quant))
+    act = jnp.ones((N_SLOTS,), bool)
+    key = jax.random.PRNGKey(1)
+    dense, dt = D.pool_decode_chunk(tiny_params, dense, act, key, TINY, 16)
+    paged, pt = D.pool_decode_chunk(tiny_params, paged, act, key, TINY, 16)
+    assert np.array_equal(np.asarray(dt), np.asarray(pt))
+    view = D._paged_gather(paged)
+    for k in ("k", "v", "logits", "slot_mask", "pos", "write"):
+        assert np.array_equal(np.asarray(dense[k]), np.asarray(view[k])), k
+    if kv_quant:
+        assert np.array_equal(np.asarray(dense["k_scale"]),
+                              np.asarray(view["k_scale"]))
+
+
+def test_grs_spec_decode_matches_dense(tiny_params):
+    act = jnp.ones((N_SLOTS,), bool)
+    _, dt, dn = D.pool_decode_spec(
+        tiny_params,
+        _admit(tiny_params, TINY,
+               D.pool_init(tiny_params, TINY, N_SLOTS, CACHE_LEN)),
+        act, TINY, 8, draft_layers=1, n_spec=3,
+    )
+    _, pt, pn = D.pool_decode_spec(
+        tiny_params,
+        _admit(tiny_params, TINY, _full_table_pool(tiny_params, TINY, False)),
+        act, TINY, 8, draft_layers=1, n_spec=3,
+    )
+    assert np.array_equal(np.asarray(dn), np.asarray(pn))
+    assert np.array_equal(np.asarray(dt), np.asarray(pt))
+
+
+# -- Pallas kernel numerics --------------------------------------------------
+
+
+def _kernel_case(nh, Bk, M, quant, seed=0):
+    rng = np.random.default_rng(seed)
+    B, hd = 3, 8
+    n_blocks = B * M + 1
+    q = rng.normal(0, 1, (B, nh, hd)).astype(np.float32)
+    if quant:
+        kb = rng.integers(-127, 128, (n_blocks, nh, Bk, hd)).astype(np.int8)
+        vb = rng.integers(-127, 128, (n_blocks, nh, Bk, hd)).astype(np.int8)
+        ks = rng.uniform(0.01, 0.1, (n_blocks, nh, Bk, 1)).astype(np.float32)
+        vs = rng.uniform(0.01, 0.1, (n_blocks, nh, Bk, 1)).astype(np.float32)
+    else:
+        kb = rng.normal(0, 1, (n_blocks, nh, Bk, hd)).astype(np.float32)
+        vb = rng.normal(0, 1, (n_blocks, nh, Bk, hd)).astype(np.float32)
+        ks = vs = None
+    # each slot gets M distinct non-sentinel blocks, shuffled
+    perm = rng.permutation(np.arange(1, n_blocks)).astype(np.int32)
+    tbl = perm[: B * M].reshape(B, M)
+    mask = np.zeros((B, M * Bk), np.int32)
+    for b in range(B):
+        mask[b, : int(rng.integers(1, M * Bk + 1))] = 1
+    return q, kb, vb, ks, vs, tbl, mask
+
+
+def _dense_attn_ref(q, kb, vb, ks, vs, tbl, mask):
+    """Plain-softmax attention over the gathered dense view — the same
+    math ``_attn_ctx`` runs on the reference path."""
+    k = kb[tbl].transpose(0, 2, 1, 3, 4)  # (B, nh, M, Bk, hd)
+    v = vb[tbl].transpose(0, 2, 1, 3, 4)
+    B, nh, M, Bk, hd = k.shape
+    k = k.reshape(B, nh, M * Bk, hd).astype(np.float32)
+    v = v.reshape(B, nh, M * Bk, hd).astype(np.float32)
+    if ks is not None:
+        k = k * ks[tbl].transpose(0, 2, 1, 3, 4).reshape(B, nh, M * Bk, 1)
+        v = v * vs[tbl].transpose(0, 2, 1, 3, 4).reshape(B, nh, M * Bk, 1)
+    s = np.einsum("bnd,bntd->bnt", q, k) / np.sqrt(hd)
+    s = np.where(mask[:, None, :] > 0, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bnt,bntd->bnd", p, v)
+
+
+@pytest.mark.parametrize("nh,Bk,M", [
+    (1, 8, 1), (4, 8, 3), (2, 16, 2), (4, 16, 4),
+])
+@pytest.mark.parametrize("quant", [False, True])
+def test_kernel_matches_dense_reference(nh, Bk, M, quant):
+    """Every (heads, block, seq) corner: the online-softmax kernel
+    agrees with the plain-softmax dense reference at f32 tolerance."""
+    q, kb, vb, ks, vs, tbl, mask = _kernel_case(nh, Bk, M, quant)
+    out = PA.paged_attn_decode(
+        jnp.asarray(q), jnp.asarray(kb), jnp.asarray(vb),
+        None if ks is None else jnp.asarray(ks),
+        None if vs is None else jnp.asarray(vs),
+        jnp.asarray(tbl), jnp.asarray(mask),
+    )
+    ref = _dense_attn_ref(q, kb, vb, ks, vs, tbl, mask)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_kernel_empty_slot_outputs_zero():
+    """A never-admitted slot (all-masked row) must produce exact zeros,
+    not NaN — the denom guard divides by 1 instead of 0."""
+    q, kb, vb, ks, vs, tbl, mask = _kernel_case(2, 8, 2, False)
+    mask[1, :] = 0
+    out = np.asarray(PA.paged_attn_decode(
+        jnp.asarray(q), jnp.asarray(kb), jnp.asarray(vb), None, None,
+        jnp.asarray(tbl), jnp.asarray(mask),
+    ))
+    assert np.all(out[1] == 0.0) and np.isfinite(out).all()
+
+
+def test_kernel_rejects_mask_table_mismatch():
+    q, kb, vb, ks, vs, tbl, mask = _kernel_case(2, 8, 2, False)
+    with pytest.raises(ValueError):
+        PA.paged_attn_decode(
+            jnp.asarray(q), jnp.asarray(kb), jnp.asarray(vb), None, None,
+            jnp.asarray(tbl), jnp.asarray(mask[:, :-1]),
+        )
+
+
+def test_kernel_pool_decode_matches_reference_tokens(tiny_params):
+    """_paged_decode_chunk_kernel (the serving fast path) emits the same
+    greedy tokens as the gather-run-scatter reference on the same pool."""
+    act = jnp.ones((N_SLOTS,), bool)
+    key = jax.random.PRNGKey(1)
+    ref_pool = _admit(tiny_params, TINY,
+                      _full_table_pool(tiny_params, TINY, False))
+    _, rt = D.pool_decode_chunk(tiny_params, ref_pool, act, key, TINY, 12)
+    krn_pool = _admit(tiny_params, TINY,
+                      _full_table_pool(tiny_params, TINY, False))
+    _, kt = D.pool_decode_chunk(tiny_params, krn_pool, act, key, TINY, 12,
+                                paged_kernel=True)
+    assert np.array_equal(np.asarray(rt), np.asarray(kt))
+
+
+# -- serving -----------------------------------------------------------------
+
+
+PROMPTS = ["hello world", "continuous batching", "abc", "qrs tuv"]
+HEAD = "x" * 56
+
+
+def _serve(tiny_params, prompts, batch=False, **kw):
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    chat = TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(96),
+        max_new_tokens=10, temperature=0.0, max_prompt_tokens=96,
+        continuous=True, n_slots=4, chunk_steps=4, pipeline_depth=2,
+        prefill_chunk=8, **kw,
+    )
+    try:
+        if batch:
+            reqs = chat.submit_batch(list(prompts))
+            for r in reqs:
+                assert r.done.wait(timeout=180)
+            out = [r.text for r in reqs]
+        else:
+            out = []
+            for p in prompts:
+                r = chat.submit_batch([p])[0]
+                assert r.done.wait(timeout=180)
+                out.append(r.text)
+        return out, dict(chat._server.stats), chat._server
+    finally:
+        chat.close()
+
+
+@pytest.fixture(scope="module")
+def plain_burst(tiny_params):
+    """Dense serving pass over PROMPTS: the byte-equality reference for
+    every paged arm, plus its fragmentation gauge reading."""
+    texts, _, srv = _serve(tiny_params, PROMPTS, paged_kv=False)
+    return texts, srv.kv_fragmentation()
+
+
+def test_kill_switch_byte_equality(tiny_params, plain_burst, monkeypatch):
+    """PATHWAY_TPU_PAGED_KV=0: the pool is the dense slot pool (no block
+    table, no allocator) and output matches the pre-paged server."""
+    monkeypatch.setenv("PATHWAY_TPU_PAGED_KV", "0")
+    off, _, srv = _serve(tiny_params, PROMPTS, paged_kv=None)
+    assert not srv.paged_kv and not D.pool_paged(srv.pool)
+    assert srv._allocator is None
+    assert off == plain_burst[0]
+
+
+def test_env_flag_enables_paged(tiny_params, plain_burst, monkeypatch):
+    """PATHWAY_TPU_PAGED_KV=1 (+ PATHWAY_TPU_PAGED_KV_BLOCK): paged pool,
+    greedy tokens byte-identical to dense, ledger reports block planes,
+    and all drained slots return their blocks to the allocator."""
+    monkeypatch.setenv("PATHWAY_TPU_PAGED_KV", "1")
+    monkeypatch.setenv("PATHWAY_TPU_PAGED_KV_BLOCK", "16")
+    on, _, srv = _serve(tiny_params, PROMPTS, paged_kv=None)
+    assert srv.paged_kv and D.pool_paged(srv.pool)
+    assert srv.paged_block == 16 and srv.cache_len % 16 == 0
+    comps = D.pool_component_bytes(srv.pool)
+    assert "kv_blocks" in comps and "block_table" in comps
+    assert on == plain_burst[0]
+    tree_used = srv.prefix.used_blocks if srv.prefix is not None else 0
+    assert srv._allocator.n_allocated == tree_used
+
+
+def test_paged_kernel_serving_matches_dense(tiny_params, plain_burst,
+                                            monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_PAGED_KV", "1")
+    monkeypatch.setenv("PATHWAY_TPU_PAGED_KERNEL", "1")
+    out, _, srv = _serve(tiny_params, PROMPTS[:2], paged_kv=None,
+                         paged_kernel=None)
+    assert srv.paged_kernel
+    assert out == plain_burst[0][:2]
+
+
+def test_paged_prefix_is_zero_copy(tiny_params):
+    """The COW claim: dense prefix hits COPY arena blocks into the slot
+    (copy_bytes grows); paged hits PIN shared blocks (copy_bytes flat),
+    with identical output and the same hit accounting."""
+    from pathway_tpu.engine import probes
+
+    hp = [HEAD + f"q{k:02d}xx" for k in range(4)]
+    a, astats, _ = _serve(tiny_params, hp, paged_kv=False,
+                          prefix_cache=True)
+    cb_dense = probes.prefix_stats()["copy_bytes"]
+    b, bstats, bsrv = _serve(tiny_params, hp, paged_kv=True,
+                             prefix_cache=True)
+    cb_paged = probes.prefix_stats()["copy_bytes"] - cb_dense
+    assert a == b
+    assert astats["prefix_hit_requests"] > 0
+    assert bstats["prefix_hit_requests"] > 0
+    assert cb_dense > 0 and cb_paged == 0
+    # shared blocks live on in the tree, pinned — allocator agrees
+    assert bsrv._allocator.n_allocated == bsrv.prefix.used_blocks
+
+
+def test_paged_full_stack_grid(tiny_params):
+    """spec x prefix x int8 on the paged pool matches the same stack on
+    the dense pool — the full byte-equality grid in one arm."""
+    hp = [HEAD + f"q{k:02d}xx" for k in range(4)]
+    a, astats, _ = _serve(tiny_params, hp, paged_kv=True, kv_quant="int8",
+                          prefix_cache=True, spec_decode=True)
+    b, _, _ = _serve(tiny_params, hp, paged_kv=False, kv_quant="int8",
+                     prefix_cache=True, spec_decode=True)
+    assert a == b
+    assert astats["prefix_hit_requests"] > 0
+    assert astats["spec_dispatches"] > 0
+
+
+def test_oversubscribed_pool_parks_without_tearing(tiny_params, plain_burst,
+                                                   monkeypatch):
+    """PATHWAY_TPU_PAGED_KV_BLOCKS undersized: concurrent admissions hit
+    PagedPoolOOM, park, and retry as slots drain — output still matches
+    dense, and the allocator reconciles to zero afterwards (no leaked
+    blocks, no torn table)."""
+    monkeypatch.setenv("PATHWAY_TPU_PAGED_KV", "1")
+    monkeypatch.setenv("PATHWAY_TPU_PAGED_KV_BLOCKS", "9")
+    out, stats, srv = _serve(tiny_params, PROMPTS, batch=True,
+                             paged_kv=None, prefix_cache=False)
+    assert srv._total_blocks == 9
+    assert stats["paged_oom"] > 0
+    assert sorted(out) == sorted(plain_burst[0])
+    assert srv._allocator.n_allocated == 0
+    assert srv._allocator.n_free == 8
+
+
+def test_fragmentation_gauge(tiny_params, plain_burst):
+    """kv_fragmentation: share of allocated KV columns no live request
+    can ever reach. Dense burns a full cache row per slot; paged
+    allocates per-request, so its gauge reads strictly lower."""
+    _, _, srv = _serve(tiny_params, PROMPTS, paged_kv=True)
+    paged_frag = srv.kv_fragmentation()
+    dense_frag = plain_burst[1]
+    for f in (paged_frag, dense_frag):
+        assert set(f) == {"current", "mean"}
+        assert 0.0 <= f["mean"] <= 1.0
+    assert paged_frag["mean"] < dense_frag["mean"]

@@ -385,3 +385,109 @@ def test_lock_sanitizer_compiled_out(monkeypatch):
     assert max(1 / med, max(ons) / max(offs)) >= 0.97, (
         "lock-sanitizer wrapper overhead above 3%: " + detail
     )
+
+
+@pytest.mark.slow
+def test_paged_kv_tok_s_and_capacity():
+    """Paged KV (PATHWAY_TPU_PAGED_KV) on a mixed long/short greedy
+    burst: paged serving must sustain >= 0.95x the dense pool's
+    throughput at equal batch on an accelerator, where the Pallas kernel
+    walks the block table in place; on CPU the reference path pays a
+    real gather/scatter materialization per dispatch, so the guard pins
+    that tax to a 25% budget instead (>= 0.75x) — it catches pathological
+    regressions (quadratic gathers, per-token dispatches) without
+    pretending the materialization is free. Token streams must be
+    byte-identical either way, and at the dense pool's HBM budget the
+    per-request block allocation must admit >= 1.3x the concurrent
+    slots (arithmetic over the server's own sizing, no timing). Same
+    max-of-alternating-rounds estimator as the other serving guards:
+    burst noise is one-sided, each arm's peak estimates its clean-host
+    rate."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models import decoder as D
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+    from tests.utils import ToyCharTokenizer
+
+    cfg = D.DecoderConfig(
+        vocab_size=128, hidden=64, layers=4, heads=4, intermediate=128,
+        max_position=256, dtype=jnp.float32,
+    )
+    params = D.init_params(jax.random.PRNGKey(0), cfg)
+    head = "c" * 40 + "ontext: "
+    # 1-in-4 long prompts: the dense pool sizes every slot for the long
+    # ones, the paged pool allocates what each request can reach
+    prompts = [
+        head + f"q{k:02d}tail"[:8].ljust(8, "x") if k % 4 == 0
+        else f"q{k:02d}" + "y" * (2 + k % 5)
+        for k in range(16)
+    ]
+    max_new = 16
+
+    def run_arm(paged: bool):
+        chat = TPUDecoderChat(
+            params=params, cfg=cfg, tokenizer=ToyCharTokenizer(128),
+            max_new_tokens=max_new, temperature=0.0, max_prompt_tokens=64,
+            continuous=True, n_slots=4, chunk_steps=8, pipeline_depth=2,
+            prefill_chunk=8, prefix_cache=False, paged_kv=paged,
+        )
+        try:
+            for r in chat.submit_batch([head + "warmAAxx", "qWWyyyy"]):
+                assert r.done.wait(timeout=120)
+            rates, toks = [], None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                reqs = chat.submit_batch(prompts)
+                for r in reqs:
+                    assert r.done.wait(timeout=120)
+                wall = max(r.finished_at for r in reqs) - t0
+                gen = sum(len(r.tokens) for r in reqs)
+                rates.append(gen / max(wall, 1e-9))
+                if toks is None:
+                    toks = [list(r.tokens) for r in reqs]
+            srv = chat._server
+            sizing = (srv.cache_len, srv.paged_block, srv._slack,
+                      srv.pipeline_depth)
+            return rates, toks, sizing
+        finally:
+            chat.close()
+
+    ons, offs = [], []
+    on_toks = off_toks = None
+    sizing = None
+    for i in range(3):  # alternate construction order per round
+        for paged in ((True, False) if i % 2 else (False, True)):
+            rates, toks, sz = run_arm(paged)
+            if paged:
+                ons.extend(rates)
+                on_toks = on_toks or toks
+                sizing = sz
+            else:
+                offs.extend(rates)
+                off_toks = off_toks or toks
+    assert on_toks == off_toks, "paged pool changed the token streams"
+
+    paged_tok_s, dense_tok_s = max(ons), max(offs)
+    bar = 0.95 if jax.default_backend() == "tpu" else 0.75
+    assert paged_tok_s >= bar * dense_tok_s, (
+        f"paged KV {paged_tok_s:.1f} tok/s below {bar}x dense "
+        f"{dense_tok_s:.1f} tok/s "
+        f"(on={[f'{v:.0f}' for v in ons]}, off={[f'{v:.0f}' for v in offs]})"
+    )
+
+    # capacity at fixed HBM: the dense pool burns n_slots full cache_len
+    # rows; paged admission allocates ceil(cover / block) blocks where
+    # cover = prompt + budget + pipeline slack (the server's own formula)
+    cache_len, block, slack, depth = sizing
+    budget_tokens = 4 * cache_len  # the dense pool's KV footprint
+    covers = [
+        min(cache_len, len(p) + max_new + (depth + 1) * slack)
+        for p in prompts
+    ]
+    alloc = [-(-c // block) * block for c in covers]
+    paged_max_slots = int(budget_tokens // np.mean(alloc))
+    assert paged_max_slots >= 1.3 * 4, (
+        f"paged pool admits {paged_max_slots} slots in the dense budget "
+        f"(dense: 4; covers={covers}, block={block})"
+    )
