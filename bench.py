@@ -2307,6 +2307,186 @@ def _serving_paged_trace(params, cfg, tok) -> dict:
     }
 
 
+def _serving_fleet_trace(params, cfg, tok) -> dict:
+    """Replicated-fleet serving claim (PATHWAY_TPU_FLEET): the shared-head
+    Poisson trace through three arms — a fleet of ONE in-process replica
+    (the single-server baseline), a 2-replica fleet behind the
+    prefix-affinity router, and the same 2-replica fleet with
+    ``PATHWAY_TPU_CHAOS`` armed at ``decode.dispatch`` on exactly one
+    replica (its serving loop dies on first dispatch; the router's
+    requeue path must carry every request to a terminal state on the
+    survivor). Two head groups with deterministic ring owners prove the
+    affinity split: each group pays one prefill miss and then hits its
+    owner's radix cache, so ``fleet_prefix_hit_rate`` must hold at the
+    single-replica rate instead of collapsing under round-robin."""
+    from pathway_tpu.engine import probes
+    from pathway_tpu.serving.fleet import FleetManager
+    from pathway_tpu.serving.replica import InProcessReplica
+    from pathway_tpu.serving.router import FleetRouter
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    if _smoke():
+        NREQ, LAM, MAXNEW, N_SLOTS, CHUNK = 8, 20.0, 8, 4, 4
+    else:
+        NREQ, LAM, MAXNEW, N_SLOTS, CHUNK = 32, 60.0, 16, 8, 8
+    rng = np.random.default_rng(13)
+    arrivals = np.cumsum(rng.exponential(1.0 / LAM, NREQ))
+    # two 48-char shared heads; the router keys on the first 4 full
+    # 8-token blocks (32 chars), and these two heads deterministically
+    # hash to DIFFERENT replicas of a 2-member 64-vnode ring
+    heads = ("c" * 40 + "ontext: ", "b" * 40 + "atabase ")
+    prompts = [
+        heads[k % 2] + f"q{k:02d}tail"[:8].ljust(8, "x")
+        for k in range(NREQ)
+    ]
+
+    def make_factory(chaos_replica_index=None):
+        counter = [0]
+
+        def factory(rid):
+            idx = counter[0]
+            counter[0] += 1
+            # the chaos rate is read ONCE at server construction, so
+            # scoping the env to ONE replica's constructor arms exactly
+            # that replica's decode.dispatch site
+            armed = (
+                chaos_replica_index is not None
+                and idx == chaos_replica_index
+            )
+            saved = {
+                k: os.environ.get(k)
+                for k in ("PATHWAY_TPU_CHAOS", "PATHWAY_TPU_CHAOS_SITES",
+                          "PATHWAY_TPU_CHAOS_SEED")
+            }
+            if armed:
+                os.environ["PATHWAY_TPU_CHAOS"] = "1.0"
+                os.environ["PATHWAY_TPU_CHAOS_SITES"] = "decode.dispatch"
+                os.environ["PATHWAY_TPU_CHAOS_SEED"] = "5"
+            try:
+                chat = TPUDecoderChat(
+                    params=params, cfg=cfg, tokenizer=tok,
+                    max_new_tokens=MAXNEW, temperature=0.0,
+                    max_prompt_tokens=64, continuous=True,
+                    n_slots=N_SLOTS, chunk_steps=CHUNK, prefill_chunk=8,
+                    prefix_cache=True, prefix_cache_mb=8,
+                )
+            finally:
+                if armed:
+                    for k, v in saved.items():
+                        if v is None:
+                            os.environ.pop(k, None)
+                        else:
+                            os.environ[k] = v
+            return InProcessReplica(rid, chat)
+
+        return factory
+
+    def run_arm(n_replicas, chaos_replica_index=None):
+        router = FleetRouter(affinity_blocks=4, block=8, vnodes=64)
+        manager = FleetManager(
+            make_factory(chaos_replica_index), router=router,
+            replicas=n_replicas, min_replicas=1, max_replicas=n_replicas,
+            health_interval_s=60.0,
+        ).start()
+        try:
+            # warm every head group through the router — each group's
+            # OWNER replica compiles its hit-path executables (and, in
+            # the chaos arm, the armed replica's loop dies here and the
+            # warm requests already prove the requeue path) — then drop
+            # the caches + registry so the timed window starts clean
+            for head in heads:
+                for wtail in ("warmAAxx", "warmBBxx"):
+                    fc = router.submit(head + wtail)
+                    fc.wait(timeout=120)
+            for rep in router.replicas().values():
+                srv = rep.chat._server
+                if srv.failed is None:
+                    srv.prefix_reset()
+            probes.reset_prefix_stats()
+            probes.reset_latency_metrics()
+            t0 = time.perf_counter()
+            fcs = []
+            for k in range(NREQ):
+                now = time.perf_counter() - t0
+                if arrivals[k] > now:
+                    time.sleep(arrivals[k] - now)
+                fcs.append(router.submit(prompts[k]))
+            e2e, finished, generated = [], [], 0
+            terminal = answered = 0
+            for k, fc in enumerate(fcs):
+                fc.wait(timeout=120)
+                terminal += int(fc.done.is_set())
+                if fc.text is not None:
+                    answered += 1
+                    generated += len(fc.tokens)
+                    done_at = getattr(fc._req, "finished_at", None)
+                    if done_at is not None:
+                        finished.append(done_at)
+                        e2e.append(done_at - t0 - arrivals[k])
+            ps = probes.prefix_stats()
+            wall = (max(finished) - t0) if finished else 0.0
+            arm = {
+                "replicas": n_replicas,
+                "tok_s": round(generated / max(wall, 1e-9), 1),
+                "p95_ms": round(
+                    float(np.percentile(np.asarray(e2e) * 1e3, 95)), 1
+                ) if e2e else None,
+                "hit_rate": ps["hit_rate"],
+                "terminal": terminal,
+                "answered": answered,
+                "requests": NREQ,
+                "owners": sorted(
+                    {fc.replica_id for fc in fcs if fc.replica_id}
+                ),
+            }
+            if chaos_replica_index is not None:
+                # supervisor view: the armed replica fails its probe,
+                # gets drained from the ring and respawned fresh
+                drained = manager.health_pass()
+                arm["drained"] = drained
+                arm["respawned_size"] = len(router)
+            return arm
+        finally:
+            manager.shutdown()
+
+    single = run_arm(1)
+    fleet = run_arm(2)
+    chaos = run_arm(2, chaos_replica_index=1)
+    hit_ratio = round(
+        fleet["hit_rate"] / max(single["hit_rate"], 1e-9), 3
+    )
+    # chaos-off reference: the single arm played the same trace on one
+    # replica, which is the capacity the chaos arm degrades to, so the
+    # 1.5x p95 bar is taken against the worse of the two clean arms
+    ref_p95 = max(fleet["p95_ms"] or 0.0, single["p95_ms"] or 0.0)
+    chaos_ratio = (
+        round(chaos["p95_ms"] / ref_p95, 2)
+        if chaos["p95_ms"] and ref_p95 else None
+    )
+    failover_ok = bool(
+        chaos["terminal"] == NREQ and chaos["answered"] == NREQ
+        and chaos_ratio is not None
+    )
+    return {
+        "trace": (
+            f"{NREQ} Poisson arrivals at {LAM}/s, two 48-token shared "
+            f"heads (alternating groups, deterministic ring owners), "
+            f"{MAXNEW} new tokens each"
+        ),
+        "single": single,
+        "fleet": fleet,
+        "chaos": chaos,
+        "fleet_tok_s": fleet["tok_s"],
+        "fleet_p95_ms": fleet["p95_ms"],
+        "fleet_prefix_hit_rate": fleet["hit_rate"],
+        "single_prefix_hit_rate": single["hit_rate"],
+        "fleet_hit_ratio": hit_ratio,
+        "fleet_chaos_p95_ms": chaos["p95_ms"],
+        "fleet_chaos_p95_ratio": chaos_ratio,
+        "fleet_failover_ok": failover_ok,
+    }
+
+
 def _decoder_serving_compare(params, cfg) -> dict:
     """Poisson-arrival serving comparison through ``TPUDecoderChat``,
     measured on the PRODUCT path: both arms play the same trace through
@@ -2492,6 +2672,7 @@ def _decoder_serving_compare(params, cfg) -> dict:
     prefix = _serving_prefix_trace(params, cfg, _Tok())
     spec = _serving_spec_trace(params, cfg, _Tok())
     paged = _serving_paged_trace(params, cfg, _Tok())
+    fleet = _serving_fleet_trace(params, cfg, _Tok())
     return {
         # headline figures come from the REST product path
         "poisson_lambda_req_per_s": LAM_REST,
@@ -2523,6 +2704,8 @@ def _decoder_serving_compare(params, cfg) -> dict:
         "spec": spec,
         # paged block-table KV pool vs the dense slot pool
         "paged": paged,
+        # replicated fleet behind the prefix-affinity router
+        "fleet": fleet,
         # bare-model comparison (per-request budgets, no engine): kept for
         # continuity with the r4/r5 records
         "direct_api": {
@@ -2815,6 +2998,24 @@ def main() -> None:
             "requests_shed": serving_det.get("requests_shed"),
             "restarts": serving_det.get("restarts"),
             "degradation_level": serving_det.get("degradation_level"),
+            "fleet_tok_s": (serving_det.get("fleet") or {}).get(
+                "fleet_tok_s"
+            ),
+            "fleet_p95_ms": (serving_det.get("fleet") or {}).get(
+                "fleet_p95_ms"
+            ),
+            "fleet_prefix_hit_rate": (serving_det.get("fleet") or {}).get(
+                "fleet_prefix_hit_rate"
+            ),
+            "fleet_hit_ratio": (serving_det.get("fleet") or {}).get(
+                "fleet_hit_ratio"
+            ),
+            "fleet_chaos_p95_ms": (serving_det.get("fleet") or {}).get(
+                "fleet_chaos_p95_ms"
+            ),
+            "fleet_failover_ok": (serving_det.get("fleet") or {}).get(
+                "fleet_failover_ok"
+            ),
         }
         if serving_det and "error" not in serving_det
         else serving_det or None
@@ -2983,9 +3184,19 @@ def main() -> None:
             "spec_acceptance_rate", "tokens_per_dispatch",
             "spec_tok_s", "plain_tok_s", "kv_quant_tok_s",
             "kv_bytes_saved", "requests_shed", "restarts",
-            "degradation_level",
+            "degradation_level", "fleet_tok_s", "fleet_p95_ms",
+            "fleet_prefix_hit_rate", "fleet_hit_ratio",
+            "fleet_chaos_p95_ms",
         ):
             _chk(f"summary.serving.{k}", srv.get(k))
+        # fleet acceptance: affinity must hold the single-replica hit
+        # rate (>= 0.9x), and with chaos killing one replica's loop
+        # every request must still have reached a terminal answer
+        ratio = srv.get("fleet_hit_ratio")
+        if not (isinstance(ratio, (int, float)) and ratio >= 0.9):
+            missing.append("summary.serving.fleet_hit_ratio>=0.9")
+        if srv.get("fleet_failover_ok") is not True:
+            missing.append("summary.serving.fleet_failover_ok")
         # acceptance floor on the shared-head trace: the draft stack
         # should agree with the full model well above chance
         acc = srv.get("spec_acceptance_rate")
@@ -3122,6 +3333,25 @@ def sentinel_check(summary: dict, baseline: dict, smoke: bool) -> list:
         breaches.append(
             "summary.serving.paged_tokens_match: paged arm diverged from "
             "dense on a greedy trace"
+        )
+    # fleet gates, exact at every scale: the affinity router must hold
+    # the single-replica prefix hit rate, and the chaos arm (one
+    # replica's decode loop killed) must have carried every request to
+    # a terminal answer through the requeue path
+    for fk in ("fleet_tok_s", "fleet_p95_ms", "fleet_prefix_hit_rate"):
+        if srv_new.get(fk) is None:
+            breaches.append(f"summary.serving.{fk}: missing")
+    fhr = srv_new.get("fleet_hit_ratio")
+    if isinstance(fhr, (int, float)) and fhr < 0.9:
+        breaches.append(
+            f"summary.serving.fleet_hit_ratio: {fhr} < 0.9 — affinity "
+            f"routing collapsed the prefix hit rate vs single-replica"
+        )
+    ffo = srv_new.get("fleet_failover_ok")
+    if ffo is not None and not ffo:
+        breaches.append(
+            "summary.serving.fleet_failover_ok: chaos-on-one-replica "
+            "trace left requests non-terminal or past the p95 bar"
         )
     return breaches
 

@@ -349,6 +349,161 @@ def watch(url, interval, iterations, fail_on_alert):
 
 
 @cli.group()
+def fleet() -> None:
+    """Replicated serving fleet: spawn replicas behind the
+    prefix-affinity router, or inspect a running fleet."""
+
+
+@fleet.command("serve", context_settings={
+    "allow_interspersed_args": False, "show_default": True,
+})
+@click.option("-n", "--replicas", metavar="N", type=int, default=None,
+              help="initial replica count "
+                   "[default: PATHWAY_TPU_FLEET_REPLICAS]")
+@click.option("--host", type=str, default="127.0.0.1",
+              help="router bind host")
+@click.option("--port", type=int, default=0,
+              help="router bind port (0 = ephemeral)")
+@click.option("--health-interval", type=float, default=None, metavar="S",
+              help="seconds between supervisor ticks "
+                   "[default: PATHWAY_TPU_FLEET_HEALTH_MS / 1000]")
+@click.option("--boot-grace", type=float, default=120.0, metavar="S",
+              help="seconds a never-yet-ready replica may spend booting "
+                   "(jax import + first jit) before failed health probes "
+                   "count toward draining it")
+@click.argument("program")
+@click.argument("arguments", nargs=-1)
+def fleet_serve(replicas, host, port, health_interval, boot_grace,
+                program, arguments):
+    """Run PROGRAM as N supervised replicas behind the affinity router.
+
+    Each replica is spawned with the single-process env contract
+    (``PATHWAY_PROCESSES=1``, its own ``PATHWAY_FIRST_PORT``) and must
+    start a REST server on that port — the router health-checks
+    ``/healthz`` + ``/readyz``, forwards ``/v1/pw_ai_answer`` and
+    ``/v1/retrieve`` with prefix affinity, and the supervisor drains,
+    respawns and autoscales off the per-replica SLO burn signal.
+
+    Requires ``PATHWAY_TPU_FLEET=1`` (the kill switch keeps the
+    single-server path byte-identical when off)."""
+    import time as time_mod
+    import uuid as uuid_mod
+
+    from pathway_tpu import serving
+
+    if not serving.fleet_enabled():
+        click.echo("PATHWAY_TPU_FLEET=0: fleet serving is switched off "
+                   "(single-server path unchanged)", err=True)
+        raise SystemExit(2)
+
+    run_id = str(uuid_mod.uuid4())
+    next_index = [0]
+
+    def factory(replica_id: str):
+        from pathway_tpu.serving.replica import (
+            HttpReplica, free_port, spawn_replica_process,
+        )
+
+        idx = next_index[0]
+        next_index[0] += 1
+        rport = free_port(host)
+        proc = spawn_replica_process(
+            [program, *arguments, "--port", str(rport)],
+            replica_index=idx, port=rport, run_id=run_id,
+        )
+        return HttpReplica(replica_id, f"http://{host}:{rport}", proc=proc)
+
+    manager = serving.build_fleet(
+        factory, replicas=replicas, health_interval_s=health_interval,
+        boot_grace_s=boot_grace,
+    )
+    router_srv = serving.RouterServer(
+        manager.router, manager=manager, host=host, port=port,
+    ).start()
+    manager.run_in_thread()
+    click.echo(
+        f"fleet router on http://{host}:{router_srv.port} "
+        f"({len(manager.router)} replicas, run {run_id})", err=True,
+    )
+    try:
+        while True:
+            time_mod.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router_srv.stop()
+        manager.shutdown()
+
+
+@fleet.command("stats")
+@click.option("--url", type=str, required=True, metavar="URL",
+              help="base URL of a running fleet router "
+                   "(fetches URL/v1/fleet)")
+@click.option("--as-json", is_flag=True, help="dump the raw state as JSON")
+def fleet_stats(url, as_json):
+    """One-shot fleet state: members, ring, burn, respawns, events."""
+    import json
+    import urllib.request
+
+    endpoint = url.rstrip("/") + "/v1/fleet"
+    with urllib.request.urlopen(endpoint, timeout=10.0) as resp:  # noqa: S310
+        state = json.loads(resp.read().decode())
+    if as_json:
+        click.echo(json.dumps(state, indent=2, default=str))
+        return
+    click.echo(
+        f"fleet size {state.get('size')} "
+        f"(min {state.get('min')} / max {state.get('max')}), "
+        f"burn {state.get('burn', 0.0):.2f}, "
+        f"respawns {state.get('respawns', 0)}"
+    )
+    for rid, info in sorted((state.get("replicas") or {}).items()):
+        click.echo(
+            f"  {rid:<14} kind={info.get('kind', '?'):<7} "
+            f"fails={info.get('consecutive_failures', 0)}"
+        )
+    events = state.get("events") or []
+    if events:
+        click.echo("recent events:")
+        for kind, rid in events[-10:]:
+            click.echo(f"  {kind} {rid if rid else ''}")
+
+
+@fleet.command("watch")
+@click.option("--url", type=str, required=True, metavar="URL",
+              help="base URL of a running fleet router")
+@click.option("--interval", type=float, default=2.0, show_default=True,
+              help="seconds between polls")
+@click.option("--iterations", type=int, default=0,
+              help="stop after N polls (0 = run until interrupted)")
+def fleet_watch(url, interval, iterations):
+    """Poll a fleet router's ``/v1/fleet`` and print size/burn lines."""
+    import json
+    import time as time_mod
+    import urllib.request
+
+    endpoint = url.rstrip("/") + "/v1/fleet"
+    n = 0
+    try:
+        while True:
+            with urllib.request.urlopen(endpoint, timeout=10.0) as resp:  # noqa: S310
+                state = json.loads(resp.read().decode())
+            n += 1
+            stamp = time_mod.strftime("%H:%M:%S")
+            click.echo(
+                f"[{stamp}] size={state.get('size')} "
+                f"burn={state.get('burn', 0.0):.2f} "
+                f"respawns={state.get('respawns', 0)} "
+                f"members={','.join(state.get('ring_members') or [])}"
+            )
+            if iterations and n >= iterations:
+                break
+            time_mod.sleep(max(interval, 0.05))
+    except KeyboardInterrupt:
+        pass
+
+
+@cli.group()
 def airbyte() -> None:
     """Airbyte connector scaffolding (reference ``cli.py:airbyte``)."""
 

@@ -20,6 +20,12 @@ Sites in the tree (grep for ``chaos.site(``):
 * ``query.tick``      — one ``QueryServer`` tick-body group dispatch
 * ``persist.put``     — snapshot chunk ``put_value``
 * ``connector.read``  — ``BaseConnector.commit_rows``
+* ``router.forward``  — the fleet router's dispatch/forward to a
+                        replica (request-scoped: router fails over to
+                        the next ring candidate)
+* ``replica.health``  — the fleet manager's health probe (probe-scoped:
+                        enough consecutive faults drain + respawn the
+                        replica)
 
 Kill switch: ``PATHWAY_TPU_CHAOS`` (a fault rate in [0, 1], default 0)
 is read ONCE when a holder constructs its site — like the lock

@@ -148,6 +148,18 @@ METRIC_FAMILIES: dict[str, tuple[str, str | None, str]] = {
         "bytes stranded beyond what active requests can reach "
         "(0 = perfectly packed; dense right-padded slots strand the "
         "whole row tail, paged allocation only the final block's)"),
+    "requests_routed": (
+        "counter", "replica", "Requests forwarded by the fleet router, "
+        "per destination replica"),
+    "requests_requeued": (
+        "counter", None, "Fleet requests re-dispatched to another "
+        "replica after their replica died mid-flight"),
+    "ring_moves": (
+        "counter", None, "Consistent-hash-ring vnode arcs that changed "
+        "owner on replica join/leave"),
+    "replica_up": (
+        "gauge", "replica", "1 while a fleet replica is a ring member, "
+        "0 once drained"),
 }
 
 LATENCY_HISTOGRAMS = (

@@ -394,3 +394,33 @@ def slo_snapshot(tick: bool = True) -> dict:
     if tick and wd.objectives:
         wd.maybe_tick()
     return wd.state()
+
+
+def burn_signals(state: dict) -> dict:
+    """Per-objective ``(burn_fast, burn_slow)`` pairs out of a *scraped*
+    watchdog state (the ``slo`` section of ``/v1/statistics``) — the
+    fleet manager consumes replica SLO pressure through this shape, so
+    it works identically on a local :meth:`SloWatchdog.state` dict and
+    on JSON scraped over HTTP from a subprocess replica."""
+    out: dict = {}
+    for name, obj in ((state or {}).get("objectives") or {}).items():
+        try:
+            out[str(name)] = (
+                float(obj.get("burn_fast") or 0.0),
+                float(obj.get("burn_slow") or 0.0),
+            )
+        except (AttributeError, TypeError, ValueError):
+            continue
+    return out
+
+
+def max_burn(state: dict) -> float:
+    """Scalar scale-up pressure from a scraped watchdog state: the max
+    over objectives of ``min(burn_fast, burn_slow)``. Both windows must
+    burn for an objective to register — the same AND rule the
+    multi-window alert uses — so a transient fast-window spike does not
+    scale the fleet."""
+    signals = burn_signals(state)
+    if not signals:
+        return 0.0
+    return max(min(fast, slow) for fast, slow in signals.values())

@@ -662,6 +662,57 @@ FLAG_REGISTRY: list[Flag] = [
             "(no alert can fire); `0` disables the ladder entirely, "
             "byte-identical.",
     ),
+    # ------------------------------------------------ fleet serving
+    Flag(
+        env="PATHWAY_TPU_FLEET", kind="bool", default=False,
+        kill_switch=True, pinned_by="tests/test_fleet.py",
+        attr="fleet", group="fleet",
+        doc="Replicated serving fleet (`pathway_tpu/serving/`): a "
+            "prefix-affinity router spreads requests over N supervised "
+            "replicas and a fleet manager health-checks, respawns and "
+            "scales them off the SLO burn signal. `0` (default) keeps "
+            "the single-server path byte-identically — "
+            "`serving.build_fleet` returns None and no router, ring or "
+            "manager object is ever constructed "
+            "(`tests/test_fleet.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_FLEET_REPLICAS", kind="int", default=2,
+        attr="fleet_replicas", group="fleet", minimum=1,
+        doc="Initial replica count the fleet manager spawns at start "
+            "(clamped into `[PATHWAY_TPU_FLEET_MIN, "
+            "PATHWAY_TPU_FLEET_MAX]`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_FLEET_MIN", kind="int", default=1,
+        attr="fleet_min", group="fleet", minimum=1,
+        doc="Elasticity floor: scale-down never drops the fleet below "
+            "this many replicas.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_FLEET_MAX", kind="int", default=4,
+        attr="fleet_max", group="fleet", minimum=1,
+        doc="Elasticity ceiling: scale-up stops here even while the "
+            "SLO burn signal stays hot.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_FLEET_AFFINITY", kind="int", default=4,
+        attr="fleet_affinity", group="fleet", minimum=0,
+        doc="Prefix-affinity depth: how many prompt-head token BLOCKS "
+            "(the prefix-cache block size, `PATHWAY_TPU_PREFIX_BLOCK` "
+            "pow2-rounded from the prefill chunk) feed the consistent-"
+            "hash ring key, so prompts sharing a RAG head land on the "
+            "replica whose radix cache already holds it. `0` disables "
+            "affinity and the router round-robins.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_FLEET_HEALTH_MS", kind="float", default=500.0,
+        attr="fleet_health_ms", group="fleet", minimum=1,
+        doc="Fleet-manager health-check cadence in ms: each pass probes "
+            "every replica (`/healthz` + `/readyz` on HTTP replicas), "
+            "drains dead ones from the ring, requeues their in-flight "
+            "requests and respawns with bounded exponential backoff.",
+    ),
 ]
 
 
@@ -845,7 +896,7 @@ def set_monitoring_config(*, server_endpoint: str | None) -> None:
 if __name__ == "__main__":
     # regenerate the README flag tables (paste between the
     # <!-- flags:<group> --> markers)
-    for _group in ("pipeline", "query", "observability", "fault"):
+    for _group in ("pipeline", "query", "observability", "fault", "fleet"):
         print(f"<!-- flags:{_group} -->")
         print(render_flag_table(_group))
         print(f"<!-- /flags:{_group} -->")

@@ -185,19 +185,60 @@ def metrics_from_stats(snapshot: dict) -> str:
 
 
 class MetricsServer:
-    """Background HTTP server exposing ``/metrics`` (and ``/`` alias)."""
+    """Background HTTP server exposing ``/metrics`` (and ``/`` alias),
+    plus the health pair every deployment probe speaks:
 
-    def __init__(self, stats, process_id: int = 0, port: int | None = None):
+    * ``/healthz`` — liveness: 200 whenever the server thread answers.
+    * ``/readyz`` — readiness: 200 once ``ready_check()`` (injected, or
+      "a scheduler snapshot exists") says the pipeline is serving; 503
+      with a ``Retry-After`` hint before that, so load balancers and
+      the fleet health checker hold traffic during warm-up.
+    """
+
+    def __init__(self, stats, process_id: int = 0, port: int | None = None,
+                 ready_check=None):
         self.stats = stats
         self.port = port if port is not None else BASE_PORT + process_id
+        self.ready_check = ready_check
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
+    def _ready(self) -> bool:
+        if self.ready_check is not None:
+            try:
+                return bool(self.ready_check())
+            except Exception:
+                return False
+        try:
+            return self.stats is not None and self.stats.snapshot() is not None
+        except Exception:
+            return False
+
     def start(self) -> None:
         stats = self.stats
+        outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _plain(self, status: int, body: bytes,
+                       retry_after: int | None = None) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self) -> None:  # noqa: N802 — http.server API
+                if self.path == "/healthz":
+                    self._plain(200, b"ok\n")
+                    return
+                if self.path == "/readyz":
+                    if outer._ready():
+                        self._plain(200, b"ready\n")
+                    else:
+                        self._plain(503, b"not ready\n", retry_after=1)
+                    return
                 if self.path not in ("/", "/metrics", "/status"):
                     self.send_error(404)
                     return

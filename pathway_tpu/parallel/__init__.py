@@ -21,7 +21,10 @@ from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex, sharded_topk_merge
 from pathway_tpu.parallel.sharded_ivf import ShardedIvfIndex, sharded_ivf_topk_merge
 from pathway_tpu.parallel.distributed import (
     DistributedConfig,
+    DistributedInitError,
+    distributed_topology,
     initialize_distributed,
+    reset_distributed,
 )
 from pathway_tpu.parallel.ring_attention import (
     ring_attention_core,
@@ -40,7 +43,10 @@ __all__ = [
     "ShardedIvfIndex",
     "sharded_ivf_topk_merge",
     "DistributedConfig",
+    "DistributedInitError",
+    "distributed_topology",
     "initialize_distributed",
+    "reset_distributed",
     "ring_attention_core",
     "encode_sequence_parallel",
 ]

@@ -41,19 +41,59 @@ class DistributedConfig:
         )
 
 
-_initialized = False
+class DistributedInitError(RuntimeError):
+    """A second ``initialize_distributed`` call asked for a topology that
+    CONFLICTS with the one already initialized. jax.distributed cannot
+    re-join a different cluster mid-process; silently keeping the first
+    topology (the historical behavior) made fleet replicas that spawned
+    with a stale env contract *look* initialized while addressing the
+    wrong coordinator. Carries both configs for the error report."""
+
+    def __init__(self, active: DistributedConfig, requested: DistributedConfig):
+        self.active = active
+        self.requested = requested
+        super().__init__(
+            f"distributed runtime already initialized with {active}; "
+            f"conflicting re-initialization requested with {requested} "
+            f"(tear the process down, or call reset_distributed() in tests)"
+        )
+
+
+_initialized: DistributedConfig | None = None
 
 
 def initialize_distributed(config: DistributedConfig | None = None) -> None:
-    """Idempotent jax.distributed init; no-op single-process."""
+    """Idempotent jax.distributed init; no-op single-process.
+
+    Re-initialization with the SAME topology is a no-op (idempotence is
+    load-bearing: every spawned entry point calls this). Re-init with a
+    *different* topology raises :class:`DistributedInitError` instead of
+    being silently ignored."""
     global _initialized
-    if _initialized:
-        return
     cfg = config or DistributedConfig.from_env()
+    if _initialized is not None:
+        if cfg != _initialized:
+            raise DistributedInitError(_initialized, cfg)
+        return
     if cfg.num_processes > 1:
         jax.distributed.initialize(
             coordinator_address=cfg.coordinator_address,
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
-    _initialized = True
+    _initialized = cfg
+
+
+def distributed_topology() -> DistributedConfig | None:
+    """The topology this process initialized with, ``None`` before
+    :func:`initialize_distributed` ran."""
+    return _initialized
+
+
+def reset_distributed() -> None:
+    """Test hook: forget the recorded topology so the next
+    ``initialize_distributed`` re-evaluates its config. Does NOT tear
+    down a live multi-process jax.distributed runtime (jax offers no
+    clean re-init); only meaningful in single-process tests."""
+    global _initialized
+    _initialized = None

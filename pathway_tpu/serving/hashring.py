@@ -1,0 +1,147 @@
+"""Consistent-hash ring with virtual nodes, keyed on prompt-head blocks.
+
+Why consistent hashing and not round-robin: the radix prefix cache
+(``engine/prefix_cache.py``) is *per replica*.  A shared RAG head (the
+retrieved context block) only pays its prefill once if every request
+carrying that head lands on the same replica.  The ring key is the
+first N *full* token blocks of the prompt (:func:`head_block_key`), so
+prompts that differ only in their suffix — the user question after the
+shared context — hash identically and stay co-located, while the vnode
+ring keeps key movement on membership change down to ~K/N instead of
+reshuffling everything (``tests/test_hashring.py`` asserts both).
+
+The block size mirrors the serving-side derivation exactly
+(:func:`affinity_block_tokens`): ``next_pow2(max(PATHWAY_TPU_PREFIX_BLOCK,
+prefill_chunk), prefill_chunk)`` — the same alignment the replica's
+``_ContinuousServer`` uses to carve cache entries, so a ring-key match
+implies a radix-cache prefix match on the owning replica.
+
+The ring itself is deliberately pure (no metrics, no config reads
+beyond the block helper): callers record ``ring_moves`` off the return
+values of :meth:`HashRing.add` / :meth:`HashRing.remove`.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Sequence
+
+from pathway_tpu.analysis.annotations import guarded_by
+from pathway_tpu.analysis.runtime import make_lock
+
+
+def _point(data: bytes) -> int:
+    """64-bit ring position for ``data`` (blake2b, stable across runs —
+    unlike ``hash()``, which is salted per process)."""
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+def affinity_block_tokens(
+    prefill_chunk: int | None = None, prefix_block: int | None = None
+) -> int:
+    """The token-block size the router hashes on — MUST mirror the
+    replica-side derivation in ``xpacks/llm/llms.py`` (prefix cache
+    block alignment), else a ring-key match would not imply a cache
+    hit.  Arguments override the flag registry for tests."""
+    from pathway_tpu.internals.config import pathway_config
+    from pathway_tpu.ops import next_pow2
+
+    chunk = pathway_config.prefill_chunk if prefill_chunk is None else int(prefill_chunk)
+    chunk = max(8, next_pow2(chunk, 8))
+    blk = pathway_config.prefix_block if prefix_block is None else int(prefix_block)
+    return next_pow2(max(int(blk), chunk), chunk)
+
+
+def head_block_key(tokens: Sequence[int], *, block: int, blocks: int) -> bytes:
+    """Ring key for a prompt: its first ``blocks`` *full* ``block``-sized
+    token groups.  Prompts differing only past that head map to the
+    same key (affinity); a prompt shorter than one block keys on its
+    whole token sequence (nothing shareable to align on)."""
+    if block <= 0:
+        raise ValueError(f"block must be positive, got {block}")
+    if blocks <= 0:
+        raise ValueError(f"blocks must be positive, got {blocks}")
+    n_full = min(len(tokens) // block, blocks)
+    head = tuple(int(t) for t in tokens[: n_full * block])
+    if not head:
+        head = tuple(int(t) for t in tokens)
+    return repr(head).encode("utf-8")
+
+
+@guarded_by(_points="_lock", _ids="_lock", _members="_lock")
+class HashRing:
+    """Consistent-hash ring: ``vnodes`` virtual nodes per member spread
+    each replica across the keyspace so load (and key movement on
+    join/leave) concentrates around K/N.  Thread-safe; lookups are a
+    binary search over the sorted vnode positions."""
+
+    def __init__(self, *, vnodes: int = 64) -> None:
+        if vnodes <= 0:
+            raise ValueError(f"vnodes must be positive, got {vnodes}")
+        self.vnodes = int(vnodes)
+        self._lock = make_lock("serving.hashring")
+        self._points: list[int] = []  # sorted vnode positions
+        self._ids: list[str] = []  # owner replica id, parallel to _points
+        self._members: dict[str, list[int]] = {}  # id -> its vnode positions
+
+    def add(self, replica_id: str) -> int:
+        """Insert ``replica_id``'s vnodes; returns the number of ring
+        arcs that changed owner (== vnodes inserted) so the caller can
+        feed the ``ring_moves`` counter.  Idempotent: re-adding an
+        existing member moves nothing."""
+        with self._lock:
+            if replica_id in self._members:
+                return 0
+            pts = [
+                _point(f"{replica_id}#{v}".encode("utf-8"))
+                for v in range(self.vnodes)
+            ]
+            for p in pts:
+                i = bisect.bisect_left(self._points, p)
+                self._points.insert(i, p)
+                self._ids.insert(i, replica_id)
+            self._members[replica_id] = pts
+            return len(pts)
+
+    def remove(self, replica_id: str) -> int:
+        """Drain ``replica_id`` from the ring; returns arcs moved (== its
+        vnodes removed), 0 if it was not a member."""
+        with self._lock:
+            pts = self._members.pop(replica_id, None)
+            if pts is None:
+                return 0
+            for p in pts:
+                i = bisect.bisect_left(self._points, p)
+                # duplicate positions across members are astronomically
+                # unlikely (64-bit space) but scan to the owned slot
+                while i < len(self._points) and self._points[i] == p:
+                    if self._ids[i] == replica_id:
+                        del self._points[i]
+                        del self._ids[i]
+                        break
+                    i += 1
+            return len(pts)
+
+    def lookup(self, key: bytes) -> str | None:
+        """Owner of ``key``: the first vnode clockwise from the key's
+        ring position (wrapping), ``None`` on an empty ring."""
+        with self._lock:
+            if not self._points:
+                return None
+            i = bisect.bisect_right(self._points, _point(key))
+            if i == len(self._points):
+                i = 0
+            return self._ids[i]
+
+    def members(self) -> list[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._members)
+
+    def __contains__(self, replica_id: str) -> bool:
+        with self._lock:
+            return replica_id in self._members

@@ -1,0 +1,368 @@
+"""Prefix-affinity fleet router.
+
+:class:`FleetRouter` owns the membership map and the consistent-hash
+ring.  For every request it derives the prompt-head ring key
+(:func:`~pathway_tpu.serving.hashring.head_block_key`, block size
+mirroring the replica prefix cache) and builds an *ordered candidate
+list*: the affinity owner first, then the remaining replicas as
+fallback.  ``PATHWAY_TPU_FLEET_AFFINITY=0`` turns the key derivation
+off and the router round-robins.
+
+Failure semantics stitch straight into PR-10's request lifecycle: a
+submission that raises (dead serving loop, injected ``router.forward``
+fault, unreachable process) moves to the next candidate immediately; a
+request whose replica *dies mid-flight* (completion resolves with
+``text is None`` and no shed ``error_reason``) is **requeued** on the
+next candidate inside :meth:`FleetCompletion.wait` — each replica is
+tried at most once per request, so failover is bounded by fleet size.
+Sheds (``error_reason == "shed:*"``) are a replica's deliberate answer
+and are surfaced, not retried.
+
+:class:`RouterServer` is the HTTP front end (same stdlib plumbing as
+``internals/http_server.py``): it forwards ``/v1/pw_ai_answer`` and
+``/v1/retrieve`` bodies to :class:`HttpReplica` members with the same
+candidate ordering, and exposes ``/healthz``, ``/readyz``, ``/metrics``
+and ``/v1/fleet`` for the supervisor and ops tooling.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from pathway_tpu.analysis.annotations import guarded_by
+from pathway_tpu.analysis.runtime import make_lock
+from pathway_tpu.engine import chaos as chaos_mod
+from pathway_tpu.engine import probes
+from pathway_tpu.serving.hashring import (
+    HashRing,
+    affinity_block_tokens,
+    head_block_key,
+)
+from pathway_tpu.serving.replica import ReplicaError
+
+
+def _char_tokenize(text: str) -> list:
+    """Router-side fallback tokenizer: the same stable char map the toy
+    tokenizers use (1 token per char).  Affinity only needs a *stable*
+    prompt→tokens map so equal heads key equally; deployments pass the
+    real tokenizer via ``FleetRouter(tokenize=...)`` for exact
+    block-boundary agreement with the replica caches."""
+    return [(ord(c) % 96) + 1 for c in str(text)]
+
+
+class FleetCompletion:
+    """Fleet-level handle for one request: wraps the replica-level
+    ``_PendingCompletion`` and re-dispatches it on replica death.
+
+    ``wait()`` drives the failover state machine synchronously (no
+    watcher threads): it blocks on the current replica's completion
+    and, if that replica died without answering, requeues on the next
+    candidate.  Terminal states: generated text, a shed
+    ``error_reason``, or candidate exhaustion (``error_reason ==
+    "fleet:no_replica"``)."""
+
+    def __init__(self, prompt, max_new: int | None, priority: int) -> None:
+        self.prompt = prompt
+        self.max_new = max_new
+        self.priority = priority
+        self.attempts: list[str] = []  # replica ids tried, in order
+        self.replica_id: str | None = None  # current/last binding
+        self.done = threading.Event()
+        self.text: str | None = None
+        self.tokens: list = []
+        self.error_reason: str | None = None
+        self._req = None  # live replica-level completion
+        self._router = None  # bound by FleetRouter.submit
+
+    def _finish_from(self, req) -> None:
+        self.text = req.text
+        self.tokens = list(getattr(req, "tokens", ()) or ())
+        self.error_reason = getattr(req, "error_reason", None)
+        self.done.set()
+
+    def _fail(self, reason: str) -> None:
+        self.text = None
+        self.error_reason = reason
+        self.done.set()
+
+    def wait(self, timeout: float | None = None, *, router=None) -> bool:
+        """Block until terminal (True) or ``timeout`` elapses (False).
+        ``router`` defaults to the router that issued this completion."""
+        import time as time_mod
+
+        deadline = None if timeout is None else time_mod.monotonic() + timeout
+        rt = router if router is not None else self._router
+        while not self.done.is_set():
+            req = self._req
+            if req is None or rt is None:  # unbound: dispatch already failed
+                self._fail("fleet:no_replica")
+                break
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time_mod.monotonic()
+                if remaining <= 0:
+                    return False
+            if not req.done.wait(timeout=remaining):
+                return False
+            if req.text is not None or getattr(req, "error_reason", None):
+                # answered, or deliberately shed — both terminal
+                self._finish_from(req)
+                break
+            # replica died mid-flight (PR-10 drain sets text=None with
+            # no reason): requeue on the next untried candidate
+            probes.REGISTRY.counter_add("requests_requeued")
+            if not rt._redispatch(self):
+                self._fail("fleet:no_replica")
+                break
+        return True
+
+
+@guarded_by(_replicas="_lock", _rr_next="_lock")
+class FleetRouter:
+    """Membership + ring + candidate ordering + dispatch."""
+
+    def __init__(
+        self,
+        *,
+        affinity_blocks: int | None = None,
+        block: int | None = None,
+        tokenize=None,
+        vnodes: int = 64,
+    ) -> None:
+        from pathway_tpu.internals.config import pathway_config
+
+        self.affinity_blocks = (
+            pathway_config.fleet_affinity
+            if affinity_blocks is None
+            else int(affinity_blocks)
+        )
+        self.block = affinity_block_tokens() if block is None else int(block)
+        self.tokenize = tokenize or _char_tokenize
+        self.ring = HashRing(vnodes=vnodes)
+        self._lock = make_lock("serving.router")
+        self._replicas: dict = {}
+        self._rr_next = 0
+        self._chaos_forward = chaos_mod.site("router.forward")
+
+    # ------ membership -------------------------------------------------
+    def add_replica(self, replica) -> None:
+        with self._lock:
+            self._replicas[replica.replica_id] = replica
+        moved = self.ring.add(replica.replica_id)
+        if moved:
+            probes.REGISTRY.counter_add("ring_moves", value=float(moved))
+        probes.REGISTRY.gauge_set(
+            "replica_up", 1.0, replica=replica.replica_id
+        )
+
+    def remove_replica(self, replica_id: str):
+        """Drain a replica from ring + membership; returns the handle
+        (or ``None``) so the caller can stop/respawn it."""
+        with self._lock:
+            replica = self._replicas.pop(replica_id, None)
+        moved = self.ring.remove(replica_id)
+        if moved:
+            probes.REGISTRY.counter_add("ring_moves", value=float(moved))
+        probes.REGISTRY.gauge_set("replica_up", 0.0, replica=replica_id)
+        return replica
+
+    def replicas(self) -> dict:
+        with self._lock:
+            return dict(self._replicas)
+
+    def get(self, replica_id: str):
+        with self._lock:
+            return self._replicas.get(replica_id)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    # ------ candidate ordering ----------------------------------------
+    def route_key(self, prompt) -> bytes | None:
+        if self.affinity_blocks <= 0:
+            return None
+        return head_block_key(
+            self.tokenize(prompt), block=self.block, blocks=self.affinity_blocks
+        )
+
+    def candidates(self, prompt, exclude=()) -> list:
+        """Ordered replica ids for ``prompt``: the ring owner of the
+        prompt head first (affinity), then the rest in stable order as
+        failover targets; pure round-robin when affinity is off."""
+        members = self.ring.members()
+        skip = set(exclude)
+        order: list = []
+        key = self.route_key(prompt)
+        if key is not None:
+            owner = self.ring.lookup(key)
+            if owner is not None:
+                order.append(owner)
+        else:
+            with self._lock:
+                self._rr_next += 1
+                start = self._rr_next
+            if members:
+                members = members[start % len(members):] + members[: start % len(members)]
+        for rid in members:
+            if rid not in order:
+                order.append(rid)
+        return [rid for rid in order if rid not in skip]
+
+    # ------ dispatch (in-process replicas) ----------------------------
+    def submit(self, prompt, max_new: int | None = None, *, priority: int = 1) -> FleetCompletion:
+        """Route one prompt to its affinity replica (ordered fallback on
+        submission failure); returns a :class:`FleetCompletion`."""
+        fc = FleetCompletion(prompt, max_new, priority)
+        fc._router = self
+        if not self._redispatch(fc):
+            fc._fail("fleet:no_replica")
+        return fc
+
+    def _redispatch(self, fc: FleetCompletion) -> bool:
+        """Bind ``fc`` to the next untried candidate; False when every
+        replica has been tried (or none exists)."""
+        for rid in self.candidates(fc.prompt, exclude=fc.attempts):
+            replica = self.get(rid)
+            if replica is None:  # raced a drain
+                continue
+            fc.attempts.append(rid)
+            try:
+                if self._chaos_forward is not None:
+                    self._chaos_forward.maybe_fail()
+                req = replica.submit(
+                    fc.prompt, fc.max_new, priority=fc.priority
+                )
+            except (chaos_mod.InjectedFault, ReplicaError, RuntimeError):
+                continue  # next candidate; health tick handles the corpse
+            fc.replica_id = rid
+            fc._req = req
+            probes.REGISTRY.counter_add("requests_routed", replica=rid)
+            return True
+        return False
+
+
+class RouterServer:
+    """HTTP front end over a :class:`FleetRouter` of HTTP replicas.
+
+    Same stdlib ``ThreadingHTTPServer`` plumbing as ``MetricsServer``;
+    routed POSTs are forwarded body-verbatim with candidate-ordered
+    failover (5xx or transport error → next replica)."""
+
+    ROUTED = ("/v1/pw_ai_answer", "/v2/answer", "/v1/retrieve", "/v2/retrieve")
+
+    def __init__(self, router: FleetRouter, *, manager=None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.router = router
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self._httpd = None
+        self._thread = None
+
+    def _route_body(self, path: str, body: bytes) -> tuple[int, bytes, str]:
+        try:
+            payload = json.loads(body.decode("utf-8")) if body else {}
+        except ValueError:
+            payload = {}
+        prompt = payload.get("prompt") or payload.get("query") or ""
+        for rid in self.router.candidates(prompt):
+            replica = self.router.get(rid)
+            if replica is None or not hasattr(replica, "forward"):
+                continue
+            try:
+                if self.router._chaos_forward is not None:
+                    self.router._chaos_forward.maybe_fail()
+                status, out, ctype = replica.forward(path, body)
+            except (chaos_mod.InjectedFault, ReplicaError):
+                continue
+            if status >= 500:
+                continue  # replica-side failure: fail over
+            probes.REGISTRY.counter_add("requests_routed", replica=rid)
+            return status, out, ctype
+        return (
+            502,
+            json.dumps({"error": "no replica available"}).encode("utf-8"),
+            "application/json",
+        )
+
+    def _fleet_state(self) -> dict:
+        if self.manager is not None:
+            return self.manager.state()
+        return {
+            "replicas": {rid: {"kind": getattr(r, "kind", "?")}
+                         for rid, r in self.router.replicas().items()},
+            "size": len(self.router),
+        }
+
+    def start(self) -> "RouterServer":
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from pathway_tpu.internals.http_server import openmetrics_text
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, status: int, body: bytes, ctype: str) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, b"ok\n", "text/plain; charset=utf-8")
+                elif self.path == "/readyz":
+                    up = any(
+                        True for _ in outer.router.replicas()
+                    )
+                    self._send(
+                        200 if up else 503,
+                        b"ready\n" if up else b"no replicas\n",
+                        "text/plain; charset=utf-8",
+                    )
+                elif self.path == "/metrics":
+                    text = openmetrics_text()
+                    self._send(
+                        200, text.encode("utf-8"),
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8",
+                    )
+                elif self.path in ("/v1/fleet", "/v1/statistics"):
+                    body = json.dumps(outer._fleet_state()).encode("utf-8")
+                    self._send(200, body, "application/json")
+                else:
+                    self._send(404, b"not found\n", "text/plain; charset=utf-8")
+
+            def do_POST(self):
+                if self.path not in RouterServer.ROUTED:
+                    self._send(404, b"not found\n", "text/plain; charset=utf-8")
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b""
+                status, out, ctype = outer._route_body(self.path, body)
+                self._send(status, out, ctype)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="fleet-router-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

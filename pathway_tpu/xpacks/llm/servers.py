@@ -90,6 +90,10 @@ class BaseRestServer:
         self.webserver = PathwayWebserver(host, port)
         self.rest_kwargs = rest_kwargs
         self._thread: threading.Thread | None = None
+        # readiness: set when run() hands control to the pipeline (the
+        # dataflow routes register at connector start inside pw.run);
+        # /readyz reports 503 until then so probes hold traffic
+        self._ready = threading.Event()
 
     def serve(
         self,
@@ -146,6 +150,23 @@ class BaseRestServer:
                 None, functools.partial(profiling.capture_trace, ms)
             )
 
+        async def healthz_handler(_payload):
+            # liveness: answering at all IS the signal
+            return "ok\n"
+
+        healthz_handler._raw_content_type = "text/plain"
+
+        async def readyz_handler(_payload):
+            from pathway_tpu.io.http import RestApiError
+
+            if not self._ready.is_set():
+                raise RestApiError(
+                    503, {"error": "pipeline not started"}, retry_after=1
+                )
+            return "ready\n"
+
+        readyz_handler._raw_content_type = "text/plain"
+
         self.webserver._register("/metrics", ["GET"], metrics_handler)
         self.webserver._register(
             "/v1/statistics", ["GET", "POST"], statistics_handler
@@ -153,6 +174,8 @@ class BaseRestServer:
         self.webserver._register(
             "/debug/profile", ["GET", "POST"], profile_handler
         )
+        self.webserver._register("/healthz", ["GET"], healthz_handler)
+        self.webserver._register("/readyz", ["GET"], readyz_handler)
 
     def run(
         self,
@@ -166,6 +189,7 @@ class BaseRestServer:
         self.start_observability_endpoints()
 
         def run_pipeline():
+            self._ready.set()  # pipeline start imminent: flip /readyz
             pw.run(
                 monitoring_level=pw.MonitoringLevel.NONE,
                 terminate_on_error=terminate_on_error,

@@ -95,6 +95,10 @@ def test_bench_smoke_schema():
         "kv_fragmentation", "kv_fragmentation_dense", "paged_tok_s",
         "dense_tok_s", "paged_max_slots", "dense_max_slots",
         "paged_tokens_match",
+        # replicated fleet (PR 12): throughput/p95/hit-rate off the
+        # 2-replica affinity-routed arm + the chaos failover verdict
+        "fleet_tok_s", "fleet_p95_ms", "fleet_prefix_hit_rate",
+        "fleet_hit_ratio", "fleet_chaos_p95_ms", "fleet_failover_ok",
     ):
         assert srv.get(key) is not None, key
     # span-derived latencies are real measurements off the decode phase
@@ -112,6 +116,12 @@ def test_bench_smoke_schema():
     assert srv["requests_shed"] == 0
     assert srv["restarts"] == 0
     assert srv["degradation_level"] == 0
+    # the fleet arm: affinity routing held the single-replica prefix hit
+    # rate, and the chaos-on-one-replica trace reached terminal answers
+    assert srv["fleet_hit_ratio"] >= 0.9
+    assert srv["fleet_failover_ok"] is True
+    assert 0.0 < srv["fleet_prefix_hit_rate"] <= 1.0
+    assert srv["fleet_tok_s"] > 0
     # the shared-prefix trace actually exercised the KV prefix cache
     assert 0.0 < srv["prefix_hit_rate"] <= 1.0
     assert srv["prefill_tokens_saved"] > 0

@@ -1,0 +1,72 @@
+"""``initialize_distributed`` re-initialization semantics: idempotent on
+the SAME topology, a typed ``DistributedInitError`` on a CONFLICTING one
+(the old code silently kept the first topology — a replica spawned with
+a stale env contract looked initialized while addressing the wrong
+coordinator), and a reset hook so tests can re-evaluate config."""
+
+import pytest
+
+from pathway_tpu.parallel import distributed as D
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    D.reset_distributed()
+    yield
+    D.reset_distributed()
+
+
+def test_single_process_init_records_topology():
+    assert D.distributed_topology() is None
+    D.initialize_distributed()
+    topo = D.distributed_topology()
+    assert topo is not None
+    assert topo.num_processes == 1  # no env contract in the test runner
+
+
+def test_reinit_same_topology_is_noop():
+    cfg = D.DistributedConfig(num_processes=1, process_id=0,
+                              coordinator_address=None)
+    D.initialize_distributed(cfg)
+    D.initialize_distributed(cfg)  # same config: silently fine
+    D.initialize_distributed()  # from_env resolves to the same thing
+    assert D.distributed_topology() == cfg
+
+
+def test_reinit_conflicting_topology_raises_typed_error():
+    D.initialize_distributed()
+    active = D.distributed_topology()
+    conflicting = D.DistributedConfig(
+        num_processes=4, process_id=2,
+        coordinator_address="127.0.0.1:12345",
+    )
+    with pytest.raises(D.DistributedInitError) as exc_info:
+        D.initialize_distributed(conflicting)
+    err = exc_info.value
+    assert isinstance(err, RuntimeError)  # catchable as the base type
+    assert err.active == active
+    assert err.requested == conflicting
+    assert "already initialized" in str(err)
+    # the active topology survives the failed re-init
+    assert D.distributed_topology() == active
+
+
+def test_reset_allows_reinitialization():
+    D.initialize_distributed()
+    assert D.distributed_topology() is not None
+    D.reset_distributed()
+    assert D.distributed_topology() is None
+    # after reset, a previously-conflicting config initializes cleanly
+    # (single-process: no actual jax.distributed join happens)
+    cfg = D.DistributedConfig(num_processes=1, process_id=0,
+                              coordinator_address="127.0.0.1:55555")
+    D.initialize_distributed(cfg)
+    assert D.distributed_topology() == cfg
+
+
+def test_exported_from_parallel_package():
+    import pathway_tpu.parallel as P
+
+    assert P.DistributedInitError is D.DistributedInitError
+    assert P.reset_distributed is D.reset_distributed
+    assert P.distributed_topology is D.distributed_topology

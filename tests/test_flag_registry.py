@@ -23,7 +23,9 @@ def _readme_block(group: str) -> str:
     return m.group(1).strip()
 
 
-@pytest.mark.parametrize("group", ["pipeline", "query", "observability", "fault"])
+@pytest.mark.parametrize(
+    "group", ["pipeline", "query", "observability", "fault", "fleet"]
+)
 def test_readme_tables_are_generated_output(group):
     """README tables match `render_flag_table` byte-for-byte; regenerate
     with `python -m pathway_tpu.internals.config` after editing a Flag."""

@@ -1,0 +1,440 @@
+"""Replicated serving fleet (``pathway_tpu/serving/``): the
+``PATHWAY_TPU_FLEET`` kill switch (off ⇒ byte-identical single-server
+behavior — the pinned test the flag registry points at), prefix-affinity
+routing, mid-flight failover through the PR-10 retry path, and the
+supervisor's drain/respawn + SLO elasticity policy."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pathway_tpu.engine import probes
+from pathway_tpu.models import decoder as D
+from pathway_tpu.serving import build_fleet, fleet_enabled
+from pathway_tpu.serving.fleet import FleetManager
+from pathway_tpu.serving.replica import InProcessReplica, ReplicaError
+from pathway_tpu.serving.router import FleetRouter
+
+from tests.utils import ToyCharTokenizer
+
+TINY = D.DecoderConfig(
+    vocab_size=128, hidden=32, layers=2, heads=4, intermediate=64,
+    max_position=128, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return D.init_params(jax.random.PRNGKey(0), TINY)
+
+
+def _chat(tiny_params, **flags):
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    return TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(),
+        max_new_tokens=6, temperature=0.0, max_prompt_tokens=32,
+        continuous=True, n_slots=2, chunk_steps=4, prefill_chunk=8,
+        **flags,
+    )
+
+
+# ------ fakes for router/supervisor logic (no decode) -------------------
+
+
+class _FakeReq:
+    def __init__(self, text="ok", error_reason=None, resolve=True):
+        self.done = threading.Event()
+        self.text = text
+        self.tokens = [1, 2]
+        self.error_reason = error_reason
+        if resolve:
+            self.done.set()
+
+
+class _FakeReplica:
+    """Duck-typed fleet member with scripted behavior."""
+
+    kind = "fake"
+
+    def __init__(self, replica_id, *, alive=True, burn=0.0,
+                 submit_raises=False, dead_mid_flight=False):
+        self.replica_id = replica_id
+        self.alive = alive
+        self.burn = burn
+        self.no_objectives = False  # scripted: scrape with no SLO config
+        self.submit_raises = submit_raises
+        self.dead_mid_flight = dead_mid_flight
+        self.submitted = []
+        self.stopped = False
+
+    def submit(self, prompt, max_new=None, *, priority=1):
+        if self.submit_raises:
+            raise ReplicaError(f"{self.replica_id} loop dead")
+        self.submitted.append(prompt)
+        if self.dead_mid_flight:
+            # PR-10 drain shape: completed event, no text, no shed reason
+            return _FakeReq(text=None, error_reason=None)
+        return _FakeReq(text=f"{self.replica_id}:{prompt}")
+
+    def healthy(self):
+        return self.alive
+
+    def scrape(self):
+        if self.no_objectives:
+            return {"slo": {"objectives": {}}}
+        return {"slo": {"objectives": {"ttft": {
+            "burn_fast": self.burn, "burn_slow": self.burn,
+        }}}}
+
+    def stop(self):
+        self.stopped = True
+
+
+def _router(n, **kwargs):
+    kwargs.setdefault("affinity_blocks", 4)
+    kwargs.setdefault("block", 8)
+    router = FleetRouter(vnodes=32, **kwargs)
+    reps = [_FakeReplica(f"replica-{i}") for i in range(n)]
+    for r in reps:
+        router.add_replica(r)
+    return router, reps
+
+
+# ------ kill switch (pins PATHWAY_TPU_FLEET) ----------------------------
+
+
+def test_fleet_kill_switch_constructs_nothing(monkeypatch):
+    """PATHWAY_TPU_FLEET off (the default): build_fleet is the single
+    choke point and returns None — no ring, router or supervisor is
+    ever constructed, so the single-server path cannot be perturbed."""
+    monkeypatch.delenv("PATHWAY_TPU_FLEET", raising=False)
+    assert fleet_enabled() is False
+    booms = []
+    assert build_fleet(lambda rid: booms.append(rid)) is None
+    assert booms == []  # the factory was never even called
+    monkeypatch.setenv("PATHWAY_TPU_FLEET", "1")
+    assert fleet_enabled() is True
+
+
+def test_fleet_off_is_byte_identical_to_single_server(
+    monkeypatch, tiny_params
+):
+    """The pinned kill-switch guarantee: greedy tokens produced with
+    PATHWAY_TPU_FLEET=0 (plain chat) and with the flag on through a
+    fleet-of-1 router are byte-identical — routing adds a hop, never a
+    perturbation."""
+    prompts = ["context: alpha?", "context: beta?"]
+
+    monkeypatch.delenv("PATHWAY_TPU_FLEET", raising=False)
+    chat = _chat(tiny_params)
+    try:
+        baseline = [chat.submit_batch([p])[0] for p in prompts]
+        for r in baseline:
+            assert r.done.wait(timeout=120)
+        base_tokens = [list(r.tokens) for r in baseline]
+        base_texts = [r.text for r in baseline]
+    finally:
+        chat.close()
+
+    monkeypatch.setenv("PATHWAY_TPU_FLEET", "1")
+    chat2 = _chat(tiny_params)
+    manager = build_fleet(
+        lambda rid: InProcessReplica(rid, chat2),
+        replicas=1, min_replicas=1, max_replicas=1,
+    )
+    assert manager is not None
+    try:
+        fleet = [manager.router.submit(p) for p in prompts]
+        for fc in fleet:
+            assert fc.wait(timeout=120)
+        assert [fc.tokens for fc in fleet] == base_tokens
+        assert [fc.text for fc in fleet] == base_texts
+        assert all(fc.error_reason is None for fc in fleet)
+    finally:
+        manager.shutdown()
+
+
+# ------ affinity routing ------------------------------------------------
+
+
+def test_affinity_groups_stick_to_one_replica():
+    router, _ = _router(3)
+    head_a = "a" * 32  # 4 full 8-token blocks (char tokenizer: 1/char)
+    head_b = "b" * 32
+    owners_a = {router.submit(head_a + f" q{i}").replica_id
+                for i in range(6)}
+    owners_b = {router.submit(head_b + f" q{i}").replica_id
+                for i in range(6)}
+    assert len(owners_a) == 1  # a shared head never spreads
+    assert len(owners_b) == 1
+    # routed counter carries the per-replica label
+    snap = probes.REGISTRY.snapshot()["counters"]["requests_routed"]
+    assert sum(s["value"] for s in snap["series"]) >= 12
+
+
+def test_affinity_zero_round_robins():
+    router, _ = _router(3, affinity_blocks=0)
+    owners = [router.submit("x" * 32).replica_id for _ in range(9)]
+    assert set(owners) == {"replica-0", "replica-1", "replica-2"}
+
+
+def test_ring_metrics_on_membership_change():
+    probes.REGISTRY.remove("ring_moves", "replica_up")
+    router, reps = _router(2)
+    snap = probes.REGISTRY.snapshot()
+    moves = snap["counters"]["ring_moves"]["series"][0]["value"]
+    assert moves == 64  # 2 joins x 32 vnodes
+    up = {tuple(s["labels"].items())[0][1]: s["value"]
+          for s in snap["gauges"]["replica_up"]["series"]}
+    assert up == {"replica-0": 1.0, "replica-1": 1.0}
+    router.remove_replica("replica-0")
+    snap = probes.REGISTRY.snapshot()
+    assert snap["counters"]["ring_moves"]["series"][0]["value"] == 96
+    up = {tuple(s["labels"].items())[0][1]: s["value"]
+          for s in snap["gauges"]["replica_up"]["series"]}
+    assert up["replica-0"] == 0.0
+
+
+# ------ failover --------------------------------------------------------
+
+
+def test_dispatch_skips_dead_replica():
+    """A replica whose serving loop died raises at submit; the router
+    moves to the next ring candidate transparently."""
+    router, reps = _router(2)
+    fc = router.submit("y" * 32 + " q")
+    owner = fc.replica_id
+    router.get(owner).submit_raises = True
+    fc2 = router.submit("y" * 32 + " q2")  # same head, owner now dead
+    assert fc2.replica_id is not None and fc2.replica_id != owner
+    assert fc2.wait(timeout=5)
+    assert fc2.text is not None
+
+
+def test_mid_flight_death_requeues_on_next_candidate():
+    """PR-10 drain semantics (text=None, no shed reason) are the requeue
+    trigger: wait() re-dispatches to the next untried replica and the
+    request still reaches a terminal state with an answer."""
+    router, reps = _router(2)
+    fc = router.submit("z" * 32 + " q")
+    owner = fc.replica_id
+    router.get(owner).dead_mid_flight = False  # already submitted
+    # simulate the in-flight drain on the bound request
+    fc._req.text = None
+    fc._req.error_reason = None
+    fc._req.done.set()
+    assert fc.wait(timeout=5)
+    assert fc.text is not None  # answered by the OTHER replica
+    assert fc.replica_id != owner
+    assert fc.attempts[0] == owner and len(fc.attempts) == 2
+
+
+def test_shed_is_terminal_not_retried():
+    router, reps = _router(2)
+    fc = router.submit("w" * 32)
+    fc._req.text = None
+    fc._req.error_reason = "shed:deadline"
+    fc._req.done.set()
+    assert fc.wait(timeout=5)
+    assert fc.error_reason == "shed:deadline"
+    assert len(fc.attempts) == 1  # a deliberate shed never fails over
+
+
+def test_all_replicas_dead_is_terminal_no_replica():
+    router, reps = _router(2)
+    for r in reps:
+        r.submit_raises = True
+    fc = router.submit("v" * 32)
+    assert fc.wait(timeout=5)
+    assert fc.text is None
+    assert fc.error_reason == "fleet:no_replica"
+
+
+# ------ supervisor: drain / respawn / elasticity ------------------------
+
+
+def _manager(n=2, factory_state=None, **kwargs):
+    state = factory_state if factory_state is not None else {}
+    state.setdefault("made", [])
+
+    def factory(rid):
+        rep = _FakeReplica(rid)
+        state["made"].append(rep)
+        return rep
+
+    kwargs.setdefault("replicas", n)
+    kwargs.setdefault("min_replicas", 1)
+    kwargs.setdefault("max_replicas", 4)
+    kwargs.setdefault("health_interval_s", 0.01)
+    kwargs.setdefault("scale_cooldown_s", 0.0)
+    kwargs.setdefault("sleep", lambda s: None)
+    manager = FleetManager(factory, **kwargs).start()
+    return manager, state
+
+
+def test_health_pass_drains_and_respawns_dead_replica():
+    manager, state = _manager(2)
+    victim = state["made"][0]
+    victim.alive = False
+    drained = manager.health_pass()
+    assert drained == [victim.replica_id]
+    assert victim.stopped  # drained replicas are torn down
+    assert victim.replica_id not in manager.router.ring.members()
+    assert len(manager.router) == 2  # respawned back to size
+    st = manager.state()
+    assert st["respawns"] == 1
+    assert ("drain", victim.replica_id) in st["events"]
+
+
+def test_boot_grace_shields_never_ready_replica():
+    """A member that has never probed healthy keeps its boot grace — a
+    subprocess replica spends seconds in jax import + first jit before
+    it listens, and draining it then is a respawn storm, not
+    supervision. The grace ends when it expires or the moment the
+    replica has ever been ready."""
+    now = {"t": 0.0}
+    manager, state = _manager(2, boot_grace_s=30.0, clock=lambda: now["t"])
+    booting = state["made"][0]
+    booting.alive = False  # not listening yet
+    assert manager.health_pass() == []  # inside grace: no drain
+    assert len(manager.router) == 2
+    now["t"] = 31.0
+    drained = manager.health_pass()  # grace expired: normal drain path
+    assert drained == [booting.replica_id]
+    assert len(manager.router) == 2  # respawned
+
+    # a replica that WAS ready once gets no grace on later failures
+    ready_once = state["made"][1]
+    assert manager.health_pass() == []  # all healthy; marked ever-ready
+    ready_once.alive = False
+    assert manager.health_pass() == [ready_once.replica_id]
+
+
+def test_respawn_uses_bounded_backoff():
+    """A factory that fails twice then succeeds: the supervisor retries
+    through ExponentialBackoffRetryStrategy's schedule instead of
+    giving up (or spinning)."""
+    sleeps = []
+    attempts = {"n": 0}
+    state = {"made": []}
+
+    def flaky_factory(rid):
+        attempts["n"] += 1
+        if attempts["n"] <= 2:
+            raise RuntimeError("spawn infra hiccup")
+        rep = _FakeReplica(rid)
+        state["made"].append(rep)
+        return rep
+
+    manager = FleetManager(
+        flaky_factory, replicas=0, min_replicas=0, max_replicas=4,
+        sleep=sleeps.append,
+    )
+    rid = manager._respawn_replica()
+    assert rid is not None
+    assert attempts["n"] == 3
+    assert len(sleeps) == 2  # two backoff waits between three attempts
+    assert sleeps[1] > sleeps[0]  # exponential, not fixed
+
+
+def test_chaos_replica_health_drains_and_respawns(monkeypatch):
+    """The `replica.health` chaos site injects probe failures: a fully
+    armed site makes every probe fail, which must drain + respawn, not
+    wedge the supervisor."""
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS", "1.0")
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS_SITES", "replica.health")
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS_SEED", "7")
+    manager, state = _manager(2)  # sites armed at construction
+    assert manager._chaos_health is not None
+    drained = manager.health_pass()
+    assert len(drained) == 2  # every probe faulted
+    assert len(manager.router) == 2  # but the fleet healed to size
+    assert manager.state()["respawns"] == 2
+
+
+def test_elasticity_scales_up_on_burn_and_down_on_quiescence():
+    clock = {"t": 0.0}
+    manager, state = _manager(
+        2, scale_cooldown_s=5.0, clock=lambda: clock["t"],
+    )
+    clock["t"] = 10.0
+    for rep in manager.router.replicas().values():
+        rep.burn = 2.0  # both windows burning hot on every member
+    assert manager.elasticity_pass() == "scale_up"
+    assert len(manager.router) == 3
+    # cooldown: an immediate second pass must NOT scale again
+    assert manager.elasticity_pass() is None
+    clock["t"] = 20.0
+    for rep in manager.router.replicas().values():
+        rep.burn = 0.0
+    assert manager.elasticity_pass() == "scale_down"
+    assert len(manager.router) == 2
+    clock["t"] = 30.0
+    # floor: min_replicas is never crossed
+    manager.min_replicas = 2
+    assert manager.elasticity_pass() is None
+    assert len(manager.router) == 2
+
+
+def test_elasticity_inert_without_slo_objectives():
+    """No replica reports any SLO objective → burn 0.0 means 'no
+    signal', not 'healthy and idle': the fleet keeps its requested size
+    instead of collapsing to min on the first tick (found live — a
+    2-replica `fleet serve` with no PATHWAY_TPU_SLO_* env scaled itself
+    down immediately)."""
+    manager, state = _manager(2)
+    for rep in state["made"]:
+        rep.no_objectives = True
+    assert manager.elasticity_pass() is None
+    assert len(manager.router) == 2  # NOT scaled down to min=1
+
+    # the moment an objective appears, the same quiescent burn scales
+    state["made"][0].no_objectives = False
+    assert manager.elasticity_pass() == "scale_down"
+    assert len(manager.router) == 1
+
+
+def test_elasticity_respects_max_replicas():
+    clock = {"t": 100.0}
+    manager, _ = _manager(
+        2, max_replicas=2, scale_cooldown_s=0.0, clock=lambda: clock["t"],
+    )
+    for rep in manager.router.replicas().values():
+        rep.burn = 5.0
+    assert manager.elasticity_pass() is None  # already at the ceiling
+    assert len(manager.router) == 2
+
+
+def test_manager_state_shape():
+    manager, _ = _manager(2)
+    st = manager.state()
+    assert st["size"] == 2
+    assert set(st["replicas"]) == set(st["ring_members"])
+    assert st["min"] == 1 and st["max"] == 4
+    assert st["burn"] == 0.0 and st["respawns"] == 0
+    manager.shutdown()
+    assert len(manager.router) == 0
+
+
+# ------ chaos router.forward --------------------------------------------
+
+
+def test_chaos_router_forward_fails_over(monkeypatch):
+    """An armed `router.forward` site faults the first dispatch attempt;
+    the router's candidate walk absorbs it — the request lands on a
+    fallback replica instead of erroring out."""
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS", "1.0")
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS_SITES", "router.forward")
+    monkeypatch.setenv("PATHWAY_TPU_CHAOS_SEED", "3")
+    router = FleetRouter(affinity_blocks=4, block=8, vnodes=32)
+    assert router._chaos_forward is not None
+    for i in range(2):
+        router.add_replica(_FakeReplica(f"replica-{i}"))
+    fc = router.submit("u" * 32)
+    # rate 1.0 faults EVERY forward, so every candidate is consumed
+    assert fc.wait(timeout=5)
+    assert fc.error_reason == "fleet:no_replica"
+    assert len(fc.attempts) == 2  # bounded by fleet size, no spin

@@ -1,0 +1,118 @@
+"""The consistent-hash ring as a standalone unit (``serving/hashring.py``):
+placement must be deterministic across processes, membership changes must
+move only ~K/N of the keyspace, and the prompt-head key must be stable
+under suffix edits — the three properties the fleet's prefix-cache
+affinity rests on."""
+
+import pytest
+
+from pathway_tpu.serving.hashring import HashRing, head_block_key
+
+
+def _keys(n=2000):
+    return [f"key-{i}".encode() for i in range(n)]
+
+
+def _placement(ring, keys):
+    return {k: ring.lookup(k) for k in keys}
+
+
+def test_deterministic_placement_across_instances():
+    """Two rings built with the same members agree on every key — the
+    vnode positions come from blake2b, not the salted builtin hash, so
+    a restarted router keeps routing prompts to the same replicas."""
+    a, b = HashRing(vnodes=64), HashRing(vnodes=64)
+    for rid in ("replica-0", "replica-1", "replica-2"):
+        a.add(rid)
+        b.add(rid)
+    keys = _keys()
+    assert _placement(a, keys) == _placement(b, keys)
+    # and insertion order does not matter either
+    c = HashRing(vnodes=64)
+    for rid in ("replica-2", "replica-0", "replica-1"):
+        c.add(rid)
+    assert _placement(a, keys) == _placement(c, keys)
+
+
+def test_join_moves_at_most_k_over_n_plus_eps():
+    """Adding the (N+1)-th member steals ~K/(N+1) keys; everything that
+    moved must have moved TO the joiner (no collateral reshuffling —
+    the whole point of consistent hashing over mod-N)."""
+    ring = HashRing(vnodes=128)
+    for i in range(4):
+        ring.add(f"replica-{i}")
+    keys = _keys(4000)
+    before = _placement(ring, keys)
+    ring.add("replica-4")
+    after = _placement(ring, keys)
+    moved = [k for k in keys if before[k] != after[k]]
+    # every moved key lands on the joiner, nothing shuffles sideways
+    assert all(after[k] == "replica-4" for k in moved)
+    expected = len(keys) / 5
+    assert len(moved) <= expected * 1.5  # K/N + eps (vnode variance)
+    assert len(moved) >= expected * 0.5  # and the joiner takes real load
+
+
+def test_leave_moves_only_the_leavers_keys():
+    ring = HashRing(vnodes=128)
+    for i in range(5):
+        ring.add(f"replica-{i}")
+    keys = _keys(4000)
+    before = _placement(ring, keys)
+    ring.remove("replica-2")
+    after = _placement(ring, keys)
+    for k in keys:
+        if before[k] == "replica-2":
+            assert after[k] != "replica-2"  # reassigned somewhere live
+        else:
+            assert after[k] == before[k]  # survivors keep their keys
+    orphaned = sum(1 for k in keys if before[k] == "replica-2")
+    assert orphaned <= len(keys) / 5 * 1.5
+
+
+def test_membership_bookkeeping():
+    ring = HashRing(vnodes=16)
+    assert ring.lookup(b"anything") is None  # empty ring
+    assert ring.add("a") == 16  # arcs moved == vnodes inserted
+    assert ring.add("a") == 0  # idempotent re-add moves nothing
+    assert "a" in ring and len(ring) == 1
+    assert ring.remove("missing") == 0
+    assert ring.remove("a") == 16
+    assert ring.members() == [] and len(ring) == 0
+
+
+def test_head_key_stable_under_suffix_edits():
+    """Prompts sharing their first `blocks` full blocks key identically
+    no matter the tail — a shared RAG context plus different user
+    questions must land on the same replica's radix cache."""
+    head = [7] * 32  # 4 full blocks of 8
+    k1 = head_block_key(head + [1, 2, 3], block=8, blocks=4)
+    k2 = head_block_key(head + [9] * 40, block=8, blocks=4)
+    k3 = head_block_key(head, block=8, blocks=4)
+    assert k1 == k2 == k3
+    # a different head keys differently
+    k4 = head_block_key([8] * 32 + [1, 2, 3], block=8, blocks=4)
+    assert k4 != k1
+    # ... and so does a prompt that shares only 3 of the 4 head blocks
+    k5 = head_block_key(head[:24] + [5] * 8 + [1, 2, 3], block=8, blocks=4)
+    assert k5 != k1
+
+
+def test_head_key_partial_and_short_prompts():
+    # shorter than `blocks` full blocks: only the full blocks count, so
+    # a 20-token prompt keys on its first 2 blocks of 8
+    assert head_block_key([3] * 20, block=8, blocks=4) == \
+        head_block_key([3] * 16 + [9, 9, 9, 9], block=8, blocks=4)
+    # shorter than ONE block: the whole prompt is the key (no shareable
+    # aligned head exists, so suffix edits legitimately re-key)
+    assert head_block_key([1, 2, 3], block=8, blocks=4) != \
+        head_block_key([1, 2, 4], block=8, blocks=4)
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        head_block_key([1], block=0, blocks=4)
+    with pytest.raises(ValueError):
+        head_block_key([1], block=8, blocks=0)
+    with pytest.raises(ValueError):
+        HashRing(vnodes=0)

@@ -1,0 +1,105 @@
+"""``/healthz`` (liveness) + ``/readyz`` (readiness) on both HTTP
+surfaces — the stdlib ``MetricsServer`` and the aiohttp-backed
+``BaseRestServer`` — in both states. The fleet health checker routes on
+exactly these codes, so the 200/503 contract is load-bearing."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+
+def _get(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read(), dict(exc.headers)
+
+
+# ------ MetricsServer (stdlib) -----------------------------------------
+
+
+@pytest.fixture
+def metrics_server():
+    from pathway_tpu.internals.http_server import MetricsServer
+
+    ready = {"v": False}
+    srv = MetricsServer(stats=None, port=0, ready_check=lambda: ready["v"])
+    srv.start()
+    try:
+        yield srv, ready
+    finally:
+        srv.stop()
+
+
+def test_metrics_server_healthz_always_live(metrics_server):
+    srv, _ = metrics_server
+    status, body, _ = _get(f"http://127.0.0.1:{srv.port}/healthz")
+    assert status == 200
+    assert body == b"ok\n"
+
+
+def test_metrics_server_readyz_both_states(metrics_server):
+    srv, ready = metrics_server
+    base = f"http://127.0.0.1:{srv.port}"
+    status, body, headers = _get(base + "/readyz")
+    assert status == 503
+    assert b"not ready" in body
+    assert headers.get("Retry-After") == "1"  # probes know to come back
+    ready["v"] = True
+    status, body, _ = _get(base + "/readyz")
+    assert status == 200
+    assert body == b"ready\n"
+
+
+def test_metrics_server_ready_check_exception_is_not_ready(metrics_server):
+    srv, _ = metrics_server
+    srv.ready_check = lambda: 1 / 0  # a crashing probe must fail closed
+    status, _, _ = _get(f"http://127.0.0.1:{srv.port}/readyz")
+    assert status == 503
+
+
+def test_metrics_server_default_readiness_is_stats_snapshot():
+    from pathway_tpu.internals.http_server import MetricsServer
+
+    class _Stats:
+        def snapshot(self):
+            return {"current_time": 0}
+
+    srv = MetricsServer(stats=None, port=0)  # no stats, no ready_check
+    assert srv._ready() is False
+    srv2 = MetricsServer(stats=_Stats(), port=0)
+    assert srv2._ready() is True
+
+
+# ------ BaseRestServer (aiohttp) ---------------------------------------
+
+
+@pytest.fixture
+def rest_server():
+    from pathway_tpu.xpacks.llm.servers import BaseRestServer
+
+    srv = BaseRestServer("127.0.0.1", 0)
+    srv.start_observability_endpoints()
+    srv.webserver.start()
+    yield srv, f"http://127.0.0.1:{srv.webserver.port}"
+
+
+def test_rest_server_healthz_before_pipeline(rest_server):
+    _, base = rest_server
+    status, body, _ = _get(base + "/healthz")
+    assert status == 200
+    assert body == b"ok\n"
+
+
+def test_rest_server_readyz_flips_with_pipeline_start(rest_server):
+    srv, base = rest_server
+    # before run(): routes answer (liveness) but readiness gates traffic
+    status, body, headers = _get(base + "/readyz")
+    assert status == 503
+    assert headers.get("Retry-After") == "1"
+    srv._ready.set()  # what run()'s run_pipeline() does first
+    status, body, _ = _get(base + "/readyz")
+    assert status == 200
+    assert body == b"ready\n"
