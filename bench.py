@@ -2307,6 +2307,255 @@ def _serving_paged_trace(params, cfg, tok) -> dict:
     }
 
 
+def _serving_disagg_trace(params, cfg, tok) -> dict:
+    """Disaggregated prefill/decode lane claim (PATHWAY_TPU_DISAGG): a
+    bursty mixed trace — a standing population of decode-heavy short
+    requests with long-context prefill bursts landing on top — through
+    two paged continuous servers, lanes ON vs interleaved. Interleaved
+    admission drains EVERY pending prefill piece between decode chunks,
+    so a prefill burst stretches the inter-chunk gap (and the decode
+    TPOT tail with it); the prefill lane's per-tick piece budget
+    (PATHWAY_TPU_DISAGG_PREFILL_BUDGET) bounds that gap at one piece.
+    Greedy decoding is schedule-invariant, so lane scheduling must not
+    change a single token (``tokens_match``); ``kv_migrated_blocks``
+    counts block-table identity handoffs at the prefill->decode lane
+    edge (zero-copy on one chip — the row IS the handoff)."""
+    from pathway_tpu.engine import probes
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    if _smoke():
+        NSHORT, NLONG, MAXNEW, N_SLOTS, CHUNK, DEPTH = 2, 8, 16, 6, 2, 2
+    else:
+        NSHORT, NLONG, MAXNEW, N_SLOTS, CHUNK, DEPTH = 4, 24, 48, 8, 2, 2
+    LONG_NEW = 4  # long requests are prefill-dominated by construction
+    rng = np.random.default_rng(17)
+    head = "c" * 40 + "ontext: "
+    shorts = [
+        f"q{k:02d}" + "y" * int(rng.integers(2, 6)) for k in range(NSHORT)
+    ]
+    longs = [
+        head + f"L{k:02d}tail"[:8].ljust(8, "x") for k in range(NLONG)
+    ]
+
+    def run_arm(disagg: bool):
+        chat = TPUDecoderChat(
+            params=params, cfg=cfg, tokenizer=tok,
+            max_new_tokens=MAXNEW, temperature=0.0, max_prompt_tokens=64,
+            continuous=True, n_slots=N_SLOTS, chunk_steps=CHUNK,
+            pipeline_depth=DEPTH, prefill_chunk=8, prefix_cache=False,
+            paged_kv=True, disagg=disagg, disagg_prefill_budget=1,
+        )
+        try:
+            srv = chat._server
+            # warm both admission buckets (long + short) outside the
+            # timed window
+            for r in chat.submit_batch([head + "warmAAxx", "qWWyyy"]):
+                r.done.wait(timeout=120)
+            probes.reset_latency_metrics()
+            base_migrated = int(srv.stats.get("kv_migrated_blocks", 0))
+            t0 = time.perf_counter()
+            # the standing decode population goes first; the long
+            # prefill bursts then land while the shorts are mid-decode
+            reqs = chat.submit_batch(shorts)
+            per_burst = max(1, NLONG // 4)
+            for b in range(0, NLONG, per_burst):
+                reqs.extend(chat.submit_batch(
+                    longs[b:b + per_burst], max_new_tokens=LONG_NEW,
+                ))
+                time.sleep(0.02)
+            toks = []
+            for r in reqs:
+                r.done.wait(timeout=120)
+                toks.append(list(r.tokens))
+            wall = max(r.finished_at for r in reqs) - t0
+            # the headline tail comes from the registry histograms the
+            # spans feed (the same series /metrics scrapes)
+            tp = (
+                probes.latency_summary(phase="decode")
+                .get("tpot_seconds") or {}
+            )
+            gen = sum(len(t) for t in toks)
+            arm = {
+                "decode_p95_ms": tp.get("p95_ms"),
+                "decode_p50_ms": tp.get("p50_ms"),
+                "tok_s": round(gen / max(wall, 1e-9), 1),
+                "wall_s": round(wall, 3),
+                "kv_migrated_blocks": int(
+                    srv.stats.get("kv_migrated_blocks", 0)
+                ) - base_migrated,
+                "lanes": srv.lane_stats(),
+            }
+            return arm, toks
+        finally:
+            chat.close()
+
+    dis, toks_dis = run_arm(True)
+    inter, toks_int = run_arm(False)
+    return {
+        "trace": (
+            f"{NSHORT} standing {MAXNEW}-token decoders + {NLONG} "
+            f"long-context ({len(head) + 8}-token prefill, {LONG_NEW} "
+            f"new) arrivals in bursts of {max(1, NLONG // 4)}, "
+            f"{N_SLOTS} slots"
+        ),
+        "disagg": dis,
+        "interleaved": inter,
+        "disagg_decode_p95_ms": dis["decode_p95_ms"],
+        "interleaved_decode_p95_ms": inter["decode_p95_ms"],
+        "decode_p95_x": round(
+            (inter["decode_p95_ms"] or 0.0)
+            / max(dis["decode_p95_ms"] or 1e-9, 1e-9), 2
+        ),
+        "kv_migrated_blocks": dis["kv_migrated_blocks"],
+        "tokens_match": toks_dis == toks_int,
+    }
+
+
+def _serving_tier2_trace(params, cfg, tok) -> dict:
+    """Two-tier prefix cache claim (PATHWAY_TPU_PREFIX_T2_MB) plus the
+    admission scheduler's preemption contract. Churny multi-tenant
+    trace: more distinct shared heads than the tier-1 block budget can
+    pin, so every head's blocks are demoted to the pinned host store
+    by the next head's insert; when a churned head returns, the
+    admission-time tier-2 match promotes its blocks back through the
+    h2d stage pipeline and the next same-head request prefills from
+    device cache again. The t2-off arm replays the identical trace with
+    the host tier disabled (budget 0 — the byte-identical kill switch),
+    so ``tokens_match`` pins schedule invariance and ``hit_rate_t2``
+    is the claim. The preemption phase drives the verified
+    over-budget construction (budget strictly between one and two
+    request budgets): a queued under-budget tenant preempts the newest
+    over-budget slot — rewound, KV parked, requeued — with ZERO sheds
+    and byte-identical tokens vs an unscheduled reference server."""
+    from pathway_tpu.engine import probes
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    if _smoke():
+        NHEADS, MAXNEW, N_SLOTS, CHUNK = 4, 8, 4, 4
+    else:
+        NHEADS, MAXNEW, N_SLOTS, CHUNK = 6, 16, 8, 8
+    # 48-char heads = 6 prefix blocks at block 8; tier-1 pins ONE
+    # prompt (7 full blocks) + slack, tier-2 holds the whole head set
+    blk = 8
+    itemsize = np.dtype(cfg.dtype).itemsize
+    block_bytes = 2 * cfg.layers * cfg.heads * blk * cfg.head_dim * itemsize
+    t1_mb = 9 * block_bytes / (1 << 20)
+    t2_mb = 16 * NHEADS * block_bytes / (1 << 20)
+    heads = [
+        ("%02d" % h) * 3 + "c" * 34 + "ontext: " for h in range(NHEADS)
+    ]
+
+    def run_arm(t2_on: bool):
+        chat = TPUDecoderChat(
+            params=params, cfg=cfg, tokenizer=tok,
+            max_new_tokens=MAXNEW, temperature=0.0, max_prompt_tokens=64,
+            continuous=True, n_slots=N_SLOTS, chunk_steps=CHUNK,
+            prefill_chunk=blk, prefix_cache=True, prefix_cache_mb=t1_mb,
+            prefix_t2_mb=t2_mb if t2_on else 0.0, paged_kv=True,
+        )
+        try:
+            srv = chat._server
+            for r in chat.submit_batch([heads[0][:40] + "warmAAxx"]):
+                r.done.wait(timeout=120)
+            srv.prefix_reset()
+            probes.reset_prefix_stats()
+            toks = []
+
+            def run_one(prompt, tenant):
+                r = chat.submit_batch([prompt], tenant=tenant)[0]
+                r.done.wait(timeout=120)
+                toks.append(list(r.tokens))
+
+            # churn: each head's insert evicts (demotes) the previous
+            # head's blocks — tier-1 never holds two heads at once
+            for h, head in enumerate(heads):
+                run_one(head + f"c{h:02d}first", f"t{h % 3}")
+            # return: every probe misses tier-1 (churned out) and, with
+            # the host tier on, hits tier-2 -> async promotion; after
+            # the h2d pipeline drains, the confirm request on the same
+            # head prefills from device cache
+            for h, head in enumerate(heads):
+                run_one(head + f"c{h:02d}probe", f"t{h % 3}")
+                if t2_on:
+                    srv.t2_drain(timeout=30.0)
+                run_one(head + f"c{h:02d}after", f"t{h % 3}")
+            ps = probes.prefix_stats()
+            arm = {
+                "hit_rate_t2": ps.get("hit_rate_t2", 0.0),
+                "t2_lookups": ps.get("t2_lookups", 0),
+                "t2_hits": ps.get("t2_hits", 0),
+                "t2_promoted_blocks": ps.get("t2_promoted_blocks", 0),
+                "t2_demoted_blocks": ps.get("t2_demoted_blocks", 0),
+                "prefill_tokens_saved": ps["prefill_tokens_saved"],
+                "hit_rate": ps["hit_rate"],
+                "tier2": (srv.prefix.stats() or {}).get("tier2"),
+            }
+            return arm, toks
+        finally:
+            chat.close()
+
+    on, toks_on = run_arm(True)
+    off, toks_off = run_arm(False)
+
+    # ---- preemption phase: budget in (MAXNEW_P, 2*MAXNEW_P) admits two
+    # same-tenant requests and only then flags the tenant over budget;
+    # the queued other-tenant request then preempts the newest slot
+    MAXNEW_P = 16
+    prompts_p = ["pa one xxxx", "pa two yyyy", "pb one zzzz"]
+
+    def run_preempt(sched: bool):
+        chat = TPUDecoderChat(
+            params=params, cfg=cfg, tokenizer=tok,
+            max_new_tokens=MAXNEW_P, temperature=0.0,
+            max_prompt_tokens=64, continuous=True, n_slots=2,
+            chunk_steps=4, prefill_chunk=8, prefix_cache=False,
+            paged_kv=True, tenant_sched=sched,
+            tenant_budget=MAXNEW_P + 2, tenant_weights="a:2,b:1",
+        )
+        try:
+            srv = chat._server
+            for r in chat.submit_batch(["warm xxxx"]):
+                r.done.wait(timeout=120)
+            base = dict(srv.stats)
+            ra = chat.submit_batch(prompts_p[:2], tenant="a")
+            deadline = time.perf_counter() + 60
+            while (srv.stats["admitted"] - base["admitted"] < 2
+                   and time.perf_counter() < deadline):
+                time.sleep(0.002)
+            rb = chat.submit_batch([prompts_p[2]], tenant="b")
+            toks = []
+            for r in ra + rb:
+                r.done.wait(timeout=120)
+                toks.append(list(r.tokens))
+            return {
+                "preemptions": int(
+                    srv.stats["preemptions"] - base["preemptions"]
+                ),
+                "shed": int(srv.stats["shed"] - base["shed"]),
+            }, toks
+        finally:
+            chat.close()
+
+    pre, toks_pre = run_preempt(True)
+    _ref, toks_ref = run_preempt(False)
+    return {
+        "trace": (
+            f"{NHEADS} shared heads x3 visits each (churn/probe/after), "
+            f"tier-1 pins 1 head, {MAXNEW} new tokens; + 3-request "
+            f"preemption phase (budget {MAXNEW_P + 2} vs {MAXNEW_P}/req)"
+        ),
+        "t2_on": on,
+        "t2_off": off,
+        "prefix_hit_rate_t2": on["hit_rate_t2"],
+        "t2_recovered_prefill_tokens": on["t2_promoted_blocks"] * blk,
+        "prefill_tokens_saved": on["prefill_tokens_saved"],
+        "tokens_match": toks_on == toks_off,
+        "preemptions_total": pre["preemptions"],
+        "preempt_sheds": pre["shed"],
+        "preempt_tokens_match": toks_pre == toks_ref,
+    }
+
+
 def _serving_fleet_trace(params, cfg, tok) -> dict:
     """Replicated-fleet serving claim (PATHWAY_TPU_FLEET): the shared-head
     Poisson trace through three arms — a fleet of ONE in-process replica
@@ -2672,6 +2921,8 @@ def _decoder_serving_compare(params, cfg) -> dict:
     prefix = _serving_prefix_trace(params, cfg, _Tok())
     spec = _serving_spec_trace(params, cfg, _Tok())
     paged = _serving_paged_trace(params, cfg, _Tok())
+    disagg = _serving_disagg_trace(params, cfg, _Tok())
+    tier2 = _serving_tier2_trace(params, cfg, _Tok())
     fleet = _serving_fleet_trace(params, cfg, _Tok())
     return {
         # headline figures come from the REST product path
@@ -2704,6 +2955,10 @@ def _decoder_serving_compare(params, cfg) -> dict:
         "spec": spec,
         # paged block-table KV pool vs the dense slot pool
         "paged": paged,
+        # disaggregated prefill/decode lanes vs interleaved admission
+        "disagg": disagg,
+        # two-tier HBM->host prefix cache + admission-scheduler preemption
+        "tier2": tier2,
         # replicated fleet behind the prefix-affinity router
         "fleet": fleet,
         # bare-model comparison (per-request budgets, no engine): kept for
@@ -2998,6 +3253,36 @@ def main() -> None:
             "requests_shed": serving_det.get("requests_shed"),
             "restarts": serving_det.get("restarts"),
             "degradation_level": serving_det.get("degradation_level"),
+            "disagg_decode_p95_ms": (serving_det.get("disagg") or {}).get(
+                "disagg_decode_p95_ms"
+            ),
+            "interleaved_decode_p95_ms": (
+                serving_det.get("disagg") or {}
+            ).get("interleaved_decode_p95_ms"),
+            "disagg_tokens_match": (serving_det.get("disagg") or {}).get(
+                "tokens_match"
+            ),
+            "kv_migrated_blocks": (serving_det.get("disagg") or {}).get(
+                "kv_migrated_blocks"
+            ),
+            "prefix_hit_rate_t2": (serving_det.get("tier2") or {}).get(
+                "prefix_hit_rate_t2"
+            ),
+            "t2_recovered_prefill_tokens": (
+                serving_det.get("tier2") or {}
+            ).get("t2_recovered_prefill_tokens"),
+            "t2_tokens_match": (serving_det.get("tier2") or {}).get(
+                "tokens_match"
+            ),
+            "preemptions_total": (serving_det.get("tier2") or {}).get(
+                "preemptions_total"
+            ),
+            "preempt_sheds": (serving_det.get("tier2") or {}).get(
+                "preempt_sheds"
+            ),
+            "preempt_tokens_match": (serving_det.get("tier2") or {}).get(
+                "preempt_tokens_match"
+            ),
             "fleet_tok_s": (serving_det.get("fleet") or {}).get(
                 "fleet_tok_s"
             ),
@@ -3186,9 +3471,28 @@ def main() -> None:
             "kv_bytes_saved", "requests_shed", "restarts",
             "degradation_level", "fleet_tok_s", "fleet_p95_ms",
             "fleet_prefix_hit_rate", "fleet_hit_ratio",
-            "fleet_chaos_p95_ms",
+            "fleet_chaos_p95_ms", "disagg_decode_p95_ms",
+            "interleaved_decode_p95_ms", "kv_migrated_blocks",
+            "prefix_hit_rate_t2", "t2_recovered_prefill_tokens",
+            "preemptions_total",
         ):
             _chk(f"summary.serving.{k}", srv.get(k))
+        # disagg/tier-2 acceptance: lane scheduling and the host tier
+        # must not change a token; the churny trace must actually hit
+        # tier-2; the preemption phase must have preempted (not shed)
+        for k in ("disagg_tokens_match", "t2_tokens_match",
+                  "preempt_tokens_match"):
+            if srv.get(k) is not True:
+                missing.append(f"summary.serving.{k}")
+        t2r = srv.get("prefix_hit_rate_t2")
+        if not (isinstance(t2r, (int, float)) and t2r > 0):
+            missing.append("summary.serving.prefix_hit_rate_t2>0")
+        npre = srv.get("preemptions_total")
+        if not (isinstance(npre, (int, float)) and npre >= 1):
+            missing.append("summary.serving.preemptions_total>=1")
+        mig = srv.get("kv_migrated_blocks")
+        if not (isinstance(mig, (int, float)) and mig > 0):
+            missing.append("summary.serving.kv_migrated_blocks>0")
         # fleet acceptance: affinity must hold the single-replica hit
         # rate (>= 0.9x), and with chaos killing one replica's loop
         # every request must still have reached a terminal answer
@@ -3352,6 +3656,55 @@ def sentinel_check(summary: dict, baseline: dict, smoke: bool) -> list:
         breaches.append(
             "summary.serving.fleet_failover_ok: chaos-on-one-replica "
             "trace left requests non-terminal or past the p95 bar"
+        )
+    # disaggregated-lane gates, exact at every scale: the bursty mixed
+    # trace is the regime the lanes exist for, so the disagg decode tail
+    # must not regress past interleaved — and lane scheduling must not
+    # change a token of a greedy stream
+    dp = srv_new.get("disagg_decode_p95_ms")
+    ip = srv_new.get("interleaved_decode_p95_ms")
+    if dp is None or ip is None:
+        breaches.append("summary.serving.disagg_decode_p95_ms: missing")
+    elif (
+        isinstance(dp, (int, float)) and isinstance(ip, (int, float))
+        and dp > ip
+    ):
+        breaches.append(
+            f"summary.serving.disagg_decode_p95_ms: {dp} > interleaved "
+            f"{ip} — lanes lost the bursty decode tail"
+        )
+    for tk in ("disagg_tokens_match", "t2_tokens_match",
+               "preempt_tokens_match"):
+        tv = srv_new.get(tk)
+        if tv is not None and not tv:
+            breaches.append(
+                f"summary.serving.{tk}: greedy token stream diverged"
+            )
+    # two-tier cache gate: the churny trace must actually recover blocks
+    # from the host tier (hit rate 0 means demote/promote is dead)
+    t2r = srv_new.get("prefix_hit_rate_t2")
+    if not isinstance(t2r, (int, float)):
+        breaches.append("summary.serving.prefix_hit_rate_t2: missing")
+    elif t2r <= 0:
+        breaches.append(
+            f"summary.serving.prefix_hit_rate_t2: {t2r} — no tier-2 hits "
+            f"on the churn trace"
+        )
+    # preemption gate: the over-budget construction must preempt (slot
+    # rewound, KV parked, request requeued), never shed
+    npre = srv_new.get("preemptions_total")
+    if not isinstance(npre, (int, float)) or isinstance(npre, bool):
+        breaches.append("summary.serving.preemptions_total: missing")
+    elif npre < 1:
+        breaches.append(
+            f"summary.serving.preemptions_total: {npre} < 1 — budget "
+            f"preemption never fired"
+        )
+    psh = srv_new.get("preempt_sheds")
+    if isinstance(psh, (int, float)) and psh > 0:
+        breaches.append(
+            f"summary.serving.preempt_sheds: {psh} — preemption must "
+            f"requeue, not shed"
         )
     return breaches
 

@@ -262,6 +262,11 @@ def stats(url, as_json):
     section("dispatch", serving.get("dispatch") or {})
     section("stage_seconds", serving.get("stage_seconds") or {})
     section("occupancy", serving.get("occupancy") or {})
+    section("lanes", serving.get("lanes") or {})
+    section("tenants", serving.get("tenants") or {})
+    section("kv_parked_bytes", {
+        k: v for k, v in (serving.get("kv_parked_bytes") or {}).items() if v
+    })
     sched = snap.get("scheduler") or {}
     if sched:
         section("scheduler", {
@@ -294,23 +299,26 @@ def watch(url, interval, iterations, fail_on_alert):
     import json
     import time as time_mod
 
-    def one_pass() -> dict:
+    def one_pass() -> tuple[dict, dict]:
         if url is not None:
             import urllib.request
 
             endpoint = url.rstrip("/") + "/v1/statistics"
             with urllib.request.urlopen(endpoint, timeout=10.0) as resp:  # noqa: S310
-                return json.loads(resp.read().decode()).get("slo") or {}
+                snap = json.loads(resp.read().decode())
+            return snap.get("slo") or {}, snap.get("serving") or {}
+        from pathway_tpu.engine import probes
         from pathway_tpu.engine import slo as slo_mod
 
         wd = slo_mod.get_watchdog()
-        return wd.tick() if wd.objectives else wd.state()
+        state = wd.tick() if wd.objectives else wd.state()
+        return state, probes.serving_snapshot()
 
     n = 0
     state: dict = {}
     try:
         while True:
-            state = one_pass()
+            state, serving = one_pass()
             n += 1
             objectives = state.get("objectives") or {}
             if not objectives:
@@ -339,6 +347,20 @@ def watch(url, interval, iterations, fail_on_alert):
                         f"slow={o['burn_slow']:.2f} "
                         f"breaches={o['breaches']}"
                     )
+            lanes = serving.get("lanes") or {}
+            tenants = serving.get("tenants") or {}
+            if lanes:
+                click.echo(
+                    "   lanes: " + " ".join(
+                        f"{k}={v:.0f}" for k, v in sorted(lanes.items())
+                    )
+                )
+            if tenants:
+                click.echo(
+                    "   tenants queued: " + " ".join(
+                        f"{k}={v:.0f}" for k, v in sorted(tenants.items())
+                    )
+                )
             if iterations and n >= iterations:
                 break
             time_mod.sleep(max(interval, 0.05))

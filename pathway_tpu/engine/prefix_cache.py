@@ -43,11 +43,24 @@ returning blocks to the global allocator once no live slot shares them.
 A hit then seeds a slot by writing the pinned ids into its block table
 (``paged_admit_cached``), copy-on-write: suffix and decode writes land
 in blocks past the shared run, so shared bytes are never written.
+
+TWO-TIER mode (``PATHWAY_TPU_PREFIX_T2_MB`` > 0): eviction DEMOTES the
+dropped edge's KV bytes into a pinned host-RAM block store
+(:class:`HostTierStore`) before freeing the device blocks — the server
+supplies an ``export`` callback (``kv_block_export`` + device_get) that
+reads the blocks to host ``np`` arrays. A later ``match_t2`` finds the
+demoted continuation of a tier-1 match and hands the blobs back for
+async PROMOTION (the server re-inserts and scatters them on the h2d
+``StageWorker`` pipeline), so churn-evicted prompt heads survive in
+host RAM instead of being re-prefilled.
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Sequence
+
+import numpy as np
 
 from pathway_tpu.engine.probes import record_prefix
 
@@ -70,15 +83,109 @@ class _Node:
         self.stamp = 0  # LRU clock at last touch
 
 
+class HostTierStore:
+    """Tier 2: a bounded host-RAM store of demoted radix edges. Entries
+    are keyed ``(path, first_block)`` — ``path`` is the tuple of block
+    keys from the root to the edge's parent — so a tier-1 match can
+    chain straight into its demoted continuation. Values are the edge's
+    block keys plus per-channel ``np`` blobs stacked ``(n, ...)`` in the
+    ``kv_block_export`` layout. LRU over whole entries: ``take`` pops
+    (the blobs are on their way back to the device — a failed promotion
+    just loses them), ``put`` evicts oldest-in until the new edge fits.
+    Plain host Python, single-threaded by its caller (the serving
+    loop)."""
+
+    def __init__(self, n_blocks: int, block_bytes: int):
+        self.capacity_blocks = int(n_blocks)
+        self.block_bytes = int(block_bytes)
+        self._edges: OrderedDict[tuple, tuple[list, dict]] = OrderedDict()
+        self._used = 0
+
+    def put(self, path: tuple, keys: list, blobs: dict) -> int:
+        """File a demoted edge; returns how many blocks were kept (the
+        tail is trimmed if the edge alone exceeds the budget)."""
+        if self.capacity_blocks <= 0 or not keys:
+            return 0
+        if len(keys) > self.capacity_blocks:
+            keys = list(keys)[: self.capacity_blocks]
+            blobs = {c: v[: self.capacity_blocks] for c, v in blobs.items()}
+        key = (tuple(path), keys[0])
+        old = self._edges.pop(key, None)
+        if old is not None:
+            self._used -= len(old[0])
+        while self._used + len(keys) > self.capacity_blocks and self._edges:
+            _, (old_keys, _) = self._edges.popitem(last=False)
+            self._used -= len(old_keys)
+        self._edges[key] = (list(keys), blobs)
+        self._used += len(keys)
+        return len(keys)
+
+    def take(self, path: tuple, want: list) -> tuple[list, dict | None]:
+        """Pop the longest stored continuation of ``want`` under
+        ``path``, chaining across entries (an edge matched only partway
+        re-files its unmatched tail under the deeper path, mirroring the
+        tree's mid-edge split). Returns ``(keys, blobs)`` with the blobs
+        concatenated along the block axis, or ``([], None)``."""
+        path = tuple(path)
+        keys_out: list = []
+        parts: dict | None = None
+        j = 0
+        while j < len(want):
+            ent = self._edges.pop((path, want[j]), None)
+            if ent is None:
+                break
+            ekeys, eblobs = ent
+            self._used -= len(ekeys)
+            i = 1  # the dict key IS the first block, so >= 1 matches
+            while (i < len(ekeys) and j + i < len(want)
+                   and ekeys[i] == want[j + i]):
+                i += 1
+            if i < len(ekeys):  # re-file the divergent tail
+                self.put(path + tuple(ekeys[:i]), ekeys[i:],
+                         {c: v[i:] for c, v in eblobs.items()})
+            keys_out.extend(ekeys[:i])
+            if parts is None:
+                parts = {c: [] for c in eblobs}
+            for c in eblobs:
+                parts[c].append(eblobs[c][:i])
+            path = path + tuple(ekeys[:i])
+            j += i
+            if i < len(ekeys):
+                break  # diverged mid-edge — nothing deeper can match
+        if not keys_out:
+            return [], None
+        blobs = {c: (v[0] if len(v) == 1 else np.concatenate(v, axis=0))
+                 for c, v in parts.items()}
+        return keys_out, blobs
+
+    def clear(self) -> None:
+        self._edges.clear()
+        self._used = 0
+
+    @property
+    def used_blocks(self) -> int:
+        return self._used
+
+    def stats(self) -> dict:
+        return {
+            "capacity_blocks": self.capacity_blocks,
+            "used_blocks": self._used,
+            "edges": len(self._edges),
+            "cached_bytes": self._used * self.block_bytes,
+        }
+
+
 class PrefixCache:
     """Radix prefix cache over ``n_blocks`` arena slots of ``block``
     tokens each. ``block_bytes`` is the device footprint of ONE block's
     K+V across all layers — only used for the bytes ledger; capacity is
     enforced in blocks (the arena is preallocated, so the byte budget is
-    exact by construction)."""
+    exact by construction). ``tier2_blocks`` > 0 plus an ``export``
+    callback (block ids -> per-channel host ``np`` blobs) turns eviction
+    into demotion — see :class:`HostTierStore`."""
 
     def __init__(self, *, n_blocks: int, block: int, block_bytes: int,
-                 pin=None, unpin=None):
+                 pin=None, unpin=None, tier2_blocks: int = 0, export=None):
         self.block = int(block)
         self.block_bytes = int(block_bytes)
         self.capacity_blocks = int(n_blocks)
@@ -97,6 +204,10 @@ class PrefixCache:
         # (deterministic layouts make the tests' arena assertions exact)
         self._free = [] if self._adopted else list(range(int(n_blocks)))[::-1]
         self._clock = 0
+        self._export = export
+        self.tier2 = (HostTierStore(int(tier2_blocks), int(block_bytes))
+                      if int(tier2_blocks) > 0 and export is not None
+                      else None)
 
     # -- tree internals ------------------------------------------------
 
@@ -108,6 +219,18 @@ class PrefixCache:
                     n_blocks: int) -> list[tuple[int, ...]]:
         B = self.block
         return [tuple(tokens[i * B:(i + 1) * B]) for i in range(n_blocks)]
+
+    def _path_keys(self, node: _Node) -> list[tuple[int, ...]]:
+        """The block keys on ``node``'s root-path, root-first — the
+        tier-2 store's addressing for everything below ``node``."""
+        runs, n = [], node
+        while n is not None:
+            runs.append(n.keys)
+            n = n.parent
+        out: list[tuple[int, ...]] = []
+        for ks in reversed(runs):
+            out.extend(ks)
+        return out
 
     def _split(self, node: _Node, i: int) -> _Node:
         """Split ``node``'s edge before block ``i`` (0 < i < len(keys)):
@@ -151,6 +274,23 @@ class PrefixCache:
             node = child
             self._tick(node)
         return j, ids, node
+
+    def match_t2(self, tokens: Sequence[int], n_blocks: int, node: _Node,
+                 j: int) -> tuple[list, dict] | None:
+        """Tier-2 continuation of a tier-1 ``match`` that stopped at
+        block ``j`` on ``node``: pop the demoted blobs covering blocks
+        ``[j, j + k)`` of the prompt's first ``n_blocks``. Returns
+        ``(keys, blobs)`` for the caller to promote (re-insert + h2d
+        scatter), or None. The entries leave the store either way —
+        promotion owns them now."""
+        if self.tier2 is None or j >= n_blocks:
+            return None
+        want = self._block_keys(tokens, n_blocks)[j:]
+        keys, blobs = self.tier2.take(tuple(self._path_keys(node)), want)
+        if not keys:
+            return None
+        record_prefix("t2_hit_blocks", len(keys))
+        return keys, blobs
 
     def acquire(self, node: _Node) -> None:
         """Pin ``node``'s whole root-path against eviction (a slot is
@@ -248,6 +388,13 @@ class PrefixCache:
         if best is None:
             return False
         del best.parent.children[best.keys[0]]
+        if self.tier2 is not None:
+            # demote before freeing: device bytes are still the edge's
+            # KV until the block ids are reused
+            blobs = self._export(list(best.blocks))
+            kept = self.tier2.put(tuple(self._path_keys(best.parent)),
+                                  list(best.keys), blobs)
+            record_prefix("t2_demoted_blocks", kept)
         if self._adopted:
             self._unpin(best.blocks)
             self._used -= len(best.blocks)
@@ -276,6 +423,8 @@ class PrefixCache:
             record_prefix("evicted_blocks", len(blocks))
             record_prefix("cached_bytes", -len(blocks) * self.block_bytes)
         self._root = _Node(None, [], [])
+        if self.tier2 is not None:
+            self.tier2.clear()
 
     # -- observability ---------------------------------------------------
 
@@ -286,9 +435,12 @@ class PrefixCache:
         return self.capacity_blocks - len(self._free)
 
     def stats(self) -> dict:
-        return {
+        out = {
             "capacity_blocks": self.capacity_blocks,
             "used_blocks": self.used_blocks,
             "cached_bytes": self.used_blocks * self.block_bytes,
             "block": self.block,
         }
+        if self.tier2 is not None:
+            out["tier2"] = self.tier2.stats()
+        return out

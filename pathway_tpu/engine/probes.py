@@ -148,6 +148,22 @@ METRIC_FAMILIES: dict[str, tuple[str, str | None, str]] = {
         "bytes stranded beyond what active requests can reach "
         "(0 = perfectly packed; dense right-padded slots strand the "
         "whole row tail, paged allocation only the final block's)"),
+    "kv_parked_bytes": (
+        "gauge", "server", "KV bytes held by preempted requests' parked "
+        "block rows (held on purpose for re-admission — classified "
+        "apart from kv_fragmentation's stranded bytes)"),
+    "lane_occupancy": (
+        "gauge", "lane", "Slots per serving lane (prefill = mid-prompt, "
+        "decode = emitting) of a continuous decode server"),
+    "tenant_queue_depth": (
+        "gauge", "tenant", "Queued requests per tenant awaiting "
+        "weighted-fair admission"),
+    "preemptions": (
+        "counter", "tenant", "Over-budget requests preempted out of "
+        "their slot (KV parked, request requeued) per tenant"),
+    "kv_migrated_blocks": (
+        "counter", "server", "KV blocks handed from the prefill lane to "
+        "the decode lane at prompt completion (PATHWAY_TPU_DISAGG)"),
     "requests_routed": (
         "counter", "replica", "Requests forwarded by the fleet router, "
         "per destination replica"),
@@ -461,6 +477,24 @@ def serving_snapshot() -> dict:
                 "serving_occupancy", "server", kind="gauge"
             ).items()
         },
+        "lanes": {
+            k: round(v, 4)
+            for k, v in REGISTRY.labelled(
+                "lane_occupancy", "lane", kind="gauge"
+            ).items()
+        },
+        "tenants": {
+            k: round(v, 4)
+            for k, v in REGISTRY.labelled(
+                "tenant_queue_depth", "tenant", kind="gauge"
+            ).items()
+        },
+        "kv_parked_bytes": {
+            k: round(v, 1)
+            for k, v in REGISTRY.labelled(
+                "kv_parked_bytes", "server", kind="gauge"
+            ).items()
+        },
         "latency": latency_summary(),
     }
 
@@ -698,6 +732,24 @@ def kv_fragmentation_value(server: str = "decoder"):
     ).get(server)
 
 
+def record_kv_parked(nbytes: float, server: str = "decoder") -> None:
+    """Set the ``kv_parked_bytes{server=}`` gauge: device KV bytes held
+    by PREEMPTED requests' parked block rows. Parked blocks are held ON
+    PURPOSE — re-admission reuses their computed prompt KV by table
+    edit — so they are classified apart from ``kv_fragmentation``:
+    counting them as stranded would make the fragmentation signal lie
+    under budget preemption."""
+    REGISTRY.gauge_set("kv_parked_bytes", nbytes, server=server)
+
+
+def kv_parked_value(server: str = "decoder"):
+    """Current ``kv_parked_bytes`` gauge for ``server`` (None before the
+    first preemption)."""
+    return REGISTRY.labelled(
+        "kv_parked_bytes", "server", kind="gauge"
+    ).get(server)
+
+
 # --------------------------------------------------------------------- #
 # device-dispatch counters (registry shim)
 
@@ -808,6 +860,8 @@ def prefix_stats() -> dict:
     hit = c.get("hit_tokens", 0)
     miss = c.get("miss_tokens", 0)
     total = hit + miss
+    t2_l = c.get("t2_lookups", 0)
+    t2_h = c.get("t2_hits", 0)
     return {
         "counts": {k: (int(v) if float(v).is_integer() else v)
                    for k, v in c.items()},
@@ -816,6 +870,15 @@ def prefix_stats() -> dict:
         "evicted_blocks": int(c.get("evicted_blocks", 0)),
         "cached_bytes": int(c.get("cached_bytes", 0)),
         "copy_bytes": int(c.get("copy_bytes", 0)),
+        # tier-2 (host-RAM) store: lookups past a tier-1 match, hits
+        # (demoted edges recovered for promotion) and the block-level
+        # demote/promote traffic
+        "hit_rate_t2": round(t2_h / t2_l, 4) if t2_l else 0.0,
+        "t2_lookups": int(t2_l),
+        "t2_hits": int(t2_h),
+        "t2_hit_blocks": int(c.get("t2_hit_blocks", 0)),
+        "t2_demoted_blocks": int(c.get("t2_demoted_blocks", 0)),
+        "t2_promoted_blocks": int(c.get("t2_promoted_blocks", 0)),
     }
 
 

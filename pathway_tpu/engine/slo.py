@@ -307,6 +307,139 @@ class DegradationController:
         return self.evaluate(now)
 
 
+@guarded_by(_vtime="_lock", _inflight="_lock", _last_served="_lock")
+class TenantScheduler:
+    """Weighted-fair multi-tenant admission policy (stride scheduling)
+    with per-tenant in-flight token budgets — the PR-10 degradation
+    ladder's peer, not its replacement: the ladder still clamps/sheds on
+    SLO burn while this decides WHICH tenant's request admits next.
+
+    The scheduler holds no requests. The serving loop keeps its one
+    submit queue and asks :meth:`select` which entry to pop: each tenant
+    carries a virtual time that advances by ``cost / weight`` per
+    selection, and the pop takes the FIFO-oldest entry of the non-empty
+    tenant with the smallest virtual time. Service is therefore
+    proportional to weight, and every tenant with a positive weight is
+    starvation-free — its virtual time eventually undercuts any
+    backlog's (pinned on a fake clock in ``tests/test_disagg.py``).
+
+    ``budget_tokens`` > 0 makes a tenant with that many tokens already
+    in flight INELIGIBLE: :meth:`select` skips it while others wait (a
+    tenant with nothing in flight is always eligible, so the budget
+    cannot deadlock admission). The serving loop escalates to
+    preemption when an eligible tenant waits with no free slot while an
+    over-budget tenant holds one. A newly-seen tenant joins at the
+    minimum contending virtual time — history grants no credit."""
+
+    def __init__(self, *, weights: dict[str, float] | None = None,
+                 budget_tokens: int = 0,
+                 clock: Callable[[], float] | None = None):
+        self.budget_tokens = int(budget_tokens)
+        self.clock = clock if clock is not None else time.monotonic
+        self._lock = make_lock("slo.tenant_sched")
+        self._weights = {str(k): float(v)
+                         for k, v in (weights or {}).items() if float(v) > 0}
+        self._vtime: dict[str, float] = {}
+        self._floor = 0.0  # monotonic global virtual time: the vtime of
+        # the last selected tenant at selection — newcomers and
+        # returning-from-idle tenants enter here, so history grants no
+        # burst credit
+        self._inflight: dict[str, int] = {}
+        self._last_served: dict[str, float] = {}
+
+    @staticmethod
+    def parse_weights(spec: str) -> dict[str, float]:
+        """``"prod:4,batch:1"`` -> ``{"prod": 4.0, "batch": 1.0}``;
+        malformed pairs are skipped rather than raising (flag input)."""
+        out: dict[str, float] = {}
+        for part in (spec or "").split(","):
+            name, _, w = part.strip().partition(":")
+            name = name.strip()
+            if not name or not w:
+                continue
+            try:
+                val = float(w)
+            except ValueError:
+                continue
+            if val > 0:
+                out[name] = val
+        return out
+
+    def weight(self, tenant: str) -> float:
+        return self._weights.get(tenant, 1.0)
+
+    def charge(self, tenant: str, tokens: int) -> None:
+        """A request of ``tenant`` entered a slot holding ``tokens`` of
+        decode budget."""
+        with self._lock:
+            self._inflight[tenant] = self._inflight.get(tenant, 0) \
+                + int(tokens)
+
+    def credit(self, tenant: str, tokens: int) -> None:
+        """The request left its slot (drained, failed, or preempted)."""
+        with self._lock:
+            left = self._inflight.get(tenant, 0) - int(tokens)
+            if left > 0:
+                self._inflight[tenant] = left
+            else:
+                self._inflight.pop(tenant, None)
+
+    def inflight(self, tenant: str) -> int:
+        with self._lock:
+            return self._inflight.get(tenant, 0)
+
+    def over_budget(self, tenant: str) -> bool:
+        """At/over the in-flight budget (and actually holding tokens)."""
+        if self.budget_tokens <= 0:
+            return False
+        with self._lock:
+            held = self._inflight.get(tenant, 0)
+        return held > 0 and held >= self.budget_tokens
+
+    def select(self, entries, charge: bool = True) -> int | None:
+        """Pick the index of the next entry to admit from ``entries``
+        (FIFO-ordered ``(tenant, cost)`` pairs), or None when every
+        waiting tenant is over budget. ``charge=False`` peeks — the
+        preemption check asks "would anyone eligible run?" without
+        advancing virtual time."""
+        first: dict[str, int] = {}
+        cost: dict[str, int] = {}
+        for i, (tenant, c) in enumerate(entries):
+            if tenant not in first:
+                first[tenant] = i
+                cost[tenant] = int(c)
+        if not first:
+            return None
+        with self._lock:
+            best = None
+            for tenant in first:
+                if self.budget_tokens > 0:
+                    held = self._inflight.get(tenant, 0)
+                    if held > 0 and held >= self.budget_tokens:
+                        continue
+                vt = max(self._vtime.get(tenant, self._floor), self._floor)
+                if best is None or (vt, tenant) < best[:2]:
+                    best = (vt, tenant, first[tenant])
+            if best is None:
+                return None
+            vt, tenant, idx = best
+            if charge:
+                self._floor = max(self._floor, vt)
+                self._vtime[tenant] = vt + max(cost[tenant], 1) \
+                    / self.weight(tenant)
+                self._last_served[tenant] = self.clock()
+        return idx
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "budget_tokens": self.budget_tokens,
+                "weights": dict(self._weights),
+                "inflight": dict(self._inflight),
+                "vtime": {k: round(v, 4) for k, v in self._vtime.items()},
+            }
+
+
 # --------------------------------------------------------------------- #
 # flag-configured module singletons
 

@@ -121,7 +121,7 @@ class Span:
         if attrs:
             self.attrs = {**self.attrs, **attrs}
         end = self.events[-1][1]
-        t_admit = t_first = t_drain = None  # one pass, first occurrence
+        t_admit = t_first = t_drain = t_migrate = None  # first occurrence
         for n, t, _ in self.events:
             if n == "admit":
                 if t_admit is None:
@@ -131,6 +131,8 @@ class Span:
                     t_first = t
             elif n == "drain" and t_drain is None:
                 t_drain = t
+            elif n == "migrate" and t_migrate is None:
+                t_migrate = t
         if t_drain is None:
             t_drain = end
         tokens = self.attrs.get("tokens")
@@ -142,6 +144,10 @@ class Span:
             probes.observe_latency(
                 "queue_wait_seconds", t_admit - self.t0, self.kind
             )
+        if t_admit is not None and t_migrate is not None:
+            # disagg lane handoff: prefill residency from admission to the
+            # KV migration edge (decode lane takes over from here)
+            metrics["prefill_ms"] = round((t_migrate - t_admit) * 1e3, 3)
         if t_first is not None:
             metrics["ttft_ms"] = round((t_first - self.t0) * 1e3, 3)
             probes.observe_latency(

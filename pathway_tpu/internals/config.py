@@ -382,6 +382,45 @@ FLAG_REGISTRY: list[Flag] = [
             "seq) corner.",
     ),
     Flag(
+        env="PATHWAY_TPU_DISAGG", kind="bool", default=False,
+        kill_switch=True, pinned_by="tests/test_disagg.py",
+        attr="disagg", group="pipeline",
+        doc="Disaggregated prefill/decode lanes for continuous serving: "
+            "pending prefills form a prefill lane that dispatches at "
+            "most `PATHWAY_TPU_DISAGG_PREFILL_BUDGET` pieces per loop "
+            "tick while any slot is decoding, so a decode chunk never "
+            "sits behind a burst of long-document prefills. A finished "
+            "prefill MIGRATES into the decode lane by block-table "
+            "handoff — zero-copy on one chip; `kv_block_export` / "
+            "`kv_block_import` carry the blocks for the cross-device "
+            "case. Greedy token streams are schedule-invariant, so `0` "
+            "(default) is byte-identical (`tests/test_disagg.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_DISAGG_PREFILL_BUDGET", kind="int", default=1,
+        attr="disagg_prefill_budget", group="pipeline", minimum=1,
+        doc="Prefill-lane width under `PATHWAY_TPU_DISAGG`: how many "
+            "pending prefill pieces may dispatch per loop tick while "
+            "the decode lane is non-empty (round-robin over waiting "
+            "slots). With the decode lane idle the budget is ignored — "
+            "there is nothing to protect, so prefill runs at full "
+            "width.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_PREFIX_T2_MB", kind="float", default=0.0,
+        kill_switch=True, pinned_by="tests/test_prefix_cache.py",
+        attr="prefix_t2_mb", group="pipeline", minimum=0,
+        doc="Host-RAM byte budget for the prefix cache's second tier: "
+            "LRU eviction DEMOTES whole leaf edges to a pinned host "
+            "`np` block store instead of dropping them, and an "
+            "admission-time tier-2 match triggers async PROMOTION back "
+            "into the device arena on the h2d `StageWorker`, so evicted "
+            "prompt heads survive churn. Promoted bytes are exact "
+            "copies of previously computed KV — greedy tokens are "
+            "byte-identical, and `0` (default) keeps the single-tier "
+            "cache bit-exactly (`tests/test_prefix_cache.py`).",
+    ),
+    Flag(
         env="PATHWAY_TPU_TOKENIZE_CACHE", kind="bool", default=True,
         kill_switch=True, pinned_by="tests/test_prefix_cache.py",
         attr="tokenize_cache", group="pipeline",
@@ -661,6 +700,41 @@ FLAG_REGISTRY: list[Flag] = [
             "recovers. Inert without `PATHWAY_TPU_SLO_*` objectives "
             "(no alert can fire); `0` disables the ladder entirely, "
             "byte-identical.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_TENANT_SCHED", kind="bool", default=False,
+        kill_switch=True, pinned_by="tests/test_disagg.py",
+        attr="tenant_sched", group="fault",
+        doc="Multi-tenant admission scheduling: `submit(..., tenant=)` "
+            "tags requests, the admission pop becomes weighted-fair "
+            "(stride scheduling over `PATHWAY_TPU_TENANT_WEIGHTS`), and "
+            "a tenant over its `PATHWAY_TPU_TENANT_BUDGET` in-flight "
+            "token budget is first skipped, then PREEMPTED — the slot "
+            "is rewound through the isolation path, its KV blocks are "
+            "parked, and the request requeues (never sheds). The PR-10 "
+            "degradation ladder keeps running as one policy among "
+            "several. `0` (default) keeps the FIFO pop byte-identically "
+            "(`tests/test_disagg.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_TENANT_BUDGET", kind="int", default=0,
+        attr="tenant_budget", group="fault", minimum=0,
+        doc="Per-tenant in-flight token budget under "
+            "`PATHWAY_TPU_TENANT_SCHED`: a tenant at or over budget is "
+            "skipped by the weighted-fair pop while others wait, and "
+            "preempted when the queue has eligible work but no free "
+            "slot. A tenant with nothing in flight is always eligible, "
+            "so the budget throttles concurrency without deadlocking. "
+            "`0` (default) = unlimited.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_TENANT_WEIGHTS", kind="str", default="",
+        attr="tenant_weights", group="fault",
+        doc="Comma-separated `tenant:weight` pairs (e.g. "
+            "`prod:4,batch:1`) for the weighted-fair admission pop; "
+            "unlisted tenants weigh 1. Service is proportional to "
+            "weight via stride scheduling, and every tenant with a "
+            "positive weight is starvation-free.",
     ),
     # ------------------------------------------------ fleet serving
     Flag(

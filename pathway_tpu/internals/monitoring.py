@@ -62,15 +62,32 @@ class StatsMonitor:
         prefix = serving.get("prefix") or {}
         spec = serving.get("spec") or {}
         latency = serving.get("latency") or {}
+        lanes = serving.get("lanes") or {}
+        tenants = serving.get("tenants") or {}
         ttft = latency.get("ttft_seconds") or {}
         rows: list[tuple[str, str]] = []
         for server, occ in sorted(occupancy.items()):
             rows.append((f"occupancy {server}", f"{occ:.2f}"))
+        for lane, n in sorted(lanes.items()):
+            rows.append((f"lane {lane}", f"{n:.0f}"))
+        for tenant, depth in sorted(tenants.items()):
+            rows.append((f"tenant {tenant} queued", f"{depth:.0f}"))
+        for server, nbytes in sorted(
+            (serving.get("kv_parked_bytes") or {}).items()
+        ):
+            if nbytes:
+                rows.append(
+                    (f"kv parked {server}", f"{nbytes / 1e6:.2f} MB")
+                )
         if (prefix.get("counts") or {}).get("requests"):
             rows.append(("prefix hit rate", f"{prefix['hit_rate']:.2%}"))
             rows.append(
                 ("prefill tokens saved", str(prefix["prefill_tokens_saved"]))
             )
+            if prefix.get("t2_lookups"):
+                rows.append(
+                    ("prefix t2 hit rate", f"{prefix['hit_rate_t2']:.2%}")
+                )
         if spec.get("acceptance_rate"):
             rows.append(("spec acceptance", f"{spec['acceptance_rate']:.2%}"))
             rows.append(

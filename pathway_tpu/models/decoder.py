@@ -1108,6 +1108,61 @@ def kv_insert(pool: dict, slot: jax.Array, start: jax.Array,
     return out
 
 
+def _block_store_channels(pool: dict) -> list[tuple[str, str]]:
+    """(blob key, pool key) pairs for the pool's block store — the dense
+    pool's prefix arena or the paged pool's global block planes. Blob
+    keys are layout-neutral so an exported payload round-trips across
+    pool kinds of the same model shape."""
+    if pool_paged(pool):
+        ch = [("k", "kb"), ("v", "vb")]
+        if pool_quantized(pool):
+            ch += [("k_scale", "kb_scale"), ("v_scale", "vb_scale")]
+        return ch
+    ch = [("k", "arena_k"), ("v", "arena_v")]
+    if pool_quantized(pool):
+        ch += [("k_scale", "arena_k_scale"), ("v_scale", "arena_v_scale")]
+    return ch
+
+
+def kv_block_export(pool: dict, idxs: jax.Array) -> dict:
+    """Gather KV blocks ``idxs`` ((n,) int32) out of the pool's block
+    store into per-channel ``(n, L, nh, block, d)`` arrays. This is the
+    tier-2 prefix cache's host-blob format (demotion device_gets the
+    result) and the cross-device lane-migration payload — pure data
+    movement, so the bytes are bit-identical to what the blocks hold.
+    Works on both layouts: the dense pool exports prefix-arena blocks,
+    the paged pool exports global-pool blocks. jit per n; ``idxs`` is
+    traced."""
+    paged = pool_paged(pool)
+    out = {}
+    for b, a in _block_store_channels(pool):
+        if paged:  # (L, n_blocks, nh, Bk, d) -> (n, L, nh, Bk, d)
+            out[b] = pool[a][:, idxs].transpose(1, 0, 2, 3, 4)
+        else:  # arena already leads with the block axis
+            out[b] = pool[a][idxs]
+    return out
+
+
+def kv_block_import(pool: dict, idxs: jax.Array, blobs: dict) -> dict:
+    """Scatter exported block payloads back into block-store blocks
+    ``idxs`` — the inverse of :func:`kv_block_export`, used by tier-2
+    promotion (h2d) and by the receiving side of a cross-device lane
+    migration. The blob's channel set must match the pool's (an int8
+    pool needs the scale planes). jit per n with the pool donated;
+    ``idxs`` and the blobs are traced."""
+    paged = pool_paged(pool)
+    out = dict(pool)
+    for b, a in _block_store_channels(pool):
+        if b not in blobs:
+            raise ValueError(f"kv_block_import: blob missing channel {b!r}")
+        blob = blobs[b].astype(pool[a].dtype)
+        if paged:
+            out[a] = pool[a].at[:, idxs].set(blob.transpose(1, 0, 2, 3, 4))
+        else:
+            out[a] = pool[a].at[idxs].set(blob)
+    return out
+
+
 def pool_admit_cached(pool: dict, slot: jax.Array, idxs: jax.Array,
                       cfg: DecoderConfig) -> dict:
     """Seed ``slot`` with a cached prompt prefix: arena blocks ``idxs``

@@ -107,6 +107,8 @@ class InProcessReplica:
         srv = getattr(self.chat, "_server", None)
         return {
             "server": dict(srv.stats) if srv is not None else {},
+            "lanes": srv.lane_stats() if srv is not None else {},
+            "tenants": srv.tenant_depths() if srv is not None else {},
             "slo": slo.get_watchdog().state(),
         }
 

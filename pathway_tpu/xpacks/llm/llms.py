@@ -286,6 +286,12 @@ class TPUDecoderChat(BaseChat):
         paged_kv_block: int | None = None,
         paged_kv_blocks: int | None = None,
         paged_kernel: bool | None = None,
+        disagg: bool | None = None,
+        disagg_prefill_budget: int | None = None,
+        tenant_sched: bool | None = None,
+        tenant_budget: int | None = None,
+        tenant_weights: str | None = None,
+        prefix_t2_mb: float | None = None,
     ):
         # continuous=True: requests are served by a persistent slot-pool
         # loop (_ContinuousServer) — new rows admit into the IN-FLIGHT
@@ -372,6 +378,12 @@ class TPUDecoderChat(BaseChat):
                 paged_kv_block=paged_kv_block,
                 paged_kv_blocks=paged_kv_blocks,
                 paged_kernel=paged_kernel,
+                disagg=disagg,
+                disagg_prefill_budget=disagg_prefill_budget,
+                tenant_sched=tenant_sched,
+                tenant_budget=tenant_budget,
+                tenant_weights=tenant_weights,
+                prefix_t2_mb=prefix_t2_mb,
             )
             # the two-phase engine protocol only exists in continuous
             # mode — exposing these as CLASS methods would activate the
@@ -398,6 +410,7 @@ class TPUDecoderChat(BaseChat):
             raise TypeError("submit_batch requires continuous=True")
         max_new = int(kwargs.pop("max_new_tokens", self.max_new_tokens))
         priority = int(kwargs.pop("priority", 1))
+        tenant = str(kwargs.pop("tenant", "default")) or "default"
         if kwargs:
             # sampling params are compiled into the serving loop; per-call
             # overrides would silently apply to OTHER rows' chunks
@@ -425,7 +438,9 @@ class TPUDecoderChat(BaseChat):
         reqs = []
         for m in messages:
             ids = self.tokenizer.encode(self._format_prompt(m))[-prompt_cap:]
-            reqs.append(self._server.submit(ids, max_new, priority=priority))
+            reqs.append(self._server.submit(
+                ids, max_new, priority=priority, tenant=tenant,
+            ))
         return reqs
 
     def _resolve_batch_continuous(self, handles) -> list:
@@ -561,7 +576,7 @@ class _PendingCompletion:
 
     __slots__ = ("ids", "max_new", "tokens", "done", "text", "finished_at",
                  "first_token_at", "span", "retries", "error_reason",
-                 "retry_after", "deadline", "priority")
+                 "retry_after", "deadline", "priority", "tenant", "seq")
 
     def __init__(self, ids: list, max_new: int):
         import threading
@@ -586,6 +601,11 @@ class _PendingCompletion:
         self.retry_after: float | None = None
         self.deadline: float | None = None
         self.priority = 1
+        # multi-tenant admission class (PATHWAY_TPU_TENANT_SCHED): the
+        # weighted-fair pop groups and budgets requests by this tag;
+        # seq is the server's admission order (newest-first preemption)
+        self.tenant = "default"
+        self.seq = 0
 
 
 @guarded_by(queue="lock", free="lock")
@@ -680,7 +700,13 @@ class _ContinuousServer:
                  paged_kv: bool | None = None,
                  paged_kv_block: int | None = None,
                  paged_kv_blocks: int | None = None,
-                 paged_kernel: bool | None = None):
+                 paged_kernel: bool | None = None,
+                 disagg: bool | None = None,
+                 disagg_prefill_budget: int | None = None,
+                 tenant_sched: bool | None = None,
+                 tenant_budget: int | None = None,
+                 tenant_weights: str | None = None,
+                 prefix_t2_mb: float | None = None):
         import threading
         from collections import deque
 
@@ -821,6 +847,58 @@ class _ContinuousServer:
         self.batch_admit = pathway_config.batch_admit
         self.prefill_overlap = pathway_config.prefill_overlap
         self.chunk_autotune = pathway_config.chunk_autotune
+        # disaggregated prefill/decode lanes (PATHWAY_TPU_DISAGG):
+        # pending prefills form a prefill LANE that dispatches at most
+        # disagg_prefill_budget pieces per tick (round-robin) while any
+        # slot decodes, so a decode chunk never queues behind a burst of
+        # long-prompt prefill pieces. A finished prefill MIGRATES into
+        # the decode lane by block handoff — zero-copy on one chip (the
+        # blocks stay put; only lane membership flips), kv_block_export/
+        # kv_block_import for the cross-device case. Greedy tokens are
+        # schedule-invariant, so the flag is a byte-identical kill
+        # switch (tests/test_disagg.py).
+        self.disagg = bool(
+            pathway_config.disagg if disagg is None else disagg
+        )
+        self._prefill_budget = max(1, int(
+            pathway_config.disagg_prefill_budget
+            if disagg_prefill_budget is None else disagg_prefill_budget
+        ))
+        self._prefill_rr = 0  # round-robin cursor over the prefill lane
+        self._lane_counts = {"prefill": 0, "decode": 0}
+        # multi-tenant weighted-fair admission (PATHWAY_TPU_TENANT_SCHED):
+        # the queue stays ONE deque (watermark, deadline sweep and crash
+        # recovery unchanged) — the scheduler is a pure pop POLICY over
+        # it, plus per-tenant in-flight token budgets whose enforcement
+        # escalates from skip to preemption (_maybe_preempt).
+        self._tenants = None
+        want_tenants = bool(
+            pathway_config.tenant_sched
+            if tenant_sched is None else tenant_sched
+        )
+        if want_tenants:
+            from pathway_tpu.engine import slo as slo_mod
+
+            self._tenants = slo_mod.TenantScheduler(
+                weights=slo_mod.TenantScheduler.parse_weights(
+                    pathway_config.tenant_weights
+                    if tenant_weights is None else str(tenant_weights)
+                ),
+                budget_tokens=int(
+                    pathway_config.tenant_budget
+                    if tenant_budget is None else tenant_budget
+                ),
+            )
+        # preempted requests' parked KV: req -> (block row, admit cover).
+        # Paged mode keeps the allocator refs alive so re-admission
+        # reuses the computed prompt KV by table edit; classified apart
+        # from fragmentation via the kv_parked_bytes gauge.
+        self._parked: dict = {}
+        self._parked_blocks = 0
+        self._admit_seq = 0  # admission order, newest-first preemption
+        # id(req) -> (tenant, charged tokens): the credit must match
+        # the charge even after EOS/degradation mutate req.max_new
+        self._charged: dict[int, tuple[str, int]] = {}
         # prefix KV cache (PATHWAY_TPU_PREFIX_CACHE): admission matches a
         # prompt's longest block-aligned cached prefix in a host radix
         # tree and SEEDS the slot's KV from a device arena instead of
@@ -871,10 +949,50 @@ class _ContinuousServer:
                 self._prefix_kwargs = dict(
                     n_blocks=n_blocks, block=blk, block_bytes=block_bytes
                 )
+                # two-tier cache (PATHWAY_TPU_PREFIX_T2_MB): eviction
+                # demotes leaf edges to a host np block store; the
+                # export callback device_gets the blocks' KV bytes.
+                # Budget 0 is the byte-identical single-tier kill switch
+                # (tests/test_prefix_cache.py).
+                t2_mb = (
+                    pathway_config.prefix_t2_mb
+                    if prefix_t2_mb is None else float(prefix_t2_mb)
+                )
+                t2_blocks = int(t2_mb * (1 << 20) // block_bytes)
+                if t2_blocks >= 1:
+                    self._prefix_kwargs["tier2_blocks"] = t2_blocks
+                    self._prefix_kwargs["export"] = self._export_blocks
                 self.prefix = self._make_prefix_cache()
         # request -> radix node whose root-path the request has pinned
         # (released when the request completes)
         self._prefix_nodes: dict = {}
+        # tier-2 promotion pipeline: admission-time tier-2 hits stage
+        # their host blobs to the device OFF-THREAD on the PR-2 h2d
+        # StageWorker; the loop adopts staged blobs into the tree/arena
+        # between ticks (_drain_promotions). _t2_pending counts hits not
+        # yet adopted, so tests/bench can quiesce (t2_drain).
+        self._promote_worker = None
+        self._promote_ready: deque = deque()
+        self._t2_pending = 0
+        self._export_jits: dict = {}
+        self._import_jits: dict = {}
+        if self.prefix is not None and self.prefix.tier2 is not None:
+            from pathway_tpu.engine.async_runtime import StageWorker
+
+            self._promote_worker = StageWorker(
+                fn=self._stage_promotion, maxsize=4, name="prefix-t2-h2d"
+            )
+        # per-block KV device footprint (the kv_parked_bytes gauge's
+        # multiplier; paged mode only — dense preemption has no blocks
+        # to park)
+        per_tok_kv = (
+            cfg.head_dim + 4 if self.kv_quant
+            else cfg.head_dim * _np_mod.dtype(cfg.dtype).itemsize
+        )
+        self._block_kv_bytes = (
+            2 * cfg.layers * cfg.heads * self.paged_block * per_tok_kv
+            if self.paged_kv else 0
+        )
         # autotune candidates: halvings of the constructor's chunk_steps
         # down to 4 — all <= chunk_steps, so the cache-slack sizing above
         # stays valid for every candidate
@@ -983,6 +1101,8 @@ class _ContinuousServer:
             "spec_emitted": 0, "spec_verify_steps": 0,
             "restarts": 0, "request_failures": 0, "request_retries": 0,
             "shed": 0, "leaked_thread": 0, "paged_oom": 0,
+            "preemptions": 0, "kv_migrated_blocks": 0,
+            "t2_hit_requests": 0, "t2_promoted_blocks": 0,
         }
         # in-flight chunk records, oldest first; an attribute (not a loop
         # local) so the failure sweep can fail eagerly-freed requests
@@ -1165,6 +1285,14 @@ class _ContinuousServer:
         self._sent = [0] * self.n_slots
         self._slot_cover.clear()
         self._slot_blocks.clear()
+        # parked rows and staged promotions died with the allocator/
+        # pool the rebuild below replaces — drop WITHOUT releasing
+        self._parked.clear()
+        self._parked_blocks = 0
+        self._record_parked()
+        self._promote_ready.clear()
+        with self.lock:
+            self._t2_pending = 0
         self.pool = self._build_pool()
         # the rebuilt pool's prefix arena/allocator is empty: reset the
         # host radix tree to match (prefix_reset also drops the
@@ -1179,6 +1307,7 @@ class _ContinuousServer:
             if id(req) in seen or req.done.is_set():
                 continue
             seen.add(id(req))
+            self._tenant_credit(req)  # re-charged at re-admission
             req.retries += 1
             if req.retries <= self._retry_budget:
                 # restart re-decodes from the prompt: drop partial output
@@ -1202,6 +1331,8 @@ class _ContinuousServer:
         text=None sentinel plus a structured reason for the REST layer."""
         from pathway_tpu.engine import probes
 
+        self._discard_parked(req)
+        self._tenant_credit(req)
         req.error_reason = reason
         req.text = None
         probes.REGISTRY.counter_add(
@@ -1218,6 +1349,8 @@ class _ContinuousServer:
         Retry-After."""
         from pathway_tpu.engine import probes
 
+        self._discard_parked(req)
+        self._tenant_credit(req)
         req.error_reason = f"shed:{reason}"
         req.retry_after = 1.0
         req.text = None
@@ -1241,6 +1374,7 @@ class _ContinuousServer:
             active[slot] = False
         self._prefix_release(req)
         self._release_slot_kv(slot)
+        self._tenant_credit(req)  # re-charged if the requeue re-admits
         with self.lock:
             self.free.append(int(slot))
         req.retries += 1
@@ -1310,16 +1444,19 @@ class _ContinuousServer:
                     req.done.set()
 
     def submit(self, prompt_ids: list, max_new: int, *,
-               priority: int = 1) -> _PendingCompletion:
+               priority: int = 1,
+               tenant: str = "default") -> _PendingCompletion:
         import time as time_mod
 
         from pathway_tpu.engine import tracing
 
         req = _PendingCompletion(prompt_ids, max_new)
         req.priority = int(priority)
+        req.tenant = str(tenant) or "default"
         req.span = tracing.start_span(
             "decode", server=self._trace_tag,
             prompt_tokens=len(prompt_ids), max_new=max_new,
+            tenant=req.tenant,
         )
         now = time_mod.perf_counter()
         if self._deadline_s > 0:
@@ -1587,6 +1724,336 @@ class _ContinuousServer:
         for k in ("prefix_hit_tokens", "prefix_miss_tokens",
                   "prefix_hit_requests", "prefix_requests"):
             self.stats[k] = 0
+        # drop staged-but-unadopted promotions with the tree they
+        # targeted; items still inside the StageWorker drain later and
+        # re-match against the fresh tree (stale paths skip harmlessly)
+        while self._promote_ready:
+            self._promote_ready.popleft()
+            with self.lock:
+                self._t2_pending -= 1
+
+    # -- tier-2 promotion pipeline ------------------------------------
+
+    def _export_blocks(self, ids: list) -> dict:
+        """Tier-2 demote callback (``PrefixCache(export=...)``): gather
+        the KV bytes of the given arena/pool blocks and device_get them
+        as per-channel host ``np`` blobs in the ``kv_block_export``
+        layout. Runs on the loop thread inside eviction — one gather
+        dispatch per demoted edge, amortized over the edge's lifetime."""
+        import jax
+        import numpy as np
+
+        if self._export_jits.get("fn") is None:
+            D = self._D
+
+            def export(pool, idxs):
+                return D.kv_block_export(pool, idxs)
+
+            self._export_jits["fn"] = jax.jit(export)
+        blobs = self._export_jits["fn"](
+            self.pool, np.asarray(ids, np.int32)
+        )
+        return {c: np.asarray(v) for c, v in blobs.items()}
+
+    def _import_blocks_fn(self):
+        """Jitted promotion scatter: write staged block blobs into the
+        pool/arena at the freshly-allocated ids (pool donated — same
+        state-in/state-out discipline as every other pool edit)."""
+        if self._import_jits.get("fn") is None:
+            import jax
+
+            D = self._D
+
+            def imp(pool, idxs, blobs):
+                return D.kv_block_import(pool, idxs, blobs)
+
+            self._import_jits["fn"] = jax.jit(imp, donate_argnums=(0,))
+        return self._import_jits["fn"]
+
+    def _schedule_promotion(self, tokens, j: int, keys: list,
+                            blobs: dict) -> None:
+        """Queue a tier-2 hit's host blobs for async h2d staging on the
+        PR-2 StageWorker; the loop adopts them between ticks."""
+        with self.lock:
+            self._t2_pending += 1
+        try:
+            self._promote_worker.submit(
+                (list(tokens), int(j), list(keys), blobs)
+            )
+        except Exception:  # noqa: BLE001 - closed worker at shutdown
+            with self.lock:
+                self._t2_pending -= 1
+
+    def _stage_promotion(self, item) -> None:
+        """StageWorker fn (worker thread — must be total): move the
+        blobs host->device off the serving thread so the adoption tick
+        only pays a table/arena scatter, never a PCIe copy."""
+        import time as time_mod
+
+        import jax
+
+        from pathway_tpu.engine.probes import record_stage
+
+        tokens, j, keys, blobs = item
+        try:
+            t0 = time_mod.perf_counter()
+            staged = {c: jax.device_put(v) for c, v in blobs.items()}
+            for v in staged.values():
+                v.block_until_ready()
+            record_stage("h2d", time_mod.perf_counter() - t0, len(keys))
+            self._promote_ready.append((tokens, j, keys, staged))
+        except Exception:  # noqa: BLE001 - drop the hit, keep serving
+            with self.lock:
+                self._t2_pending -= 1
+        self.wake.set()
+
+    def _drain_promotions(self) -> None:
+        """Adopt every staged promotion (loop thread, once per tick,
+        BEFORE admissions — so a request arriving right behind its
+        promotion already sees the tier-1 hit)."""
+        if self._promote_worker is None:
+            return
+        from pathway_tpu.internals.errors import get_global_error_log
+
+        while self._promote_ready:
+            tokens, j, keys, staged = self._promote_ready.popleft()
+            try:
+                self._apply_promotion(tokens, j, keys, staged)
+            except Exception as exc:  # noqa: BLE001 - best-effort cache
+                get_global_error_log().log(
+                    f"tier-2 promotion dropped: "
+                    f"{type(exc).__name__}: {exc}"
+                )
+            finally:
+                with self.lock:
+                    self._t2_pending -= 1
+
+    def _apply_promotion(self, tokens, j: int, keys: list,
+                         staged: dict) -> None:
+        """Re-insert a staged tier-2 edge into the radix tree and
+        scatter its KV bytes into fresh device blocks. The tree may
+        have moved since the admission-time lookup (another request
+        prefilled the same head), so re-match and keep only the still-
+        missing suffix; a path that diverged entirely is dropped — the
+        blobs were popped from tier 2 and promotion owns them."""
+        import numpy as np
+
+        from pathway_tpu.engine.probes import record_prefix
+
+        if self.prefix is None:
+            return
+        B = self.prefix_block
+        nb = j + len(keys)
+        j2, _ids, _node = self.prefix.match(tokens[: nb * B])
+        if j2 != j:
+            d = j2 - j
+            if d < 0 or d >= len(keys):
+                return  # stale: the matched path changed under us
+            keys = keys[d:]
+            staged = {c: v[d:] for c, v in staged.items()}
+            j = j2
+            nb = j + len(keys)
+        if self.paged_kv:
+            try:
+                ids = self._allocator.alloc(len(keys))
+            except self._D.PagedPoolOOM:
+                return  # pool is the scarce tier — decode wins
+            _node2, _first, new_ids = self.prefix.insert(
+                tokens[: nb * B], n_blocks=nb,
+                block_ids=[0] * j + ids,
+            )
+            if new_ids:
+                self.pool = self._import_blocks_fn()(
+                    self.pool, np.asarray(new_ids, np.int32),
+                    {c: v[: len(new_ids)] for c, v in staged.items()},
+                )
+            # the tree pinned new_ids (adopting insert): drop our own
+            # alloc refs so eviction alone governs their lifetime —
+            # and free any tail the tree's budget didn't stretch to
+            self._allocator.release(ids)
+        else:
+            _node2, first_new, new_ids = self.prefix.insert(
+                tokens[: nb * B], n_blocks=nb
+            )
+            if not new_ids:
+                return
+            d = first_new - j
+            if d < 0 or d >= len(keys):
+                return
+            self.pool = self._import_blocks_fn()(
+                self.pool, np.asarray(new_ids, np.int32),
+                {c: v[d:d + len(new_ids)] for c, v in staged.items()},
+            )
+        if new_ids:
+            self.stats["t2_promoted_blocks"] += len(new_ids)
+            record_prefix("t2_promoted_blocks", len(new_ids))
+
+    def t2_drain(self, timeout: float = 10.0) -> bool:
+        """Block until every scheduled tier-2 promotion has been staged
+        AND adopted (tests/bench quiesce point); True on success."""
+        import time as time_mod
+
+        if self._promote_worker is None:
+            return True
+        end = time_mod.monotonic() + timeout
+        while time_mod.monotonic() < end:
+            with self.lock:
+                if self._t2_pending <= 0:
+                    return True
+            self.wake.set()
+            time_mod.sleep(0.005)
+        return False
+
+    def _t2_probe(self, e: list, n: int, m: int, node) -> None:
+        """Admission-time tier-2 lookup past a tier-1 match of ``m``
+        blocks. A hit schedules async promotion — THIS request still
+        prefills (the blobs are host-side); the NEXT request on the
+        same head lands the tier-1 hit."""
+        if self.prefix is None or self.prefix.tier2 is None:
+            return
+        from pathway_tpu.engine.probes import record_prefix
+
+        n_full = (n - 1) // self.prefix_block
+        if m >= n_full:
+            return
+        record_prefix("t2_lookups", 1)
+        hit = self.prefix.match_t2(e, n_full, node, m)
+        if hit is None:
+            return
+        keys, blobs = hit
+        record_prefix("t2_hits", 1)
+        self.stats["t2_hit_requests"] += 1
+        self._schedule_promotion(e, m, keys, blobs)
+
+    # -- multi-tenant budgets & preemption ----------------------------
+
+    def _tenant_charge(self, req) -> None:
+        """Admission charges the request's full decode budget against
+        its tenant; the amount is remembered so the credit matches even
+        after EOS/degradation mutate ``req.max_new``."""
+        if self._tenants is None:
+            return
+        amt = int(req.max_new)
+        self._tenants.charge(req.tenant, amt)
+        self._charged[id(req)] = (req.tenant, amt)
+
+    def _tenant_credit(self, req) -> None:
+        if self._tenants is None:
+            return
+        rec = self._charged.pop(id(req), None)
+        if rec is not None:
+            self._tenants.credit(rec[0], rec[1])
+
+    def _record_parked(self) -> None:
+        """Refresh the ``kv_parked_bytes`` gauge: preempted requests'
+        parked blocks are HELD ON PURPOSE, so they are classified apart
+        from the fragmentation (stranded-bytes) signal."""
+        from pathway_tpu.engine.probes import record_kv_parked
+
+        record_kv_parked(
+            self._parked_blocks * self._block_kv_bytes,
+            server=self._trace_tag,
+        )
+
+    def _discard_parked(self, req) -> None:
+        """Release a preempted request's parked blocks (terminal paths:
+        fail/shed — the KV will never be re-admitted)."""
+        row = self._parked.pop(req, None)
+        if row is None:
+            return
+        self._parked_blocks -= len(row)
+        if self._allocator is not None:
+            self._allocator.release(row)
+        self._record_parked()
+
+    def _preempt_request(self, slot: int, req, active) -> None:
+        """Budget preemption: rewind ONE over-budget request's slot via
+        the PR-10 isolation machinery, PARK its paged KV blocks (the
+        allocator refs stay alive, so re-admission is a table edit plus
+        a one-block tail re-prefill — not a full re-prefill), and
+        requeue it at the head. Preemption is a scheduling decision,
+        not a failure: the request is never shed and never counts
+        against its retry budget."""
+        import numpy as np
+
+        from pathway_tpu.engine import probes
+
+        req.span.event("preempt", slot=int(slot), tenant=req.tenant)
+        self.slots[slot] = None
+        self._pending_prefill.pop(slot, None)
+        active[slot] = False
+        self._sent[slot] = 0
+        self._prefix_release(req)
+        self._slot_cover.pop(slot, None)
+        if self._allocator is not None:
+            row = self._slot_blocks.pop(slot, None)
+            if row:
+                self.pool = self._table_clear_fn()(
+                    self.pool, np.int32(slot)
+                )
+                # refs are KEPT: the blocks park instead of freeing
+                self._parked[req] = row
+                self._parked_blocks += len(row)
+                self._record_parked()
+        self._update_fragmentation()
+        # null the request out of the in-flight snapshots: tokens from
+        # chunks already dispatched must not drain into the rewound
+        # stream (re-admission re-decodes them byte-identically)
+        for rec in self._inflight:
+            snap = rec[2]
+            for i, r in enumerate(snap):
+                if r is req:
+                    snap[i] = None
+        req.tokens = []
+        req.first_token_at = None
+        self._tenant_credit(req)
+        probes.REGISTRY.counter_add("preemptions", tenant=req.tenant)
+        with self.lock:
+            self.stats["preemptions"] += 1
+            self.free.append(int(slot))
+            self.queue.appendleft(req)
+
+    def _maybe_preempt(self, active) -> None:
+        """Escalated budget enforcement: when a queued ELIGIBLE tenant
+        would admit but every slot is busy and some tenant is over its
+        token budget, preempt that tenant's newest-admitted decode-lane
+        request (newest-first keeps the most-finished work running).
+        Slots still mid-prefill are never victims — their parked rows
+        would hold uncomputed KV."""
+        if self._tenants is None or self._tenants.budget_tokens <= 0:
+            return
+        with self.lock:
+            if not self.queue or self.free:
+                return
+            entries = [(r.tenant, r.max_new) for r in self.queue]
+        if self._tenants.select(entries, charge=False) is None:
+            return  # every waiter is itself over budget — hold
+        victim = None
+        for slot, req in enumerate(self.slots):
+            if (req is None or req.done.is_set()
+                    or slot in self._pending_prefill):
+                continue
+            if not self._tenants.over_budget(req.tenant):
+                continue
+            if victim is None or req.seq > self.slots[victim].seq:
+                victim = slot
+        if victim is not None:
+            self._preempt_request(victim, self.slots[victim], active)
+
+    # -- lane / tenant observability ----------------------------------
+
+    def lane_stats(self) -> dict:
+        """Per-lane occupancy snapshot: slots mid-prompt (prefill lane)
+        vs slots emitting (decode lane)."""
+        return dict(self._lane_counts)
+
+    def tenant_depths(self) -> dict:
+        """Queued requests per tenant (scrape/panel feed)."""
+        with self.lock:
+            depth: dict[str, int] = {}
+            for r in self.queue:
+                depth[r.tenant] = depth.get(r.tenant, 0) + 1
+        return depth
 
     def _admit_one(self, slot: int, req, direct: list,
                    direct_inserts: list) -> None:
@@ -1641,6 +2108,9 @@ class _ContinuousServer:
                 "prefix_match", hit_blocks=int(m_hit),
                 hit_tokens=int(hit_t), miss_tokens=int(n - hit_t),
             )
+            # tier-2 continuation past the tier-1 match (uncapped m:
+            # the probe extends from the true matched depth)
+            self._t2_probe(e, n, m, node)
         if m_hit >= 1:
             # cache hit: pin the matched path, seed the slot's
             # cache columns [0, m_hit*B) straight from the arena
@@ -1722,6 +2192,56 @@ class _ContinuousServer:
                 direct_inserts.append((slot, ins))
         self.stats["admitted"] += 1
 
+    def _unpark(self, slot: int, req, e: list, n: int,
+                row: list) -> bool:
+        """Re-admit a preempted request onto its own parked block row:
+        the prompt's full blocks still hold their computed KV (the
+        refs never dropped), so admission is one table edit plus a
+        re-prefill of the final partial block — that last piece is
+        what regenerates the first-token logits the rewound stream
+        needs. Returns False when the row no longer fits the (possibly
+        degradation-clamped) budget."""
+        import numpy as np
+
+        B = self.paged_block
+        per_slot = self.cache_len // B
+        cover = min(
+            self.cache_len,
+            n + req.max_new + (self.pipeline_depth + 1) * self._slack,
+        )
+        need = min(per_slot, -(-cover // B))
+        if len(row) != need:
+            return False
+        self._slot_blocks[slot] = row
+        self._slot_cover[slot] = cover
+        n_cached = ((n - 1) // B) * B
+        row_arr = np.zeros((per_slot,), np.int32)
+        row_arr[:len(row)] = row
+        self.pool = self._paged_seed_fn()(
+            self.pool, np.int32(slot), row_arr, np.int32(n_cached)
+        )
+        req.span.event("unpark", blocks=len(row), cached=int(n_cached))
+        P = self.prefill_chunk
+        W = n_cached + -((n_cached - n) // P) * P
+        r_ids = np.zeros((1, W), np.int32)
+        r_mask = np.zeros((1, W), np.int32)
+        r_ids[0, :n] = e
+        r_mask[0, :n] = 1
+        pos = np.minimum(np.arange(W), n - 1)[None, :].astype(np.int32)
+        n_prompt = np.asarray([n], np.int32)
+        pieces = [
+            (r_ids[:, o:o + P], r_mask[:, o:o + P], pos[:, o:o + P], o)
+            for o in range(n_cached, W, P)
+        ]
+        lc = (n - 1) - (W - P)
+        meta = {"last_col": None if lc == P - 1 else lc}
+        if self.prefix is not None and n >= B:
+            meta["insert"] = (req, e, 0)
+        self._pending_prefill[slot] = (pieces, n_prompt, meta)
+        self.stats["admitted"] += 1
+        self._update_fragmentation()
+        return True
+
     def _admit_one_paged(self, slot: int, req, e: list, n: int) -> None:
         """Paged admission: allocate exactly the blocks this request can
         reach, install the slot's block-table row, seed any cached
@@ -1743,6 +2263,16 @@ class _ContinuousServer:
             e, n = [0], 1
         B = self.paged_block
         per_slot = self.cache_len // B
+        parked = self._parked.pop(req, None)
+        if parked is not None:
+            self._parked_blocks -= len(parked)
+            self._record_parked()
+            if self._unpark(slot, req, e, n, parked):
+                return
+            # the budget changed under degradation and the row no
+            # longer fits the request — fall through to a fresh
+            # admission (the parked KV is lost, correctness is not)
+            self._allocator.release(parked)
         m_hit, pool_ids, node = 0, [], None
         if self.prefix is not None and n > B:
             m, pool_ids, node = self.prefix.match(e)
@@ -1761,6 +2291,7 @@ class _ContinuousServer:
                 "prefix_match", hit_blocks=int(m_hit),
                 hit_tokens=int(hit_t), miss_tokens=int(n - hit_t),
             )
+            self._t2_probe(e, n, m, node)
         # worst-case columns the lane can write: prompt + its own answer
         # budget + one chunk of overrun slack per in-flight chunk (the
         # same bound that sizes the dense cache_len)
@@ -1859,6 +2390,26 @@ class _ContinuousServer:
         if last:
             del self._pending_prefill[slot]
             active[slot] = True
+            if self.disagg:
+                # lane handoff: the finished prompt's KV migrates from
+                # the prefill lane into the decode lane by block-table
+                # IDENTITY — zero-copy on one chip (the slot's row is
+                # the handoff; kv_block_export/import carry the same
+                # blobs for the cross-device fleet case). Counted only
+                # under the flag so the kill switch stays stats-clean.
+                from pathway_tpu.engine import probes
+
+                nb = (
+                    len(self._slot_blocks.get(slot, ()))
+                    if self.paged_kv
+                    else -(-int(n_prompt[0]) // self.prefill_chunk)
+                )
+                self.stats["kv_migrated_blocks"] += nb
+                probes.REGISTRY.counter_add(
+                    "kv_migrated_blocks", nb, server=self._trace_tag
+                )
+                if req_p is not None:
+                    req_p.span.event("migrate", blocks=int(nb))
             if meta and meta.get("insert") is not None:
                 req_i, e_i, base_i = meta["insert"]
                 self._prefix_insert(slot, req_i, e_i, base_i)
@@ -1944,6 +2495,20 @@ class _ContinuousServer:
                     "serving_occupancy", self.occupancy(),
                     server=self._trace_tag,
                 )
+                probes.REGISTRY.gauge_set(
+                    "lane_occupancy", float(len(self._pending_prefill)),
+                    server=self._trace_tag, lane="prefill",
+                )
+                probes.REGISTRY.gauge_set(
+                    "lane_occupancy", float(active.sum()),
+                    server=self._trace_tag, lane="decode",
+                )
+                if self._tenants is not None:
+                    for t, d in self.tenant_depths().items():
+                        probes.REGISTRY.gauge_set(
+                            "tenant_queue_depth", float(d),
+                            server=self._trace_tag, tenant=t,
+                        )
             # snapshot WHICH request each lane served: by the time
             # these tokens drain the slot may have been freed and
             # re-admitted to a different request
@@ -2030,6 +2595,10 @@ class _ContinuousServer:
                 # one rate-limited watchdog read per tick; levels are
                 # consumed below (clamp / spec gate / shed)
                 self._degradation_level = self._degrade.maybe_evaluate()
+            # adopt staged tier-2 promotions BEFORE admissions: a
+            # request arriving right behind its promotion already
+            # lands the tier-1 hit
+            self._drain_promotions()
             admissions = []
             shed: list = []
             with self.lock:
@@ -2047,12 +2616,37 @@ class _ContinuousServer:
                     if shed:
                         self.queue.clear()
                         self.queue.extend(kept)
+                now_a = time_mod.monotonic()
                 while self.queue and self.free:
-                    req = self.queue.popleft()
+                    if self._tenants is not None:
+                        # weighted-fair pop (PATHWAY_TPU_TENANT_SCHED):
+                        # the queue stays one FIFO deque; the scheduler
+                        # only picks WHICH tenant's oldest entry admits
+                        # next (None = every waiter is over its token
+                        # budget — hold until a slot credits back)
+                        entries = [
+                            (r.tenant, r.max_new) for r in self.queue
+                        ]
+                        i = self._tenants.select(entries)
+                        if i is None:
+                            break
+                        req = self.queue[i]
+                        del self.queue[i]
+                    else:
+                        req = self.queue.popleft()
                     if (self._degradation_level >= 3
                             and req.priority <= 0):
                         shed.append((req, "degraded"))
                         continue
+                    if (req.deadline is not None
+                            and req.deadline <= now_a):
+                        # admission-time enforcement: a deadline can
+                        # lapse between the sweep above and the pop
+                        shed.append((req, "deadline"))
+                        continue
+                    self._admit_seq += 1
+                    req.seq = self._admit_seq
+                    self._tenant_charge(req)
                     admissions.append((self.free.pop(), req))
             for req, reason in shed:
                 self._shed_request(req, reason)
@@ -2084,7 +2678,26 @@ class _ContinuousServer:
                 # after the admit dispatch: the slot's KV now holds the
                 # prompt's blocks — publish the new ones into the arena
                 self._prefix_insert(slot, req_i, e_i, base_i)
-            for slot in list(self._pending_prefill):
+            pend = list(self._pending_prefill)
+            if (self.disagg and active.any()
+                    and len(pend) > self._prefill_budget):
+                # disaggregated lanes (PATHWAY_TPU_DISAGG): the decode
+                # lane owns the dispatch stream — at most
+                # prefill_budget prompts advance one piece per tick
+                # (round-robin, so every pending prompt progresses),
+                # instead of EVERY pending prompt queueing a piece
+                # ahead of the next decode chunk. With no active
+                # decode lane there is nothing to protect and all
+                # prompts advance, same as interleaved. Greedy tokens
+                # are schedule-invariant, so the flag never changes a
+                # stream — only its timing.
+                start = self._prefill_rr % len(pend)
+                pend = [
+                    pend[(start + k) % len(pend)]
+                    for k in range(self._prefill_budget)
+                ]
+                self._prefill_rr += self._prefill_budget
+            for slot in pend:
                 try:
                     self._prefill_piece(slot, active)
                 except Exception as exc:  # noqa: BLE001 - isolation gate
@@ -2094,6 +2707,9 @@ class _ContinuousServer:
                     self._isolate_admission_failure(
                         slot, req_p, exc, active
                     )
+            self._maybe_preempt(active)
+            self._lane_counts["prefill"] = len(self._pending_prefill)
+            self._lane_counts["decode"] = int(active.sum())
             if not dispatched:
                 # legacy ordering (kill switch off) — or the pool was
                 # empty at the top of the tick and admissions just
@@ -2157,6 +2773,23 @@ class _ContinuousServer:
                 req = snap_slots[slot]
                 if req is None or req.done.is_set():
                     continue  # freed by an earlier chunk's tail
+                if (self._deadline_s > 0.0 and req.deadline is not None
+                        and req.deadline <= time_mod.monotonic()):
+                    # in-flight enforcement: an admitted-then-stalled
+                    # request can't burn its slot past its deadline —
+                    # free it NOW instead of decoding an answer the
+                    # caller already abandoned
+                    if self.slots[slot] is req:
+                        self.slots[slot] = None
+                        active[slot] = False
+                        self._release_slot_kv(slot)
+                        with self.lock:
+                            self.free.append(int(slot))
+                    self._prefix_release(req)
+                    self._discard_parked(req)
+                    self._tenant_credit(req)
+                    self._shed_request(req, "deadline_inflight")
+                    continue
                 if spec_rec:
                     stream = [
                         int(t) for c in range(toks.shape[0])
@@ -2194,6 +2827,7 @@ class _ContinuousServer:
                         with self.lock:
                             self.free.append(int(slot))
                     self._prefix_release(req)
+                    self._tenant_credit(req)
                     # flush + finish BEFORE done.set(): a waiter that
                     # wakes on done must find the spec counters and the
                     # span already recorded
@@ -2234,6 +2868,11 @@ class _ContinuousServer:
                     f"serving loop thread {t.name!r} still alive "
                     f"{timeout}s after shutdown join"
                 )
+        # getattr: shutdown must also work on a partially-constructed
+        # server (init failure cleanup, bare-object harness tests)
+        promote = getattr(self, "_promote_worker", None)
+        if promote is not None:
+            promote.close()
         # the loop thread is down: every span it will ever write has been
         # written, so drain the flight recorder's buffered JSONL lines
         from pathway_tpu.engine import tracing

@@ -99,6 +99,14 @@ def test_bench_smoke_schema():
         # 2-replica affinity-routed arm + the chaos failover verdict
         "fleet_tok_s", "fleet_p95_ms", "fleet_prefix_hit_rate",
         "fleet_hit_ratio", "fleet_chaos_p95_ms", "fleet_failover_ok",
+        # disaggregated lanes + two-tier cache + admission scheduler
+        # (PR 13): the bursty decode-tail pair, lane-edge migration
+        # accounting, the churny tier-2 trace, and the preemption phase
+        "disagg_decode_p95_ms", "interleaved_decode_p95_ms",
+        "disagg_tokens_match", "kv_migrated_blocks",
+        "prefix_hit_rate_t2", "t2_recovered_prefill_tokens",
+        "t2_tokens_match", "preemptions_total", "preempt_sheds",
+        "preempt_tokens_match",
     ):
         assert srv.get(key) is not None, key
     # span-derived latencies are real measurements off the decode phase
@@ -144,6 +152,25 @@ def test_bench_smoke_schema():
     assert srv["kv_fragmentation"] < srv["kv_fragmentation_dense"]
     assert srv["paged_tok_s"] > 0 and srv["dense_tok_s"] > 0
     assert srv["paged_max_slots"] > srv["dense_max_slots"] > 0
+    # disaggregated lanes (PR 13): on the bursty mixed trace the decode
+    # tail must not regress vs interleaved admission, lane scheduling
+    # must not change a greedy token, and the prefill->decode lane edge
+    # actually handed blocks over
+    assert srv["disagg_decode_p95_ms"] <= srv["interleaved_decode_p95_ms"]
+    assert srv["disagg_tokens_match"] is True
+    assert srv["kv_migrated_blocks"] > 0
+    # two-tier prefix cache: the churny trace actually hit the host tier
+    # and promoted blocks back to the device; the t2-off (budget 0) arm
+    # is byte-identical
+    assert srv["prefix_hit_rate_t2"] > 0
+    assert srv["t2_recovered_prefill_tokens"] > 0
+    assert srv["t2_tokens_match"] is True
+    # admission scheduler: the over-budget construction preempted (slot
+    # rewound, KV parked, request requeued) — never shed — and the
+    # re-decoded stream is byte-identical to an unscheduled server
+    assert srv["preemptions_total"] >= 1
+    assert srv["preempt_sheds"] == 0
+    assert srv["preempt_tokens_match"] is True
     # pipeline-depth observability (PR 9): per-operator latency telemetry
     # sampled during the streaming phases, the HBM ledger saw the decoder
     # pools, and the SLO watchdog state rode the summary out

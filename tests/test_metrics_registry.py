@@ -177,7 +177,7 @@ def test_serving_and_unified_snapshot_shapes():
     serving = probes.serving_snapshot()
     assert set(serving) == {
         "prefix", "spec", "cascade", "dispatch", "stage_seconds",
-        "occupancy", "latency",
+        "occupancy", "latency", "lanes", "tenants", "kv_parked_bytes",
     }
     assert serving["prefix"]["hit_rate"] == 0.5
     assert serving["latency"]["ttft_seconds"]["count"] == 1

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from pathway_tpu.engine import probes
-from pathway_tpu.engine.prefix_cache import PrefixCache
+from pathway_tpu.engine.prefix_cache import HostTierStore, PrefixCache
 from pathway_tpu.models import decoder as D
 from tests.utils import ToyCharTokenizer
 
@@ -271,6 +271,193 @@ def test_serving_lru_respects_byte_budget(tiny_params):
     assert 0 < used <= cap
     assert prefix.stats()["cached_bytes"] == used * prefix.block_bytes
     assert stats["prefix_requests"] == len(prompts)
+
+
+# -- tier 2: HBM -> host demotion store (PATHWAY_TPU_PREFIX_T2_MB) -----------
+
+
+def _K(v):
+    """One block key: the token tuple of a block of repeated ``v``s."""
+    return tuple([v] * B)
+
+
+def _blob(vals):
+    """Per-channel host blobs in the block-major export layout."""
+    return {"k": np.asarray([[v, v + 0.5] for v in vals], np.float32)}
+
+
+def test_host_tier_put_take_pop_once():
+    st = HostTierStore(8, block_bytes=100)
+    assert st.put((), [_K(1), _K(2)], _blob([1, 2])) == 2
+    assert st.used_blocks == 2
+    keys, blobs = st.take((), [_K(1), _K(2)])
+    assert keys == [_K(1), _K(2)]
+    np.testing.assert_array_equal(blobs["k"], _blob([1, 2])["k"])
+    # pop-once: the promotion owns the entry now
+    assert st.take((), [_K(1), _K(2)]) == ([], None)
+    assert st.used_blocks == 0
+
+
+def test_host_tier_chains_across_entries():
+    """A tier-1 match point deeper than one demoted edge still recovers
+    the whole continuation: take() chains path -> deeper path."""
+    st = HostTierStore(8, block_bytes=100)
+    st.put((), [_K(1)], _blob([1]))
+    st.put((_K(1),), [_K(2), _K(3)], _blob([2, 3]))
+    keys, blobs = st.take((), [_K(1), _K(2), _K(3)])
+    assert keys == [_K(1), _K(2), _K(3)]
+    np.testing.assert_array_equal(blobs["k"], _blob([1, 2, 3])["k"])
+
+
+def test_host_tier_refiles_divergent_tail():
+    """An edge matched only partway hands back the matched half and
+    re-files the tail under the deeper path — mirroring the radix
+    tree's mid-edge split, so no demoted bytes are lost."""
+    st = HostTierStore(8, block_bytes=100)
+    st.put((), [_K(1), _K(2), _K(3)], _blob([1, 2, 3]))
+    keys, blobs = st.take((), [_K(1), _K(2), _K(9)])
+    assert keys == [_K(1), _K(2)]
+    np.testing.assert_array_equal(blobs["k"], _blob([1, 2])["k"])
+    keys, blobs = st.take((_K(1), _K(2)), [_K(3)])
+    assert keys == [_K(3)]
+    np.testing.assert_array_equal(blobs["k"], _blob([3])["k"])
+    assert st.used_blocks == 0
+
+
+def test_host_tier_lru_eviction_and_trim():
+    st = HostTierStore(3, block_bytes=100)
+    st.put((), [_K(1), _K(2)], _blob([1, 2]))
+    st.put((), [_K(3), _K(4)], _blob([3, 4]))  # evicts oldest-in (1,2)
+    assert st.used_blocks == 2
+    assert st.take((), [_K(1)]) == ([], None)
+    assert st.take((), [_K(3)])[0] == [_K(3)]
+    # an edge wider than the whole budget is trimmed, never rejected
+    st2 = HostTierStore(2, block_bytes=100)
+    assert st2.put((), [_K(i) for i in range(4)], _blob(range(4))) == 2
+    assert st2.stats() == {
+        "capacity_blocks": 2, "used_blocks": 2, "edges": 1,
+        "cached_bytes": 200,
+    }
+
+
+def test_tier2_demote_promote_roundtrip_unit():
+    """PrefixCache with a tier-2 budget: eviction demotes the dropped
+    edge's bytes through the export callback, match_t2 recovers them
+    byte-identically from the tier-1 match point, and the entry pops
+    exactly once."""
+    probes.reset_prefix_stats()
+    arena = {}
+    c = PrefixCache(
+        n_blocks=2, block=B, block_bytes=100, tier2_blocks=4,
+        export=lambda ids: {"k": np.stack([arena[i] for i in ids])},
+    )
+    assert c.tier2 is not None
+    _, _, new_ids = c.insert(_toks(1, 2))
+    for i, a in enumerate(new_ids):
+        arena[a] = np.full((3,), 10.0 + i, np.float32)
+    want = np.stack([arena[a] for a in new_ids])
+    c.insert(_toks(3, 4))  # arena full: evicts AND demotes (1, 2)
+    n, _, node = c.match(_toks(1, 2))
+    assert n == 0
+    assert probes.prefix_stats()["t2_demoted_blocks"] == 2
+    assert c.stats()["tier2"]["used_blocks"] == 2
+    hit = c.match_t2(_toks(1, 2), 2, node, n)
+    assert hit is not None
+    keys, blobs = hit
+    assert keys == [_K(1), _K(2)]
+    np.testing.assert_array_equal(blobs["k"], want)
+    assert c.match_t2(_toks(1, 2), 2, node, n) is None
+    assert probes.prefix_stats()["t2_hit_blocks"] == 2
+
+
+def test_tier2_budget_zero_is_single_tier():
+    """tier2_blocks=0 (or no export callback) never constructs the host
+    store — eviction frees instead of demoting, bytes drop."""
+    c = PrefixCache(n_blocks=2, block=B, block_bytes=100, tier2_blocks=0,
+                    export=lambda ids: {})
+    assert c.tier2 is None
+    c2 = PrefixCache(n_blocks=2, block=B, block_bytes=100, tier2_blocks=4)
+    assert c2.tier2 is None
+
+
+# -- serving: churn -> demote -> tier-2 hit -> promote -> tier-1 hit ---------
+
+
+def _serve_t2(tiny_params, prefix_t2_mb):
+    """Churny single-stream trace against a 3-block tier-1 arena: six
+    distinct 3-block heads evict each other (demoting under a tier-2
+    budget), then the first head comes back — a tier-2 hit that
+    promotes — and a final same-head request lands the tier-1 hit."""
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    probes.reset_prefix_stats()
+    chat = TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(64),
+        max_new_tokens=NEW, temperature=0.0, max_prompt_tokens=32,
+        continuous=True, n_slots=4, chunk_steps=4, pipeline_depth=2,
+        prefill_chunk=8, prefix_cache=True, prefix_cache_mb=0.013,
+        prefix_t2_mb=prefix_t2_mb,
+    )
+    texts = []
+    try:
+        srv = chat._server
+
+        def run(p):
+            r = chat.submit_batch([p], max_new_tokens=NEW)[0]
+            assert r.done.wait(timeout=120)
+            texts.append(r.text)
+
+        for c in "abcdef":
+            run(c * 24 + "?")
+        run("a" * 24 + "?")
+        assert srv.t2_drain(timeout=30.0)
+        run("a" * 24 + "!")
+        resident = srv.prefix.match(chat.tokenizer.encode("a" * 24))[0]
+        return texts, dict(srv.stats), srv.prefix, resident
+    finally:
+        chat.close()
+
+
+@pytest.fixture(scope="module")
+def t2_off_truth(tiny_params):
+    """Single-tier reference arm (budget 0): the byte-equality truth for
+    the tier-2 serving trace."""
+    texts, stats, prefix, _ = _serve_t2(tiny_params, 0.0)
+    assert prefix.tier2 is None
+    assert stats["t2_hit_requests"] == 0
+    return texts
+
+
+def test_tier2_serving_demote_promote_roundtrip(tiny_params, t2_off_truth):
+    texts, stats, prefix, resident = _serve_t2(tiny_params, 0.1)
+    assert prefix.tier2 is not None
+    # the returning head missed tier 1 but hit the host tier...
+    assert stats["t2_hit_requests"] >= 1
+    s = probes.prefix_stats()
+    assert s["t2_lookups"] >= 1 and s["t2_hits"] >= 1
+    assert s["hit_rate_t2"] > 0.0
+    # ...after churn demoted whole evicted edges into it...
+    assert s["t2_demoted_blocks"] >= 3 * 3
+    # ...and the promotion landed the head back in the device arena (the
+    # final request admits against it)
+    assert stats["t2_promoted_blocks"] >= 1
+    assert resident == 3
+    assert stats["prefix_hit_requests"] >= 1
+    # async promotion never forks the numerics: tokens byte-identical to
+    # the single-tier arm
+    assert texts == t2_off_truth
+
+
+def test_tier2_kill_switch_budget_zero(tiny_params, t2_off_truth,
+                                       monkeypatch):
+    """PATHWAY_TPU_PREFIX_T2_MB=0 (the default): no host store, no
+    probe/promotion machinery, byte-identical serving."""
+    monkeypatch.setenv("PATHWAY_TPU_PREFIX_T2_MB", "0")
+    texts, stats, prefix, _ = _serve_t2(tiny_params, None)
+    assert prefix.tier2 is None
+    assert stats["t2_hit_requests"] == 0
+    assert probes.prefix_stats()["t2_lookups"] == 0
+    assert texts == t2_off_truth
 
 
 # -- tokenizer / BPE encode memos (PATHWAY_TPU_TOKENIZE_CACHE) ---------------
