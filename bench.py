@@ -1438,6 +1438,125 @@ def config5_sharded() -> dict:
     }
 
 
+def config6_mesh_serving() -> dict:
+    """Mesh-sharded serving (PATHWAY_TPU_MESH tentpole): the SAME greedy
+    continuous-batching trace through ``TPUDecoderChat`` single-chip and
+    on a ``(data=1, fsdp=2, tp=4)`` serving mesh — params GSPMD-sharded,
+    the paged KV pool split tp-ways, paged attention head-sharded via
+    shard_map. Reports the mesh arm's throughput, the token-identity
+    verdict (a greedy mesh trace must be byte-identical to single-chip),
+    and the per-device HBM high-water off the ledger — the per-device
+    split is the number the mesh exists to shrink. On the driver this
+    phase runs in a fresh subprocess pinned to the virtual 8-device CPU
+    topology (JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count=8)
+    in BOTH smoke and full mode: the relayed chip exposes one device, and
+    the claim is the sharded serving PATH, not chip speed."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.engine import probes as probes_mod
+    from pathway_tpu.models import decoder as D
+    from pathway_tpu.parallel.mesh import make_serving_mesh
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    t_phase = time.perf_counter()
+    n_dev = jax.device_count()
+    if n_dev < 8:
+        raise RuntimeError(
+            f"config6_mesh needs the 8-device topology, got {n_dev} "
+            "device(s) — run via the pinned subprocess env"
+        )
+
+    # float32 end to end: the kill-switch claim is TOKEN IDENTITY, and
+    # tp-sharded matmuls reassociate partial sums, so the comparison
+    # runs where greedy argmax is stable (the grid tier-1 pins)
+    if _smoke():
+        cfg = D.DecoderConfig(
+            vocab_size=128, hidden=32, layers=4, heads=4,
+            intermediate=64, max_position=128, dtype=jnp.float32,
+        )
+        NREQ, NEW, N_SLOTS, CHUNK = 6, 8, 4, 4
+    else:
+        cfg = D.DecoderConfig(
+            vocab_size=256, hidden=64, layers=4, heads=8,
+            intermediate=128, max_position=256, dtype=jnp.float32,
+        )
+        NREQ, NEW, N_SLOTS, CHUNK = 16, 24, 8, 8
+    params = D.init_params(jax.random.PRNGKey(0), cfg)
+    mesh = make_serving_mesh(jax.devices()[:8], data=1, fsdp=2, tp=4)
+
+    class _Tok:
+        eos_id = None  # budget-bounded: every request emits NEW tokens
+
+        def encode(self, text):
+            return [(ord(c) % 96) + 1 for c in text]
+
+        def decode(self, ids):
+            return "".join(chr((int(i) % 96) + 32) for i in ids)
+
+    rng = np.random.default_rng(5)
+    prompts = [
+        "mesh " + "x" * int(rng.integers(8, 24)) for _ in range(NREQ)
+    ]
+
+    def _arm(mesh_arg):
+        chat = TPUDecoderChat(
+            params=params, cfg=cfg, tokenizer=_Tok(),
+            max_new_tokens=NEW, temperature=0.0, max_prompt_tokens=32,
+            continuous=True, n_slots=N_SLOTS, chunk_steps=CHUNK,
+            pipeline_depth=2, paged_kv=True, paged_kernel=True,
+            mesh=mesh_arg,
+        )
+        try:
+            # warm the (single) prompt bucket + the chunk executable so
+            # no jit compile lands inside the timed window
+            chat.resolve_batch([chat.submit_batch([prompts[0]])])
+            t0 = time.perf_counter()
+            reqs = chat.submit_batch(prompts)
+            for r in reqs:
+                if not r.done.wait(timeout=600):
+                    raise RuntimeError("serving request timed out")
+            return [r.text for r in reqs], time.perf_counter() - t0
+        finally:
+            chat.close()
+
+    # mesh arm FIRST, ledger snapshot right after: the per-device
+    # high-water then reflects the sharded pools, not the dense arm's
+    # device-0 footprint
+    mesh_texts, mesh_s = _arm(mesh)
+    hbm = probes_mod.hbm_stats()
+    per_dev_hw = {
+        str(k): int(v)
+        for k, v in (hbm.get("per_device_high_water_bytes") or {}).items()
+    }
+    base_texts, base_s = _arm(None)
+
+    useful = NREQ * NEW
+    mesh_tps = useful / max(mesh_s, 1e-9)
+    base_tps = useful / max(base_s, 1e-9)
+    detail = {
+        "mesh": {"axes": ["data", "fsdp", "tp"], "shape": [1, 2, 4]},
+        "devices": n_dev,
+        "backend": jax.default_backend(),
+        "requests": NREQ,
+        "new_tokens": NEW,
+        "mesh_tok_s": round(mesh_tps, 1),
+        "single_chip_tok_s": round(base_tps, 1),
+        "mesh_vs_single_x": round(mesh_tps / max(base_tps, 1e-9), 3),
+        "mesh_tokens_match": mesh_texts == base_texts,
+        "hbm_device_high_water_bytes": per_dev_hw,
+        "hbm_devices_seen": len(per_dev_hw),
+        "elapsed_s": round(time.perf_counter() - t_phase, 1),
+    }
+    diag(phase="config6_mesh", **detail)
+    return {
+        "metric": "mesh_serving_tok_s",
+        "value": round(mesh_tps, 1),
+        "unit": "tokens/s",
+        "detail": detail,
+    }
+
+
 def config_join_streaming() -> dict:
     """Streaming inner join through the FULL engine (kafka -> join ->
     select -> subscribe): orders x users on user id, 200k orders against
@@ -3030,6 +3149,7 @@ def run_single_phase(name: str) -> None:
         "config4": config4_streaming_engine,
         "config5": lambda: config5_ivf_recall_latency(MINILM_L6),
         "config5_sharded": config5_sharded,
+        "config6_mesh": config6_mesh_serving,
         "join": config_join_streaming,
         "wordcount": config_wordcount_streaming,
         "decoder": config_decoder_generate,
@@ -3095,16 +3215,31 @@ def main() -> None:
     import gc
 
     gc.collect()
+    # the sharded phases want 8 devices; the relayed chip has one, so
+    # their subprocesses are pinned to the virtual CPU mesh (the same
+    # topology the tier-1 suite runs on)
+    cpu8_env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+    }
     if _smoke():
         # in-process: the subprocess isolation exists for HBM heap
         # hygiene, which tiny smoke shapes don't need, and process
-        # startup would dominate the run
+        # startup would dominate the run. Exception: the mesh-serving
+        # arm NEEDS a fresh process — the smoke parent runs on one CPU
+        # device (its test pops XLA_FLAGS) and jax device topology is
+        # fixed at first import
         phase_fns = (
             ("config5", lambda: config5_ivf_recall_latency(cfg)),
             ("config5_sharded", config5_sharded),
             ("join", config_join_streaming),
             ("wordcount", config_wordcount_streaming),
             ("decoder", config_decoder_generate),
+            ("config6_mesh", lambda: _run_phase_subprocess(
+                "config6_mesh", timeout_s=600, env=cpu8_env)),
         )
         for phase, fn in phase_fns:
             try:
@@ -3115,20 +3250,11 @@ def main() -> None:
                     error=repr(exc),
                 )
     else:
-        # the sharded-IVF phase wants 8 devices; the relayed chip has
-        # one, so its subprocess is pinned to the virtual CPU mesh (the
-        # same topology the tier-1 suite runs on)
-        cpu8_env = {
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": (
-                os.environ.get("XLA_FLAGS", "")
-                + " --xla_force_host_platform_device_count=8"
-            ).strip(),
-        }
         for phase, budget, env in (
             ("config5", 2400, None), ("join", 1200, None),
             ("wordcount", 900, None), ("decoder", 1800, None),
             ("config5_sharded", 2400, cpu8_env),
+            ("config6_mesh", 1800, cpu8_env),
         ):
             try:
                 extra.append(
@@ -3307,6 +3433,8 @@ def main() -> None:
     )
     c4_detail = config4.get("detail") or {}
     shiv = _m("sharded_ivf_build_rows")
+    mesh_m = _m("mesh_serving_tok_s")
+    mesh_det = mesh_m.get("detail") or {}
     ceiling = headline_detail.get("ceiling") or {}
     wc = _m("wordcount_streaming_rows_per_sec")
     # pipeline-depth observability: per-operator latency from THIS
@@ -3425,6 +3553,16 @@ def main() -> None:
                 )
                 if k in (shiv.get("detail") or {})
             },
+            "mesh_serving": {
+                k: mesh_det.get(k)
+                for k in (
+                    "mesh", "devices", "mesh_tok_s", "single_chip_tok_s",
+                    "mesh_vs_single_x", "mesh_tokens_match",
+                    "hbm_device_high_water_bytes", "hbm_devices_seen",
+                    "elapsed_s", "error",
+                )
+                if k in mesh_det
+            },
             "engine": {
                 "op_latency_p50_ms": engine_telemetry.get(
                     "op_latency_p50_ms"
@@ -3517,6 +3655,23 @@ def main() -> None:
             "shards", "rows_total", "build_s", "recall_at_10", "elapsed_s",
         ):
             _chk(f"summary.sharded_ivf.{k}", sh.get(k))
+        # mesh-serving acceptance: the 8-device arm must have emitted the
+        # exact single-chip token stream, and the per-device HBM ledger
+        # must have seen EVERY mesh device with nonzero bytes
+        ms = s.get("mesh_serving") or {}
+        for k in ("mesh_tok_s", "single_chip_tok_s", "mesh_vs_single_x"):
+            _chk(f"summary.mesh_serving.{k}", ms.get(k))
+        if ms.get("mesh_tokens_match") is not True:
+            missing.append("summary.mesh_serving.mesh_tokens_match")
+        mdevs = ms.get("hbm_device_high_water_bytes") or {}
+        if not (
+            set(mdevs) >= {str(i) for i in range(8)}
+            and all(v > 0 for v in mdevs.values())
+        ):
+            missing.append(
+                "summary.mesh_serving.hbm_device_high_water_bytes"
+                "[all 8 devices > 0]"
+            )
         # observability keys: operator telemetry and the HBM ledger must
         # have actually sampled during the run, not merely exist
         eng = s.get("engine") or {}
@@ -3637,6 +3792,21 @@ def sentinel_check(summary: dict, baseline: dict, smoke: bool) -> list:
         breaches.append(
             "summary.serving.paged_tokens_match: paged arm diverged from "
             "dense on a greedy trace"
+        )
+    # mesh-serving gates, exact at every scale: the sharded arm must not
+    # change a greedy token, and its ledger must cover every mesh device
+    mesh_new = new.get("mesh_serving") or {}
+    mtm = mesh_new.get("mesh_tokens_match")
+    if mtm is not None and not mtm:
+        breaches.append(
+            "summary.mesh_serving.mesh_tokens_match: mesh arm diverged "
+            "from single-chip on a greedy trace"
+        )
+    mdev = mesh_new.get("hbm_devices_seen")
+    if mdev is not None and mdev < 8:
+        breaches.append(
+            f"summary.mesh_serving.hbm_devices_seen: {mdev} < 8 — the "
+            f"per-device HBM ledger lost mesh devices"
         )
     # fleet gates, exact at every scale: the affinity router must hold
     # the single-replica prefix hit rate, and the chaos arm (one

@@ -5,9 +5,11 @@ forms (``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``) and call
 forms (``jax.jit(f)``, ``jax.jit(partial(mod.f, ...))``) — plus
 ``pl.pallas_call(kernel, ...)`` boundaries (a Pallas kernel body is
 traced exactly like a jitted function, so host effects inside it are
-the same bug) — then walks the call graph across modules (import-alias
-resolution, absolute and relative) and flags, inside the reachable
-set:
+the same bug) and ``shard_map`` / ``compat_shard_map`` boundaries (the
+serving mesh's paged-attention seam: the mapped function traces under
+the SPMD per-shard view) — then walks the call graph across modules
+(import-alias resolution, absolute and relative) and flags, inside the
+reachable set:
 
 * **GL101** host-side effects: ``print``, ``time.*``, ``os.environ`` /
   ``os.getenv``, ``pathway_config.*`` reads, and calls into the
@@ -132,6 +134,29 @@ def _is_pallas_call(node: ast.AST, imps: _Imports) -> bool:
         return True
     if not tail and imps.from_names.get(head, ("", ""))[1] == "pallas_call":
         return True
+    return False
+
+
+def _is_shard_map(node: ast.AST, imps: _Imports) -> bool:
+    """``jax.shard_map`` / ``jax.experimental.shard_map.shard_map`` /
+    the repo's ``compat_shard_map`` version shim (any from-import
+    alias) — the mapped function is a trace boundary exactly like
+    ``jax.jit``'s argument, and it additionally runs under the SPMD
+    per-shard view, so the GL1xx purity rules apply to its body (the
+    serving mesh routes paged attention through this seam)."""
+    d = _dotted(node)
+    if d is None:
+        return False
+    head, _, tail = d.partition(".")
+    if tail == "shard_map" and imps.mod_alias.get(head) in (
+        "jax", "jax.experimental.shard_map"
+    ):
+        return True
+    if tail == "compat_shard_map" and imps.module_of(head):
+        return True
+    if not tail:
+        orig = imps.from_names.get(head, ("", ""))[1]
+        return orig in ("shard_map", "compat_shard_map")
     return False
 
 
@@ -307,11 +332,12 @@ def _collect_roots(
                         ref.static |= _static_argnames(call)
                         roots.append(ref)
         # call form: jax.jit(f) / jax.jit(partial(mod.f, ...)) /
-        # pl.pallas_call(kernel, ...)
+        # pl.pallas_call(kernel, ...) / shard_map(f, mesh=..., ...)
         for node in ast.walk(src.tree):
             if not (isinstance(node, ast.Call)
                     and (_is_jax_jit(node.func, imps)
-                         or _is_pallas_call(node.func, imps))):
+                         or _is_pallas_call(node.func, imps)
+                         or _is_shard_map(node.func, imps))):
                 continue
             if not node.args:
                 continue

@@ -267,6 +267,17 @@ def stats(url, as_json):
     section("kv_parked_bytes", {
         k: v for k, v in (serving.get("kv_parked_bytes") or {}).items() if v
     })
+    section("retrieval", serving.get("retrieval") or {})
+    hbm = snap.get("hbm") or {}
+    section("hbm_bytes", hbm.get("current_bytes") or {})
+    # per-device rows (PATHWAY_TPU_MESH): one section per mesh device,
+    # plus the per-device total high-water capacity planning reads
+    for dev, comps in sorted((hbm.get("device_bytes") or {}).items()):
+        section(f"hbm_bytes/device={dev}", comps)
+    section(
+        "hbm_high_water_bytes/device",
+        hbm.get("per_device_high_water_bytes") or {},
+    )
     sched = snap.get("scheduler") or {}
     if sched:
         section("scheduler", {
@@ -277,7 +288,7 @@ def stats(url, as_json):
     if not any((latency, serving.get("prefix"), serving.get("spec"),
                 serving.get("cascade"), serving.get("dispatch"),
                 serving.get("stage_seconds"), serving.get("occupancy"),
-                sched)):
+                hbm.get("current_bytes"), sched)):
         click.echo("no metrics recorded yet")
 
 

@@ -495,6 +495,7 @@ def serving_snapshot() -> dict:
                 "kv_parked_bytes", "server", kind="gauge"
             ).items()
         },
+        "retrieval": retrieval_backend_stats(),
         "latency": latency_summary(),
     }
 
@@ -659,50 +660,98 @@ def reset_engine_stats() -> None:
 # (pool rebuilds overwrite current, high-water is monotone). State lives
 # in a module dict so the total high-water is computed atomically even
 # though the registry only sees per-series writes.
+#
+# Under a serving mesh (PATHWAY_TPU_MESH) the ledger is PER DEVICE:
+# callers pass the device id a shard lives on and each (component,
+# device) cell tracks its own current + high-water, with
+# `hbm_bytes{component=,device=}` series alongside the
+# device-aggregated `hbm_bytes{component=}` the existing dashboards
+# read. Single-chip callers omit the label and land on device "0", so
+# every pre-mesh key and gauge keeps its exact value — capacity
+# planning against the TIGHTEST device reads `per_device_*`.
 
 _hbm_lock = make_lock("probes.hbm")
-_hbm_current: dict[str, int] = {}
-_hbm_high_water: dict[str, int] = {}
+_hbm_current: dict[tuple[str, str], int] = {}  # (component, device)
+_hbm_high_water: dict[str, int] = {}           # component (+ "total")
+_hbm_dev_high_water: dict[str, int] = {}       # device total
 
 _GUARDED_BY = {
     "_hbm_current": "_hbm_lock",
     "_hbm_high_water": "_hbm_lock",
+    "_hbm_dev_high_water": "_hbm_lock",
+    "_retrieval_backends": "_hbm_lock",
 }
 
 
-def record_hbm(component: str, nbytes: int) -> None:
-    """Record ``component``'s current device-memory footprint (bytes).
-    Updates the current gauge, the per-component high-water and the
-    cross-component ``total`` high-water. Called at pool/arena build
-    time — never on the per-token path."""
+def record_hbm(component: str, nbytes: int, device: str = "0") -> None:
+    """Record ``component``'s current device-memory footprint (bytes)
+    on ``device`` (a device id; "0" for single-chip callers). Updates
+    the per-(component, device) current gauge, the device-aggregated
+    per-component gauge + high-water, the cross-component ``total``
+    high-water, and the per-device total high-water. Called at
+    pool/arena build time — never on the per-token path."""
     if not REGISTRY.enabled:
         return
     n = int(nbytes)
+    dev = str(device)
     with _hbm_lock:
-        _hbm_current[component] = n
-        if n > _hbm_high_water.get(component, -1):
-            _hbm_high_water[component] = n
+        _hbm_current[(component, dev)] = n
+        comp_total = sum(
+            v for (c, _), v in _hbm_current.items() if c == component
+        )
+        if comp_total > _hbm_high_water.get(component, -1):
+            _hbm_high_water[component] = comp_total
         total = sum(_hbm_current.values())
         if total > _hbm_high_water.get("total", -1):
             _hbm_high_water["total"] = total
+        dev_total = sum(
+            v for (_, d), v in _hbm_current.items() if d == dev
+        )
+        if dev_total > _hbm_dev_high_water.get(dev, -1):
+            _hbm_dev_high_water[dev] = dev_total
         high = dict(_hbm_high_water)
-    REGISTRY.gauge_set("hbm_bytes", n, component=component)
+        dev_high = dict(_hbm_dev_high_water)
+    REGISTRY.gauge_set("hbm_bytes", n, component=component, device=dev)
+    REGISTRY.gauge_set("hbm_bytes", comp_total, component=component)
     for comp, hw in high.items():
         REGISTRY.gauge_max("hbm_high_water_bytes", hw, component=comp)
+    for d, hw in dev_high.items():
+        REGISTRY.gauge_max("hbm_high_water_bytes", hw, component="total",
+                           device=d)
 
 
 def hbm_stats() -> dict:
-    """Snapshot: current bytes per component, per-component high-water,
-    and the total high-water across components."""
+    """Snapshot: current bytes per component (aggregated over devices),
+    per-component high-water, the total high-water across components,
+    and the per-device breakdown (``per_device_bytes`` /
+    ``per_device_high_water_bytes``, plus ``device_bytes`` nesting
+    component rows per device for `cli stats`). Single-chip all
+    per-device views carry the one key "0"."""
     with _hbm_lock:
         current = dict(_hbm_current)
         high = dict(_hbm_high_water)
-    total_high = high.pop("total", sum(current.values()))
+        dev_high = dict(_hbm_dev_high_water)
+    comp_cur: dict[str, int] = {}
+    dev_cur: dict[str, int] = {}
+    dev_comp: dict[str, dict[str, int]] = {}
+    for (c, d), v in current.items():
+        comp_cur[c] = comp_cur.get(c, 0) + v
+        dev_cur[d] = dev_cur.get(d, 0) + v
+        dev_comp.setdefault(d, {})[c] = dev_comp.get(d, {}).get(c, 0) + v
+    total_high = high.pop("total", sum(comp_cur.values()))
     return {
-        "current_bytes": {k: current[k] for k in sorted(current)},
+        "current_bytes": {k: comp_cur[k] for k in sorted(comp_cur)},
         "high_water_bytes": {k: high[k] for k in sorted(high)},
-        "current_total_bytes": sum(current.values()),
+        "current_total_bytes": sum(comp_cur.values()),
         "high_water_total_bytes": total_high,
+        "per_device_bytes": {k: dev_cur[k] for k in sorted(dev_cur)},
+        "per_device_high_water_bytes": {
+            k: dev_high[k] for k in sorted(dev_high)
+        },
+        "device_bytes": {
+            d: {c: dev_comp[d][c] for c in sorted(dev_comp[d])}
+            for d in sorted(dev_comp)
+        },
     }
 
 
@@ -710,6 +759,7 @@ def reset_hbm_stats() -> None:
     with _hbm_lock:
         _hbm_current.clear()
         _hbm_high_water.clear()
+        _hbm_dev_high_water.clear()
     REGISTRY.remove("hbm_bytes", "hbm_high_water_bytes")
 
 
@@ -778,6 +828,38 @@ def dispatch_counts() -> dict[str, int]:
 
 def reset_dispatch_counts() -> None:
     REGISTRY.remove("device_dispatch")
+
+
+# --------------------------------------------------------------------- #
+# retrieval-backend ledger (PATHWAY_TPU_MESH)
+#
+# Which index answered retrieval queries: ``dense`` (single-device
+# brute force / IVF) or ``sharded_ivf`` (mesh-resident, one shard per
+# device). Tests and the bench assert that mesh serving actually routed
+# queries through the sharded index rather than silently falling back.
+
+_retrieval_backends: dict[str, int] = {}  # backend -> queries served
+
+
+def record_retrieval_backend(backend: str, n: int = 1) -> None:
+    """Count ``n`` retrieval queries answered by ``backend``
+    (``dense`` | ``ivf`` | ``sharded_ivf``). Thread-safe."""
+    REGISTRY.counter_add("retrieval_queries", n, backend=backend)
+    with _hbm_lock:
+        _retrieval_backends[backend] = _retrieval_backends.get(backend, 0) + n
+
+
+def retrieval_backend_stats() -> dict[str, int]:
+    """``{backend: queries}`` since the last reset (metrics-off safe:
+    the host dict is kept even when the registry is disabled)."""
+    with _hbm_lock:
+        return dict(_retrieval_backends)
+
+
+def reset_retrieval_backend_stats() -> None:
+    with _hbm_lock:
+        _retrieval_backends.clear()
+    REGISTRY.remove("retrieval_queries")
 
 
 # --------------------------------------------------------------------- #

@@ -104,3 +104,38 @@ class MultisetState:
 
     def __contains__(self, key: int) -> bool:
         return key in self.counts
+
+
+# ---- sharding-aware device state (PATHWAY_TPU_MESH) ------------------------
+#
+# Engine state that lives on device (serving pools, param pytrees,
+# persisted operator state) crosses the host boundary in two
+# directions: gather-to-host for snapshots/persistence and
+# place-on-mesh for restore. These helpers are the one seam the rest
+# of the engine uses, so "state moved across a topology change" always
+# means "gathered bytes were identical, only placement changed".
+
+
+def host_state_pytree(tree):
+    """Gather every array leaf of ``tree`` to host numpy (replicated or
+    sharded alike — a sharded leaf is gathered across its shards).
+    Non-array leaves pass through. The result is topology-free: it can
+    be persisted or re-placed onto any mesh."""
+    import jax
+
+    def to_host(leaf):
+        if hasattr(leaf, "addressable_shards") or hasattr(leaf, "devices"):
+            return np.asarray(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map(to_host, tree)
+
+
+def place_state_pytree(tree, mesh=None, specs=None):
+    """Commit a host state pytree onto a serving mesh with per-leaf
+    ``PartitionSpec``s (``parallel.mesh.place_pytree`` — replicated
+    where unspecified); ``mesh=None`` returns the tree untouched, the
+    single-chip restore path."""
+    from pathway_tpu.parallel.mesh import place_pytree
+
+    return place_pytree(tree, mesh, specs)

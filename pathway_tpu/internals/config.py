@@ -450,6 +450,44 @@ FLAG_REGISTRY: list[Flag] = [
             "this target and records `bound_by` when host CPU memory, "
             "not the design point, set the ceiling.",
     ),
+    Flag(
+        env="PATHWAY_TPU_MESH", kind="bool", default=False,
+        kill_switch=True, pinned_by="tests/test_mesh_serving.py",
+        attr="mesh", group="pipeline",
+        doc="GSPMD mesh-sharded serving: decoder/embedder params get "
+            "Megatron `NamedSharding` annotations over a `(data, fsdp, "
+            "tp)` mesh (`parallel/mesh.py:make_serving_mesh`), the "
+            "paged/dense KV pool shards its head axis over `tp`, the "
+            "Pallas paged-attention kernel runs per-shard via "
+            "`shard_map`, and `answer_query` retrieval routes through "
+            "the mesh-resident `ShardedIvfIndex`. `0` (default) — and "
+            "`1` on a 1x1x1 mesh — leaves single-chip serving tokens "
+            "byte-identical (`tests/test_mesh_serving.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_MESH_DATA", kind="int", default=1,
+        attr="mesh_data", group="pipeline", minimum=1,
+        doc="`data` axis length of the serving mesh (replica/batch "
+            "dimension). `data * fsdp * tp` must equal the device "
+            "count; impossible shapes raise a typed `MeshShapeError` "
+            "at server construction instead of an XLA crash.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_MESH_FSDP", kind="int", default=1,
+        attr="mesh_fsdp", group="pipeline", minimum=1,
+        doc="`fsdp` axis length of the serving mesh: parameters not "
+            "tensor-sharded by `tp` split their first divisible dim "
+            "here (ZeRO-3-style layout; 1 = fully replicated "
+            "remainder).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_MESH_TP", kind="int", default=0,
+        attr="mesh_tp", group="pipeline", minimum=0,
+        doc="`tp` (tensor-parallel) axis length of the serving mesh: "
+            "attention heads, ffn features and the KV pool's head axis "
+            "shard here. `0` = auto — every device left over after "
+            "`data * fsdp`.",
+    ),
     # ---- query-path knobs (README 'query' table) ----------------------
     Flag(
         env="PATHWAY_TPU_PAIR_BUCKETS", kind="bool", default=True,

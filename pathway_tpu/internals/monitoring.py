@@ -96,6 +96,19 @@ class StatsMonitor:
         if ttft:
             rows.append(("TTFT p50", f"{ttft['p50_ms']:.1f} ms"))
             rows.append(("TTFT p95", f"{ttft['p95_ms']:.1f} ms"))
+        for backend, n in sorted((serving.get("retrieval") or {}).items()):
+            rows.append((f"retrieval {backend}", str(int(n))))
+        from pathway_tpu.engine import probes as _probes
+
+        hbm = _probes.hbm_stats()
+        # per-device HBM rows (PATHWAY_TPU_MESH): single-chip shows one
+        # device "0" row; a mesh shows one row per device so the panel
+        # surfaces the TIGHTEST device, not just the fleet aggregate
+        for dev, nbytes in sorted(
+            (hbm.get("per_device_bytes") or {}).items()
+        ):
+            if nbytes:
+                rows.append((f"hbm device {dev}", f"{nbytes / 1e6:.2f} MB"))
         if not rows:
             return None
         panel = RichTable(title="serving")

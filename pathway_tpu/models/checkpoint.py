@@ -379,3 +379,152 @@ def load_decoder_checkpoint(path: str, cfg=None) -> tuple[dict, "Any"]:
     if cfg is None:
         cfg = decoder_config_from_hf(path)
     return params_from_hf_gpt2(load_hf_state_dict(path), cfg), cfg
+
+
+# ---- native sharding-aware checkpoints (PATHWAY_TPU_MESH) ------------------
+#
+# The HF loaders above READ foreign checkpoints; the functions below
+# are the repo's own round-trip format, and they are mesh-aware in one
+# specific way: the ARRAYS on disk are always fully gathered (host
+# numpy in an .npz), while the LAYOUT each param had at save time —
+# mesh axes, axis lengths, per-param PartitionSpec axis names — rides
+# alongside in layout.json. Resharding is therefore pure placement: a
+# checkpoint saved on an 8-way mesh loads onto a single chip (specs
+# ignored, plain arrays), onto the same mesh (specs replayed), or onto
+# a DIFFERENT mesh (specs replayed against the new axis lengths) with
+# bitwise-identical gathered values in every direction
+# (tests/test_mesh_serving.py pins the matrix).
+
+_CKPT_ARRAYS = "params.npz"
+_CKPT_LAYOUT = "layout.json"
+_KEY_SEP = "/"
+
+
+def _flatten_tree(tree: dict, prefix: str = "") -> dict[str, "Any"]:
+    flat: dict[str, Any] = {}
+    for k in sorted(tree):
+        v = tree[k]
+        name = f"{prefix}{_KEY_SEP}{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            flat.update(_flatten_tree(v, name))
+        else:
+            flat[name] = v
+    return flat
+
+
+def _unflatten_tree(flat: dict[str, "Any"]) -> dict:
+    tree: dict = {}
+    for name, v in flat.items():
+        parts = name.split(_KEY_SEP)
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def _leaf_spec_names(leaf) -> "list | None":
+    """The PartitionSpec axis names a placed array carries, as a JSON
+    row (``["tp", None]`` etc.), or None for host/replicated arrays."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    names = [
+        list(p) if isinstance(p, tuple) else p for p in tuple(spec)
+    ]
+    return names if any(n is not None for n in names) else None
+
+
+def save_checkpoint(path: str, params: dict, *, mesh=None) -> None:
+    """Write ``params`` (a nested dict pytree of arrays) as a native
+    checkpoint directory: fully gathered arrays in ``params.npz`` plus
+    ``layout.json`` recording the serving mesh (axis names + lengths)
+    and each param's PartitionSpec axis names as observed on the
+    arrays. Works for sharded and single-chip params alike — saving
+    from a mesh gathers, so the bytes on disk never depend on the
+    topology they were computed on."""
+    os.makedirs(path, exist_ok=True)
+    flat = _flatten_tree(params)
+    arrays = {k: np.asarray(v) for k, v in flat.items()}
+    layout: dict[str, Any] = {
+        "format": 1,
+        "mesh": None,
+        "specs": {
+            k: names
+            for k, v in flat.items()
+            if (names := _leaf_spec_names(v)) is not None
+        },
+    }
+    if mesh is not None:
+        layout["mesh"] = {
+            "axes": [str(a) for a in mesh.axis_names],
+            "shape": [int(mesh.shape[a]) for a in mesh.axis_names],
+        }
+    with open(os.path.join(path, _CKPT_ARRAYS), "wb") as fh:
+        np.savez(fh, **arrays)
+    with open(os.path.join(path, _CKPT_LAYOUT), "w") as fh:
+        json.dump(layout, fh, indent=1, sort_keys=True)
+
+
+def checkpoint_layout(path: str) -> dict:
+    """The saved layout metadata (mesh axes/lengths + per-param spec
+    names); ``{"format": 1, "mesh": None, "specs": {}}`` for a
+    checkpoint saved without any."""
+    with open(os.path.join(path, _CKPT_LAYOUT)) as fh:
+        return json.load(fh)
+
+
+def load_checkpoint(path: str, *, mesh=None, specs=None) -> dict:
+    """Load a native checkpoint back into a nested param pytree.
+
+    ``mesh=None`` returns host numpy arrays (the single-chip path —
+    callers ``device_put`` as usual). With a serving ``mesh``, each
+    param is committed with a ``NamedSharding``: from ``specs`` (a
+    ``{flat_key: PartitionSpec}`` override) when given, else by
+    replaying the SAVED spec axis names against the target mesh —
+    which is what makes a mesh checkpoint load onto a different mesh
+    shape, and a single-chip checkpoint (no saved specs) load onto a
+    mesh replicated."""
+    with np.load(os.path.join(path, _CKPT_ARRAYS)) as z:
+        flat = {k: z[k] for k in z.files}
+    if mesh is None:
+        return _unflatten_tree(flat)
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    saved = checkpoint_layout(path).get("specs", {})
+    axis_names = set(mesh.axis_names)
+
+    def keep(axes, dim: int):
+        """Saved axis names that exist on the target mesh AND whose
+        combined length still divides the dim — a spec axis that fits
+        an 8-way mesh but not this one degrades to replicated instead
+        of crashing placement."""
+        kept = tuple(a for a in axes if a in axis_names)
+        size = 1
+        for a in kept:
+            size *= int(mesh.shape[a])
+        return kept if kept and dim % size == 0 else None
+
+    def spec_for(key: str, shape) -> PartitionSpec:
+        if specs is not None and key in specs:
+            return specs[key]
+        names = saved.get(key)
+        if not names:
+            return PartitionSpec()
+        parts = []
+        for i, n in enumerate(names[: len(shape)]):
+            axes = n if isinstance(n, list) else ([n] if n else [])
+            kept = keep(axes, int(shape[i]))
+            parts.append(
+                kept if kept and len(kept) > 1
+                else (kept[0] if kept else None)
+            )
+        return PartitionSpec(*parts)
+
+    placed = {
+        k: jax.device_put(v, NamedSharding(mesh, spec_for(k, v.shape)))
+        for k, v in flat.items()
+    }
+    return _unflatten_tree(placed)

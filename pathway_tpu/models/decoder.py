@@ -118,6 +118,113 @@ def param_partition_specs(cfg: DecoderConfig, tp_axis: str = "tp") -> dict:
     }
 
 
+# ---- serving-mesh placement (PATHWAY_TPU_MESH) ----------------------------
+#
+# The specs above describe WHAT shards over tp; the helpers below bind
+# them to a concrete ``(data, fsdp, tp)`` serving mesh
+# (``parallel/mesh.py:make_serving_mesh``): params get the Megatron
+# layout plus an fsdp overlay on whatever tp left replicated, and the
+# KV pool (dense or paged, arena included) shards its HEAD axis over tp
+# — attention is per-head, so every pool op partitions with zero
+# cross-shard traffic except the one psum per block the param specs
+# already imply. Divisibility is validated host-side
+# (:class:`parallel.mesh.MeshShapeError`), never left to XLA.
+
+
+def validate_decoder_mesh(cfg: DecoderConfig, mesh) -> None:
+    """Raise a typed ``MeshShapeError`` when ``cfg`` cannot shard over
+    ``mesh``'s tp axis: heads, ffn features and vocab must all divide."""
+    from pathway_tpu.parallel.mesh import SERVE_TP_AXIS, MeshShapeError
+
+    tp = int(mesh.shape.get(SERVE_TP_AXIS, 1))
+    bad = []
+    if cfg.heads % tp != 0:
+        bad.append(f"heads={cfg.heads}")
+    if cfg.intermediate % tp != 0:
+        bad.append(f"intermediate={cfg.intermediate}")
+    if cfg.vocab_size % tp != 0:
+        bad.append(f"vocab_size={cfg.vocab_size}")
+    if bad:
+        raise MeshShapeError(
+            f"decoder config does not divide the tp axis: {', '.join(bad)} "
+            f"% tp={tp} != 0",
+            data=int(mesh.shape.get("data", 1)),
+            fsdp=int(mesh.shape.get("fsdp", 1)),
+            tp=tp, n_devices=int(mesh.devices.size),
+        )
+
+
+def param_mesh_specs(params: dict, cfg: DecoderConfig, mesh) -> dict:
+    """Per-param ``PartitionSpec`` pytree for the serving mesh: the
+    Megatron tp layout of :func:`param_partition_specs` with the fsdp
+    axis overlaid on each param's first unsharded divisible dim."""
+    from pathway_tpu.parallel.mesh import (
+        SERVE_FSDP_AXIS, SERVE_TP_AXIS, spec_with_fsdp,
+    )
+
+    fsdp = int(mesh.shape.get(SERVE_FSDP_AXIS, 1))
+    specs = param_partition_specs(cfg, tp_axis=SERVE_TP_AXIS)
+    is_spec = lambda x: x is None or isinstance(x, P)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    spec_leaves = jax.tree_util.tree_flatten(specs, is_leaf=is_spec)[0]
+    out = [
+        spec_with_fsdp(s, leaf.shape, fsdp)
+        for leaf, s in zip(leaves, spec_leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def pool_partition_specs(pool: dict, mesh) -> dict:
+    """Per-plane ``PartitionSpec``s for a serving pool (dense or paged):
+    KV planes and their int8 scales shard the HEAD axis over tp, logits
+    shard the vocab (matching the vocab-sharded tied LM head, so the
+    decode-step write needs no resharding), and the block table /
+    masks / cursors replicate."""
+    from pathway_tpu.parallel.mesh import SERVE_TP_AXIS
+
+    t = SERVE_TP_AXIS
+    tp = int(mesh.shape.get(t, 1))
+    head3 = P(None, None, t, None, None)  # (L, S|NB, nh, T|Bk, d)
+    arena = P(None, None, t, None, None)  # (A, L, nh, Bk, d)
+    specs: dict = {}
+    for key in pool:
+        if key in ("k", "v", "k_scale", "v_scale", "kb", "vb",
+                   "kb_scale", "vb_scale"):
+            specs[key] = head3
+        elif key in ("arena_k", "arena_v", "arena_k_scale",
+                     "arena_v_scale"):
+            specs[key] = arena
+        elif key == "logits" and pool[key].shape[1] % tp == 0:
+            specs[key] = P(None, t)
+        else:
+            specs[key] = P()
+    return specs
+
+
+def shard_decoder_params(params: dict, cfg: DecoderConfig, mesh) -> dict:
+    """Commit ``params`` onto the serving mesh with the Megatron + fsdp
+    layout (validated first). No-op when ``mesh`` is None."""
+    from pathway_tpu.parallel.mesh import place_pytree
+
+    if mesh is None:
+        return params
+    validate_decoder_mesh(cfg, mesh)
+    return place_pytree(params, mesh, param_mesh_specs(params, cfg, mesh))
+
+
+def shard_pool(pool: dict, cfg: DecoderConfig, mesh) -> dict:
+    """Commit a freshly built serving pool onto the mesh (head axis over
+    tp). Jitted pool ops then inherit the layout through GSPMD sharding
+    propagation, and donation keeps it across dispatches. No-op when
+    ``mesh`` is None."""
+    from pathway_tpu.parallel.mesh import place_pytree
+
+    if mesh is None:
+        return pool
+    validate_decoder_mesh(cfg, mesh)
+    return place_pytree(pool, mesh, pool_partition_specs(pool, mesh))
+
+
 def _ln(x, scale, bias, eps):
     x = x.astype(jnp.float32)
     mu = jnp.mean(x, axis=-1, keepdims=True)
@@ -545,20 +652,59 @@ def pool_component_bytes(pool: dict) -> dict[str, int]:
     prefix arena's role), ``kv_scales``, and ``block_table``. The HBM
     ledger (``probes.record_hbm``) records these per component at pool
     build; :func:`pool_bytes` sums them for the historical total."""
-    groups = {
-        "slot_pool": ("k", "v"),
-        "kv_blocks": ("kb", "vb"),
-        "kv_scales": ("k_scale", "v_scale", "kb_scale", "vb_scale"),
-        "block_table": ("block_tbl",),
-        "prefix_arena": ("arena_k", "arena_v"),
-        "arena_scales": ("arena_k_scale", "arena_v_scale"),
-    }
     out: dict[str, int] = {}
-    for component, keys in groups.items():
+    for component, keys in _HBM_COMPONENT_KEYS.items():
         n = sum(int(pool[c].size) * pool[c].dtype.itemsize
                 for c in keys if c in pool)
         if n:
             out[component] = n
+    return out
+
+
+# ledger component -> pool keys it accounts (both layouts; absent keys skip)
+_HBM_COMPONENT_KEYS = {
+    "slot_pool": ("k", "v"),
+    "kv_blocks": ("kb", "vb"),
+    "kv_scales": ("k_scale", "v_scale", "kb_scale", "vb_scale"),
+    "block_table": ("block_tbl",),
+    "prefix_arena": ("arena_k", "arena_v"),
+    "arena_scales": ("arena_k_scale", "arena_v_scale"),
+}
+
+
+def _device_bytes(arr) -> dict[str, int]:
+    """Physical bytes of one array per device id, from its addressable
+    shards. Replicated arrays correctly charge the full size to EVERY
+    device; arrays without shard info (numpy, tracers) charge device
+    "0", matching the single-chip ledger label."""
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        return {"0": int(arr.size) * arr.dtype.itemsize}
+    out: dict[str, int] = {}
+    for s in shards:
+        dev = str(s.device.id)
+        out[dev] = out.get(dev, 0) + int(s.data.size) * arr.dtype.itemsize
+    return out
+
+
+def pool_component_device_bytes(pool: dict) -> dict[str, dict[str, int]]:
+    """:func:`pool_component_bytes` split per DEVICE: ``{component:
+    {device_id: bytes}}``. On a single chip every component lands on
+    device "0" and the per-device view degenerates to the component
+    view; on a serving mesh the tp-sharded planes report 1/tp bytes per
+    device while the replicated block table charges every device in
+    full — exactly what capacity planning needs to size the block
+    allocator against the TIGHTEST device."""
+    out: dict[str, dict[str, int]] = {}
+    for component, keys in _HBM_COMPONENT_KEYS.items():
+        per_dev: dict[str, int] = {}
+        for c in keys:
+            if c not in pool:
+                continue
+            for dev, n in _device_bytes(pool[c]).items():
+                per_dev[dev] = per_dev.get(dev, 0) + n
+        if any(per_dev.values()):
+            out[component] = per_dev
     return out
 
 
@@ -1199,7 +1345,8 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
                       temperature: float = 0.0,
                       top_k: int | None = None,
                       top_p: float | None = None,
-                      paged_kernel: bool = False) -> tuple[dict, jax.Array]:
+                      paged_kernel: bool = False,
+                      mesh=None) -> tuple[dict, jax.Array]:
     """Advance every ``active`` slot ``n_steps`` decode steps in ONE
     dispatch. Returns ``(pool, tokens (n_steps, n_slots))`` — the host
     truncates each slot's stream at EOS / its budget (a lane keeps
@@ -1209,12 +1356,18 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
     Paged pools gather-run-scatter (see :func:`pool_admit`) unless
     ``paged_kernel`` is set, in which case the chunk runs directly on
     the block planes with the Pallas paged-attention kernel — no dense
-    materialization, int8 dequant fused into the attention read."""
+    materialization, int8 dequant fused into the attention read.
+
+    ``mesh`` (a serving mesh, static) makes the Pallas kernel run
+    per-tp-shard via ``shard_map`` — the block planes are head-sharded,
+    attention is per-head, so each shard walks its own heads with zero
+    cross-shard traffic. ``None`` (or a trivial mesh) is the single-chip
+    path, byte-identical to before the flag existed."""
     if pool_paged(pool):
         if paged_kernel:
             return _paged_decode_chunk_kernel(
                 params, pool, active, key, cfg, n_steps,
-                temperature, top_k, top_p,
+                temperature, top_k, top_p, mesh=mesh,
             )
         view, toks = pool_decode_chunk(
             params, _paged_gather(pool), active, key, cfg, n_steps,
@@ -1298,8 +1451,49 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
     return out, toks
 
 
+def _paged_attn_fn(mesh, quant):
+    """The paged-attention entry the decode chunk should call: the
+    plain Pallas kernel on a single chip, or a ``shard_map``-wrapped
+    version on a serving mesh with tp > 1. The wrapper splits the HEAD
+    axis (q / block planes / scales all carry it) over ``tp`` and runs
+    the UNCHANGED kernel per shard — attention never mixes heads, so
+    ``check_vma=False`` is the only concession and no collective is
+    inserted. Quantized pools get a separate wrapper because
+    ``shard_map`` in_specs cannot describe the ``None`` scale operands
+    of the bf16 layout."""
+    from pathway_tpu.models import paged_attention as _pa
+
+    if mesh is None:
+        return _pa.paged_attn_decode
+    from pathway_tpu.parallel.mesh import SERVE_TP_AXIS, compat_shard_map
+
+    if int(mesh.shape.get(SERVE_TP_AXIS, 1)) == 1:
+        return _pa.paged_attn_decode
+    t = SERVE_TP_AXIS
+    head = P(None, t, None)           # q / ctx: (B, nh, hd)
+    blocks = P(None, t, None, None)   # kb / vb / scales: (NB, nh, Bk, d)
+    rep = P(None, None)               # block table / slot mask
+    if quant:
+        return compat_shard_map(
+            _pa.paged_attn_decode, mesh=mesh,
+            in_specs=(head, blocks, blocks, blocks, blocks, rep, rep),
+            out_specs=head, check_vma=False,
+        )
+
+    def unquant(q, kb, vb, tbl, slot_mask):
+        return _pa.paged_attn_decode(q, kb, vb, None, None, tbl, slot_mask)
+
+    mapped = compat_shard_map(
+        unquant, mesh=mesh,
+        in_specs=(head, blocks, blocks, rep, rep),
+        out_specs=head, check_vma=False,
+    )
+    return lambda q, kb, vb, _ks, _vs, tbl, slot_mask: \
+        mapped(q, kb, vb, tbl, slot_mask)
+
+
 def _paged_decode_chunk_kernel(params, pool, active, key, cfg, n_steps,
-                               temperature, top_k, top_p):
+                               temperature, top_k, top_p, mesh=None):
     """:func:`pool_decode_chunk` running DIRECTLY on the paged block
     planes — no dense gather/scatter. Each step writes the new token's
     KV into its slot's current physical block (one advanced-index
@@ -1308,9 +1502,8 @@ def _paged_decode_chunk_kernel(params, pool, active, key, cfg, n_steps,
     (:mod:`pathway_tpu.models.paged_attention`), which walks the block
     table and fuses int8 dequant into the read. Same op sequence as the
     dense chunk otherwise (embedding, QKV, MLP, logits), so tokens
-    match the reference path at online-softmax tolerance."""
-    from pathway_tpu.models import paged_attention as _pa
-
+    match the reference path at online-softmax tolerance. On a serving
+    mesh the kernel runs per-tp-shard (:func:`_paged_attn_fn`)."""
     B, C = pool["slot_mask"].shape
     Bk = paged_block(pool)
     tbl = pool["block_tbl"]
@@ -1318,6 +1511,7 @@ def _paged_decode_chunk_kernel(params, pool, active, key, cfg, n_steps,
     act_i = active.astype(jnp.int32)
     act_b = active[:, None, None]
     quant = pool_quantized(pool)
+    attn = _paged_attn_fn(mesh, quant)
 
     def sample(logits, k):
         if temperature == 0.0:
@@ -1365,7 +1559,7 @@ def _paged_decode_chunk_kernel(params, pool, active, key, cfg, n_steps,
             vbl = vbl.at[dst_b, :, dst_c, :].set(
                 jnp.where(act_b, v_new[:, :, 0, :], vbl[dst_b, :, dst_c, :])
             )
-            ctx = _pa.paged_attn_decode(
+            ctx = attn(
                 q[:, :, 0, :], kbl, vbl, kbsl, vbsl, tbl, slot_mask,
             )
             x = _block_finish(x, lp, ctx[:, :, None, :], cfg)

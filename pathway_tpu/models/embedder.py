@@ -274,6 +274,17 @@ class SentenceEmbedderModel:
         if params is None:
             params = init_params(jax.random.PRNGKey(seed), cfg)
         self.params = cast_params_for_inference(params, cfg)
+        # serving mesh (PATHWAY_TPU_MESH): encoder params commit onto
+        # the (data, fsdp, tp) mesh with the Megatron NamedSharding
+        # layout; embed dispatches then run GSPMD-partitioned. Off-mesh
+        # (or 1x1x1) this is plain single-chip placement.
+        from pathway_tpu.parallel.mesh import serving_mesh_from_flags
+
+        self.mesh = serving_mesh_from_flags()
+        if self.mesh is not None:
+            from pathway_tpu.models.transformer import shard_encoder_params
+
+            self.params = shard_encoder_params(self.params, cfg, self.mesh)
         self._pipeline: _IngestPipeline | None = None
         self._pipeline_lock = threading.Lock()
 

@@ -125,6 +125,64 @@ def param_partition_specs(cfg: TransformerConfig, tp_axis: str = "tp") -> dict:
     }
 
 
+def validate_encoder_mesh(cfg: TransformerConfig, mesh) -> None:
+    """Typed ``MeshShapeError`` when ``cfg`` cannot shard over the
+    serving mesh's tp axis (heads, ffn features, vocab must divide)."""
+    from pathway_tpu.parallel.mesh import SERVE_TP_AXIS, MeshShapeError
+
+    tp = int(mesh.shape.get(SERVE_TP_AXIS, 1))
+    bad = []
+    if cfg.heads % tp != 0:
+        bad.append(f"heads={cfg.heads}")
+    if cfg.intermediate % tp != 0:
+        bad.append(f"intermediate={cfg.intermediate}")
+    if cfg.vocab_size % tp != 0:
+        bad.append(f"vocab_size={cfg.vocab_size}")
+    if bad:
+        raise MeshShapeError(
+            f"encoder config does not divide the tp axis: {', '.join(bad)} "
+            f"% tp={tp} != 0",
+            data=int(mesh.shape.get("data", 1)),
+            fsdp=int(mesh.shape.get("fsdp", 1)),
+            tp=tp, n_devices=int(mesh.devices.size),
+        )
+
+
+def shard_encoder_params(params: dict, cfg: TransformerConfig,
+                         mesh) -> dict:
+    """Commit encoder params onto the ``(data, fsdp, tp)`` serving mesh
+    (PATHWAY_TPU_MESH): the Megatron layout above over ``tp`` with the
+    ``fsdp`` axis overlaid on each param's first unsharded divisible
+    dim. Placement is LENIENT — the encoder has no ``shard_map`` seam,
+    so a dim the tp axis does not divide (e.g. heads=12 on tp=8, or the
+    30522-row vocab) degrades to replicated rather than refusing the
+    mesh; ``validate_encoder_mesh`` stays available for callers that
+    want the strict check. No-op when ``mesh`` is None; a 1x1x1 mesh
+    degenerates to plain single-chip placement (the kill-switch
+    byte-identity regime)."""
+    from pathway_tpu.parallel.mesh import (
+        SERVE_FSDP_AXIS, SERVE_TP_AXIS, place_pytree,
+        spec_dropping_nondividing, spec_with_fsdp,
+    )
+
+    if mesh is None:
+        return params
+    fsdp = int(mesh.shape.get(SERVE_FSDP_AXIS, 1))
+    specs = param_partition_specs(cfg, tp_axis=SERVE_TP_AXIS)
+    is_spec = lambda x: x is None or isinstance(x, P)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    spec_leaves = jax.tree_util.tree_flatten(specs, is_leaf=is_spec)[0]
+    overlaid = [
+        spec_with_fsdp(
+            spec_dropping_nondividing(s, leaf.shape, mesh), leaf.shape, fsdp
+        )
+        for leaf, s in zip(leaves, spec_leaves)
+    ]
+    return place_pytree(
+        params, mesh, jax.tree_util.tree_unflatten(treedef, overlaid)
+    )
+
+
 # Odd minimax-style fit of erf over |t|<=3.2 (erf(t) ~ t*P(t^2), P below;
 # |t|>3.2 clamps to sign(t) where 1-erf < 7e-6). Max |gelu error| 1.9e-5
 # absolute — two orders of magnitude below bf16 resolution (~2e-3 for O(1)

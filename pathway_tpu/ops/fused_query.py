@@ -279,6 +279,31 @@ class FusedRAGPipeline:
             dimensions=embedder.cfg.hidden,
             reserved_space=reserved_space, metric=metric,
         )
+        # mesh-resident retrieval (PATHWAY_TPU_MESH): mirror the corpus
+        # into a sharded IVF (one shard per device, ICI top-k merge) and
+        # answer plain ``retrieve`` from it, so QueryServer queries scan
+        # 1/dp of the corpus per chip. Exhaustive probing (nprobe ==
+        # n_cells) keeps recall at 1.0 — the win here is the shard split,
+        # not IVF pruning. Rerank keeps the fused dense path (its doc
+        # gather + cross-encode is one dispatch against the dense slots).
+        self.sharded_index = None
+        from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+            mesh_retrieval_active,
+        )
+
+        if mesh_retrieval_active():
+            import jax as _jax
+
+            from pathway_tpu.parallel.mesh import make_mesh
+            from pathway_tpu.parallel.sharded_ivf import ShardedIvfIndex
+
+            devices = _jax.devices()
+            self.sharded_index = ShardedIvfIndex(
+                make_mesh(devices, dp=len(devices), tp=1),
+                dimensions=embedder.cfg.hidden,
+                n_cells=16, nprobe=16,
+                metric="l2" if metric in ("l2", "l2sq") else "cos",
+            )
         cap = self.index.capacity
         self._doc_tokens = jnp.zeros((cap, doc_seq), dtype=jnp.int32)
         self._doc_lens = jnp.zeros((cap,), dtype=jnp.int32)
@@ -329,6 +354,15 @@ class FusedRAGPipeline:
         self._doc_lens = jax.lax.dynamic_update_slice(
             self._doc_lens, jnp.asarray(lens), (start,)
         )
+        if self.sharded_index is not None:
+            # mirror the just-embedded rows into the sharded IVF (slot
+            # map, not [start:start+n] — upserts may have moved rows)
+            slots = [self.index._slot_of[key] for key in keys]
+            vecs = np.asarray(
+                jnp.take(self.index._corpus, jnp.asarray(slots), axis=0),
+                np.float32,
+            )
+            self.sharded_index.add(list(keys), vecs)
 
     # ------------------------------------------------------------ queries
     def _tokenize_queries(self, texts: list[str], max_length: int | None = None):
@@ -396,6 +430,8 @@ class FusedRAGPipeline:
                 )
             self._doc_lens = self._doc_lens.at[last].set(0)
             self.index.remove([key])
+        if self.sharded_index is not None:
+            self.sharded_index.remove(list(keys))
 
     def retrieve_device(self, texts: list[str], k: int):
         ids, mask, _ = self._tokenize_queries(texts)
@@ -408,8 +444,21 @@ class FusedRAGPipeline:
         )
 
     def retrieve(self, texts: list[str], k: int):
-        """[(key, score)] per query — ONE dispatch round trip."""
+        """[(key, score)] per query — ONE dispatch round trip (under a
+        serving mesh: one sharded-IVF dispatch, every chip scanning its
+        shard, plus the query-embed dispatch)."""
+        if self.sharded_index is not None:
+            ids, mask, _ = self._tokenize_queries(texts)
+            record_device_dispatch("sharded_ivf_search")
+            emb = np.asarray(
+                embed_fn(self.embedder.params, ids, mask, self.embedder.cfg),
+                np.float32,
+            )[: len(texts)]
+            return self.sharded_index.search(emb, k)
+        from pathway_tpu.engine.probes import record_retrieval_backend
+
         scores, idx = jax.device_get(self.retrieve_device(texts, k))
+        record_retrieval_backend("dense", len(texts))
         return self.index.resolve(scores, idx, len(texts), k)
 
     def _rerank_args(self, texts: list[str], k: int):

@@ -617,11 +617,15 @@ class IvfFlatIndex:
         return out
 
     def search(self, queries, k: int) -> list[list[tuple[Any, float]]]:
+        from pathway_tpu.engine.probes import record_retrieval_backend
+
         if self.n == 0:
             q = np.asarray(queries)
             nq = 1 if q.ndim == 1 else len(q)
+            record_retrieval_backend("ivf", nq)
             return [[] for _ in range(nq)]
         q = self._prep(queries)  # idempotent; search_device re-prep is a no-op
+        record_retrieval_backend("ivf", len(q))
         scores, cell_ids, slots = jax.device_get(self.search_device(q, k))
         return self.resolve(scores, cell_ids, slots, len(q), k)
 

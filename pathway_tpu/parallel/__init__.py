@@ -16,6 +16,13 @@ from pathway_tpu.parallel.mesh import (
     local_mesh,
     shard_batch,
     replicated,
+    MeshShapeError,
+    make_serving_mesh,
+    serving_mesh_from_flags,
+    mesh_is_trivial,
+    spec_dropping_nondividing,
+    spec_with_fsdp,
+    place_pytree,
 )
 from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex, sharded_topk_merge
 from pathway_tpu.parallel.sharded_ivf import ShardedIvfIndex, sharded_ivf_topk_merge
@@ -25,6 +32,7 @@ from pathway_tpu.parallel.distributed import (
     distributed_topology,
     initialize_distributed,
     reset_distributed,
+    validate_mesh_topology,
 )
 from pathway_tpu.parallel.ring_attention import (
     ring_attention_core,
@@ -38,6 +46,13 @@ __all__ = [
     "local_mesh",
     "shard_batch",
     "replicated",
+    "MeshShapeError",
+    "make_serving_mesh",
+    "serving_mesh_from_flags",
+    "mesh_is_trivial",
+    "spec_dropping_nondividing",
+    "spec_with_fsdp",
+    "place_pytree",
     "ShardedKnnIndex",
     "sharded_topk_merge",
     "ShardedIvfIndex",
@@ -47,6 +62,7 @@ __all__ = [
     "distributed_topology",
     "initialize_distributed",
     "reset_distributed",
+    "validate_mesh_topology",
     "ring_attention_core",
     "encode_sequence_parallel",
 ]

@@ -81,7 +81,40 @@ def initialize_distributed(config: DistributedConfig | None = None) -> None:
             num_processes=cfg.num_processes,
             process_id=cfg.process_id,
         )
+    validate_mesh_topology(cfg)
     _initialized = cfg
+
+
+def validate_mesh_topology(config: DistributedConfig | None = None) -> None:
+    """``PATHWAY_TPU_MESH{,_DATA,_FSDP,_TP}`` and the process topology
+    must AGREE on device counts: the serving mesh factors
+    ``data * fsdp * tp`` over every device the initialized runtime can
+    see (all hosts' chips under multi-process jax.distributed). An
+    impossible request — factors that don't multiply out to the device
+    count, or ``data * fsdp`` not dividing it with ``tp`` on auto —
+    raises the typed host-side :class:`~pathway_tpu.parallel.mesh.\
+MeshShapeError` HERE, at bootstrap, annotated with the topology,
+    instead of surfacing as an opaque XLA device-assignment crash on the
+    first sharded dispatch. No-op with the mesh flag off."""
+    from pathway_tpu.parallel.mesh import (
+        MeshShapeError,
+        serving_mesh_from_flags,
+    )
+
+    if not pathway_config.mesh:
+        return
+    cfg = config or _initialized or DistributedConfig.from_env()
+    try:
+        serving_mesh_from_flags()
+    except MeshShapeError as err:
+        raise MeshShapeError(
+            f"PATHWAY_TPU_MESH disagrees with the initialized topology: "
+            f"{cfg.num_processes} process(es) expose "
+            f"{jax.device_count()} device(s), but the mesh flags "
+            f"requested an impossible factoring",
+            data=err.data, fsdp=err.fsdp, tp=err.tp,
+            n_devices=err.n_devices,
+        ) from err
 
 
 def distributed_topology() -> DistributedConfig | None:

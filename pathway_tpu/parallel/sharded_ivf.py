@@ -434,14 +434,17 @@ class ShardedIvfIndex:
         return self._dev
 
     def search(self, queries, k: int) -> list[list[tuple[Any, float]]]:
+        from pathway_tpu.engine.probes import record_retrieval_backend
         from pathway_tpu.ops import next_pow2
 
         if len(self._loc) == 0:
             q = np.asarray(queries)
             nq = 1 if q.ndim == 1 else len(q)
+            record_retrieval_backend("sharded_ivf", nq)
             return [[] for _ in range(nq)]
         q = self._prep(queries)
         nq = len(q)
+        record_retrieval_backend("sharded_ivf", nq)
         bucket = next_pow2(nq, 16)
         if bucket > nq:
             q = np.concatenate(

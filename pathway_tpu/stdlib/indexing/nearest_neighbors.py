@@ -59,6 +59,12 @@ class _KnnIndexFactory(ExternalIndexFactory):
         self.metric = metric
 
     def make_instance(self):
+        if mesh_retrieval_active():
+            # exhaustive probing (nprobe == n_cells): the mesh win is the
+            # dp-way shard split, recall stays 1.0 vs the dense scan
+            return _ShardedIvfIndexFactory(
+                self.dimensions, 16, 16, self.metric, None,
+            ).make_instance()
         from pathway_tpu.ops.knn import BruteForceKnnIndex
 
         return BruteForceKnnIndex(
@@ -179,6 +185,62 @@ class USearchKnn(BruteForceKnn):
         )
 
 
+def mesh_retrieval_active() -> bool:
+    """True when ``PATHWAY_TPU_MESH`` is on AND more than one device is
+    visible — the condition under which index factories route retrieval
+    to the mesh-resident sharded IVF. A 1×1×1 mesh (or the flag off)
+    keeps the single-device index byte-for-byte (kill-switch contract)."""
+    from pathway_tpu.internals.config import pathway_config
+
+    if not pathway_config.mesh:
+        return False
+    import jax
+
+    return len(jax.devices()) > 1
+
+
+def _sharded_ivf_metric(metric: str) -> str:
+    """Map the KNN metric vocabulary ("cos" / "l2sq" / "l2") onto the
+    sharded IVF's ("cos" / "l2")."""
+    return "l2" if metric in ("l2", "l2sq") else "cos"
+
+
+class _ShardedIvfIndexFactory(ExternalIndexFactory):
+    """Mesh-resident IVF: one shard (own centroids + cell block) per
+    device, searched in one ``shard_map`` step with an ICI top-k merge
+    (``parallel/sharded_ivf.py``). Selected automatically by
+    :class:`_IvfIndexFactory` under ``PATHWAY_TPU_MESH``, so
+    ``answer_query`` retrieval runs on the whole mesh instead of a
+    single chip."""
+
+    def __init__(self, dimensions, n_cells, nprobe, metric, train_after,
+                 dtype=None):
+        self.dimensions = dimensions
+        self.n_cells = n_cells
+        self.nprobe = nprobe
+        self.metric = metric
+        self.train_after = train_after
+        self.dtype = dtype
+
+    def make_instance(self):
+        import jax
+
+        from pathway_tpu.parallel.mesh import make_mesh
+        from pathway_tpu.parallel.sharded_ivf import ShardedIvfIndex
+
+        devices = jax.devices()
+        mesh = make_mesh(devices, dp=len(devices), tp=1)
+        return ShardedIvfIndex(
+            mesh,
+            dimensions=self.dimensions,
+            n_cells=self.n_cells,
+            nprobe=self.nprobe,
+            metric=_sharded_ivf_metric(self.metric),
+            train_after=self.train_after,
+            **({} if self.dtype is None else {"dtype": self.dtype}),
+        )
+
+
 class _IvfIndexFactory(ExternalIndexFactory):
     def __init__(self, dimensions, n_cells, nprobe, metric, train_after,
                  dtype=None):
@@ -190,6 +252,11 @@ class _IvfIndexFactory(ExternalIndexFactory):
         self.dtype = dtype
 
     def make_instance(self):
+        if mesh_retrieval_active():
+            return _ShardedIvfIndexFactory(
+                self.dimensions, self.n_cells, self.nprobe, self.metric,
+                self.train_after, self.dtype,
+            ).make_instance()
         from pathway_tpu.ops.ivf import IvfFlatIndex
 
         return IvfFlatIndex(

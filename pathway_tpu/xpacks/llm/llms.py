@@ -292,6 +292,7 @@ class TPUDecoderChat(BaseChat):
         tenant_budget: int | None = None,
         tenant_weights: str | None = None,
         prefix_t2_mb: float | None = None,
+        mesh=None,
     ):
         # continuous=True: requests are served by a persistent slot-pool
         # loop (_ContinuousServer) — new rows admit into the IN-FLIGHT
@@ -384,6 +385,7 @@ class TPUDecoderChat(BaseChat):
                 tenant_budget=tenant_budget,
                 tenant_weights=tenant_weights,
                 prefix_t2_mb=prefix_t2_mb,
+                mesh=mesh,
             )
             # the two-phase engine protocol only exists in continuous
             # mode — exposing these as CLASS methods would activate the
@@ -706,7 +708,8 @@ class _ContinuousServer:
                  tenant_sched: bool | None = None,
                  tenant_budget: int | None = None,
                  tenant_weights: str | None = None,
-                 prefix_t2_mb: float | None = None):
+                 prefix_t2_mb: float | None = None,
+                 mesh=None):
         import threading
         from collections import deque
 
@@ -1007,6 +1010,21 @@ class _ContinuousServer:
         self._last_dispatch_t: float | None = None
         self._last_dispatch_steps = 0
         self._D = decoder_mod
+        # serving mesh (PATHWAY_TPU_MESH): resolved ONCE here. Params
+        # and the pool COMMIT onto the (data, fsdp, tp) mesh with
+        # NamedSharding (Megatron tp over heads/ffn/vocab, fsdp over
+        # the remainder, the KV pool's head axis over tp); every jitted
+        # pool op below then inherits the layout through GSPMD sharding
+        # propagation, and donation carries it across dispatches. Off —
+        # or on a 1x1x1 mesh — placement degenerates to single-chip and
+        # tokens are byte-identical (tests/test_mesh_serving.py).
+        from pathway_tpu.parallel.mesh import serving_mesh_from_flags
+
+        self.mesh = mesh if mesh is not None else serving_mesh_from_flags()
+        if self.mesh is not None:
+            self.params = decoder_mod.shard_decoder_params(
+                self.params, cfg, self.mesh
+            )
         self.pool = self._build_pool()
         self.kv_bytes_saved = 0
         if self.kv_quant:
@@ -1022,16 +1040,19 @@ class _ContinuousServer:
             )
             self.kv_bytes_saved = base - decoder_mod.pool_bytes(self.pool)
             record_spec("kv_bytes_saved", self.kv_bytes_saved)
-        # HBM ledger: per-component footprint of the pool just built
-        # (slot caches / dequant scales / prefix arena). Recorded once
-        # here — never on the per-token path — feeding the
-        # `hbm_bytes{component=}` gauges and the total high-water.
+        # HBM ledger: per-component, PER-DEVICE footprint of the pool
+        # just built (slot caches / dequant scales / prefix arena).
+        # Recorded once here — never on the per-token path — feeding
+        # `hbm_bytes{component=,device=}` and the per-device high-water.
+        # Single-chip everything lands on device "0", which keeps the
+        # component-aggregated gauges byte-identical to the PR-9 ledger.
         from pathway_tpu.engine.probes import record_hbm
 
-        for comp, nbytes in decoder_mod.pool_component_bytes(
+        for comp, per_dev in decoder_mod.pool_component_device_bytes(
             self.pool
         ).items():
-            record_hbm(comp, nbytes)
+            for dev, nbytes in per_dev.items():
+                record_hbm(comp, nbytes, device=dev)
         self._admit_fns: dict = {}
         self._admit_batch_fns: dict = {}
         self._prefill_fns: dict = {}
@@ -1143,17 +1164,24 @@ class _ContinuousServer:
             self._slot_blocks = {}
             self._paged_seed_jit = None
             self._table_clear_jit = None
-            return self._D.paged_pool_init(
+            pool = self._D.paged_pool_init(
                 self.params, self.cfg, self.n_slots, self.cache_len,
                 n_blocks=self._total_blocks, block=self.paged_block,
                 kv_quant=bool(self.kv_quant),
             )
-        return self._D.pool_init(
-            self.params, self.cfg, self.n_slots, self.cache_len,
-            arena_blocks=(self.prefix.capacity_blocks if self.prefix else 0),
-            arena_block=self.prefix_block,
-            kv_quant=bool(self.kv_quant),
-        )
+        else:
+            pool = self._D.pool_init(
+                self.params, self.cfg, self.n_slots, self.cache_len,
+                arena_blocks=(
+                    self.prefix.capacity_blocks if self.prefix else 0
+                ),
+                arena_block=self.prefix_block,
+                kv_quant=bool(self.kv_quant),
+            )
+        # commit the pool onto the serving mesh (head axis over tp) —
+        # no-op off-mesh; the supervised restart path lands here too,
+        # so a rebuilt pool re-shards identically
+        return self._D.shard_pool(pool, self.cfg, self.mesh)
 
     def _make_prefix_cache(self):
         """The prefix tree for this server: arena-backed normally;
@@ -1538,13 +1566,13 @@ class _ContinuousServer:
 
             D, cfgc = self._D, self.cfg
             temp, tk, tp = self._temperature, self._top_k, self._top_p
-            pk = self.paged_kernel
+            pk, msh = self.paged_kernel, self.mesh
 
             def chunk(params_, pool, active, key):
                 return D.pool_decode_chunk(
                     params_, pool, active, key, cfgc, steps,
                     temperature=temp, top_k=tk, top_p=tp,
-                    paged_kernel=pk,
+                    paged_kernel=pk, mesh=msh,
                 )
 
             fn = jax.jit(chunk, donate_argnums=(1,))

@@ -53,7 +53,7 @@ def test_bench_smoke_schema():
         "config4_default_docs_per_sec", "config4_docs",
         "config4_elapsed_s", "join_rows", "join_elapsed_s",
         "wordcount_rows", "wordcount_elapsed_s", "knn_recall_at_10_f32",
-        "sharded_ivf",
+        "sharded_ivf", "mesh_serving",
     ):
         assert s.get(key) is not None, key
     assert s["ingest_elapsed_s"] > 0 and s["ingest_docs"] > 0
@@ -64,6 +64,18 @@ def test_bench_smoke_schema():
     assert sh.get("error") is None, sh
     assert sh["rows_total"] == sh["shards"] * sh["rows_per_shard"] > 0
     assert 0.0 < sh["recall_at_10"] <= 1.0
+    # mesh-sharded serving (PR 14): the 8-virtual-device arm ran in its
+    # pinned subprocess, emitted the exact single-chip token stream, and
+    # the per-device HBM ledger saw every mesh device
+    ms = s["mesh_serving"]
+    assert ms.get("error") is None, ms
+    assert ms["mesh_tok_s"] > 0 and ms["single_chip_tok_s"] > 0
+    assert ms["mesh_tokens_match"] is True
+    assert ms["mesh"] == {"axes": ["data", "fsdp", "tp"],
+                          "shape": [1, 2, 4]}
+    mdevs = ms["hbm_device_high_water_bytes"]
+    assert set(mdevs) >= {str(i) for i in range(8)}, mdevs
+    assert all(v > 0 for v in mdevs.values()), mdevs
     assert 0.0 <= s["knn_recall_at_10_f32"] <= 1.0
     # the query-serving phase ran under load: a survivor rate strictly
     # inside (0, 1] and a non-empty tick batch histogram

@@ -70,3 +70,65 @@ def test_exported_from_parallel_package():
     assert P.DistributedInitError is D.DistributedInitError
     assert P.reset_distributed is D.reset_distributed
     assert P.distributed_topology is D.distributed_topology
+
+
+# ---- PATHWAY_TPU_MESH vs topology agreement (serving mesh) ----------------
+#
+# The conftest pins an 8-virtual-device CPU topology, so these tests can
+# exercise real factorings: the mesh flags and the initialized topology
+# must agree on device counts, and an impossible request fails HERE as a
+# typed host-side MeshShapeError — never as an XLA crash mid-dispatch.
+
+
+def test_mesh_flag_off_skips_agreement_check(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_MESH", "0")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_DATA", "13")  # absurd, but gated
+    D.initialize_distributed()  # must not raise
+    D.validate_mesh_topology()  # standalone call: also a no-op
+
+
+def test_mesh_agreeing_shape_initializes(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_MESH", "1")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_DATA", "2")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_FSDP", "2")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_TP", "2")  # 2*2*2 == 8 devices
+    D.initialize_distributed()
+    assert D.distributed_topology() is not None
+
+
+def test_mesh_auto_tp_fills_remaining_devices(monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_MESH", "1")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_DATA", "2")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_FSDP", "1")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_TP", "0")  # auto: 8 // 2 = 4
+    D.initialize_distributed()
+    from pathway_tpu.parallel.mesh import serving_mesh_from_flags
+
+    mesh = serving_mesh_from_flags()
+    assert mesh is not None and mesh.shape["tp"] == 4
+
+
+def test_mesh_impossible_shape_raises_typed_error(monkeypatch):
+    from pathway_tpu.parallel.mesh import MeshShapeError
+
+    monkeypatch.setenv("PATHWAY_TPU_MESH", "1")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_DATA", "3")  # 3 does not divide 8
+    with pytest.raises(MeshShapeError) as exc_info:
+        D.initialize_distributed()
+    err = exc_info.value
+    assert isinstance(err, ValueError)  # catchable as the base type
+    assert err.data == 3 and err.n_devices == 8
+    assert "process" in str(err)  # topology annotated in the message
+    # the failed bootstrap records no topology: a fixed env re-inits
+    assert D.distributed_topology() is None
+
+
+def test_mesh_overcommitted_shape_raises_typed_error(monkeypatch):
+    from pathway_tpu.parallel.mesh import MeshShapeError
+
+    monkeypatch.setenv("PATHWAY_TPU_MESH", "1")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_DATA", "4")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_FSDP", "4")
+    monkeypatch.setenv("PATHWAY_TPU_MESH_TP", "4")  # 64 > 8 devices
+    with pytest.raises(MeshShapeError):
+        D.validate_mesh_topology()

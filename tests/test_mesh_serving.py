@@ -1,0 +1,377 @@
+"""Mesh-sharded serving (PATHWAY_TPU_MESH) — pins the kill switch.
+
+Three contracts, on the conftest's virtual 8-device CPU topology:
+
+* KILL SWITCH: flag off (mesh None) and flag on with a 1x1x1 mesh emit
+  BYTE-IDENTICAL serving tokens across the paged x spec x prefix grid —
+  NamedSharding on a single device is plain placement, so the whole
+  mesh machinery must be invisible until a real mesh exists.
+* MESH EQUALITY: on an 8-device ``(data=1, fsdp=2, tp=4)`` mesh, greedy
+  decode tokens match single-chip exactly (head-sharded paged-attention
+  via shard_map included), with per-device HBM accounting populated for
+  every mesh device.
+* CHECKPOINT RESHARDING: save-on-mesh -> load-on-host /
+  load-on-1x1x1 / load-on-8-mesh all gather back bitwise-equal params
+  (disk always holds fully gathered arrays; resharding is placement).
+
+Plus: ``answer_query``/QueryServer retrieval routes through the
+mesh-resident ``ShardedIvfIndex`` when the flag is on.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.internals.config import pathway_config
+from pathway_tpu.models import decoder as D
+from pathway_tpu.parallel.mesh import (
+    make_serving_mesh,
+    mesh_is_trivial,
+    serving_mesh_from_flags,
+    spec_dropping_nondividing,
+    spec_with_fsdp,
+)
+from tests.utils import ToyCharTokenizer
+
+from jax.sharding import PartitionSpec as P
+
+TINY = D.DecoderConfig(
+    vocab_size=128, hidden=32, layers=4, heads=4, intermediate=64,
+    max_position=128, dtype=jnp.float32,
+)
+N_SLOTS, CACHE_LEN, BLOCK = 4, 96, 16
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return D.init_params(jax.random.PRNGKey(0), TINY)
+
+
+def _mesh8():
+    """data=1 fsdp=2 tp=4 over the 8 virtual devices: heads=4,
+    intermediate=64 and vocab=128 all divide tp=4, and fsdp=2 exercises
+    the ZeRO-3 overlay axis."""
+    return make_serving_mesh(jax.devices(), data=1, fsdp=2, tp=4)
+
+
+def _mesh1():
+    """The 1x1x1 trivial mesh (flag ON, mesh degenerate)."""
+    return make_serving_mesh(jax.devices()[:1], data=1, fsdp=1, tp=1)
+
+
+# -- flag / helper units -----------------------------------------------------
+
+
+def test_mesh_flag_defaults_off():
+    assert pathway_config.mesh is False
+    assert serving_mesh_from_flags() is None
+
+
+def test_mesh_trivial_predicate():
+    assert mesh_is_trivial(None)
+    assert mesh_is_trivial(_mesh1())
+    assert not mesh_is_trivial(_mesh8())
+
+
+def test_spec_with_fsdp_overlays_first_divisible_dim():
+    assert spec_with_fsdp(P(None, "tp"), (6, 8), 2) == P("fsdp", "tp")
+    # no divisible unsharded dim -> unchanged (annotation never pads)
+    assert spec_with_fsdp(P(None, "tp"), (7, 8), 2) == P(None, "tp")
+    assert spec_with_fsdp(P("tp"), (8,), 1) == P("tp")
+
+
+def test_spec_dropping_nondividing_degrades_to_replicated():
+    mesh = _mesh8()  # tp=4, fsdp=2
+    assert spec_dropping_nondividing(P("tp", None), (8, 3), mesh) == \
+        P("tp", None)
+    # 30522 % 4 != 0 -> the vocab dim degrades, the rest survives
+    assert spec_dropping_nondividing(P("tp", None), (30522, 3), mesh) == \
+        P(None, None)
+    assert spec_dropping_nondividing(
+        P(("fsdp", "tp"), None), (16, 3), mesh
+    ) == P(("fsdp", "tp"), None)
+    assert spec_dropping_nondividing(
+        P(("fsdp", "tp"), None), (12, 3), mesh  # 12 % (2*4) != 0
+    ) == P(None, None)
+
+
+def test_decoder_mesh_validation_is_typed():
+    from pathway_tpu.parallel.mesh import MeshShapeError
+
+    mesh = make_serving_mesh(jax.devices(), data=1, fsdp=1, tp=8)
+    with pytest.raises(MeshShapeError):  # heads=4 cannot split 8 ways
+        D.validate_decoder_mesh(TINY, mesh)
+    D.validate_decoder_mesh(TINY, _mesh8())  # tp=4 divides everything
+
+
+# -- serving-level kill switch (paged x spec x prefix grid) ------------------
+
+
+PROMPTS = ["hello world", "mesh serving", "abc", "slot pool"]
+
+
+def _serve(tiny_params, prompts, **kw):
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    kw.setdefault("prefill_chunk", 8)
+    chat = TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(96),
+        max_new_tokens=8, temperature=0.0, max_prompt_tokens=96,
+        continuous=True, n_slots=4, chunk_steps=4, pipeline_depth=2,
+        **kw,
+    )
+    try:
+        out = []
+        for p in prompts:
+            r = chat.submit_batch([p])[0]
+            assert r.done.wait(timeout=180)
+            out.append(r.text)
+        return out
+    finally:
+        chat.close()
+
+
+@pytest.mark.parametrize("paged_kv", [False, True])
+@pytest.mark.parametrize("spec_decode", [False, True])
+@pytest.mark.parametrize("prefix_cache", [False, True])
+def test_trivial_mesh_serving_byte_identical(tiny_params, paged_kv,
+                                             spec_decode, prefix_cache):
+    """The kill-switch pin: a 1x1x1 mesh serves the exact token streams
+    of the mesh-off path across the paged x spec x prefix grid."""
+    kw = dict(paged_kv=paged_kv, spec_decode=spec_decode,
+              prefix_cache=prefix_cache)
+    baseline = _serve(tiny_params, PROMPTS, **kw)
+    on_mesh = _serve(tiny_params, PROMPTS, mesh=_mesh1(), **kw)
+    assert on_mesh == baseline
+
+
+# -- 8-device mesh decode equality (decoder level) ---------------------------
+
+
+def _full_table_pool(params, cfg, kv_quant=False):
+    """Paged pool whose table gives every slot a full row of DISTINCT
+    blocks (the gathered view is byte-for-byte a dense pool)."""
+    M = CACHE_LEN // BLOCK
+    pool = D.paged_pool_init(params, cfg, N_SLOTS, CACHE_LEN,
+                             n_blocks=N_SLOTS * M + 1, block=BLOCK,
+                             kv_quant=kv_quant)
+    tbl = 1 + np.arange(N_SLOTS * M, dtype=np.int32).reshape(N_SLOTS, M)
+    pool["block_tbl"] = jnp.asarray(tbl)
+    return pool
+
+
+def _admit(params, cfg, pool):
+    S = 16
+    rng = np.random.default_rng(3)
+    ids = np.zeros((N_SLOTS, S), np.int32)
+    mask = np.zeros((N_SLOTS, S), np.int32)
+    for r, n in enumerate([6, 10, 4, 8]):
+        ids[r, S - n:] = rng.integers(1, 97, n)
+        mask[r, S - n:] = 1
+    return D.pool_admit_batch(
+        params, jnp.asarray(ids), jnp.asarray(mask), pool,
+        jnp.arange(N_SLOTS, dtype=jnp.int32), cfg,
+    )
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_mesh8_paged_kernel_tokens_match_single_chip(tiny_params, kv_quant):
+    """Greedy paged-kernel decode on the 8-device mesh (params + pool
+    sharded, attention heads split tp-ways via shard_map) emits exactly
+    the single-chip token stream."""
+    act = jnp.ones((N_SLOTS,), bool)
+    key = jax.random.PRNGKey(1)
+    base_pool = _admit(tiny_params, TINY,
+                       _full_table_pool(tiny_params, TINY, kv_quant))
+    _, base_toks = D.pool_decode_chunk(
+        tiny_params, base_pool, act, key, TINY, 16, paged_kernel=True,
+    )
+
+    mesh = _mesh8()
+    params_sh = D.shard_decoder_params(tiny_params, TINY, mesh)
+    pool_sh = D.shard_pool(
+        _admit(tiny_params, TINY,
+               _full_table_pool(tiny_params, TINY, kv_quant)),
+        TINY, mesh,
+    )
+    out_pool, mesh_toks = D.pool_decode_chunk(
+        params_sh, pool_sh, act, key, TINY, 16, paged_kernel=True,
+        mesh=mesh,
+    )
+    np.testing.assert_array_equal(np.asarray(base_toks),
+                                  np.asarray(mesh_toks))
+    # the decode output pool kept its tp sharding (GSPMD propagated it)
+    kb_spec = out_pool["kb"].sharding.spec
+    assert "tp" in [ax for entry in kb_spec if entry
+                    for ax in ((entry,) if isinstance(entry, str)
+                               else entry)]
+
+
+def test_mesh8_pool_device_bytes_cover_all_devices(tiny_params):
+    """Per-device HBM accounting sees every mesh device, and the
+    tp-sharded KV planes are split (not replicated) across them."""
+    mesh = _mesh8()
+    pool = D.shard_pool(_full_table_pool(tiny_params, TINY), TINY, mesh)
+    per_dev = D.pool_component_device_bytes(pool)
+    kv = per_dev["kv_blocks"]
+    assert len(kv) == 8  # one entry per mesh device
+    total = D.pool_component_bytes(pool)["kv_blocks"]
+    tp = 4
+    for nbytes in kv.values():
+        assert nbytes == total // tp  # sharded tp-ways, replicated on fsdp
+
+
+def test_mesh8_serving_tokens_match_single_chip(tiny_params):
+    """End-to-end continuous serving on the real 8-device mesh matches
+    the single-chip transcript (greedy, paged pool + paged kernel)."""
+    kw = dict(paged_kv=True, paged_kernel=True)
+    baseline = _serve(tiny_params, PROMPTS, **kw)
+    on_mesh = _serve(tiny_params, PROMPTS, mesh=_mesh8(), **kw)
+    assert on_mesh == baseline
+
+    from pathway_tpu.engine.probes import hbm_stats
+
+    per_dev = hbm_stats()["per_device_bytes"]
+    assert set(per_dev) >= {str(i) for i in range(8)}
+    assert all(v > 0 for v in per_dev.values())
+
+
+# -- checkpoint resharding (satellite) ---------------------------------------
+
+
+def _flat_host(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return [np.asarray(x) for x in leaves]
+
+
+def test_checkpoint_reshard_roundtrip_bitwise(tiny_params, tmp_path):
+    """save-on-mesh -> load-on-host / load-on-1x1x1 / load-on-8-mesh:
+    every direction gathers back bitwise-equal params, and the layout
+    sidecar records the mesh + per-param specs."""
+    from pathway_tpu.models import checkpoint as C
+
+    mesh = _mesh8()
+    params_sh = D.shard_decoder_params(tiny_params, TINY, mesh)
+    path = str(tmp_path / "mesh_ckpt")
+    C.save_checkpoint(path, params_sh, mesh=mesh)
+
+    layout = C.checkpoint_layout(path)
+    assert layout["mesh"]["axes"] == ["data", "fsdp", "tp"]
+    assert layout["mesh"]["shape"] == [1, 2, 4]
+    assert any(s for s in layout["specs"].values())  # something sharded
+
+    want = _flat_host(tiny_params)
+
+    host = C.load_checkpoint(path)  # topology-free numpy pytree
+    for a, b in zip(_flat_host(host), want):
+        np.testing.assert_array_equal(a, b)
+
+    on_one = C.load_checkpoint(path, mesh=_mesh1())
+    for a, b in zip(_flat_host(on_one), want):
+        np.testing.assert_array_equal(a, b)
+
+    back_on_mesh = C.load_checkpoint(path, mesh=_mesh8())
+    for a, b in zip(_flat_host(back_on_mesh), want):
+        np.testing.assert_array_equal(a, b)
+    # the replayed placement is sharded again, not just replicated
+    wte = back_on_mesh["wte"]
+    assert not wte.sharding.is_fully_replicated
+
+
+def test_checkpoint_single_chip_save_loads_onto_mesh(tiny_params, tmp_path):
+    """The reverse direction: a single-chip checkpoint (no mesh at save
+    time) loads onto the 8-device mesh with explicit specs."""
+    from pathway_tpu.models import checkpoint as C
+
+    path = str(tmp_path / "chip_ckpt")
+    C.save_checkpoint(path, tiny_params)
+    assert C.checkpoint_layout(path)["mesh"] is None
+
+    mesh = _mesh8()
+    specs = D.param_mesh_specs(tiny_params, TINY, mesh)
+    loaded = C.load_checkpoint(path, mesh=mesh, specs=specs)
+    for a, b in zip(_flat_host(loaded), _flat_host(tiny_params)):
+        np.testing.assert_array_equal(a, b)
+    assert not loaded["wte"].sharding.is_fully_replicated
+
+
+# -- retrieval routes through the sharded index ------------------------------
+
+
+def test_ivf_factory_routes_to_sharded_index_under_mesh(monkeypatch):
+    from pathway_tpu.engine.probes import (
+        reset_retrieval_backend_stats,
+        retrieval_backend_stats,
+    )
+    from pathway_tpu.ops.ivf import IvfFlatIndex
+    from pathway_tpu.parallel.sharded_ivf import ShardedIvfIndex
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        _IvfIndexFactory,
+        _KnnIndexFactory,
+    )
+
+    monkeypatch.setenv("PATHWAY_TPU_MESH", "0")
+    assert isinstance(
+        _IvfIndexFactory(16, 8, 8, "cos", None).make_instance(),
+        IvfFlatIndex,
+    )
+
+    monkeypatch.setenv("PATHWAY_TPU_MESH", "1")
+    reset_retrieval_backend_stats()
+    idx = _IvfIndexFactory(16, 8, 8, "cos", None).make_instance()
+    assert isinstance(idx, ShardedIvfIndex)
+    # the brute-force factory routes too (exhaustive probing: recall 1.0)
+    assert isinstance(
+        _KnnIndexFactory(16, 64, "cos").make_instance(), ShardedIvfIndex
+    )
+
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(40, 16)).astype(np.float32)
+    idx.add(list(range(40)), vecs)
+    res = idx.search(vecs[:3], 5)
+    assert [row[0][0] for row in res] == [0, 1, 2]  # self-hits
+    assert retrieval_backend_stats().get("sharded_ivf", 0) >= 3
+
+
+def test_query_server_retrieval_hits_sharded_index(monkeypatch):
+    """The QueryServer/answer_query product path: under the mesh flag
+    the fused pipeline mirrors its corpus into the sharded IVF and
+    plain retrieval answers from it — same hits as the dense scan."""
+    monkeypatch.setenv("PATHWAY_TPU_MESH", "1")
+    from pathway_tpu.engine.probes import (
+        reset_retrieval_backend_stats,
+        retrieval_backend_stats,
+    )
+    from pathway_tpu.models import SentenceEmbedderModel
+    from pathway_tpu.ops.fused_query import FusedRAGPipeline
+    from pathway_tpu.ops.query_server import QueryServer
+    from pathway_tpu.parallel.sharded_ivf import ShardedIvfIndex
+
+    reset_retrieval_backend_stats()
+    emb = SentenceEmbedderModel(max_length=32)
+    pipe = FusedRAGPipeline(emb, None, reserved_space=16, doc_seq=16,
+                            pair_seq=64)
+    assert isinstance(pipe.sharded_index, ShardedIvfIndex)
+
+    words = ["alpha", "beta", "gamma", "delta", "stream", "tensor"]
+    rng = np.random.default_rng(3)
+    docs = [" ".join(rng.choice(words, 8)) for _ in range(12)]
+    pipe.add([f"d{i}" for i in range(len(docs))], docs)
+    assert len(pipe.sharded_index) == len(docs)
+
+    server = QueryServer(pipe)
+    try:
+        hits = server.query("alpha stream tensor", 3)
+    finally:
+        server.shutdown()
+    assert len(hits) == 3
+    # identical hits to the dense staged scan (exhaustive probing)
+    qv = pipe.embedder.embed_batch(["alpha stream tensor"])
+    (dense,) = pipe.index.search(qv, k=3)
+    assert [k for k, _ in hits] == [k for k, _ in dense]
+    assert retrieval_backend_stats().get("sharded_ivf", 0) >= 1
+
+    pipe.remove(["d0"])
+    assert len(pipe.sharded_index) == len(docs) - 1

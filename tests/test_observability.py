@@ -319,6 +319,10 @@ def test_kill_switch_byte_identical_outputs(tiny_params, monkeypatch):
 
 def test_serving_panel_renders_from_registry():
     probes.REGISTRY.reset()
+    # the panel also reads the host-side HBM / retrieval ledgers, which
+    # earlier tests in the process may have populated
+    probes.reset_hbm_stats()
+    probes.reset_retrieval_backend_stats()
     monitor = StatsMonitor(SchedulerStats(), MonitoringLevel.ALL)
     assert monitor._serving_panel() is None  # nothing recorded yet
     probes.record_prefix("requests", 4)
@@ -337,6 +341,8 @@ def test_serving_panel_renders_from_registry():
 
     assert isinstance(monitor._render_dashboard(), Group)
     probes.REGISTRY.reset()
+    probes.reset_hbm_stats()
+    probes.reset_retrieval_backend_stats()
     assert monitor._serving_panel() is None
     # with no serving data the dashboard is just the operator table
     assert not isinstance(monitor._render_dashboard(), Group)

@@ -158,6 +158,62 @@ def f(x):
     assert analyze_source(src) == []
 
 
+def test_gl101_shard_map_boundary_is_a_root():
+    """A shard_map-mapped function traces under the SPMD per-shard view;
+    host effects inside it are the same bug as inside jax.jit."""
+    src = """
+import jax
+
+def mapped(x):
+    print(x)
+    return x
+
+def outer(mesh, x, specs):
+    return jax.shard_map(
+        mapped, mesh=mesh, in_specs=specs, out_specs=specs
+    )(x)
+"""
+    found = analyze_source(src)
+    assert _rules(found) == ["GL101"]
+    assert found[0].symbol == "mapped"
+
+
+def test_gl101_compat_shard_map_alias_is_a_root():
+    """The repo's version shim (any from-import alias) is the same
+    trace boundary."""
+    src = """
+from pathway_tpu.parallel.mesh import compat_shard_map as shard_map
+
+def mapped(x):
+    print(x)
+    return x
+
+def outer(mesh, x, specs):
+    return shard_map(
+        mapped, mesh=mesh, in_specs=specs, out_specs=specs
+    )(x)
+"""
+    found = analyze_source(src)
+    assert _rules(found) == ["GL101"]
+    assert found[0].symbol == "mapped"
+
+
+def test_gl101_clean_shard_map_body():
+    src = """
+import jax
+import jax.numpy as jnp
+
+def mapped(x):
+    return jnp.sum(x) + jax.lax.axis_index("tp")
+
+def outer(mesh, x, specs):
+    return jax.shard_map(
+        mapped, mesh=mesh, in_specs=specs, out_specs=specs
+    )(x)
+"""
+    assert analyze_source(src) == []
+
+
 # ------------------------------------------------------------------ GL201
 
 
