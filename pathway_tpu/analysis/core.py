@@ -95,6 +95,13 @@ RULES: dict[str, Rule] = {
             "existing test file that references the env var.",
         ),
         Rule(
+            "GL302", "kill-switch-pin-prose-only",
+            "Kill switch's `pinned_by` test mentions the env var only in "
+            "docstrings/comments — the test must use the literal in code "
+            "(a setenv argument, parametrize entry, env dict key), or "
+            "the pin is prose, not a test.",
+        ),
+        Rule(
             "GL401", "lock-unguarded-access",
             "Access to a `guarded_by`-declared field outside a `with "
             "<lock>:` block (and not in `__init__` or an "

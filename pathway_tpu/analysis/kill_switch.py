@@ -9,10 +9,17 @@ live: the named test file must exist and must actually reference the
 env var (a renamed or deleted pinning test un-pins the switch and fails
 the analyzer, not a human's memory).
 
+**GL302** tightens the reference requirement: the env var must appear in
+the pinning test's *code* — a string literal outside docstrings (a
+``monkeypatch.setenv`` arg, a parametrize id, an env dict key). A
+mention that lives only in a docstring or comment satisfies GL301's
+substring scan while pinning nothing; GL302 catches exactly that
+drift.
+
 Registry-wide by nature: runs only on full-package scans (needs
 ``internals/config.py`` in the scanned set). Unit tests drive
-:func:`check_kill_switches` directly with synthetic registries and a
-tmp_path tests tree.
+:func:`check_kill_switches` / :func:`check_pinning_refs` directly with
+synthetic registries and a tmp_path tests tree.
 """
 
 from __future__ import annotations
@@ -53,6 +60,72 @@ def check_kill_switches(flags, repo_root: str) -> list[tuple[str, str]]:
     return problems
 
 
+def _code_strings(source: str) -> list[str]:
+    """Every string literal in ``source`` that is NOT a docstring.
+
+    Comments never reach the AST and module/class/function docstrings are
+    the leading ``Expr``-statement constants of their bodies — everything
+    left is a literal the code actually uses (a ``setenv`` argument, a
+    parametrize list entry, an env dict key, ...).
+    """
+    tree = ast.parse(source)
+    doc_nodes: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(
+            node,
+            (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
+        ):
+            body = node.body
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                doc_nodes.add(id(body[0].value))
+    out: list[str] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and id(node) not in doc_nodes
+        ):
+            out.append(node.value)
+    return out
+
+
+def check_pinning_refs(flags, repo_root: str) -> list[tuple[str, str]]:
+    """``[(env, problem), ...]`` for every kill switch whose pinning test
+    mentions the env var ONLY in prose (docstrings/comments) — a
+    reference GL301's substring scan accepts but which pins nothing."""
+    problems: list[tuple[str, str]] = []
+    for flag in flags:
+        if not getattr(flag, "kill_switch", False):
+            continue
+        pinned_by = getattr(flag, "pinned_by", None)
+        if not pinned_by:
+            continue  # GL301's finding; nothing further to refine
+        full = os.path.join(repo_root, pinned_by)
+        if not os.path.exists(full):
+            continue  # GL301's finding
+        with open(full, encoding="utf-8") as f:
+            source = f.read()
+        if flag.env not in source:
+            continue  # GL301's finding
+        try:
+            strings = _code_strings(source)
+        except SyntaxError:
+            continue  # unparseable test file fails loudly elsewhere
+        if not any(flag.env in s for s in strings):
+            problems.append(
+                (flag.env,
+                 f"pinned_by `{pinned_by}` mentions `{flag.env}` only in "
+                 "docstrings/comments — the test must use the env var in "
+                 "code (setenv / parametrize / env dict)")
+            )
+    return problems
+
+
 def run(ctx: PackageCtx) -> list[Finding]:
     config = ctx.module(CONFIG_PATH)
     if config is None or not ctx.registry_checks:
@@ -60,10 +133,13 @@ def run(ctx: PackageCtx) -> list[Finding]:
     from pathway_tpu.internals.config import FLAG_REGISTRY
 
     findings: list[Finding] = []
-    for env, problem in check_kill_switches(FLAG_REGISTRY, ctx.repo_root):
-        line = _registry_line(config, env)
-        node = ast.Constant(value=env)
-        node.lineno = line
-        config.emit(findings, "GL301", node,
-                    f"`{env}`: {problem}", env)
+    for rule, checker in (
+        ("GL301", check_kill_switches),
+        ("GL302", check_pinning_refs),
+    ):
+        for env, problem in checker(FLAG_REGISTRY, ctx.repo_root):
+            line = _registry_line(config, env)
+            node = ast.Constant(value=env)
+            node.lineno = line
+            config.emit(findings, rule, node, f"`{env}`: {problem}", env)
     return findings

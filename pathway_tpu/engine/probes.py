@@ -867,15 +867,17 @@ def reset_retrieval_backend_stats() -> None:
 #
 # The cascade's whole point is skipped compute, so the ledger counts what
 # each stage actually paid: pairs scored and model FLOPs per stage
-# (``cheap`` = truncated-depth pass over all k candidates, ``full`` =
+# (``cheap`` = truncated-depth pass over all k candidates, ``maxsim`` =
+# late-interaction MaxSim over the ingest-time token banks, ``full`` =
 # full-depth pass over survivors only). ``cascade_stats()['survivor_rate']``
 # is the fraction of candidates that reached the full pass — the knob the
-# quality/latency trade hangs on.
+# quality/latency trade hangs on — and the per-stage FLOPs expose the
+# cheap-stage pair-FLOPs collapse when MaxSim replaces the encoder pass.
 
 def record_cascade(stage: str, pairs: int, flops: float = 0.0) -> None:
     """Account ``pairs`` scored (and model ``flops`` paid) by cascade
-    ``stage`` (``cheap`` / ``full``). Thread-safe; called per dispatch by
-    the fused query path."""
+    ``stage`` (``cheap`` / ``maxsim`` / ``full``). Thread-safe; called
+    per dispatch by the fused query path."""
     REGISTRY.counter_add("cascade_pairs", pairs, stage=stage)
     if flops:
         REGISTRY.counter_add("cascade_flops", flops, stage=stage)
@@ -883,13 +885,14 @@ def record_cascade(stage: str, pairs: int, flops: float = 0.0) -> None:
 
 def cascade_stats() -> dict:
     """Snapshot: per-stage pairs + FLOPs, and the survivor rate (full-pass
-    pairs / cheap-pass pairs; 1.0 when the cascade never ran — every
-    candidate 'survived' into the only pass there was)."""
+    pairs / first-stage pairs, with ``cheap`` and ``maxsim`` both counting
+    as a first stage; 1.0 when the cascade never ran — every candidate
+    'survived' into the only pass there was)."""
     pairs = {
         k: int(v) for k, v in REGISTRY.labelled("cascade_pairs", "stage").items()
     }
     flops = REGISTRY.labelled("cascade_flops", "stage")
-    cheap = pairs.get("cheap", 0)
+    cheap = pairs.get("cheap", 0) + pairs.get("maxsim", 0)
     full = pairs.get("full", 0)
     rate = (full / cheap) if cheap else 1.0
     return {

@@ -525,6 +525,29 @@ FLAG_REGISTRY: list[Flag] = [
             "the cheap-stage score.",
     ),
     Flag(
+        env="PATHWAY_TPU_LATE_INTERACTION", kind="bool", default=False,
+        kill_switch=True, pinned_by="tests/test_late_interaction.py",
+        attr="late_interaction", group="query",
+        doc="Late-interaction MaxSim cheap stage over the ingest-time "
+            "compressed doc-token bank (int8 payloads, `LATE_DIM` per "
+            "token). `0` keeps the truncated-encoder cheap pass "
+            "(bitwise with the current cascade).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_LATE_DIM", kind="int", default=32,
+        attr="late_dim", group="query", minimum=8,
+        doc="Compressed per-token dimension of the late-interaction "
+            "doc bank — the width MaxSim dots query tokens against.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_LLM_RERANK", kind="bool", default=False,
+        kill_switch=True, pinned_by="tests/test_late_interaction.py",
+        attr="llm_rerank", group="query",
+        doc="Listwise LLM rerank over cascade survivors (RankLLM-style "
+            "sliding window served by the continuous decoder). `0` "
+            "returns the cross-encoder order untouched.",
+    ),
+    Flag(
         env="PATHWAY_TPU_QUERY_TICK_MS", kind="float", default=2.0,
         attr="query_tick_ms", group="query", minimum=0,
         doc="Micro-batch window: how long the first queued query waits "

@@ -104,6 +104,19 @@ def _embed_fn_packed(params, packed, cfg: TransformerConfig):
     return embed_fn(params, packed[0], packed[1], cfg)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _token_states_packed(params, packed, proj, cfg: TransformerConfig):
+    """Token-level sibling of :func:`_embed_fn_packed` for the
+    late-interaction doc bank: same fused single-transfer input, but the
+    executable keeps PER-TOKEN states — full-depth encode, project to the
+    compressed dc dim, L2-normalize, int8 per-token quant — instead of
+    pooling. Returns ``(payload int8 (B, S, dc), scale f32 (B, S, 1))``."""
+    from pathway_tpu.ops.late_bank import _project_tokens, _quant_tokens
+
+    hidden = encode(params, packed[0], packed[1], cfg)
+    return _quant_tokens(_project_tokens(hidden, packed[1], proj))
+
+
 class _PendingEmbed:
     """Handle returned by the pipelined ``embed_submit``: tokenize and
     dispatch run on background stage workers; :meth:`wait` blocks until
@@ -162,18 +175,24 @@ class _IngestPipeline:
             self._tokenize_one, maxsize=queue_bound, name="pathway-tpu:embed-tokenize"
         )
 
-    def submit(self, texts: list[str]) -> _PendingEmbed:
+    def submit(self, texts: list[str], kind: str = "embed",
+               dc: int = 0) -> _PendingEmbed:
+        """Queue a batch for the stage chain. ``kind="embed"`` (default)
+        is the pooled-vector path; ``kind="tokens"`` keeps per-token
+        states for the late-interaction doc bank (``dc`` = compressed
+        token dim) — same tokenize/h2d/dispatch workers, different
+        executable at the dispatch stage."""
         from pathway_tpu.engine import tracing
 
         handle = _PendingEmbed()
         handle.span = tracing.start_span(
             "embed", server=self._trace_tag, texts=len(texts),
         )
-        self._tokenize.submit((texts, handle))
+        self._tokenize.submit((texts, handle, kind, dc))
         return handle
 
     def _tokenize_one(self, item) -> None:
-        texts, handle = item
+        texts, handle, kind, dc = item
         try:
             model = self._model
             t0 = time.perf_counter()
@@ -189,10 +208,10 @@ class _IngestPipeline:
             return
         # blocks while `depth` batches are staged/dispatched ahead — the
         # backpressure that keeps input buffers ping-ponging
-        self._dispatch.submit((ids, mask, len(texts), handle))
+        self._dispatch.submit((ids, mask, len(texts), handle, kind, dc))
 
     def _dispatch_one(self, item) -> None:
-        ids, mask, n, handle = item
+        ids, mask, n, handle, kind, dc = item
         try:
             if self._retries > 0:
                 from pathway_tpu.internals.udfs.retries import (
@@ -203,16 +222,19 @@ class _IngestPipeline:
                     max_retries=self._retries, initial_delay=20,
                     backoff_factor=2, jitter_ms=10, max_delay_ms=1000,
                 ).invoke_sync(
-                    lambda: self._stage_and_dispatch(ids, mask, n, handle)
+                    lambda: self._stage_and_dispatch(
+                        ids, mask, n, handle, kind, dc
+                    )
                 )
             else:
-                self._stage_and_dispatch(ids, mask, n, handle)
+                self._stage_and_dispatch(ids, mask, n, handle, kind, dc)
         except BaseException as exc:  # noqa: BLE001 - surfaces at resolve
             handle._error = exc
             handle.span.finish(error=True)
         handle._event.set()
 
-    def _stage_and_dispatch(self, ids, mask, n, handle) -> None:
+    def _stage_and_dispatch(self, ids, mask, n, handle, kind="embed",
+                            dc=0) -> None:
         from pathway_tpu.internals.config import pathway_config
 
         if self._chaos_h2d is not None:
@@ -230,16 +252,35 @@ class _IngestPipeline:
         t1 = time.perf_counter()
         record_stage("h2d", t1 - t0)
         handle.span.event("h2d")
-        if fused:
-            out = _embed_fn_packed(model.params, dev_packed, model.cfg)
+        if kind == "tokens":
+            proj = model.late_projection_matrix(dc)
+            if fused:
+                out = _token_states_packed(
+                    model.params, dev_packed, proj, model.cfg
+                )
+            else:
+                from pathway_tpu.ops.late_bank import doc_token_states
+
+                out = doc_token_states(
+                    model.params, dev_ids, dev_mask, proj, model.cfg
+                )
+            record_device_dispatch("token_bank_dispatch")
+            # int8 payload + f32 scales: already transport-compact, no
+            # precision cast needed before the drain
         else:
-            out = _embed_fn_donated(model.params, dev_ids, dev_mask, model.cfg)
-        record_device_dispatch("embed_dispatch")
-        out = out.astype(jnp.float16)
-        try:
-            out.copy_to_host_async()
-        except Exception:  # noqa: BLE001 - platform-optional fast path
-            pass
+            if fused:
+                out = _embed_fn_packed(model.params, dev_packed, model.cfg)
+            else:
+                out = _embed_fn_donated(
+                    model.params, dev_ids, dev_mask, model.cfg
+                )
+            record_device_dispatch("embed_dispatch")
+            out = out.astype(jnp.float16)
+        for leaf in jax.tree.leaves(out):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - platform-optional fast path
+                pass
         record_stage("dispatch", time.perf_counter() - t1)
         handle.span.event("dispatch", rows=n)
         handle._value = (out, n)
@@ -287,6 +328,7 @@ class SentenceEmbedderModel:
             self.params = shard_encoder_params(self.params, cfg, self.mesh)
         self._pipeline: _IngestPipeline | None = None
         self._pipeline_lock = threading.Lock()
+        self._late_proj = None  # (hidden, dc), built at first token submit
 
     def _maybe_pipeline(self) -> _IngestPipeline | None:
         """The shared ingest pipeline, lazily built — or None when
@@ -418,6 +460,67 @@ class SentenceEmbedderModel:
         return [
             _renorm(np.asarray(o)[:n].astype(np.float32))
             for o, (_, n) in zip(fetched, resolved)
+        ]
+
+    # -- token-level path: per-token states for the late-interaction bank --
+    def late_projection_matrix(self, dc: int | None = None):
+        """The shared ``(hidden, dc)`` down-projection (deterministic, so
+        ingest-time bank rows and query-time token states agree without a
+        checkpoint). ``dc`` defaults to ``PATHWAY_TPU_LATE_DIM``; cached
+        per width."""
+        from pathway_tpu.internals.config import pathway_config
+        from pathway_tpu.ops.late_bank import late_projection
+
+        dc = int(dc) if dc else int(pathway_config.late_dim)
+        if self._late_proj is None or self._late_proj.shape[1] != dc:
+            self._late_proj = late_projection(self.cfg.hidden, dc)
+        return self._late_proj
+
+    def token_bank_submit(self, texts: list[str], dc: int | None = None):
+        """Dispatch-only token-state encode for the late-interaction doc
+        bank: full-depth encode -> project to ``dc`` -> L2-normalize ->
+        int8 per-token quant, one fused executable per batch. Rides the
+        same StageWorker ingest pipeline as :meth:`embed_submit`
+        (tokenize / h2d / dispatch overlap across batches); resolve via
+        :meth:`token_bank_resolve`."""
+        proj = self.late_projection_matrix(dc)
+        pipe = self._maybe_pipeline()
+        if pipe is not None:
+            return pipe.submit(texts, kind="tokens", dc=proj.shape[1])
+        from pathway_tpu.ops.late_bank import doc_token_states
+
+        ids, mask = self.tokenizer(texts, max_length=self.max_length)
+        ids, mask = pad_to_buckets(ids, mask)
+        out = doc_token_states(
+            self.params, jnp.asarray(ids), jnp.asarray(mask), proj, self.cfg
+        )
+        record_device_dispatch("token_bank_dispatch")
+        for leaf in jax.tree.leaves(out):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - platform-optional fast path
+                pass
+        return (out, len(texts))
+
+    def token_bank_resolve(self, handles) -> list[tuple[np.ndarray, np.ndarray]]:
+        """One device drain for submitted token-bank handles ->
+        ``[(payload int8 (n, S, dc), scale f32 (n, S, 1))]`` per handle,
+        sliced back to real row counts. Accepts pipelined and serial
+        handles interchangeably, like :meth:`embed_resolve`."""
+        resolved = [
+            h.wait() if isinstance(h, _PendingEmbed) else h for h in handles
+        ]
+        t0 = time.perf_counter()
+        fetched = jax.device_get([out for out, _ in resolved])
+        record_device_dispatch("token_bank_drain")
+        record_stage("drain", time.perf_counter() - t0)
+        for h in handles:
+            if isinstance(h, _PendingEmbed):
+                h.span.event("drain")
+                h.span.finish()
+        return [
+            (np.asarray(q)[:n], np.asarray(s)[:n])
+            for (q, s), (_, n) in zip(fetched, resolved)
         ]
 
     def __call__(self, texts: list[str]) -> np.ndarray:

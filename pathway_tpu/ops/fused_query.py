@@ -28,11 +28,19 @@ import jax.numpy as jnp
 
 from pathway_tpu.engine.probes import record_cascade, record_device_dispatch
 from pathway_tpu.internals.config import pathway_config
-from pathway_tpu.models.embedder import embed_fn
+from pathway_tpu.models.embedder import embed_fn, mean_pool
 from pathway_tpu.models.tokenizer import PAD_ID, SEP_ID
 from pathway_tpu.models.transformer import TransformerConfig, encode
 from pathway_tpu.ops import next_pow2
 from pathway_tpu.ops.knn import BruteForceKnnIndex, knn_scores, topk_scores
+from pathway_tpu.ops.late_bank import (
+    doc_token_states,
+    late_projection,
+    maxsim_flops,
+    maxsim_scores,
+    projection_flops,
+    query_token_states,
+)
 
 _NEG_INF = -1e30
 
@@ -248,6 +256,76 @@ def _fused_retrieve_rerank_cascade(e_params, q_ids, q_mask, corpus, valid,
     return scores, idx, r_scores, order
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "e_cfg", "r_cfg", "k", "metric", "pair_seq", "keep", "seed_weight",
+    ),
+)
+def _fused_retrieve_maxsim_cascade(e_params, q_ids, q_mask, corpus, valid,
+                                   doc_tokens, doc_lens, bank_q, bank_scale,
+                                   late_proj, r_params, r_head,
+                                   e_cfg: TransformerConfig,
+                                   r_cfg: TransformerConfig,
+                                   k: int, metric: str, pair_seq: int,
+                                   keep: int, seed_weight: float):
+    """Late-interaction cascade, still ONE dispatch: the cheap stage is
+    MaxSim over the candidates' ingest-time token banks instead of a
+    truncated encoder pass, so it pays one (S, dc) x (dc, T) gemm per
+    candidate — no query-time encoder FLOPs at all for the cascaded-out
+    rest. The query encodes ONCE: the same token states feed the pooled
+    retrieval embedding and the projected query tokens MaxSim dots
+    against. Survivor selection, the full-depth pass and the order
+    construction are IDENTICAL to :func:`_fused_retrieve_rerank_cascade`
+    (the two kernels differ only in where ``cheap`` comes from).
+
+    Returns (knn_scores (Qb, k), idx (Qb, k), rerank_scores (Qb, k),
+    order (Qb, k)) with the same survivors-first contract."""
+    hidden = encode(e_params, q_ids, q_mask, e_cfg)           # (Qb, S, H)
+    pooled = mean_pool(hidden, q_mask)
+    emb = pooled / jnp.clip(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9, None
+    )
+    scores, idx = topk_scores(
+        knn_scores(corpus, valid, emb, metric), k
+    )                                                         # (Qb, k)
+    d_tok = jnp.take(doc_tokens, idx, axis=0)                 # (Qb, k, dseq)
+    d_len = jnp.take(doc_lens, idx)                           # (Qb, k)
+    q_len = jnp.sum(q_mask, axis=1).astype(jnp.int32)
+    pair, mask, ttype = jax.vmap(
+        functools.partial(_assemble_pairs, pair_seq=pair_seq)
+    )(q_ids, q_len, d_tok, d_len)
+    q_tok = query_token_states(hidden, q_mask, late_proj)     # (Qb, S, dc)
+    b_q = jnp.take(bank_q, idx, axis=0)                       # (Qb, k, T, dc)
+    b_s = jnp.take(bank_scale, idx, axis=0)                   # (Qb, k, T, 1)
+    cheap = maxsim_scores(q_tok, q_mask, b_q, b_s, d_len)     # (Qb, k)
+    # seed with the ranking signal retrieval already paid for
+    cheap = cheap + jnp.float32(seed_weight) * scores.astype(jnp.float32)
+    cheap = jnp.where(scores <= _NEG_INF / 2, _NEG_INF, cheap)
+    qb = q_ids.shape[0]
+    flat = lambda a, n: a.reshape(qb * n, pair_seq)  # noqa: E731
+    _, surv = jax.lax.top_k(cheap, keep)                      # (Qb, keep)
+    gather = lambda a: jnp.take_along_axis(  # noqa: E731
+        a, surv[:, :, None], axis=1
+    )
+    full = _pair_scores(
+        r_params, r_head,
+        flat(gather(pair), keep), flat(gather(mask), keep),
+        flat(gather(ttype), keep), r_cfg,
+    ).reshape(qb, keep)
+    surv_knn = jnp.take_along_axis(scores, surv, axis=1)
+    full = jnp.where(surv_knn <= _NEG_INF / 2, _NEG_INF, full)
+    rows = jnp.arange(qb)[:, None]
+    r_scores = cheap.at[rows, surv].set(full)
+    surv_sorted = jnp.take_along_axis(surv, jnp.argsort(-full, axis=1), axis=1)
+    # survivor slots drop to -inf, STRICTLY below the _NEG_INF of padded
+    # candidates (same permutation guarantee as the encoder cascade)
+    rest = cheap.at[rows, surv].set(-jnp.inf)
+    rest_order = jnp.argsort(-rest, axis=1)
+    order = jnp.concatenate([surv_sorted, rest_order[:, : k - keep]], axis=1)
+    return scores, idx, r_scores, order
+
+
 class FusedRAGPipeline:
     """HBM-resident retrieval (+ optional rerank) with one-dispatch queries.
 
@@ -257,10 +335,16 @@ class FusedRAGPipeline:
     stream of queries can pipeline dispatches and drain once."""
 
     def __init__(self, embedder, reranker=None, *,
+                 llm_reranker=None,
                  reserved_space: int = 1024, metric: str = "cos",
                  doc_seq: int = 96, pair_seq: int = 160):
         self.embedder = embedder          # SentenceEmbedderModel
         self.reranker = reranker          # CrossEncoderModel | None
+        # optional listwise LLM final stage (PATHWAY_TPU_LLM_RERANK):
+        # reorders cascade survivors host-side after the fused dispatch
+        # resolves; doc texts are kept host-side for its prompts
+        self.llm_reranker = llm_reranker  # ListwiseLLMReranker | None
+        self._text_by_key: dict = {}
         self.metric = metric
         self.doc_seq = doc_seq
         self.pair_seq = pair_seq
@@ -311,6 +395,18 @@ class FusedRAGPipeline:
         # bucket is computable without a device round trip; monotone (not
         # lowered on remove) so it stays a safe upper bound
         self._max_doc_len = 0
+        # late-interaction doc-token bank (PATHWAY_TPU_LATE_INTERACTION):
+        # int8 per-token states + f32 scales, device-resident next to the
+        # corpus. Allocated lazily at the first add/query with the flag
+        # on — flag-off pipelines pay zero HBM — and dc freezes at that
+        # first allocation. `_bank_valid` (host) tracks which slots hold
+        # a current bank row, so rows ingested with the flag off backfill
+        # lazily at query time instead of silently scoring garbage.
+        self._bank_q = None       # (cap, doc_seq, dc) int8
+        self._bank_scale = None   # (cap, doc_seq, 1) f32
+        self._bank_valid = None   # (cap,) bool, host
+        self._late_proj = None    # (H, dc) f32, shared ingest/query
+        self._late_dim = 0
 
     # ------------------------------------------------------------- ingest
     def _doc_token_rows(self, texts: list[str]):
@@ -346,6 +442,8 @@ class FusedRAGPipeline:
             self._doc_tokens = jnp.pad(self._doc_tokens, ((0, grow), (0, 0)))
             self._doc_lens = jnp.pad(self._doc_lens, (0, grow))
         ids, lens = self._doc_token_rows(list(texts))
+        if self.llm_reranker is not None:
+            self._text_by_key.update(zip(keys, texts))
         if lens.size:
             self._max_doc_len = max(self._max_doc_len, int(lens.max()))
         self._doc_tokens = jax.lax.dynamic_update_slice(
@@ -354,6 +452,24 @@ class FusedRAGPipeline:
         self._doc_lens = jax.lax.dynamic_update_slice(
             self._doc_lens, jnp.asarray(lens), (start,)
         )
+        if pathway_config.late_interaction or self._bank_q is not None:
+            self._late_alloc()
+            if pathway_config.late_interaction:
+                # ingest-time bank build: ONE fused full-depth encode per
+                # batch; queries will only ever gather + dequantize
+                bq, bs = self._late_bank_rows(ids, lens)
+                self._bank_q = jax.lax.dynamic_update_slice(
+                    self._bank_q, bq, (start, 0, 0)
+                )
+                self._bank_scale = jax.lax.dynamic_update_slice(
+                    self._bank_scale, bs, (start, 0, 0)
+                )
+                self._bank_valid[start:start + len(lens)] = True
+            else:
+                # flag flipped off mid-stream: new rows backfill on the
+                # next late-interaction query
+                self._bank_valid[start:start + len(lens)] = False
+            self._record_late_bank()
         if self.sharded_index is not None:
             # mirror the just-embedded rows into the sharded IVF (slot
             # map, not [start:start+n] — upserts may have moved rows)
@@ -411,6 +527,122 @@ class FusedRAGPipeline:
             _encoder_flops(r_cfg, pair_seq, r_cfg.layers, qb * keep),
         )
 
+    # ------------------------------------------- late-interaction bank
+    def _late_alloc(self) -> None:
+        """Allocate the bank (first use) or grow it alongside the index's
+        capacity doublings, keeping slot alignment with ``_doc_tokens``."""
+        if self._bank_q is None:
+            self._late_dim = int(pathway_config.late_dim)
+            self._late_proj = late_projection(
+                self.embedder.cfg.hidden, self._late_dim
+            )
+            cap = self.index.capacity
+            self._bank_q = jnp.zeros(
+                (cap, self.doc_seq, self._late_dim), dtype=jnp.int8
+            )
+            self._bank_scale = jnp.zeros(
+                (cap, self.doc_seq, 1), dtype=jnp.float32
+            )
+            self._bank_valid = np.zeros((cap,), dtype=bool)
+            return
+        if self.index.capacity != self._bank_q.shape[0]:
+            grow = self.index.capacity - self._bank_q.shape[0]
+            self._bank_q = jnp.pad(self._bank_q, ((0, grow), (0, 0), (0, 0)))
+            self._bank_scale = jnp.pad(
+                self._bank_scale, ((0, grow), (0, 0), (0, 0))
+            )
+            self._bank_valid = np.pad(self._bank_valid, (0, grow))
+
+    def _late_bank_rows(self, ids: np.ndarray, lens: np.ndarray):
+        """Bank rows for a batch of already-tokenized docs: ONE fused
+        encode->project->quant dispatch. Rows pad to the pow2 bucket so
+        ingest batch sizes reuse executables; the doc-token width stays
+        exactly ``doc_seq`` (the bank's storage width)."""
+        rows = ids.shape[0]
+        rb = next_pow2(max(rows, 1), 1)
+        ids_p = np.zeros((rb, self.doc_seq), dtype=np.int32)
+        ids_p[:rows] = ids
+        # empty docs keep one live (PAD) position: an all-masked row
+        # would NaN the encoder softmax; d_len=0 hides it from MaxSim
+        live = np.maximum(lens, 1)
+        mask_p = np.zeros((rb, self.doc_seq), dtype=np.int32)
+        mask_p[:rows] = (
+            np.arange(self.doc_seq)[None, :] < live[:, None]
+        ).astype(np.int32)
+        record_device_dispatch("late_bank_build")
+        bq, bs = doc_token_states(
+            self.embedder.params, jnp.asarray(ids_p), jnp.asarray(mask_p),
+            self._late_proj, self.embedder.cfg,
+        )
+        return bq[:rows], bs[:rows]
+
+    def _ensure_late_bank(self) -> None:
+        """Backfill bank rows for live slots ingested while the flag was
+        off (or before this pipeline ran late-interaction at all), in
+        bounded batches — each one fused dispatch. After this every live
+        slot's bank row is current."""
+        self._late_alloc()
+        n = self.index.n
+        missing = np.flatnonzero(~self._bank_valid[:n])
+        if not missing.size:
+            return
+        for i in range(0, missing.size, 256):
+            sl = missing[i:i + 256]
+            dev_sl = jnp.asarray(sl)
+            ids = np.asarray(jnp.take(self._doc_tokens, dev_sl, axis=0))
+            lens = np.asarray(jnp.take(self._doc_lens, dev_sl))
+            bq, bs = self._late_bank_rows(ids, lens)
+            self._bank_q = self._bank_q.at[dev_sl].set(bq)
+            self._bank_scale = self._bank_scale.at[dev_sl].set(bs)
+            self._bank_valid[sl] = True
+        self._record_late_bank()
+
+    def _record_late_bank(self) -> None:
+        """Record the bank's LIVE footprint on the HBM ledger, per device
+        (``late_bank`` component). Live rows, not allocated capacity, so
+        retraction visibly lowers the gauge — the same observable the
+        retraction/compaction tests pin."""
+        from pathway_tpu.engine.probes import record_hbm
+        from pathway_tpu.models.decoder import _device_bytes
+
+        cap = self._bank_q.shape[0]
+        live = int(self._bank_valid.sum())
+        per_dev: dict[str, int] = {}
+        for arr in (self._bank_q, self._bank_scale):
+            for dev, nb in _device_bytes(arr).items():
+                per_dev[dev] = per_dev.get(dev, 0) + nb
+        frac = (live / cap) if cap else 0.0
+        for dev, nb in per_dev.items():
+            record_hbm("late_bank", int(nb * frac), device=dev)
+
+    def _maxsim_args(self, arrays):
+        """Interleave the bank arrays into the shared ``_rerank_args``
+        bundle, backfilling any stale slots first."""
+        self._ensure_late_bank()
+        return arrays[:7] + (
+            self._bank_q, self._bank_scale, self._late_proj,
+        ) + arrays[7:]
+
+    def _record_maxsim(self, qb: int, k: int, keep: int,
+                       pair_seq: int) -> None:
+        """Cascade-ledger attribution for the MaxSim stage: the per-pair
+        similarity gemm plus the per-query projection, and the full-depth
+        pass over survivors — so ``cascade_stats()`` can report the
+        pair-FLOPs collapse vs the encoder cheap stage."""
+        r_cfg = self.reranker.cfg
+        q_seq = min(self.embedder.max_length, self._rerank_q_budget)
+        record_cascade(
+            "maxsim", qb * k,
+            maxsim_flops(q_seq, self.doc_seq, self._late_dim, qb * k)
+            + projection_flops(
+                q_seq, self.embedder.cfg.hidden, self._late_dim, qb
+            ),
+        )
+        record_cascade(
+            "full", qb * keep,
+            _encoder_flops(r_cfg, pair_seq, r_cfg.layers, qb * keep),
+        )
+
     def remove(self, keys: list) -> None:
         """Remove documents, keeping the token store aligned with the
         index's swap-with-last slot moves. Use THIS, not ``index.remove``,
@@ -429,7 +661,23 @@ class FusedRAGPipeline:
                     self._doc_lens[last]
                 )
             self._doc_lens = self._doc_lens.at[last].set(0)
+            if self._bank_q is not None:
+                # bank rows compact with the same swap-with-last move;
+                # the vacated tail slot loses validity (and its bytes
+                # leave the late_bank gauge below)
+                if slot != last:
+                    self._bank_q = self._bank_q.at[slot].set(
+                        self._bank_q[last]
+                    )
+                    self._bank_scale = self._bank_scale.at[slot].set(
+                        self._bank_scale[last]
+                    )
+                    self._bank_valid[slot] = self._bank_valid[last]
+                self._bank_valid[last] = False
             self.index.remove([key])
+            self._text_by_key.pop(key, None)
+        if self._bank_q is not None:
+            self._record_late_bank()
         if self.sharded_index is not None:
             self.sharded_index.remove(list(keys))
 
@@ -483,6 +731,15 @@ class FusedRAGPipeline:
         arrays, k_eff, pair_seq = self._rerank_args([text], k)
         if pathway_config.rerank_cascade:
             depth, keep, seed_w = self._cascade_plan(k_eff)
+            if pathway_config.late_interaction:
+                record_device_dispatch("fused_rerank_maxsim")
+                args = self._maxsim_args(arrays)
+                self._record_maxsim(1, k_eff, keep, pair_seq)
+                scores, idx, r_scores, order = _fused_retrieve_maxsim_cascade(
+                    *args, self.embedder.cfg, self.reranker.cfg,
+                    k_eff, self.metric, pair_seq, keep, seed_w,
+                )
+                return scores[0], idx[0], r_scores[0], order[0]
             record_device_dispatch("fused_rerank_cascade")
             self._record_cascade(1, k_eff, keep, depth, pair_seq)
             scores, idx, r_scores, order = _fused_retrieve_rerank_cascade(
@@ -502,7 +759,25 @@ class FusedRAGPipeline:
         scores, idx, r_scores, order = jax.device_get(
             self.retrieve_rerank_device(text, k)
         )
-        return self._resolve_rerank_row(scores, idx, r_scores, order)
+        row = self._resolve_rerank_row(scores, idx, r_scores, order)
+        return self._llm_rerank_rows([text], [row])[0]
+
+    def _llm_rerank_rows(self, texts: list[str], rows: list[list]):
+        """Optional listwise LLM final stage over resolved rerank rows.
+
+        Each row is ``[(key, score)]`` best-first from the cross-encoder;
+        the LLM permutes the ORDER while each doc keeps its cross-encoder
+        score (RankLLM semantics — the listwise pass ranks, it does not
+        re-score). No-op unless a reranker is attached AND the flag is on.
+        """
+        if self.llm_reranker is None or not pathway_config.llm_rerank:
+            return rows
+        docs_lists = [
+            [self._text_by_key.get(key, "") for key, _ in row] for row in rows
+        ]
+        record_cascade("llm_rerank", sum(len(r) for r in rows))
+        perms = self.llm_reranker.rerank_batch(list(texts), docs_lists)
+        return [[row[j] for j in perm] for row, perm in zip(rows, perms)]
 
     def _resolve_rerank_row(self, scores, idx, r_scores, order):
         out = []
@@ -522,6 +797,14 @@ class FusedRAGPipeline:
         arrays, k_eff, pair_seq = self._rerank_args(texts, k)
         if pathway_config.rerank_cascade:
             depth, keep, seed_w = self._cascade_plan(k_eff)
+            if pathway_config.late_interaction:
+                record_device_dispatch("fused_rerank_maxsim")
+                args = self._maxsim_args(arrays)
+                self._record_maxsim(len(texts), k_eff, keep, pair_seq)
+                return _fused_retrieve_maxsim_cascade(
+                    *args, self.embedder.cfg, self.reranker.cfg,
+                    k_eff, self.metric, pair_seq, keep, seed_w,
+                )
             record_device_dispatch("fused_rerank_cascade")
             self._record_cascade(len(texts), k_eff, keep, depth, pair_seq)
             return _fused_retrieve_rerank_cascade(
@@ -540,7 +823,8 @@ class FusedRAGPipeline:
         scores, idx, r_scores, order = jax.device_get(
             self.retrieve_rerank_batch_device(texts, k)
         )
-        return [
+        rows = [
             self._resolve_rerank_row(scores[i], idx[i], r_scores[i], order[i])
             for i in range(len(texts))
         ]
+        return self._llm_rerank_rows(texts, rows)

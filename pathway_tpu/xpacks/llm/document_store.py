@@ -278,11 +278,20 @@ class DocumentStore:
 
         @pw.udf
         def format_stats(count, last_modified, last_indexed) -> Json:
+            # late-interaction bank health rides the same statistics
+            # surface: current device bytes of the `late_bank` HBM
+            # component (0 when PATHWAY_TPU_LATE_INTERACTION never ran).
+            # Retraction/compaction lower it live, mirroring the IVF row
+            # lifecycle the file_count tracks.
+            from pathway_tpu.engine.probes import hbm_stats
+
+            late = hbm_stats()["current_bytes"].get("late_bank", 0)
             return Json(
                 {
                     "file_count": int(count or 0),
                     "last_modified": last_modified,
                     "last_indexed": last_indexed,
+                    "late_bank_bytes": int(late),
                 }
             )
 

@@ -200,6 +200,20 @@ class SentenceTransformerEmbedder(BaseEmbedder):
             out.append(self._dedup_fill(plan, miss_texts, miss_vecs))
         return out
 
+    # token-level submit path (late-interaction ingest): compressed
+    # per-token states for the doc bank, encoded ONCE per document on the
+    # same StageWorker pipeline as the pooled path. Not a UDF column —
+    # the bank consumer (FusedRAGPipeline / DocumentStore) drives these
+    # directly, two-phase like embed_submit/resolve.
+    def embed_tokens_submit(self, input: list[str], dc: int | None = None):
+        texts = [t if t is not None else "" for t in input]
+        return self.model.token_bank_submit(texts, dc=dc)
+
+    def embed_tokens_resolve(self, handles):
+        """-> ``[(payload int8 (n, S, dc), scale f32 (n, S, 1))]`` per
+        submitted handle."""
+        return self.model.token_bank_resolve(handles)
+
     def get_embedding_dimension(self, **kwargs) -> int:
         return self.model.dim
 

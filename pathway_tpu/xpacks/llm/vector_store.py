@@ -145,6 +145,15 @@ class VectorStoreServer:
     def index(self) -> DataIndex:
         return self.document_store.index
 
+    def late_bank_bytes(self) -> int:
+        """Current device bytes of the late-interaction doc-token bank
+        (the ``late_bank`` HBM-ledger component) behind this store — the
+        number ``/v1/statistics`` reports as ``late_bank_bytes``. Falls on
+        document retraction, mirroring the IVF row lifecycle."""
+        from pathway_tpu.engine.probes import hbm_stats
+
+        return int(hbm_stats()["current_bytes"].get("late_bank", 0))
+
     def run_server(
         self,
         host: str = "0.0.0.0",  # noqa: S104

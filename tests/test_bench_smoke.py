@@ -54,6 +54,10 @@ def test_bench_smoke_schema():
         "config4_elapsed_s", "join_rows", "join_elapsed_s",
         "wordcount_rows", "wordcount_elapsed_s", "knn_recall_at_10_f32",
         "sharded_ivf", "mesh_serving",
+        # ingest-amortized late-interaction cascade (ISSUE 16): MaxSim
+        # cheap stage off the ingest-time token bank + listwise LLM stage
+        "maxsim_p50_ms", "maxsim_top8_overlap", "late_bank_build_ms",
+        "llm_rerank_overlap",
     ):
         assert s.get(key) is not None, key
     assert s["ingest_elapsed_s"] > 0 and s["ingest_docs"] > 0
@@ -80,6 +84,16 @@ def test_bench_smoke_schema():
     # the query-serving phase ran under load: a survivor rate strictly
     # inside (0, 1] and a non-empty tick batch histogram
     assert 0.0 < s["cascade_survivor_rate"] <= 1.0
+    # the MaxSim cheap stage amortizes its encoder work into ingest, so
+    # per-query it must beat the truncated-depth encoder cheap stage at
+    # the SAME survivor budget; its bank build is a real measurement
+    assert 0 < s["maxsim_p50_ms"] < s["rerank_cascade_p50_ms"]
+    assert 0.0 <= s["maxsim_top8_overlap"] <= 1.0
+    assert s["late_bank_build_ms"] > 0
+    # the listwise LLM stage rode the continuous serve path; random-init
+    # weights emit no parseable permutation, so the malformed-window
+    # fallback must keep the candidate set intact (permutation, no loss)
+    assert s["llm_rerank_overlap"] >= 0.9
     assert s["query_batch_hist"]
     assert s["query_qps"] > 0
     bub = s["ingest_bubbles"]
@@ -197,6 +211,8 @@ def test_bench_smoke_schema():
         comps
     assert comps.get("kv_blocks", 0) > 0 and \
         comps.get("block_table", 0) > 0, comps
+    # the late-interaction token bank is device-resident and on the ledger
+    assert comps.get("late_bank", 0) > 0, comps
     slo = s["slo"]
     assert slo["breaches"] == 0 and slo["alerting"] == []
     assert slo["enabled"] in (True, False)

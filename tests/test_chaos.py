@@ -387,7 +387,7 @@ def test_embed_h2d_chaos_provenance_and_retry():
     pipe._chaos_h2d = site
     pipe._retries = 1
     handle = _PendingEmbed()
-    pipe._dispatch_one((None, None, 1, handle))
+    pipe._dispatch_one((None, None, 1, handle, "embed", 0))
     assert handle._event.is_set()
     assert isinstance(handle._error, chaos.InjectedFault)
     assert handle._error.site == "embed.h2d"

@@ -412,6 +412,60 @@ def test_add_embed_fused_matches_two_step():
     assert fused.n == 32 and int(np.asarray(fused._valid).sum()) == 32
 
 
+def test_fused_pipeline_remove_evicts_late_bank_rows(monkeypatch):
+    """Retract-and-compact under PATHWAY_TPU_LATE_INTERACTION:
+    ``FusedRAGPipeline.remove``'s swap-with-last must move the matching
+    late-interaction bank row too — a stale row left in the vacated slot
+    would silently MaxSim-score the WRONG document. After removal every
+    surviving slot's bank row must dequantize to a fresh encode of its
+    own text, and the ``late_bank`` HBM gauge must fall; re-adding a key
+    restores both."""
+    from pathway_tpu.engine.probes import hbm_stats
+    from pathway_tpu.models.cross_encoder import CrossEncoderModel
+    from pathway_tpu.models.embedder import SentenceEmbedderModel
+    from pathway_tpu.models.transformer import TransformerConfig
+    from pathway_tpu.ops.fused_query import FusedRAGPipeline
+
+    monkeypatch.setenv("PATHWAY_TPU_LATE_INTERACTION", "1")
+    cfg = TransformerConfig(
+        layers=2, hidden=32, heads=4, intermediate=64, vocab_size=4096
+    )
+    emb = SentenceEmbedderModel(cfg=cfg, max_length=16)
+    ce = CrossEncoderModel(cfg=cfg, tokenizer=emb.tokenizer, max_length=64)
+    p = FusedRAGPipeline(emb, ce, reserved_space=32, doc_seq=12, pair_seq=32)
+    rng = np.random.default_rng(1)
+    words = np.array(["alpha", "beta", "gamma", "delta", "eps", "zeta"])
+    texts = {f"k{i}": " ".join(rng.choice(words, 6)) for i in range(20)}
+    p.add(list(texts), list(texts.values()))
+    full_gauge = hbm_stats()["current_bytes"]["late_bank"]
+    assert full_gauge > 0
+
+    gone = ["k3", "k17", "k0", "k9"]
+    p.remove(gone)
+    assert hbm_stats()["current_bytes"]["late_bank"] < full_gauge
+    assert int(p._bank_valid.sum()) == 16
+    for key, text in texts.items():
+        slot = p.index._slot_of.get(key)
+        if key in gone:
+            assert slot is None
+            continue
+        assert p._bank_valid[slot]
+        ids, lens = p._doc_token_rows([text])
+        bq, bs = p._late_bank_rows(ids, lens)
+        want = np.asarray(bq[0], np.float32) * np.asarray(bs[0])
+        got = (
+            np.asarray(p._bank_q[slot], np.float32)
+            * np.asarray(p._bank_scale[slot])
+        )
+        assert np.allclose(got, want, atol=0.02), key
+
+    # re-ingest one retracted key: its bank row comes back live
+    p.add(["k3"], [texts["k3"]])
+    assert int(p._bank_valid.sum()) == 17
+    assert hbm_stats()["current_bytes"]["late_bank"] > 0
+    emb.close()
+
+
 def test_ivf_int8_cells_match_bf16_recall():
     """int8 cell storage (per-slot symmetric quantization, int8 MXU
     scoring) must track the bf16 path's recall on clustered data and

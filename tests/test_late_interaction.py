@@ -1,0 +1,406 @@
+"""Ingest-time compressed late-interaction reranking: the MaxSim cascade
+stage over the int8 doc-token bank, its HBM/FLOPs accounting, and the
+listwise LLM rerank final stage (``ops/late_bank.py``,
+``ops/fused_query.py``, ``xpacks/llm/rerankers.py``).
+
+Kill switches pinned here:
+
+* ``PATHWAY_TPU_LATE_INTERACTION=0`` — the cascade calls the UNTOUCHED
+  truncated-encoder kernel: outputs bitwise-equal to invoking it
+  directly, and no bank HBM is ever allocated;
+* ``PATHWAY_TPU_LLM_RERANK=0`` — an attached listwise reranker is never
+  consulted and the cross-encoder order passes through untouched.
+
+Quality/efficiency contracts: flag-on MaxSim keeps >=0.9 mean top-8
+overlap vs the full rerank at the depth-3 operating point while paying
+>=5x fewer cheap-stage FLOPs; the ``late_bank`` gauge falls on
+retraction; rows ingested with the flag off backfill lazily at query
+time.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.engine.probes import cascade_stats, hbm_stats
+from pathway_tpu.models.cross_encoder import CrossEncoderModel
+from pathway_tpu.models.embedder import SentenceEmbedderModel
+from pathway_tpu.models.transformer import TransformerConfig
+from pathway_tpu.ops.fused_query import (
+    FusedRAGPipeline,
+    _encoder_flops,
+    _fused_retrieve_rerank_cascade,
+)
+from pathway_tpu.ops.late_bank import (
+    late_projection,
+    maxsim_flops,
+    maxsim_scores,
+)
+
+CFG = TransformerConfig(
+    vocab_size=4096, hidden=128, layers=4, heads=4, intermediate=256
+)
+
+WORDS = np.array([
+    "alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta",
+    "theta", "iota", "kappa", "mu", "nu", "stream", "index", "query",
+    "tensor",
+])
+
+
+@pytest.fixture(scope="module")
+def models():
+    emb = SentenceEmbedderModel(cfg=CFG, max_length=32)
+    rr = CrossEncoderModel(cfg=CFG, tokenizer=emb.tokenizer, max_length=128)
+    return emb, rr
+
+
+def _make_pipe(models, n_docs=256, seed=3, llm_reranker=None,
+               reserved_space=None):
+    emb, rr = models
+    p = FusedRAGPipeline(
+        emb, rr, llm_reranker=llm_reranker,
+        reserved_space=reserved_space or max(n_docs, 32),
+        doc_seq=24, pair_seq=64,
+    )
+    rng = np.random.default_rng(seed)
+    docs = [
+        " ".join(rng.choice(WORDS, int(rng.integers(4, 21))))
+        for _ in range(n_docs)
+    ]
+    p.add([f"k{i}" for i in range(n_docs)], docs)
+    p.queries = [" ".join(rng.choice(WORDS, 5)) for _ in range(10)]
+    return p
+
+
+@pytest.fixture(scope="module")
+def pipe(models):
+    # ingested with PATHWAY_TPU_LATE_INTERACTION unset (off): flag-on
+    # tests exercise the lazy query-time backfill, flag-off tests see a
+    # bank-free pipeline
+    return _make_pipe(models)
+
+
+def _late_env(monkeypatch, on: bool, keep=None, dim=None):
+    monkeypatch.setenv("PATHWAY_TPU_LATE_INTERACTION", "1" if on else "0")
+    monkeypatch.setenv("PATHWAY_TPU_RERANK_CASCADE", "1")
+    for var, v in (
+        ("PATHWAY_TPU_RERANK_CASCADE_SURVIVORS", keep),
+        ("PATHWAY_TPU_LATE_DIM", dim),
+    ):
+        if v is None:
+            monkeypatch.delenv(var, raising=False)
+        else:
+            monkeypatch.setenv(var, str(v))
+
+
+# ------------------------------------------------------------ kill switch
+def test_late_interaction_off_bitwise_identical(pipe, monkeypatch):
+    """PATHWAY_TPU_LATE_INTERACTION=0 + cascade on -> the pipeline calls
+    the UNTOUCHED truncated-encoder cascade kernel: outputs bitwise-equal
+    to invoking that kernel directly, and the pipeline never allocates
+    bank HBM."""
+    _late_env(monkeypatch, on=False)
+    assert pipe._bank_q is None
+    text, k = pipe.queries[0], 16
+    got = jax.device_get(pipe.retrieve_rerank_device(text, k))
+
+    depth, keep, seed_w = pipe._cascade_plan(k)
+    ids, mask, q_max = pipe._tokenize_queries(
+        [text],
+        max_length=min(pipe.embedder.max_length, pipe._rerank_q_budget),
+    )
+    want = jax.device_get(_fused_retrieve_rerank_cascade(
+        pipe.embedder.params, ids, mask, pipe.index._corpus,
+        pipe.index._valid, pipe._doc_tokens, pipe._doc_lens,
+        pipe.reranker.params, pipe.reranker.head,
+        pipe.embedder.cfg, pipe.reranker.cfg,
+        k, pipe.metric, pipe._pair_bucket(q_max), depth, keep, seed_w,
+    ))
+    # device path returns row 0 of the (Qb', k) kernel outputs
+    for g, w in zip(got, want):
+        assert np.array_equal(np.asarray(g), np.asarray(w)[0])
+    # flag off all the way through: still no bank, no late_bank gauge
+    assert pipe._bank_q is None
+
+
+# ------------------------------------------------------- quality / flops
+def test_maxsim_overlap_top8(pipe, monkeypatch):
+    """MaxSim cheap stage keeps >=0.9 mean top-8 overlap vs the full
+    rerank ordering. 30/32 survivors suit this random-init model's
+    noise-level margins (its token states correlate far less than a
+    trained checkpoint's); pretrained weights run much harder cuts."""
+    monkeypatch.setenv("PATHWAY_TPU_RERANK_CASCADE", "0")
+    monkeypatch.delenv("PATHWAY_TPU_LATE_INTERACTION", raising=False)
+    full = [
+        [key for key, _ in pipe.retrieve_rerank(q, k=32)[:8]]
+        for q in pipe.queries
+    ]
+    _late_env(monkeypatch, on=True, keep=30)
+    overlaps = []
+    for q, want in zip(pipe.queries, full):
+        got = [key for key, _ in pipe.retrieve_rerank(q, k=32)[:8]]
+        overlaps.append(len(set(got) & set(want)) / 8.0)
+    assert sum(overlaps) / len(overlaps) >= 0.9, overlaps
+
+
+def test_maxsim_flops_collapse_and_attribution(pipe, monkeypatch):
+    """The MaxSim stage pays >=5x fewer FLOPs per candidate pair than the
+    depth-3 truncated-encoder cheap stage it replaces, and the cascade
+    ledger attributes a ``maxsim`` stage entry per dispatch."""
+    q_seq = min(pipe.embedder.max_length, pipe._rerank_q_budget)
+    per_pair_maxsim = maxsim_flops(q_seq, pipe.doc_seq, 32, 1)
+    per_pair_cheap = _encoder_flops(pipe.reranker.cfg, pipe.pair_seq, 3, 1)
+    assert per_pair_cheap >= 5.0 * per_pair_maxsim, (
+        per_pair_cheap, per_pair_maxsim
+    )
+
+    _late_env(monkeypatch, on=True, keep=30)
+    before = cascade_stats()
+    pipe.retrieve_rerank(pipe.queries[0], k=32)
+    after = cascade_stats()
+    d_pairs = {
+        s: after["pairs"].get(s, 0) - before["pairs"].get(s, 0)
+        for s in ("maxsim", "full")
+    }
+    assert d_pairs["maxsim"] == 32
+    assert d_pairs["full"] == 30
+    d_maxsim_gf = (
+        after["gflops"].get("maxsim", 0) - before["gflops"].get("maxsim", 0)
+    )
+    d_full_gf = (
+        after["gflops"].get("full", 0) - before["gflops"].get("full", 0)
+    )
+    assert 0 < d_maxsim_gf < d_full_gf / 5.0
+
+
+def test_maxsim_batched_equals_per_query_loop(pipe, monkeypatch):
+    _late_env(monkeypatch, on=True, keep=30)
+    texts = pipe.queries[:3]
+    batched = pipe.retrieve_rerank_batch(texts, k=16)
+    looped = [pipe.retrieve_rerank(t, k=16) for t in texts]
+    for b, l in zip(batched, looped):
+        assert [key for key, _ in b] == [key for key, _ in l]
+        np.testing.assert_allclose(
+            [s for _, s in b], [s for _, s in l], rtol=0, atol=1e-4
+        )
+
+
+def test_maxsim_scores_matches_numpy_reference():
+    """``maxsim_scores`` == sum over query tokens of the max dot product
+    over each doc's LIVE tokens; zero-length docs score a finite very-bad
+    value (never NaN)."""
+    rng = np.random.default_rng(0)
+    qb, s, k, t, dc = 2, 5, 3, 7, 8
+    q_tok = rng.normal(size=(qb, s, dc)).astype(np.float32)
+    q_mask = np.ones((qb, s), dtype=np.int32)
+    q_mask[0, 3:] = 0
+    bank = rng.normal(size=(qb, k, t, dc)).astype(np.float32)
+    scale = np.abs(rng.normal(size=(qb, k, t, 1))).astype(np.float32) + 0.1
+    bank_q = np.clip(np.round(bank / scale), -127, 127).astype(np.int8)
+    d_lens = np.array([[7, 3, 0], [1, 7, 2]], dtype=np.int32)
+
+    got = np.asarray(maxsim_scores(
+        jnp.asarray(q_tok), jnp.asarray(q_mask), jnp.asarray(bank_q),
+        jnp.asarray(scale), jnp.asarray(d_lens),
+    ))
+    d = bank_q.astype(np.float32) * scale
+    for b in range(qb):
+        for j in range(k):
+            n = d_lens[b, j]
+            if n == 0:
+                assert np.isfinite(got[b, j]) and got[b, j] < -1e6
+                continue
+            want = sum(
+                float(np.max(d[b, j, :n] @ q_tok[b, i]))
+                for i in range(s) if q_mask[b, i]
+            )
+            np.testing.assert_allclose(got[b, j], want, rtol=2e-5, atol=1e-4)
+
+
+# ------------------------------------------------- bank lifecycle / HBM
+def test_bank_backfills_after_flag_flip(models, monkeypatch):
+    """Docs ingested with the flag OFF get bank rows lazily at the first
+    flag-on query (one bounded fused dispatch), not garbage scores; the
+    backfill never re-runs once every live slot is valid."""
+    monkeypatch.delenv("PATHWAY_TPU_LATE_INTERACTION", raising=False)
+    p = _make_pipe(models, n_docs=48, seed=11)
+    assert p._bank_q is None
+    _late_env(monkeypatch, on=True, keep=8)
+    out = p.retrieve_rerank(p.queries[0], k=16)
+    assert p._bank_q is not None
+    assert p._bank_valid[:p.index.n].all()
+    keys = [key for key, _ in out]
+    assert len(keys) == len(set(keys)) == 16
+    assert hbm_stats()["current_bytes"].get("late_bank", 0) > 0
+
+    def boom(*a, **k):  # noqa: ARG001
+        raise AssertionError("backfill re-ran on a fully-valid bank")
+
+    monkeypatch.setattr(p, "_late_bank_rows", boom)
+    p.retrieve_rerank(p.queries[1], k=16)
+
+
+def test_retraction_lowers_late_bank_gauge(models, monkeypatch):
+    """Deleting docs evicts their bank rows: the ``late_bank`` HBM gauge
+    falls, queries stop returning the retracted keys, and re-ingesting
+    restores both."""
+    _late_env(monkeypatch, on=True, keep=8)
+    p = _make_pipe(models, n_docs=64, seed=7)
+    p.retrieve_rerank(p.queries[0], k=8)  # settle gauge at 64 live rows
+    full = hbm_stats()["current_bytes"]["late_bank"]
+    assert full > 0
+
+    gone = [f"k{i}" for i in range(16)]
+    p.remove(gone)
+    after = hbm_stats()["current_bytes"]["late_bank"]
+    assert after < full
+    np.testing.assert_allclose(after, full * 48 / 64, rtol=0.02)
+    out = p.retrieve_rerank(p.queries[0], k=48)
+    keys = [key for key, _ in out]
+    assert len(keys) == len(set(keys)) == 48
+    assert not set(keys) & set(gone)
+
+    # re-ingest: rows re-enter the bank at ingest time and the gauge rises
+    rng = np.random.default_rng(99)
+    p.add(gone, [" ".join(rng.choice(WORDS, 8)) for _ in gone])
+    assert p._bank_valid[:p.index.n].all()
+    assert hbm_stats()["current_bytes"]["late_bank"] > after
+
+
+def test_late_dim_freezes_at_first_alloc(models, monkeypatch):
+    """``PATHWAY_TPU_LATE_DIM`` is read once, at bank allocation; later
+    env churn can't desync stored rows from the query projection."""
+    _late_env(monkeypatch, on=True, keep=8, dim=16)
+    p = _make_pipe(models, n_docs=32, seed=5)
+    assert p._bank_q.shape[-1] == 16
+    monkeypatch.setenv("PATHWAY_TPU_LATE_DIM", "64")
+    p.retrieve_rerank(p.queries[0], k=8)
+    assert p._bank_q.shape[-1] == 16
+    assert p._late_proj.shape == (CFG.hidden, 16)
+
+
+def test_late_projection_deterministic():
+    a = np.asarray(late_projection(64, 16))
+    b = np.asarray(late_projection(64, 16))
+    assert np.array_equal(a, b)
+    assert a.shape == (64, 16)
+
+
+# --------------------------------------------------- listwise LLM rerank
+class _ScriptedChat:
+    """Deterministic stand-in chat: pops canned replies; raises if
+    consulted when it must not be."""
+
+    batch = False
+    deterministic = True
+
+    def __init__(self, replies=(), forbid=False):
+        self.replies = list(replies)
+        self.forbid = forbid
+        self.prompts = []
+
+    def __wrapped__(self, messages, **kwargs):
+        assert not self.forbid, "LLM consulted with PATHWAY_TPU_LLM_RERANK=0"
+        self.prompts.append(messages[0]["content"])
+        return self.replies.pop(0) if self.replies else ""
+
+
+def test_llm_rerank_off_never_consults_the_llm(models, monkeypatch):
+    """PATHWAY_TPU_LLM_RERANK=0 pin: with a listwise reranker ATTACHED,
+    the flag-off path returns the cross-encoder order untouched and the
+    LLM is never called."""
+    from pathway_tpu.xpacks.llm.rerankers import ListwiseLLMReranker
+
+    monkeypatch.setenv("PATHWAY_TPU_RERANK_CASCADE", "0")
+    monkeypatch.setenv("PATHWAY_TPU_LLM_RERANK", "0")
+    chat = _ScriptedChat(forbid=True)
+    rr = ListwiseLLMReranker(chat, window=4, stride=2)
+    p = _make_pipe(models, n_docs=32, seed=13, llm_reranker=rr)
+    base_pipe = _make_pipe(models, n_docs=32, seed=13)
+    got = p.retrieve_rerank(p.queries[0], k=8)
+    want = base_pipe.retrieve_rerank(p.queries[0], k=8)
+    assert got == want
+
+
+def test_llm_rerank_permutes_order_keeps_scores(models, monkeypatch):
+    """Flag on: the listwise stage permutes the ORDER of cascade
+    survivors while each doc keeps its cross-encoder score (RankLLM
+    semantics), and malformed model output falls back to the incoming
+    order."""
+    from pathway_tpu.xpacks.llm.rerankers import ListwiseLLMReranker
+
+    monkeypatch.setenv("PATHWAY_TPU_RERANK_CASCADE", "0")
+    monkeypatch.setenv("PATHWAY_TPU_LLM_RERANK", "1")
+    chat = _ScriptedChat(["[4] > [3] > [2] > [1]"])
+    rr = ListwiseLLMReranker(chat, window=4, stride=4)
+    p = _make_pipe(models, n_docs=32, seed=13, llm_reranker=rr)
+    monkeypatch.setenv("PATHWAY_TPU_LLM_RERANK", "0")
+    base = p.retrieve_rerank(p.queries[0], k=4)
+    monkeypatch.setenv("PATHWAY_TPU_LLM_RERANK", "1")
+    out = p.retrieve_rerank(p.queries[0], k=4)
+    assert [key for key, _ in out] == [key for key, _ in reversed(base)]
+    assert dict(out) == dict(base)  # scores ride with their keys
+    assert len(chat.prompts) == 1
+    # doc texts (not ids) reached the prompt
+    assert "[1] " in chat.prompts[0] and "[4] " in chat.prompts[0]
+
+    # malformed reply -> cross-encoder order passes through untouched
+    chat.replies = ["no identifiers here at all"]
+    again = p.retrieve_rerank(p.queries[0], k=4)
+    assert again == base
+
+
+def test_listwise_sliding_window_bubbles_bottom_up():
+    """RankGPT schedule: overlapping bottom-up windows let a deep doc
+    climb across window boundaries in one pass."""
+    from pathway_tpu.xpacks.llm.rerankers import ListwiseLLMReranker
+
+    # round 1 (start 2, docs c d e f): best-last -> f e d c
+    # round 2 (start 0, docs a b f e): f first -> f a b e
+    chat = _ScriptedChat(["[4] > [3] > [2] > [1]", "[3] > [1] > [2] > [4]"])
+    rr = ListwiseLLMReranker(chat, window=4, stride=2)
+    perm = rr.rerank_batch(["q"], [["a", "b", "c", "d", "e", "f"]])[0]
+    assert perm == [5, 0, 1, 4, 3, 2]
+    assert len(chat.prompts) == 2
+
+    # partial reply: ranked ids first, dropped ids keep incoming order
+    chat = _ScriptedChat(["[2]"])
+    rr = ListwiseLLMReranker(chat, window=4, stride=2)
+    assert rr.rerank_batch(["q"], [["a", "b", "c"]])[0] == [1, 0, 2]
+
+    # degenerate lists never consult the model
+    chat = _ScriptedChat(forbid=True)
+    rr = ListwiseLLMReranker(chat, window=4, stride=2)
+    assert rr.rerank_batch(["q", "r"], [["only"], []]) == [[0], []]
+
+
+# -------------------------------------------------- token-bank ingest path
+def test_token_bank_submit_resolve_roundtrip(monkeypatch):
+    """The embedder's token-level submit path returns int8 payloads +
+    f32 scales shaped (n, S, dc)/(n, S, 1), identical between the
+    pipelined (StageWorker) and serial (PATHWAY_TPU_PIPELINE=0) paths."""
+    import dataclasses
+
+    from pathway_tpu.models import MINILM_L6
+    from pathway_tpu.xpacks.llm.embedders import SentenceTransformerEmbedder
+
+    cfg = dataclasses.replace(
+        MINILM_L6, layers=1, hidden=16, heads=2, intermediate=32,
+        vocab_size=500, max_position=32,
+    )
+    model = SentenceEmbedderModel(cfg=cfg, max_length=16)
+    emb = SentenceTransformerEmbedder(model)
+    texts = ["aa bb cc", "dd", None]
+    h = emb.embed_tokens_submit(texts, dc=8)
+    ((q1, s1),) = emb.embed_tokens_resolve([h])
+    assert q1.shape == (3, 16, 8) and q1.dtype == np.int8
+    assert s1.shape == (3, 16, 1) and s1.dtype == np.float32
+
+    monkeypatch.setenv("PATHWAY_TPU_PIPELINE", "0")
+    ((q2, s2),) = emb.embed_tokens_resolve([emb.embed_tokens_submit(texts, dc=8)])
+    assert np.array_equal(q1, q2)
+    np.testing.assert_allclose(s1, s2, rtol=0, atol=0)
+    model.close()

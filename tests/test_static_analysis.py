@@ -21,7 +21,10 @@ from pathway_tpu.analysis import runtime as rt
 from pathway_tpu.analysis.annotations import guarded_by
 from pathway_tpu.analysis.core import Finding, analyze_source
 from pathway_tpu.analysis.flag_hygiene import check_dead_flags
-from pathway_tpu.analysis.kill_switch import check_kill_switches
+from pathway_tpu.analysis.kill_switch import (
+    check_kill_switches,
+    check_pinning_refs,
+)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -361,6 +364,66 @@ def test_live_registry_kill_switches_all_pinned():
     assert check_kill_switches(FLAG_REGISTRY, REPO_ROOT) == []
     # and the contract is actually exercised: the registry declares some
     assert sum(1 for f in FLAG_REGISTRY if f.kill_switch) >= 10
+
+
+# ------------------------------------------------------------------ GL302
+
+
+def test_gl302_prose_only_pin_rejected(tmp_path):
+    """A pinning test that names the env var only in its docstring (or a
+    comment) satisfies GL301's substring scan but pins nothing; the env
+    var must appear in a CODE string literal — setenv arg, parametrize
+    entry, env dict key all count."""
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_code.py").write_text(
+        'def test_x(monkeypatch):\n'
+        '    monkeypatch.setenv("PATHWAY_TPU_CODE", "0")\n'
+    )
+    (tests_dir / "test_param.py").write_text(
+        'import pytest\n'
+        '@pytest.mark.parametrize("env", ["PATHWAY_TPU_PARAM"])\n'
+        'def test_x(env):\n'
+        '    pass\n'
+    )
+    (tests_dir / "test_prose.py").write_text(
+        '"""Pins PATHWAY_TPU_PROSE byte-identical (it says here).\n'
+        '"""\n'
+        '# also mentions PATHWAY_TPU_PROSE in a comment\n'
+        'def test_x():\n'
+        '    """Inner docstring: PATHWAY_TPU_PROSE again."""\n'
+        '    pass\n'
+    )
+    flags = [
+        NS(env="PATHWAY_TPU_CODE", kill_switch=True,
+           pinned_by="tests/test_code.py"),
+        NS(env="PATHWAY_TPU_PARAM", kill_switch=True,
+           pinned_by="tests/test_param.py"),
+        NS(env="PATHWAY_TPU_PROSE", kill_switch=True,
+           pinned_by="tests/test_prose.py"),
+        # GL301's findings, not GL302's: missing file / missing reference
+        NS(env="PATHWAY_TPU_GONE", kill_switch=True,
+           pinned_by="tests/test_missing.py"),
+        NS(env="PATHWAY_TPU_UNREF", kill_switch=True,
+           pinned_by="tests/test_code.py"),
+        NS(env="PATHWAY_TPU_NOPIN", kill_switch=True, pinned_by=None),
+    ]
+    problems = dict(check_pinning_refs(flags, str(tmp_path)))
+    assert set(problems) == {"PATHWAY_TPU_PROSE"}
+    assert "only in" in problems["PATHWAY_TPU_PROSE"]
+
+
+def test_gl302_live_registry_pins_are_code():
+    """Every declared kill switch's pinning test uses its env var in
+    actual code today — keep it that way."""
+    from pathway_tpu.internals.config import FLAG_REGISTRY
+
+    assert check_pinning_refs(FLAG_REGISTRY, REPO_ROOT) == []
+
+
+def test_gl302_rule_registered():
+    assert "GL302" in core.RULES
+    assert "prose" in core.RULES["GL302"].summary
 
 
 # ------------------------------------------------------------------ GL401

@@ -219,6 +219,9 @@ def test_document_store_statistics():
     stats = unwrap_json(list(rows.values())[0][cols.index("result")])
     assert stats["file_count"] == 2
     assert stats["last_modified"] == 2
+    # late-interaction bank health rides the same surface: present even
+    # when the bank never built (0 bytes), live when it did
+    assert stats["late_bank_bytes"] >= 0
 
 
 def test_document_store_inputs():

@@ -233,6 +233,60 @@ def test_encoder_reranker_cosine():
     assert s_same > s_diff
 
 
+def test_rerank_topk_filter_k_exceeds_docs():
+    """k past the end is a slice, not an error: ALL docs come back in
+    score order. k <= 0 keeps nothing; docs without a score are dropped
+    rather than ordered arbitrarily."""
+    from pathway_tpu.xpacks.llm.rerankers import rerank_topk_filter
+
+    docs = ["a", "b", "c"]
+    scores = [0.2, 0.9, 0.5]
+    kept_docs, kept_scores = rerank_topk_filter.__wrapped__(docs, scores, k=50)
+    assert kept_docs == ["b", "c", "a"]
+    assert kept_scores == [0.9, 0.5, 0.2]
+    assert rerank_topk_filter.__wrapped__(docs, scores, k=0) == ([], [])
+    assert rerank_topk_filter.__wrapped__(docs, scores, k=-3) == ([], [])
+    # score list shorter than the doc list: unscored docs are dropped
+    kept_docs, kept_scores = rerank_topk_filter.__wrapped__(docs, [0.7], k=9)
+    assert kept_docs == ["a"] and kept_scores == [0.7]
+
+
+def test_encoder_reranker_rides_embed_dedup(monkeypatch):
+    """EncoderReranker embeds through the embedder UDF's dedup cache
+    (PATHWAY_TPU_EMBED_DEDUP): the query column repeats one text per
+    candidate doc, so k rows collapse to one miss — with scores identical
+    to the dedup-off path."""
+    import dataclasses
+
+    from pathway_tpu.models import MINILM_L6, SentenceEmbedderModel
+    from pathway_tpu.xpacks.llm.rerankers import EncoderReranker
+
+    cfg = dataclasses.replace(
+        MINILM_L6, layers=1, hidden=16, heads=2, intermediate=32,
+        vocab_size=500, max_position=32,
+    )
+    model = SentenceEmbedderModel(cfg=cfg, max_length=16)
+    rr = EncoderReranker(model)
+    docs = ["aa bb", "cc dd", "ee ff", "aa bb"]
+    queries = ["the query"] * len(docs)
+
+    monkeypatch.setenv("PATHWAY_TPU_EMBED_DEDUP", "0")
+    ref = rr.__wrapped__(list(docs), list(queries))
+
+    monkeypatch.setenv("PATHWAY_TPU_EMBED_DEDUP", "1")
+    on = rr.__wrapped__(list(docs), list(queries))
+    np.testing.assert_allclose(on, ref, rtol=0, atol=0)
+    stats = rr.embedder.dedup_stats
+    # 4 query rows -> 1 miss + 3 hits; docs: "aa bb" repeats -> 1 more hit
+    assert stats["hits"] >= 4
+    assert stats["misses"] == 4  # query + 3 unique docs
+
+    # two-phase protocol parity (the engine's pipelined path)
+    handle = rr.submit_batch(list(docs), list(queries))
+    (scores,) = rr.resolve_batch([handle])
+    np.testing.assert_allclose(scores, ref, rtol=0, atol=0)
+
+
 # -------------------------------------------------------------------- misc
 def test_adaptive_rag_escalates_k():
     # the adaptive strategy widens k until the answer stops being "no info"
