@@ -3249,6 +3249,92 @@ def _run_phase_subprocess(name: str, timeout_s: int = 1800,
     )
 
 
+def config_tuned_serving() -> dict:
+    """The ``--tuned`` arm: replay workload profiles default-vs-tuned.
+
+    With ``--tuned <artifact>`` the persisted tuned-config's flags are
+    applied to every profile; without one, a per-profile inline
+    micro-tune (search + SLO/chaos validation, seeded) picks the flags,
+    so the arm always measures a VALIDATED config. The default and tuned
+    legs replay the identical seeded trace against the real continuous
+    server, so `tuned_tok_s` vs `default_tok_s` (and the per-profile
+    headline pair) is an apples-to-apples flag-surface delta."""
+    t_phase = time.perf_counter()
+    from pathway_tpu.internals.config import load_tuned_config
+    from pathway_tpu.tuning import Autotuner, get_profile, run_trial
+
+    tuned_path = os.environ.get("PATHWAY_BENCH_TUNED", "")
+    scale = 0.5 if _smoke() else 1.0
+    persisted = dict(load_tuned_config(tuned_path)) if tuned_path else None
+    profiles_out: dict = {}
+    for pname in ("shared_prefix_chat", "long_doc_rag"):
+        prof = get_profile(pname)
+        tuner = Autotuner(
+            prof, seed=0, max_trials=2 if _smoke() else 0,
+            base_scale=(0.3 if _smoke() else 0.5) * scale,
+            validation_scale=(0.6 if _smoke() else 1.0) * scale,
+            rounds=1 if _smoke() else 2,
+        )
+        if persisted is not None:
+            flags = dict(persisted)
+            ok, reason, validation = tuner._real_validate(flags)
+            if not ok:
+                diag(
+                    warning="tuned_artifact_rejected", profile=pname,
+                    reason=reason,
+                )
+        else:
+            result = tuner.run()
+            flags, validation = dict(result.winner), result.validation
+        default = run_trial(prof, {}, scale=scale, seed=101)
+        tuned = run_trial(prof, flags, scale=scale, seed=101)
+        d = default.get(prof.headline)
+        t = tuned.get(prof.headline)
+        improvement = None
+        if isinstance(d, (int, float)) and isinstance(t, (int, float)):
+            if prof.direction == "max" and d:
+                improvement = round(t / d, 3)
+            elif prof.direction == "min" and t:
+                improvement = round(d / t, 3)
+        slo_leg = validation.get("slo") or {}
+        chaos_leg = validation.get("chaos") or {}
+        profiles_out[pname] = {
+            "headline": prof.headline,
+            "direction": prof.direction,
+            "flags": flags,
+            "default": d,
+            "tuned": t,
+            "improvement_x": improvement,
+            "default_tok_s": default.get("tok_s"),
+            "tuned_tok_s": tuned.get("tok_s"),
+            "validation_alerts": len(slo_leg.get("slo_alerting") or []),
+            "validation_sheds": int(slo_leg.get("shed") or 0)
+            + int(chaos_leg.get("shed") or 0),
+            "sheds": int(default.get("shed") or 0)
+            + int(tuned.get("shed") or 0),
+        }
+        diag(
+            phase="config_tuned", profile=pname, flags=flags,
+            default=d, tuned=t, improvement_x=improvement,
+        )
+    chat = profiles_out.get("shared_prefix_chat") or {}
+    detail = {
+        "artifact": tuned_path or "",
+        "source": "artifact" if persisted is not None else
+        "inline_micro_tune",
+        "profiles": profiles_out,
+        "tuned_tok_s": chat.get("tuned_tok_s"),
+        "default_tok_s": chat.get("default_tok_s"),
+        "elapsed_s": round(time.perf_counter() - t_phase, 1),
+    }
+    return {
+        "metric": "tuned_serving_tok_s",
+        "value": chat.get("tuned_tok_s"),
+        "unit": "tok/s",
+        "detail": detail,
+    }
+
+
 def run_single_phase(name: str) -> None:
     from pathway_tpu.models import MINILM_L6
 
@@ -3260,6 +3346,7 @@ def run_single_phase(name: str) -> None:
         "join": config_join_streaming,
         "wordcount": config_wordcount_streaming,
         "decoder": config_decoder_generate,
+        "config_tuned": config_tuned_serving,
     }
     print(json.dumps(fns[name]()), flush=True)
 
@@ -3345,6 +3432,7 @@ def main() -> None:
             ("join", config_join_streaming),
             ("wordcount", config_wordcount_streaming),
             ("decoder", config_decoder_generate),
+            ("config_tuned", config_tuned_serving),
             ("config6_mesh", lambda: _run_phase_subprocess(
                 "config6_mesh", timeout_s=600, env=cpu8_env)),
         )
@@ -3360,6 +3448,7 @@ def main() -> None:
         for phase, budget, env in (
             ("config5", 2400, None), ("join", 1200, None),
             ("wordcount", 900, None), ("decoder", 1800, None),
+            ("config_tuned", 1800, None),
             ("config5_sharded", 2400, cpu8_env),
             ("config6_mesh", 1800, cpu8_env),
         ):
@@ -3539,6 +3628,7 @@ def main() -> None:
         else serving_det or None
     )
     c4_detail = config4.get("detail") or {}
+    tuned_det = _m("tuned_serving_tok_s").get("detail") or {}
     shiv = _m("sharded_ivf_build_rows")
     mesh_m = _m("mesh_serving_tok_s")
     mesh_det = mesh_m.get("detail") or {}
@@ -3604,6 +3694,13 @@ def main() -> None:
             "decoder_tokens_per_sec": dec.get("value"),
             "ingest_bubbles": headline_detail.get("bubble_attribution"),
             "serving": serving_summary,
+            "tuned_tok_s": tuned_det.get("tuned_tok_s"),
+            "default_tok_s": tuned_det.get("default_tok_s"),
+            "tuned": {
+                k: tuned_det.get(k)
+                for k in ("source", "profiles", "elapsed_s")
+                if k in tuned_det
+            },
             "knn_recall_at_10": _m("knn_recall_at_10").get("value"),
             "knn_recall_at_10_f32": (
                 _m("knn_recall_at_10").get("detail") or {}
@@ -3766,6 +3863,23 @@ def main() -> None:
         acc = srv.get("spec_acceptance_rate")
         if not (isinstance(acc, (int, float)) and acc > 0.3):
             missing.append("summary.serving.spec_acceptance_rate>0.3")
+        # autotuner acceptance: both --tuned arm profiles must have run
+        # default + tuned legs off a VALIDATED config — zero SLO alerts
+        # and zero sheds during validation (smoke checks shape and the
+        # validation contract, not the speed delta)
+        tuned_profiles = (s.get("tuned") or {}).get("profiles") or {}
+        for pname in ("shared_prefix_chat", "long_doc_rag"):
+            tp = tuned_profiles.get(pname) or {}
+            for k in ("default", "tuned", "improvement_x", "headline"):
+                _chk(f"summary.tuned.profiles.{pname}.{k}", tp.get(k))
+            if tp.get("validation_alerts", 1) != 0:
+                missing.append(
+                    f"summary.tuned.profiles.{pname}.validation_alerts==0"
+                )
+            if tp.get("validation_sheds", 1) != 0:
+                missing.append(
+                    f"summary.tuned.profiles.{pname}.validation_sheds==0"
+                )
         bub = s.get("ingest_bubbles") or {}
         for k in ("wall_s", "stages_s", "pct"):
             _chk(f"summary.ingest_bubbles.{k}", bub.get(k))
@@ -3965,6 +4079,30 @@ def sentinel_check(summary: dict, baseline: dict, smoke: bool) -> list:
             "summary.serving.fleet_failover_ok: chaos-on-one-replica "
             "trace left requests non-terminal or past the p95 bar"
         )
+    # autotuner gates, enforced even against pre-tuner baselines: the
+    # --tuned arm must have produced both legs on both profiles, and the
+    # config it measured must have validated with zero SLO alerts and
+    # zero sheds — a "tuned" config that breaches p95 or sheds under the
+    # drill is a regression in the validator, not a speed issue
+    for fk in ("tuned_tok_s", "default_tok_s"):
+        if not isinstance(new.get(fk), (int, float)):
+            breaches.append(f"summary.{fk}: missing")
+    tuned_profiles = (new.get("tuned") or {}).get("profiles") or {}
+    for pname in ("shared_prefix_chat", "long_doc_rag"):
+        tp = tuned_profiles.get(pname) or {}
+        if not isinstance(tp.get("tuned"), (int, float)):
+            breaches.append(f"summary.tuned.profiles.{pname}: missing")
+            continue
+        if tp.get("validation_alerts", 0):
+            breaches.append(
+                f"summary.tuned.profiles.{pname}.validation_alerts: "
+                f"{tp['validation_alerts']} SLO alerts during validation"
+            )
+        if tp.get("validation_sheds", 0):
+            breaches.append(
+                f"summary.tuned.profiles.{pname}.validation_sheds: "
+                f"{tp['validation_sheds']} sheds during validation"
+            )
     # late-interaction gates, enforced even against pre-maxsim baselines:
     # the ingest-amortized cheap stage must have run and must beat the
     # encoder cheap stage's cascade p50; its overlaps are fractions; the
@@ -4043,6 +4181,10 @@ if __name__ == "__main__":
     if "--sentinel" in sys.argv:
         os.environ["PATHWAY_BENCH_SENTINEL"] = sys.argv[
             sys.argv.index("--sentinel") + 1
+        ]
+    if "--tuned" in sys.argv:
+        os.environ["PATHWAY_BENCH_TUNED"] = sys.argv[
+            sys.argv.index("--tuned") + 1
         ]
     if "--phase" in sys.argv:
         run_single_phase(sys.argv[sys.argv.index("--phase") + 1])

@@ -89,6 +89,13 @@ RULES: dict[str, Rule] = {
             "flag or wire it up.",
         ),
         Rule(
+            "GL204", "tunable-bounds",
+            "Registry flag with a `tunable` search spec whose bounds are "
+            "missing/non-finite, whose candidate ladder is empty or "
+            "degenerate, or whose default falls outside the declared "
+            "range — the autotuner would search a broken space.",
+        ),
+        Rule(
             "GL301", "kill-switch-unpinned",
             "Registry flag marked `kill_switch=True` without a live "
             "byte-equality pinning test: `pinned_by` must name an "

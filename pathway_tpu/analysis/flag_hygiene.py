@@ -19,10 +19,16 @@ in ``internals/config.py``'s ``FLAG_REGISTRY`` and read through
   never accessed in the package (outside config.py) and its env name
   never appears in package/bench/tests sources. Dead flags are lies in
   the docs; delete them or wire them up.
+* **GL204** — a flag carrying a ``tunable`` search spec whose space is
+  broken: missing/non-finite bounds, an inverted range, a non-positive
+  step, an empty or single-rung candidate ladder, or a default outside
+  the declared space. The autotuner trusts these specs; a malformed one
+  would search garbage (or nothing).
 
-GL203 is registry-wide, so it only fires on full-package runs (it needs
-``internals/config.py`` in the scanned set); unit tests exercise
-:func:`check_dead_flags` directly with synthetic registries.
+GL203/GL204 are registry-wide, so they only fire on full-package runs
+(they need ``internals/config.py`` in the scanned set); unit tests
+exercise :func:`check_dead_flags` / :func:`check_tunable_bounds`
+directly with synthetic registries.
 """
 
 from __future__ import annotations
@@ -72,6 +78,7 @@ def run(ctx: PackageCtx) -> list[Finding]:
     config = ctx.module(CONFIG_PATH)
     if config is not None and ctx.registry_checks:
         findings.extend(_dead_flags_on_repo(ctx, config))
+        findings.extend(_tunable_bounds_on_repo(config))
     return findings
 
 
@@ -226,6 +233,106 @@ def _dead_flags_on_repo(
             findings, "GL203", node,
             f"flag `{env}` (attr `{attr}`) is never read by package, bench, "
             "or tests — delete it or wire it up",
+            env,
+        )
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# GL204 tunable bounds
+
+
+def check_tunable_bounds(flags) -> list[tuple[str, str]]:
+    """Malformed ``Tunable`` search specs. ``flags`` is an iterable with
+    ``.env`` / ``.tunable`` (``None`` = not tunable) where a spec has
+    ``.kind`` / ``.lo`` / ``.hi`` / ``.step`` / ``.log`` / ``.choices``
+    / ``.candidates()``, and the flag parses raw values via
+    ``.parse_raw`` and renders its default via ``.render_default``.
+    Returns ``[(env, problem), ...]``."""
+    import math
+
+    bad: list[tuple[str, str]] = []
+    for flag in flags:
+        spec = getattr(flag, "tunable", None)
+        if spec is None:
+            continue
+        env = flag.env
+
+        def problem(msg: str, env=env) -> None:
+            bad.append((env, msg))
+
+        if spec.kind == "choice":
+            if len(spec.choices) < 2:
+                problem("choice spec needs >= 2 choices")
+                continue
+        elif spec.kind in ("int", "float"):
+            if spec.lo is None or spec.hi is None:
+                problem(f"{spec.kind} spec must declare lo and hi")
+                continue
+            lo, hi = float(spec.lo), float(spec.hi)
+            if not (math.isfinite(lo) and math.isfinite(hi)):
+                problem("bounds must be finite")
+                continue
+            if lo >= hi:
+                problem(f"inverted/empty range [{lo}, {hi}]")
+                continue
+            if spec.log:
+                if lo <= 0:
+                    problem("log ladder needs lo > 0")
+                    continue
+            elif spec.step is not None and float(spec.step) <= 0:
+                problem(f"non-positive step {spec.step}")
+                continue
+        else:
+            problem(f"unknown tunable kind {spec.kind!r}")
+            continue
+
+        try:
+            cands = spec.candidates()
+        except Exception as exc:  # noqa: BLE001 - report, don't crash
+            problem(f"candidates() raised {type(exc).__name__}: {exc}")
+            continue
+        if len(cands) < 2:
+            problem(f"degenerate candidate ladder ({len(cands)} rung)")
+            continue
+        # every rung must round-trip through the flag's own parser
+        try:
+            parsed = [flag.parse_raw(c) for c in cands]
+        except Exception as exc:  # noqa: BLE001 - report, don't crash
+            problem(f"candidate fails flag parser: {exc}")
+            continue
+        # the default must live inside the declared space (compare in
+        # parsed units: choice "0" on a float flag means 0.0)
+        default = flag.parse_raw(flag.render_default())
+        if spec.kind == "choice":
+            if default not in parsed:
+                problem(
+                    f"default {default!r} is not one of the choices"
+                )
+        else:
+            lo, hi = float(spec.lo), float(spec.hi)
+            try:
+                dv = float(default)
+            except (TypeError, ValueError):
+                problem(
+                    f"non-numeric default {default!r} on a {spec.kind} range"
+                )
+                continue
+            if not (lo <= dv <= hi):
+                problem(f"default {dv} outside [{lo}, {hi}]")
+    return bad
+
+
+def _tunable_bounds_on_repo(config: ModuleSource) -> list[Finding]:
+    from pathway_tpu.internals.config import FLAG_REGISTRY
+
+    findings: list[Finding] = []
+    for env, msg in check_tunable_bounds(FLAG_REGISTRY):
+        node = ast.Constant(value=env)
+        node.lineno = _registry_line(config, env)
+        config.emit(
+            findings, "GL204", node,
+            f"flag `{env}` has a malformed tunable spec: {msg}",
             env,
         )
     return findings

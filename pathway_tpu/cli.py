@@ -381,6 +381,79 @@ def watch(url, interval, iterations, fail_on_alert):
         raise SystemExit(1)
 
 
+@cli.command()
+@click.argument("profile")
+@click.option("--out", type=str, default=None, metavar="PATH",
+              help="write the winning tuned-config JSON here "
+                   "[default: tuned-<profile>.json]")
+@click.option("--seed", type=int, default=None,
+              help="search seed [default: PATHWAY_TPU_TUNE_SEED]")
+@click.option("--trials", type=int, default=None,
+              help="cap the candidate pool (baseline + N-1 candidates) "
+                   "[default: PATHWAY_TPU_TUNE_TRIALS; 0 = full ladder]")
+@click.option("--scale", type=float, default=1.0, show_default=True,
+              help="trace-scale multiplier for the first halving round")
+@click.option("--rounds", type=int, default=3, show_default=True,
+              help="successive-halving rounds")
+@click.option("--smoke", is_flag=True,
+              help="seconds-scale CI invocation: 2 trials, 1 round, "
+                   "half-scale traces")
+def tune(profile, out, seed, trials, scale, rounds, smoke):
+    """Search the tunable flag surface for a workload PROFILE, validate
+    survivors under the SLO watchdog + a chaos drill, and persist the
+    winner as a tuned-config JSON for ``PATHWAY_TPU_TUNED_CONFIG``.
+
+    Exits nonzero when validation rejects every candidate (the current
+    defaults stay in force)."""
+    import json
+
+    from pathway_tpu.tuning import (
+        Autotuner,
+        PROFILES,
+        TuneError,
+        save_artifact,
+        to_artifact,
+    )
+
+    if profile not in PROFILES:
+        click.echo(
+            f"unknown profile {profile!r}; available: {sorted(PROFILES)}",
+            err=True,
+        )
+        raise SystemExit(2)
+    if smoke:
+        trials = 2 if trials is None else trials
+        rounds = min(rounds, 1)
+        scale = min(scale, 0.5)
+    tuner = Autotuner(
+        profile, seed=seed, max_trials=trials,
+        base_scale=scale, rounds=rounds,
+    )
+    try:
+        result = tuner.run()
+    except TuneError as exc:
+        click.echo(f"tune failed: {exc}", err=True)
+        raise SystemExit(3) from exc
+    path = out or f"tuned-{profile}.json"
+    save_artifact(result, path)
+    art = to_artifact(result)
+    click.echo(json.dumps(
+        {
+            "profile": art["profile"],
+            "headline": art["headline"],
+            "direction": art["direction"],
+            "flags": art["flags"],
+            "score": art["score"],
+            "baseline_score": art["baseline_score"],
+            "trials": len(result.trials),
+            "rejected": len(result.rejected),
+            "artifact": path,
+        },
+        indent=2, sort_keys=True,
+    ))
+    click.echo(f"export PATHWAY_TPU_TUNED_CONFIG={path}", err=True)
+
+
 @cli.group()
 def fleet() -> None:
     """Replicated serving fleet: spawn replicas behind the

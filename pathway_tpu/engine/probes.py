@@ -511,6 +511,7 @@ def unified_snapshot(scheduler_stats=None) -> dict:
             if hasattr(scheduler_stats, "snapshot") else scheduler_stats
         )
     from pathway_tpu.engine import slo as slo_mod
+    from pathway_tpu.internals.config import tuned_config_snapshot
 
     return {
         "scheduler": sched,
@@ -518,6 +519,7 @@ def unified_snapshot(scheduler_stats=None) -> dict:
         "engine": engine_snapshot(),
         "hbm": hbm_stats(),
         "slo": slo_mod.slo_snapshot(),
+        "tuning": tuned_config_snapshot(),
         "registry": REGISTRY.snapshot(),
     }
 

@@ -22,16 +22,21 @@ cached at import, so tests can flip them per-run with
 
 from __future__ import annotations
 
+import contextlib
+import json
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Any
+
+_TRUTHY = ("1", "true", "yes", "on")
 
 
 def _env_bool(name: str, default: bool = False) -> bool:
     v = os.environ.get(name)
     if v is None:
         return default
-    return v.strip().lower() in ("1", "true", "yes", "on")
+    return v.strip().lower() in _TRUTHY
 
 
 def _parse_kv_quant(raw: str) -> str:
@@ -40,6 +45,49 @@ def _parse_kv_quant(raw: str) -> str:
     return "int8" if raw.strip().lower() in (
         "1", "true", "yes", "on", "int8"
     ) else ""
+
+
+@dataclass(frozen=True)
+class Tunable:
+    """Search-space declaration for one flag — what the autotuner
+    (``pathway_tpu/tuning/``) may try. ``kind`` is ``"int"`` /
+    ``"float"`` (a ``[lo, hi]`` range walked additively by ``step`` or
+    multiplicatively — a doubling ladder — when ``log=True``) or
+    ``"choice"`` (an explicit value tuple; the only legal kind for
+    ``bool``/``str`` flags). Bounds must be finite and contain the
+    flag's default — rule ``GL204`` (``tunable-bounds``) enforces it."""
+
+    kind: str = "int"  # "int" | "float" | "choice"
+    lo: float | None = None
+    hi: float | None = None
+    step: float | None = None
+    log: bool = False
+    choices: tuple = ()
+
+    def candidates(self) -> tuple[str, ...]:
+        """The deterministic candidate ladder, as raw env-var strings
+        (the tuner feeds them through the flag's own parser)."""
+        if self.kind == "choice":
+            return tuple(str(c) for c in self.choices)
+        vals: list[float] = []
+        v = float(self.lo)
+        while v <= float(self.hi) + 1e-9:
+            vals.append(v)
+            v = v * 2.0 if self.log else v + float(self.step or 1)
+        if self.kind == "int":
+            return tuple(str(int(round(x))) for x in vals)
+        return tuple(str(x) for x in vals)
+
+    def contains(self, raw: Any) -> bool:
+        """Is ``raw`` (an env-var string or parsed value) inside the
+        declared space? Used to validate tuned-config artifacts."""
+        if self.kind == "choice":
+            return str(raw) in {str(c) for c in self.choices}
+        try:
+            v = float(raw)
+        except (TypeError, ValueError):
+            return False
+        return float(self.lo) <= v <= float(self.hi)
 
 
 @dataclass(frozen=True)
@@ -59,7 +107,19 @@ class Flag:
     The contract is analyzer-enforced (rule ``GL301``,
     ``python -m pathway_tpu.analysis check``): the file must exist and
     reference the env var, so renaming or deleting a pinning test fails
-    CI instead of silently un-pinning the switch."""
+    CI instead of silently un-pinning the switch.
+
+    ``reload`` declares WHEN the value is consumed: ``"live"`` flags are
+    re-read on every use, so flipping them mid-process takes effect
+    immediately; ``"construction"`` flags are read once when the
+    consuming object is built (a server, scheduler, chaos site, lock,
+    the SLO watchdog singleton) and flipping them later silently
+    no-ops. :func:`flag_overrides` refuses construction flags unless
+    the caller owns construction (``construction=True``), which is how
+    the autotuner avoids the mid-trial-no-op bug class.
+
+    ``tunable`` (a :class:`Tunable`) declares the search space the
+    autotuner may explore; None means hand-tuned only."""
 
     env: str
     kind: str  # "bool" | "int" | "float" | "str"
@@ -71,19 +131,28 @@ class Flag:
     parse: Any = None
     kill_switch: bool = False
     pinned_by: str | None = None
+    reload: str = "live"  # "live" | "construction"
+    tunable: Tunable | None = None
 
-    def read(self) -> Any:
+    def parse_raw(self, raw: str) -> Any:
+        """Parse one raw env-var string with this flag's own semantics
+        (kind parser / ``parse`` override / ``minimum`` clamp) — the
+        single code path for environment, override and tuned-config
+        values alike."""
         if self.kind == "bool":
-            return _env_bool(self.env, self.default)
-        raw = os.environ.get(self.env)
-        if raw is None:
-            return self.default
+            return raw.strip().lower() in _TRUTHY
         if self.parse is not None:
             return self.parse(raw)
         val = {"int": int, "float": float, "str": str}[self.kind](raw)
         if self.minimum is not None:
             val = max(type(val)(self.minimum), val)
         return val
+
+    def read(self) -> Any:
+        raw = _raw_flag_value(self.env)
+        if raw is None:
+            return self.default
+        return self.parse_raw(raw)
 
     def render_default(self) -> str:
         if self.kind == "bool":
@@ -97,6 +166,7 @@ FLAG_REGISTRY: list[Flag] = [
     # ---- ungrouped (documented in prose, not a README table) ----------
     Flag(
         env="PATHWAY_FUSION", kind="bool", default=True, attr="fusion",
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_fusion.py",
         doc="Stateless operator-chain fusion (scheduler plan rewrite, "
             "`engine/graph.py:fuse_chains`); read per scheduler "
@@ -111,6 +181,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_DISABLE_NATIVE", kind="bool", default=False,
+        reload="construction",
         attr="disable_native",
         doc="Skip loading the optional native extension in "
             "`pathway_tpu/native/` and use the pure-Python fallbacks "
@@ -146,6 +217,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PIPELINE_DEPTH", kind="int", default=2,
+        reload="construction",
+        tunable=Tunable("int", lo=1, hi=8, log=True),
         attr="tpu_pipeline_depth", group="pipeline", minimum=1,
         doc="Dispatch-ahead depth: how many batches may be staged/in "
             "flight beyond the one computing. Bounds live input buffers "
@@ -153,12 +226,16 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PIPELINE_QUEUE", kind="int", default=8,
+        reload="construction",
+        tunable=Tunable("int", lo=2, hi=32, log=True),
         attr="tpu_pipeline_queue", group="pipeline", minimum=1,
         doc="Tokenizer→dispatch queue bound; `embed_submit` blocks "
             "(backpressure) once this many tokenized batches wait.",
     ),
     Flag(
         env="PATHWAY_TPU_CHUNKED_PREFILL", kind="bool", default=True,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "1")),
         kill_switch=True, pinned_by="tests/test_chunk_admission.py",
         attr="chunked_prefill", group="pipeline",
         doc="Continuous serving: admit a long prompt in "
@@ -168,12 +245,16 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PREFILL_CHUNK", kind="int", default=64,
+        reload="construction",
+        tunable=Tunable("int", lo=8, hi=256, log=True),
         attr="prefill_chunk", group="pipeline", minimum=8,
         doc="Piece size for chunked prefill (pow2-rounded, min 8). "
             "Prompt buckets at or below it prefill one-shot.",
     ),
     Flag(
         env="PATHWAY_TPU_EAGER_REFILL", kind="bool", default=True,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "1")),
         kill_switch=True, pinned_by="tests/test_chunk_admission.py",
         attr="eager_refill", group="pipeline",
         doc="Free a serving slot the moment its request's token budget "
@@ -222,6 +303,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_DRAIN_COALESCE_MAX", kind="int", default=8,
+        tunable=Tunable("int", lo=1, hi=32, log=True),
         attr="drain_coalesce_max", group="pipeline", minimum=1,
         doc="Most resolved chunks merged into one drain injection "
             "(bounds the latency a coalesced group can add while the "
@@ -239,6 +321,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_BATCH_ADMIT", kind="bool", default=True,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "1")),
         kill_switch=True, pinned_by="tests/test_chunk_admission.py",
         attr="batch_admit", group="pipeline",
         doc="Continuous serving: requests waiting at the same chunk "
@@ -249,6 +333,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PREFILL_OVERLAP", kind="bool", default=True,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "1")),
         kill_switch=True, pinned_by="tests/test_chunk_admission.py",
         attr="prefill_overlap", group="pipeline",
         doc="Serving loop dispatches the next decode chunk BEFORE "
@@ -257,6 +343,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_CHUNK_AUTOTUNE", kind="bool", default=True,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "1")),
         kill_switch=True, pinned_by="tests/test_chunk_admission.py",
         attr="chunk_autotune", group="pipeline",
         doc="Serving loop adapts `chunk_steps` to queue pressure (small "
@@ -266,6 +354,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PREFIX_CACHE", kind="bool", default=True,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "1")),
         kill_switch=True, pinned_by="tests/test_prefix_cache.py",
         attr="prefix_cache", group="pipeline",
         doc="Radix-tree KV prefix cache for continuous serving: "
@@ -278,6 +368,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PREFIX_CACHE_MB", kind="float", default=64,
+        reload="construction",
+        tunable=Tunable("float", lo=8, hi=256, log=True),
         attr="prefix_cache_mb", group="pipeline", minimum=0,
         doc="HBM byte budget for the prefix arena; the block count is "
             "derived from the model's per-block KV footprint, and LRU "
@@ -286,6 +378,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PREFIX_BLOCK", kind="int", default=0,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "8", "16", "32", "64")),
         attr="prefix_block", group="pipeline", minimum=0,
         doc="Cache block size in tokens; `0` = auto (the prefill "
             "chunk). Always pow2-rounded up to a multiple of "
@@ -294,6 +388,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SPEC_DECODE", kind="bool", default=True,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "1")),
         kill_switch=True, pinned_by="tests/test_spec_decode.py",
         attr="spec_decode", group="pipeline",
         doc="Self-speculative decoding for greedy continuous serving: "
@@ -309,6 +405,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SPEC_DECODE_DRAFT_LAYERS", kind="int",
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "1", "2")),
         default=0, attr="spec_draft_layers", group="pipeline", minimum=0,
         doc="Draft-stack depth for self-speculative decode; `0` = auto "
             "(`max(1, layers // 4)`), always clamped to `layers - 1`. "
@@ -317,6 +415,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SPEC_DECODE_K", kind="int", default=3,
+        reload="construction",
+        tunable=Tunable("int", lo=1, hi=8, step=1),
         attr="spec_k", group="pipeline", minimum=1,
         doc="Draft tokens proposed per speculative cycle (the verify "
             "pass scores k+1 positions in one dispatch). Larger k "
@@ -325,6 +425,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_KV_QUANT", kind="str", default="",
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_kv_quant.py",
         attr="kv_quant", group="pipeline", parse=_parse_kv_quant,
         doc="`int8` stores the KV slot pool AND the prefix-cache arena "
@@ -337,6 +438,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PAGED_KV", kind="bool", default=False,
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_paged_kv.py",
         attr="paged_kv", group="pipeline",
         doc="Paged KV store for continuous serving: slots reference "
@@ -351,6 +453,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PAGED_KV_BLOCK", kind="int", default=0,
+        reload="construction",
         attr="paged_kv_block", group="pipeline", minimum=0,
         doc="Paged KV block size in tokens; `0` = auto (the prefix-cache "
             "block, itself pow2-rounded from the prefill chunk). The "
@@ -360,6 +463,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PAGED_KV_BLOCKS", kind="int", default=0,
+        reload="construction",
         attr="paged_kv_blocks", group="pipeline", minimum=0,
         doc="Total physical blocks in the paged pool; `0` = auto (every "
             "slot's worst case plus the prefix-cache budget plus the "
@@ -369,6 +473,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PAGED_KERNEL", kind="bool", default=False,
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_paged_kv.py",
         attr="paged_kernel", group="pipeline",
         doc="Pallas paged-attention decode kernel (requires "
@@ -383,6 +488,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_DISAGG", kind="bool", default=False,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "1")),
         kill_switch=True, pinned_by="tests/test_disagg.py",
         attr="disagg", group="pipeline",
         doc="Disaggregated prefill/decode lanes for continuous serving: "
@@ -398,6 +505,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_DISAGG_PREFILL_BUDGET", kind="int", default=1,
+        reload="construction",
+        tunable=Tunable("int", lo=1, hi=4, step=1),
         attr="disagg_prefill_budget", group="pipeline", minimum=1,
         doc="Prefill-lane width under `PATHWAY_TPU_DISAGG`: how many "
             "pending prefill pieces may dispatch per loop tick while "
@@ -408,6 +517,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_PREFIX_T2_MB", kind="float", default=0.0,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "16", "64")),
         kill_switch=True, pinned_by="tests/test_prefix_cache.py",
         attr="prefix_t2_mb", group="pipeline", minimum=0,
         doc="Host-RAM byte budget for the prefix cache's second tier: "
@@ -452,6 +563,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_MESH", kind="bool", default=False,
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_mesh_serving.py",
         attr="mesh", group="pipeline",
         doc="GSPMD mesh-sharded serving: decoder/embedder params get "
@@ -466,6 +578,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_MESH_DATA", kind="int", default=1,
+        reload="construction",
         attr="mesh_data", group="pipeline", minimum=1,
         doc="`data` axis length of the serving mesh (replica/batch "
             "dimension). `data * fsdp * tp` must equal the device "
@@ -474,6 +587,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_MESH_FSDP", kind="int", default=1,
+        reload="construction",
         attr="mesh_fsdp", group="pipeline", minimum=1,
         doc="`fsdp` axis length of the serving mesh: parameters not "
             "tensor-sharded by `tp` split their first divisible dim "
@@ -482,6 +596,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_MESH_TP", kind="int", default=0,
+        reload="construction",
         attr="mesh_tp", group="pipeline", minimum=0,
         doc="`tp` (tensor-parallel) axis length of the serving mesh: "
             "attention heads, ffn features and the KV pool's head axis "
@@ -535,6 +650,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_LATE_DIM", kind="int", default=32,
+        reload="construction",
         attr="late_dim", group="query", minimum=8,
         doc="Compressed per-token dimension of the late-interaction "
             "doc bank — the width MaxSim dots query tokens against.",
@@ -549,18 +665,23 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_QUERY_TICK_MS", kind="float", default=2.0,
+        reload="construction",
+        tunable=Tunable("float", lo=0.5, hi=8, log=True),
         attr="query_tick_ms", group="query", minimum=0,
         doc="Micro-batch window: how long the first queued query waits "
             "for companions before the tick dispatches.",
     ),
     Flag(
         env="PATHWAY_TPU_QUERY_MAX_BATCH", kind="int", default=64,
+        reload="construction",
+        tunable=Tunable("int", lo=8, hi=128, log=True),
         attr="query_max_batch", group="query", minimum=1,
         doc="Max queries coalesced into one tick (rows pad to pow2 "
             "buckets).",
     ),
     Flag(
         env="PATHWAY_TPU_QUERY_QUEUE", kind="int", default=256,
+        reload="construction",
         attr="query_queue", group="query", minimum=1,
         doc="Pending-request bound; `submit` blocks (backpressure) "
             "beyond it.",
@@ -595,6 +716,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_LOCK_SANITIZER", kind="bool", default=False,
+        reload="construction",
         attr="lock_sanitizer", group="observability",
         doc="Runtime race harness (`pathway_tpu/analysis/runtime.py`): "
             "locks built through `analysis.runtime.make_lock` record "
@@ -608,6 +730,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_OP_METRICS", kind="bool", default=True,
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_engine_telemetry.py",
         attr="op_metrics", group="observability",
         doc="Per-operator dataflow telemetry (registry "
@@ -632,6 +755,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SLO_TTFT_P95_MS", kind="float", default=0.0,
+        reload="construction",
         attr="slo_ttft_p95_ms", group="observability",
         doc="SLO objective: serving TTFT p95 ceiling in ms "
             "(`engine/slo.py` watchdog). `0` (default) disables the "
@@ -639,12 +763,14 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SLO_E2E_P95_MS", kind="float", default=0.0,
+        reload="construction",
         attr="slo_e2e_p95_ms", group="observability",
         doc="SLO objective: request end-to-end p95 ceiling in ms. `0` "
             "(default) disables the objective.",
     ),
     Flag(
         env="PATHWAY_TPU_SLO_OCCUPANCY_MIN", kind="float", default=0.0,
+        reload="construction",
         attr="slo_occupancy_min", group="observability",
         doc="SLO objective: continuous-batching occupancy floor "
             "(useful slot-steps / total, 0..1). `0` (default) disables "
@@ -652,6 +778,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SLO_PREFIX_HIT_MIN", kind="float", default=0.0,
+        reload="construction",
         attr="slo_prefix_hit_min", group="observability",
         doc="SLO objective: prefix-KV-cache token hit-rate floor "
             "(0..1; only judged once the cache has seen requests). `0` "
@@ -659,12 +786,14 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SLO_WINDOW_FAST_S", kind="float", default=60.0,
+        reload="construction",
         attr="slo_window_fast_s", group="observability", minimum=1,
         doc="Fast burn-rate window in seconds: catches an SLO cliff "
             "quickly; the alert clears when this window recovers.",
     ),
     Flag(
         env="PATHWAY_TPU_SLO_WINDOW_SLOW_S", kind="float", default=600.0,
+        reload="construction",
         attr="slo_window_slow_s", group="observability", minimum=1,
         doc="Slow burn-rate window in seconds: confirms a breach is "
             "sustained before the alert fires (both windows must burn "
@@ -672,6 +801,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SLO_BURN_THRESHOLD", kind="float", default=1.0,
+        reload="construction",
         attr="slo_burn_threshold", group="observability",
         doc="Burn-rate alert threshold: alert when (violating fraction "
             "in window) / budget reaches this in BOTH windows. `1.0` "
@@ -680,6 +810,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SLO_BUDGET", kind="float", default=0.1,
+        reload="construction",
         attr="slo_budget", group="observability",
         doc="Error budget: the tolerated fraction of violating samples "
             "within a window (SRE error-budget fraction).",
@@ -687,6 +818,7 @@ FLAG_REGISTRY: list[Flag] = [
     # ------------------------------------------------ fault tolerance
     Flag(
         env="PATHWAY_TPU_CHAOS", kind="float", default=0.0,
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_chaos.py",
         attr="chaos", group="fault", minimum=0,
         doc="Deterministic fault injection (`engine/chaos.py`): the "
@@ -698,6 +830,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_CHAOS_SEED", kind="int", default=0,
+        reload="construction",
         attr="chaos_seed", group="fault",
         doc="Seed for the per-site chaos RNGs: the same (seed, site) "
             "pair yields the same fault schedule across runs and "
@@ -705,6 +838,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_CHAOS_SITES", kind="str", default="",
+        reload="construction",
         attr="chaos_sites", group="fault",
         doc="Comma-separated chaos site names (or dotted prefixes, e.g. "
             "`decode` arms `decode.admit` and `decode.dispatch`) to "
@@ -713,6 +847,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SERVE_RESTARTS", kind="int", default=0,
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_chaos.py",
         attr="serve_restarts", group="fault", minimum=0,
         doc="Supervised serving: how many times a crashed serving loop "
@@ -724,6 +859,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SERVE_RETRIES", kind="int", default=1,
+        reload="construction",
         attr="serve_retries", group="fault", minimum=0,
         doc="Per-request retry budget under supervised serving: a "
             "request whose admission work faults re-queues up to this "
@@ -732,6 +868,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_REQUEST_DEADLINE_MS", kind="float", default=0.0,
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_chaos.py",
         attr="request_deadline_ms", group="fault", minimum=0,
         doc="Per-request serving deadline in ms, enforced at admission "
@@ -742,6 +879,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_SERVE_QUEUE", kind="int", default=0,
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_chaos.py",
         attr="serve_queue", group="fault", minimum=0,
         doc="Continuous-server submit-queue watermark: a submit landing "
@@ -752,6 +890,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_DEGRADATION", kind="bool", default=True,
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_chaos.py",
         attr="degradation", group="fault",
         doc="SLO-driven degradation ladder (`engine/slo.py`): while the "
@@ -764,6 +903,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_TENANT_SCHED", kind="bool", default=False,
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_disagg.py",
         attr="tenant_sched", group="fault",
         doc="Multi-tenant admission scheduling: `submit(..., tenant=)` "
@@ -779,6 +919,8 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_TENANT_BUDGET", kind="int", default=0,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "64", "128", "256")),
         attr="tenant_budget", group="fault", minimum=0,
         doc="Per-tenant in-flight token budget under "
             "`PATHWAY_TPU_TENANT_SCHED`: a tenant at or over budget is "
@@ -790,6 +932,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_TENANT_WEIGHTS", kind="str", default="",
+        reload="construction",
         attr="tenant_weights", group="fault",
         doc="Comma-separated `tenant:weight` pairs (e.g. "
             "`prod:4,batch:1`) for the weighted-fair admission pop; "
@@ -800,6 +943,7 @@ FLAG_REGISTRY: list[Flag] = [
     # ------------------------------------------------ fleet serving
     Flag(
         env="PATHWAY_TPU_FLEET", kind="bool", default=False,
+        reload="construction",
         kill_switch=True, pinned_by="tests/test_fleet.py",
         attr="fleet", group="fleet",
         doc="Replicated serving fleet (`pathway_tpu/serving/`): a "
@@ -813,6 +957,7 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_FLEET_REPLICAS", kind="int", default=2,
+        reload="construction",
         attr="fleet_replicas", group="fleet", minimum=1,
         doc="Initial replica count the fleet manager spawns at start "
             "(clamped into `[PATHWAY_TPU_FLEET_MIN, "
@@ -820,18 +965,21 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_FLEET_MIN", kind="int", default=1,
+        reload="construction",
         attr="fleet_min", group="fleet", minimum=1,
         doc="Elasticity floor: scale-down never drops the fleet below "
             "this many replicas.",
     ),
     Flag(
         env="PATHWAY_TPU_FLEET_MAX", kind="int", default=4,
+        reload="construction",
         attr="fleet_max", group="fleet", minimum=1,
         doc="Elasticity ceiling: scale-up stops here even while the "
             "SLO burn signal stays hot.",
     ),
     Flag(
         env="PATHWAY_TPU_FLEET_AFFINITY", kind="int", default=4,
+        reload="construction",
         attr="fleet_affinity", group="fleet", minimum=0,
         doc="Prefix-affinity depth: how many prompt-head token BLOCKS "
             "(the prefix-cache block size, `PATHWAY_TPU_PREFIX_BLOCK` "
@@ -842,13 +990,221 @@ FLAG_REGISTRY: list[Flag] = [
     ),
     Flag(
         env="PATHWAY_TPU_FLEET_HEALTH_MS", kind="float", default=500.0,
+        reload="construction",
         attr="fleet_health_ms", group="fleet", minimum=1,
         doc="Fleet-manager health-check cadence in ms: each pass probes "
             "every replica (`/healthz` + `/readyz` on HTTP replicas), "
             "drains dead ones from the ring, requeues their in-flight "
             "requests and respawns with bounded exponential backoff.",
     ),
+    # ------------------------------------------------ autotuning
+    Flag(
+        env="PATHWAY_TPU_TUNED_CONFIG", kind="str", default="",
+        kill_switch=True, pinned_by="tests/test_autotune.py",
+        attr="tuned_config", group="tuning",
+        doc="Path to a tuned-config JSON artifact (written by `python -m "
+            "pathway_tpu.cli tune <profile>`): its `flags` section "
+            "becomes the LOWEST-precedence value source for registry "
+            "flags — explicit env vars and `flag_overrides()` scopes "
+            "still win, flag-by-flag. Unset (default) every flag reads "
+            "exactly as before the artifact existed, byte-identically "
+            "(`tests/test_autotune.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_TUNE_SEED", kind="int", default=0,
+        attr="tune_seed", group="tuning",
+        doc="Seed for the autotuner's candidate shuffling and trial "
+            "traces: the same (seed, profile) pair replays the same "
+            "search, trial for trial.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_TUNE_TRIALS", kind="int", default=0,
+        attr="tune_trials", group="tuning", minimum=0,
+        doc="Hard cap on autotuner trials per search; `0` = auto (the "
+            "successive-halving schedule decides). The CLI `--smoke` "
+            "mode forces a 2-trial cap for seconds-scale CI runs.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_TUNE_CHAOS_RATE", kind="float", default=0.25,
+        attr="tune_chaos_rate", group="tuning", minimum=0,
+        doc="Fault-injection rate for the autotuner's validation drill: "
+            "surviving candidates re-run with `PATHWAY_TPU_CHAOS` at "
+            "this rate (plus a restart budget) and are rejected unless "
+            "every request still reaches a terminal state.",
+    ),
 ]
+
+_REGISTRY_BY_ENV: dict[str, Flag] = {f.env: f for f in FLAG_REGISTRY}
+
+
+# --------------------------------------------------------------------- #
+# override overlay + tuned-config artifact (the autotuner's substrate)
+
+class FlagReloadError(RuntimeError):
+    """Raised when :func:`flag_overrides` is asked to hot-flip a flag
+    whose value is consumed at construction time (``reload=
+    "construction"``) without the caller owning construction — the
+    override would silently no-op on every already-built object."""
+
+
+class TunedConfigError(ValueError):
+    """Raised when ``PATHWAY_TPU_TUNED_CONFIG`` names an artifact that
+    cannot be loaded (missing file, bad JSON, unknown or unparseable
+    flag). Loud on purpose: a tuned config is explicit opt-in, and a
+    silently dropped artifact would masquerade as a perf regression."""
+
+
+_OVERRIDES_LOCK = threading.RLock()
+_FLAG_OVERRIDES: dict[str, str] = {}
+
+
+@contextlib.contextmanager
+def flag_overrides(values: dict[str, Any], *, construction: bool = False):
+    """Scoped flag values that never touch ``os.environ``.
+
+    ``values`` maps registered env names to raw values (stringified with
+    bool→``"1"``/``"0"``); inside the ``with`` block every
+    :meth:`Flag.read` resolves them FIRST, ahead of the real environment
+    and any tuned config. Scopes nest, restore exactly on exit (also on
+    exception), and are process-global — the point is that trial servers
+    running on background threads see them while child processes and
+    concurrent tooling never do. Unknown env names raise ``KeyError``
+    (the GL2xx choke-point discipline extends here: only declared flags
+    have values), and ``reload="construction"`` flags raise
+    :class:`FlagReloadError` unless ``construction=True`` says the
+    caller builds the consuming objects inside the scope."""
+    norm: dict[str, str] = {}
+    for env, val in values.items():
+        flag = _REGISTRY_BY_ENV.get(env)
+        if flag is None:
+            raise KeyError(
+                f"flag_overrides: {env!r} is not in FLAG_REGISTRY — "
+                "every override must name a declared flag"
+            )
+        if flag.reload == "construction" and not construction:
+            raise FlagReloadError(
+                f"flag_overrides: {env} is read at construction time; "
+                "overriding it mid-flight would silently no-op. Pass "
+                "construction=True if the consuming objects are built "
+                "inside the scope."
+            )
+        if isinstance(val, bool):
+            raw = "1" if val else "0"
+        else:
+            raw = str(val)
+        flag.parse_raw(raw)  # surface bad values here, not at first read
+        norm[env] = raw
+    with _OVERRIDES_LOCK:
+        saved = {env: _FLAG_OVERRIDES.get(env) for env in norm}
+        _FLAG_OVERRIDES.update(norm)
+    try:
+        yield
+    finally:
+        with _OVERRIDES_LOCK:
+            for env, prev in saved.items():
+                if prev is None:
+                    _FLAG_OVERRIDES.pop(env, None)
+                else:
+                    _FLAG_OVERRIDES[env] = prev
+
+
+def load_tuned_config(path: str) -> dict[str, str]:
+    """Parse one tuned-config artifact into ``{env: raw_value}``.
+
+    Every key must be a registered flag (``PATHWAY_TPU_TUNED_CONFIG``
+    itself excluded — no recursion) and every value must survive the
+    flag's own parser; anything else raises :class:`TunedConfigError`
+    with the artifact path in the message."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise TunedConfigError(f"tuned config {path!r}: {exc}") from exc
+    if not isinstance(data, dict) or not isinstance(data.get("flags"), dict):
+        raise TunedConfigError(
+            f"tuned config {path!r}: expected a JSON object with a "
+            "'flags' mapping"
+        )
+    out: dict[str, str] = {}
+    for env in sorted(data["flags"]):
+        flag = _REGISTRY_BY_ENV.get(env)
+        if flag is None or env == "PATHWAY_TPU_TUNED_CONFIG":
+            raise TunedConfigError(
+                f"tuned config {path!r}: {env!r} is not a tunable "
+                "registry flag"
+            )
+        val = data["flags"][env]
+        raw = ("1" if val else "0") if isinstance(val, bool) else str(val)
+        try:
+            flag.parse_raw(raw)
+        except (TypeError, ValueError) as exc:
+            raise TunedConfigError(
+                f"tuned config {path!r}: {env}={raw!r} does not parse: "
+                f"{exc}"
+            ) from exc
+        out[env] = raw
+    return out
+
+
+# keyed on (path, mtime_ns, size) so a rewritten artifact — or a test
+# pointing the env var at a different tmp file — re-parses, while steady
+# state costs one stat per read
+_TUNED_CACHE: tuple[tuple[str, int, int], dict[str, str]] | None = None
+
+
+def _tuned_flags() -> dict[str, str]:
+    global _TUNED_CACHE
+    path = _FLAG_OVERRIDES.get("PATHWAY_TPU_TUNED_CONFIG")
+    if path is None:
+        path = os.environ.get("PATHWAY_TPU_TUNED_CONFIG", "")
+    if not path:
+        return {}
+    try:
+        st = os.stat(path)
+        key = (path, st.st_mtime_ns, st.st_size)
+    except OSError as exc:
+        raise TunedConfigError(f"tuned config {path!r}: {exc}") from exc
+    if _TUNED_CACHE is not None and _TUNED_CACHE[0] == key:
+        return _TUNED_CACHE[1]
+    flags = load_tuned_config(path)
+    _TUNED_CACHE = (key, flags)
+    return flags
+
+
+def _raw_flag_value(env: str) -> str | None:
+    """One flag's raw string under the full precedence chain:
+    ``flag_overrides`` scope > explicit environment > tuned-config
+    artifact > (None — caller falls back to the declared default)."""
+    raw = _FLAG_OVERRIDES.get(env)
+    if raw is not None:
+        return raw
+    raw = os.environ.get(env)
+    if raw is not None:
+        return raw
+    if env == "PATHWAY_TPU_TUNED_CONFIG":
+        return None
+    return _tuned_flags().get(env)
+
+
+def tuned_config_snapshot() -> dict[str, Any]:
+    """The ``tuning`` section of ``/v1/statistics``: which artifact (if
+    any) is loaded, the flags it pins, and which of those an explicit
+    env var out-ranks."""
+    path = _FLAG_OVERRIDES.get("PATHWAY_TPU_TUNED_CONFIG")
+    if path is None:
+        path = os.environ.get("PATHWAY_TPU_TUNED_CONFIG", "")
+    if not path:
+        return {"enabled": False, "path": None, "flags": {},
+                "shadowed_by_env": []}
+    flags = _tuned_flags()
+    return {
+        "enabled": True,
+        "path": path,
+        "flags": dict(flags),
+        "shadowed_by_env": sorted(
+            env for env in flags if os.environ.get(env) is not None
+        ),
+    }
 
 
 def env_interpolate(name: str) -> str | None:
@@ -1031,7 +1387,9 @@ def set_monitoring_config(*, server_endpoint: str | None) -> None:
 if __name__ == "__main__":
     # regenerate the README flag tables (paste between the
     # <!-- flags:<group> --> markers)
-    for _group in ("pipeline", "query", "observability", "fault", "fleet"):
+    for _group in (
+        "pipeline", "query", "observability", "fault", "fleet", "tuning",
+    ):
         print(f"<!-- flags:{_group} -->")
         print(render_flag_table(_group))
         print(f"<!-- /flags:{_group} -->")

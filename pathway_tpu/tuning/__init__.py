@@ -1,0 +1,24 @@
+"""Workload-driven autotuner for the flag surface (ROADMAP item 6).
+
+``python -m pathway_tpu.cli tune <profile>`` searches the registry's
+``Tunable`` flags for one :data:`~pathway_tpu.tuning.profiles.PROFILES`
+entry, validates survivors under the SLO watchdog + a chaos drill, and
+persists the winner as a tuned-config JSON that
+``PATHWAY_TPU_TUNED_CONFIG=<path>`` loads at startup (explicit env vars
+still win, flag-by-flag)."""
+
+from pathway_tpu.tuning.profiles import (  # noqa: F401
+    PROFILES,
+    WorkloadProfile,
+    decoder_resources,
+    get_profile,
+    run_trial,
+)
+from pathway_tpu.tuning.search import (  # noqa: F401
+    Autotuner,
+    TuneError,
+    TuneResult,
+    candidate_axes,
+    save_artifact,
+    to_artifact,
+)

@@ -1,0 +1,544 @@
+"""Workload-driven autotuner (`pathway_tpu/tuning/`): the override
+overlay, the tuned-config artifact and its precedence chain, the
+successive-halving search against a synthetic cost model (no device
+work), the SLO/chaos rejection decisions, and the `cli tune` smoke
+path end-to-end.
+
+`PATHWAY_TPU_TUNED_CONFIG` is a kill switch: with it unset every flag
+resolves exactly as before the tuner existed (explicit env var, else
+declared default) — pinned here.
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+from pathway_tpu.internals import config as C
+from pathway_tpu.tuning import (
+    PROFILES,
+    Autotuner,
+    TuneError,
+    WorkloadProfile,
+    candidate_axes,
+    get_profile,
+    save_artifact,
+    to_artifact,
+)
+from pathway_tpu.tuning import search as search_mod
+
+SPEC_K = "PATHWAY_TPU_SPEC_DECODE_K"
+CHUNK = "PATHWAY_TPU_PREFILL_CHUNK"
+
+
+def _flag(env):
+    return C._REGISTRY_BY_ENV[env]
+
+
+# ------------------------------------------------------------------ #
+# flag_overrides: the no-os.environ override overlay
+
+
+def test_flag_overrides_visible_and_environ_untouched(monkeypatch):
+    monkeypatch.delenv(SPEC_K, raising=False)
+    with C.flag_overrides({SPEC_K: "7"}, construction=True):
+        assert C.pathway_config.spec_k == 7
+        assert SPEC_K not in os.environ
+    assert C.pathway_config.spec_k == _flag(SPEC_K).default
+
+
+def test_flag_overrides_nest_and_restore(monkeypatch):
+    monkeypatch.delenv(SPEC_K, raising=False)
+    with C.flag_overrides({SPEC_K: "2"}, construction=True):
+        with C.flag_overrides({SPEC_K: "5"}, construction=True):
+            assert C.pathway_config.spec_k == 5
+        assert C.pathway_config.spec_k == 2
+    assert C.pathway_config.spec_k == _flag(SPEC_K).default
+
+
+def test_flag_overrides_restore_on_exception(monkeypatch):
+    monkeypatch.delenv(SPEC_K, raising=False)
+    with pytest.raises(RuntimeError, match="boom"):
+        with C.flag_overrides({SPEC_K: "3"}, construction=True):
+            raise RuntimeError("boom")
+    assert C.pathway_config.spec_k == _flag(SPEC_K).default
+
+
+def test_flag_overrides_beat_explicit_env(monkeypatch):
+    monkeypatch.setenv(SPEC_K, "2")
+    with C.flag_overrides({SPEC_K: "6"}, construction=True):
+        assert C.pathway_config.spec_k == 6
+    assert C.pathway_config.spec_k == 2
+
+
+def test_flag_overrides_reject_unregistered_env():
+    with pytest.raises(KeyError, match="NOT_A_FLAG"):
+        with C.flag_overrides({"PATHWAY_TPU_NOT_A_FLAG": "1"}):
+            pass
+
+
+def test_flag_overrides_refuse_construction_flags_by_default():
+    """A construction-read knob hot-flipped mid-flight would silently
+    no-op on every already-built server — the overlay refuses unless the
+    caller declares it owns construction."""
+    assert _flag(CHUNK).reload == "construction"
+    with pytest.raises(C.FlagReloadError, match="construction"):
+        with C.flag_overrides({CHUNK: "64"}):
+            pass
+    with C.flag_overrides({CHUNK: "64"}, construction=True):
+        assert C.pathway_config.prefill_chunk == 64
+
+
+def test_flag_overrides_validate_values_at_entry():
+    with pytest.raises(ValueError):
+        with C.flag_overrides({SPEC_K: "not-an-int"}, construction=True):
+            pass
+
+
+def test_flag_overrides_bool_normalization(monkeypatch):
+    monkeypatch.delenv("PATHWAY_TPU_SPEC_DECODE", raising=False)
+    with C.flag_overrides(
+        {"PATHWAY_TPU_SPEC_DECODE": False}, construction=True
+    ):
+        assert C.pathway_config.spec_decode is False
+
+
+# ------------------------------------------------------------------ #
+# reload declarations (construction-read audit)
+
+
+def test_reload_declarations_well_formed():
+    for f in C.FLAG_REGISTRY:
+        assert f.reload in ("live", "construction"), f.env
+
+
+def test_known_construction_and_live_flags():
+    """Spot-pin the audit: serving/SLO knobs are read once when the
+    consuming object is built; observability toggles re-read per use."""
+    construction = [
+        CHUNK, SPEC_K, "PATHWAY_TPU_SPEC_DECODE",
+        "PATHWAY_TPU_PREFIX_CACHE_MB", "PATHWAY_TPU_QUERY_TICK_MS",
+        "PATHWAY_TPU_SLO_E2E_P95_MS", "PATHWAY_TPU_CHAOS",
+        "PATHWAY_TPU_TENANT_BUDGET",
+    ]
+    live = [
+        "PATHWAY_TPU_METRICS", "PATHWAY_TPU_LATE_INTERACTION",
+        "PATHWAY_TPU_DRAIN_COALESCE", "PATHWAY_TPU_TUNED_CONFIG",
+    ]
+    for env in construction:
+        assert _flag(env).reload == "construction", env
+    for env in live:
+        assert _flag(env).reload == "live", env
+
+
+def test_every_tunable_is_well_bounded():
+    """Registry-wide GL204 invariant, enforced here too so a malformed
+    spec fails fast even without the analyzer."""
+    from pathway_tpu.analysis.flag_hygiene import check_tunable_bounds
+
+    assert check_tunable_bounds(C.FLAG_REGISTRY) == []
+
+
+# ------------------------------------------------------------------ #
+# tuned-config artifact: precedence chain + loud failure
+
+
+def _write_artifact(tmp_path, flags, name="tuned.json", **extra):
+    path = tmp_path / name
+    path.write_text(json.dumps({"version": 1, "flags": flags, **extra}))
+    return str(path)
+
+
+def test_tuned_config_roundtrip(monkeypatch, tmp_path):
+    monkeypatch.delenv(SPEC_K, raising=False)
+    path = _write_artifact(tmp_path, {SPEC_K: "6"})
+    monkeypatch.setenv("PATHWAY_TPU_TUNED_CONFIG", path)
+    assert C.pathway_config.spec_k == 6
+    snap = C.tuned_config_snapshot()
+    assert snap["enabled"] is True
+    assert snap["path"] == path
+    assert snap["flags"] == {SPEC_K: "6"}
+    assert snap["shadowed_by_env"] == []
+
+
+def test_explicit_env_beats_tuned_config(monkeypatch, tmp_path):
+    path = _write_artifact(tmp_path, {SPEC_K: "6"})
+    monkeypatch.setenv("PATHWAY_TPU_TUNED_CONFIG", path)
+    monkeypatch.setenv(SPEC_K, "3")
+    assert C.pathway_config.spec_k == 3
+    assert C.tuned_config_snapshot()["shadowed_by_env"] == [SPEC_K]
+
+
+def test_override_scope_beats_env_and_tuned(monkeypatch, tmp_path):
+    path = _write_artifact(tmp_path, {SPEC_K: "6"})
+    monkeypatch.setenv("PATHWAY_TPU_TUNED_CONFIG", path)
+    monkeypatch.setenv(SPEC_K, "3")
+    with C.flag_overrides({SPEC_K: "8"}, construction=True):
+        assert C.pathway_config.spec_k == 8
+
+
+def test_tuned_config_kill_switch_unset_means_defaults(monkeypatch):
+    """With `PATHWAY_TPU_TUNED_CONFIG` unset, resolution is exactly
+    pre-tuner: explicit env var, else declared default."""
+    monkeypatch.delenv("PATHWAY_TPU_TUNED_CONFIG", raising=False)
+    monkeypatch.delenv(SPEC_K, raising=False)
+    assert C.pathway_config.spec_k == _flag(SPEC_K).default
+    assert C.tuned_config_snapshot() == {
+        "enabled": False, "path": None, "flags": {},
+        "shadowed_by_env": [],
+    }
+
+
+def test_tuned_config_missing_file_is_loud(monkeypatch, tmp_path):
+    monkeypatch.setenv(
+        "PATHWAY_TPU_TUNED_CONFIG", str(tmp_path / "absent.json")
+    )
+    with pytest.raises(C.TunedConfigError, match="absent.json"):
+        C.pathway_config.spec_k  # noqa: B018
+
+
+def test_tuned_config_rejects_unknown_flag(tmp_path):
+    path = _write_artifact(tmp_path, {"PATHWAY_TPU_NOT_A_FLAG": "1"})
+    with pytest.raises(C.TunedConfigError, match="NOT_A_FLAG"):
+        C.load_tuned_config(path)
+
+
+def test_tuned_config_rejects_unparseable_value(tmp_path):
+    path = _write_artifact(tmp_path, {SPEC_K: "banana"})
+    with pytest.raises(C.TunedConfigError, match="does not parse"):
+        C.load_tuned_config(path)
+
+
+def test_tuned_config_rejects_recursion(tmp_path):
+    path = _write_artifact(
+        tmp_path, {"PATHWAY_TPU_TUNED_CONFIG": "other.json"}
+    )
+    with pytest.raises(C.TunedConfigError):
+        C.load_tuned_config(path)
+
+
+def test_tuned_config_rejects_non_object(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text("[1, 2, 3]")
+    with pytest.raises(C.TunedConfigError, match="flags"):
+        C.load_tuned_config(str(path))
+
+
+def test_unified_snapshot_has_tuning_section(monkeypatch, tmp_path):
+    from pathway_tpu.engine.probes import unified_snapshot
+
+    monkeypatch.delenv("PATHWAY_TPU_TUNED_CONFIG", raising=False)
+    snap = unified_snapshot()
+    assert snap["tuning"]["enabled"] is False
+    path = _write_artifact(tmp_path, {SPEC_K: "6"})
+    monkeypatch.setenv("PATHWAY_TPU_TUNED_CONFIG", path)
+    snap = unified_snapshot()
+    assert snap["tuning"]["enabled"] is True
+    assert snap["tuning"]["flags"] == {SPEC_K: "6"}
+
+
+# ------------------------------------------------------------------ #
+# the search, against a synthetic cost model (no device work)
+
+
+def _synthetic_profile(tunables=(CHUNK,)):
+    return WorkloadProfile(
+        name="synthetic", doc="test-only", headline="tok_s",
+        direction="max", tunables=tuple(tunables),
+    )
+
+
+def _cost_evaluate(flags, scale, deadline_s):
+    """Deterministic cost model peaking at PREFILL_CHUNK=128 (off the
+    default of 64) and
+    SPEC_DECODE_K=8, additive across axes."""
+    chunk = float(flags.get(CHUNK, _flag(CHUNK).default))
+    k = float(flags.get(SPEC_K, _flag(SPEC_K).default))
+    tok_s = (
+        200.0
+        - 40.0 * abs(math.log2(chunk) - math.log2(128.0))
+        + 5.0 * k
+    )
+    return {"tok_s": round(tok_s, 3), "terminal_ok": True,
+            "aborted": False, "wall_s": 0.01, "shed": 0}
+
+
+def _ok_validate(flags):
+    return True, "", {"synthetic": True}
+
+
+def test_candidate_axes_excludes_defaults():
+    axes = candidate_axes(_synthetic_profile())
+    assert CHUNK in axes
+    default = _flag(CHUNK).render_default()
+    assert default not in axes[CHUNK]
+    assert len(axes[CHUNK]) >= 2
+
+
+def test_candidate_axes_requires_tunable_spec():
+    prof = _synthetic_profile(tunables=("PATHWAY_TPU_METRICS",))
+    with pytest.raises(TuneError, match="no Tunable spec"):
+        candidate_axes(prof)
+
+
+def test_search_converges_on_synthetic_optimum():
+    tuner = Autotuner(
+        _synthetic_profile(), seed=0,
+        evaluate=_cost_evaluate, validate=_ok_validate,
+    )
+    result = tuner.run()
+    assert result.winner == {CHUNK: "128"}
+    assert result.winner_score > result.baseline_score
+    assert result.validation == {"synthetic": True}
+
+
+def test_search_is_deterministic_per_seed():
+    def run(seed):
+        return Autotuner(
+            _synthetic_profile((CHUNK, SPEC_K)), seed=seed,
+            evaluate=_cost_evaluate, validate=_ok_validate,
+        ).run()
+
+    a, b = run(3), run(3)
+    assert a.winner == b.winner
+    assert [t["flags"] for t in a.trials] == [t["flags"] for t in b.trials]
+    assert a.winner_score == b.winner_score
+
+
+def test_search_composes_per_axis_winners():
+    result = Autotuner(
+        _synthetic_profile((CHUNK, SPEC_K)), seed=0,
+        evaluate=_cost_evaluate, validate=_ok_validate,
+    ).run()
+    # additive cost model: the combined candidate dominates both axes
+    assert result.winner == {CHUNK: "128", SPEC_K: "8"}
+
+
+def test_search_drops_crashing_configs():
+    def evaluate(flags, scale, deadline_s):
+        if flags.get(CHUNK) == "128":
+            raise RuntimeError("synthetic crash")
+        return _cost_evaluate(flags, scale, deadline_s)
+
+    result = Autotuner(
+        _synthetic_profile(), seed=0,
+        evaluate=evaluate, validate=_ok_validate,
+    ).run()
+    assert result.winner != {CHUNK: "128"}
+
+
+def test_all_rejected_raises_tune_error():
+    def reject(flags):
+        return False, "slo_breach", {"synthetic": True}
+
+    tuner = Autotuner(
+        _synthetic_profile(), seed=0,
+        evaluate=_cost_evaluate, validate=reject,
+    )
+    with pytest.raises(TuneError, match="slo_breach"):
+        tuner.run()
+
+
+def test_rejection_falls_through_to_next_candidate():
+    rejected_first = []
+
+    def validate(flags):
+        if not rejected_first:
+            rejected_first.append(dict(flags))
+            return False, "chaos_shed", {}
+        return True, "", {}
+
+    result = Autotuner(
+        _synthetic_profile(), seed=0,
+        evaluate=_cost_evaluate, validate=validate,
+    ).run()
+    assert result.rejected and result.rejected[0]["reason"] == "chaos_shed"
+    assert result.winner != rejected_first[0]
+
+
+def test_max_trials_caps_candidate_pool():
+    seen = []
+
+    def evaluate(flags, scale, deadline_s):
+        seen.append(dict(flags))
+        return _cost_evaluate(flags, scale, deadline_s)
+
+    Autotuner(
+        _synthetic_profile(), seed=0, max_trials=2, rounds=1,
+        evaluate=evaluate, validate=_ok_validate,
+    ).run()
+    assert len(seen) <= 3  # baseline + 1 candidate (+ compose never fires)
+
+
+def test_empty_search_space_raises():
+    prof = _synthetic_profile(tunables=())
+    with pytest.raises(TuneError, match="empty search space"):
+        Autotuner(prof, seed=0, evaluate=_cost_evaluate,
+                  validate=_ok_validate).run()
+
+
+# ------------------------------------------------------------------ #
+# _real_validate decision logic (run_trial stubbed: no servers)
+
+
+def _validate_with(monkeypatch, slo_metrics, chaos_metrics):
+    calls = []
+
+    def fake_run_trial(profile, flags, **kw):
+        calls.append((dict(flags), dict(kw)))
+        return dict(slo_metrics if kw.get("arm_slo") else chaos_metrics)
+
+    monkeypatch.setattr(search_mod.profiles_mod, "run_trial", fake_run_trial)
+    tuner = Autotuner(get_profile("smoke"), seed=0)
+    return tuner._real_validate({CHUNK: "64"}), calls
+
+
+_CLEAN = {"terminal_ok": True, "shed": 0, "failures": 0,
+          "slo_alerting": [], "slo_breaches": 0}
+
+
+def test_real_validate_accepts_clean_runs(monkeypatch):
+    (ok, reason, detail), calls = _validate_with(
+        monkeypatch, _CLEAN, _CLEAN
+    )
+    assert ok and reason == ""
+    assert set(detail) == {"slo", "chaos"}
+    # SLO leg arms the watchdog with the profile objectives; chaos leg
+    # arms the drill flags
+    slo_flags, slo_kw = calls[0]
+    assert slo_kw.get("arm_slo") is True
+    chaos_flags, _ = calls[1]
+    assert chaos_flags["PATHWAY_TPU_CHAOS_SITES"] == "decode.admit"
+    assert float(chaos_flags["PATHWAY_TPU_CHAOS"]) > 0
+
+
+def test_real_validate_rejects_slo_breach(monkeypatch):
+    (ok, reason, _), _ = _validate_with(
+        monkeypatch, {**_CLEAN, "slo_alerting": ["e2e_p95_ms"]}, _CLEAN
+    )
+    assert not ok and reason == "slo_breach"
+
+
+def test_real_validate_rejects_slo_shed(monkeypatch):
+    (ok, reason, _), _ = _validate_with(
+        monkeypatch, {**_CLEAN, "shed": 2}, _CLEAN
+    )
+    assert not ok and reason == "slo_leg_shed_or_failed"
+
+
+def test_real_validate_rejects_chaos_shed(monkeypatch):
+    (ok, reason, _), _ = _validate_with(
+        monkeypatch, _CLEAN, {**_CLEAN, "shed": 1}
+    )
+    assert not ok and reason == "chaos_shed"
+
+
+def test_real_validate_rejects_chaos_non_terminal(monkeypatch):
+    (ok, reason, _), _ = _validate_with(
+        monkeypatch, _CLEAN, {**_CLEAN, "terminal_ok": False}
+    )
+    assert not ok and reason == "chaos_not_terminal"
+
+
+def test_real_validate_skips_chaos_without_fault_surface(monkeypatch):
+    calls = []
+
+    def fake_run_trial(profile, flags, **kw):
+        calls.append(kw)
+        return dict(_CLEAN)
+
+    monkeypatch.setattr(search_mod.profiles_mod, "run_trial", fake_run_trial)
+    tuner = Autotuner(get_profile("retraction_heavy_ingest"), seed=0)
+    ok, reason, detail = tuner._real_validate({})
+    assert ok and len(calls) == 1 and "chaos" not in detail
+
+
+# ------------------------------------------------------------------ #
+# artifact persistence + the profile catalogue
+
+
+def test_artifact_roundtrips_through_loader(tmp_path):
+    result = Autotuner(
+        _synthetic_profile(), seed=0,
+        evaluate=_cost_evaluate, validate=_ok_validate,
+    ).run()
+    path = str(tmp_path / "tuned.json")
+    save_artifact(result, path)
+    art = json.loads(open(path, encoding="utf-8").read())
+    assert art["version"] == search_mod.ARTIFACT_VERSION
+    assert art["profile"] == "synthetic"
+    assert C.load_tuned_config(path) == result.winner
+    assert to_artifact(result)["flags"] == result.winner
+
+
+def test_profiles_catalogue_well_formed():
+    assert {"long_doc_rag", "shared_prefix_chat", "multi_tenant_burst",
+            "retraction_heavy_ingest", "smoke"} <= set(PROFILES)
+    for p in PROFILES.values():
+        assert p.direction in ("max", "min"), p.name
+        assert p.kind in ("serving", "ingest"), p.name
+        axes = candidate_axes(p)  # every tunable has a healthy spec
+        assert axes, p.name
+        for env in p.tunables:
+            assert _flag(env).reload in ("live", "construction")
+    with pytest.raises(KeyError, match="unknown workload profile"):
+        get_profile("nope")
+
+
+# ------------------------------------------------------------------ #
+# cli tune (in-process; the smoke profile is seconds-scale)
+
+
+def test_cli_tune_smoke_end_to_end(tmp_path, monkeypatch):
+    """`cli tune smoke --smoke` — the tier-1 guard for the whole
+    search → validate → persist path: runs real trials against the real
+    continuous server and writes a loadable artifact."""
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli as cli_group
+
+    monkeypatch.delenv("PATHWAY_TPU_TUNED_CONFIG", raising=False)
+    out = str(tmp_path / "tuned-smoke.json")
+    res = CliRunner().invoke(
+        cli_group, ["tune", "smoke", "--smoke", "--out", out],
+        catch_exceptions=False,
+    )
+    assert res.exit_code == 0, res.output
+    summary = json.loads(
+        res.output[res.output.index("{"):res.output.rindex("}") + 1]
+    )
+    assert summary["profile"] == "smoke"
+    assert summary["artifact"] == out
+    flags = C.load_tuned_config(out)  # parses clean
+    for env, raw in flags.items():
+        assert _flag(env).tunable.contains(raw), (env, raw)
+
+
+def test_cli_tune_unknown_profile_exits_2():
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli as cli_group
+
+    res = CliRunner().invoke(cli_group, ["tune", "nope"])
+    assert res.exit_code == 2
+    assert "unknown profile" in res.output
+
+
+def test_cli_tune_all_rejected_exits_nonzero(monkeypatch):
+    from click.testing import CliRunner
+
+    import pathway_tpu.tuning as tuning_mod
+    from pathway_tpu.cli import cli as cli_group
+
+    class _Failing:
+        def __init__(self, *a, **kw):
+            pass
+
+        def run(self):
+            raise tuning_mod.TuneError("no candidate survived validation")
+
+    monkeypatch.setattr(tuning_mod, "Autotuner", _Failing)
+    res = CliRunner().invoke(cli_group, ["tune", "smoke", "--smoke"])
+    assert res.exit_code == 3
+    assert "tune failed" in res.output

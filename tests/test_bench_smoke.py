@@ -58,8 +58,24 @@ def test_bench_smoke_schema():
         # cheap stage off the ingest-time token bank + listwise LLM stage
         "maxsim_p50_ms", "maxsim_top8_overlap", "late_bank_build_ms",
         "llm_rerank_overlap",
+        # workload-driven autotuner (ISSUE 17): the --tuned arm replays
+        # two profiles default-vs-tuned off a validated config
+        "tuned_tok_s", "default_tok_s", "tuned",
     ):
         assert s.get(key) is not None, key
+    # the --tuned arm: both profiles ran both legs, the measured config
+    # came out of validation with zero SLO alerts and zero sheds, and
+    # the default legs of a chaos-off bench shed nothing either
+    tuned = s["tuned"]
+    assert tuned["source"] in ("inline_micro_tune", "artifact")
+    for pname in ("shared_prefix_chat", "long_doc_rag"):
+        tp = tuned["profiles"][pname]
+        assert tp["default"] is not None and tp["tuned"] is not None
+        assert tp["improvement_x"] is not None
+        assert tp["validation_alerts"] == 0
+        assert tp["validation_sheds"] == 0
+        assert tp["sheds"] == 0
+    assert s["tuned_tok_s"] > 0 and s["default_tok_s"] > 0
     assert s["ingest_elapsed_s"] > 0 and s["ingest_docs"] > 0
     ceil = s["ingest_ceiling"]
     assert ceil["bound"] in ("compute", "memory")

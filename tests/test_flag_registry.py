@@ -24,7 +24,8 @@ def _readme_block(group: str) -> str:
 
 
 @pytest.mark.parametrize(
-    "group", ["pipeline", "query", "observability", "fault", "fleet"]
+    "group",
+    ["pipeline", "query", "observability", "fault", "fleet", "tuning"],
 )
 def test_readme_tables_are_generated_output(group):
     """README tables match `render_flag_table` byte-for-byte; regenerate
@@ -85,6 +86,26 @@ def test_kill_switch_declarations_well_formed():
             assert f.pinned_by.startswith("tests/"), f.env
         else:
             assert f.pinned_by is None, f"{f.env}: pinned_by without kill_switch"
+
+
+def test_reload_declarations_valid():
+    """Every flag declares how its value is consumed: `"live"` (re-read
+    per use, safe to hot-flip) or `"construction"` (read once when the
+    consuming object is built — the tuner's `flag_overrides` refuses to
+    flip these without `construction=True`)."""
+    for f in C.FLAG_REGISTRY:
+        assert f.reload in ("live", "construction"), f.env
+
+
+def test_tunable_specs_well_formed():
+    """Flags carrying a `tunable` search spec must declare a healthy
+    space (finite bounds, ≥ 2 candidate rungs, default inside) — the
+    analyzer enforces this repo-wide as GL204."""
+    from pathway_tpu.analysis.flag_hygiene import check_tunable_bounds
+
+    tunables = [f for f in C.FLAG_REGISTRY if f.tunable is not None]
+    assert len(tunables) >= 15  # the searchable surface stays real
+    assert check_tunable_bounds(C.FLAG_REGISTRY) == []
 
 
 def test_lock_sanitizer_flag_default_off(monkeypatch):

@@ -148,7 +148,7 @@ def test_rest_metrics_scrape_during_live_burst(tiny_params):
         assert stats["serving"]["latency"][name]["count"] == summary["count"]
     assert stats["serving"]["dispatch"] == want["dispatch"]
     assert set(stats) == {
-        "scheduler", "serving", "engine", "hbm", "slo", "registry",
+        "scheduler", "serving", "engine", "hbm", "slo", "registry", "tuning",
     }
     # the decode burst built a slot pool, so the HBM ledger has data and
     # it rides the same scrape surface

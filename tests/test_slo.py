@@ -183,7 +183,7 @@ def test_slo_section_in_unified_snapshot():
     try:
         snap = probes.unified_snapshot()
         assert set(snap) == {
-            "scheduler", "serving", "engine", "hbm", "slo", "registry",
+            "scheduler", "serving", "engine", "hbm", "slo", "registry", "tuning",
         }
         assert snap["slo"]["breaches"] == 0
         assert snap["slo"]["alerting"] == []

@@ -330,6 +330,76 @@ def test_gl203_attr_match_is_word_bounded():
     assert check_dead_flags(flags, texts) == [("PATHWAY_TPU_DEAD", "dead_knob")]
 
 
+# ------------------------------------------------------------------ GL204
+
+
+def _tflag(env="PATHWAY_TPU_T", default=4, **spec):
+    from pathway_tpu.internals.config import Flag, Tunable
+
+    return Flag(
+        env=env, attr=None, kind="int" if isinstance(default, int) else
+        "float", default=default, doc="x", group="pipeline",
+        tunable=Tunable(**spec),
+    )
+
+
+def test_gl204_healthy_specs_pass():
+    from pathway_tpu.analysis.flag_hygiene import check_tunable_bounds
+
+    flags = [
+        _tflag(kind="int", lo=1, hi=8, log=True),
+        _tflag(kind="int", lo=1, hi=8, step=1),
+        _tflag(env="PATHWAY_TPU_C", kind="choice", choices=("4", "8")),
+        NS(env="PATHWAY_TPU_PLAIN", tunable=None),  # untunable = exempt
+    ]
+    assert check_tunable_bounds(flags) == []
+
+
+@pytest.mark.parametrize("spec,needle", [
+    (dict(kind="int", hi=8), "lo and hi"),               # missing bound
+    (dict(kind="int", lo=1, hi=float("inf")), "finite"),  # open-ended
+    (dict(kind="int", lo=8, hi=1), "inverted"),           # lo >= hi
+    (dict(kind="int", lo=1, hi=8, step=0), "step"),       # walks nowhere
+    (dict(kind="float", lo=0.0, hi=8.0, log=True), "lo > 0"),
+    (dict(kind="choice", choices=("4",)), ">= 2 choices"),
+    (dict(kind="weird", lo=1, hi=8), "unknown tunable kind"),
+])
+def test_gl204_malformed_specs_flagged(spec, needle):
+    from pathway_tpu.analysis.flag_hygiene import check_tunable_bounds
+
+    bad = check_tunable_bounds([_tflag(**spec)])
+    assert len(bad) == 1 and bad[0][0] == "PATHWAY_TPU_T"
+    assert needle in bad[0][1], bad
+
+
+def test_gl204_default_outside_space_flagged():
+    from pathway_tpu.analysis.flag_hygiene import check_tunable_bounds
+
+    bad = check_tunable_bounds(
+        [_tflag(default=32, kind="int", lo=1, hi=8, step=1)]
+    )
+    assert bad and "outside" in bad[0][1]
+    bad = check_tunable_bounds(
+        [_tflag(default=3, kind="choice", choices=("4", "8"))]
+    )
+    assert bad and "not one of the choices" in bad[0][1]
+
+
+def test_gl204_choice_default_compared_in_parsed_units():
+    """A float flag defaulting to 0.0 with choices ("0", "16") is fine:
+    membership is judged through the flag's parser, not raw strings."""
+    from pathway_tpu.analysis.flag_hygiene import check_tunable_bounds
+
+    flags = [_tflag(default=0.0, kind="choice", choices=("0", "16"))]
+    assert check_tunable_bounds(flags) == []
+
+
+def test_gl204_rule_registered():
+    from pathway_tpu.analysis.core import RULES
+
+    assert RULES["GL204"].name == "tunable-bounds"
+
+
 # ------------------------------------------------------------------ GL301
 
 
