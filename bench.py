@@ -1664,6 +1664,104 @@ def config6_mesh_serving() -> dict:
     }
 
 
+def config7_long_prefill() -> dict:
+    """Flash prefill (PATHWAY_TPU_FLASH_PREFILL tentpole): whole-prompt
+    causal prefill at seq 256 -> 4k, flash (tiled online-softmax Pallas
+    kernel) vs dense (materialized mask-bias scores), same params and
+    prompt. Reports prefill tok/s per arm, the greedy next-token
+    identity verdict, and the attention-byte ACCOUNTING for each arm
+    (models/flash_attention.py attn_bytes_* — a traffic model, not a
+    hardware counter): dense grows quadratically in seq, flash must
+    stay linear. On CPU the flash arm runs the Pallas interpreter, so
+    the claim there is the bytes curve + token identity, not speed."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.models import decoder as D
+    from pathway_tpu.models import flash_attention as FA
+
+    t_phase = time.perf_counter()
+    if _smoke():
+        seqs = [64, 128]
+        cfg = D.DecoderConfig(
+            vocab_size=128, hidden=32, layers=2, heads=4,
+            intermediate=64, max_position=max(seqs), dtype=jnp.float32,
+        )
+        reps = 1
+    else:
+        seqs = [256, 512, 1024, 2048, 4096]
+        cfg = D.DecoderConfig(
+            vocab_size=256, hidden=64, layers=4, heads=8,
+            intermediate=128, max_position=max(seqs), dtype=jnp.float32,
+        )
+        reps = 3
+    params = D.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(7)
+
+    def _arm(ids, mask, seq, flash):
+        fn = jax.jit(
+            lambda p_, i_, m_: D.prefill(p_, i_, m_, cfg, seq, flash=flash)
+        )
+        logits, _ = fn(params, ids, mask)  # compile + warm
+        logits.block_until_ready()
+        best = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            logits, _ = fn(params, ids, mask)
+            logits.block_until_ready()
+            best = max(best, seq / max(time.perf_counter() - t0, 1e-9))
+        return best, np.asarray(jnp.argmax(logits, axis=-1))
+
+    sweep: dict = {}
+    fb_prev = None
+    linear = match_all = True
+    for seq in seqs:
+        ids = jnp.asarray(
+            rng.integers(1, cfg.vocab_size, size=(1, seq)), jnp.int32
+        )
+        mask = jnp.ones((1, seq), jnp.int32)
+        d_tps, d_tok = _arm(ids, mask, seq, flash=False)
+        f_tps, f_tok = _arm(ids, mask, seq, flash=True)
+        db = cfg.layers * FA.attn_bytes_dense(seq, seq, cfg.heads)
+        fb = cfg.layers * FA.attn_bytes_flash(
+            seq, seq, cfg.heads, cfg.hidden // cfg.heads
+        )
+        tok_match = bool(np.array_equal(d_tok, f_tok))
+        match_all = match_all and tok_match
+        if fb_prev is not None and fb > 3.0 * fb_prev:
+            linear = False  # a linear curve doubles; quadratic quadruples
+        fb_prev = fb
+        sweep[str(seq)] = {
+            "flash_tok_s": round(f_tps, 1),
+            "dense_tok_s": round(d_tps, 1),
+            "speedup_x": round(f_tps / max(d_tps, 1e-9), 3),
+            "attn_bytes_flash": int(fb),
+            "attn_bytes_dense": int(db),
+            "tokens_match": tok_match,
+        }
+    top = sweep[str(seqs[-1])]
+    detail = {
+        "backend": jax.default_backend(),
+        "seqs": seqs,
+        "sweep": sweep,
+        "flash_tok_s": top["flash_tok_s"],
+        "dense_tok_s": top["dense_tok_s"],
+        "speedup_x": top["speedup_x"],
+        "attn_bytes_flash": top["attn_bytes_flash"],
+        "attn_bytes_dense": top["attn_bytes_dense"],
+        "attn_bytes_linear": linear,
+        "tokens_match": match_all,
+        "elapsed_s": round(time.perf_counter() - t_phase, 1),
+    }
+    diag(phase="config7_prefill", **detail)
+    return {
+        "metric": "flash_prefill_tok_s",
+        "value": top["flash_tok_s"],
+        "unit": "tokens/s",
+        "detail": detail,
+    }
+
+
 def config_join_streaming() -> dict:
     """Streaming inner join through the FULL engine (kafka -> join ->
     select -> subscribe): orders x users on user id, 200k orders against
@@ -3343,6 +3441,7 @@ def run_single_phase(name: str) -> None:
         "config5": lambda: config5_ivf_recall_latency(MINILM_L6),
         "config5_sharded": config5_sharded,
         "config6_mesh": config6_mesh_serving,
+        "config7_prefill": config7_long_prefill,
         "join": config_join_streaming,
         "wordcount": config_wordcount_streaming,
         "decoder": config_decoder_generate,
@@ -3433,6 +3532,7 @@ def main() -> None:
             ("wordcount", config_wordcount_streaming),
             ("decoder", config_decoder_generate),
             ("config_tuned", config_tuned_serving),
+            ("config7_prefill", config7_long_prefill),
             ("config6_mesh", lambda: _run_phase_subprocess(
                 "config6_mesh", timeout_s=600, env=cpu8_env)),
         )
@@ -3451,6 +3551,7 @@ def main() -> None:
             ("config_tuned", 1800, None),
             ("config5_sharded", 2400, cpu8_env),
             ("config6_mesh", 1800, cpu8_env),
+            ("config7_prefill", 1800, None),
         ):
             try:
                 extra.append(
@@ -3632,6 +3733,7 @@ def main() -> None:
     shiv = _m("sharded_ivf_build_rows")
     mesh_m = _m("mesh_serving_tok_s")
     mesh_det = mesh_m.get("detail") or {}
+    fp_det = _m("flash_prefill_tok_s").get("detail") or {}
     ceiling = headline_detail.get("ceiling") or {}
     wc = _m("wordcount_streaming_rows_per_sec")
     # pipeline-depth observability: per-operator latency from THIS
@@ -3779,6 +3881,16 @@ def main() -> None:
                 )
                 if k in mesh_det
             },
+            "flash_prefill": {
+                k: fp_det.get(k)
+                for k in (
+                    "backend", "seqs", "sweep", "flash_tok_s",
+                    "dense_tok_s", "speedup_x", "attn_bytes_flash",
+                    "attn_bytes_dense", "attn_bytes_linear",
+                    "tokens_match", "elapsed_s", "error",
+                )
+                if k in fp_det
+            },
             "engine": {
                 "op_latency_p50_ms": engine_telemetry.get(
                     "op_latency_p50_ms"
@@ -3908,6 +4020,17 @@ def main() -> None:
                 "summary.mesh_serving.hbm_device_high_water_bytes"
                 "[all 8 devices > 0]"
             )
+        # flash-prefill acceptance: both arms ran at every swept seq,
+        # flash emitted the dense greedy tokens, and the flash byte
+        # accounting stayed linear in seq (the tentpole claim)
+        fp = s.get("flash_prefill") or {}
+        for k in ("flash_tok_s", "dense_tok_s", "speedup_x",
+                  "attn_bytes_flash", "attn_bytes_dense", "sweep"):
+            _chk(f"summary.flash_prefill.{k}", fp.get(k))
+        if fp.get("tokens_match") is not True:
+            missing.append("summary.flash_prefill.tokens_match")
+        if fp.get("attn_bytes_linear") is not True:
+            missing.append("summary.flash_prefill.attn_bytes_linear")
         # observability keys: operator telemetry and the HBM ledger must
         # have actually sampled during the run, not merely exist
         eng = s.get("engine") or {}
@@ -4059,6 +4182,23 @@ def sentinel_check(summary: dict, baseline: dict, smoke: bool) -> list:
         breaches.append(
             f"summary.mesh_serving.hbm_devices_seen: {mdev} < 8 — the "
             f"per-device HBM ledger lost mesh devices"
+        )
+    # flash-prefill gates, exact at every scale (absent on pre-flash
+    # baselines is fine; present-but-broken is a breach): the tiled
+    # kernel must not change a greedy token, and its attention-byte
+    # accounting must stay linear in seq
+    fp_new = new.get("flash_prefill") or {}
+    fptm = fp_new.get("tokens_match")
+    if fptm is not None and not fptm:
+        breaches.append(
+            "summary.flash_prefill.tokens_match: flash arm diverged from "
+            "dense on a greedy prefill"
+        )
+    fpl = fp_new.get("attn_bytes_linear")
+    if fpl is not None and not fpl:
+        breaches.append(
+            "summary.flash_prefill.attn_bytes_linear: flash attention "
+            "bytes grew super-linearly in seq"
         )
     # fleet gates, exact at every scale: the affinity router must hold
     # the single-replica prefix hit rate, and the chaos arm (one

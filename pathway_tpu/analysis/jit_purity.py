@@ -5,9 +5,13 @@ forms (``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``) and call
 forms (``jax.jit(f)``, ``jax.jit(partial(mod.f, ...))``) — plus
 ``pl.pallas_call(kernel, ...)`` boundaries (a Pallas kernel body is
 traced exactly like a jitted function, so host effects inside it are
-the same bug) and ``shard_map`` / ``compat_shard_map`` boundaries (the
-serving mesh's paged-attention seam: the mapped function traces under
-the SPMD per-shard view) — then walks the call graph across modules
+the same bug), ``pl.BlockSpec(shape, index_map)`` index-map functions
+(an index map runs at trace/grid-resolution time inside the Pallas
+machinery — the flash/paged kernels name theirs as top-level functions
+precisely so this pass can see them) and ``shard_map`` /
+``compat_shard_map`` boundaries (the serving mesh's paged-attention
+seam: the mapped function traces under the SPMD per-shard view) —
+then walks the call graph across modules
 (import-alias resolution, absolute and relative) and flags, inside the
 reachable set:
 
@@ -135,6 +139,35 @@ def _is_pallas_call(node: ast.AST, imps: _Imports) -> bool:
     if not tail and imps.from_names.get(head, ("", ""))[1] == "pallas_call":
         return True
     return False
+
+
+def _is_block_spec(node: ast.AST, imps: _Imports) -> bool:
+    """``pl.BlockSpec`` / ``pallas.BlockSpec`` / a bare ``BlockSpec``
+    from-import — its index-map argument runs under Pallas tracing, so
+    it is a GL1xx root exactly like a kernel body."""
+    d = _dotted(node)
+    if d is None:
+        return False
+    head, _, tail = d.partition(".")
+    if tail == "BlockSpec" and (
+        imps.mod_alias.get(head) in ("jax.experimental.pallas",
+                                     "jax.experimental.pallas.tpu")
+    ):
+        return True
+    if not tail and imps.from_names.get(head, ("", ""))[1] == "BlockSpec":
+        return True
+    return False
+
+
+def _block_spec_index_map(call: ast.Call) -> ast.AST | None:
+    """The index-map operand of a BlockSpec call: 2nd positional arg or
+    the ``index_map=`` keyword."""
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "index_map":
+            return kw.value
+    return None
 
 
 def _is_shard_map(node: ast.AST, imps: _Imports) -> bool:
@@ -332,16 +365,22 @@ def _collect_roots(
                         ref.static |= _static_argnames(call)
                         roots.append(ref)
         # call form: jax.jit(f) / jax.jit(partial(mod.f, ...)) /
-        # pl.pallas_call(kernel, ...) / shard_map(f, mesh=..., ...)
+        # pl.pallas_call(kernel, ...) / shard_map(f, mesh=..., ...) /
+        # pl.BlockSpec(shape, index_map)
         for node in ast.walk(src.tree):
-            if not (isinstance(node, ast.Call)
-                    and (_is_jax_jit(node.func, imps)
-                         or _is_pallas_call(node.func, imps)
-                         or _is_shard_map(node.func, imps))):
+            if not isinstance(node, ast.Call):
                 continue
-            if not node.args:
+            if _is_block_spec(node.func, imps):
+                arg = _block_spec_index_map(node)
+            elif (_is_jax_jit(node.func, imps)
+                  or _is_pallas_call(node.func, imps)
+                  or _is_shard_map(node.func, imps)):
+                arg = node.args[0] if node.args else None
+            else:
                 continue
-            tmod, fname, _pcall = _target_of_jit_arg(node.args[0], imps, defs)
+            if arg is None:
+                continue
+            tmod, fname, _pcall = _target_of_jit_arg(arg, imps, defs)
             owner = tmod or mod
             ref = by_name.get(owner, {}).get(fname or "")
             if ref is not None:

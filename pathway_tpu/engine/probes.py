@@ -467,6 +467,7 @@ def serving_snapshot() -> dict:
         "prefix": prefix_stats(),
         "spec": spec_stats(),
         "cascade": cascade_stats(),
+        "attn": attn_stats(),
         "dispatch": dispatch_counts(),
         "stage_seconds": {
             k: round(v, 6) for k, v in sorted(stage_seconds().items())
@@ -906,6 +907,50 @@ def cascade_stats() -> dict:
 
 def reset_cascade_stats() -> None:
     REGISTRY.remove("cascade_pairs", "cascade_flops")
+
+
+# --------------------------------------------------------------------- #
+# attention HBM-traffic ledger (flash prefill)
+#
+# An ACCOUNTING MODEL, not a hardware counter: each attention dispatch is
+# charged the bytes its arm's graph materializes per layer — the dense
+# path's f32 score/prob/mask tensors (quadratic in sequence), or the
+# flash kernels' streamed q/k/v/o tiles (linear; see
+# ``models/flash_attention.attn_bytes_dense`` / ``attn_bytes_flash``).
+# ``attn_bytes_saved`` is the dense-score accounting minus what the
+# flash arm paid — what PATHWAY_TPU_FLASH_PREFILL kept out of HBM.
+
+def record_attn(path: str, nbytes: float, saved: float = 0.0) -> None:
+    """Account ``nbytes`` of modeled attention traffic on ``path``
+    (``prefill`` = whole-prompt admits, ``chunk`` = chunked-prefill
+    pieces, ``encoder`` = embedder/cross-encoder stacks); ``saved`` is
+    the dense-vs-flash delta when the flash arm ran. Thread-safe;
+    called host-side at each dispatch site."""
+    REGISTRY.counter_add("attn_bytes", nbytes, path=path)
+    if saved:
+        REGISTRY.counter_add("attn_bytes_saved", saved, path=path)
+
+
+def attn_stats() -> dict:
+    """Snapshot: per-path modeled attention bytes, bytes saved vs the
+    dense-score accounting, and their totals."""
+    bytes_ = {
+        k: int(v) for k, v in REGISTRY.labelled("attn_bytes", "path").items()
+    }
+    saved = {
+        k: int(v)
+        for k, v in REGISTRY.labelled("attn_bytes_saved", "path").items()
+    }
+    return {
+        "bytes": bytes_,
+        "bytes_saved": saved,
+        "total_bytes": sum(bytes_.values()),
+        "total_saved": sum(saved.values()),
+    }
+
+
+def reset_attn_stats() -> None:
+    REGISTRY.remove("attn_bytes", "attn_bytes_saved")
 
 
 # --------------------------------------------------------------------- #

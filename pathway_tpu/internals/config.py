@@ -487,6 +487,43 @@ FLAG_REGISTRY: list[Flag] = [
             "seq) corner.",
     ),
     Flag(
+        env="PATHWAY_TPU_FLASH_PREFILL", kind="bool", default=False,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "1")),
+        kill_switch=True, pinned_by="tests/test_flash_prefill.py",
+        attr="flash_prefill", group="pipeline",
+        doc="Tiled online-softmax Pallas flash attention for every "
+            "prefill/encode path (`models/flash_attention.py`): "
+            "whole-prompt admits, chunked-prefill pieces (int8 dequant "
+            "fused into the cache tile read; dense rows and, via the "
+            "block table, paged pools), and the encoder stacks through "
+            "the `core(q, k, v)` seam — no more materialized "
+            "`(B, 1, S, S)` score/mask tensors, O(S) attention memory. "
+            "Online softmax is allclose-not-bitwise vs the dense path, "
+            "so `0` (default) keeps today's dense attention "
+            "byte-identically (`tests/test_flash_prefill.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_FLASH_BLOCK_Q", kind="int", default=0,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "64", "128", "256", "512")),
+        attr="flash_block_q", group="pipeline", minimum=0,
+        doc="Flash-prefill query tile size in tokens; `0` = auto (one "
+            "128 tile, shrunk to the 8-rounded sequence when shorter). "
+            "Native TPU compilation wants multiples of the (8, 128) "
+            "register shape.",
+    ),
+    Flag(
+        env="PATHWAY_TPU_FLASH_BLOCK_K", kind="int", default=0,
+        reload="construction",
+        tunable=Tunable("choice", choices=("0", "64", "128", "256", "512")),
+        attr="flash_block_k", group="pipeline", minimum=0,
+        doc="Flash-prefill key/value tile size in tokens; `0` = auto. "
+            "For chunk-vs-cache reads the tile must divide the cache "
+            "row, so the effective size is the largest divisor of "
+            "`cache_len` at most this value.",
+    ),
+    Flag(
         env="PATHWAY_TPU_DISAGG", kind="bool", default=False,
         reload="construction",
         tunable=Tunable("choice", choices=("0", "1")),

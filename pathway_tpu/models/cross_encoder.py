@@ -27,14 +27,34 @@ from pathway_tpu.models.transformer import (
 )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+@functools.partial(jax.jit, static_argnames=("cfg", "flash"))
 def score_fn(params, head, input_ids, attention_mask, cfg: TransformerConfig,
-             token_type_ids=None):
-    hidden = encode(params, input_ids, attention_mask, cfg, token_type_ids)
+             token_type_ids=None, flash: bool = False):
+    hidden = encode(params, input_ids, attention_mask, cfg, token_type_ids,
+                    flash=flash)
     cls = hidden[:, 0, :]
     pooled = jnp.tanh(cls @ params["pooler"]["w"].astype(jnp.float32)
                       + params["pooler"]["b"].astype(jnp.float32))
     return (pooled @ head["w"] + head["b"])[:, 0]
+
+
+def _record_rerank_attn(cfg, batch, seq, flash):
+    """Charge the attention-bytes ledger for one rerank batch (accounting
+    model — see probes.record_attn)."""
+    from pathway_tpu.engine.probes import record_attn
+    from pathway_tpu.models.flash_attention import (
+        attn_bytes_dense,
+        attn_bytes_flash,
+    )
+
+    batch, seq = int(batch), int(seq)
+    dense = cfg.layers * attn_bytes_dense(seq, seq, cfg.heads, batch=batch)
+    if flash:
+        fl = cfg.layers * attn_bytes_flash(
+            seq, seq, cfg.heads, cfg.hidden // cfg.heads, batch=batch)
+        record_attn("encoder", fl, saved=dense - fl)
+    else:
+        record_attn("encoder", dense)
 
 
 class CrossEncoderModel:
@@ -52,6 +72,16 @@ class CrossEncoderModel:
         self.cfg = cfg
         self.tokenizer = tokenizer or HashTokenizer(max_length=max_length)
         self.max_length = max_length
+        # Construction-time flag read (reload="construction"): the rerank
+        # cascade gets the same O(S) flash encoder as the embedder.
+        from pathway_tpu.internals.config import pathway_config
+
+        self.flash_prefill = bool(pathway_config.flash_prefill)
+        if self.flash_prefill:
+            from pathway_tpu.models import flash_attention as _fa
+
+            _fa.configure_blocks(pathway_config.flash_block_q,
+                                 pathway_config.flash_block_k)
         key = jax.random.PRNGKey(seed)
         if params is None:
             params = init_params(key, cfg)
@@ -104,7 +134,10 @@ class CrossEncoderModel:
         )
         ids, mask, types = pad_to_buckets(ids, mask, types)
         out = score_fn(self.params, self.head, jnp.asarray(ids),
-                       jnp.asarray(mask), self.cfg, jnp.asarray(types))
+                       jnp.asarray(mask), self.cfg, jnp.asarray(types),
+                       flash=self.flash_prefill)
+        _record_rerank_attn(self.cfg, ids.shape[0], ids.shape[1],
+                            self.flash_prefill)
         return (out, len(pairs))
 
     def score_resolve(self, handles) -> list[np.ndarray]:

@@ -329,7 +329,7 @@ def _block_finish(x, lp, ctx, cfg: DecoderConfig):
 
 
 def _block(x, lp, k, v, mask_bias, cfg: DecoderConfig, k_scale=None,
-           v_scale=None):
+           v_scale=None, ctx_fn=None):
     """One pre-LN GPT-2 block over ALREADY-PROJECTED k/v (B, nh, Skv, hd).
 
     The caller owns the KV source — the in-sequence keys for prefill, the
@@ -339,11 +339,89 @@ def _block(x, lp, k, v, mask_bias, cfg: DecoderConfig, k_scale=None,
     gelu / residuals stay in cfg.dtype (the MXU accumulates f32
     internally; attention SCORES and layernorm statistics stay f32) —
     same HBM-traffic optimization as the encoder's _layer, bit-unchanged
-    for f32 configs."""
+    for f32 configs.
+
+    ``ctx_fn(q, k, v, k_scale, v_scale) -> (B, nh, Sq, hd)`` swaps the
+    dense :func:`_attn_ctx` read for an alternative (the flash-prefill
+    Pallas kernels); it owns scaling and masking, mirroring the
+    encoder's ``core`` seam. ``None`` (default) keeps the dense path
+    byte-identical."""
     q, k_new, v_new = _block_qkv(x, lp, cfg)
-    ctx = _attn_ctx(q, k, v, mask_bias, cfg, k_scale, v_scale)
+    if ctx_fn is None:
+        ctx = _attn_ctx(q, k, v, mask_bias, cfg, k_scale, v_scale)
+    else:
+        ctx = ctx_fn(q, k, v, k_scale, v_scale).astype(cfg.dtype)
     x = _block_finish(x, lp, ctx, cfg)
     return x, k_new, v_new
+
+
+def _flash_self_attn_fn(mesh):
+    """The whole-sequence flash-attention entry the prefill paths call
+    as a ``_block`` ``ctx_fn`` factory: the plain Pallas kernel on a
+    single chip, or a ``shard_map``-wrapped version on a serving mesh
+    with tp > 1 (q/k/v all carry the head axis, attention never mixes
+    heads, so the UNCHANGED kernel runs per shard with no collective —
+    the same treatment as :func:`_paged_attn_fn`)."""
+    from pathway_tpu.models import flash_attention as _fa
+
+    def plain(q, k, v, mask):
+        return _fa.flash_attn(q, k, v, mask, causal=True)
+
+    if mesh is None:
+        return plain
+    from pathway_tpu.parallel.mesh import SERVE_TP_AXIS, compat_shard_map
+
+    if int(mesh.shape.get(SERVE_TP_AXIS, 1)) == 1:
+        return plain
+    t = SERVE_TP_AXIS
+    head = P(None, t, None, None)  # q / k / v / ctx: (B, nh, S, hd)
+    rep = P(None, None)            # attention mask: (B, S)
+    return compat_shard_map(
+        plain, mesh=mesh, in_specs=(head, head, head, rep),
+        out_specs=head, check_vma=False,
+    )
+
+
+def _flash_chunk_attn_fn(mesh, quant):
+    """Chunk-vs-cache flash entry for :func:`pool_prefill_chunk`,
+    adapting ``_block``'s (1, nh, ...) operands to the batchless kernel
+    layout. Quantized pools get a separate wrapper because ``shard_map``
+    in_specs cannot describe the ``None`` scale operands of the
+    full-precision layout (same split as :func:`_paged_attn_fn`)."""
+    from pathway_tpu.models import flash_attention as _fa
+
+    def plain(q, k_row, v_row, ks_row, vs_row, row_mask, start):
+        return _fa.flash_chunk_attn(
+            q[0], k_row[0], v_row[0], row_mask[0], start,
+            k_scale=None if ks_row is None else ks_row[0],
+            v_scale=None if vs_row is None else vs_row[0],
+        )[None]
+
+    if mesh is None:
+        return plain
+    from pathway_tpu.parallel.mesh import SERVE_TP_AXIS, compat_shard_map
+
+    if int(mesh.shape.get(SERVE_TP_AXIS, 1)) == 1:
+        return plain
+    t = SERVE_TP_AXIS
+    head = P(None, t, None, None)  # q / rows / scales: (1, nh, ., .)
+    rep = P(None, None)            # row mask: (1, C)
+    if quant:
+        return compat_shard_map(
+            plain, mesh=mesh,
+            in_specs=(head, head, head, head, head, rep, P()),
+            out_specs=head, check_vma=False,
+        )
+
+    def unquant(q, k_row, v_row, row_mask, start):
+        return plain(q, k_row, v_row, None, None, row_mask, start)
+
+    mapped = compat_shard_map(
+        unquant, mesh=mesh, in_specs=(head, head, head, rep, P()),
+        out_specs=head, check_vma=False,
+    )
+    return lambda q, k_row, v_row, _ks, _vs, row_mask, start: \
+        mapped(q, k_row, v_row, row_mask, start)
 
 
 def _logits(params, x, cfg):
@@ -354,23 +432,38 @@ def _logits(params, x, cfg):
 
 
 def forward(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
-            cfg: DecoderConfig) -> jax.Array:
+            cfg: DecoderConfig, *, flash: bool = False,
+            mesh=None) -> jax.Array:
     """Full causal forward. Returns logits (B, S, V) float32.
 
     ``attention_mask`` is 1 for real tokens (left- or right-padded); masked
     positions neither attend nor are attended to. Position ids follow the HF
     convention ``cumsum(mask) - 1`` (clipped), so left-padded rows see the
-    same positions as their unpadded equivalents."""
+    same positions as their unpadded equivalents.
+
+    ``flash`` (static) runs attention through the tiled flash kernel
+    (``models/flash_attention.py``): no ``(B, 1, S, S)`` bias is
+    materialized, the column mask is computed from lengths inside the
+    kernel. Logits at LIVE positions match dense at online-softmax
+    tolerance; fully-masked query rows (left-padding) produce different
+    hidden states (flash: zeros) that never reach live positions.
+    ``mesh`` shard-maps the kernel over tp shards (heads split)."""
     B, S = input_ids.shape
     pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)
     x = (params["wte"][input_ids] + params["wpe"][pos]).astype(cfg.dtype)
-    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    allowed = causal[None, None, :, :] & (attention_mask[:, None, None, :] > 0)
-    mask_bias = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
+    ctx_fn = mask_bias = None
+    if flash:
+        attn = _flash_self_attn_fn(mesh)
+        ctx_fn = lambda q, k, v, ks, vs: attn(q, k, v, attention_mask)
+    else:
+        causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        allowed = (causal[None, None, :, :]
+                   & (attention_mask[:, None, None, :] > 0))
+        mask_bias = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
 
     def body(carry, lp):
         k, v = _prefill_kv(carry, lp, cfg)
-        x, _, _ = _block(carry, lp, k, v, mask_bias, cfg)
+        x, _, _ = _block(carry, lp, k, v, mask_bias, cfg, ctx_fn=ctx_fn)
         return x, None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
@@ -392,21 +485,33 @@ def _prefill_kv(x, lp, cfg):
 
 
 def prefill(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
-            cfg: DecoderConfig, cache_len: int):
+            cfg: DecoderConfig, cache_len: int, *, flash: bool = False,
+            mesh=None):
     """Causal forward over the (left-padded) prompt, returning
     ``(last_logits (B, V), cache)`` with per-layer K/V written into a cache
-    padded to ``cache_len`` slots."""
+    padded to ``cache_len`` slots.
+
+    ``flash``/``mesh`` as in :func:`forward` — the flash arm's cached KV
+    at fully-masked (padding) columns differs from dense, but those
+    columns stay masked by every downstream ``slot_mask``/``row_mask``
+    read, so decode streams see identical attention inputs."""
     B, S = input_ids.shape
     assert cache_len >= S
     pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)
     x = (params["wte"][input_ids] + params["wpe"][pos]).astype(cfg.dtype)
-    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    allowed = causal[None, None, :, :] & (attention_mask[:, None, None, :] > 0)
-    mask_bias = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
+    ctx_fn = mask_bias = None
+    if flash:
+        attn = _flash_self_attn_fn(mesh)
+        ctx_fn = lambda q, k, v, ks, vs: attn(q, k, v, attention_mask)
+    else:
+        causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        allowed = (causal[None, None, :, :]
+                   & (attention_mask[:, None, None, :] > 0))
+        mask_bias = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
 
     def body(carry, lp):
         k, v = _prefill_kv(carry, lp, cfg)
-        x, _, _ = _block(carry, lp, k, v, mask_bias, cfg)
+        x, _, _ = _block(carry, lp, k, v, mask_bias, cfg, ctx_fn=ctx_fn)
         return x, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
@@ -478,6 +583,25 @@ def _filter_logits(logits, top_k: int | None, top_p: float | None):
     return logits
 
 
+def _sample_fn(temperature: float, top_k: int | None, top_p: float | None):
+    """The ONE greedy-vs-nucleus sampling closure, shared by
+    :func:`generate`, :func:`pool_decode_chunk` and the paged-kernel
+    decode chunk (they carried three identical copies). Returns
+    ``sample(logits, key) -> (B,) int32``; ``temperature == 0`` is
+    greedy argmax and ignores the key, otherwise temperature FIRST, then
+    the nucleus (HF warper order): the top-p set must be chosen from the
+    TEMPERED distribution — filtering untempered logits would nullify
+    high temperatures. Bitwise-pinned against the historical inline
+    closures by ``tests/test_flash_prefill.py``."""
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
+
+    return sample
+
+
 def generate(params: dict, prompt_ids: jax.Array, attention_mask: jax.Array,
              cfg: DecoderConfig, max_new: int, temperature: float = 0.0,
              key: jax.Array | None = None,
@@ -511,14 +635,7 @@ def generate(params: dict, prompt_ids: jax.Array, attention_mask: jax.Array,
         [attention_mask, jnp.zeros((B, max_new), attention_mask.dtype)], axis=1
     )
 
-    def sample(logits, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        # temperature FIRST, then the nucleus (HF warper order): the top-p
-        # set must be chosen from the TEMPERED distribution — filtering
-        # untempered logits would nullify high temperatures
-        logits = _filter_logits(logits / temperature, top_k, top_p)
-        return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
+    sample = _sample_fn(temperature, top_k, top_p)
 
     done0 = jnp.zeros((B,), jnp.bool_)
 
@@ -969,22 +1086,25 @@ def paged_admit_cached(pool: dict, slot: jax.Array, row: jax.Array,
 
 
 def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,
-               slot: jax.Array, cfg: DecoderConfig) -> dict:
+               slot: jax.Array, cfg: DecoderConfig, *,
+               flash: bool = False, mesh=None) -> dict:
     """Prefill ONE left-padded prompt (``ids``/``mask`` shaped (1, S))
     and install it in ``slot``: KV written, cursors set, first-token
     logits staged. jit per prompt-length bucket; ``slot`` is traced.
 
     PAGED pools run the identical computation over a gathered dense
     view and scatter the written row back into the slot's table blocks
-    — the dict-key branch is static under jit."""
+    — the dict-key branch is static under jit. ``flash``/``mesh``
+    (static) as in :func:`prefill`."""
     if pool_paged(pool):
         return _paged_scatter(
             pool, pool_admit(params, ids, mask, _paged_gather(pool),
-                             slot, cfg)
+                             slot, cfg, flash=flash, mesh=mesh)
         )
     C = pool["k"].shape[3]
     S = ids.shape[1]
-    last_logits, cache = prefill(params, ids, mask, cfg, cache_len=C)
+    last_logits, cache = prefill(params, ids, mask, cfg, cache_len=C,
+                                 flash=flash, mesh=mesh)
     upd = {}
     if pool_quantized(pool):
         ck, sk = _kv_quant(cache["k"])
@@ -1023,7 +1143,8 @@ def pool_admit(params: dict, ids: jax.Array, mask: jax.Array, pool: dict,
 
 def pool_admit_batch(params: dict, ids: jax.Array, mask: jax.Array,
                      pool: dict, slots: jax.Array,
-                     cfg: DecoderConfig) -> dict:
+                     cfg: DecoderConfig, *,
+                     flash: bool = False, mesh=None) -> dict:
     """Prefill M left-padded prompts (``ids``/``mask`` shaped (M, S)) and
     install them in ``slots`` (M distinct slot indices) in ONE dispatch.
 
@@ -1038,11 +1159,12 @@ def pool_admit_batch(params: dict, ids: jax.Array, mask: jax.Array,
     if pool_paged(pool):
         return _paged_scatter(
             pool, pool_admit_batch(params, ids, mask, _paged_gather(pool),
-                                   slots, cfg)
+                                   slots, cfg, flash=flash, mesh=mesh)
         )
     C = pool["k"].shape[3]
     M, S = ids.shape
-    last_logits, cache = prefill(params, ids, mask, cfg, cache_len=C)
+    last_logits, cache = prefill(params, ids, mask, cfg, cache_len=C,
+                                 flash=flash, mesh=mesh)
     upd = {}
     if pool_quantized(pool):
         ck, sk = _kv_quant(cache["k"])
@@ -1070,7 +1192,8 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
                        start: jax.Array, n_prompt: jax.Array,
                        cfg: DecoderConfig, *, first: bool,
                        last: bool,
-                       last_col: jax.Array | None = None) -> dict:
+                       last_col: jax.Array | None = None,
+                       flash: bool = False, mesh=None) -> dict:
     """CHUNKED prefill: write ONE piece of a left-padded prompt
     (``ids``/``mask``/``pos`` shaped (1, T)) into ``slot``'s cache at
     offsets ``[start, start + T)``, sharing ``_block`` with decode and
@@ -1104,6 +1227,7 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
             pool, pool_prefill_chunk(
                 params, ids, mask, pos, _paged_gather(pool), slot, start,
                 n_prompt, cfg, first=first, last=last, last_col=last_col,
+                flash=flash, mesh=mesh,
             )
         )
     C = pool["k"].shape[3]
@@ -1121,15 +1245,24 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
     slot_mask = jax.lax.dynamic_update_slice(
         pool["slot_mask"], row_mask, (slot, 0)
     )
-    # a piece query at cache index start+j attends every LIVE index of
-    # this row <= start+j (earlier pieces + its own causal prefix) —
-    # elementwise the same predicate as prefill()'s causal & pad mask
-    idxs = jnp.arange(C)[None, None, None, :]
-    qpos = (start + jnp.arange(T))[None, None, :, None]
-    allowed = (row_mask[:, None, None, :] > 0) & (idxs <= qpos)
-    mask_bias = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
-
     quant = pool_quantized(pool)
+    ctx_fn = mask_bias = None
+    if flash:
+        # the kernel rebuilds the same live-&-causal predicate from
+        # row_mask and start internally, with int8 dequant fused into
+        # the cache tile read — no (1, 1, T, C) bias, no f32 KV row
+        attn_c = _flash_chunk_attn_fn(mesh, quant)
+        ctx_fn = lambda q, kr, vr, ksr, vsr: \
+            attn_c(q, kr, vr, ksr, vsr, row_mask, start)
+    else:
+        # a piece query at cache index start+j attends every LIVE index
+        # of this row <= start+j (earlier pieces + its own causal
+        # prefix) — elementwise the same predicate as prefill()'s
+        # causal & pad mask
+        idxs = jnp.arange(C)[None, None, None, :]
+        qpos = (start + jnp.arange(T))[None, None, :, None]
+        allowed = (row_mask[:, None, None, :] > 0) & (idxs <= qpos)
+        mask_bias = jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
 
     def layer(x, inp):
         lp, kl, vl, ksl, vsl = inp
@@ -1155,7 +1288,7 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
         k_row = jax.lax.dynamic_slice(kl, (slot, 0, 0, 0), (1, nh, C, hd))
         v_row = jax.lax.dynamic_slice(vl, (slot, 0, 0, 0), (1, nh, C, hd))
         x, _, _ = _block(x, lp, k_row, v_row, mask_bias, cfg,
-                         k_scale=ks_row, v_scale=vs_row)
+                         k_scale=ks_row, v_scale=vs_row, ctx_fn=ctx_fn)
         return x, (kl, vl, ksl, vsl)
 
     x, (k, v, ks, vs) = jax.lax.scan(
@@ -1380,12 +1513,7 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
     act_i = active.astype(jnp.int32)
     act_b = active[:, None, None]
     quant = pool_quantized(pool)
-
-    def sample(logits, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = _filter_logits(logits / temperature, top_k, top_p)
-        return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
+    sample = _sample_fn(temperature, top_k, top_p)
 
     def body(carry, _):
         k_c, v_c, ks_c, vs_c, logits, slot_mask, pos, write, key = carry
@@ -1512,12 +1640,7 @@ def _paged_decode_chunk_kernel(params, pool, active, key, cfg, n_steps,
     act_b = active[:, None, None]
     quant = pool_quantized(pool)
     attn = _paged_attn_fn(mesh, quant)
-
-    def sample(logits, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = _filter_logits(logits / temperature, top_k, top_p)
-        return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
+    sample = _sample_fn(temperature, top_k, top_p)
 
     def body(carry, _):
         kb_c, vb_c, kbs_c, vbs_c, logits, slot_mask, pos, write, key = carry

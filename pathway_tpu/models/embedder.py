@@ -63,12 +63,17 @@ def cast_params_for_inference(params, cfg: TransformerConfig):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def embed_fn(params, input_ids, attention_mask, cfg: TransformerConfig):
+@functools.partial(jax.jit, static_argnames=("cfg", "flash"))
+def embed_fn(params, input_ids, attention_mask, cfg: TransformerConfig,
+             flash: bool = False):
     """One fused executable for the whole embed step. MUST stay jitted: on a
     tunneled/relayed chip each eager op costs a full dispatch round trip
-    (~150ms measured), turning a 15ms batch into seconds."""
-    hidden = encode(params, input_ids, attention_mask, cfg)
+    (~150ms measured), turning a 15ms batch into seconds.
+
+    ``flash`` (static, from the model's construction-time read of
+    ``PATHWAY_TPU_FLASH_PREFILL``) routes attention through the
+    non-causal flash kernel via ``encode``'s core seam."""
+    hidden = encode(params, input_ids, attention_mask, cfg, flash=flash)
     pooled = mean_pool(hidden, attention_mask)
     return pooled / jnp.clip(
         jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9, None
@@ -83,29 +88,35 @@ warnings.filterwarnings(
 )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1, 2))
-def _embed_fn_donated(params, input_ids, attention_mask, cfg: TransformerConfig):
+@functools.partial(jax.jit, static_argnames=("cfg", "flash"),
+                   donate_argnums=(1, 2))
+def _embed_fn_donated(params, input_ids, attention_mask,
+                      cfg: TransformerConfig, flash: bool = False):
     """``embed_fn`` with the token buffers donated back to XLA. The
     pipeline's staged inputs alternate between "being written by the h2d
     stage" and "owned by the in-flight dispatch", so donation caps live
     input buffers at the dispatch-ahead depth (ping-pong) instead of one
     pair per batch in flight."""
-    return embed_fn(params, input_ids, attention_mask, cfg)
+    return embed_fn(params, input_ids, attention_mask, cfg, flash=flash)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
-def _embed_fn_packed(params, packed, cfg: TransformerConfig):
+@functools.partial(jax.jit, static_argnames=("cfg", "flash"),
+                   donate_argnums=(1,))
+def _embed_fn_packed(params, packed, cfg: TransformerConfig,
+                     flash: bool = False):
     """Fused-transfer variant: ``packed`` is ``stack([ids, mask])`` moved as
     ONE contiguous ``device_put``. Two small transfers per batch each pay a
     fixed runtime/transport overhead (on a relayed v5e the per-transfer
     setup dominates at seq-32 batch sizes); halving the transfer count
     takes the h2d stage off the per-batch critical path. The split back
     into ids/mask happens inside the executable, where it is free."""
-    return embed_fn(params, packed[0], packed[1], cfg)
+    return embed_fn(params, packed[0], packed[1], cfg, flash=flash)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
-def _token_states_packed(params, packed, proj, cfg: TransformerConfig):
+@functools.partial(jax.jit, static_argnames=("cfg", "flash"),
+                   donate_argnums=(1,))
+def _token_states_packed(params, packed, proj, cfg: TransformerConfig,
+                         flash: bool = False):
     """Token-level sibling of :func:`_embed_fn_packed` for the
     late-interaction doc bank: same fused single-transfer input, but the
     executable keeps PER-TOKEN states — full-depth encode, project to the
@@ -113,8 +124,25 @@ def _token_states_packed(params, packed, proj, cfg: TransformerConfig):
     pooling. Returns ``(payload int8 (B, S, dc), scale f32 (B, S, 1))``."""
     from pathway_tpu.ops.late_bank import _project_tokens, _quant_tokens
 
-    hidden = encode(params, packed[0], packed[1], cfg)
+    hidden = encode(params, packed[0], packed[1], cfg, flash=flash)
     return _quant_tokens(_project_tokens(hidden, packed[1], proj))
+
+
+def _record_encoder_attn(cfg: TransformerConfig, batch: int, seq: int,
+                         flash: bool) -> None:
+    """Charge one encoder dispatch to the attention ledger (accounting
+    model, per layer x batch; see ``engine/probes.record_attn``)."""
+    from pathway_tpu.engine.probes import record_attn
+    from pathway_tpu.models import flash_attention as _fa
+
+    dense = cfg.layers * _fa.attn_bytes_dense(seq, seq, cfg.heads,
+                                              batch=batch)
+    if flash:
+        paid = cfg.layers * _fa.attn_bytes_flash(seq, seq, cfg.heads,
+                                                 cfg.head_dim, batch=batch)
+        record_attn("encoder", paid, saved=max(0, dense - paid))
+    else:
+        record_attn("encoder", dense)
 
 
 class _PendingEmbed:
@@ -252,30 +280,35 @@ class _IngestPipeline:
         t1 = time.perf_counter()
         record_stage("h2d", t1 - t0)
         handle.span.event("h2d")
+        flash = model.flash_prefill
         if kind == "tokens":
             proj = model.late_projection_matrix(dc)
             if fused:
                 out = _token_states_packed(
-                    model.params, dev_packed, proj, model.cfg
+                    model.params, dev_packed, proj, model.cfg, flash=flash
                 )
             else:
                 from pathway_tpu.ops.late_bank import doc_token_states
 
                 out = doc_token_states(
-                    model.params, dev_ids, dev_mask, proj, model.cfg
+                    model.params, dev_ids, dev_mask, proj, model.cfg,
+                    flash=flash,
                 )
             record_device_dispatch("token_bank_dispatch")
             # int8 payload + f32 scales: already transport-compact, no
             # precision cast needed before the drain
         else:
             if fused:
-                out = _embed_fn_packed(model.params, dev_packed, model.cfg)
+                out = _embed_fn_packed(model.params, dev_packed, model.cfg,
+                                       flash=flash)
             else:
                 out = _embed_fn_donated(
-                    model.params, dev_ids, dev_mask, model.cfg
+                    model.params, dev_ids, dev_mask, model.cfg, flash=flash
                 )
             record_device_dispatch("embed_dispatch")
             out = out.astype(jnp.float16)
+        _record_encoder_attn(model.cfg, int(ids.shape[0]),
+                             int(ids.shape[1]), flash)
         for leaf in jax.tree.leaves(out):
             try:
                 leaf.copy_to_host_async()
@@ -321,6 +354,17 @@ class SentenceEmbedderModel:
         # (or 1x1x1) this is plain single-chip placement.
         from pathway_tpu.parallel.mesh import serving_mesh_from_flags
 
+        # construction-time flag read (reload="construction"): the jit
+        # caches key on the static flash arg, so a rebuilt model picks
+        # up a flipped env var without invalidating other instances
+        from pathway_tpu.internals.config import pathway_config
+
+        self.flash_prefill = bool(pathway_config.flash_prefill)
+        if self.flash_prefill:
+            from pathway_tpu.models import flash_attention as _fa
+
+            _fa.configure_blocks(pathway_config.flash_block_q,
+                                 pathway_config.flash_block_k)
         self.mesh = serving_mesh_from_flags()
         if self.mesh is not None:
             from pathway_tpu.models.transformer import shard_encoder_params
@@ -435,8 +479,11 @@ class SentenceEmbedderModel:
         for nothing."""
         ids, mask = self.tokenizer(texts, max_length=self.max_length)
         ids, mask = pad_to_buckets(ids, mask)
-        out = embed_fn(self.params, jnp.asarray(ids), jnp.asarray(mask), self.cfg)
+        out = embed_fn(self.params, jnp.asarray(ids), jnp.asarray(mask),
+                       self.cfg, flash=self.flash_prefill)
         record_device_dispatch("embed_dispatch")
+        _record_encoder_attn(self.cfg, int(ids.shape[0]),
+                             int(ids.shape[1]), self.flash_prefill)
         return (out, len(texts))
 
     def embed_resolve(self, handles) -> list[np.ndarray]:
@@ -492,9 +539,12 @@ class SentenceEmbedderModel:
         ids, mask = self.tokenizer(texts, max_length=self.max_length)
         ids, mask = pad_to_buckets(ids, mask)
         out = doc_token_states(
-            self.params, jnp.asarray(ids), jnp.asarray(mask), proj, self.cfg
+            self.params, jnp.asarray(ids), jnp.asarray(mask), proj, self.cfg,
+            flash=self.flash_prefill,
         )
         record_device_dispatch("token_bank_dispatch")
+        _record_encoder_attn(self.cfg, int(ids.shape[0]),
+                             int(ids.shape[1]), self.flash_prefill)
         for leaf in jax.tree.leaves(out):
             try:
                 leaf.copy_to_host_async()

@@ -322,7 +322,8 @@ def embed_inputs(params: dict, input_ids: jax.Array,
 def encode(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
            cfg: TransformerConfig,
            token_type_ids: jax.Array | None = None,
-           *, n_layers: int | None = None) -> jax.Array:
+           *, n_layers: int | None = None,
+           flash: bool = False) -> jax.Array:
     """Full encoder forward. Returns final hidden states (B, S, H) float32.
 
     Static shapes only; the S dimension is the caller's padded bucket size
@@ -333,12 +334,26 @@ def encode(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
     its own executable). Used by the cascade rerank's cheap first pass;
     ``None`` (default) runs the full stack and is byte-identical to the
     pre-truncation path.
-    """
+
+    ``flash`` (static) plugs the non-causal tiled flash kernel
+    (``models/flash_attention.py``) into the ``core(q, k, v)`` seam: the
+    pad mask is applied from lengths inside the kernel and the
+    (B, nh, S, S) score/prob tensors never materialize — O(S) attention
+    memory for the embedder and the cross-encoder rerank cascade.
+    Online softmax is allclose-not-bitwise vs the dense path; ``False``
+    (default, the ``PATHWAY_TPU_FLASH_PREFILL`` kill-switch position)
+    is byte-identical to before the flag existed."""
     x, mask_bias = embed_inputs(params, input_ids, attention_mask, cfg,
                                 token_type_ids)
+    core = None
+    if flash:
+        from pathway_tpu.models import flash_attention as _fa
+
+        def core(q, k, v):
+            return _fa.flash_attn(q, k, v, attention_mask, causal=False)
 
     def body(carry, lp):
-        return _layer(carry, lp, mask_bias, cfg), None
+        return _layer(carry, lp, mask_bias, cfg, core=core), None
 
     layers = params["layers"]
     if n_layers is not None and n_layers < cfg.layers:

@@ -67,14 +67,14 @@ def _quant_tokens(t):
     return jnp.round(t / scale).astype(jnp.int8), scale
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+@functools.partial(jax.jit, static_argnames=("cfg", "flash"))
 def doc_token_states(params, input_ids, attention_mask, proj,
-                     cfg: TransformerConfig):
+                     cfg: TransformerConfig, flash: bool = False):
     """One fused executable: full-depth encode -> project -> normalize ->
     int8 quant. Returns ``(payload int8 (B, S, dc), scale f32 (B, S, 1))``
     — the bank rows for a batch of documents. Runs ONCE per document at
     ingest; queries only ever dequantize."""
-    hidden = encode(params, input_ids, attention_mask, cfg)
+    hidden = encode(params, input_ids, attention_mask, cfg, flash=flash)
     return _quant_tokens(_project_tokens(hidden, attention_mask, proj))
 
 

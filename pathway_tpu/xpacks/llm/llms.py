@@ -286,6 +286,7 @@ class TPUDecoderChat(BaseChat):
         paged_kv_block: int | None = None,
         paged_kv_blocks: int | None = None,
         paged_kernel: bool | None = None,
+        flash_prefill: bool | None = None,
         disagg: bool | None = None,
         disagg_prefill_budget: int | None = None,
         tenant_sched: bool | None = None,
@@ -379,6 +380,7 @@ class TPUDecoderChat(BaseChat):
                 paged_kv_block=paged_kv_block,
                 paged_kv_blocks=paged_kv_blocks,
                 paged_kernel=paged_kernel,
+                flash_prefill=flash_prefill,
                 disagg=disagg,
                 disagg_prefill_budget=disagg_prefill_budget,
                 tenant_sched=tenant_sched,
@@ -703,6 +705,7 @@ class _ContinuousServer:
                  paged_kv_block: int | None = None,
                  paged_kv_blocks: int | None = None,
                  paged_kernel: bool | None = None,
+                 flash_prefill: bool | None = None,
                  disagg: bool | None = None,
                  disagg_prefill_budget: int | None = None,
                  tenant_sched: bool | None = None,
@@ -813,6 +816,22 @@ class _ContinuousServer:
             pathway_config.paged_kernel
             if paged_kernel is None else bool(paged_kernel)
         ))
+        # flash prefill (PATHWAY_TPU_FLASH_PREFILL): every whole-prompt
+        # admit and every chunked-prefill piece runs the tiled
+        # online-softmax kernel (models/flash_attention.py) instead of
+        # materializing the (T, C) mask-bias score matrix. Kill switch
+        # keeps the dense path byte-identical. Construction-time read:
+        # the per-server jit caches below key nothing on it — the closure
+        # captures the bool, and a rebuilt server re-traces.
+        self.flash_prefill = bool(
+            pathway_config.flash_prefill
+            if flash_prefill is None else flash_prefill
+        )
+        if self.flash_prefill:
+            from pathway_tpu.models import flash_attention as _fa
+
+            _fa.configure_blocks(pathway_config.flash_block_q,
+                                 pathway_config.flash_block_k)
         self.paged_block = 0
         self._paged_blocks_override = 0
         self._allocator = None
@@ -1530,15 +1549,45 @@ class _ContinuousServer:
         served live lanes (1.0 = every lane of every chunk was busy)."""
         return self.stats["steps"] / max(self.stats["slot_steps_total"], 1)
 
+    def _record_attn(self, path: str, n_q: int, n_k: int,
+                     batch: int = 1, cached_kv: bool = False) -> None:
+        """Charge the attention-bytes ledger for one prefill dispatch
+        (accounting model, not a hardware counter — see
+        probes.record_attn). ``cached_kv=True`` bills KV reads at the
+        pool's storage width (int8 under kv_quant)."""
+        import numpy as np
+
+        from pathway_tpu.engine.probes import record_attn
+        from pathway_tpu.models.flash_attention import (
+            attn_bytes_dense,
+            attn_bytes_flash,
+        )
+
+        cfg = self.cfg
+        dense = cfg.layers * attn_bytes_dense(n_q, n_k, cfg.heads,
+                                              batch=batch)
+        if self.flash_prefill:
+            item = 1 if (cached_kv and self.kv_quant) else (
+                np.dtype(cfg.dtype).itemsize)
+            fl = cfg.layers * attn_bytes_flash(
+                n_q, n_k, cfg.heads, cfg.hidden // cfg.heads,
+                batch=batch, itemsize=item,
+            )
+            record_attn(path, fl, saved=dense - fl)
+        else:
+            record_attn(path, dense)
+
     def _admit_fn(self, s: int):
         fn = self._admit_fns.get(s)
         if fn is None:
             import jax
 
             D, cfgc = self._D, self.cfg
+            fl, msh = self.flash_prefill, self.mesh
 
             def admit(params_, ids, mask, pool, slot):
-                return D.pool_admit(params_, ids, mask, pool, slot, cfgc)
+                return D.pool_admit(params_, ids, mask, pool, slot, cfgc,
+                                    flash=fl, mesh=msh)
 
             fn = jax.jit(admit, donate_argnums=(3,))
             self._admit_fns[s] = fn
@@ -1550,10 +1599,11 @@ class _ContinuousServer:
             import jax
 
             D, cfgc = self._D, self.cfg
+            fl, msh = self.flash_prefill, self.mesh
 
             def admit(params_, ids, mask, pool, slots):
                 return D.pool_admit_batch(params_, ids, mask, pool, slots,
-                                          cfgc)
+                                          cfgc, flash=fl, mesh=msh)
 
             fn = jax.jit(admit, donate_argnums=(3,))
             self._admit_batch_fns[(m, s)] = fn
@@ -1639,6 +1689,7 @@ class _ContinuousServer:
             import jax
 
             D, cfgc = self._D, self.cfg
+            fl, msh = self.flash_prefill, self.mesh
 
             if with_col:
                 # cached-path final piece: the prompt's last real token
@@ -1649,7 +1700,7 @@ class _ContinuousServer:
                     return D.pool_prefill_chunk(
                         params_, ids, mask, pos, pool, slot, start,
                         n_prompt, cfgc, first=first, last=last,
-                        last_col=last_col,
+                        last_col=last_col, flash=fl, mesh=msh,
                     )
             else:
                 def piece(params_, ids, mask, pos, pool, slot, start,
@@ -1657,6 +1708,7 @@ class _ContinuousServer:
                     return D.pool_prefill_chunk(
                         params_, ids, mask, pos, pool, slot, start,
                         n_prompt, cfgc, first=first, last=last,
+                        flash=fl, mesh=msh,
                     )
 
             fn = jax.jit(piece, donate_argnums=(4,))
@@ -2409,6 +2461,8 @@ class _ContinuousServer:
                 np.int32(lc),
             )
         self.stats["prefill_chunks"] += 1
+        self._record_attn("chunk", int(p_ids.shape[1]), self.cache_len,
+                          cached_kv=True)
         req_p = self.slots[slot]
         if req_p is not None:
             req_p.span.event(
@@ -2600,6 +2654,7 @@ class _ContinuousServer:
                             self.pool = self._admit_batch_fn(m, s)(
                                 self.params, ids, mask, self.pool, slots
                             )
+                        self._record_attn("prefill", s, s, batch=m)
                         self.stats["admit_dispatches"] += 1
                         for p in part:
                             active[p[0]] = True
@@ -2608,6 +2663,7 @@ class _ContinuousServer:
                     self.pool = self._admit_fn(s)(
                         self.params, ids, mask, self.pool, np.int32(slot)
                     )
+                    self._record_attn("prefill", s, s)
                     self.stats["admit_dispatches"] += 1
                     active[slot] = True
 

@@ -61,6 +61,9 @@ def test_bench_smoke_schema():
         # workload-driven autotuner (ISSUE 17): the --tuned arm replays
         # two profiles default-vs-tuned off a validated config
         "tuned_tok_s", "default_tok_s", "tuned",
+        # flash prefill (ISSUE 18): tiled online-softmax sweep, flash vs
+        # dense at every seq with linear-not-quadratic byte accounting
+        "flash_prefill",
     ):
         assert s.get(key) is not None, key
     # the --tuned arm: both profiles ran both legs, the measured config
@@ -96,6 +99,23 @@ def test_bench_smoke_schema():
     mdevs = ms["hbm_device_high_water_bytes"]
     assert set(mdevs) >= {str(i) for i in range(8)}, mdevs
     assert all(v > 0 for v in mdevs.values()), mdevs
+    # flash prefill (ISSUE 18): both arms ran at every swept seq, flash
+    # emitted the dense greedy tokens, and the byte accounting doubles
+    # (not quadruples) per seq doubling — linear, the tentpole claim
+    fp = s["flash_prefill"]
+    assert fp.get("error") is None, fp
+    assert fp["flash_tok_s"] > 0 and fp["dense_tok_s"] > 0
+    assert fp["tokens_match"] is True
+    assert fp["attn_bytes_linear"] is True
+    seqs = [str(x) for x in fp["seqs"]]
+    assert set(fp["sweep"]) == set(seqs)
+    for a, b in zip(seqs, seqs[1:]):
+        fa, fb = (fp["sweep"][a]["attn_bytes_flash"],
+                  fp["sweep"][b]["attn_bytes_flash"])
+        da, db = (fp["sweep"][a]["attn_bytes_dense"],
+                  fp["sweep"][b]["attn_bytes_dense"])
+        assert fb <= 3 * fa, (fa, fb)       # linear: ~2x per doubling
+        assert db == pytest.approx(4 * da), (da, db)  # dense: quadratic
     assert 0.0 <= s["knn_recall_at_10_f32"] <= 1.0
     # the query-serving phase ran under load: a survivor rate strictly
     # inside (0, 1] and a non-empty tick batch histogram

@@ -178,7 +178,7 @@ def test_serving_and_unified_snapshot_shapes():
     assert set(serving) == {
         "prefix", "spec", "cascade", "dispatch", "stage_seconds",
         "occupancy", "latency", "lanes", "tenants", "kv_parked_bytes",
-        "retrieval",
+        "retrieval", "attn",
     }
     assert serving["prefix"]["hit_rate"] == 0.5
     assert serving["latency"]["ttft_seconds"]["count"] == 1

@@ -201,6 +201,63 @@ def outer(mesh, x, specs):
     assert found[0].symbol == "mapped"
 
 
+def test_gl101_block_spec_index_map_is_a_root():
+    """A BlockSpec index map runs under Pallas tracing (grid
+    resolution), so host effects inside it are GL101 — both the 2nd
+    positional arg and the index_map= keyword forms root it."""
+    src = """
+import jax.experimental.pallas as pl
+
+def imap(b, kt):
+    print(b)
+    return (b, kt)
+
+def kmap(b, kt):
+    import time
+    time.sleep(0)
+    return (b, 0)
+
+def body(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def call(x):
+    return pl.pallas_call(
+        body,
+        grid=(2, 2),
+        in_specs=[pl.BlockSpec((8, 8), imap)],
+        out_specs=pl.BlockSpec((8, 8), index_map=kmap),
+        out_shape=x,
+    )(x)
+"""
+    found = analyze_source(src)
+    assert _rules(found) == ["GL101"]
+    assert {f.symbol for f in found} == {"imap", "kmap"}
+
+
+def test_gl101_clean_block_spec_index_map():
+    """A pure index map (the repo's named-top-level convention in
+    models/flash_attention.py) stays clean."""
+    src = """
+import jax.experimental.pallas as pl
+
+def imap(b, kt):
+    return (b, 0, kt, 0)
+
+def body(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+def call(x):
+    return pl.pallas_call(
+        body,
+        grid=(2, 2),
+        in_specs=[pl.BlockSpec((8, 8), imap)],
+        out_specs=pl.BlockSpec((8, 8), imap),
+        out_shape=x,
+    )(x)
+"""
+    assert analyze_source(src) == []
+
+
 def test_gl101_clean_shard_map_body():
     src = """
 import jax
