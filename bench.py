@@ -1762,6 +1762,110 @@ def config7_long_prefill() -> dict:
     }
 
 
+def config8_weight_quant() -> dict:
+    """Weight-only int8 (PATHWAY_TPU_WEIGHT_QUANT tentpole): the same
+    greedy continuous-batching burst through two ``TPUDecoderChat``
+    servers — weights stored bf16/f32 (base) vs symmetric per-channel
+    int8 with dequant fused into the matmul read (quant). Reports decode
+    tok/s per arm, the ``weights.decoder`` HBM-ledger bytes each arm
+    actually placed (the footprint the flag exists to shrink — gate
+    >= 1.7x saved), and position-wise greedy top-1 agreement between the
+    two token streams (gate >= 0.99). On CPU the speed pair is
+    illustrative; the portable claims are the bytes ratio + agreement."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.engine import probes
+    from pathway_tpu.models import decoder as D
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    t_phase = time.perf_counter()
+    if _smoke():
+        NREQ, MAXNEW, N_SLOTS, CHUNK = 4, 8, 4, 4
+        cfg = D.DecoderConfig(
+            vocab_size=128, hidden=32, layers=2, heads=4,
+            intermediate=64, max_position=128, dtype=jnp.float32,
+        )
+    else:
+        NREQ, MAXNEW, N_SLOTS, CHUNK = 32, 48, 16, 8
+        cfg = D.DecoderConfig(
+            vocab_size=256, hidden=64, layers=4, heads=8,
+            intermediate=128, max_position=256, dtype=jnp.float32,
+        )
+    params = D.init_params(jax.random.PRNGKey(0), cfg)
+
+    class _Tok:
+        eos_id = None  # budget-bounded: every request decodes MAXNEW
+
+        def encode(self, text):
+            return [(ord(c) % 96) + 1 for c in text]
+
+        def decode(self, ids):
+            return "".join(chr((int(i) % 96) + 32) for i in ids)
+
+    head = "c" * 40 + "ontext: "
+    prompts = [head + f"q{k:02d}tail"[:8].ljust(8, "x") for k in range(NREQ)]
+
+    def run_arm(wq: str):
+        chat = TPUDecoderChat(
+            params=params, cfg=cfg, tokenizer=_Tok(),
+            max_new_tokens=MAXNEW, temperature=0.0, max_prompt_tokens=64,
+            continuous=True, n_slots=N_SLOTS, chunk_steps=CHUNK,
+            prefill_chunk=8, weight_quant=wq,
+        )
+        try:
+            # the ledger gauge is SET per (component, device) at placement,
+            # so read it while THIS arm's params are the latest record
+            hbm = probes.hbm_stats().get("current_bytes") or {}
+            wbytes = int(hbm.get("weights.decoder") or 0)
+            for r in chat.submit_batch([prompts[0]]):  # compile + warm
+                r.done.wait(timeout=300)
+            t0 = time.perf_counter()
+            reqs = [chat.submit_batch([p])[0] for p in prompts]
+            for r in reqs:
+                r.done.wait(timeout=300)
+            wall = max(time.perf_counter() - t0, 1e-9)
+            toks = [list(r.tokens) for r in reqs]
+            tps = sum(len(t) for t in toks) / wall
+            return tps, wbytes, toks
+        finally:
+            chat.close()
+
+    base_tps, base_bytes, base_toks = run_arm("")
+    quant_tps, quant_bytes, quant_toks = run_arm("int8")
+
+    # position-wise greedy top-1 agreement across the whole burst
+    agree = total = 0
+    for bt, qt in zip(base_toks, quant_toks):
+        n = max(len(bt), len(qt))
+        total += n
+        agree += sum(
+            1 for i in range(min(len(bt), len(qt))) if bt[i] == qt[i]
+        )
+    agreement = agree / max(total, 1)
+    detail = {
+        "backend": jax.default_backend(),
+        "quant_tok_s": round(quant_tps, 1),
+        "base_tok_s": round(base_tps, 1),
+        "speedup_x": round(quant_tps / max(base_tps, 1e-9), 3),
+        "weights_hbm_bytes_base": base_bytes,
+        "weights_hbm_bytes_quant": quant_bytes,
+        "bytes_saved_x": round(base_bytes / max(quant_bytes, 1), 3),
+        "agreement": round(agreement, 4),
+        "tokens_match": base_toks == quant_toks,
+        "nreq": NREQ,
+        "max_new": MAXNEW,
+        "elapsed_s": round(time.perf_counter() - t_phase, 1),
+    }
+    diag(phase="config8_weight_quant", **detail)
+    return {
+        "metric": "weight_quant_tok_s",
+        "value": detail["quant_tok_s"],
+        "unit": "tokens/s",
+        "detail": detail,
+    }
+
+
 def config_join_streaming() -> dict:
     """Streaming inner join through the FULL engine (kafka -> join ->
     select -> subscribe): orders x users on user id, 200k orders against
@@ -3442,6 +3546,7 @@ def run_single_phase(name: str) -> None:
         "config5_sharded": config5_sharded,
         "config6_mesh": config6_mesh_serving,
         "config7_prefill": config7_long_prefill,
+        "config8_weight_quant": config8_weight_quant,
         "join": config_join_streaming,
         "wordcount": config_wordcount_streaming,
         "decoder": config_decoder_generate,
@@ -3533,6 +3638,7 @@ def main() -> None:
             ("decoder", config_decoder_generate),
             ("config_tuned", config_tuned_serving),
             ("config7_prefill", config7_long_prefill),
+            ("config8_weight_quant", config8_weight_quant),
             ("config6_mesh", lambda: _run_phase_subprocess(
                 "config6_mesh", timeout_s=600, env=cpu8_env)),
         )
@@ -3552,6 +3658,7 @@ def main() -> None:
             ("config5_sharded", 2400, cpu8_env),
             ("config6_mesh", 1800, cpu8_env),
             ("config7_prefill", 1800, None),
+            ("config8_weight_quant", 1200, None),
         ):
             try:
                 extra.append(
@@ -3734,6 +3841,7 @@ def main() -> None:
     mesh_m = _m("mesh_serving_tok_s")
     mesh_det = mesh_m.get("detail") or {}
     fp_det = _m("flash_prefill_tok_s").get("detail") or {}
+    wq_det = _m("weight_quant_tok_s").get("detail") or {}
     ceiling = headline_detail.get("ceiling") or {}
     wc = _m("wordcount_streaming_rows_per_sec")
     # pipeline-depth observability: per-operator latency from THIS
@@ -3891,6 +3999,16 @@ def main() -> None:
                 )
                 if k in fp_det
             },
+            "weight_quant": {
+                k: wq_det.get(k)
+                for k in (
+                    "backend", "quant_tok_s", "base_tok_s", "speedup_x",
+                    "weights_hbm_bytes_base", "weights_hbm_bytes_quant",
+                    "bytes_saved_x", "agreement", "tokens_match",
+                    "elapsed_s", "error",
+                )
+                if k in wq_det
+            },
             "engine": {
                 "op_latency_p50_ms": engine_telemetry.get(
                     "op_latency_p50_ms"
@@ -4031,6 +4149,20 @@ def main() -> None:
             missing.append("summary.flash_prefill.tokens_match")
         if fp.get("attn_bytes_linear") is not True:
             missing.append("summary.flash_prefill.attn_bytes_linear")
+        # weight-quant acceptance: both arms ran, the int8 arm's ledger
+        # footprint is >= 1.7x smaller, and its greedy stream agrees
+        # with the full-precision stream at >= 0.99 top-1 (the tentpole
+        # quality bar)
+        wq = s.get("weight_quant") or {}
+        for k in ("quant_tok_s", "base_tok_s", "weights_hbm_bytes_base",
+                  "weights_hbm_bytes_quant"):
+            _chk(f"summary.weight_quant.{k}", wq.get(k))
+        bsx = wq.get("bytes_saved_x")
+        if not (isinstance(bsx, (int, float)) and bsx >= 1.7):
+            missing.append("summary.weight_quant.bytes_saved_x>=1.7")
+        agr = wq.get("agreement")
+        if not (isinstance(agr, (int, float)) and agr >= 0.99):
+            missing.append("summary.weight_quant.agreement>=0.99")
         # observability keys: operator telemetry and the HBM ledger must
         # have actually sampled during the run, not merely exist
         eng = s.get("engine") or {}
@@ -4199,6 +4331,27 @@ def sentinel_check(summary: dict, baseline: dict, smoke: bool) -> list:
         breaches.append(
             "summary.flash_prefill.attn_bytes_linear: flash attention "
             "bytes grew super-linearly in seq"
+        )
+    # weight-quant gates, exact at every scale (absent on pre-quant
+    # baselines is fine; present-but-broken is a breach): the int8 arm
+    # must hold the >= 1.7x weights-footprint saving and >= 0.99 greedy
+    # top-1 agreement vs full precision
+    wq_new = new.get("weight_quant") or {}
+    wqb = wq_new.get("bytes_saved_x")
+    if wqb is not None and not (
+        isinstance(wqb, (int, float)) and wqb >= 1.7
+    ):
+        breaches.append(
+            f"summary.weight_quant.bytes_saved_x: {wqb} < 1.7 — int8 "
+            f"weights stopped shrinking the HBM footprint"
+        )
+    wqa = wq_new.get("agreement")
+    if wqa is not None and not (
+        isinstance(wqa, (int, float)) and wqa >= 0.99
+    ):
+        breaches.append(
+            f"summary.weight_quant.agreement: {wqa} < 0.99 — int8 arm "
+            f"diverged from full precision past the quality bar"
         )
     # fleet gates, exact at every scale: the affinity router must hold
     # the single-replica prefix hit rate, and the chaos arm (one

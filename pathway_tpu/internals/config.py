@@ -47,6 +47,15 @@ def _parse_kv_quant(raw: str) -> str:
     ) else ""
 
 
+def _parse_weight_quant(raw: str) -> str:
+    """``int8`` (or any truthy spelling) enables weight-only int8
+    storage; every other value — the kill switch ``0`` included — keeps
+    full-precision weights byte-identically."""
+    return "int8" if raw.strip().lower() in (
+        "1", "true", "yes", "on", "int8"
+    ) else ""
+
+
 @dataclass(frozen=True)
 class Tunable:
     """Search-space declaration for one flag — what the autotuner
@@ -435,6 +444,36 @@ FLAG_REGISTRY: list[Flag] = [
             "holds ~2× the slots + cached prefix blocks. `0` (default) "
             "keeps full-precision KV byte-identically "
             "(`tests/test_kv_quant.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_WEIGHT_QUANT", kind="str", default="",
+        reload="construction",
+        kill_switch=True, pinned_by="tests/test_weight_quant.py",
+        attr="weight_quant", group="pipeline", parse=_parse_weight_quant,
+        tunable=Tunable(kind="choice", choices=("0", "int8")),
+        doc="`int8` stores every large weight matrix of the decoder "
+            "(qkv/attn-out/MLP, wte + tied LM head), the MiniLM embedder "
+            "and the cross-encoder as symmetric per-output-channel int8 "
+            "with f32 scales, dequantized inside the matmul read "
+            "(`models/decoder.py:quantize_params`) — ~4× fewer weight "
+            "bytes streamed per decode step on a memory-bound roofline, "
+            "at ≥0.99 greedy top-1 agreement. `0` (default) serves "
+            "full-precision weights byte-identically "
+            "(`tests/test_weight_quant.py`).",
+    ),
+    Flag(
+        env="PATHWAY_TPU_WQ_KERNEL", kind="bool", default=False,
+        reload="construction",
+        kill_switch=True, pinned_by="tests/test_weight_quant.py",
+        attr="wq_kernel", group="pipeline",
+        doc="Route the quantized decoder matmuls through the Pallas "
+            "fused int8-weight kernel (`models/wq_matmul.py`): the int8 "
+            "tile is widened and scaled inside VMEM, so a full-precision "
+            "weight copy never exists. Requires "
+            "`PATHWAY_TPU_WEIGHT_QUANT=int8`; `0` (default) keeps the "
+            "XLA fused-dequant einsums, which are the numerical "
+            "reference (`tests/test_weight_quant.py`). Off-TPU the "
+            "kernel runs interpreted, like flash/paged attention.",
     ),
     Flag(
         env="PATHWAY_TPU_PAGED_KV", kind="bool", default=False,

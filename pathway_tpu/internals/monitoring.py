@@ -109,6 +109,14 @@ class StatsMonitor:
         ):
             if nbytes:
                 rows.append((f"hbm device {dev}", f"{nbytes / 1e6:.2f} MB"))
+        # model-weight components (weights.decoder / .embedder /
+        # .reranker): the footprint the weight-quant flag shrinks — one
+        # row per model so bytes-saved is visible next to the KV pool
+        for comp, nbytes in sorted(
+            (hbm.get("current_bytes") or {}).items()
+        ):
+            if nbytes and comp.startswith("weights."):
+                rows.append((f"hbm {comp}", f"{nbytes / 1e6:.2f} MB"))
         if not rows:
             return None
         panel = RichTable(title="serving")

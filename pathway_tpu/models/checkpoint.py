@@ -399,6 +399,20 @@ _CKPT_ARRAYS = "params.npz"
 _CKPT_LAYOUT = "layout.json"
 _KEY_SEP = "/"
 
+# flat keys whose presence marks a weight-quantized artifact: the
+# decoder's / encoder's int8 format markers (models/decoder.py
+# ``params_quantized`` / models/transformer.py ``encoder_params_quantized``)
+_WQ_MARKER_KEYS = ("wte_scale", "embeddings/word_scale")
+
+
+class QuantizedCheckpointError(RuntimeError):
+    """Raised when a weight-quantized checkpoint is loaded while
+    ``PATHWAY_TPU_WEIGHT_QUANT`` is off. Loading would otherwise hand
+    the caller raw int8 payloads that no unquantized forward path knows
+    how to read — or invite a silent dequant-to-f32 that forfeits the
+    quality pin. The artifact says what it is (the ``weight_quant``
+    layout field); the serving config must agree."""
+
 
 def _flatten_tree(tree: dict, prefix: str = "") -> dict[str, "Any"]:
     flat: dict[str, Any] = {}
@@ -456,6 +470,8 @@ def save_checkpoint(path: str, params: dict, *, mesh=None) -> None:
             if (names := _leaf_spec_names(v)) is not None
         },
     }
+    if any(k in flat for k in _WQ_MARKER_KEYS):
+        layout["weight_quant"] = "int8"
     if mesh is not None:
         layout["mesh"] = {
             "axes": [str(a) for a in mesh.axis_names],
@@ -488,12 +504,31 @@ def load_checkpoint(path: str, *, mesh=None, specs=None) -> dict:
     mesh replicated."""
     with np.load(os.path.join(path, _CKPT_ARRAYS)) as z:
         flat = {k: z[k] for k in z.files}
+    layout = checkpoint_layout(path)
+    quantized = (layout.get("weight_quant")
+                 or any(k in flat for k in _WQ_MARKER_KEYS))
+    if quantized:
+        from pathway_tpu.internals.config import pathway_config
+
+        if not pathway_config.weight_quant:
+            raise QuantizedCheckpointError(
+                f"{path!r} holds int8-quantized weights (layout "
+                f"weight_quant={layout.get('weight_quant')!r}) but "
+                "PATHWAY_TPU_WEIGHT_QUANT is off — refusing to load "
+                "int8 payloads into an unquantized serving config. "
+                "Set PATHWAY_TPU_WEIGHT_QUANT=int8, or save an "
+                "unquantized checkpoint."
+            )
     if mesh is None:
         return _unflatten_tree(flat)
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
-    saved = checkpoint_layout(path).get("specs", {})
+    saved = layout.get("specs", {})
+    if specs is not None and any(isinstance(v, dict) for v in specs.values()):
+        # a nested spec pytree (param_mesh_specs / shard layouts) —
+        # flatten to the same "a/b" keys the arrays are stored under
+        specs = _flatten_tree(specs)
     axis_names = set(mesh.axis_names)
 
     def keep(axes, dim: int):

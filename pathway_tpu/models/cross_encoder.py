@@ -85,7 +85,23 @@ class CrossEncoderModel:
         key = jax.random.PRNGKey(seed)
         if params is None:
             params = init_params(key, cfg)
+        # weight-only int8 (PATHWAY_TPU_WEIGHT_QUANT, construction-time
+        # read): the rerank encoder's word table and layer weights store
+        # int8 + f32 scales, dequantized inside the einsum read; the
+        # pooler/head stay f32 (they feed the score in f32 already)
+        self.weight_quant = str(pathway_config.weight_quant or "")
+        if self.weight_quant:
+            from pathway_tpu.models.transformer import quantize_encoder_params
+
+            params = quantize_encoder_params(params)
         self.params = params
+        # HBM ledger: the reranker's physical param footprint at
+        # construction (host-held arrays charge device "0")
+        from pathway_tpu.engine.probes import record_hbm
+        from pathway_tpu.models.decoder import params_device_bytes
+
+        for dev, nbytes in params_device_bytes(self.params).items():
+            record_hbm("weights.reranker", nbytes, device=dev)
         if head is None:
             head = {
                 "w": _dense_init(jax.random.fold_in(key, 7),

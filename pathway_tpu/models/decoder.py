@@ -46,6 +46,10 @@ class DecoderConfig:
     layer_norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # routes the fused int8-weight matmuls through the Pallas kernel
+    # (models/wq_matmul.py) — a CONFIG field, not a module global, so the
+    # jit caches key on it and a rebuilt server cannot serve stale traces
+    wq_kernel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -162,16 +166,31 @@ def param_mesh_specs(params: dict, cfg: DecoderConfig, mesh) -> dict:
         SERVE_FSDP_AXIS, SERVE_TP_AXIS, spec_with_fsdp,
     )
 
+    from pathway_tpu.parallel.mesh import spec_dropping_nondividing
+
     fsdp = int(mesh.shape.get(SERVE_FSDP_AXIS, 1))
     specs = param_partition_specs(cfg, tp_axis=SERVE_TP_AXIS)
-    is_spec = lambda x: x is None or isinstance(x, P)
-    leaves, treedef = jax.tree_util.tree_flatten(params)
-    spec_leaves = jax.tree_util.tree_flatten(specs, is_leaf=is_spec)[0]
-    out = [
-        spec_with_fsdp(s, leaf.shape, fsdp)
-        for leaf, s in zip(leaves, spec_leaves)
-    ]
-    return jax.tree_util.tree_unflatten(treedef, out)
+
+    def leaf_spec(path, leaf):
+        node = specs
+        for key in path[:-1]:
+            node = node[key.key]
+        name = path[-1].key
+        if name in node:
+            s = node[name]
+        elif name.endswith("_scale") and name[: -len("_scale")] in node:
+            # int8 weight-quant scale plane (quantize_params): inherit
+            # the payload's tp spec with non-dividing axes dropped — the
+            # keepdims size-1 contracted dim degrades to replicated, the
+            # output-channel dim keeps its shard so scale rows co-locate
+            # with their int8 columns.
+            s = spec_dropping_nondividing(
+                node[name[: -len("_scale")]], leaf.shape, mesh)
+        else:
+            raise KeyError(f"no partition spec for decoder param {name!r}")
+        return spec_with_fsdp(s, leaf.shape, fsdp)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
 
 def pool_partition_specs(pool: dict, mesh) -> dict:
@@ -270,15 +289,124 @@ def pool_quantized(pool: dict) -> bool:
     return "k_scale" in pool or "kb_scale" in pool
 
 
+# ---- weight-only int8 quantization (PATHWAY_TPU_WEIGHT_QUANT=int8) --------
+#
+# Decode streams the WHOLE parameter set from HBM every step (spec decode
+# amortizes it over k+1 tokens, but the stream itself is full-precision).
+# Weight-only quantization stores every large matmul weight — qkv_w,
+# attn_out_w, the MLP pair, and wte (embedding table AND tied LM head) —
+# as symmetric per-output-channel int8 with one f32 scale per output
+# channel (max|w| / 127 over the CONTRACTED axis), the standard roofline
+# move for a memory-bound decode. Dequant is fused into the matmul read:
+# the int8 payload feeds the einsum directly (int8 values <= 127 are
+# exact in bf16) with f32 accumulation, and the per-output-channel scale
+# multiplies the OUTPUT — algebraically identical to dequantizing the
+# weight first, without ever materializing a full-precision copy.
+# Presence of a ``wte_scale`` key is the static format marker every
+# forward path branches on (mirroring the pool's ``k_scale``), so
+# prefill, chunked prefill, decode chunks, spec draft/verify and the
+# paged kernel path all pick the quantized read up from ONE seam
+# (:func:`_wq_matmul` / :func:`_tok_embed` / :func:`_logits`) without
+# forking numerics. With no scale keys present every branch reproduces
+# the historical ops byte-for-byte (tests/test_weight_quant.py pins it).
+
+_WQ_QMAX = 127.0
+_WQ_SCALE_FLOOR = 1e-8  # all-zero channels quantize to exact zeros
+# the decoder leaves that quantize, with their contracted axis
+_WQ_LAYER_WEIGHTS = ("qkv_w", "attn_out_w", "mlp_in_w", "mlp_out_w")
+
+
+def _wq_quant(w, axis: int):
+    """Symmetric int8 quantization of one weight over its contracted
+    ``axis``: returns ``(payload int8, scale f32)`` with the scale
+    keeping a size-1 dim at ``axis`` (one scale per OUTPUT channel).
+    ``|w| / scale <= 127`` by construction, so the round never clips."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax / _WQ_QMAX, _WQ_SCALE_FLOOR)
+    return jnp.round(wf / scale).astype(jnp.int8), scale
+
+
+def params_quantized(params: dict) -> bool:
+    """True when ``params`` store int8 weights (:func:`quantize_params`)."""
+    return "wte_scale" in params
+
+
+def quantize_params(params: dict, cfg: DecoderConfig) -> dict:
+    """int8-quantize the large decoder weights for serving: wte (and the
+    tied LM head with it) per vocab row, each stacked layer weight per
+    output channel. Everything else (wpe, biases, layernorms) keeps the
+    :func:`cast_params_for_inference` treatment. Scales are computed from
+    the ORIGINAL full-precision leaves — quantizing after a bf16 cast
+    would bake the cast's mantissa loss into the scales."""
+    out = dict(cast_params_for_inference(params, cfg))
+    out["wte"], out["wte_scale"] = _wq_quant(params["wte"], axis=-1)
+    layers = dict(out["layers"])
+    for name in _WQ_LAYER_WEIGHTS:
+        q, s = _wq_quant(params["layers"][name], axis=-2)
+        layers[name], layers[name + "_scale"] = q, s
+    out["layers"] = layers
+    return out
+
+
+def _wq_matmul(eq: str, x, lp: dict, name: str, cfg: DecoderConfig):
+    """The ONE weight-matmul seam: ``einsum(eq, x, lp[name])`` with the
+    historical unquantized ops when ``lp`` has no ``{name}_scale`` key
+    (byte-identical — same cast, same accumulation preference), or the
+    fused-dequant int8 read when it does: int8 payload in the compute
+    dtype, f32 accumulation, per-output-channel scale applied to the
+    output. ``cfg.wq_kernel`` routes the quantized branch through the
+    Pallas fused kernel (models/wq_matmul.py) when the operand layout
+    fits; the XLA expression is the fallback and the reference."""
+    w = lp[name]
+    scale = lp.get(name + "_scale")
+    if scale is None:
+        return jnp.einsum(eq, x, w.astype(cfg.dtype),
+                          preferred_element_type=cfg.dtype)
+    if cfg.wq_kernel and x.ndim == 3 and w.ndim == 2:
+        from pathway_tpu.models import wq_matmul as _wqk
+
+        B, S, K = x.shape
+        out = _wqk.wq_matmul(
+            x.reshape(B * S, K), w, scale.reshape(1, -1)
+        ).reshape(B, S, w.shape[-1])
+        return out.astype(cfg.dtype)
+    out = jnp.einsum(eq, x, w.astype(cfg.dtype),
+                     preferred_element_type=jnp.float32)
+    return (out * scale).astype(cfg.dtype)
+
+
+def _tok_embed(params: dict, ids: jax.Array) -> jax.Array:
+    """Token-embedding gather with dequant fused into the row read:
+    unquantized tables pass the gathered rows through untouched (the
+    historical expression, byte-identical); int8 tables dequantize the
+    gathered rows with their per-row scales — O(rows) work, never the
+    full table."""
+    rows = params["wte"][ids]
+    s = params.get("wte_scale")
+    if s is None:
+        return rows
+    return rows.astype(jnp.float32) * s[ids]
+
+
+def params_device_bytes(params: dict) -> dict[str, int]:
+    """Physical param bytes per device id (scales included), from each
+    leaf's addressable shards — the ``weights.*`` HBM ledger's source,
+    mirroring :func:`pool_component_device_bytes` for the KV pool."""
+    out: dict[str, int] = {}
+    for leaf in jax.tree_util.tree_leaves(params):
+        for dev, n in _device_bytes(leaf).items():
+            out[dev] = out.get(dev, 0) + n
+    return out
+
+
 def _block_qkv(x, lp, cfg: DecoderConfig):
     """Pre-LN + fused QKV projection, head-split: ``(q, k_new, v_new)``
     each (B, nh, S, hd). Shared by :func:`_block` and the paged-kernel
     decode path, so both read identical projections."""
     nh, hd = cfg.heads, cfg.head_dim
     h1 = _ln(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
-    qkv = jnp.einsum("bsh,hk->bsk", h1.astype(cfg.dtype),
-                     lp["qkv_w"].astype(cfg.dtype),
-                     preferred_element_type=cfg.dtype)
+    qkv = _wq_matmul("bsh,hk->bsk", h1.astype(cfg.dtype), lp, "qkv_w", cfg)
     qkv = qkv + lp["qkv_b"].astype(cfg.dtype)
     q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
     return (_split_heads(q, nh, hd), _split_heads(k_new, nh, hd),
@@ -313,17 +441,13 @@ def _block_finish(x, lp, ctx, cfg: DecoderConfig):
     MLP. ``ctx`` is the attention read (B, nh, S, hd)."""
     B, S, H = x.shape
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H)
-    attn = jnp.einsum("bsh,hk->bsk", ctx, lp["attn_out_w"].astype(cfg.dtype),
-                      preferred_element_type=cfg.dtype)
+    attn = _wq_matmul("bsh,hk->bsk", ctx, lp, "attn_out_w", cfg)
     x = x + attn + lp["attn_out_b"].astype(cfg.dtype)
     h2 = _ln(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layer_norm_eps)
-    m = jnp.einsum("bsh,hi->bsi", h2.astype(cfg.dtype),
-                   lp["mlp_in_w"].astype(cfg.dtype),
-                   preferred_element_type=cfg.dtype)
+    m = _wq_matmul("bsh,hi->bsi", h2.astype(cfg.dtype), lp, "mlp_in_w", cfg)
     # gelu_new (tanh approximation) — what GPT-2 checkpoints are trained with
     m = jax.nn.gelu(m + lp["mlp_in_b"].astype(cfg.dtype), approximate=True)
-    m = jnp.einsum("bsi,ih->bsh", m, lp["mlp_out_w"].astype(cfg.dtype),
-                   preferred_element_type=cfg.dtype)
+    m = _wq_matmul("bsi,ih->bsh", m, lp, "mlp_out_w", cfg)
     x = x + m + lp["mlp_out_b"].astype(cfg.dtype)
     return x.astype(cfg.dtype)
 
@@ -426,9 +550,15 @@ def _flash_chunk_attn_fn(mesh, quant):
 
 def _logits(params, x, cfg):
     h = _ln(x, params["ln_f_scale"], params["ln_f_bias"], cfg.layer_norm_eps)
-    return jnp.einsum("bsh,vh->bsv", h.astype(cfg.dtype),
-                      params["wte"].astype(cfg.dtype),
-                      preferred_element_type=jnp.float32)
+    out = jnp.einsum("bsh,vh->bsv", h.astype(cfg.dtype),
+                     params["wte"].astype(cfg.dtype),
+                     preferred_element_type=jnp.float32)
+    s = params.get("wte_scale")
+    if s is not None:
+        # tied LM head over the int8 table: wte_scale is (V, 1) — one
+        # scale per vocab row == per output channel of this einsum
+        out = out * s[:, 0]
+    return out
 
 
 def forward(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
@@ -450,7 +580,7 @@ def forward(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
     ``mesh`` shard-maps the kernel over tp shards (heads split)."""
     B, S = input_ids.shape
     pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-    x = (params["wte"][input_ids] + params["wpe"][pos]).astype(cfg.dtype)
+    x = (_tok_embed(params, input_ids) + params["wpe"][pos]).astype(cfg.dtype)
     ctx_fn = mask_bias = None
     if flash:
         attn = _flash_self_attn_fn(mesh)
@@ -474,9 +604,7 @@ def _prefill_kv(x, lp, cfg):
     """Project this layer's k/v from the in-sequence activations (pre-LN
     applied inside, mirroring _block's own projection)."""
     h1 = _ln(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layer_norm_eps)
-    qkv = jnp.einsum("bsh,hk->bsk", h1.astype(cfg.dtype),
-                     lp["qkv_w"].astype(cfg.dtype),
-                     preferred_element_type=cfg.dtype)
+    qkv = _wq_matmul("bsh,hk->bsk", h1.astype(cfg.dtype), lp, "qkv_w", cfg)
     qkv = qkv + lp["qkv_b"].astype(cfg.dtype)
     _, k, v = jnp.split(qkv, 3, axis=-1)
     nh, hd = cfg.heads, cfg.head_dim
@@ -498,7 +626,7 @@ def prefill(params: dict, input_ids: jax.Array, attention_mask: jax.Array,
     B, S = input_ids.shape
     assert cache_len >= S
     pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0)
-    x = (params["wte"][input_ids] + params["wpe"][pos]).astype(cfg.dtype)
+    x = (_tok_embed(params, input_ids) + params["wpe"][pos]).astype(cfg.dtype)
     ctx_fn = mask_bias = None
     if flash:
         attn = _flash_self_attn_fn(mesh)
@@ -537,7 +665,7 @@ def decode_step(params: dict, token: jax.Array, step_pos: jax.Array,
     model, its KV a depth-prefix of the same cache (layers >= N pass
     through untouched), no second parameter set anywhere."""
     B = token.shape[0]
-    x = (params["wte"][token][:, None, :]
+    x = (_tok_embed(params, token)[:, None, :]
          + params["wpe"][step_pos][:, None, :]).astype(cfg.dtype)
     mask_bias = jnp.where(slot_mask[:, None, None, :] > 0, 0.0, -1e9
                           ).astype(jnp.float32)
@@ -1234,7 +1362,7 @@ def pool_prefill_chunk(params: dict, ids: jax.Array, mask: jax.Array,
     T = ids.shape[1]
     nh, hd = cfg.heads, cfg.head_dim
     p = jnp.clip(pos, 0, cfg.max_position - 1)
-    x = (params["wte"][ids] + params["wpe"][p]).astype(cfg.dtype)
+    x = (_tok_embed(params, ids) + params["wpe"][p]).astype(cfg.dtype)
     if first:
         row_mask = jnp.zeros((1, C), jnp.int32)
     else:
@@ -1526,7 +1654,7 @@ def pool_decode_chunk(params: dict, pool: dict, active: jax.Array,
             1, slot_mask,
         )
         p = jnp.minimum(pos, cfg.max_position - 1)
-        x = (params["wte"][tok][:, None, :]
+        x = (_tok_embed(params, tok)[:, None, :]
              + params["wpe"][p][:, None, :]).astype(cfg.dtype)
         mask_bias = jnp.where(
             slot_mask[:, None, None, :] > 0, 0.0, -1e9
@@ -1652,7 +1780,7 @@ def _paged_decode_chunk_kernel(params, pool, active, key, cfg, n_steps,
             1, slot_mask,
         )
         p = jnp.minimum(pos, cfg.max_position - 1)
-        x = (params["wte"][tok][:, None, :]
+        x = (_tok_embed(params, tok)[:, None, :]
              + params["wpe"][p][:, None, :]).astype(cfg.dtype)
         # each lane's write column in PHYSICAL coordinates: the block
         # table maps its logical block, the remainder is the in-block
@@ -1756,7 +1884,7 @@ def _draft_scan(params, cfg: DecoderConfig, kd, vd, ksd, vsd, slot_mask,
         kd, vd, ksd, vsd, tok = carry
         col = jnp.minimum(w + j, C - 1)
         p = jnp.clip(pos + j, 0, cfg.max_position - 1)
-        x = (params["wte"][tok][:, None, :]
+        x = (_tok_embed(params, tok)[:, None, :]
              + params["wpe"][p][:, None, :]).astype(cfg.dtype)
         # attend the live cache plus every column this cycle already
         # wrote (w..col) — the draft's own freshly-drafted context
@@ -1892,7 +2020,7 @@ def pool_decode_spec(params: dict, pool: dict, active: jax.Array,
         )
         u = jnp.concatenate([t0[:, None], drafts], axis=1)  # (B, k+1)
         p = jnp.clip(pos[:, None] + offs[None, :], 0, cfg.max_position - 1)
-        x = (params["wte"][u] + params["wpe"][p]).astype(cfg.dtype)
+        x = (_tok_embed(params, u) + params["wpe"][p]).astype(cfg.dtype)
         qcol = w[:, None] + offs[None, :]  # (B, k+1) per-query column
         # query i attends the live cache plus this cycle's columns up to
         # its own (w..w+i) — causal within the speculated window, the

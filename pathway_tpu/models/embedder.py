@@ -347,7 +347,6 @@ class SentenceEmbedderModel:
         self.max_length = max_length
         if params is None:
             params = init_params(jax.random.PRNGKey(seed), cfg)
-        self.params = cast_params_for_inference(params, cfg)
         # serving mesh (PATHWAY_TPU_MESH): encoder params commit onto
         # the (data, fsdp, tp) mesh with the Megatron NamedSharding
         # layout; embed dispatches then run GSPMD-partitioned. Off-mesh
@@ -359,6 +358,19 @@ class SentenceEmbedderModel:
         # up a flipped env var without invalidating other instances
         from pathway_tpu.internals.config import pathway_config
 
+        # weight-only int8 (PATHWAY_TPU_WEIGHT_QUANT): the word table
+        # and layer matmul weights store int8 + f32 scales, dequantized
+        # inside the einsum read; scales come from the ORIGINAL params,
+        # the compute-dtype cast covers everything else
+        self.weight_quant = str(pathway_config.weight_quant or "")
+        if self.weight_quant:
+            from pathway_tpu.models.transformer import quantize_encoder_params
+
+            self.params = quantize_encoder_params(
+                params, out=cast_params_for_inference(params, cfg)
+            )
+        else:
+            self.params = cast_params_for_inference(params, cfg)
         self.flash_prefill = bool(pathway_config.flash_prefill)
         if self.flash_prefill:
             from pathway_tpu.models import flash_attention as _fa
@@ -370,6 +382,13 @@ class SentenceEmbedderModel:
             from pathway_tpu.models.transformer import shard_encoder_params
 
             self.params = shard_encoder_params(self.params, cfg, self.mesh)
+        # HBM ledger: the embedder's physical param footprint (int8
+        # payloads + scales when quantized), per device, at placement
+        from pathway_tpu.engine.probes import record_hbm
+        from pathway_tpu.models.decoder import params_device_bytes
+
+        for dev, nbytes in params_device_bytes(self.params).items():
+            record_hbm("weights.embedder", nbytes, device=dev)
         self._pipeline: _IngestPipeline | None = None
         self._pipeline_lock = threading.Lock()
         self._late_proj = None  # (hidden, dc), built at first token submit

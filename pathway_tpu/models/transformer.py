@@ -125,6 +125,75 @@ def param_partition_specs(cfg: TransformerConfig, tp_axis: str = "tp") -> dict:
     }
 
 
+# ---- weight-only int8 quantization (PATHWAY_TPU_WEIGHT_QUANT=int8) --------
+#
+# Encoder counterpart of the decoder's quantize_params seam: the four
+# stacked layer matmul weights and the word-embedding table store as
+# symmetric per-output-channel int8 (scale = max|w| / 127 over the
+# CONTRACTED axis) with dequant fused into the einsum read — int8 payload
+# in the compute dtype (int8 values <= 127 are exact in bf16), f32
+# accumulation, per-output-channel scale on the OUTPUT. Presence of a
+# ``word_scale`` key under ``embeddings`` is the static format marker;
+# without it every expression below is byte-identical to the historical
+# encoder. Position/type embeddings, layernorms and the pooler stay
+# full-precision (tiny, and the pooler feeds a tanh in f32).
+
+_WQ_QMAX = 127.0
+_WQ_SCALE_FLOOR = 1e-8
+_WQ_ENC_LAYER_WEIGHTS = ("qkv_w", "attn_out_w", "mlp_in_w", "mlp_out_w")
+
+
+def _wq_quant(w, axis: int):
+    """Symmetric int8 over the contracted ``axis``; scale keeps a size-1
+    dim there (one f32 scale per output channel). Never clips."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax / _WQ_QMAX, _WQ_SCALE_FLOOR)
+    return jnp.round(wf / scale).astype(jnp.int8), scale
+
+
+def encoder_params_quantized(params: dict) -> bool:
+    """True when ``params`` store int8 weights
+    (:func:`quantize_encoder_params`)."""
+    return "word_scale" in params["embeddings"]
+
+
+def quantize_encoder_params(params: dict, out: dict | None = None) -> dict:
+    """int8-quantize the large encoder weights for serving: the word
+    table per vocab row, each stacked layer weight per output channel.
+    Quantize from the ORIGINAL full-precision ``params`` — an already-
+    cast copy would bake the cast's mantissa loss into the scales.
+    ``out`` optionally supplies the base tree the unquantized leaves are
+    taken from (the embedder passes its compute-dtype cast), so quant
+    payloads/scales stay int8/f32 while everything else keeps the
+    caller's storage treatment."""
+    out = dict(out if out is not None else params)
+    emb = dict(out["embeddings"])
+    emb["word"], emb["word_scale"] = _wq_quant(params["embeddings"]["word"],
+                                               axis=-1)
+    out["embeddings"] = emb
+    layers = dict(out["layers"])
+    for name in _WQ_ENC_LAYER_WEIGHTS:
+        q, s = _wq_quant(params["layers"][name], axis=-2)
+        layers[name], layers[name + "_scale"] = q, s
+    out["layers"] = layers
+    return out
+
+
+def _wq_einsum(eq: str, x, lp: dict, name: str, cfg: TransformerConfig):
+    """The encoder's weight-matmul seam: historical unquantized ops when
+    ``lp`` has no ``{name}_scale`` key (byte-identical), fused-dequant
+    int8 read when it does."""
+    w = lp[name]
+    scale = lp.get(name + "_scale")
+    if scale is None:
+        return jnp.einsum(eq, x, w.astype(cfg.dtype),
+                          preferred_element_type=cfg.dtype)
+    out = jnp.einsum(eq, x, w.astype(cfg.dtype),
+                     preferred_element_type=jnp.float32)
+    return (out * scale).astype(cfg.dtype)
+
+
 def validate_encoder_mesh(cfg: TransformerConfig, mesh) -> None:
     """Typed ``MeshShapeError`` when ``cfg`` cannot shard over the
     serving mesh's tp axis (heads, ffn features, vocab must divide)."""
@@ -169,17 +238,28 @@ def shard_encoder_params(params: dict, cfg: TransformerConfig,
         return params
     fsdp = int(mesh.shape.get(SERVE_FSDP_AXIS, 1))
     specs = param_partition_specs(cfg, tp_axis=SERVE_TP_AXIS)
-    is_spec = lambda x: x is None or isinstance(x, P)
-    leaves, treedef = jax.tree_util.tree_flatten(params)
-    spec_leaves = jax.tree_util.tree_flatten(specs, is_leaf=is_spec)[0]
-    overlaid = [
-        spec_with_fsdp(
+
+    def leaf_spec(path, leaf):
+        node = specs
+        for key in path[:-1]:
+            node = node[key.key]
+        name = path[-1].key
+        if name in node:
+            s = node[name]
+        elif name.endswith("_scale") and name[: -len("_scale")] in node:
+            # int8 weight-quant scale plane: inherit the payload's spec
+            # (non-dividing axes drop below, so the keepdims size-1
+            # contracted dim replicates and the output-channel dim keeps
+            # its shard, co-locating scale rows with their int8 columns)
+            s = node[name[: -len("_scale")]]
+        else:
+            raise KeyError(f"no partition spec for encoder param {name!r}")
+        return spec_with_fsdp(
             spec_dropping_nondividing(s, leaf.shape, mesh), leaf.shape, fsdp
         )
-        for leaf, s in zip(leaves, spec_leaves)
-    ]
+
     return place_pytree(
-        params, mesh, jax.tree_util.tree_unflatten(treedef, overlaid)
+        params, mesh, jax.tree_util.tree_map_with_path(leaf_spec, params)
     )
 
 
@@ -248,8 +328,7 @@ def _attention(x, lp, mask_bias, cfg: TransformerConfig, core=None):
     drift vs the all-f32-intermediate path. f32 configs are bit-unchanged."""
     B, S, H = x.shape
     nh, hd = cfg.heads, cfg.head_dim
-    qkv = jnp.einsum("bsh,hk->bsk", x, lp["qkv_w"].astype(cfg.dtype),
-                     preferred_element_type=cfg.dtype)
+    qkv = _wq_einsum("bsh,hk->bsk", x, lp, "qkv_w", cfg)
     qkv = qkv + lp["qkv_b"].astype(cfg.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
@@ -276,8 +355,7 @@ def _attention(x, lp, mask_bias, cfg: TransformerConfig, core=None):
         ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, v,
                          preferred_element_type=jnp.float32).astype(cfg.dtype)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H)
-    out = jnp.einsum("bsh,hk->bsk", ctx, lp["attn_out_w"].astype(cfg.dtype),
-                     preferred_element_type=cfg.dtype)
+    out = _wq_einsum("bsh,hk->bsk", ctx, lp, "attn_out_w", cfg)
     return out + lp["attn_out_b"].astype(cfg.dtype)
 
 
@@ -285,11 +363,9 @@ def _layer(x, lp, mask_bias, cfg: TransformerConfig, core=None):
     attn = _attention(x, lp, mask_bias, cfg, core=core)
     x = _layer_norm(x + attn, lp["ln1_scale"],
                     lp["ln1_bias"], cfg.layer_norm_eps).astype(cfg.dtype)
-    h = jnp.einsum("bsh,hi->bsi", x, lp["mlp_in_w"].astype(cfg.dtype),
-                   preferred_element_type=cfg.dtype)
+    h = _wq_einsum("bsh,hi->bsi", x, lp, "mlp_in_w", cfg)
     h = _gelu(h + lp["mlp_in_b"].astype(cfg.dtype), cfg)
-    h = jnp.einsum("bsi,ih->bsh", h, lp["mlp_out_w"].astype(cfg.dtype),
-                   preferred_element_type=cfg.dtype)
+    h = _wq_einsum("bsi,ih->bsh", h, lp, "mlp_out_w", cfg)
     h = h + lp["mlp_out_b"].astype(cfg.dtype)
     x = _layer_norm(x + h, lp["ln2_scale"],
                     lp["ln2_bias"], cfg.layer_norm_eps).astype(cfg.dtype)
@@ -307,7 +383,12 @@ def embed_inputs(params: dict, input_ids: jax.Array,
     pair inputs pass segment ids so pretrained type embeddings apply."""
     B, S = input_ids.shape
     emb = params["embeddings"]
-    x = emb["word"][input_ids] + emb["position"][jnp.arange(S)][None, :, :]
+    rows = emb["word"][input_ids]
+    ws = emb.get("word_scale")
+    if ws is not None:
+        # dequant fused into the row gather — O(rows), never the table
+        rows = rows.astype(jnp.float32) * ws[input_ids]
+    x = rows + emb["position"][jnp.arange(S)][None, :, :]
     if token_type_ids is None:
         x = x + emb["type"][jnp.zeros((B, S), jnp.int32)]
     else:

@@ -294,6 +294,8 @@ class TPUDecoderChat(BaseChat):
         tenant_weights: str | None = None,
         prefix_t2_mb: float | None = None,
         mesh=None,
+        weight_quant: str | bool | None = None,
+        wq_kernel: bool | None = None,
     ):
         # continuous=True: requests are served by a persistent slot-pool
         # loop (_ContinuousServer) — new rows admit into the IN-FLIGHT
@@ -328,12 +330,46 @@ class TPUDecoderChat(BaseChat):
             )
         import jax
 
-        from pathway_tpu.models.decoder import cast_params_for_inference
+        from pathway_tpu.internals import config as _config_mod
+        from pathway_tpu.internals.config import pathway_config
+        from pathway_tpu.models.decoder import (
+            cast_params_for_inference,
+            params_device_bytes,
+            quantize_params,
+        )
 
-        # compute-dtype weights: the decode phase reads the full parameter
-        # set per step, so bf16 storage halves its HBM bill (no-op for
-        # f32 configs)
-        self.params = jax.device_put(cast_params_for_inference(params, cfg))
+        # weight-only int8 (PATHWAY_TPU_WEIGHT_QUANT): the large decoder
+        # matrices store as symmetric per-output-channel int8 with f32
+        # scales, dequantized inside the matmul read — ~4× fewer weight
+        # bytes per decode step on a memory-bound roofline
+        wq = pathway_config.weight_quant if weight_quant is None else weight_quant
+        wq = "int8" if wq is True else ("" if wq in (False, None) else wq)
+        self.weight_quant = _config_mod._parse_weight_quant(str(wq))
+        wqk = pathway_config.wq_kernel if wq_kernel is None else bool(wq_kernel)
+        self.wq_kernel = bool(self.weight_quant) and bool(wqk)
+        if self.wq_kernel:
+            # a CONFIG field, not a module global: jit caches built for
+            # this server key on it, so a rebuilt server cannot serve
+            # stale kernel-less traces
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, wq_kernel=True)
+        if self.weight_quant:
+            self.params = jax.device_put(quantize_params(params, cfg))
+        else:
+            # compute-dtype weights: the decode phase reads the full
+            # parameter set per step, so bf16 storage halves its HBM bill
+            # (no-op for f32 configs)
+            self.params = jax.device_put(cast_params_for_inference(params, cfg))
+        # HBM ledger: the decoder's physical param footprint (int8
+        # payloads + scales when quantized) at placement — the bench
+        # quant arm reads its bytes-saved headline from this gauge. The
+        # continuous server re-records after mesh sharding with the
+        # real per-device split.
+        from pathway_tpu.engine.probes import record_hbm
+
+        for dev, nbytes in params_device_bytes(self.params).items():
+            record_hbm("weights.decoder", nbytes, device=dev)
         self.cfg = cfg
         self.tokenizer = tokenizer
         self.max_new_tokens = int(max_new_tokens)
@@ -388,6 +424,7 @@ class TPUDecoderChat(BaseChat):
                 tenant_weights=tenant_weights,
                 prefix_t2_mb=prefix_t2_mb,
                 mesh=mesh,
+                weight_quant=self.weight_quant,
             )
             # the two-phase engine protocol only exists in continuous
             # mode — exposing these as CLASS methods would activate the
@@ -712,7 +749,8 @@ class _ContinuousServer:
                  tenant_budget: int | None = None,
                  tenant_weights: str | None = None,
                  prefix_t2_mb: float | None = None,
-                 mesh=None):
+                 mesh=None,
+                 weight_quant: str = ""):
         import threading
         from collections import deque
 
@@ -1040,6 +1078,10 @@ class _ContinuousServer:
         from pathway_tpu.parallel.mesh import serving_mesh_from_flags
 
         self.mesh = mesh if mesh is not None else serving_mesh_from_flags()
+        # already-quantized params arrive from TPUDecoderChat; the string
+        # is carried for stats/traces only — the format marker on the
+        # pytree itself (``wte_scale``) is what the forward paths read
+        self.weight_quant = weight_quant
         if self.mesh is not None:
             self.params = decoder_mod.shard_decoder_params(
                 self.params, cfg, self.mesh
@@ -1072,6 +1114,13 @@ class _ContinuousServer:
         ).items():
             for dev, nbytes in per_dev.items():
                 record_hbm(comp, nbytes, device=dev)
+        # the decoder weights component, re-recorded post-shard so the
+        # per-device split reflects the actual mesh placement (TPUDecoder
+        # Chat recorded the pre-shard single-device view at device_put)
+        for dev, nbytes in decoder_mod.params_device_bytes(
+            self.params
+        ).items():
+            record_hbm("weights.decoder", nbytes, device=dev)
         self._admit_fns: dict = {}
         self._admit_batch_fns: dict = {}
         self._prefill_fns: dict = {}

@@ -64,6 +64,9 @@ def test_bench_smoke_schema():
         # flash prefill (ISSUE 18): tiled online-softmax sweep, flash vs
         # dense at every seq with linear-not-quadratic byte accounting
         "flash_prefill",
+        # weight-only int8 (ISSUE 19): fused-dequant serving arm vs full
+        # precision — bytes saved off the weights ledger + top-1 agreement
+        "weight_quant",
     ):
         assert s.get(key) is not None, key
     # the --tuned arm: both profiles ran both legs, the measured config
@@ -116,6 +119,15 @@ def test_bench_smoke_schema():
                   fp["sweep"][b]["attn_bytes_dense"])
         assert fb <= 3 * fa, (fa, fb)       # linear: ~2x per doubling
         assert db == pytest.approx(4 * da), (da, db)  # dense: quadratic
+    # weight-only int8 (ISSUE 19): both arms decoded, the int8 arm's
+    # weights ledger footprint shrank >= 1.7x, and its greedy stream
+    # agreed with full precision at >= 0.99 top-1
+    wq = s["weight_quant"]
+    assert wq.get("error") is None, wq
+    assert wq["quant_tok_s"] > 0 and wq["base_tok_s"] > 0
+    assert wq["weights_hbm_bytes_base"] > wq["weights_hbm_bytes_quant"] > 0
+    assert wq["bytes_saved_x"] >= 1.7
+    assert wq["agreement"] >= 0.99
     assert 0.0 <= s["knn_recall_at_10_f32"] <= 1.0
     # the query-serving phase ran under load: a survivor rate strictly
     # inside (0, 1] and a non-empty tick batch histogram

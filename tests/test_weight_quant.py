@@ -1,0 +1,419 @@
+"""Weight-only int8 quantization (PATHWAY_TPU_WEIGHT_QUANT=int8):
+symmetric per-output-channel scales for every large weight matrix in the
+decoder, the MiniLM embedder, and the cross-encoder, with the dequant
+fused into the matmul read (``_wq_matmul`` / ``_wq_einsum``), plus the
+optional Pallas fused kernel behind PATHWAY_TPU_WQ_KERNEL.
+
+Pinned here: the kill switch is byte-identical to the bf16/f32 serving
+path, the footprint claim (>= 1.7x weights bytes saved on the HBM
+ledger), the quality bound (>= 0.99 greedy top-1 agreement), that the
+quantized weights compose with spec decode x paged/int8 KV x flash
+prefill x prefix cache x the 8-device mesh, and that quantized
+checkpoints roundtrip bitwise (and refuse to load with the flag off)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.internals.config import pathway_config
+from pathway_tpu.models import decoder as D
+from pathway_tpu.models import transformer as T
+from tests.utils import ToyCharTokenizer
+
+TINY = D.DecoderConfig(
+    vocab_size=128, hidden=32, layers=2, heads=4, intermediate=64,
+    max_position=128, dtype=jnp.float32,
+)
+# serving-shaped bf16 checkpoint: the footprint claim at the dtype the
+# flag actually targets (int8 + f32 scales vs bf16 payloads)
+BF16 = D.DecoderConfig(
+    vocab_size=128, hidden=256, layers=2, heads=4, intermediate=256,
+    max_position=128, dtype=jnp.bfloat16,
+)
+ENC = T.TransformerConfig(
+    vocab_size=128, hidden=32, layers=2, heads=4, intermediate=64,
+    max_position=64, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return D.init_params(jax.random.PRNGKey(0), TINY)
+
+
+@pytest.fixture(scope="module")
+def enc_params():
+    return T.init_params(jax.random.PRNGKey(1), ENC)
+
+
+# -- quant mechanics ---------------------------------------------------------
+
+
+def test_wq_roundtrip_error_bounded():
+    """Symmetric int8 with a per-output-channel scale: worst-case abs
+    error is half a quantization step of that channel's own max."""
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(0, 0.4, (64, 48)).astype(np.float32))
+    q, s = D._wq_quant(w, axis=-2)
+    assert q.dtype == jnp.int8 and s.shape == (1, 48)
+    err = np.abs(np.asarray(q, np.float32) * np.asarray(s) - np.asarray(w))
+    assert (err <= 0.5 * np.asarray(s) + 1e-6).all()
+
+
+def test_quantize_params_marker_and_dtypes(tiny_params):
+    plain = D.cast_params_for_inference(tiny_params, TINY)
+    assert not D.params_quantized(plain)
+    qp = D.quantize_params(tiny_params, TINY)
+    assert D.params_quantized(qp)
+    assert qp["wte"].dtype == jnp.int8
+    assert qp["wte_scale"].dtype == jnp.float32
+    assert qp["wte_scale"].shape == (TINY.vocab_size, 1)
+    for name in D._WQ_LAYER_WEIGHTS:
+        assert qp["layers"][name].dtype == jnp.int8
+        s = qp["layers"][name + "_scale"]
+        assert s.dtype == jnp.float32
+        # per-layer slice of the scan-stacked scale broadcasts over (B,S)
+        assert s.shape == (TINY.layers, 1,
+                           tiny_params["layers"][name].shape[-1])
+    # everything NOT on the quant list keeps the inference cast untouched
+    assert qp["wpe"].dtype == plain["wpe"].dtype
+    assert qp["layers"]["ln1_scale"].dtype == plain["layers"]["ln1_scale"].dtype
+
+
+def test_weights_bytes_saved_at_least_1_7x():
+    """The HBM claim at serving dtype: int8 payloads + f32 scales store
+    the bf16 checkpoint in >= 1.7x fewer bytes (f32 checkpoints save
+    more)."""
+    for cfg in (BF16, TINY):
+        params = D.init_params(jax.random.PRNGKey(0), cfg)
+        base = sum(D.params_device_bytes(
+            D.cast_params_for_inference(params, cfg)).values())
+        quant = sum(D.params_device_bytes(
+            D.quantize_params(params, cfg)).values())
+        assert base / quant >= 1.7, (cfg.dtype, base, quant)
+
+
+def test_forward_top1_agreement(tiny_params):
+    """Greedy prefill logits: the quantized forward agrees with full
+    precision >= 99% top-1 over a batch of random prompts."""
+    rng = np.random.default_rng(7)
+    ids = jnp.asarray(rng.integers(1, 97, (4, 32)), jnp.int32)
+    mask = jnp.ones((4, 32), jnp.int32)
+    ref, _ = D.prefill(
+        D.cast_params_for_inference(tiny_params, TINY), ids, mask, TINY, 32)
+    got, _ = D.prefill(
+        D.quantize_params(tiny_params, TINY), ids, mask, TINY, 32)
+    agree = (jnp.argmax(ref, -1) == jnp.argmax(got, -1)).mean()
+    assert float(agree) >= 0.99
+
+
+def test_wq_kernel_matches_einsum_path(tiny_params):
+    """PATHWAY_TPU_WQ_KERNEL: the Pallas fused int8-weight matmul
+    (interpreter off-TPU) emits the einsum dequant path's logits."""
+    import dataclasses
+
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(1, 97, (2, 16)), jnp.int32)
+    mask = jnp.ones((2, 16), jnp.int32)
+    qp = D.quantize_params(tiny_params, TINY)
+    ref, _ = D.prefill(qp, ids, mask, TINY, 16)
+    kcfg = dataclasses.replace(TINY, wq_kernel=True)
+    got, _ = D.prefill(qp, ids, mask, kcfg, 16)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_wq_matmul_kernel_odd_shapes():
+    """The standalone kernel pads ragged M/N to tile multiples and
+    slices back — exact vs the reference f32 matmul."""
+    from pathway_tpu.models.wq_matmul import wq_matmul
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(0, 1, (13, 32)).astype(np.float32))
+    w8 = jnp.asarray(rng.integers(-127, 128, (32, 27)), jnp.int8)
+    s = jnp.asarray(rng.uniform(1e-3, 1e-1, (1, 27)).astype(np.float32))
+    got = wq_matmul(x, w8, s, interpret=True)
+    want = (x @ w8.astype(jnp.float32)) * s
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+# -- encoder seam ------------------------------------------------------------
+
+
+def test_encoder_quant_marker_and_quality(enc_params):
+    assert not T.encoder_params_quantized(enc_params)
+    qp = T.quantize_encoder_params(enc_params)
+    assert T.encoder_params_quantized(qp)
+    assert qp["embeddings"]["word"].dtype == jnp.int8
+    assert qp["embeddings"]["word_scale"].dtype == jnp.float32
+    rng = np.random.default_rng(11)
+    ids = jnp.asarray(rng.integers(1, 97, (3, 24)), jnp.int32)
+    mask = jnp.ones((3, 24), jnp.int32)
+    ref = T.encode(enc_params, ids, mask, ENC)
+    got = T.encode(qp, ids, mask, ENC)
+    a = np.asarray(ref).reshape(3, -1)
+    b = np.asarray(got).reshape(3, -1)
+    cos = (a * b).sum(-1) / (
+        np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1)
+    )
+    assert (cos >= 0.99).all()
+
+
+# -- serving -----------------------------------------------------------------
+
+
+PROMPTS = ["hello world", "weight quant", "abc", "qrs tuv"]
+HEAD = "x" * 56
+
+
+def _serve(tiny_params, prompts, **kw):
+    from pathway_tpu.xpacks.llm.llms import TPUDecoderChat
+
+    chat = TPUDecoderChat(
+        params=tiny_params, cfg=TINY, tokenizer=ToyCharTokenizer(96),
+        max_new_tokens=10, temperature=0.0, max_prompt_tokens=96,
+        continuous=True, n_slots=4, chunk_steps=4, pipeline_depth=2,
+        prefill_chunk=8, **kw,
+    )
+    try:
+        out = []
+        for p in prompts:
+            r = chat.submit_batch([p])[0]
+            assert r.done.wait(timeout=180)
+            out.append(r.text)
+        return out, dict(chat._server.stats), chat._server
+    finally:
+        chat.close()
+
+
+@pytest.fixture(scope="module")
+def plain_burst(tiny_params):
+    """One full-precision serving pass over PROMPTS (explicit
+    weight_quant=''), shared by the kill-switch and quality tests."""
+    texts, _, _ = _serve(tiny_params, PROMPTS, weight_quant="")
+    return texts
+
+
+def test_kill_switch_byte_equality(tiny_params, plain_burst, monkeypatch):
+    """PATHWAY_TPU_WEIGHT_QUANT unset/0: params keep the historical
+    inference cast and serving output is byte-identical to an explicit
+    weight_quant='' server (PATHWAY_TPU_WQ_KERNEL is inert without it)."""
+    monkeypatch.setenv("PATHWAY_TPU_WEIGHT_QUANT", "0")
+    monkeypatch.setenv("PATHWAY_TPU_WQ_KERNEL", "0")
+    off, _, srv = _serve(tiny_params, PROMPTS, weight_quant=None)
+    assert srv.weight_quant == ""
+    assert not D.params_quantized(srv.params)
+    assert off == plain_burst
+
+
+def test_env_flag_enables_quant(tiny_params, monkeypatch):
+    monkeypatch.setenv("PATHWAY_TPU_WEIGHT_QUANT", "int8")
+    _, _, srv = _serve(tiny_params, PROMPTS[:1], weight_quant=None)
+    assert srv.weight_quant == "int8"
+    assert D.params_quantized(srv.params)
+
+
+def test_wq_kernel_serving_matches(tiny_params):
+    """The fused Pallas kernel (interpreter on CPU) serves the exact
+    einsum-dequant token streams."""
+    a, _, _ = _serve(tiny_params, PROMPTS[:2], weight_quant="int8",
+                     wq_kernel=False)
+    b, _, _ = _serve(tiny_params, PROMPTS[:2], weight_quant="int8",
+                     wq_kernel=True)
+    assert a == b
+
+
+@pytest.mark.parametrize("paged_kv,kv_quant", [(False, ""), (True, "int8")])
+def test_quant_composes_with_spec_prefix_paged_kvq_flash(
+    tiny_params, paged_kv, kv_quant
+):
+    """The composition grid: int8 weights x spec decode x prefix cache x
+    {dense, paged} x {bf16, int8} KV x flash prefill — spec on/off arms
+    on the SAME quantized weights emit identical greedy streams, and the
+    prefix/spec machinery actually engaged.  Two corner combos (dense KV
+    in bf16, paged KV in int8) bound the grid inside the tier-1 budget;
+    the cross terms share all the same code paths."""
+    prompts = [HEAD + f"q{k:02d}xx" for k in range(4)]
+    kw = dict(weight_quant="int8", prefix_cache=True, paged_kv=paged_kv,
+              kv_quant=kv_quant, flash_prefill=True)
+    a, _, _ = _serve(tiny_params, prompts, spec_decode=False, **kw)
+    b, stats, _ = _serve(tiny_params, prompts, spec_decode=True, **kw)
+    assert stats["prefix_hit_requests"] > 0
+    assert stats["spec_dispatches"] > 0
+    assert a == b
+
+
+def test_quant_serving_quality(tiny_params, plain_burst):
+    """End-to-end top-1 agreement between int8-weight and full-precision
+    serving stays >= 0.99 over the burst."""
+    quant, _, _ = _serve(tiny_params, PROMPTS, weight_quant="int8")
+    ref = "".join(plain_burst)
+    got = "".join(quant)
+    agree = sum(x == y for x, y in zip(ref, got)) / max(len(ref), 1)
+    assert len(got) == len(ref) and agree >= 0.99
+
+
+# -- mesh sharding -----------------------------------------------------------
+
+
+def _mesh8():
+    from pathway_tpu.parallel.mesh import make_serving_mesh
+
+    return make_serving_mesh(jax.devices(), data=1, fsdp=2, tp=4)
+
+
+def _mesh1():
+    from pathway_tpu.parallel.mesh import make_serving_mesh
+
+    return make_serving_mesh(jax.devices()[:1], data=1, fsdp=1, tp=1)
+
+
+def test_mesh8_quant_serving_matches_single_chip(tiny_params):
+    """int8 weights on the 8-device (data=1, fsdp=2, tp=4) mesh: scale
+    planes shard with their payloads and greedy tokens match the
+    single-chip quantized transcript."""
+    base, _, _ = _serve(tiny_params, PROMPTS, weight_quant="int8")
+    on_mesh, _, srv = _serve(tiny_params, PROMPTS, weight_quant="int8",
+                             mesh=_mesh8())
+    assert on_mesh == base
+    # the tp-sharded qkv payload and its scale landed on every device
+    qkv = srv.params["layers"]["qkv_w"]
+    assert qkv.dtype == jnp.int8
+    assert not qkv.sharding.is_fully_replicated
+    per_dev = D.params_device_bytes(srv.params)
+    assert set(per_dev) >= {str(i) for i in range(8)}
+
+
+def test_mesh8_param_specs_cover_scales(tiny_params):
+    """param_mesh_specs emits a spec for every quantized leaf — scale
+    planes get their payload's spec with non-dividing axes dropped."""
+    qp = D.quantize_params(tiny_params, TINY)
+    specs = D.param_mesh_specs(qp, TINY, _mesh8())
+    flat_p = jax.tree_util.tree_leaves(qp)
+    flat_s = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: x is None or not isinstance(x, dict))
+    assert len(flat_p) == len(flat_s)
+
+
+def test_mesh8_quant_encoder_matches_host(enc_params):
+    """Sharded quantized encoder params (word_scale included) encode the
+    host-placement outputs exactly."""
+    qp = T.quantize_encoder_params(enc_params)
+    rng = np.random.default_rng(13)
+    ids = jnp.asarray(rng.integers(1, 97, (2, 16)), jnp.int32)
+    mask = jnp.ones((2, 16), jnp.int32)
+    want = T.encode(qp, ids, mask, ENC)
+    sharded = T.shard_encoder_params(qp, ENC, _mesh8())
+    got = T.encode(sharded, ids, mask, ENC)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=1e-5, atol=1e-5)
+
+
+# -- quantized checkpoints (satellite) ---------------------------------------
+
+
+def _flat_host(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def test_quantized_checkpoint_roundtrip_bitwise(tiny_params, tmp_path,
+                                                monkeypatch):
+    """save-quantized -> load host / 1x1x1 / 8-mesh: every direction
+    gathers back bitwise-equal int8 payloads + f32 scales, and the
+    layout sidecar records the quantized format."""
+    from pathway_tpu.models import checkpoint as C
+
+    monkeypatch.setenv("PATHWAY_TPU_WEIGHT_QUANT", "int8")
+    qp = D.quantize_params(tiny_params, TINY)
+    path = str(tmp_path / "wq_ckpt")
+    C.save_checkpoint(path, qp)
+    assert C.checkpoint_layout(path)["weight_quant"] == "int8"
+
+    want = _flat_host(qp)
+    host = C.load_checkpoint(path)
+    for a, b in zip(_flat_host(host), want):
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == b.dtype
+
+    on_one = C.load_checkpoint(path, mesh=_mesh1())
+    for a, b in zip(_flat_host(on_one), want):
+        np.testing.assert_array_equal(a, b)
+
+    specs = D.param_mesh_specs(qp, TINY, _mesh8())
+    on_mesh = C.load_checkpoint(path, mesh=_mesh8(), specs=specs)
+    for a, b in zip(_flat_host(on_mesh), want):
+        np.testing.assert_array_equal(a, b)
+    assert not on_mesh["layers"]["qkv_w"].sharding.is_fully_replicated
+
+
+def test_quantized_checkpoint_flag_off_raises(tiny_params, tmp_path,
+                                              monkeypatch):
+    """A quantized artifact refuses to load while the flag is off — a
+    typed error instead of silently serving int8 through a server that
+    thinks it has plain weights."""
+    from pathway_tpu.models import checkpoint as C
+
+    monkeypatch.setenv("PATHWAY_TPU_WEIGHT_QUANT", "int8")
+    path = str(tmp_path / "wq_ckpt_off")
+    C.save_checkpoint(path, D.quantize_params(tiny_params, TINY))
+
+    monkeypatch.setenv("PATHWAY_TPU_WEIGHT_QUANT", "0")
+    with pytest.raises(C.QuantizedCheckpointError):
+        C.load_checkpoint(path)
+    with pytest.raises(C.QuantizedCheckpointError):
+        C.load_checkpoint(path, mesh=_mesh1())
+
+
+# -- HBM ledger (satellite) --------------------------------------------------
+
+
+def test_weights_ledger_components(tiny_params, monkeypatch):
+    """Every model records its physical param bytes at placement:
+    weights.decoder / weights.embedder / weights.reranker appear in
+    hbm_stats()['current_bytes'], and the quantized decoder entry is
+    >= 1.7x smaller than full precision."""
+    from pathway_tpu.engine import probes
+
+    def comp(name):
+        return int(
+            (probes.hbm_stats().get("current_bytes") or {}).get(name) or 0
+        )
+
+    # the gauge is SET per (component, device): clear residue earlier
+    # mesh arms left on devices 1..7 so the single-chip pair is clean
+    probes.reset_hbm_stats()
+    _serve(tiny_params, PROMPTS[:1], weight_quant="")
+    base = comp("weights.decoder")
+    _serve(tiny_params, PROMPTS[:1], weight_quant="int8")
+    quant = comp("weights.decoder")
+    assert base > quant > 0
+    assert base / quant >= 1.7
+
+    monkeypatch.setenv("PATHWAY_TPU_WEIGHT_QUANT", "int8")
+    from pathway_tpu.models.cross_encoder import CrossEncoderModel
+    from pathway_tpu.models.embedder import SentenceEmbedderModel
+
+    SentenceEmbedderModel(cfg=ENC)
+    assert comp("weights.embedder") > 0
+    CrossEncoderModel(cfg=ENC)
+    assert comp("weights.reranker") > 0
+
+
+# -- flag registration (satellite) -------------------------------------------
+
+
+def test_flags_registered_and_tunable():
+    """PATHWAY_TPU_WEIGHT_QUANT is a construction-reload kill-switch
+    choice tunable {0, int8}; PATHWAY_TPU_WQ_KERNEL is its bool rider."""
+    from pathway_tpu.internals import config as C
+
+    f = C._REGISTRY_BY_ENV["PATHWAY_TPU_WEIGHT_QUANT"]
+    assert f.kill_switch and f.reload == "construction"
+    assert f.tunable is not None and f.tunable.kind == "choice"
+    assert set(f.tunable.choices) == {"0", "int8"}
+    k = C._REGISTRY_BY_ENV["PATHWAY_TPU_WQ_KERNEL"]
+    assert k.kill_switch and k.reload == "construction"
+    assert pathway_config.weight_quant in ("", "int8")
